@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched TPU scheduling throughput.
+
+scheduler_perf-analog workload (BASELINE.md config 2 shape: NodeResourcesFit-only,
+homogeneous requests): 5000 pending pods vs 1000 nodes, full filter+score+commit
+with exact sequential semantics.  Metric: pods scheduled per second, steady-state
+(post-compile), best of 3.
+
+vs_baseline: the reference default scheduler's scheduler_perf throughput on
+simple profiles is O(100-300) pods/s (BASELINE.md "typical" row; no published
+table exists for the fork) — vs_baseline = value / 300 (the generous end).
+
+Prints exactly one JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+N_NODES = 1000
+N_PODS = 5000
+BASELINE_PODS_PER_SEC = 300.0
+
+
+def main() -> None:
+    import jax
+
+    from kubernetes_tpu.api.snapshot import encode_snapshot
+    from kubernetes_tpu.bench.workloads import basic
+    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    snap = basic(N_NODES, N_PODS, seed=0)
+    t0 = time.perf_counter()
+    arr, meta = encode_snapshot(snap)
+    arr = jax.device_put(arr)
+    t_encode = time.perf_counter() - t0
+    print(f"encode: {t_encode:.3f}s  N={arr.N} P={arr.P} R={arr.R}", file=sys.stderr)
+
+    import numpy as np
+
+    # warmup / compile.  NOTE: block_until_ready can return early through the
+    # axon TPU tunnel, so timing forces a (tiny) host transfer of the choices
+    # vector — which is also what a real sidecar client would consume.
+    t0 = time.perf_counter()
+    choices = np.asarray(schedule_batch(arr, DEFAULT_SCORE_CONFIG)[0])
+    print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        choices = np.asarray(schedule_batch(arr, DEFAULT_SCORE_CONFIG)[0])
+        best = min(best, time.perf_counter() - t0)
+
+    scheduled = int((choices[: meta.n_pods] >= 0).sum())
+    pods_per_sec = meta.n_pods / best
+    print(
+        f"step: {best*1e3:.1f}ms  scheduled {scheduled}/{meta.n_pods}", file=sys.stderr
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "scheduling_throughput_5kpods_1knodes",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""kubernetes_tpu — a TPU-native scheduling framework.
+
+A brand-new framework with the capabilities of the reference Kubernetes fork
+(zizhuo-yan/kubernetes): the kube-scheduler's per-pod Filter/Score fan-out
+(reference: pkg/scheduler/schedule_one.go — ScheduleOne) is recast as a batched
+constraint-satisfaction problem scored on TPU.  One jitted XLA program evaluates
+a (pending-pods x nodes) feasibility + score matrix for the default-profile
+plugins, then a `lax.scan` commit pass reproduces the reference's sequential
+one-pod-at-a-time semantics exactly.
+
+Plugin coverage so far (kernel + oracle, parity-tested): NodeResourcesFit
+(filter + LeastAllocated score), NodeResourcesBalancedAllocation,
+TaintToleration (filter + score), NodeAffinity required terms + nodeSelector
+(all operators), NodeName, NodeUnschedulable (toleration-aware), SchedulingGates.
+In progress (fields exist on the API types but are not yet enforced):
+PodTopologySpread, InterPodAffinity, NodePorts, preferred (soft) affinities,
+gang scheduling, preemption.
+
+Layout (SURVEY.md §7):
+  api/        cluster model: Pod/Node dataclasses + Snapshot -> device arrays (L0)
+  ops/        jitted filter/score/assignment kernels (L1-L3)
+  parallel/   device-mesh sharding: node-axis DP, ring blockwise affinity (§2.4)
+  oracle/     NumPy sequential reference scheduler — the parity oracle (L5)
+  bench/      scheduler_perf-style workload harness (L6)
+"""
+
+__version__ = "0.1.0"

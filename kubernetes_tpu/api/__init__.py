@@ -1,0 +1,18 @@
+from .types import (  # noqa: F401
+    Affinity,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinityTerm,
+    PodGroup,
+    PreferredSchedulingTerm,
+    ResourceList,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from .snapshot import ClusterArrays, Snapshot, encode_snapshot  # noqa: F401

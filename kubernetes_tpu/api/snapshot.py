@@ -1,0 +1,324 @@
+"""Snapshot -> fixed-shape device arrays.
+
+Analog of the reference scheduler's cache snapshot (pkg/scheduler/backend/cache/
+snapshot.go — UpdateSnapshot; NodeInfo in pkg/scheduler/framework/types.go): the
+host-side cluster state is lowered once per scheduling step into padded, bucketed
+arrays so the jitted kernels see static shapes (pad-and-bucket is the TPU answer
+to pod/node churn — SURVEY.md §7 hard part 2).
+
+Array schema (N nodes, P pending pods, R resources, L node-label literals,
+T taint vocab, S node-selector terms, E exprs/term, TT terms/pod — all padded):
+
+  node_valid[N]        bool   real node (padding rows are infeasible everywhere)
+  node_alloc[N, R]     i32    allocatable, rescaled per-resource to fit int32
+  node_used[N, R]      i32    sum of bound pods' requests (assume-cache output)
+  node_unsched[N]      bool   spec.unschedulable
+  node_labels[N, L]    f32    0/1 literal incidence (f32: matmul operand)
+  node_taint_ns[N, T]  bool   NoSchedule/NoExecute taints (hard)
+  node_taint_pref[N,T] bool   PreferNoSchedule taints (scored)
+  pod_valid[P]         bool
+  pod_req[P, R]        i32    effective pod request (+1 synthetic "pods" resource)
+  pod_prio[P]          i32    spec.priority
+  pod_tol_ns[P, T]     bool   True = pod tolerates hard taint t
+  pod_tol_pref[P, T]   bool   True = pod tolerates PreferNoSchedule taint t
+  pod_nodename[P]      i32    fixed node index, -1 unset, -2 named node missing
+  pod_terms[P, TT]     i32    required node-selection term ids into sel_*, -1 pad
+  pod_has_sel[P]       bool
+  sel_mask[S, E, L]    f32    0/1 literal masks per term expression
+  sel_kind[S, E]       i32    vocab.KIND_* per expression
+
+Pending pods are pre-sorted into activeQ order — priority desc, then arrival
+order (reference: pkg/scheduler/backend/queue/scheduling_queue.go — the default
+queue sort plugin's Less) — so array index == commit order in ops/assign.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import types as t
+from . import vocab as v
+
+# Resources always present, in fixed axis order (extended resources appended).
+_BASE_RESOURCES = (t.CPU, t.MEMORY, t.PODS, t.EPHEMERAL_STORAGE)
+_DEFAULT_POD_LIMIT = 1_000_000  # allocatable "pods" when a node does not declare it
+_INT32_MAX = 2**31 - 1
+
+
+def _round_up_pow2(n: int, minimum: int = 8) -> int:
+    return max(minimum, 1 << max(0, math.ceil(math.log2(max(1, n)))))
+
+
+@dataclass
+class Snapshot:
+    """Host-side cluster state handed to the encoder.
+
+    `bound_pods` are pods with node_name set (running/assumed); they contribute
+    node_used and (later layers) the existing-pod side of affinity/spread.
+    """
+
+    nodes: List[t.Node] = field(default_factory=list)
+    pending_pods: List[t.Pod] = field(default_factory=list)
+    bound_pods: List[t.Pod] = field(default_factory=list)
+    pod_groups: Dict[str, t.PodGroup] = field(default_factory=dict)
+
+
+@dataclass
+class EncodingMeta:
+    """Host-side metadata needed to decode kernel outputs back to names."""
+
+    node_names: List[str]
+    pod_names: List[str]  # in activeQ order == device pod index order
+    pod_perm: np.ndarray  # pod_perm[device_pod_index] == pending_pods list index
+    resources: List[str]
+    resource_scale: np.ndarray  # i64[R]; device value * scale == canonical units
+    label_vocab: v.LabelVocab
+    taint_vocab: v.Interner
+    n_nodes: int
+    n_pods: int
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ClusterArrays:
+    """The device-side snapshot (all numpy here; kernels move to device)."""
+
+    node_valid: np.ndarray
+    node_alloc: np.ndarray
+    node_used: np.ndarray
+    node_unsched: np.ndarray
+    node_labels: np.ndarray
+    node_taint_ns: np.ndarray
+    node_taint_pref: np.ndarray
+    pod_valid: np.ndarray
+    pod_req: np.ndarray
+    pod_prio: np.ndarray
+    pod_tol_ns: np.ndarray
+    pod_tol_pref: np.ndarray
+    pod_nodename: np.ndarray
+    pod_terms: np.ndarray
+    pod_has_sel: np.ndarray
+    sel_mask: np.ndarray
+    sel_kind: np.ndarray
+
+    @property
+    def N(self) -> int:
+        return self.node_alloc.shape[0]
+
+    @property
+    def P(self) -> int:
+        return self.pod_req.shape[0]
+
+    @property
+    def R(self) -> int:
+        return self.node_alloc.shape[1]
+
+
+def _resource_axis(snap: Snapshot) -> List[str]:
+    res = list(_BASE_RESOURCES)
+    seen = set(res)
+    for obj in [*snap.nodes]:
+        for k in obj.allocatable:
+            if k not in seen:
+                seen.add(k)
+                res.append(k)
+    for pod in [*snap.pending_pods, *snap.bound_pods]:
+        for k in pod.requests:
+            if k not in seen:
+                seen.add(k)
+                res.append(k)
+    return res
+
+
+def _scale_for(values: List[int]) -> int:
+    """Exact-where-possible int32 rescale: gcd unit, widened if the max still
+    overflows (widening rounds requests up / allocatable down — conservative)."""
+    nz = [abs(x) for x in values if x]
+    if not nz:
+        return 1
+    g = 0
+    for x in nz:
+        g = math.gcd(g, x)
+    scale = max(1, g)
+    while max(nz) // scale > _INT32_MAX:
+        scale *= 2
+    return scale
+
+
+def pod_effective_requests(pod: t.Pod, resources: Sequence[str]) -> List[int]:
+    """Pod-level request vector; every pod consumes 1 of the synthetic "pods"
+    resource (reference: noderesources/fit.go — computePodResourceRequest +
+    the NodeInfo pod-count check)."""
+    return [pod.requests.get(r, 0) if r != t.PODS else max(1, pod.requests.get(r, 1)) for r in resources]
+
+
+def activeq_order(pods: Sequence[t.Pod]) -> np.ndarray:
+    """Indices sorting pods into activeQ pop order: priority desc, arrival asc
+    (reference: queue sort plugin — PrioritySort.Less)."""
+    return np.array(
+        sorted(range(len(pods)), key=lambda i: (-pods[i].priority, i)), dtype=np.int64
+    )
+
+
+def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArrays, EncodingMeta]:
+    nodes, pending = snap.nodes, snap.pending_pods
+    n, p = len(nodes), len(pending)
+    N = _round_up_pow2(n) if bucket else max(1, n)
+    P = _round_up_pow2(p) if bucket else max(1, p)
+
+    resources = _resource_axis(snap)
+    R = len(resources)
+
+    # --- label vocab over node labels (selectors lower against this) ---
+    lab = v.LabelVocab()
+    node_lits: List[List[int]] = [lab.add_labels(nd.labels) for nd in nodes]
+
+    # --- taint vocab ---
+    # spec.unschedulable is modeled as the synthetic taint the reference's node
+    # controller applies (node.kubernetes.io/unschedulable:NoSchedule), which makes
+    # the NodeUnschedulable plugin's toleration-aware check fall out of the taint
+    # kernel (reference: nodeunschedulable/node_unschedulable.go — Filter).
+    def _node_taints(nd: t.Node) -> List[t.Taint]:
+        ts = list(nd.taints)
+        if nd.unschedulable:
+            ts.append(t.Taint(key="node.kubernetes.io/unschedulable", effect=t.NO_SCHEDULE))
+        return ts
+
+    taints = v.Interner()
+    for nd in nodes:
+        for tn in _node_taints(nd):
+            taints.intern((tn.key, tn.value, tn.effect))
+    T = max(1, len(taints))
+
+    # --- raw quantities, then per-resource rescale to int32 ---
+    alloc_raw = np.zeros((n, R), dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        for j, r in enumerate(resources):
+            if r == t.PODS:
+                alloc_raw[i, j] = nd.allocatable.get(r, _DEFAULT_POD_LIMIT)
+            else:
+                alloc_raw[i, j] = nd.allocatable.get(r, 0)
+    perm = activeq_order(pending)
+    req_raw = np.zeros((p, R), dtype=np.int64)
+    for out_i, src_i in enumerate(perm):
+        req_raw[out_i] = pod_effective_requests(pending[src_i], resources)
+    used_raw = np.zeros((n, R), dtype=np.int64)
+    node_index = {nd.name: i for i, nd in enumerate(nodes)}
+    for bp in snap.bound_pods:
+        i = node_index.get(bp.node_name)
+        if i is not None:
+            used_raw[i] += np.array(pod_effective_requests(bp, resources), dtype=np.int64)
+
+    scale = np.ones(R, dtype=np.int64)
+    for j in range(R):
+        vals = [int(x) for x in alloc_raw[:, j]] + [int(x) for x in req_raw[:, j]] + [
+            int(x) for x in used_raw[:, j]
+        ]
+        scale[j] = _scale_for(vals)
+    # ceil for demand, floor for supply when the unit is inexact (conservative)
+    req_s = -(-req_raw // scale)
+    used_s = -(-used_raw // scale)
+    alloc_s = alloc_raw // scale
+
+    node_alloc = np.zeros((N, R), dtype=np.int32)
+    node_used = np.zeros((N, R), dtype=np.int32)
+    node_alloc[:n] = alloc_s
+    node_used[:n] = used_s
+
+    node_valid = np.zeros(N, dtype=bool)
+    node_valid[:n] = True
+    node_unsched = np.zeros(N, dtype=bool)
+    node_unsched[:n] = [nd.unschedulable for nd in nodes]
+
+    L = max(1, len(lab))
+    node_labels = np.zeros((N, L), dtype=np.float32)
+    for i, lits in enumerate(node_lits):
+        node_labels[i, lits] = 1.0
+
+    node_taint_ns = np.zeros((N, T), dtype=bool)
+    node_taint_pref = np.zeros((N, T), dtype=bool)
+    for i, nd in enumerate(nodes):
+        for tn in _node_taints(nd):
+            tid = taints.get((tn.key, tn.value, tn.effect))
+            if tn.effect == t.PREFER_NO_SCHEDULE:
+                node_taint_pref[i, tid] = True
+            else:
+                node_taint_ns[i, tid] = True
+
+    # --- pods (in activeQ order) ---
+    # SchedulingGates: gated pods never enter the schedulable set (reference:
+    # schedulinggates/scheduling_gates.go — PreEnqueue holds them out of activeQ);
+    # they come back with verdict -1 (still pending).
+    pod_valid = np.zeros(P, dtype=bool)
+    for out_i, src_i in enumerate(perm):
+        pod_valid[out_i] = not pending[src_i].scheduling_gates
+    pod_req = np.zeros((P, R), dtype=np.int32)
+    pod_req[:p] = req_s
+    pod_prio = np.zeros(P, dtype=np.int32)
+    pod_tol_ns = np.ones((P, T), dtype=bool)  # default: padding tolerates all
+    pod_tol_pref = np.ones((P, T), dtype=bool)
+    pod_nodename = np.full(P, -1, dtype=np.int32)
+
+    table = v.TermTable()
+    pod_term_lists: List[List[int]] = []
+    for out_i, src_i in enumerate(perm):
+        pod = pending[src_i]
+        pod_prio[out_i] = pod.priority
+        for tid, (tk, tv, te) in enumerate(taints.items):
+            taint = t.Taint(tk, tv, te)
+            tol = any(tol.tolerates(taint) for tol in pod.tolerations)
+            if te == t.PREFER_NO_SCHEDULE:
+                pod_tol_pref[out_i, tid] = tol
+            else:
+                pod_tol_ns[out_i, tid] = tol
+        if pod.node_name:
+            pod_nodename[out_i] = node_index.get(pod.node_name, -2)
+        terms = v.pod_required_node_terms(pod, lab)
+        pod_term_lists.append([] if terms is None else [table.intern(tm) for tm in terms])
+
+    TT = max(1, max((len(x) for x in pod_term_lists), default=1))
+    pod_terms = np.full((P, TT), -1, dtype=np.int32)
+    pod_has_sel = np.zeros(P, dtype=bool)
+    for i, ids in enumerate(pod_term_lists):
+        if ids:
+            pod_has_sel[i] = True
+            pod_terms[i, : len(ids)] = ids
+
+    sel_mask, sel_kind = table.encode(L)
+
+    arrays = ClusterArrays(
+        node_valid=node_valid,
+        node_alloc=node_alloc,
+        node_used=node_used,
+        node_unsched=node_unsched,
+        node_labels=node_labels,
+        node_taint_ns=node_taint_ns,
+        node_taint_pref=node_taint_pref,
+        pod_valid=pod_valid,
+        pod_req=pod_req,
+        pod_prio=pod_prio,
+        pod_tol_ns=pod_tol_ns,
+        pod_tol_pref=pod_tol_pref,
+        pod_nodename=pod_nodename,
+        pod_terms=pod_terms,
+        pod_has_sel=pod_has_sel,
+        sel_mask=sel_mask,
+        sel_kind=sel_kind,
+    )
+    meta = EncodingMeta(
+        node_names=[nd.name for nd in nodes],
+        pod_names=[pending[i].name for i in perm],
+        pod_perm=perm,
+        resources=resources,
+        resource_scale=scale,
+        label_vocab=lab,
+        taint_vocab=taints,
+        n_nodes=n,
+        n_pods=p,
+    )
+    return arrays, meta

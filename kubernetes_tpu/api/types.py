@@ -1,0 +1,258 @@
+"""Cluster object model — the fields the scheduler reads.
+
+This is the TPU framework's analog of the reference's API types
+(staging/src/k8s.io/api/core/v1/types.go — type Pod, type Node) restricted to the
+scheduling-relevant surface: resource requests/allocatable, labels, taints and
+tolerations, node selectors and (anti-)affinity, topology-spread constraints,
+priority, host ports, and scheduling gates.  Everything else (status machinery,
+volumes-as-objects, probes, ...) belongs to components SURVEY.md §7 scopes out.
+
+Quantities are plain integers in canonical units chosen by the caller (the
+convention used throughout tests and benchmarks: cpu in millicores, memory in
+bytes, pods/extended resources in counts).  The snapshot encoder rescales each
+resource axis independently so values fit int32 exactly (api/snapshot.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Canonical well-known resource names (reference: pkg/api/v1/resource,
+# noderesources/fit.go default resources).  Extended resources are any other key.
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+DEFAULT_RESOURCES: Tuple[str, ...] = (CPU, MEMORY)
+
+ResourceList = Dict[str, int]
+
+# Taint effects (reference: core/v1/types.go — TaintEffect).
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+# Selector operators (reference: core/v1/types.go — NodeSelectorOperator,
+# metav1 LabelSelectorOperator).
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+
+# Topology-spread unsatisfiable policies (core/v1/types.go — UnsatisfiableConstraintAction).
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+# Well-known topology label keys (component-helpers; used for default spread constraints).
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_REGION = "topology.kubernetes.io/region"
+
+
+@dataclass(frozen=True)
+class Taint:
+    """reference: core/v1/types.go — type Taint."""
+
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """reference: core/v1/types.go — type Toleration.
+
+    operator "Equal" matches key+value; "Exists" matches any value of key.
+    Empty key with operator Exists tolerates everything.  Empty effect matches
+    all effects.  (tolerationSeconds only matters for NoExecute eviction, which
+    is the node-lifecycle controller's job, not filtering's; carried for parity.)
+    """
+
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        # reference: component-helpers scheduling/corev1 — ToleratesTaint
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == OP_EXISTS:
+            return True
+        # Equal (default); empty key+Exists handled above via `self.key and ...`
+        return self.value == taint.value
+
+
+@dataclass(frozen=True)
+class NodeSelectorRequirement:
+    """reference: core/v1/types.go — type NodeSelectorRequirement."""
+
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    """Conjunction of requirements; terms within a selector are ORed.
+
+    reference: core/v1/types.go — type NodeSelectorTerm (matchFields folded into
+    matchExpressions on the single supported field metadata.name).
+    """
+
+    match_expressions: Tuple[NodeSelectorRequirement, ...] = ()
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass(frozen=True)
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """reference: apimachinery metav1 — type LabelSelector.
+
+    match_labels is sugar for In-with-one-value requirements.  An empty selector
+    matches everything; None (no selector) matches nothing.
+    """
+
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    match_expressions: Tuple[LabelSelectorRequirement, ...] = ()
+
+    @staticmethod
+    def of(**labels: str) -> "LabelSelector":
+        return LabelSelector(match_labels=tuple(sorted(labels.items())))
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for req in self.match_expressions:
+            has = req.key in labels
+            val = labels.get(req.key)
+            if req.operator == OP_IN:
+                if not has or val not in req.values:
+                    return False
+            elif req.operator == OP_NOT_IN:
+                if has and val in req.values:
+                    return False
+            elif req.operator == OP_EXISTS:
+                if not has:
+                    return False
+            elif req.operator == OP_DOES_NOT_EXIST:
+                if has:
+                    return False
+            else:
+                raise ValueError(f"bad label selector operator {req.operator}")
+        return True
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """reference: core/v1/types.go — type PodAffinityTerm."""
+
+    topology_key: str
+    label_selector: Optional[LabelSelector] = None
+    namespaces: Tuple[str, ...] = ()  # empty => pod's own namespace
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm = None  # type: ignore[assignment]
+
+
+@dataclass(frozen=True)
+class Affinity:
+    """reference: core/v1/types.go — type Affinity (node + pod + podAntiAffinity)."""
+
+    # nodeAffinity
+    required_node_terms: Tuple[NodeSelectorTerm, ...] = ()  # ORed; empty => no constraint
+    preferred_node_terms: Tuple[PreferredSchedulingTerm, ...] = ()
+    # podAffinity / podAntiAffinity (requiredDuringSchedulingIgnoredDuringExecution)
+    required_pod_affinity: Tuple[PodAffinityTerm, ...] = ()
+    required_pod_anti_affinity: Tuple[PodAffinityTerm, ...] = ()
+    preferred_pod_affinity: Tuple[WeightedPodAffinityTerm, ...] = ()
+    preferred_pod_anti_affinity: Tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    """reference: core/v1/types.go — type TopologySpreadConstraint."""
+
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+
+@dataclass
+class Node:
+    """Scheduling view of a node.
+
+    reference: core/v1/types.go — type Node + the scheduler's aggregation of it
+    (pkg/scheduler/framework/types.go — type NodeInfo).
+    """
+
+    name: str
+    allocatable: ResourceList = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: Tuple[Taint, ...] = ()
+    unschedulable: bool = False  # spec.unschedulable
+
+    def __post_init__(self) -> None:
+        self.labels.setdefault(LABEL_HOSTNAME, self.name)
+
+
+@dataclass
+class Pod:
+    """Scheduling view of a pod (pending or running).
+
+    reference: core/v1/types.go — type Pod / PodSpec; requests aggregated the way
+    pkg/scheduler/framework/plugins/noderesources — computePodResourceRequest does
+    (max(sum(containers), initContainers) + overhead), which callers perform before
+    constructing this object: `requests` here is the pod-level effective request.
+    """
+
+    name: str
+    namespace: str = "default"
+    requests: ResourceList = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""  # spec.nodeName: "" = pending; set = bound/running
+    priority: int = 0
+    tolerations: Tuple[Toleration, ...] = ()
+    node_selector: Tuple[Tuple[str, str], ...] = ()  # spec.nodeSelector (AND of k=v)
+    affinity: Optional[Affinity] = None
+    topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
+    host_ports: Tuple[Tuple[str, int], ...] = ()  # (protocol, port)
+    scheduling_gates: Tuple[str, ...] = ()
+    pod_group: str = ""  # gang-scheduling group name ("" = none)
+    uid: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class PodGroup:
+    """Gang-scheduling group (analog of out-of-tree coscheduling PodGroup CRD;
+    BASELINE config 5)."""
+
+    name: str
+    min_member: int

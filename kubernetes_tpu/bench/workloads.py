@@ -1,0 +1,87 @@
+"""Synthetic workload generators for the BASELINE.md benchmark configs.
+
+Analog of scheduler_perf's createNodes/createPods ops (test/integration/
+scheduler_perf/config/performance-config.yaml): deterministic (seeded) cluster
+generators at the five target scales.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..api import types as t
+from ..api.snapshot import Snapshot
+
+MILLI = 1000
+GI = 1024**3
+
+
+def basic(n_nodes: int, n_pods: int, seed: int = 0) -> Snapshot:
+    """Config 1/2: homogeneous nodes, cpu+mem-requesting pods (SchedulingBasic /
+    NodeResourcesFit-only)."""
+    rng = random.Random(seed)
+    nodes = [
+        t.Node(
+            name=f"node-{i}",
+            allocatable={t.CPU: 32 * MILLI, t.MEMORY: 128 * GI, t.PODS: 110},
+            labels={t.LABEL_ZONE: f"zone-{i % 3}"},
+        )
+        for i in range(n_nodes)
+    ]
+    pods = [
+        t.Pod(
+            name=f"pod-{i}",
+            requests={
+                t.CPU: rng.choice([100, 250, 500, 1000]),
+                t.MEMORY: rng.choice([128, 256, 512, 1024]) * 1024**2,
+            },
+        )
+        for i in range(n_pods)
+    ]
+    return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+def heterogeneous(n_nodes: int, n_pods: int, seed: int = 0) -> Snapshot:
+    """Config 4: heterogeneous capacities + extended resources + taints/tolerations."""
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        alloc = {
+            t.CPU: rng.choice([8, 16, 32, 64]) * MILLI,
+            t.MEMORY: rng.choice([32, 64, 128, 256]) * GI,
+            t.PODS: rng.choice([64, 110, 256]),
+        }
+        taints = ()
+        if i % 5 == 0:
+            alloc["example.com/accel"] = rng.choice([4, 8])
+            taints = (t.Taint(key="accel", value="true", effect=t.NO_SCHEDULE),)
+        nodes.append(
+            t.Node(
+                name=f"node-{i}",
+                allocatable=alloc,
+                labels={t.LABEL_ZONE: f"zone-{i % 9}", "pool": f"pool-{i % 17}"},
+                taints=taints,
+            )
+        )
+    pods = []
+    for i in range(n_pods):
+        req = {
+            t.CPU: rng.choice([100, 250, 500, 1000, 2000]),
+            t.MEMORY: rng.choice([128, 256, 512, 2048, 4096]) * 1024**2,
+        }
+        tols = ()
+        sel = ()
+        if i % 10 == 0:
+            req["example.com/accel"] = 1
+            tols = (t.Toleration(key="accel", operator=t.OP_EXISTS),)
+        pods.append(
+            t.Pod(
+                name=f"pod-{i}",
+                requests=req,
+                tolerations=tols,
+                node_selector=sel,
+                priority=rng.choice([0, 0, 0, 100]),
+            )
+        )
+    return Snapshot(nodes=nodes, pending_pods=pods)

@@ -1,0 +1,68 @@
+"""Batched assignment with sequential-commit semantics — L3 (the hard part).
+
+The reference schedules one pod per cycle; placing pod i mutates NodeInfo before
+pod i+1 is considered (pkg/scheduler/schedule_one.go — ScheduleOne + the assume
+cache, backend/cache/cache.go — AssumePod).  To reproduce those semantics in one
+XLA program, everything capacity-independent (static feasibility, raw score
+counts) is evaluated for the whole batch up front as [P, N] matrices, and a
+`lax.scan` over pods (in activeQ order == array order) re-evaluates only the
+capacity-dependent terms per step:
+
+  - NodeResourcesFit.Filter against the running node_used
+  - LeastAllocated / BalancedAllocation scores against used + this pod's request
+  - per-pod NormalizeScore over the *currently* feasible set
+
+Host selection is argmax of the weighted sum; ties break to the lowest node
+index.  (The reference's selectHost — schedule_one.go — picks randomly among
+equal-score nodes; this framework is deterministic by design, the "full-scoring
+deterministic mode" deviation called out in SURVEY.md §7 hard part 1.  The
+oracle applies the identical rule, so parity is exact within the framework.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..api.snapshot import ClusterArrays
+from . import filters
+from .scores import ScoreConfig, balanced_allocation, least_allocated, normalize_reverse, taint_prefer_counts
+
+
+def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
+    """Schedule every pending pod in the snapshot.
+
+    Returns (assignment i32[P] — node index or -1 unschedulable,
+             node_used i32[N, R] — capacity state after all commits).
+    """
+    sf = filters.static_feasible(arr)  # [P, N]
+    pref = taint_prefer_counts(arr)  # [P, N]
+    n_alloc = arr.node_alloc
+
+    def step(used, xs):
+        req, feas_row, pref_row, valid = xs
+        feasible = feas_row & filters.fit_ok(req, used, n_alloc)
+        requested = used + req[None, :]
+        total = (
+            cfg.fit_weight * least_allocated(requested, n_alloc, cfg.score_resources)
+            + cfg.balanced_weight
+            * balanced_allocation(requested, n_alloc, cfg.score_resources)
+            + cfg.taint_weight * normalize_reverse(pref_row, feasible)
+        )
+        total = jnp.where(feasible, total, -jnp.inf)
+        schedulable = feasible.any() & valid
+        choice = jnp.where(schedulable, jnp.argmax(total).astype(jnp.int32), -1)
+        placed = (jnp.arange(used.shape[0], dtype=jnp.int32) == choice)[:, None]
+        return used + placed.astype(used.dtype) * req[None, :], choice
+
+    used_final, choices = lax.scan(
+        step, arr.node_used, (arr.pod_req, sf, pref, arr.pod_valid)
+    )
+    return choices, used_final
+
+
+schedule_batch = partial(jax.jit, static_argnames=("cfg",))(schedule_batch_impl)

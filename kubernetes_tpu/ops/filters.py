@@ -1,0 +1,98 @@
+"""Feasibility (Filter extension point) as batched array ops — L1.
+
+One jitted evaluation replaces the reference's chunked 16-goroutine fan-out of
+per-node Filter plugins (pkg/scheduler/framework/parallelize/parallelism.go —
+Parallelizer.Until; pkg/scheduler/schedule_one.go — findNodesThatFitPod).
+
+The capacity check (NodeResourcesFit.Filter — noderesources/fit.go) is split out
+as `fit_ok`: it depends on node_used, which mutates as the commit scan places
+pods (ops/assign.py), so it re-evaluates in-scan while everything
+capacity-independent is computed once here for the whole batch:
+
+  TaintToleration.Filter   (tainttoleration/taint_toleration.go)  -> taint test
+  NodeAffinity.Filter + spec.nodeSelector (nodeaffinity/node_affinity.go)
+                                                                 -> term matmul
+  NodeUnschedulable.Filter (via the synthetic unschedulable taint, api/snapshot.py)
+  NodeName.Filter          (nodename/node_name.go)               -> index equality
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..api import vocab as v
+from ..api.snapshot import ClusterArrays
+
+
+def term_match(sel_mask: jax.Array, sel_kind: jax.Array, node_labels: jax.Array) -> jax.Array:
+    """[S, E, L] masks x [N, L] labels -> bool[S, N]: which nodes satisfy each
+    interned selector term.
+
+    The AnyOf/NoneOf primitives (api/vocab.py) become one counting matmul on the
+    MXU; counts are exact in f32 (< 2^24 literals).
+    """
+    counts = jnp.einsum(
+        "sel,nl->sen", sel_mask, node_labels, precision=jax.lax.Precision.HIGHEST
+    )
+    kind = sel_kind[:, :, None]
+    ok = jnp.where(
+        kind == v.KIND_ANY,
+        counts > 0,
+        jnp.where(kind == v.KIND_NONE, counts == 0, kind == v.KIND_PAD),
+    )
+    return jnp.all(ok, axis=1)
+
+
+def node_selection_ok(arr: ClusterArrays) -> jax.Array:
+    """bool[P, N]: spec.nodeSelector AND required node affinity (ORed terms)."""
+    tm = term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)  # [S, N]
+    ids = jnp.maximum(arr.pod_terms, 0)  # [P, TT]
+    per_term = tm[ids] & (arr.pod_terms >= 0)[:, :, None]  # [P, TT, N]
+    return jnp.where(arr.pod_has_sel[:, None], per_term.any(axis=1), True)
+
+
+def taints_ok(arr: ClusterArrays) -> jax.Array:
+    """bool[P, N]: every hard (NoSchedule/NoExecute) taint on the node is
+    tolerated.  Counting matmul over the taint vocab."""
+    intolerable = jnp.einsum(
+        "pt,nt->pn",
+        (~arr.pod_tol_ns).astype(jnp.float32),
+        arr.node_taint_ns.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return intolerable == 0
+
+
+def nodename_ok(arr: ClusterArrays) -> jax.Array:
+    """bool[P, N]: spec.nodeName pinning (-1 unset, -2 named node missing)."""
+    n_idx = jnp.arange(arr.N, dtype=jnp.int32)[None, :]
+    pin = arr.pod_nodename[:, None]
+    return jnp.where(pin == -1, True, pin == n_idx)
+
+
+def static_feasible(arr: ClusterArrays) -> jax.Array:
+    """bool[P, N]: all capacity-independent filters, one batched evaluation."""
+    return (
+        arr.node_valid[None, :]
+        & arr.pod_valid[:, None]
+        & taints_ok(arr)
+        & node_selection_ok(arr)
+        & nodename_ok(arr)
+    )
+
+
+def fit_ok(pod_req: jax.Array, node_used: jax.Array, node_alloc: jax.Array) -> jax.Array:
+    """bool[N] for one pod: used + req <= alloc on every resource (int32 exact).
+
+    reference: noderesources/fit.go — fitsRequest.  Called inside the commit
+    scan with the running `node_used` state.
+
+    Computed as req <= alloc - used, NOT used + req <= alloc: the subtraction
+    form cannot overflow int32 (alloc and used are both >= 0), whereas the sum
+    wraps negative for near-int32-max quantities and would falsely pass.
+    Resources the pod does not request (req == 0) never block — the reference
+    skips them, so a node overcommitted on memory still accepts a 0-memory pod.
+    """
+    req = pod_req[None, :]
+    return jnp.all((req == 0) | (req <= node_alloc - node_used), axis=1)

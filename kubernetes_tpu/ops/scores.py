@@ -1,0 +1,106 @@
+"""Score (Score extension point) kernels — L2.
+
+Replaces the reference's second parallelize.Until fan-out (pkg/scheduler/
+schedule_one.go — prioritizeNodes; framework/runtime/framework.go —
+RunScorePlugins) with elementwise array math.
+
+Score arithmetic is float32 (the oracle mirrors it op-for-op, so TPU-vs-oracle
+parity is exact); the reference computes in int64 — a documented deviation that
+can differ only when an int division truncates within one f32 ulp of a score
+boundary.  MaxNodeScore = 100 (framework/interface.go — MaxNodeScore).
+
+Per-pod normalization (NormalizeScore) runs over the pod's *currently feasible*
+node set, which depends on capacity state — so the normalize+weight step happens
+inside the commit scan (ops/assign.py) on [N]-shaped slices, while raw
+per-(pod,node) counts are batched here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..api.snapshot import ClusterArrays
+
+MAX_NODE_SCORE = 100.0
+
+
+@dataclass(frozen=True)
+class ScoreConfig:
+    """Default-profile plugin weights (reference: pkg/scheduler/apis/config/v1/
+    default_plugins.go — getDefaultPlugins multipoint weights) and the scored
+    resource axis indices (cpu, memory — noderesources defaults)."""
+
+    fit_weight: float = 1.0  # NodeResourcesFit (LeastAllocated strategy)
+    balanced_weight: float = 1.0  # NodeResourcesBalancedAllocation
+    taint_weight: float = 3.0  # TaintToleration
+    node_affinity_weight: float = 2.0  # NodeAffinity (preferred terms)
+    spread_weight: float = 2.0  # PodTopologySpread
+    interpod_weight: float = 2.0  # InterPodAffinity
+    score_resources: Tuple[int, ...] = (0, 1)  # indices into the R axis
+
+
+DEFAULT_SCORE_CONFIG = ScoreConfig()
+
+
+def least_allocated(
+    requested: jax.Array, alloc: jax.Array, res_idx: Tuple[int, ...]
+) -> jax.Array:
+    """f32[N]: NodeResourcesFit LeastAllocated strategy.
+
+    reference: noderesources/least_allocated.go — leastResourceScorer:
+    score_r = max(0, (alloc - requested) * 100 / alloc), 0 when alloc == 0;
+    node score = mean over scored resources (equal resource weights).
+    """
+    idx = jnp.array(res_idx, dtype=jnp.int32)
+    a = alloc[:, idx].astype(jnp.float32)
+    r = requested[:, idx].astype(jnp.float32)
+    per_res = jnp.where(a > 0, jnp.maximum(0.0, (a - r) * MAX_NODE_SCORE / a), 0.0)
+    return per_res.mean(axis=1)
+
+
+def balanced_allocation(
+    requested: jax.Array, alloc: jax.Array, res_idx: Tuple[int, ...]
+) -> jax.Array:
+    """f32[N]: NodeResourcesBalancedAllocation.
+
+    reference: noderesources/balanced_allocation.go — balancedResourceScorer:
+    fractions f_r = min(1, requested/alloc) over resources with alloc > 0;
+    score = (1 - std(f)) * 100 with population std over present resources.
+    """
+    idx = jnp.array(res_idx, dtype=jnp.int32)
+    a = alloc[:, idx].astype(jnp.float32)
+    r = requested[:, idx].astype(jnp.float32)
+    present = a > 0
+    f = jnp.where(present, jnp.minimum(1.0, r / jnp.where(present, a, 1.0)), 0.0)
+    cnt = jnp.maximum(1, present.sum(axis=1)).astype(jnp.float32)
+    mean = f.sum(axis=1) / cnt
+    var = (jnp.where(present, (f - mean[:, None]) ** 2, 0.0)).sum(axis=1) / cnt
+    return (1.0 - jnp.sqrt(var)) * MAX_NODE_SCORE
+
+
+def taint_prefer_counts(arr: ClusterArrays) -> jax.Array:
+    """f32[P, N]: # of intolerable PreferNoSchedule taints — TaintToleration's
+    raw Score before normalization (tainttoleration/taint_toleration.go —
+    CountIntolerableTaintsPreferNoSchedule)."""
+    return jnp.einsum(
+        "pt,nt->pn",
+        (~arr.pod_tol_pref).astype(jnp.float32),
+        arr.node_taint_pref.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def normalize_reverse(counts: jax.Array, feasible: jax.Array) -> jax.Array:
+    """f32[N]: DefaultNormalizeScore with reverse=true over the feasible set.
+
+    reference: framework/plugins/helper/normalize_score.go: score_i =
+    max - max * count_i / maxCount; all `max` when maxCount == 0.
+    """
+    max_c = jnp.max(jnp.where(feasible, counts, 0.0))
+    return jnp.where(
+        max_c > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / max_c, MAX_NODE_SCORE
+    )

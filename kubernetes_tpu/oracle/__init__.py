@@ -1,0 +1,1 @@
+from .reference import oracle_schedule  # noqa: F401

@@ -1,0 +1,207 @@
+"""CPU reference scheduler — the parity oracle (L5).
+
+A deliberately naive sequential reimplementation of the reference default
+scheduler's semantics (pkg/scheduler/schedule_one.go — ScheduleOne: filter all
+nodes, score, select host, assume, next pod), operating on the *object* model
+(string label matching, per-node Python loops) rather than the encoded arrays —
+so a parity test exercises the encoder AND the kernels end-to-end.
+
+Two framework-level conventions shared with the TPU path (both documented
+deviations from the reference, SURVEY.md §7 hard part 1):
+  - deterministic tie-break: lowest node index among max-score nodes
+    (reference selectHost randomizes among ties);
+  - full scoring: no percentageOfNodesToScore sampling;
+  - score arithmetic in float32, mirroring the kernels op-for-op.
+
+Resource quantities go through the same int32 rescale as the encoder
+(api/snapshot.py — _scale_for), which is part of framework semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import types as t
+from ..api import snapshot as snap_mod
+from ..api.snapshot import Snapshot
+from ..ops.scores import MAX_NODE_SCORE, ScoreConfig, DEFAULT_SCORE_CONFIG
+
+f32 = np.float32
+
+
+def _tolerates_all(pod: t.Pod, taints) -> bool:
+    # reference: component-helpers scheduling/corev1 — FindMatchingUntoleratedTaint
+    for taint in taints:
+        if taint.effect == t.PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(taint) for tol in pod.tolerations):
+            return False
+    return True
+
+
+def _intolerable_prefer_count(pod: t.Pod, taints) -> int:
+    return sum(
+        1
+        for taint in taints
+        if taint.effect == t.PREFER_NO_SCHEDULE
+        and not any(tol.tolerates(taint) for tol in pod.tolerations)
+    )
+
+
+def _node_taints(nd: t.Node):
+    ts = list(nd.taints)
+    if nd.unschedulable:
+        ts.append(t.Taint(key="node.kubernetes.io/unschedulable", effect=t.NO_SCHEDULE))
+    return ts
+
+
+def _matches_term(term: t.NodeSelectorTerm, labels: Dict[str, str]) -> bool:
+    # reference: component-helpers nodeaffinity — nodeSelectorTermMatches;
+    # a null/empty term matches no objects
+    if not term.match_expressions:
+        return False
+    for req in term.match_expressions:
+        has, val = req.key in labels, labels.get(req.key)
+        if req.operator == t.OP_IN:
+            if not has or val not in req.values:
+                return False
+        elif req.operator == t.OP_NOT_IN:
+            if has and val in req.values:
+                return False
+        elif req.operator == t.OP_EXISTS:
+            if not has:
+                return False
+        elif req.operator == t.OP_DOES_NOT_EXIST:
+            if has:
+                return False
+        elif req.operator in (t.OP_GT, t.OP_LT):
+            try:
+                x, bound = int(val), int(req.values[0])
+            except (TypeError, ValueError, IndexError):
+                return False
+            if not ((x > bound) if req.operator == t.OP_GT else (x < bound)):
+                return False
+        else:
+            raise ValueError(req.operator)
+    return True
+
+
+def _node_selection_ok(pod: t.Pod, node: t.Node) -> bool:
+    for k, v in pod.node_selector:
+        if node.labels.get(k) != v:
+            return False
+    if pod.affinity and pod.affinity.required_node_terms:
+        return any(_matches_term(tm, node.labels) for tm in pod.affinity.required_node_terms)
+    return True
+
+
+def _least_allocated(requested: np.ndarray, alloc: np.ndarray, idx) -> f32:
+    vals = []
+    for j in idx:
+        a, r = f32(alloc[j]), f32(requested[j])
+        vals.append(max(f32(0.0), (a - r) * f32(MAX_NODE_SCORE) / a) if a > 0 else f32(0.0))
+    return f32(np.mean(np.array(vals, dtype=f32)))
+
+
+def _balanced(requested: np.ndarray, alloc: np.ndarray, idx) -> f32:
+    fs, cnt = [], 0
+    for j in idx:
+        if alloc[j] > 0:
+            fs.append(min(f32(1.0), f32(requested[j]) / f32(alloc[j])))
+            cnt += 1
+        else:
+            fs.append(f32(0.0))
+    n = f32(max(1, cnt))
+    f = np.array(fs, dtype=f32)
+    mean = f32(f.sum() / n)
+    var = f32(np.where(np.array([alloc[j] > 0 for j in idx]), (f - mean) ** 2, f32(0)).sum() / n)
+    return f32((f32(1.0) - f32(np.sqrt(var))) * f32(MAX_NODE_SCORE))
+
+
+def oracle_schedule(
+    snap: Snapshot, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG
+) -> List[Tuple[str, Optional[str]]]:
+    """Sequentially schedule all pending pods; returns [(pod name, node name | None)]
+    in activeQ order."""
+    resources = snap_mod._resource_axis(snap)
+    nodes = snap.nodes
+    n = len(nodes)
+
+    alloc_raw = np.zeros((n, len(resources)), dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        for j, r in enumerate(resources):
+            alloc_raw[i, j] = nd.allocatable.get(
+                r, snap_mod._DEFAULT_POD_LIMIT if r == t.PODS else 0
+            )
+    used_raw = np.zeros((n, len(resources)), dtype=np.int64)
+    node_index = {nd.name: i for i, nd in enumerate(nodes)}
+    for bp in snap.bound_pods:
+        i = node_index.get(bp.node_name)
+        if i is not None:
+            used_raw[i] += np.array(
+                snap_mod.pod_effective_requests(bp, resources), dtype=np.int64
+            )
+    order = snap_mod.activeq_order(snap.pending_pods)
+    req_raw = np.array(
+        [snap_mod.pod_effective_requests(snap.pending_pods[i], resources) for i in order],
+        dtype=np.int64,
+    ).reshape(len(order), len(resources))
+
+    scale = np.ones(len(resources), dtype=np.int64)
+    for j in range(len(resources)):
+        scale[j] = snap_mod._scale_for(
+            [int(x) for x in alloc_raw[:, j]]
+            + [int(x) for x in req_raw[:, j]]
+            + [int(x) for x in used_raw[:, j]]
+        )
+    alloc = alloc_raw // scale
+    used = -(-used_raw // scale)
+    reqs = -(-req_raw // scale)
+
+    idx = list(cfg.score_resources)
+    out: List[Tuple[str, Optional[str]]] = []
+    for k, src_i in enumerate(order):
+        pod = snap.pending_pods[src_i]
+        if pod.scheduling_gates:  # held out of activeQ (SchedulingGates PreEnqueue)
+            out.append((pod.name, None))
+            continue
+        req = reqs[k]
+        feasible, pref_counts = [], {}
+        for i, nd in enumerate(nodes):
+            taints = _node_taints(nd)
+            if not _tolerates_all(pod, taints):
+                continue
+            if not _node_selection_ok(pod, nd):
+                continue
+            # nodeName pinning: a missing named node leaves every node infeasible
+            if pod.node_name and node_index.get(pod.node_name) != i:
+                continue
+            # zero-request resources never block (reference fitsRequest skips them)
+            if np.any((req > 0) & (used[i] + req > alloc[i])):
+                continue
+            feasible.append(i)
+            pref_counts[i] = _intolerable_prefer_count(pod, taints)
+        if not feasible:
+            out.append((pod.name, None))
+            continue
+        max_pref = f32(max(pref_counts[i] for i in feasible))
+        best_i, best_s = -1, -np.inf
+        for i in feasible:
+            requested = used[i] + req
+            taint_sc = (
+                f32(MAX_NODE_SCORE) - f32(MAX_NODE_SCORE) * f32(pref_counts[i]) / max_pref
+                if max_pref > 0
+                else f32(MAX_NODE_SCORE)
+            )
+            s = (
+                f32(cfg.fit_weight) * _least_allocated(requested, alloc[i], idx)
+                + f32(cfg.balanced_weight) * _balanced(requested, alloc[i], idx)
+                + f32(cfg.taint_weight) * taint_sc
+            )
+            if s > best_s:
+                best_s, best_i = s, i
+        used[best_i] += req
+        out.append((pod.name, nodes[best_i].name))
+    return out

@@ -1,0 +1,2 @@
+from .mesh import make_mesh  # noqa: F401
+from .sharded import sharded_schedule_batch  # noqa: F401

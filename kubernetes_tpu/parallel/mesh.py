@@ -1,0 +1,26 @@
+"""Device mesh construction.
+
+The scale axis of the reference is node count (SURVEY.md §5 long-context note):
+its 16-goroutine chunked fan-out over nodes (pkg/scheduler/framework/parallelize/
+parallelism.go — Parallelizer.Until) maps to data parallelism over the node axis
+of the (pods x nodes) matrices, sharded across TPU chips over ICI.  One mesh
+axis "nodes" for now; the pods axis joins when ring/all-to-all stages land.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (NODE_AXIS,))

@@ -1,0 +1,22 @@
+"""Test env: virtual 8-device CPU platform.
+
+Mirrors the reference's determinism-first test posture (SURVEY.md §5 race
+detection: CPU sim mode for deterministic tests); sharding tests get a real
+8-device mesh without TPU hardware.
+
+Note: this machine's sitecustomize registers the axon TPU PJRT plugin and
+overwrites jax.config.jax_platforms at interpreter start, so setting the
+JAX_PLATFORMS env var is not enough — the config must be re-overridden after
+jax import (before any backend initialization).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

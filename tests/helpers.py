@@ -1,0 +1,147 @@
+"""Shared test fixtures: builder-style Pod/Node constructors + random clusters.
+
+Analog of the reference's fixture wrappers (pkg/scheduler/testing/wrappers.go —
+st.MakePod().Req(...).Obj() builder pattern, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot
+
+MILLI = 1000
+GI = 1024**3
+
+
+def mk_node(
+    name: str,
+    cpu: int = 4 * MILLI,
+    mem: int = 8 * GI,
+    pods: int = 110,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Tuple[t.Taint, ...] = (),
+    unschedulable: bool = False,
+    extra: Optional[Dict[str, int]] = None,
+) -> t.Node:
+    alloc = {t.CPU: cpu, t.MEMORY: mem, t.PODS: pods}
+    if extra:
+        alloc.update(extra)
+    return t.Node(
+        name=name,
+        allocatable=alloc,
+        labels=dict(labels or {}),
+        taints=taints,
+        unschedulable=unschedulable,
+    )
+
+
+def mk_pod(
+    name: str,
+    cpu: int = 100,
+    mem: int = 128 * 1024**2,
+    node_name: str = "",
+    priority: int = 0,
+    labels: Optional[Dict[str, str]] = None,
+    tolerations: Tuple[t.Toleration, ...] = (),
+    node_selector: Optional[Dict[str, str]] = None,
+    affinity: Optional[t.Affinity] = None,
+    extra: Optional[Dict[str, int]] = None,
+    **kw,
+) -> t.Pod:
+    req = {t.CPU: cpu, t.MEMORY: mem}
+    if extra:
+        req.update(extra)
+    return t.Pod(
+        name=name,
+        requests=req,
+        node_name=node_name,
+        priority=priority,
+        labels=dict(labels or {}),
+        tolerations=tolerations,
+        node_selector=tuple(sorted((node_selector or {}).items())),
+        affinity=affinity,
+        **kw,
+    )
+
+
+def random_cluster(
+    rng: random.Random,
+    n_nodes: int,
+    n_pods: int,
+    with_taints: bool = False,
+    with_selectors: bool = False,
+    n_zones: int = 3,
+) -> Snapshot:
+    nodes: List[t.Node] = []
+    for i in range(n_nodes):
+        labels = {
+            t.LABEL_ZONE: f"zone-{i % n_zones}",
+            "disktype": rng.choice(["ssd", "hdd"]),
+            "tier": rng.choice(["a", "b", "c"]),
+        }
+        taints: Tuple[t.Taint, ...] = ()
+        if with_taints and rng.random() < 0.3:
+            taints = (
+                t.Taint(
+                    key="dedicated",
+                    value=rng.choice(["infra", "batch"]),
+                    effect=rng.choice([t.NO_SCHEDULE, t.PREFER_NO_SCHEDULE]),
+                ),
+            )
+        nodes.append(
+            mk_node(
+                f"node-{i}",
+                cpu=rng.choice([2, 4, 8, 16]) * MILLI,
+                mem=rng.choice([4, 8, 16, 32]) * GI,
+                pods=rng.choice([32, 64, 110]),
+                labels=labels,
+                taints=taints,
+                unschedulable=rng.random() < 0.02,
+            )
+        )
+    pods: List[t.Pod] = []
+    for i in range(n_pods):
+        tols: Tuple[t.Toleration, ...] = ()
+        if with_taints and rng.random() < 0.5:
+            tols = (
+                t.Toleration(
+                    key="dedicated",
+                    operator=rng.choice(["Equal", "Exists"]),
+                    value=rng.choice(["infra", "batch"]),
+                ),
+            )
+        sel = None
+        aff = None
+        if with_selectors and rng.random() < 0.4:
+            which = rng.random()
+            if which < 0.5:
+                sel = {"disktype": rng.choice(["ssd", "hdd"])}
+            else:
+                aff = t.Affinity(
+                    required_node_terms=(
+                        t.NodeSelectorTerm(
+                            match_expressions=(
+                                t.NodeSelectorRequirement(
+                                    key="tier",
+                                    operator=rng.choice([t.OP_IN, t.OP_NOT_IN, t.OP_EXISTS]),
+                                    values=(rng.choice(["a", "b", "c"]),),
+                                ),
+                            )
+                        ),
+                    )
+                )
+        pods.append(
+            mk_pod(
+                f"pod-{i}",
+                cpu=rng.choice([50, 100, 250, 500, 1000]),
+                mem=rng.choice([64, 128, 256, 512, 1024]) * 1024**2,
+                priority=rng.choice([0, 0, 0, 10, 100]),
+                tolerations=tols,
+                node_selector=sel,
+                affinity=aff,
+            )
+        )
+    return Snapshot(nodes=nodes, pending_pods=pods)

@@ -1,0 +1,97 @@
+"""L3+L5: end-to-end parity — jitted batch scheduler vs the sequential NumPy
+oracle, the framework's conformance analog (SURVEY.md §4: "same snapshot ->
+TPU verdicts == CPU-reference verdicts")."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+from kubernetes_tpu.oracle import oracle_schedule
+from helpers import mk_node, mk_pod, random_cluster
+
+
+def run_tpu(snap):
+    arr, meta = encode_snapshot(snap)
+    choices, _ = schedule_batch(arr, DEFAULT_SCORE_CONFIG)
+    choices = np.asarray(choices)
+    out = []
+    for k in range(meta.n_pods):
+        c = int(choices[k])
+        out.append((meta.pod_names[k], meta.node_names[c] if c >= 0 else None))
+    return out
+
+
+def assert_parity(snap):
+    got = run_tpu(snap)
+    want = oracle_schedule(snap)
+    assert got == want
+
+
+def test_single_pod_single_node():
+    assert_parity(Snapshot(nodes=[mk_node("n0")], pending_pods=[mk_pod("p0")]))
+
+
+def test_prefers_least_allocated():
+    snap = Snapshot(
+        nodes=[mk_node("busy", cpu=4000), mk_node("idle", cpu=4000)],
+        pending_pods=[mk_pod("p", cpu=1000)],
+        bound_pods=[mk_pod("b", cpu=2000, node_name="busy")],
+    )
+    got = run_tpu(snap)
+    assert got[0] == ("p", "idle")
+    assert_parity(snap)
+
+
+def test_sequential_capacity_semantics():
+    # Two pods each needing >half a node: second must spill to the other node.
+    snap = Snapshot(
+        nodes=[mk_node("a", cpu=1000), mk_node("b", cpu=1000)],
+        pending_pods=[mk_pod("p0", cpu=600), mk_pod("p1", cpu=600)],
+    )
+    got = dict(run_tpu(snap))
+    assert {got["p0"], got["p1"]} == {"a", "b"}
+    assert_parity(snap)
+
+
+def test_unschedulable_reported():
+    snap = Snapshot(
+        nodes=[mk_node("tiny", cpu=100)],
+        pending_pods=[mk_pod("p", cpu=200)],
+    )
+    assert run_tpu(snap)[0] == ("p", None)
+    assert_parity(snap)
+
+
+def test_priority_order_matters():
+    # High-priority pod pops first and takes the last slot.
+    snap = Snapshot(
+        nodes=[mk_node("only", cpu=700)],
+        pending_pods=[mk_pod("low", cpu=600), mk_pod("high", cpu=600, priority=100)],
+    )
+    got = dict(run_tpu(snap))
+    assert got["high"] == "only" and got["low"] is None
+    assert_parity(snap)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_random_small(seed):
+    rng = random.Random(seed)
+    assert_parity(random_cluster(rng, n_nodes=13, n_pods=29))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_random_taints_selectors(seed):
+    rng = random.Random(1000 + seed)
+    assert_parity(
+        random_cluster(rng, n_nodes=17, n_pods=41, with_taints=True, with_selectors=True)
+    )
+
+
+def test_parity_random_medium():
+    rng = random.Random(42)
+    assert_parity(
+        random_cluster(rng, n_nodes=64, n_pods=200, with_taints=True, with_selectors=True)
+    )

@@ -1,0 +1,157 @@
+"""L1 filter kernel tests — table-driven, mirroring the reference's plugin unit
+tests (e.g. noderesources/fit_test.go, tainttoleration/taint_toleration_test.go)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.ops import filters
+from helpers import mk_node, mk_pod
+
+
+def feasible_matrix(snap):
+    arr, meta = encode_snapshot(snap)
+    sf = np.asarray(filters.static_feasible(arr))
+    # fold in the capacity check at initial used for a full Filter answer
+    fit = np.all(
+        arr.node_used[None, :, :] + arr.pod_req[:, None, :] <= arr.node_alloc[None, :, :],
+        axis=2,
+    )
+    return (sf & fit), meta
+
+
+def test_fit_filters_oversized_pod():
+    snap = Snapshot(
+        nodes=[mk_node("small", cpu=1000), mk_node("big", cpu=8000)],
+        pending_pods=[mk_pod("p", cpu=4000)],
+    )
+    f, _ = feasible_matrix(snap)
+    assert not f[0, 0] and f[0, 1]
+
+
+def test_taint_requires_toleration():
+    taint = (t.Taint(key="dedicated", value="infra", effect=t.NO_SCHEDULE),)
+    snap = Snapshot(
+        nodes=[mk_node("tainted", taints=taint), mk_node("clean")],
+        pending_pods=[
+            mk_pod("no-tol"),
+            mk_pod("tol", tolerations=(t.Toleration(key="dedicated", value="infra"),)),
+            mk_pod("tol-exists", tolerations=(t.Toleration(key="dedicated", operator=t.OP_EXISTS),)),
+        ],
+    )
+    f, meta = feasible_matrix(snap)
+    by = {nm: i for i, nm in enumerate(meta.pod_names[:3])}
+    assert not f[by["no-tol"], 0] and f[by["no-tol"], 1]
+    assert f[by["tol"], 0] and f[by["tol-exists"], 0]
+
+
+def test_prefer_no_schedule_does_not_filter():
+    taint = (t.Taint(key="soft", effect=t.PREFER_NO_SCHEDULE),)
+    snap = Snapshot(nodes=[mk_node("n", taints=taint)], pending_pods=[mk_pod("p")])
+    f, _ = feasible_matrix(snap)
+    assert f[0, 0]
+
+
+def test_node_selector_equality():
+    snap = Snapshot(
+        nodes=[mk_node("ssd", labels={"disk": "ssd"}), mk_node("hdd", labels={"disk": "hdd"})],
+        pending_pods=[mk_pod("p", node_selector={"disk": "ssd"})],
+    )
+    f, _ = feasible_matrix(snap)
+    assert f[0, 0] and not f[0, 1]
+
+
+def test_node_affinity_operators():
+    nodes = [
+        mk_node("a", labels={"tier": "gold", "gen": "7"}),
+        mk_node("b", labels={"tier": "silver", "gen": "5"}),
+        mk_node("c", labels={"gen": "9"}),
+    ]
+
+    def aff(op, key="tier", values=()):
+        return t.Affinity(
+            required_node_terms=(
+                t.NodeSelectorTerm(
+                    match_expressions=(
+                        t.NodeSelectorRequirement(key=key, operator=op, values=values),
+                    )
+                ),
+            )
+        )
+
+    snap = Snapshot(
+        nodes=nodes,
+        pending_pods=[
+            mk_pod("in", affinity=aff(t.OP_IN, values=("gold",))),
+            mk_pod("notin", affinity=aff(t.OP_NOT_IN, values=("gold",))),
+            mk_pod("exists", affinity=aff(t.OP_EXISTS)),
+            mk_pod("absent", affinity=aff(t.OP_DOES_NOT_EXIST)),
+            mk_pod("gt", affinity=aff(t.OP_GT, key="gen", values=("6",))),
+            mk_pod("lt", affinity=aff(t.OP_LT, key="gen", values=("6",))),
+        ],
+    )
+    f, meta = feasible_matrix(snap)
+    by = {nm: i for i, nm in enumerate(meta.pod_names[:6])}
+    assert list(f[by["in"], :3]) == [True, False, False]
+    assert list(f[by["notin"], :3]) == [False, True, True]  # absent key matches NotIn
+    assert list(f[by["exists"], :3]) == [True, True, False]
+    assert list(f[by["absent"], :3]) == [False, False, True]
+    assert list(f[by["gt"], :3]) == [True, False, True]
+    assert list(f[by["lt"], :3]) == [False, True, False]
+
+
+def test_or_of_terms_and_nodeselector_conjunction():
+    nodes = [
+        mk_node("a", labels={"x": "1", "disk": "ssd"}),
+        mk_node("b", labels={"y": "1", "disk": "ssd"}),
+        mk_node("c", labels={"x": "1", "disk": "hdd"}),
+    ]
+    aff = t.Affinity(
+        required_node_terms=(
+            t.NodeSelectorTerm(
+                match_expressions=(
+                    t.NodeSelectorRequirement(key="x", operator=t.OP_IN, values=("1",)),
+                )
+            ),
+            t.NodeSelectorTerm(
+                match_expressions=(
+                    t.NodeSelectorRequirement(key="y", operator=t.OP_IN, values=("1",)),
+                )
+            ),
+        )
+    )
+    snap = Snapshot(
+        nodes=nodes,
+        pending_pods=[mk_pod("p", affinity=aff, node_selector={"disk": "ssd"})],
+    )
+    f, _ = feasible_matrix(snap)
+    # (x=1 OR y=1) AND disk=ssd
+    assert list(f[0, :3]) == [True, True, False]
+
+
+def test_unknown_selector_value_unsatisfiable():
+    snap = Snapshot(
+        nodes=[mk_node("a", labels={"disk": "ssd"})],
+        pending_pods=[mk_pod("p", node_selector={"disk": "nvme"})],
+    )
+    f, _ = feasible_matrix(snap)
+    assert not f[0].any()
+
+
+def test_unschedulable_node_filtered_unless_tolerated():
+    snap = Snapshot(
+        nodes=[mk_node("cordoned", unschedulable=True)],
+        pending_pods=[
+            mk_pod("p"),
+            mk_pod(
+                "tolerant",
+                tolerations=(
+                    t.Toleration(key="node.kubernetes.io/unschedulable", operator=t.OP_EXISTS),
+                ),
+            ),
+        ],
+    )
+    f, meta = feasible_matrix(snap)
+    by = {nm: i for i, nm in enumerate(meta.pod_names[:2])}
+    assert not f[by["p"], 0]
+    assert f[by["tolerant"], 0]

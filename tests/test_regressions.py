@@ -1,0 +1,60 @@
+"""Regression tests for review-confirmed defects."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+from kubernetes_tpu.oracle import oracle_schedule
+from helpers import mk_node, mk_pod
+
+
+def run_both(snap):
+    arr, meta = encode_snapshot(snap)
+    c = np.asarray(schedule_batch(arr, DEFAULT_SCORE_CONFIG)[0])
+    got = [
+        (meta.pod_names[k], meta.node_names[c[k]] if c[k] >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+    want = oracle_schedule(snap)
+    assert got == want
+    return dict(got)
+
+
+def test_int32_overflow_in_fit():
+    # used + req would wrap negative in int32 and falsely pass
+    big = 2**31 - 1
+    snap = Snapshot(
+        nodes=[t.Node("n0", allocatable={t.CPU: big, t.MEMORY: 1 << 40, t.PODS: 110})],
+        pending_pods=[t.Pod("p", requests={t.CPU: big - 5})],
+        bound_pods=[t.Pod("b", requests={t.CPU: big - 3}, node_name="n0")],
+    )
+    got = run_both(snap)
+    assert got["p"] is None
+
+
+def test_zero_request_resource_never_blocks():
+    # node overcommitted on cpu by external binds still accepts a 0-cpu pod
+    snap = Snapshot(
+        nodes=[mk_node("n0", cpu=1000)],
+        pending_pods=[t.Pod("zero", requests={t.MEMORY: 1 << 20})],
+        bound_pods=[t.Pod("hog", requests={t.CPU: 2000}, node_name="n0")],
+    )
+    got = run_both(snap)
+    assert got["zero"] == "n0"
+
+
+def test_empty_affinity_term_matches_nothing():
+    aff = t.Affinity(required_node_terms=(t.NodeSelectorTerm(),))
+    snap = Snapshot(nodes=[mk_node("n0")], pending_pods=[mk_pod("p", affinity=aff)])
+    got = run_both(snap)
+    assert got["p"] is None
+
+
+def test_scheduling_gates_hold_pod():
+    snap = Snapshot(
+        nodes=[mk_node("n0")],
+        pending_pods=[mk_pod("gated", scheduling_gates=("wait-for-quota",)), mk_pod("free")],
+    )
+    got = run_both(snap)
+    assert got["gated"] is None and got["free"] == "n0"

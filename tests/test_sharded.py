@@ -1,0 +1,31 @@
+"""Sharded (8-device CPU mesh) vs single-device parity — the multi-chip path
+must be bit-identical to the unsharded scan and hence to the oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_tpu.api.snapshot import encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+from kubernetes_tpu.parallel import make_mesh, sharded_schedule_batch
+from helpers import random_cluster
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 virtual CPU devices"
+    return make_mesh(8)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_sharded_matches_unsharded(mesh, seed):
+    rng = random.Random(7000 + seed)
+    snap = random_cluster(rng, n_nodes=24, n_pods=50, with_taints=True, with_selectors=True)
+    arr, _ = encode_snapshot(snap)
+    want, want_used = schedule_batch(arr, DEFAULT_SCORE_CONFIG)
+    got, got_used = sharded_schedule_batch(arr, DEFAULT_SCORE_CONFIG, mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got_used), np.asarray(want_used))

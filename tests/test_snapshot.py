@@ -1,0 +1,73 @@
+"""L0 encoder unit tests (analog of scheduler cache/snapshot tests)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from helpers import GI, MILLI, mk_node, mk_pod
+
+
+def test_shapes_padded_pow2():
+    snap = Snapshot(nodes=[mk_node(f"n{i}") for i in range(5)], pending_pods=[mk_pod("p0")])
+    arr, meta = encode_snapshot(snap)
+    assert arr.N == 8 and arr.P == 8
+    assert arr.node_valid.sum() == 5 and arr.pod_valid.sum() == 1
+    assert meta.resources[:3] == [t.CPU, t.MEMORY, t.PODS]
+
+
+def test_resource_scaling_exact():
+    snap = Snapshot(
+        nodes=[mk_node("n0", cpu=4 * MILLI, mem=8 * GI)],
+        pending_pods=[mk_pod("p0", cpu=250, mem=256 * 1024**2)],
+    )
+    arr, meta = encode_snapshot(snap)
+    j_cpu = meta.resources.index(t.CPU)
+    j_mem = meta.resources.index(t.MEMORY)
+    # scaled values recover canonical quantities exactly
+    assert arr.node_alloc[0, j_cpu] * meta.resource_scale[j_cpu] == 4 * MILLI
+    assert arr.pod_req[0, j_mem] * meta.resource_scale[j_mem] == 256 * 1024**2
+
+
+def test_activeq_order_priority_then_fifo():
+    pods = [mk_pod("low"), mk_pod("high", priority=10), mk_pod("mid", priority=5)]
+    snap = Snapshot(nodes=[mk_node("n0")], pending_pods=pods)
+    _, meta = encode_snapshot(snap)
+    assert meta.pod_names[:3] == ["high", "mid", "low"]
+
+
+def test_pods_resource_synthetic():
+    snap = Snapshot(nodes=[mk_node("n0", pods=7)], pending_pods=[mk_pod("p0")])
+    arr, meta = encode_snapshot(snap)
+    j = meta.resources.index(t.PODS)
+    assert arr.node_alloc[0, j] == 7
+    assert arr.pod_req[0, j] == 1
+
+
+def test_bound_pods_accumulate_used():
+    snap = Snapshot(
+        nodes=[mk_node("n0", cpu=4000)],
+        pending_pods=[mk_pod("p")],
+        bound_pods=[mk_pod("b1", cpu=500, node_name="n0"), mk_pod("b2", cpu=300, node_name="n0")],
+    )
+    arr, meta = encode_snapshot(snap)
+    j = meta.resources.index(t.CPU)
+    assert arr.node_used[0, j] * meta.resource_scale[j] == 800
+
+
+def test_unschedulable_becomes_taint():
+    snap = Snapshot(nodes=[mk_node("n0", unschedulable=True)], pending_pods=[mk_pod("p")])
+    arr, meta = encode_snapshot(snap)
+    assert ("node.kubernetes.io/unschedulable", "", t.NO_SCHEDULE) in meta.taint_vocab
+    assert arr.node_taint_ns[0].any()
+    # pod does not tolerate it
+    assert not arr.pod_tol_ns[0, meta.taint_vocab.get(("node.kubernetes.io/unschedulable", "", t.NO_SCHEDULE))]
+
+
+def test_nodename_pinning():
+    snap = Snapshot(
+        nodes=[mk_node("a"), mk_node("b")],
+        pending_pods=[mk_pod("p0", node_name="b"), mk_pod("p1", node_name="ghost")],
+    )
+    arr, _ = encode_snapshot(snap)
+    assert arr.pod_nodename[0] == 1
+    assert arr.pod_nodename[1] == -2
