@@ -27,12 +27,13 @@ def main() -> None:
 
     from kubernetes_tpu.api.snapshot import encode_snapshot
     from kubernetes_tpu.bench.workloads import basic
-    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
 
     print(f"devices: {jax.devices()}", file=sys.stderr)
     snap = basic(N_NODES, N_PODS, seed=0)
     t0 = time.perf_counter()
     arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
     arr = jax.device_put(arr)
     t_encode = time.perf_counter() - t0
     print(f"encode: {t_encode:.3f}s  N={arr.N} P={arr.P} R={arr.R}", file=sys.stderr)
@@ -43,13 +44,13 @@ def main() -> None:
     # axon TPU tunnel, so timing forces a (tiny) host transfer of the choices
     # vector — which is also what a real sidecar client would consume.
     t0 = time.perf_counter()
-    choices = np.asarray(schedule_batch(arr, DEFAULT_SCORE_CONFIG)[0])
+    choices = np.asarray(schedule_batch(arr, cfg)[0])
     print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     best = float("inf")
     for _ in range(5):
         t0 = time.perf_counter()
-        choices = np.asarray(schedule_batch(arr, DEFAULT_SCORE_CONFIG)[0])
+        choices = np.asarray(schedule_batch(arr, cfg)[0])
         best = min(best, time.perf_counter() - t0)
 
     scheduled = int((choices[: meta.n_pods] >= 0).sum())

@@ -1,0 +1,207 @@
+"""Encoding for the pairwise plugins: PodTopologySpread, InterPodAffinity,
+NodePorts — the O(pods x pods) / O(pods x nodes) hot spots of the reference
+(SURVEY.md §2.2 ①: interpodaffinity/filtering.go, podtopologyspread/filtering.go).
+
+TPU-first reformulation: every (pod-label-selector, namespace-set, topologyKey)
+triple appearing in any spread constraint or (anti-)affinity term is interned as
+a *term* t.  The cluster-side state each plugin needs then collapses to
+
+  counts[t, d]      # matching pods per topology domain d (domain = interned
+                    # (key, value); column D = "node lacks the key")
+  anti_counts[t, d] # pods OWNING anti-affinity term t, per their domain
+
+maintained as scan-carried state in ops/assign.py: committing a pod scatter-adds
+its term-match row M[:, p] (and its own anti terms) at the chosen node's domain
+column.  Per-step feasibility/score checks are [N]-gathers of counts through the
+static node->domain map — no per-pod string work ever reaches the device.
+
+Selector-vs-pod matching itself (M_pend[T, P], and the counts0 initialisation
+from bound pods) is one host-side 0/1 matmul over the pod-label literal vocab.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import types as t
+from . import vocab as v
+
+# spread modes
+HARD = 1  # DoNotSchedule -> Filter
+SOFT = 0  # ScheduleAnyway -> Score only
+
+
+@dataclass(frozen=True)
+class TermKey:
+    """Interned identity of a pairwise term."""
+
+    topology_key: str
+    namespaces: Tuple[str, ...]
+    selector: Optional[t.LabelSelector]  # None matches nothing
+
+
+@dataclass
+class PairwiseVocab:
+    topo_keys: v.Interner  # topology key -> k
+    domains: v.Interner  # (key, value) -> d  (D == len == "absent" sentinel)
+    terms: v.Interner  # TermKey -> t
+    ports: v.Interner  # (protocol, port) -> id
+
+
+def _term_of_affinity(term: t.PodAffinityTerm, pod_ns: str) -> TermKey:
+    ns = tuple(sorted(term.namespaces)) if term.namespaces else (pod_ns,)
+    return TermKey(term.topology_key, ns, term.label_selector)
+
+
+def _term_of_spread(c: t.TopologySpreadConstraint, pod_ns: str) -> TermKey:
+    # spread counts pods in the pod's own namespace (reference:
+    # podtopologyspread/common.go — the constraint selector is namespace-scoped)
+    return TermKey(c.topology_key, (pod_ns,), c.label_selector)
+
+
+def _matches(term: TermKey, pod: t.Pod) -> bool:
+    if term.selector is None:
+        return False
+    return pod.namespace in term.namespaces and term.selector.matches(pod.labels)
+
+
+def build_pairwise(
+    nodes: Sequence[t.Node],
+    pending: Sequence[t.Pod],  # already in activeQ order
+    bound: Sequence[t.Pod],
+    node_index: Dict[str, int],
+    N: int,
+    P: int,
+):
+    """Returns (PairwiseVocab, dict of arrays) — see ClusterArrays for shapes."""
+    voc = PairwiseVocab(v.Interner(), v.Interner(), v.Interner(), v.Interner())
+
+    # ---- collect terms from every pending AND bound pod (bound pods' anti
+    # terms constrain incoming pods symmetrically) ----
+    pod_aff: List[List[int]] = []
+    pod_anti: List[List[int]] = []
+    pod_spread: List[List[Tuple[int, int, int]]] = []  # (term, maxSkew, mode)
+    for pod in pending:
+        aff_ids, anti_ids, spread_ids = [], [], []
+        if pod.affinity:
+            for term in pod.affinity.required_pod_affinity:
+                aff_ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
+            for term in pod.affinity.required_pod_anti_affinity:
+                anti_ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
+        for c in pod.topology_spread:
+            spread_ids.append(
+                (
+                    voc.terms.intern(_term_of_spread(c, pod.namespace)),
+                    c.max_skew,
+                    HARD if c.when_unsatisfiable == t.DO_NOT_SCHEDULE else SOFT,
+                )
+            )
+        pod_aff.append(aff_ids)
+        pod_anti.append(anti_ids)
+        pod_spread.append(spread_ids)
+    bound_anti: List[List[int]] = []
+    for pod in bound:
+        ids = []
+        if pod.affinity:
+            for term in pod.affinity.required_pod_anti_affinity:
+                ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
+        bound_anti.append(ids)
+
+    # ---- topology keys + domains over the node set ----
+    for tk in [tm.topology_key for tm in voc.terms.items]:
+        voc.topo_keys.intern(tk)
+    K = max(1, len(voc.topo_keys))
+    for nd in nodes:
+        for tk in voc.topo_keys.items:
+            if tk in nd.labels:
+                voc.domains.intern((tk, nd.labels[tk]))
+    D = len(voc.domains)  # sentinel column D = key absent
+
+    node_dom = np.full((K, N), D, dtype=np.int32)
+    for i, nd in enumerate(nodes):
+        for k, tk in enumerate(voc.topo_keys.items):
+            if tk in nd.labels:
+                node_dom[k, i] = voc.domains.get((tk, nd.labels[tk]))
+
+    T = max(1, len(voc.terms))
+    term_key = np.zeros(T, dtype=np.int32)
+    for ti, term in enumerate(voc.terms.items):
+        term_key[ti] = voc.topo_keys.get(term.topology_key)
+
+    # ---- host-side match matrices (the one O(T x pods) pass) ----
+    m_pend = np.zeros((T, P), dtype=np.float32)
+    for ti, term in enumerate(voc.terms.items):
+        for pi, pod in enumerate(pending):
+            if _matches(term, pod):
+                m_pend[ti, pi] = 1.0
+    term_counts0 = np.zeros((T, D + 1), dtype=np.float32)
+    for pod in bound:
+        ni = node_index.get(pod.node_name)
+        if ni is None:
+            continue
+        for ti, term in enumerate(voc.terms.items):
+            if _matches(term, pod):
+                k = term_key[ti]
+                term_counts0[ti, node_dom[k, ni]] += 1.0
+    anti_counts0 = np.zeros((T, D + 1), dtype=np.float32)
+    for pod, ids in zip(bound, bound_anti):
+        ni = node_index.get(pod.node_name)
+        if ni is None:
+            continue
+        for ti in ids:
+            anti_counts0[ti, node_dom[term_key[ti], ni]] += 1.0
+
+    # ---- per-pod term id arrays (padded) ----
+    A1 = max(1, max((len(x) for x in pod_aff), default=1))
+    A2 = max(1, max((len(x) for x in pod_anti), default=1))
+    C = max(1, max((len(x) for x in pod_spread), default=1))
+    pod_aff_terms = np.full((P, A1), -1, dtype=np.int32)
+    pod_anti_terms = np.full((P, A2), -1, dtype=np.int32)
+    pod_spread_terms = np.full((P, C), -1, dtype=np.int32)
+    pod_spread_maxskew = np.zeros((P, C), dtype=np.int32)
+    pod_spread_hard = np.zeros((P, C), dtype=bool)
+    for pi in range(len(pending)):
+        for a, ti in enumerate(pod_aff[pi]):
+            pod_aff_terms[pi, a] = ti
+        for a, ti in enumerate(pod_anti[pi]):
+            pod_anti_terms[pi, a] = ti
+        for c, (ti, skew, mode) in enumerate(pod_spread[pi]):
+            pod_spread_terms[pi, c] = ti
+            pod_spread_maxskew[pi, c] = skew
+            pod_spread_hard[pi, c] = mode == HARD
+
+    # ---- host ports ----
+    for pod in [*pending, *bound]:
+        for proto, port in pod.host_ports:
+            voc.ports.intern((proto, port))
+    PT = max(1, len(voc.ports))
+    pod_ports = np.zeros((P, PT), dtype=bool)
+    for pi, pod in enumerate(pending):
+        for proto, port in pod.host_ports:
+            pod_ports[pi, voc.ports.get((proto, port))] = True
+    node_ports0 = np.zeros((N, PT), dtype=bool)
+    for pod in bound:
+        ni = node_index.get(pod.node_name)
+        if ni is None:
+            continue
+        for proto, port in pod.host_ports:
+            node_ports0[ni, voc.ports.get((proto, port))] = True
+
+    arrays = dict(
+        node_dom=node_dom,
+        term_key=term_key,
+        m_pend=m_pend,
+        term_counts0=term_counts0,
+        anti_counts0=anti_counts0,
+        pod_aff_terms=pod_aff_terms,
+        pod_anti_terms=pod_anti_terms,
+        pod_spread_terms=pod_spread_terms,
+        pod_spread_maxskew=pod_spread_maxskew,
+        pod_spread_hard=pod_spread_hard,
+        pod_ports=pod_ports,
+        node_ports0=node_ports0,
+    )
+    return voc, arrays

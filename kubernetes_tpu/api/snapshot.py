@@ -79,6 +79,7 @@ class EncodingMeta:
     resource_scale: np.ndarray  # i64[R]; device value * scale == canonical units
     label_vocab: v.LabelVocab
     taint_vocab: v.Interner
+    pairwise_vocab: object  # api/pairwise.py — PairwiseVocab
     n_nodes: int
     n_pods: int
 
@@ -105,6 +106,23 @@ class ClusterArrays:
     pod_has_sel: np.ndarray
     sel_mask: np.ndarray
     sel_kind: np.ndarray
+    # preferred (soft) node affinity: term ids into sel_* + weights
+    pod_pref_terms: np.ndarray  # i32[P, PW], -1 pad
+    pod_pref_weights: np.ndarray  # f32[P, PW]
+    # pairwise-plugin state (api/pairwise.py): topology domains, interned
+    # (selector, nsset, topoKey) terms, match matrices, initial counts
+    node_dom: np.ndarray  # i32[K, N] domain id, D = key absent
+    term_key: np.ndarray  # i32[T] -> topology key index
+    m_pend: np.ndarray  # f32[T, P] pending pod matches term selector+ns
+    term_counts0: np.ndarray  # f32[T, D+1] matching bound pods per domain
+    anti_counts0: np.ndarray  # f32[T, D+1] bound pods OWNING anti term t
+    pod_aff_terms: np.ndarray  # i32[P, A1] required pod-affinity term ids
+    pod_anti_terms: np.ndarray  # i32[P, A2] required pod-anti-affinity term ids
+    pod_spread_terms: np.ndarray  # i32[P, C] topology-spread term ids
+    pod_spread_maxskew: np.ndarray  # i32[P, C]
+    pod_spread_hard: np.ndarray  # bool[P, C] DoNotSchedule?
+    pod_ports: np.ndarray  # bool[P, PT] requested host ports
+    node_ports0: np.ndarray  # bool[N, PT] ports taken by bound pods
 
     @property
     def N(self) -> int:
@@ -266,6 +284,7 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
 
     table = v.TermTable()
     pod_term_lists: List[List[int]] = []
+    pref_lists: List[List[Tuple[int, float]]] = []
     for out_i, src_i in enumerate(perm):
         pod = pending[src_i]
         pod_prio[out_i] = pod.priority
@@ -280,6 +299,16 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
             pod_nodename[out_i] = node_index.get(pod.node_name, -2)
         terms = v.pod_required_node_terms(pod, lab)
         pod_term_lists.append([] if terms is None else [table.intern(tm) for tm in terms])
+        # preferred node affinity: weight per matching term (empty term matches
+        # nothing, mirroring the required path)
+        prefs: List[Tuple[int, float]] = []
+        if pod.affinity:
+            for pt in pod.affinity.preferred_node_terms:
+                if pt.preference.match_expressions:
+                    prefs.append(
+                        (table.intern(v.lower_node_term(pt.preference.match_expressions, lab)), float(pt.weight))
+                    )
+        pref_lists.append(prefs)
 
     TT = max(1, max((len(x) for x in pod_term_lists), default=1))
     pod_terms = np.full((P, TT), -1, dtype=np.int32)
@@ -289,7 +318,22 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
             pod_has_sel[i] = True
             pod_terms[i, : len(ids)] = ids
 
+    PW = max(1, max((len(x) for x in pref_lists), default=1))
+    pod_pref_terms = np.full((P, PW), -1, dtype=np.int32)
+    pod_pref_weights = np.zeros((P, PW), dtype=np.float32)
+    for i, prefs in enumerate(pref_lists):
+        for a, (tid, w) in enumerate(prefs):
+            pod_pref_terms[i, a] = tid
+            pod_pref_weights[i, a] = w
+
     sel_mask, sel_kind = table.encode(L)
+
+    from .pairwise import build_pairwise
+
+    sorted_pending = [pending[i] for i in perm]
+    _pair_voc, pair = build_pairwise(
+        nodes, sorted_pending, snap.bound_pods, node_index, N, P
+    )
 
     arrays = ClusterArrays(
         node_valid=node_valid,
@@ -309,6 +353,9 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
         pod_has_sel=pod_has_sel,
         sel_mask=sel_mask,
         sel_kind=sel_kind,
+        pod_pref_terms=pod_pref_terms,
+        pod_pref_weights=pod_pref_weights,
+        **pair,
     )
     meta = EncodingMeta(
         node_names=[nd.name for nd in nodes],
@@ -318,6 +365,7 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
         resource_scale=scale,
         label_vocab=lab,
         taint_vocab=taints,
+        pairwise_vocab=_pair_voc,
         n_nodes=n,
         n_pods=p,
     )

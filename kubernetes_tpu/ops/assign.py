@@ -4,11 +4,15 @@ The reference schedules one pod per cycle; placing pod i mutates NodeInfo before
 pod i+1 is considered (pkg/scheduler/schedule_one.go — ScheduleOne + the assume
 cache, backend/cache/cache.go — AssumePod).  To reproduce those semantics in one
 XLA program, everything capacity-independent (static feasibility, raw score
-counts) is evaluated for the whole batch up front as [P, N] matrices, and a
-`lax.scan` over pods (in activeQ order == array order) re-evaluates only the
-capacity-dependent terms per step:
+counts, selector matmuls) is evaluated for the whole batch up front as [P, N]
+matrices, and a `lax.scan` over pods (in activeQ order == array order)
+re-evaluates only the state-dependent terms per step:
 
   - NodeResourcesFit.Filter against the running node_used
+  - NodePorts.Filter against the running ports_used
+  - PodTopologySpread / InterPodAffinity against the running counts[T, D+1]
+    (committed pods become "existing" for every later pod — including their
+    own anti-affinity terms, via anti_counts)
   - LeastAllocated / BalancedAllocation scores against used + this pod's request
   - per-pod NormalizeScore over the *currently* feasible set
 
@@ -17,52 +21,159 @@ index.  (The reference's selectHost — schedule_one.go — picks randomly among
 equal-score nodes; this framework is deterministic by design, the "full-scoring
 deterministic mode" deviation called out in SURVEY.md §7 hard part 1.  The
 oracle applies the identical rule, so parity is exact within the framework.)
+
+ONE implementation serves both execution modes: `axis_name=None` runs on a
+single device; under shard_map (parallel/sharded.py) the same step function
+sees local node shards and stitches global decisions with pmax/pmin/psum —
+per-node score math never crosses shards, so both modes are bit-identical.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..api.snapshot import ClusterArrays
-from . import filters
-from .scores import ScoreConfig, balanced_allocation, least_allocated, normalize_reverse, taint_prefer_counts
+from . import filters, pairwise
+from .scores import (
+    MAX_NODE_SCORE,
+    ScoreConfig,
+    balanced_allocation,
+    least_allocated,
+    taint_prefer_counts,
+)
+
+_INT_MAX = jnp.iinfo(jnp.int32).max
 
 
-def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
-    """Schedule every pending pod in the snapshot.
+def _rmax(x, axis_name):
+    """Reduce-max over the node axis (last), then across shards if sharded."""
+    m = jnp.max(x, axis=-1)
+    return lax.pmax(m, axis_name) if axis_name else m
 
-    Returns (assignment i32[P] — node index or -1 unschedulable,
-             node_used i32[N, R] — capacity state after all commits).
-    """
-    sf = filters.static_feasible(arr)  # [P, N]
-    pref = taint_prefer_counts(arr)  # [P, N]
+
+def _rmin(x, axis_name):
+    m = jnp.min(x, axis=-1)
+    return lax.pmin(m, axis_name) if axis_name else m
+
+
+def _preferred_node_affinity_raw(arr: ClusterArrays, term_matches: jax.Array) -> jax.Array:
+    """f32[P, N]: summed weights of matching preferred node-affinity terms
+    (nodeaffinity/node_affinity.go — Score).  One [P, S] @ [S, N] matmul."""
+    P, _ = arr.pod_pref_terms.shape
+    S = term_matches.shape[0]
+    ids = jnp.maximum(arr.pod_pref_terms, 0)
+    w = jnp.where(arr.pod_pref_terms >= 0, arr.pod_pref_weights, 0.0)
+    W = jnp.zeros((P, S), dtype=jnp.float32)
+    W = W.at[jnp.arange(P)[:, None], ids].add(w)
+    return W @ term_matches.astype(jnp.float32)
+
+
+def schedule_scan(
+    arr: ClusterArrays, cfg: ScoreConfig, axis_name: Optional[str] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """The full scheduling step.  `arr` holds the whole cluster when
+    axis_name is None, or this shard's node slice under shard_map.
+
+    Returns (assignment i32[P] — GLOBAL node index or -1, node_used i32[N,R])."""
+    local_n = arr.N
+    if axis_name:
+        base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
+    else:
+        base = jnp.int32(0)
+    my_nodes = base + jnp.arange(local_n, dtype=jnp.int32)
+
+    tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)  # [S, Nl]
+    nodesel = filters.node_selection_ok_from(tm, arr)  # [P, Nl]
+    pin = arr.pod_nodename[:, None]
+    nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+    sf = (
+        arr.node_valid[None, :]
+        & arr.pod_valid[:, None]
+        & filters.taints_ok(arr)
+        & nodesel
+        & nodename_ok
+    )
+    pref_taints = taint_prefer_counts(arr)  # [P, Nl]
+    na_raw = _preferred_node_affinity_raw(arr, tm)  # [P, Nl]
     n_alloc = arr.node_alloc
+    node_dom, term_key = arr.node_dom, arr.term_key
 
-    def step(used, xs):
-        req, feas_row, pref_row, valid = xs
+    def norm_reverse(counts, feasible):
+        mx = _rmax(jnp.where(feasible, counts, 0.0), axis_name)
+        return jnp.where(mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / mx, MAX_NODE_SCORE)
+
+    def step(state, xs):
+        used, counts, anti_counts, ports_used = state
+        (req, feas_row, nodesel_row, pref_row, na_row, valid,
+         aff_terms, anti_terms, spread_terms, spread_skew, spread_hard,
+         m_col, ports_row) = xs
+
         feasible = feas_row & filters.fit_ok(req, used, n_alloc)
+        if cfg.enable_ports:
+            feasible &= pairwise.ports_ok(ports_used, ports_row)
+        if cfg.enable_pairwise:
+            spread_ok, spread_raw = pairwise.spread_step(
+                counts, node_dom, term_key, spread_terms, spread_skew, spread_hard,
+                nodesel_row & arr.node_valid, axis_name,
+            )
+            feasible &= spread_ok & pairwise.interpod_required_ok(
+                counts, anti_counts, node_dom, term_key, aff_terms, anti_terms, m_col
+            )
+        else:
+            spread_raw = jnp.zeros_like(feas_row, dtype=jnp.float32)
         requested = used + req[None, :]
+        # NodeAffinity preferred: DefaultNormalizeScore (not reversed)
+        na_max = _rmax(jnp.where(feasible, na_row, 0.0), axis_name)
+        na_sc = jnp.where(na_max > 0, na_row * MAX_NODE_SCORE / na_max, 0.0)
         total = (
             cfg.fit_weight * least_allocated(requested, n_alloc, cfg.score_resources)
             + cfg.balanced_weight
             * balanced_allocation(requested, n_alloc, cfg.score_resources)
-            + cfg.taint_weight * normalize_reverse(pref_row, feasible)
+            + cfg.taint_weight * norm_reverse(pref_row, feasible)
+            + cfg.node_affinity_weight * na_sc
+            + cfg.spread_weight * norm_reverse(spread_raw, feasible)
         )
         total = jnp.where(feasible, total, -jnp.inf)
-        schedulable = feasible.any() & valid
-        choice = jnp.where(schedulable, jnp.argmax(total).astype(jnp.int32), -1)
-        placed = (jnp.arange(used.shape[0], dtype=jnp.int32) == choice)[:, None]
-        return used + placed.astype(used.dtype) * req[None, :], choice
+        best = _rmax(total, axis_name)
+        schedulable = (best > -jnp.inf) & valid
+        # lowest global index attaining the max
+        cand = jnp.where((total == best) & feasible, my_nodes, _INT_MAX)
+        choice = jnp.where(schedulable, _rmin(cand, axis_name).astype(jnp.int32), -1)
 
-    used_final, choices = lax.scan(
-        step, arr.node_used, (arr.pod_req, sf, pref, arr.pod_valid)
+        placed = (my_nodes == choice)[:, None]
+        used = used + placed.astype(used.dtype) * req[None, :]
+        if cfg.enable_pairwise:
+            # domain column of the chosen node, per term — owner shard broadcasts
+            is_mine = (choice >= base) & (choice < base + local_n)
+            local_col = jnp.clip(choice - base, 0, local_n - 1)
+            dom_col = jnp.where(is_mine, node_dom[term_key, local_col], 0)
+            if axis_name:
+                dom_col = lax.psum(dom_col, axis_name)
+            counts, anti_counts = pairwise.commit_counts(
+                counts, anti_counts, choice, dom_col, m_col, anti_terms
+            )
+        if cfg.enable_ports:
+            ports_used = ports_used | (placed & ports_row[None, :])
+        return (used, counts, anti_counts, ports_used), choice
+
+    state0 = (arr.node_used, arr.term_counts0, arr.anti_counts0, arr.node_ports0)
+    xs = (
+        arr.pod_req, sf, nodesel, pref_taints, na_raw, arr.pod_valid,
+        arr.pod_aff_terms, arr.pod_anti_terms, arr.pod_spread_terms,
+        arr.pod_spread_maxskew, arr.pod_spread_hard,
+        arr.m_pend.T, arr.pod_ports,
     )
+    (used_final, _, _, _), choices = lax.scan(step, state0, xs)
     return choices, used_final
+
+
+def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
+    return schedule_scan(arr, cfg, axis_name=None)
 
 
 schedule_batch = partial(jax.jit, static_argnames=("cfg",))(schedule_batch_impl)

@@ -44,12 +44,19 @@ def term_match(sel_mask: jax.Array, sel_kind: jax.Array, node_labels: jax.Array)
     return jnp.all(ok, axis=1)
 
 
-def node_selection_ok(arr: ClusterArrays) -> jax.Array:
-    """bool[P, N]: spec.nodeSelector AND required node affinity (ORed terms)."""
-    tm = term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)  # [S, N]
+def node_selection_ok_from(tm: jax.Array, arr: ClusterArrays) -> jax.Array:
+    """bool[P, N] from a precomputed term_match matrix (shared with preferred
+    node-affinity scoring)."""
     ids = jnp.maximum(arr.pod_terms, 0)  # [P, TT]
     per_term = tm[ids] & (arr.pod_terms >= 0)[:, :, None]  # [P, TT, N]
     return jnp.where(arr.pod_has_sel[:, None], per_term.any(axis=1), True)
+
+
+def node_selection_ok(arr: ClusterArrays) -> jax.Array:
+    """bool[P, N]: spec.nodeSelector AND required node affinity (ORed terms)."""
+    return node_selection_ok_from(
+        term_match(arr.sel_mask, arr.sel_kind, arr.node_labels), arr
+    )
 
 
 def taints_ok(arr: ClusterArrays) -> jax.Array:

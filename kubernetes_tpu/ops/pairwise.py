@@ -1,0 +1,106 @@
+"""Per-step kernels for PodTopologySpread, InterPodAffinity, NodePorts — L2's
+pairwise half, evaluated inside the commit scan against the running
+counts[T, D+1] / anti_counts[T, D+1] / ports_used[N, PT] state.
+
+Shapes: T interned terms, K topology keys, D domains (column D = key absent),
+N nodes, C/A1/A2 per-pod constraint slots (padded with -1).
+
+reference: podtopologyspread/filtering.go — calPreFilterState + Filter skew
+check; interpodaffinity/filtering.go — satisfyPodAffinity/satisfyPodAntiAffinity
+/satisfyExistingPodsAntiAffinity; nodeports/node_ports.go — Fits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _term_rows(counts, node_dom, term_key, term_ids):
+    """For each term slot (id or -1): its per-node count row and key presence.
+
+    Returns (cnt[A, N], has_key[A, N], valid[A])."""
+    valid = term_ids >= 0
+    tids = jnp.maximum(term_ids, 0)
+    keys = term_key[tids]  # [A]
+    dom_rows = node_dom[keys]  # [A, N]
+    D = counts.shape[1] - 1
+    cnt = jnp.take_along_axis(counts[tids], dom_rows, axis=1)  # [A, N]
+    return cnt, dom_rows < D, valid
+
+
+def spread_step(counts, node_dom, term_key, spread_terms, maxskew, hard, eligible,
+                axis_name=None):
+    """-> (ok[N] hard-constraint feasibility, raw[N] score counts).
+
+    Skew rule per DoNotSchedule constraint: placing the pod in node n's domain
+    must keep  count(domain) + 1 - minMatch <= maxSkew, where minMatch is the
+    min count over domains that contain at least one node passing the pod's
+    node-affinity filter (reference: TpKeyToCriticalPaths — the "critical path"
+    min).  Nodes lacking the topology key fail hard constraints.
+    """
+    cnt, has_key, valid = _term_rows(counts, node_dom, term_key, spread_terms)
+    elig = eligible[None, :] & has_key
+    min_match = jnp.min(jnp.where(elig, cnt, jnp.inf), axis=1)
+    if axis_name:
+        min_match = jax.lax.pmin(min_match, axis_name)
+    min_match = jnp.where(jnp.isinf(min_match), 0.0, min_match)
+    ok_c = has_key & (cnt + 1.0 - min_match[:, None] <= maxskew[:, None].astype(jnp.float32))
+    ok_c = jnp.where((valid & hard)[:, None], ok_c, True)
+    raw = jnp.where((valid[:, None] & has_key), cnt, 0.0).sum(axis=0)
+    return jnp.all(ok_c, axis=0), raw
+
+
+def interpod_required_ok(
+    counts, anti_counts, node_dom, term_key, aff_terms, anti_terms, m_pend_col
+):
+    """-> ok[N]: required pod affinity + own anti-affinity + existing pods'
+    anti-affinity (symmetric), against current counts."""
+    D = counts.shape[1] - 1
+    N = node_dom.shape[1]
+
+    # --- required affinity: every term's domain must already hold a match,
+    # unless NO matching pod exists anywhere and the pod matches its own terms
+    cnt, has_key, valid = _term_rows(counts, node_dom, term_key, aff_terms)
+    ok_a = jnp.where(valid[:, None], has_key & (cnt > 0), True)
+    tids = jnp.maximum(aff_terms, 0)
+    total_any = jnp.where(valid, counts[tids, :D].sum(axis=1), 0.0).sum()
+    self_all = jnp.all(jnp.where(valid, m_pend_col[tids] > 0, True))
+    has_aff = valid.any()
+    waiver = has_aff & (total_any == 0) & self_all
+    aff_ok = jnp.all(ok_a, axis=0) | waiver
+
+    # --- own required anti-affinity: domain must hold no match (absent key
+    # cannot be violated)
+    cnt2, has_key2, valid2 = _term_rows(counts, node_dom, term_key, anti_terms)
+    anti_ok = jnp.all(jnp.where(valid2[:, None], ~(has_key2 & (cnt2 > 0)), True), axis=0)
+
+    # --- existing pods' anti-affinity vs this pod: aggregate per topology key
+    # (column D dropped: an anti term on a keyless node can't be violated)
+    K = node_dom.shape[0]
+    contrib = m_pend_col[:, None] * anti_counts[:, :D]  # [T, D]
+    per_key = jax.ops.segment_sum(contrib, term_key, num_segments=K)  # [K, D]
+    per_key = jnp.concatenate([per_key, jnp.zeros((K, 1), per_key.dtype)], axis=1)
+    blocked = jnp.take_along_axis(per_key, node_dom, axis=1).sum(axis=0)  # [N]
+    return aff_ok & anti_ok & (blocked == 0)
+
+
+def ports_ok(ports_used, pod_ports_row):
+    """-> ok[N]: no hostPort conflict (nodeports/node_ports.go — Fits)."""
+    return ~jnp.any(ports_used & pod_ports_row[None, :], axis=1)
+
+
+def commit_counts(counts, anti_counts, choice, dom_col, m_pend_col, anti_terms):
+    """Scatter the committed pod into the pairwise counts (no-op when choice<0).
+
+    `dom_col` is the chosen node's domain per term ([T], already resolved
+    globally by the caller — under sharding the owner shard broadcasts it).
+    """
+    T = counts.shape[0]
+    placed = (choice >= 0).astype(counts.dtype)
+    counts = counts.at[jnp.arange(T), dom_col].add(placed * m_pend_col)
+    # the pod's own anti terms now constrain later pods
+    valid2 = (anti_terms >= 0) & (choice >= 0)
+    tids2 = jnp.maximum(anti_terms, 0)
+    anti_counts = anti_counts.at[tids2, dom_col[tids2]].add(valid2.astype(anti_counts.dtype))
+    return counts, anti_counts

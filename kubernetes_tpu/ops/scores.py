@@ -41,9 +41,32 @@ class ScoreConfig:
     spread_weight: float = 2.0  # PodTopologySpread
     interpod_weight: float = 2.0  # InterPodAffinity
     score_resources: Tuple[int, ...] = (0, 1)  # indices into the R axis
+    # Static specialization: when a snapshot carries no pairwise terms / host
+    # ports, the jitted program omits that per-step state entirely (XLA sees
+    # the branch at trace time).  Results are identical either way; this only
+    # prunes provably-dead work.  See infer_score_config.
+    enable_pairwise: bool = True
+    enable_ports: bool = True
 
 
 DEFAULT_SCORE_CONFIG = ScoreConfig()
+
+
+def infer_score_config(arr, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG) -> ScoreConfig:
+    """Specialize cfg to the snapshot: disable pairwise/ports stages the
+    encoded arrays prove unused (host-side inspection of concrete arrays)."""
+    import dataclasses
+
+    import numpy as np
+
+    has_terms = bool(
+        np.any(arr.pod_aff_terms >= 0)
+        or np.any(arr.pod_anti_terms >= 0)
+        or np.any(arr.pod_spread_terms >= 0)
+        or np.any(arr.anti_counts0 > 0)
+    )
+    has_ports = bool(np.any(arr.pod_ports) or np.any(arr.node_ports0))
+    return dataclasses.replace(cfg, enable_pairwise=has_terms, enable_ports=has_ports)
 
 
 def least_allocated(

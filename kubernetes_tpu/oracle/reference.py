@@ -120,6 +120,122 @@ def _balanced(requested: np.ndarray, alloc: np.ndarray, idx) -> f32:
     return f32((f32(1.0) - f32(np.sqrt(var))) * f32(MAX_NODE_SCORE))
 
 
+def _term_matches_pod(sel: Optional[t.LabelSelector], namespaces, pod: t.Pod) -> bool:
+    if sel is None:
+        return False
+    return pod.namespace in namespaces and sel.matches(pod.labels)
+
+
+def _aff_namespaces(term: t.PodAffinityTerm, owner: t.Pod):
+    return tuple(sorted(term.namespaces)) if term.namespaces else (owner.namespace,)
+
+
+def _ports_conflict(pod: t.Pod, existing_on_node) -> bool:
+    mine = set(pod.host_ports)
+    if not mine:
+        return False
+    for q in existing_on_node:
+        if mine & set(q.host_ports):
+            return True
+    return False
+
+
+def _spread_eval(pod, nodes, node_ok_sel, existing, n):
+    """Per DoNotSchedule constraint feasibility + summed match counts for the
+    score, mirroring ops/pairwise.spread_step."""
+    ok = True
+    raw = f32(0.0)
+    for c in pod.topology_spread:
+        key = c.topology_key
+        # counts per domain over keyed nodes
+        counts: Dict[str, int] = {}
+        for q, qn in existing:
+            val = nodes[qn].labels.get(key)
+            if val is not None and _term_matches_pod(c.label_selector, (pod.namespace,), q):
+                counts[val] = counts.get(val, 0) + 1
+        has_key = key in nodes[n].labels
+        if has_key:
+            raw = f32(raw + f32(counts.get(nodes[n].labels[key], 0)))
+        if c.when_unsatisfiable != t.DO_NOT_SCHEDULE:
+            continue
+        # minMatch over domains containing >= 1 node passing the node filter
+        elig_domains = set()
+        for i, nd in enumerate(nodes):
+            if node_ok_sel[i] and key in nd.labels:
+                elig_domains.add(nd.labels[key])
+        if not has_key:
+            ok = False
+            continue
+        min_match = min((counts.get(d, 0) for d in elig_domains), default=0)
+        if counts.get(nodes[n].labels[key], 0) + 1 - min_match > c.max_skew:
+            ok = False
+    return ok, raw
+
+
+def _interpod_ok(pod, nodes, existing, n) -> bool:
+    """Mirrors ops/pairwise.interpod_required_ok."""
+    aff = pod.affinity
+    nd = nodes[n]
+    if aff:
+        # required affinity
+        terms = aff.required_pod_affinity
+        if terms:
+            all_ok = True
+            total_any = 0
+            self_all = True
+            for term in terms:
+                ns = _aff_namespaces(term, pod)
+                matches_in_dom = 0
+                anywhere = 0
+                for q, qn in existing:
+                    val = nodes[qn].labels.get(term.topology_key)
+                    if val is None or not _term_matches_pod(term.label_selector, ns, q):
+                        continue
+                    anywhere += 1
+                    if nd.labels.get(term.topology_key) == val:
+                        matches_in_dom += 1
+                total_any += anywhere
+                if term.topology_key not in nd.labels or matches_in_dom == 0:
+                    all_ok = False
+                if not _term_matches_pod(term.label_selector, ns, pod):
+                    self_all = False
+            if not all_ok and not (total_any == 0 and self_all):
+                return False
+        # own required anti-affinity
+        for term in aff.required_pod_anti_affinity:
+            ns = _aff_namespaces(term, pod)
+            val = nd.labels.get(term.topology_key)
+            if val is None:
+                continue
+            for q, qn in existing:
+                if nodes[qn].labels.get(term.topology_key) == val and _term_matches_pod(
+                    term.label_selector, ns, q
+                ):
+                    return False
+    # existing pods' anti-affinity vs this pod
+    for q, qn in existing:
+        if not (q.affinity and q.affinity.required_pod_anti_affinity):
+            continue
+        for term in q.affinity.required_pod_anti_affinity:
+            val = nodes[qn].labels.get(term.topology_key)
+            if val is None:
+                continue
+            if nd.labels.get(term.topology_key) != val:
+                continue
+            if _term_matches_pod(term.label_selector, _aff_namespaces(term, q), pod):
+                return False
+    return True
+
+
+def _preferred_na_raw(pod, nd) -> f32:
+    raw = f32(0.0)
+    if pod.affinity:
+        for pt in pod.affinity.preferred_node_terms:
+            if pt.preference.match_expressions and _matches_term(pt.preference, nd.labels):
+                raw = f32(raw + f32(pt.weight))
+    return raw
+
+
 def oracle_schedule(
     snap: Snapshot, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG
 ) -> List[Tuple[str, Optional[str]]]:
@@ -161,6 +277,14 @@ def oracle_schedule(
     reqs = -(-req_raw // scale)
 
     idx = list(cfg.score_resources)
+    # running "existing pods" ledger: bound + committed (pod, node_index)
+    existing: List[Tuple[t.Pod, int]] = [
+        (bp, node_index[bp.node_name]) for bp in snap.bound_pods if bp.node_name in node_index
+    ]
+    existing_by_node: Dict[int, List[t.Pod]] = {}
+    for q, qn in existing:
+        existing_by_node.setdefault(qn, []).append(q)
+
     out: List[Tuple[str, Optional[str]]] = []
     for k, src_i in enumerate(order):
         pod = snap.pending_pods[src_i]
@@ -168,12 +292,13 @@ def oracle_schedule(
             out.append((pod.name, None))
             continue
         req = reqs[k]
-        feasible, pref_counts = [], {}
+        node_ok_sel = [_node_selection_ok(pod, nd) for nd in nodes]
+        feasible, pref_counts, spread_raws = [], {}, {}
         for i, nd in enumerate(nodes):
             taints = _node_taints(nd)
             if not _tolerates_all(pod, taints):
                 continue
-            if not _node_selection_ok(pod, nd):
+            if not node_ok_sel[i]:
                 continue
             # nodeName pinning: a missing named node leaves every node infeasible
             if pod.node_name and node_index.get(pod.node_name) != i:
@@ -181,12 +306,23 @@ def oracle_schedule(
             # zero-request resources never block (reference fitsRequest skips them)
             if np.any((req > 0) & (used[i] + req > alloc[i])):
                 continue
+            if _ports_conflict(pod, existing_by_node.get(i, [])):
+                continue
+            spread_ok, spread_raw = _spread_eval(pod, nodes, node_ok_sel, existing, i)
+            if not spread_ok:
+                continue
+            if not _interpod_ok(pod, nodes, existing, i):
+                continue
             feasible.append(i)
             pref_counts[i] = _intolerable_prefer_count(pod, taints)
+            spread_raws[i] = spread_raw
         if not feasible:
             out.append((pod.name, None))
             continue
         max_pref = f32(max(pref_counts[i] for i in feasible))
+        na_raws = {i: _preferred_na_raw(pod, nodes[i]) for i in feasible}
+        max_na = f32(max(na_raws.values()))
+        max_spread = f32(max(spread_raws.values()))
         best_i, best_s = -1, -np.inf
         for i in feasible:
             requested = used[i] + req
@@ -195,13 +331,23 @@ def oracle_schedule(
                 if max_pref > 0
                 else f32(MAX_NODE_SCORE)
             )
+            na_sc = f32(na_raws[i] * f32(MAX_NODE_SCORE) / max_na) if max_na > 0 else f32(0.0)
+            spread_sc = (
+                f32(MAX_NODE_SCORE) - f32(MAX_NODE_SCORE) * spread_raws[i] / max_spread
+                if max_spread > 0
+                else f32(MAX_NODE_SCORE)
+            )
             s = (
                 f32(cfg.fit_weight) * _least_allocated(requested, alloc[i], idx)
                 + f32(cfg.balanced_weight) * _balanced(requested, alloc[i], idx)
                 + f32(cfg.taint_weight) * taint_sc
+                + f32(cfg.node_affinity_weight) * na_sc
+                + f32(cfg.spread_weight) * spread_sc
             )
             if s > best_s:
                 best_s, best_i = s, i
         used[best_i] += req
+        existing.append((pod, best_i))
+        existing_by_node.setdefault(best_i, []).append(pod)
         out.append((pod.name, nodes[best_i].name))
     return out

@@ -1,18 +1,20 @@
 """Node-axis-sharded scheduling step (shard_map over the device mesh).
 
 The reference fans Filter/Score over nodes with 16 goroutines and reduces
-through channels; here the node axis of every [*, N] array is sharded across
-chips and the reduce is XLA collectives over ICI:
+through channels (parallelize/parallelism.go); here the node axis of every
+[*, N] array is sharded across chips and the reduce is XLA collectives over
+ICI.  The step logic itself lives in ops/assign.py — schedule_scan — shared
+verbatim with the single-device path and parameterized on the mesh axis:
 
-  - per-pod NormalizeScore max       -> lax.pmax
-  - feasibility "any node fits"      -> lax.pmax over local any
-  - selectHost global argmax         -> pmax of local max score, then pmin of
-    the global node index attaining it (preserves the deterministic
-    lowest-index tie-break bit-exactly vs the single-device path)
+  - per-pod NormalizeScore max / spread minMatch  -> pmax / pmin
+  - selectHost global argmax                      -> pmax + pmin over the
+    global node index attaining the max (deterministic lowest-index tie-break,
+    bit-exact vs single-device)
+  - committed pod's domain column                 -> owner-shard psum broadcast
 
-Per-node score math stays local to the owning shard, so sharded and unsharded
-execution produce identical float32 values — no cross-shard accumulation ever
-touches a score.
+Pairwise counts state is replicated (every shard applies identical scatter
+updates); per-node score math stays local to the owning shard, so sharded and
+unsharded execution produce identical float32 values.
 """
 
 from __future__ import annotations
@@ -21,27 +23,17 @@ from functools import partial
 from typing import Tuple
 
 import jax
-import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..api.snapshot import ClusterArrays
-from ..ops import filters
-from ..ops.scores import (
-    MAX_NODE_SCORE,
-    ScoreConfig,
-    balanced_allocation,
-    least_allocated,
-    taint_prefer_counts,
-)
+from ..ops.assign import schedule_scan
+from ..ops.scores import ScoreConfig
 from .mesh import NODE_AXIS
 
-_INT_MAX = jnp.iinfo(jnp.int32).max
 
-
-def _node_sharding_specs(arr: ClusterArrays) -> ClusterArrays:
-    """PartitionSpec pytree: [N, ...] arrays sharded on the node axis, pod-axis
-    and selector-table arrays replicated."""
+def _node_sharding_specs() -> ClusterArrays:
+    """PartitionSpec pytree: [N, ...] / [*, N] arrays sharded on the node axis,
+    pod-axis and vocab-table arrays replicated."""
     return ClusterArrays(
         node_valid=P(NODE_AXIS),
         node_alloc=P(NODE_AXIS, None),
@@ -60,6 +52,20 @@ def _node_sharding_specs(arr: ClusterArrays) -> ClusterArrays:
         pod_has_sel=P(),
         sel_mask=P(None, None, None),
         sel_kind=P(None, None),
+        pod_pref_terms=P(None, None),
+        pod_pref_weights=P(None, None),
+        node_dom=P(None, NODE_AXIS),
+        term_key=P(),
+        m_pend=P(None, None),
+        term_counts0=P(None, None),
+        anti_counts0=P(None, None),
+        pod_aff_terms=P(None, None),
+        pod_anti_terms=P(None, None),
+        pod_spread_terms=P(None, None),
+        pod_spread_maxskew=P(None, None),
+        pod_spread_hard=P(None, None),
+        pod_ports=P(None, None),
+        node_ports0=P(NODE_AXIS, None),
     )
 
 
@@ -68,70 +74,15 @@ def sharded_schedule_batch(
 ) -> Tuple[jax.Array, jax.Array]:
     """Same contract as ops.assign.schedule_batch, node axis sharded over `mesh`.
 
-    Returns (assignment i32[P], node_used i32[N, R] — sharded).
+    Returns (assignment i32[P], node_used i32[N, R] — node-sharded).
     """
     n_shards = mesh.shape[NODE_AXIS]
     if arr.N % n_shards:
         raise ValueError(f"node axis {arr.N} not divisible by mesh size {n_shards}")
-    local_n = arr.N // n_shards
-
-    def step_fn(a: ClusterArrays):
-        # Everything in here sees the LOCAL node shard [N/d, ...].
-        shard = lax.axis_index(NODE_AXIS)
-        base = shard * local_n
-        my_nodes = base + jnp.arange(local_n, dtype=jnp.int32)
-
-        # nodename pinning compares against global node indices
-        pin = a.pod_nodename[:, None]
-        nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-        sf = (
-            a.node_valid[None, :]
-            & a.pod_valid[:, None]
-            & filters.taints_ok(a)
-            & filters.node_selection_ok(a)
-            & nodename_ok
-        )
-        pref = taint_prefer_counts(a)
-
-        def step(used, xs):
-            req, feas_row, pref_row, valid = xs
-            feasible = feas_row & filters.fit_ok(req, used, a.node_alloc)
-            requested = used + req[None, :]
-            max_pref = lax.pmax(jnp.max(jnp.where(feasible, pref_row, 0.0)), NODE_AXIS)
-            taint_sc = jnp.where(
-                max_pref > 0,
-                MAX_NODE_SCORE - MAX_NODE_SCORE * pref_row / max_pref,
-                MAX_NODE_SCORE,
-            )
-            total = (
-                cfg.fit_weight * least_allocated(requested, a.node_alloc, cfg.score_resources)
-                + cfg.balanced_weight
-                * balanced_allocation(requested, a.node_alloc, cfg.score_resources)
-                + cfg.taint_weight * taint_sc
-            )
-            total = jnp.where(feasible, total, -jnp.inf)
-            best = lax.pmax(jnp.max(total), NODE_AXIS)
-            schedulable = (best > -jnp.inf) & valid
-            # lowest global index attaining the max, across shards
-            local_idx = jnp.where(
-                (total == best) & feasible, my_nodes, _INT_MAX
-            ).min()
-            choice = jnp.where(
-                schedulable, lax.pmin(local_idx, NODE_AXIS).astype(jnp.int32), -1
-            )
-            placed = (my_nodes == choice)[:, None]
-            return used + placed.astype(used.dtype) * req[None, :], choice
-
-        used_final, choices = lax.scan(
-            step, a.node_used, (a.pod_req, sf, pref, a.pod_valid)
-        )
-        return choices, used_final
-
-    specs = _node_sharding_specs(arr)
     fn = jax.shard_map(
-        step_fn,
+        partial(schedule_scan, cfg=cfg, axis_name=NODE_AXIS),
         mesh=mesh,
-        in_specs=(specs,),
+        in_specs=(_node_sharding_specs(),),
         out_specs=(P(), P(NODE_AXIS, None)),
     )
     return jax.jit(fn)(arr)

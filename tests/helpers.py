@@ -73,6 +73,7 @@ def random_cluster(
     n_pods: int,
     with_taints: bool = False,
     with_selectors: bool = False,
+    with_pairwise: bool = False,
     n_zones: int = 3,
 ) -> Snapshot:
     nodes: List[t.Node] = []
@@ -133,6 +134,37 @@ def random_cluster(
                         ),
                     )
                 )
+        labels = {"app": rng.choice(["web", "db", "cache", "batch"]), "team": rng.choice(["x", "y"])}
+        spread_cs = ()
+        ports = ()
+        if with_pairwise:
+            r = rng.random()
+            if r < 0.25:
+                spread_cs = (
+                    t.TopologySpreadConstraint(
+                        max_skew=rng.choice([1, 2]),
+                        topology_key=t.LABEL_ZONE,
+                        when_unsatisfiable=rng.choice([t.DO_NOT_SCHEDULE, t.SCHEDULE_ANYWAY]),
+                        label_selector=t.LabelSelector.of(app=labels["app"]),
+                    ),
+                )
+            elif r < 0.4:
+                kind = rng.random()
+                term = t.PodAffinityTerm(
+                    topology_key=t.LABEL_ZONE,
+                    label_selector=t.LabelSelector.of(app=rng.choice(["web", "db", "cache"])),
+                )
+                pa = t.Affinity(
+                    required_pod_affinity=(term,) if kind < 0.5 else (),
+                    required_pod_anti_affinity=() if kind < 0.5 else (term,),
+                )
+                aff = t.Affinity(
+                    required_node_terms=aff.required_node_terms if aff else (),
+                    required_pod_affinity=pa.required_pod_affinity,
+                    required_pod_anti_affinity=pa.required_pod_anti_affinity,
+                )
+            elif r < 0.5:
+                ports = (("TCP", rng.choice([8080, 9090])),)
         pods.append(
             mk_pod(
                 f"pod-{i}",
@@ -142,6 +174,9 @@ def random_cluster(
                 tolerations=tols,
                 node_selector=sel,
                 affinity=aff,
+                labels=labels,
+                topology_spread=spread_cs,
+                host_ports=ports,
             )
         )
     return Snapshot(nodes=nodes, pending_pods=pods)

@@ -95,3 +95,22 @@ def test_parity_random_medium():
     assert_parity(
         random_cluster(rng, n_nodes=64, n_pods=200, with_taints=True, with_selectors=True)
     )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_parity_random_pairwise(seed):
+    rng = random.Random(2000 + seed)
+    assert_parity(
+        random_cluster(
+            rng, n_nodes=15, n_pods=37, with_taints=True, with_selectors=True, with_pairwise=True
+        )
+    )
+
+
+def test_parity_random_pairwise_medium():
+    rng = random.Random(77)
+    assert_parity(
+        random_cluster(
+            rng, n_nodes=48, n_pods=150, with_taints=True, with_selectors=True, with_pairwise=True
+        )
+    )

@@ -1,0 +1,194 @@
+"""PodTopologySpread / InterPodAffinity / NodePorts / preferred-node-affinity
+tests — table-driven (reference analog: podtopologyspread/filtering_test.go,
+interpodaffinity/filtering_test.go, nodeports/node_ports_test.go)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+from kubernetes_tpu.oracle import oracle_schedule
+from helpers import mk_node, mk_pod
+
+
+def run_both(snap):
+    arr, meta = encode_snapshot(snap)
+    c = np.asarray(schedule_batch(arr, DEFAULT_SCORE_CONFIG)[0])
+    got = [
+        (meta.pod_names[k], meta.node_names[c[k]] if c[k] >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+    want = oracle_schedule(snap)
+    assert got == want, f"kernel={got} oracle={want}"
+    return dict(got)
+
+
+def zone_nodes(n_per_zone=2, zones=("a", "b", "c"), cpu=4000):
+    out = []
+    for z in zones:
+        for i in range(n_per_zone):
+            out.append(mk_node(f"n-{z}-{i}", cpu=cpu, labels={t.LABEL_ZONE: z}))
+    return out
+
+
+def spread(max_skew=1, key=t.LABEL_ZONE, hard=True, **sel):
+    return t.TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=t.DO_NOT_SCHEDULE if hard else t.SCHEDULE_ANYWAY,
+        label_selector=t.LabelSelector.of(**sel),
+    )
+
+
+def test_spread_hard_enforces_skew():
+    # 3 zones, app pods must spread: 4 pods -> at most 2 in any zone with skew 1
+    pods = [
+        mk_pod(f"app-{i}", labels={"app": "web"}, topology_spread=(spread(app="web"),))
+        for i in range(4)
+    ]
+    got = run_both(Snapshot(nodes=zone_nodes(), pending_pods=pods))
+    zones = [v.split("-")[1] for v in got.values()]
+    counts = {z: zones.count(z) for z in "abc"}
+    assert max(counts.values()) - min(counts.values()) <= 1
+
+
+def test_spread_unsatisfiable_when_skew_exceeded():
+    # single zone already has 2 matching bound pods; maxSkew 1 vs empty zone b
+    nodes = zone_nodes(zones=("a", "b"))
+    bound = [
+        mk_pod(f"old-{i}", labels={"app": "web"}, node_name="n-a-0") for i in range(2)
+    ]
+    # zone b nodes are cordoned -> only zone a feasible, but skew would be 3 > 1
+    for nd in nodes:
+        if "-b-" in nd.name:
+            nd.unschedulable = True
+    pod = mk_pod("new", labels={"app": "web"}, topology_spread=(spread(app="web"),))
+    got = run_both(Snapshot(nodes=nodes, pending_pods=[pod], bound_pods=bound))
+    # minMatch counts zone b (eligible by node-affinity terms; cordon is a taint,
+    # not affinity) => skew 3 > 1: unschedulable
+    assert got["new"] is None
+
+
+def test_spread_node_missing_key_fails_hard_constraint():
+    nodes = [mk_node("zoned", labels={t.LABEL_ZONE: "a"}), mk_node("keyless")]
+    pod = mk_pod("p", labels={"app": "x"}, topology_spread=(spread(app="x"),))
+    got = run_both(Snapshot(nodes=nodes, pending_pods=[pod]))
+    assert got["p"] == "zoned"
+
+
+def test_required_pod_affinity_first_pod_waiver_and_colocation():
+    aff = t.Affinity(
+        required_pod_affinity=(
+            t.PodAffinityTerm(
+                topology_key=t.LABEL_ZONE, label_selector=t.LabelSelector.of(app="db")
+            ),
+        )
+    )
+    pods = [
+        mk_pod("db-0", labels={"app": "db"}, affinity=aff),  # waiver: self-match
+        mk_pod("db-1", labels={"app": "db"}, affinity=aff),  # must join db-0's zone
+    ]
+    got = run_both(Snapshot(nodes=zone_nodes(), pending_pods=pods))
+    z0 = got["db-0"].split("-")[1]
+    z1 = got["db-1"].split("-")[1]
+    assert z0 == z1
+
+
+def test_required_affinity_no_match_no_self_is_unschedulable():
+    aff = t.Affinity(
+        required_pod_affinity=(
+            t.PodAffinityTerm(
+                topology_key=t.LABEL_ZONE, label_selector=t.LabelSelector.of(app="db")
+            ),
+        )
+    )
+    got = run_both(
+        Snapshot(nodes=zone_nodes(), pending_pods=[mk_pod("web", labels={"app": "web"}, affinity=aff)])
+    )
+    assert got["web"] is None
+
+
+def test_anti_affinity_one_per_zone():
+    anti = t.Affinity(
+        required_pod_anti_affinity=(
+            t.PodAffinityTerm(
+                topology_key=t.LABEL_ZONE, label_selector=t.LabelSelector.of(app="zk")
+            ),
+        )
+    )
+    pods = [mk_pod(f"zk-{i}", labels={"app": "zk"}, affinity=anti) for i in range(4)]
+    got = run_both(Snapshot(nodes=zone_nodes(), pending_pods=pods))
+    placed_zones = [v.split("-")[1] for v in got.values() if v]
+    assert len(placed_zones) == 3 and len(set(placed_zones)) == 3  # 4th unschedulable
+    assert sum(1 for v in got.values() if v is None) == 1
+
+
+def test_existing_pod_anti_affinity_blocks_incoming():
+    anti = t.Affinity(
+        required_pod_anti_affinity=(
+            t.PodAffinityTerm(
+                topology_key=t.LABEL_ZONE, label_selector=t.LabelSelector.of(app="web")
+            ),
+        )
+    )
+    nodes = zone_nodes(zones=("a", "b"))
+    bound = [mk_pod("lonely", labels={"app": "zk"}, affinity=anti, node_name="n-a-0")]
+    got = run_both(
+        Snapshot(nodes=nodes, pending_pods=[mk_pod("web", labels={"app": "web"})], bound_pods=bound)
+    )
+    # zone a is poisoned by lonely's anti-affinity against app=web
+    assert got["web"].startswith("n-b-")
+
+
+def test_host_ports_conflict():
+    pods = [
+        mk_pod("a", host_ports=(("TCP", 8080),)),
+        mk_pod("b", host_ports=(("TCP", 8080),)),
+        mk_pod("c", host_ports=(("UDP", 8080),)),  # different proto: no conflict
+    ]
+    got = run_both(Snapshot(nodes=[mk_node("n0"), mk_node("n1")], pending_pods=pods))
+    assert got["a"] != got["b"]
+    assert got["c"] is not None
+
+
+def test_host_ports_conflict_with_bound():
+    bound = [mk_pod("old", host_ports=(("TCP", 443),), node_name="n0")]
+    got = run_both(
+        Snapshot(
+            nodes=[mk_node("n0"), mk_node("n1")],
+            pending_pods=[mk_pod("new", host_ports=(("TCP", 443),))],
+            bound_pods=bound,
+        )
+    )
+    assert got["new"] == "n1"
+
+
+def test_preferred_node_affinity_steers():
+    pref = t.Affinity(
+        preferred_node_terms=(
+            t.PreferredSchedulingTerm(
+                weight=10,
+                preference=t.NodeSelectorTerm(
+                    match_expressions=(
+                        t.NodeSelectorRequirement(key="disktype", operator=t.OP_IN, values=("ssd",)),
+                    )
+                ),
+            ),
+        )
+    )
+    nodes = [
+        mk_node("hdd-node", labels={"disktype": "hdd"}),
+        mk_node("ssd-node", labels={"disktype": "ssd"}),
+    ]
+    got = run_both(Snapshot(nodes=nodes, pending_pods=[mk_pod("p", affinity=pref)]))
+    assert got["p"] == "ssd-node"
+
+
+def test_soft_spread_prefers_less_loaded_zone():
+    nodes = zone_nodes(zones=("a", "b"))
+    bound = [mk_pod(f"w-{i}", labels={"app": "web"}, node_name="n-a-0") for i in range(3)]
+    pod = mk_pod(
+        "new", labels={"app": "web"}, topology_spread=(spread(hard=False, app="web"),)
+    )
+    got = run_both(Snapshot(nodes=nodes, pending_pods=[pod], bound_pods=bound))
+    assert got["new"].startswith("n-b-")

@@ -23,7 +23,9 @@ def mesh():
 @pytest.mark.parametrize("seed", range(4))
 def test_sharded_matches_unsharded(mesh, seed):
     rng = random.Random(7000 + seed)
-    snap = random_cluster(rng, n_nodes=24, n_pods=50, with_taints=True, with_selectors=True)
+    snap = random_cluster(
+        rng, n_nodes=24, n_pods=50, with_taints=True, with_selectors=True, with_pairwise=True
+    )
     arr, _ = encode_snapshot(snap)
     want, want_used = schedule_batch(arr, DEFAULT_SCORE_CONFIG)
     got, got_used = sharded_schedule_batch(arr, DEFAULT_SCORE_CONFIG, mesh)
