@@ -123,6 +123,9 @@ class ClusterArrays:
     pod_spread_hard: np.ndarray  # bool[P, C] DoNotSchedule?
     pod_ports: np.ndarray  # bool[P, PT] requested host ports
     node_ports0: np.ndarray  # bool[N, PT] ports taken by bound pods
+    # gang scheduling (BASELINE config 5; analog of the coscheduling PodGroup)
+    pod_group: np.ndarray  # i32[P] group index or -1
+    group_min: np.ndarray  # i32[G] minMember per group
 
     @property
     def N(self) -> int:
@@ -328,6 +331,20 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
 
     sel_mask, sel_kind = table.encode(L)
 
+    # gang groups: pods referencing a PodGroup name share an index; minMember
+    # defaults to the group's pod count when no PodGroup object is given
+    group_ids = v.Interner()
+    pod_group = np.full(P, -1, dtype=np.int32)
+    for out_i, src_i in enumerate(perm):
+        g = pending[src_i].pod_group
+        if g:
+            pod_group[out_i] = group_ids.intern(g)
+    G = max(1, len(group_ids))
+    group_min = np.ones(G, dtype=np.int32)
+    for gi, gname in enumerate(group_ids.items):
+        pg = snap.pod_groups.get(gname)
+        group_min[gi] = pg.min_member if pg else int((pod_group == gi).sum())
+
     from .pairwise import build_pairwise
 
     sorted_pending = [pending[i] for i in perm]
@@ -355,6 +372,8 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
         sel_kind=sel_kind,
         pod_pref_terms=pod_pref_terms,
         pod_pref_weights=pod_pref_weights,
+        pod_group=pod_group,
+        group_min=group_min,
         **pair,
     )
     meta = EncodingMeta(

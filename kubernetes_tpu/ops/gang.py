@@ -1,0 +1,66 @@
+"""Gang scheduling: all-or-nothing PodGroups — BASELINE config 5.
+
+Analog of the reference ecosystem's coscheduling plugin (PodGroup CRD +
+Permit-based waiting; the in-tree precedent is the Permit extension point,
+framework/runtime/waiting_pods_map.go): a group binds only if at least
+minMember of its pods can be placed in this cycle.
+
+Batch formulation: run the commit scan optimistically; if any group missed its
+quorum, revoke ONE failed group — the earliest in activeQ order — and re-run,
+because its freed capacity may let later gangs (which only failed by transient
+contention) succeed.  Revoking one at a time mirrors the reference timeline:
+a gang whose Permit times out is rejected back to the backoff queue, and the
+remaining pods reschedule against the released capacity.  Revoked groups stay
+revoked within the cycle.  <= #groups + 1 scans, all hitting the same compiled
+executable (shapes never change).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..api.snapshot import ClusterArrays
+from .assign import schedule_batch
+from .scores import ScoreConfig
+
+
+def failed_groups(choices: np.ndarray, pod_group: np.ndarray, group_min: np.ndarray,
+                  active: Optional[np.ndarray] = None) -> np.ndarray:
+    """bool[G]: groups (with >=1 active pod) that missed their quorum."""
+    G = group_min.shape[0]
+    sched = np.zeros(G, dtype=np.int64)
+    present = np.zeros(G, dtype=bool)
+    mask = pod_group >= 0
+    if active is not None:
+        mask &= active
+    np.add.at(sched, pod_group[mask], (choices[mask] >= 0).astype(np.int64))
+    present[pod_group[mask]] = True
+    return present & (sched < group_min)
+
+
+def schedule_with_gangs(
+    arr: ClusterArrays, cfg: ScoreConfig
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Schedule honoring all-or-nothing groups.
+
+    Returns (choices i32[P] with revoked gangs at -1, node_used i32[N, R])."""
+    pod_valid = np.asarray(arr.pod_valid).copy()
+    revoked = np.zeros_like(pod_valid)
+    while True:
+        import dataclasses
+
+        arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
+        choices, used = schedule_batch(arr_i, cfg)
+        choices = np.asarray(choices)
+        pod_group = np.asarray(arr.pod_group)
+        bad = failed_groups(choices, pod_group, np.asarray(arr.group_min), active=pod_valid)
+        if not bad.any():
+            return choices, np.asarray(used)
+        # revoke the failed group appearing earliest in activeQ order
+        in_bad = bad[np.maximum(pod_group, 0)] & (pod_group >= 0) & pod_valid
+        first_g = pod_group[int(np.argmax(in_bad))]
+        newly = (pod_group == first_g) & pod_valid
+        revoked |= newly
+        pod_valid = pod_valid & ~newly

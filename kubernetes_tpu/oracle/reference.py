@@ -237,10 +237,13 @@ def _preferred_na_raw(pod, nd) -> f32:
 
 
 def oracle_schedule(
-    snap: Snapshot, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG
+    snap: Snapshot,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    exclude: Optional[set] = None,
 ) -> List[Tuple[str, Optional[str]]]:
     """Sequentially schedule all pending pods; returns [(pod name, node name | None)]
-    in activeQ order."""
+    in activeQ order.  Pods whose uid is in `exclude` are skipped (used by the
+    gang iteration — mirrors pod_valid masking on the device path)."""
     resources = snap_mod._resource_axis(snap)
     nodes = snap.nodes
     n = len(nodes)
@@ -289,6 +292,9 @@ def oracle_schedule(
     for k, src_i in enumerate(order):
         pod = snap.pending_pods[src_i]
         if pod.scheduling_gates:  # held out of activeQ (SchedulingGates PreEnqueue)
+            out.append((pod.name, None))
+            continue
+        if exclude and pod.uid in exclude:
             out.append((pod.name, None))
             continue
         req = reqs[k]
@@ -351,3 +357,37 @@ def oracle_schedule(
         existing_by_node.setdefault(best_i, []).append(pod)
         out.append((pod.name, nodes[best_i].name))
     return out
+
+
+def oracle_schedule_with_gangs(
+    snap: Snapshot, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG
+) -> List[Tuple[str, Optional[str]]]:
+    """Gang-aware oracle: iterate, revoking groups that miss minMember, until
+    fixpoint — the same rule as ops/gang.schedule_with_gangs."""
+    groups: Dict[str, List[t.Pod]] = {}
+    for pod in snap.pending_pods:
+        if pod.pod_group:
+            groups.setdefault(pod.pod_group, []).append(pod)
+    min_member = {
+        g: (snap.pod_groups[g].min_member if g in snap.pod_groups else len(pods))
+        for g, pods in groups.items()
+    }
+    order = snap_mod.activeq_order(snap.pending_pods)
+    queue_rank = {snap.pending_pods[src].uid: k for k, src in enumerate(order)}
+    excluded: set = set()
+    while True:
+        res = oracle_schedule(snap, cfg, exclude=excluded)
+        placed = {name for name, node in res if node is not None}
+        failed = []
+        for g, pods in groups.items():
+            active = [p for p in pods if p.uid not in excluded]
+            if not active:
+                continue
+            if sum(1 for p in active if p.name in placed) < min_member[g]:
+                failed.append(min(queue_rank[p.uid] for p in active))
+        if not failed:
+            return res
+        # revoke only the failed group earliest in activeQ order, then retry
+        first_rank = min(failed)
+        first_uid = snap.pending_pods[order[first_rank]].pod_group
+        excluded |= {p.uid for p in groups[first_uid] if p.uid not in excluded}

@@ -66,6 +66,8 @@ def _node_sharding_specs() -> ClusterArrays:
         pod_spread_hard=P(None, None),
         pod_ports=P(None, None),
         node_ports0=P(NODE_AXIS, None),
+        pod_group=P(),
+        group_min=P(),
     )
 
 

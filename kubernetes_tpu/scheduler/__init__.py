@@ -1,0 +1,4 @@
+from .framework import Framework, Status, CycleState  # noqa: F401
+from .config import SchedulerConfiguration, Profile  # noqa: F401
+from .scheduler import Scheduler  # noqa: F401
+from .store import ClusterStore  # noqa: F401

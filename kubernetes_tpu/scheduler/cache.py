@@ -1,0 +1,94 @@
+"""Scheduler cache: watch-driven NodeInfo aggregation + assumed pods.
+
+Analog of pkg/scheduler/backend/cache/cache.go — cacheImpl: consumes store
+events, maintains per-node NodeInfo (running pods, aggregated requests), and
+an assumed-pod set so a bound-but-unconfirmed pod occupies capacity for later
+cycles (AssumePod / FinishBinding / ForgetPod).  UpdateSnapshot produces the
+api.Snapshot the encoder and the CPU path both consume.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from ..api import types as t
+from ..api.snapshot import Snapshot
+from .framework import NodeInfo
+from .store import ClusterStore, Event
+
+
+class SchedulerCache:
+    def __init__(self, store: ClusterStore):
+        self._lock = threading.Lock()
+        self.nodes: Dict[str, t.Node] = {}
+        self.pods: Dict[str, t.Pod] = {}  # all pods by uid (pending + bound)
+        self.assumed: Dict[str, str] = {}  # pod uid -> node (optimistic binds)
+        self.pod_groups: Dict[str, t.PodGroup] = {}
+        store.watch(self._on_event)
+
+    def _on_event(self, ev: Event) -> None:
+        with self._lock:
+            if ev.obj_type == "Node":
+                if ev.kind == "Deleted":
+                    self.nodes.pop(ev.obj.name, None)
+                else:
+                    self.nodes[ev.obj.name] = ev.obj
+            elif ev.obj_type == "Pod":
+                if ev.kind == "Deleted":
+                    self.pods.pop(ev.obj.uid, None)
+                    self.assumed.pop(ev.obj.uid, None)
+                else:
+                    self.pods[ev.obj.uid] = ev.obj
+                    if ev.obj.node_name and self.assumed.get(ev.obj.uid) == ev.obj.node_name:
+                        # bind confirmed by the store: assumption retired
+                        self.assumed.pop(ev.obj.uid, None)
+
+    # --- assume cache (cache.go — AssumePod / ForgetPod / FinishBinding) ---
+    def assume(self, pod_uid: str, node_name: str) -> None:
+        with self._lock:
+            self.assumed[pod_uid] = node_name
+
+    def forget(self, pod_uid: str) -> None:
+        with self._lock:
+            self.assumed.pop(pod_uid, None)
+
+    def _effective_node(self, pod: t.Pod) -> str:
+        return pod.node_name or self.assumed.get(pod.uid, "")
+
+    def update_snapshot(self) -> Snapshot:
+        """Snapshot for the batch/TPU path: bound = running + assumed pods."""
+        with self._lock:
+            nodes = list(self.nodes.values())
+            pending, bound = [], []
+            for p in self.pods.values():
+                node = self._effective_node(p)
+                if node:
+                    q = p if p.node_name else _with_node(p, node)
+                    bound.append(q)
+                else:
+                    pending.append(p)
+            return Snapshot(
+                nodes=nodes,
+                pending_pods=pending,
+                bound_pods=bound,
+                pod_groups=dict(self.pod_groups),
+            )
+
+    def node_infos(self, snap: Snapshot) -> List[NodeInfo]:
+        from ..api.snapshot import _resource_axis
+
+        resources = _resource_axis(snap)
+        infos = {nd.name: NodeInfo(node=nd) for nd in snap.nodes}
+        for q in snap.bound_pods:
+            if q.node_name in infos:
+                infos[q.node_name].add_pod(q, resources)
+        return list(infos.values())
+
+
+def _with_node(pod: t.Pod, node: str) -> t.Pod:
+    import copy
+
+    q = copy.copy(pod)
+    q.node_name = node
+    return q

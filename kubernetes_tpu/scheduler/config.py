@@ -1,0 +1,154 @@
+"""ComponentConfig: SchedulerConfiguration — the KubeSchedulerConfiguration
+analog (pkg/scheduler/apis/config/types.go — KubeSchedulerConfiguration /
+KubeSchedulerProfile) as dataclasses + YAML loading, with defaulting and
+validation in the same spirit as apis/config/{v1,validation}.
+
+The TPUScore section configures the batched offload path (the north star's
+out-of-tree plugin's pluginConfig: sidecar address, batch window, fallback
+deadline); mode="cpu" disables offload entirely — the mandated fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from ..ops.scores import ScoreConfig
+
+
+@dataclass(frozen=True)
+class PluginSpec:
+    name: str
+    weight: float = 1.0
+    enabled: bool = True
+
+
+@dataclass(frozen=True)
+class TPUScoreArgs:
+    """pluginConfig for the TPU offload (north star: sidecar address, batch
+    window, deadline -> CPU fallback)."""
+
+    sidecar_address: str = "local"  # "local" = in-process kernels, no gRPC hop
+    batch_window_ms: float = 5.0
+    deadline_ms: float = 1000.0
+    mesh_devices: int = 1
+
+
+@dataclass(frozen=True)
+class Profile:
+    scheduler_name: str = "default-scheduler"
+    plugins: Tuple[PluginSpec, ...] = ()
+    # percentageOfNodesToScore: 0 = adaptive default in the reference; this
+    # framework always scores all nodes (deterministic mode) and keeps the
+    # field for config parity + validation
+    percentage_of_nodes_to_score: int = 100
+    tpu_score: Optional[TPUScoreArgs] = None
+
+
+@dataclass(frozen=True)
+class SchedulerConfiguration:
+    profiles: Tuple[Profile, ...] = (Profile(),)
+    parallelism: int = 16  # reference default goroutine fan-out; informational here
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    feature_gates: Tuple[Tuple[str, bool], ...] = ()
+    mode: str = "tpu"  # "tpu" (batched kernels) | "cpu" (per-pod plugin path)
+
+    def profile(self, name: str = "default-scheduler") -> Profile:
+        for p in self.profiles:
+            if p.scheduler_name == name:
+                return p
+        return self.profiles[0]
+
+    def score_config(self) -> ScoreConfig:
+        """Lower profile plugin weights onto the kernel ScoreConfig."""
+        w = {s.name: s.weight for s in self.profile().plugins if s.enabled}
+        disabled = {s.name for s in self.profile().plugins if not s.enabled}
+        cfg = ScoreConfig(
+            fit_weight=w.get("NodeResourcesFit", 1.0),
+            balanced_weight=w.get("NodeResourcesBalancedAllocation", 1.0),
+            taint_weight=w.get("TaintToleration", 3.0),
+            node_affinity_weight=w.get("NodeAffinity", 2.0),
+            spread_weight=w.get("PodTopologySpread", 2.0),
+            interpod_weight=w.get("InterPodAffinity", 2.0),
+        )
+        for name in disabled:
+            key = {
+                "NodeResourcesFit": "fit_weight",
+                "NodeResourcesBalancedAllocation": "balanced_weight",
+                "TaintToleration": "taint_weight",
+                "NodeAffinity": "node_affinity_weight",
+                "PodTopologySpread": "spread_weight",
+                "InterPodAffinity": "interpod_weight",
+            }.get(name)
+            if key:
+                cfg = replace(cfg, **{key: 0.0})
+        return cfg
+
+
+def validate(cfg: SchedulerConfiguration) -> List[str]:
+    """apis/config/validation — ValidateKubeSchedulerConfiguration."""
+    errs = []
+    if not cfg.profiles:
+        errs.append("at least one profile required")
+    names = [p.scheduler_name for p in cfg.profiles]
+    if len(set(names)) != len(names):
+        errs.append("duplicate profile schedulerName")
+    for p in cfg.profiles:
+        if not 0 <= p.percentage_of_nodes_to_score <= 100:
+            errs.append(f"{p.scheduler_name}: percentageOfNodesToScore out of [0,100]")
+        for s in p.plugins:
+            if s.weight < 0:
+                errs.append(f"{p.scheduler_name}/{s.name}: negative weight")
+    if cfg.mode not in ("tpu", "cpu"):
+        errs.append(f"unknown mode {cfg.mode!r}")
+    if cfg.parallelism <= 0:
+        errs.append("parallelism must be positive")
+    return errs
+
+
+def from_yaml(text: str) -> SchedulerConfiguration:
+    """Load a KubeSchedulerConfiguration-shaped YAML document."""
+    import yaml
+
+    doc = yaml.safe_load(text) or {}
+    profiles = []
+    for prof in doc.get("profiles", [{}]):
+        plugins = []
+        for item in prof.get("plugins", []):
+            plugins.append(
+                PluginSpec(
+                    name=item["name"],
+                    weight=float(item.get("weight", 1.0)),
+                    enabled=bool(item.get("enabled", True)),
+                )
+            )
+        tpu = None
+        if "tpuScore" in prof:
+            a = prof["tpuScore"] or {}
+            tpu = TPUScoreArgs(
+                sidecar_address=a.get("sidecarAddress", "local"),
+                batch_window_ms=float(a.get("batchWindowMs", 5.0)),
+                deadline_ms=float(a.get("deadlineMs", 1000.0)),
+                mesh_devices=int(a.get("meshDevices", 1)),
+            )
+        profiles.append(
+            Profile(
+                scheduler_name=prof.get("schedulerName", "default-scheduler"),
+                plugins=tuple(plugins),
+                percentage_of_nodes_to_score=int(prof.get("percentageOfNodesToScore", 100)),
+                tpu_score=tpu,
+            )
+        )
+    cfg = SchedulerConfiguration(
+        profiles=tuple(profiles) or (Profile(),),
+        parallelism=int(doc.get("parallelism", 16)),
+        pod_initial_backoff_seconds=float(doc.get("podInitialBackoffSeconds", 1.0)),
+        pod_max_backoff_seconds=float(doc.get("podMaxBackoffSeconds", 10.0)),
+        feature_gates=tuple((k, bool(v)) for k, v in (doc.get("featureGates") or {}).items()),
+        mode=doc.get("mode", "tpu"),
+    )
+    errs = validate(cfg)
+    if errs:
+        raise ValueError("; ".join(errs))
+    return cfg

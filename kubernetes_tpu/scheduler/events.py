@@ -1,0 +1,33 @@
+"""Event recorder (client-go tools/record — EventRecorder): the scheduler's
+Scheduled / FailedScheduling / Preempted event stream, kept in-process as the
+scheduling-decision log for parity debugging (SURVEY.md §5 observability)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class SchedulingEvent:
+    reason: str  # Scheduled | FailedScheduling | Preempted
+    pod: str
+    node: str = ""
+    message: str = ""
+
+
+class EventRecorder:
+    def __init__(self, capacity: int = 100_000):
+        self._lock = threading.Lock()
+        self.events: List[SchedulingEvent] = []
+        self.capacity = capacity
+
+    def record(self, reason: str, pod: str, node: str = "", message: str = "") -> None:
+        with self._lock:
+            if len(self.events) < self.capacity:
+                self.events.append(SchedulingEvent(reason, pod, node, message))
+
+    def by_reason(self, reason: str) -> List[SchedulingEvent]:
+        with self._lock:
+            return [e for e in self.events if e.reason == reason]

@@ -1,0 +1,205 @@
+"""Scheduler Framework — the plugin extension-point API and its runtime.
+
+Analog of the reference's pkg/scheduler/framework/interface.go (one interface
+per extension point: PreEnqueue, QueueingHint, PreFilter, Filter, PostFilter,
+PreScore, Score+NormalizeScore, Reserve, Permit, PreBind, Bind, PostBind) and
+framework/runtime/framework.go (frameworkImpl — RunFilterPlugins /
+RunScorePlugins fan-out).  This host-side path IS the CPU fallback the north
+star mandates: plugins here reproduce the kernels' semantics one pod at a time;
+the TPU path replaces the per-pod Filter/Score fan-out with the batched kernel
+while everything else (queue, binding cycle, preemption) is shared.
+
+MaxNodeScore = 100 (interface.go — MaxNodeScore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import types as t
+from ..api.snapshot import Snapshot
+
+MAX_NODE_SCORE = 100
+
+# Status codes (framework/interface.go — Code)
+SUCCESS = "Success"
+UNSCHEDULABLE = "Unschedulable"
+UNSCHEDULABLE_AND_UNRESOLVABLE = "UnschedulableAndUnresolvable"
+ERROR = "Error"
+
+
+@dataclass
+class Status:
+    code: str = SUCCESS
+    reasons: Tuple[str, ...] = ()
+
+    @property
+    def ok(self) -> bool:
+        return self.code == SUCCESS
+
+    @staticmethod
+    def unschedulable(*reasons: str) -> "Status":
+        return Status(UNSCHEDULABLE, reasons)
+
+
+@dataclass
+class CycleState:
+    """Per-scheduling-cycle scratch shared between a plugin's extension points
+    (framework/cycle_state.go — CycleState)."""
+
+    data: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class NodeInfo:
+    """Aggregated scheduling view of one node (framework/types.go — NodeInfo)."""
+
+    node: t.Node
+    pods: List[t.Pod] = field(default_factory=list)
+    requested: Dict[str, int] = field(default_factory=dict)
+
+    def add_pod(self, pod: t.Pod, resources: Sequence[str]) -> None:
+        from ..api.snapshot import pod_effective_requests
+
+        self.pods.append(pod)
+        for r, q in zip(resources, pod_effective_requests(pod, resources)):
+            self.requested[r] = self.requested.get(r, 0) + q
+
+    def remove_pod(self, pod: t.Pod, resources: Sequence[str]) -> None:
+        from ..api.snapshot import pod_effective_requests
+
+        self.pods = [q for q in self.pods if q.uid != pod.uid]
+        for r, q in zip(resources, pod_effective_requests(pod, resources)):
+            self.requested[r] = self.requested.get(r, 0) - q
+
+
+class Plugin:
+    """Base: a plugin implements any subset of the extension-point methods.
+    Method absence == not registered at that point (the runtime checks with
+    hasattr, mirroring the reference's per-point plugin lists)."""
+
+    name: str = "Plugin"
+
+    # PreEnqueue(pod) -> Status
+    # EventsToRegister() -> list of event kinds that can make pods schedulable
+    # PreFilter(state, snapshot, pod) -> Status
+    # Filter(state, snapshot, pod, node_info) -> Status
+    # PostFilter(state, snapshot, pod, filtered_statuses) -> (nominated_node, Status)
+    # PreScore(state, snapshot, pod, nodes) -> Status
+    # Score(state, snapshot, pod, node_info) -> float
+    # NormalizeScore(state, snapshot, pod, scores) -> None (in place)
+    # Reserve/Unreserve(state, snapshot, pod, node_name)
+    # Permit(state, snapshot, pod, node_name) -> Status
+    # PreBind/Bind/PostBind(state, snapshot, pod, node_name) -> Status
+
+
+@dataclass
+class PluginWeight:
+    plugin: Plugin
+    weight: float = 1.0
+
+
+class Framework:
+    """frameworkImpl: holds the enabled plugins per extension point and runs
+    the fan-outs.  The Filter/Score fan-out here is the sequential CPU path;
+    see ops/assign.py for the batched TPU equivalent."""
+
+    def __init__(self, plugins: Sequence[PluginWeight]):
+        self.plugins = list(plugins)
+
+    def _at(self, point: str) -> List[PluginWeight]:
+        return [pw for pw in self.plugins if hasattr(pw.plugin, point)]
+
+    def run_pre_enqueue(self, pod: t.Pod) -> Status:
+        for pw in self._at("PreEnqueue"):
+            st = pw.plugin.PreEnqueue(pod)
+            if not st.ok:
+                return st
+        return Status()
+
+    def run_pre_filter(self, state: CycleState, snap: Snapshot, pod: t.Pod) -> Status:
+        for pw in self._at("PreFilter"):
+            st = pw.plugin.PreFilter(state, snap, pod)
+            if not st.ok:
+                return st
+        return Status()
+
+    def run_filters(
+        self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo
+    ) -> Status:
+        for pw in self._at("Filter"):
+            st = pw.plugin.Filter(state, snap, pod, info)
+            if not st.ok:
+                return st
+        return Status()
+
+    def run_post_filters(
+        self, state: CycleState, snap: Snapshot, pod: t.Pod, statuses: Dict[str, Status]
+    ) -> Tuple[Optional[str], Status]:
+        for pw in self._at("PostFilter"):
+            nominated, st = pw.plugin.PostFilter(state, snap, pod, statuses)
+            if st.ok:
+                return nominated, st
+        return None, Status.unschedulable("no postfilter plugin succeeded")
+
+    def run_pre_score(
+        self, state: CycleState, snap: Snapshot, pod: t.Pod, nodes: List[NodeInfo]
+    ) -> None:
+        for pw in self._at("PreScore"):
+            pw.plugin.PreScore(state, snap, pod, nodes)
+
+    def run_scores(
+        self, state: CycleState, snap: Snapshot, pod: t.Pod, infos: List[NodeInfo]
+    ) -> np.ndarray:
+        """Weighted sum over Score plugins with per-plugin NormalizeScore —
+        RunScorePlugins (framework.go ~:900)."""
+        total = np.zeros(len(infos), dtype=np.float32)
+        for pw in self._at("Score"):
+            raw = np.array(
+                [np.float32(pw.plugin.Score(state, snap, pod, ni)) for ni in infos],
+                dtype=np.float32,
+            )
+            if hasattr(pw.plugin, "NormalizeScore"):
+                pw.plugin.NormalizeScore(state, snap, pod, raw)
+            total += np.float32(pw.weight) * raw
+        return total
+
+    def run_reserve(self, state, snap, pod, node_name) -> Status:
+        for pw in self._at("Reserve"):
+            st = pw.plugin.Reserve(state, snap, pod, node_name)
+            if not st.ok:
+                self.run_unreserve(state, snap, pod, node_name)
+                return st
+        return Status()
+
+    def run_unreserve(self, state, snap, pod, node_name) -> None:
+        for pw in reversed(self._at("Unreserve")):
+            pw.plugin.Unreserve(state, snap, pod, node_name)
+
+    def run_permit(self, state, snap, pod, node_name) -> Status:
+        for pw in self._at("Permit"):
+            st = pw.plugin.Permit(state, snap, pod, node_name)
+            if not st.ok:
+                return st
+        return Status()
+
+    def run_pre_bind(self, state, snap, pod, node_name) -> Status:
+        for pw in self._at("PreBind"):
+            st = pw.plugin.PreBind(state, snap, pod, node_name)
+            if not st.ok:
+                return st
+        return Status()
+
+    def run_bind(self, state, snap, pod, node_name) -> Status:
+        for pw in self._at("Bind"):
+            st = pw.plugin.Bind(state, snap, pod, node_name)
+            if st.code != "Skip":
+                return st
+        return Status(ERROR, ("no bind plugin",))
+
+    def run_post_bind(self, state, snap, pod, node_name) -> None:
+        for pw in self._at("PostBind"):
+            pw.plugin.PostBind(state, snap, pod, node_name)

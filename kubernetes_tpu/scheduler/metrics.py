@@ -1,0 +1,76 @@
+"""Scheduler metrics (pkg/scheduler/metrics/metrics.go).
+
+Prometheus when prometheus_client is importable, else a minimal in-process
+registry with the same API — either way the same metric names as the
+reference: scheduling_attempt_duration_seconds, pending_pods,
+queue_incoming_pods_total, preemption_victims, framework_extension_point_duration_seconds.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram, REGISTRY
+
+    _PROM = True
+except Exception:  # pragma: no cover
+    _PROM = False
+
+
+class _Hist:
+    def __init__(self):
+        self.samples: List[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.samples.append(v)
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self.samples:
+                return 0.0
+            s = sorted(self.samples)
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+
+class Metrics:
+    """One instance per scheduler; label-free simple registry + optional
+    Prometheus mirroring."""
+
+    def __init__(self, prometheus: bool = False):
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = defaultdict(float)
+        self.hists: Dict[str, _Hist] = defaultdict(_Hist)
+        self._prom = {}
+        if prometheus and _PROM:  # pragma: no cover - optional path
+            self._prom = {
+                "scheduling_attempt_duration_seconds": Histogram(
+                    "scheduling_attempt_duration_seconds", "per-attempt latency"
+                ),
+                "pending_pods": Gauge("pending_pods", "pods waiting to schedule"),
+                "queue_incoming_pods_total": Counter(
+                    "queue_incoming_pods_total", "pods entering the queue"
+                ),
+            }
+
+    def inc(self, name: str, v: float = 1.0) -> None:
+        self.counters[name] += v
+        p = self._prom.get(name)
+        if p is not None:
+            p.inc(v)
+
+    def set(self, name: str, v: float) -> None:
+        self.gauges[name] = v
+        p = self._prom.get(name)
+        if p is not None:
+            p.set(v)
+
+    def observe(self, name: str, v: float) -> None:
+        self.hists[name].observe(v)
+        p = self._prom.get(name)
+        if p is not None:
+            p.observe(v)

@@ -1,0 +1,1 @@
+from .cpu import default_plugins, default_registry  # noqa: F401

@@ -1,0 +1,309 @@
+"""In-tree CPU plugins — the sequential fallback path.
+
+Each class mirrors one reference plugin (pkg/scheduler/framework/plugins/...)
+and delegates its semantics to the same helpers the parity oracle uses
+(oracle/reference.py), so the CPU path, the TPU kernels, and the oracle share
+one behavior definition.
+
+Default enablement/weights: registry at the bottom (reference:
+pkg/scheduler/framework/plugins/registry.go — NewInTreeRegistry +
+apis/config/v1/default_plugins.go — getDefaultPlugins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...api import types as t
+from ...api.snapshot import Snapshot, pod_effective_requests
+from ...oracle import reference as oref
+from ..framework import (
+    MAX_NODE_SCORE,
+    CycleState,
+    NodeInfo,
+    Plugin,
+    PluginWeight,
+    Status,
+)
+
+f32 = np.float32
+
+
+def _existing(snap: Snapshot, infos: Dict[str, NodeInfo]) -> List[Tuple[t.Pod, int]]:
+    """(pod, node_index) ledger of running pods, in node order."""
+    idx = {name: i for i, name in enumerate(infos)}
+    out = []
+    for name, ni in infos.items():
+        for q in ni.pods:
+            out.append((q, idx[name]))
+    return out
+
+
+class SchedulingGates(Plugin):
+    """schedulinggates/scheduling_gates.go — PreEnqueue."""
+
+    name = "SchedulingGates"
+
+    def PreEnqueue(self, pod: t.Pod) -> Status:
+        if pod.scheduling_gates:
+            return Status.unschedulable(f"waiting for gates {pod.scheduling_gates}")
+        return Status()
+
+
+class TaintToleration(Plugin):
+    """tainttoleration/taint_toleration.go — Filter + Score(reverse-normalized)."""
+
+    name = "TaintToleration"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        if not oref._tolerates_all(pod, oref._node_taints(info.node)):
+            return Status.unschedulable("node taint not tolerated")
+        return Status()
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        return float(oref._intolerable_prefer_count(pod, oref._node_taints(info.node)))
+
+    def NormalizeScore(self, state, snap, pod, scores: np.ndarray) -> None:
+        mx = f32(scores.max()) if len(scores) else f32(0)
+        if mx > 0:
+            scores[:] = f32(MAX_NODE_SCORE) - f32(MAX_NODE_SCORE) * scores / mx
+        else:
+            scores[:] = f32(MAX_NODE_SCORE)
+
+
+class NodeAffinity(Plugin):
+    """nodeaffinity/node_affinity.go — Filter (required + nodeSelector) and
+    Score (preferred terms, DefaultNormalizeScore)."""
+
+    name = "NodeAffinity"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        if not oref._node_selection_ok(pod, info.node):
+            return Status.unschedulable("node(s) didn't match Pod's node affinity/selector")
+        return Status()
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        return float(oref._preferred_na_raw(pod, info.node))
+
+    def NormalizeScore(self, state, snap, pod, scores: np.ndarray) -> None:
+        mx = f32(scores.max()) if len(scores) else f32(0)
+        scores[:] = scores * f32(MAX_NODE_SCORE) / mx if mx > 0 else f32(0.0)
+
+
+class NodeName(Plugin):
+    """nodename/node_name.go — Filter."""
+
+    name = "NodeName"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        if pod.node_name and pod.node_name != info.node.name:
+            return Status.unschedulable("node didn't match the requested node name")
+        return Status()
+
+
+class NodePorts(Plugin):
+    """nodeports/node_ports.go — Filter."""
+
+    name = "NodePorts"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        if oref._ports_conflict(pod, info.pods):
+            return Status.unschedulable("node(s) didn't have free ports")
+        return Status()
+
+
+class NodeResourcesFit(Plugin):
+    """noderesources/fit.go — Filter (fitsRequest over the shared ScaledState,
+    the analog of computePodResourceRequest's PreFilter output) + Score
+    (LeastAllocated strategy)."""
+
+    name = "NodeResourcesFit"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        req = sc.req_of(pod)
+        if np.any((req > 0) & (sc.used[i] + req > sc.alloc[i])):
+            return Status.unschedulable("Insufficient resources")
+        return Status()
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        return float(
+            oref._least_allocated(sc.used[i] + sc.req_of(pod), sc.alloc[i], sc.score_idx)
+        )
+
+
+class NodeResourcesBalancedAllocation(Plugin):
+    """noderesources/balanced_allocation.go — Score."""
+
+    name = "NodeResourcesBalancedAllocation"
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        return float(
+            oref._balanced(sc.used[i] + sc.req_of(pod), sc.alloc[i], sc.score_idx)
+        )
+
+
+class PodTopologySpread(Plugin):
+    """podtopologyspread/{filtering,scoring}.go — Filter skew check + Score."""
+
+    name = "PodTopologySpread"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        ok, raw = oref._spread_eval(pod, sc.nodes, sc.node_ok_sel(pod), sc.existing, i)
+        state.data.setdefault("spread_raw", {})[(pod.uid, i)] = raw
+        if not ok:
+            return Status.unschedulable("node(s) didn't satisfy topology spread")
+        return Status()
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        raw = state.data.get("spread_raw", {}).get((pod.uid, i))
+        if raw is None:
+            _, raw = oref._spread_eval(pod, sc.nodes, sc.node_ok_sel(pod), sc.existing, i)
+        return float(raw)
+
+    def NormalizeScore(self, state, snap, pod, scores: np.ndarray) -> None:
+        mx = f32(scores.max()) if len(scores) else f32(0)
+        if mx > 0:
+            scores[:] = f32(MAX_NODE_SCORE) - f32(MAX_NODE_SCORE) * scores / mx
+        else:
+            scores[:] = f32(MAX_NODE_SCORE)
+
+
+class InterPodAffinity(Plugin):
+    """interpodaffinity/filtering.go — Filter (required affinity with first-pod
+    waiver, own + symmetric anti-affinity)."""
+
+    name = "InterPodAffinity"
+
+    def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        if not oref._interpod_ok(pod, sc.nodes, sc.existing, i):
+            return Status.unschedulable("node(s) didn't satisfy pod affinity/anti-affinity")
+        return Status()
+
+
+class DefaultBinder(Plugin):
+    """defaultbinder/default_binder.go — Bind: POST pods/{name}/binding."""
+
+    name = "DefaultBinder"
+
+    def __init__(self, store):
+        self.store = store
+
+    def Bind(self, state, snap, pod, node_name) -> Status:
+        self.store.bind(pod.uid, node_name)
+        return Status()
+
+
+class DefaultPreemption(Plugin):
+    """defaultpreemption/default_preemption.go + framework/preemption/
+    preemption.go — Evaluator: PostFilter that picks victims on one node,
+    evicts them, and nominates the node.
+
+    Victim selection: remove all lower-priority pods; if the pod then passes
+    every Filter, reprieve victims highest-priority-first (re-add while still
+    feasible).  Node choice: lexicographic (lowest max victim priority,
+    smallest priority sum, fewest victims, lowest node index) — the PDB term
+    of the reference's ordering is vacuous here (no PDB objects yet).
+    """
+
+    name = "DefaultPreemption"
+
+    def __init__(self, filter_fn, store):
+        self.filter_fn = filter_fn  # (state, snap, pod, NodeInfo) -> Status
+        self.store = store
+
+    def PostFilter(self, state, snap, pod, statuses) -> Tuple[Optional[str], Status]:
+        sc = state.data["scaled"]
+        best = None  # (max_prio, sum_prio, count, node_idx, victims, node_name)
+        for i, info in enumerate(sc.infos):
+            lower = [q for q in info.pods if q.priority < pod.priority]
+            if not lower:
+                continue
+            sim = NodeInfo(node=info.node, pods=[q for q in info.pods if q.priority >= pod.priority])
+            sc.push_sim(i, sim)
+            try:
+                if not self.filter_fn(state, snap, pod, sim).ok:
+                    continue
+                # reprieve: re-add highest-priority victims while still feasible
+                victims = []
+                for q in sorted(lower, key=lambda q: (-q.priority, q.uid)):
+                    sim.add_pod(q, sc.resources)
+                    sc.refresh_sim(i, sim)
+                    if self.filter_fn(state, snap, pod, sim).ok:
+                        continue  # reprieved
+                    sim.remove_pod(q, sc.resources)
+                    sc.refresh_sim(i, sim)
+                    victims.append(q)
+            finally:
+                sc.pop_sim(i)
+            if not victims:
+                continue
+            key = (
+                max(q.priority for q in victims),
+                sum(q.priority for q in victims),
+                len(victims),
+                i,
+            )
+            if best is None or key < best[0]:
+                best = (key, victims, info.node.name)
+        if best is None:
+            return None, Status.unschedulable("preemption: no candidates")
+        _, victims, node_name = best
+        for q in victims:
+            self.store.delete_pod(q.uid)
+        return node_name, Status()
+
+
+def default_plugins(store, filter_fn=None) -> List[PluginWeight]:
+    """The default profile — plugin set and weights mirroring
+    default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
+    TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2)."""
+    pls = [
+        PluginWeight(SchedulingGates()),
+        PluginWeight(NodeName()),
+        PluginWeight(NodePorts()),
+        PluginWeight(TaintToleration(), 3.0),
+        PluginWeight(NodeAffinity(), 2.0),
+        PluginWeight(NodeResourcesFit(), 1.0),
+        PluginWeight(NodeResourcesBalancedAllocation(), 1.0),
+        PluginWeight(PodTopologySpread(), 2.0),
+        PluginWeight(InterPodAffinity(), 2.0),
+    ]
+    if filter_fn is not None:
+        pls.append(PluginWeight(DefaultPreemption(filter_fn, store)))
+    pls.append(PluginWeight(DefaultBinder(store)))
+    return pls
+
+
+def default_registry() -> Dict[str, type]:
+    """Name -> class registry (registry.go — NewInTreeRegistry)."""
+    return {
+        c.name: c
+        for c in [
+            SchedulingGates,
+            NodeName,
+            NodePorts,
+            TaintToleration,
+            NodeAffinity,
+            NodeResourcesFit,
+            NodeResourcesBalancedAllocation,
+            PodTopologySpread,
+            InterPodAffinity,
+            DefaultPreemption,
+            DefaultBinder,
+        ]
+    }

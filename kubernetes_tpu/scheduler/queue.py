@@ -1,0 +1,138 @@
+"""Scheduling queue: activeQ + backoffQ + unschedulablePods.
+
+Analog of pkg/scheduler/backend/queue/scheduling_queue.go — PriorityQueue:
+
+  - activeQ: heap ordered by the queue-sort plugin's Less (priority desc, then
+    arrival — PrioritySort)
+  - backoffQ: pods recently failed, re-activated after an exponential backoff
+    (1s initial, doubling, 10s cap — DefaultPodInitialBackoffDuration /
+    DefaultPodMaxBackoffDuration)
+  - unschedulablePods: pods that failed with no backoff pending; moved back to
+    activeQ/backoffQ when a cluster event that might make them schedulable
+    arrives (MoveAllToActiveOrBackoffQueue; QueueingHint machinery reduced to
+    event-kind matching)
+
+A injectable clock makes backoff deterministic in tests (the reference uses
+k8s.io/utils/clock/testing the same way — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..api import types as t
+
+INITIAL_BACKOFF_S = 1.0
+MAX_BACKOFF_S = 10.0
+
+# Cluster event kinds (framework/types.go — ClusterEvent); plugins that fail a
+# pod register which events may resolve the failure (EventsToRegister).
+EV_NODE_ADD = "Node/Add"
+EV_NODE_UPDATE = "Node/Update"
+EV_POD_DELETE = "Pod/Delete"
+EV_POD_ADD = "Pod/Add"
+EV_ALL = "*"
+
+
+class Clock:
+    def now(self) -> float:
+        return _time.monotonic()
+
+
+class FakeClock(Clock):
+    def __init__(self, start: float = 0.0):
+        self.t = start
+
+    def now(self) -> float:
+        return self.t
+
+    def step(self, dt: float) -> None:
+        self.t += dt
+
+
+@dataclass(order=True)
+class _Item:
+    sort_key: Tuple
+    pod: t.Pod = field(compare=False)
+
+
+class PriorityQueue:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._seq = itertools.count()
+        self._active: List[_Item] = []  # heap
+        self._active_uids: Set[str] = set()
+        self._backoff: List[Tuple[float, int, t.Pod]] = []  # (ready_at, seq, pod)
+        self._unschedulable: Dict[str, Tuple[t.Pod, Set[str]]] = {}  # uid -> (pod, events)
+        self._attempts: Dict[str, int] = {}
+        self._arrival: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        self._flush_backoff()
+        return len(self._active)
+
+    @property
+    def pending_total(self) -> int:
+        return len(self._active) + len(self._backoff) + len(self._unschedulable)
+
+    def _key(self, pod: t.Pod) -> Tuple:
+        # PrioritySort.Less: higher priority first, then FIFO by first arrival
+        arr = self._arrival.setdefault(pod.uid, next(self._seq))
+        return (-pod.priority, arr)
+
+    def add(self, pod: t.Pod) -> None:
+        if pod.uid in self._active_uids:
+            return
+        heapq.heappush(self._active, _Item(self._key(pod), pod))
+        self._active_uids.add(pod.uid)
+
+    def _flush_backoff(self) -> None:
+        now = self.clock.now()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, pod = heapq.heappop(self._backoff)
+            self.add(pod)
+
+    def pop(self) -> Optional[t.Pod]:
+        """Next pod in activeQ order, or None if activeQ is empty
+        (scheduling_queue.go — Pop; non-blocking variant)."""
+        self._flush_backoff()
+        while self._active:
+            item = heapq.heappop(self._active)
+            if item.pod.uid in self._active_uids:
+                self._active_uids.discard(item.pod.uid)
+                self._attempts[item.pod.uid] = self._attempts.get(item.pod.uid, 0) + 1
+                return item.pod
+        return None
+
+    def backoff_duration(self, pod_uid: str) -> float:
+        n = max(0, self._attempts.get(pod_uid, 1) - 1)
+        return min(MAX_BACKOFF_S, INITIAL_BACKOFF_S * (2**n))
+
+    def add_unschedulable(self, pod: t.Pod, events: Optional[Set[str]] = None,
+                          backoff: bool = True) -> None:
+        """AddUnschedulableIfNotPresent: failed pods wait for a wake event; with
+        backoff=True they first sit out their backoff window."""
+        if backoff:
+            ready = self.clock.now() + self.backoff_duration(pod.uid)
+            heapq.heappush(self._backoff, (ready, next(self._seq), pod))
+        else:
+            self._unschedulable[pod.uid] = (pod, events or {EV_ALL})
+
+    def move_all_to_active_or_backoff(self, event: str) -> int:
+        """MoveAllToActiveOrBackoffQueue on a cluster event; returns #moved."""
+        moved = []
+        for uid, (pod, events) in list(self._unschedulable.items()):
+            if EV_ALL in events or event in events:
+                moved.append(uid)
+                del self._unschedulable[uid]
+                ready = self.clock.now() + self.backoff_duration(uid)
+                heapq.heappush(self._backoff, (ready, next(self._seq), pod))
+        return len(moved)
+
+    def delete(self, pod_uid: str) -> None:
+        self._active_uids.discard(pod_uid)
+        self._unschedulable.pop(pod_uid, None)

@@ -1,0 +1,221 @@
+"""The Scheduler: pop -> schedule -> bind, in two execution modes.
+
+Analog of pkg/scheduler/scheduler.go (type Scheduler, Run) and
+schedule_one.go (ScheduleOne: schedulingCycle + bindingCycle):
+
+  mode="cpu"  one pod per cycle through the plugin framework — the reference's
+              exact shape (findNodesThatFitPod -> prioritizeNodes -> selectHost
+              -> assume -> bind) and the mandated fallback path.
+  mode="tpu"  drain the activeQ into a batch, lower the cache snapshot to
+              device arrays, run the jitted filter/score/commit scan (+ gang
+              fixpoint), bind all placements.  Decision-identical to cpu mode
+              (both tie-break to the lowest node index; see parity tests).
+
+Failure path (both modes): PostFilter/preemption may evict victims and
+nominate a node; the pod then re-queues with backoff and the freed capacity is
+visible to its retry — the reference's nominatedNodeName flow reduced to
+requeue-after-evict (the nomination is not reserved against competing pods;
+deviation noted, matching the reference's own best-effort nomination).
+
+Watch wiring: new pending pods pass PreEnqueue into the activeQ (gated pods
+wait in unschedulablePods for a Pod/Update); Node add/update and Pod delete
+events MoveAllToActiveOrBackoffQueue — the QueueingHint machinery reduced to
+event kinds.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import types as t
+from ..api.snapshot import Snapshot, encode_snapshot
+from ..ops.scores import infer_score_config
+from .cache import SchedulerCache
+from .config import SchedulerConfiguration
+from .events import EventRecorder
+from .features import FeatureGates
+from .framework import CycleState, Framework, NodeInfo, Status
+from .metrics import Metrics
+from .plugins.cpu import default_plugins
+from .queue import (
+    EV_NODE_ADD,
+    EV_NODE_UPDATE,
+    EV_POD_DELETE,
+    Clock,
+    PriorityQueue,
+)
+from .state import ScaledState
+from .store import ClusterStore, Event
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: ClusterStore,
+        config: SchedulerConfiguration = SchedulerConfiguration(),
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.config = config
+        self.features = FeatureGates(config.feature_gates)
+        self.cache = SchedulerCache(store)
+        self.queue = PriorityQueue(clock)
+        self.metrics = Metrics()
+        self.events = EventRecorder()
+        self.framework = Framework(
+            default_plugins(store, filter_fn=self._filter_one)
+        )
+        store.watch(self._on_event)
+
+    # --- watch plumbing ---
+    def _on_event(self, ev: Event) -> None:
+        if ev.obj_type == "Pod":
+            pod = ev.obj
+            if ev.kind == "Deleted":
+                self.queue.delete(pod.uid)
+                self.queue.move_all_to_active_or_backoff(EV_POD_DELETE)
+            elif not pod.node_name:
+                st = self.framework.run_pre_enqueue(pod)
+                if st.ok:
+                    self.queue.add(pod)
+                    self.metrics.inc("queue_incoming_pods_total")
+                else:
+                    self.queue.add_unschedulable(pod, {"Pod/Update"}, backoff=False)
+        elif ev.obj_type == "Node":
+            self.queue.move_all_to_active_or_backoff(
+                EV_NODE_ADD if ev.kind == "Added" else EV_NODE_UPDATE
+            )
+
+    def _filter_one(self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo) -> Status:
+        return self.framework.run_filters(state, snap, pod, info)
+
+    # --- the CPU scheduling cycle (ScheduleOne) ---
+    def schedule_one(self, pod: t.Pod) -> Optional[str]:
+        t0 = time.perf_counter()
+        snap = self.cache.update_snapshot()
+        infos = self.cache.node_infos(snap)
+        state = CycleState()
+        state.data["scaled"] = ScaledState(snap, infos)
+        st = self.framework.run_pre_filter(state, snap, pod)
+        feasible: List[int] = []
+        statuses: Dict[str, Status] = {}
+        if st.ok:
+            for i, info in enumerate(infos):
+                fst = self.framework.run_filters(state, snap, pod, info)
+                if fst.ok:
+                    feasible.append(i)
+                else:
+                    statuses[info.node.name] = fst
+        if not feasible:
+            nominated, pst = self.framework.run_post_filters(state, snap, pod, statuses)
+            self.events.record(
+                "FailedScheduling", pod.name,
+                message=f"0/{len(infos)} nodes available" + (f"; preemption nominated {nominated}" if pst.ok else ""),
+            )
+            if pst.ok and nominated:
+                self.events.record("Preempted", pod.name, node=nominated)
+            self.queue.add_unschedulable(pod, backoff=True)
+            self.metrics.inc("scheduling_attempts_unschedulable")
+            return None
+        chosen = [infos[i] for i in feasible]
+        self.framework.run_pre_score(state, snap, pod, chosen)
+        scores = self.framework.run_scores(state, snap, pod, chosen)
+        best = feasible[int(np.argmax(scores))]  # first max == lowest node index
+        node_name = infos[best].node.name
+        # assume + binding cycle (synchronous here; the reference overlaps it)
+        self.cache.assume(pod.uid, node_name)
+        st = self.framework.run_permit(state, snap, pod, node_name)
+        if st.ok:
+            st = self.framework.run_pre_bind(state, snap, pod, node_name)
+        if st.ok:
+            st = self.framework.run_bind(state, snap, pod, node_name)
+        if not st.ok:
+            self.cache.forget(pod.uid)
+            self.queue.add_unschedulable(pod, backoff=True)
+            return None
+        self.framework.run_post_bind(state, snap, pod, node_name)
+        self.events.record("Scheduled", pod.name, node=node_name)
+        self.metrics.observe("scheduling_attempt_duration_seconds", time.perf_counter() - t0)
+        self.metrics.inc("scheduling_attempts_scheduled")
+        return node_name
+
+    # --- the TPU batch cycle ---
+    def schedule_batch(self) -> Dict[str, Optional[str]]:
+        """Drain the activeQ and schedule the whole batch in one device program."""
+        from ..ops.gang import schedule_with_gangs
+
+        t0 = time.perf_counter()
+        batch: List[t.Pod] = []
+        while True:
+            pod = self.queue.pop()
+            if pod is None:
+                break
+            batch.append(pod)
+        if not batch:
+            return {}
+        snap = self.cache.update_snapshot()
+        bound_uids = {p.uid for p in snap.bound_pods}
+        snap = Snapshot(
+            nodes=snap.nodes,
+            pending_pods=[p for p in batch if p.uid not in bound_uids],
+            bound_pods=snap.bound_pods,
+            pod_groups=snap.pod_groups,
+        )
+        arr, meta = encode_snapshot(snap)
+        cfg = infer_score_config(arr, self.config.score_config())
+        if self.features.enabled("GangScheduling"):
+            choices, _ = schedule_with_gangs(arr, cfg)
+        else:
+            from ..ops import schedule_batch as kernel
+
+            choices = np.asarray(kernel(arr, cfg)[0])
+        by_name = {p.name: p for p in snap.pending_pods}
+        result: Dict[str, Optional[str]] = {}
+        failed: List[t.Pod] = []
+        for k in range(meta.n_pods):
+            pod = by_name[meta.pod_names[k]]
+            c = int(choices[k])
+            if c >= 0:
+                node_name = meta.node_names[c]
+                self.cache.assume(pod.uid, node_name)
+                self.store.bind(pod.uid, node_name)
+                self.events.record("Scheduled", pod.name, node=node_name)
+                result[pod.name] = node_name
+            else:
+                failed.append(pod)
+                result[pod.name] = None
+        # failure path: preemption through the CPU PostFilter, then requeue
+        for pod in failed:
+            snap2 = self.cache.update_snapshot()
+            infos = self.cache.node_infos(snap2)
+            state = CycleState()
+            state.data["scaled"] = ScaledState(snap2, infos)
+            nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
+            self.events.record("FailedScheduling", pod.name)
+            if pst.ok and nominated:
+                self.events.record("Preempted", pod.name, node=nominated)
+            self.queue.add_unschedulable(pod, backoff=True)
+        dt = time.perf_counter() - t0
+        self.metrics.observe("batch_scheduling_duration_seconds", dt)
+        self.metrics.inc("scheduling_attempts_scheduled", len(batch) - len(failed))
+        self.metrics.inc("scheduling_attempts_unschedulable", len(failed))
+        self.metrics.set("pending_pods", self.queue.pending_total)
+        return result
+
+    # --- driver ---
+    def run_until_idle(self, max_cycles: int = 100) -> None:
+        """Schedule until the activeQ drains (backoff/unschedulable pods wait
+        for their clock/events — the test harness advances a FakeClock)."""
+        for _ in range(max_cycles):
+            if self.config.mode == "tpu":
+                if not self.schedule_batch():
+                    return
+            else:
+                pod = self.queue.pop()
+                if pod is None:
+                    return
+                self.schedule_one(pod)

@@ -1,0 +1,107 @@
+"""ClusterStore — the in-process cluster-state hub.
+
+Plays the role the apiserver+etcd pair plays for the reference's scheduler in
+scheduler_perf (SURVEY.md §3.5: real apiserver, in-process, nodes as bare API
+objects): a strongly-ordered object store with monotonically increasing
+resourceVersion and level-triggered watch fan-out (one event stream -> N
+subscribers, the cacher pattern from apiserver/pkg/storage/cacher).
+
+Single-writer by design (one lock around mutations) — the framework's answer
+to the reference's optimistic-concurrency CAS: there is exactly one scheduler
+mutating bindings in-process, so CAS degenerates to serialized apply.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional
+
+from ..api import types as t
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str  # Added | Modified | Deleted
+    obj_type: str  # Node | Pod
+    obj: object
+    resource_version: int
+
+
+class ClusterStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rv = 0
+        self.nodes: Dict[str, t.Node] = {}
+        self.pods: Dict[str, t.Pod] = {}  # by uid
+        self._watchers: List[Callable[[Event], None]] = []
+
+    # --- watch ---
+    def watch(self, fn: Callable[[Event], None], replay: bool = True) -> None:
+        """Subscribe; replay=True first delivers synthetic Added events for
+        current state (the LIST half of LIST+WATCH)."""
+        with self._lock:
+            if replay:
+                for nd in self.nodes.values():
+                    fn(Event("Added", "Node", nd, self._rv))
+                for p in self.pods.values():
+                    fn(Event("Added", "Pod", p, self._rv))
+            self._watchers.append(fn)
+
+    def _emit(self, ev: Event) -> None:
+        for fn in self._watchers:
+            fn(ev)
+
+    def _bump(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # --- nodes ---
+    def add_node(self, node: t.Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+            self._emit(Event("Added", "Node", node, self._bump()))
+
+    def update_node(self, node: t.Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+            self._emit(Event("Modified", "Node", node, self._bump()))
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            nd = self.nodes.pop(name, None)
+            if nd is not None:
+                self._emit(Event("Deleted", "Node", nd, self._bump()))
+
+    # --- pods ---
+    def add_pod(self, pod: t.Pod) -> None:
+        with self._lock:
+            self.pods[pod.uid] = pod
+            self._emit(Event("Added", "Pod", pod, self._bump()))
+
+    def update_pod(self, pod: t.Pod) -> None:
+        with self._lock:
+            self.pods[pod.uid] = pod
+            self._emit(Event("Modified", "Pod", pod, self._bump()))
+
+    def delete_pod(self, uid: str) -> None:
+        with self._lock:
+            p = self.pods.pop(uid, None)
+            if p is not None:
+                self._emit(Event("Deleted", "Pod", p, self._bump()))
+
+    def bind(self, pod_uid: str, node_name: str) -> None:
+        """The pods/{name}/binding subresource (defaultbinder's POST)."""
+        with self._lock:
+            p = self.pods[pod_uid]
+            bound = replace_pod_nodename(p, node_name)
+            self.pods[pod_uid] = bound
+            self._emit(Event("Modified", "Pod", bound, self._bump()))
+
+
+def replace_pod_nodename(pod: t.Pod, node_name: str) -> t.Pod:
+    import copy
+
+    q = copy.copy(pod)
+    q.node_name = node_name
+    return q

@@ -1,0 +1,68 @@
+"""Gang scheduling (all-or-nothing PodGroups) — BASELINE config 5 semantics."""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG
+from kubernetes_tpu.ops.gang import schedule_with_gangs
+from kubernetes_tpu.oracle.reference import oracle_schedule_with_gangs
+from helpers import mk_node, mk_pod
+
+
+def run_both(snap):
+    arr, meta = encode_snapshot(snap)
+    choices, _ = schedule_with_gangs(arr, DEFAULT_SCORE_CONFIG)
+    got = [
+        (meta.pod_names[k], meta.node_names[choices[k]] if choices[k] >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+    want = oracle_schedule_with_gangs(snap)
+    assert got == want, f"kernel={got} oracle={want}"
+    return dict(got)
+
+
+def test_gang_fits_entirely():
+    pods = [mk_pod(f"g-{i}", cpu=500, pod_group="job") for i in range(4)]
+    got = run_both(Snapshot(nodes=[mk_node("n0", cpu=4000)], pending_pods=pods))
+    assert all(v == "n0" for v in got.values())
+
+
+def test_gang_all_or_nothing_revoked():
+    # group of 3 x 600m on a 1000m node: only 1 fits -> whole gang revoked
+    pods = [mk_pod(f"g-{i}", cpu=600, pod_group="job") for i in range(3)]
+    got = run_both(Snapshot(nodes=[mk_node("n0", cpu=1000)], pending_pods=pods))
+    assert all(v is None for v in got.values())
+
+
+def test_gang_revocation_frees_capacity_for_next_gang():
+    # big gang (higher priority) cannot fully fit; once revoked, small gang fits
+    big = [mk_pod(f"big-{i}", cpu=800, priority=10, pod_group="big") for i in range(3)]
+    small = [mk_pod(f"small-{i}", cpu=500, pod_group="small") for i in range(2)]
+    snap = Snapshot(nodes=[mk_node("n0", cpu=1000), mk_node("n1", cpu=1000)],
+                    pending_pods=big + small)
+    got = run_both(snap)
+    assert all(got[f"big-{i}"] is None for i in range(3))
+    assert all(got[f"small-{i}"] is not None for i in range(2))
+
+
+def test_min_member_quorum():
+    # minMember 2 of 3: gang sticks even though the third pod can't fit
+    pods = [mk_pod(f"g-{i}", cpu=600, pod_group="job") for i in range(3)]
+    snap = Snapshot(
+        nodes=[mk_node("n0", cpu=1000), mk_node("n1", cpu=700)],
+        pending_pods=pods,
+        pod_groups={"job": t.PodGroup(name="job", min_member=2)},
+    )
+    got = run_both(snap)
+    assert sum(1 for v in got.values() if v is not None) == 2
+
+
+def test_gangs_mixed_with_plain_pods():
+    rng = random.Random(5)
+    pods = [mk_pod(f"plain-{i}", cpu=rng.choice([100, 300])) for i in range(10)]
+    pods += [mk_pod(f"gang-{i}", cpu=900, pod_group="heavy") for i in range(4)]
+    snap = Snapshot(nodes=[mk_node(f"n{i}", cpu=2000) for i in range(3)], pending_pods=pods)
+    run_both(snap)

@@ -1,0 +1,172 @@
+"""Host scheduler runtime: store/watch, queue, CPU-vs-TPU decision parity,
+preemption, backoff — the integration tier (SURVEY.md §4: in-process cluster
+state + real scheduling pipeline, no kubelet)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.config import Profile, PluginSpec, from_yaml, validate
+from kubernetes_tpu.scheduler.queue import FakeClock
+from helpers import mk_node, mk_pod
+
+
+def mk_cluster(mode="tpu", nodes=(), clock=None, config=None):
+    store = ClusterStore()
+    for nd in nodes:
+        store.add_node(nd)
+    sched = Scheduler(store, config or SchedulerConfiguration(mode=mode), clock=clock)
+    return store, sched
+
+
+def bound_map(store):
+    return {p.name: (p.node_name or None) for p in store.pods.values()}
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_end_to_end_bind(mode):
+    store, sched = mk_cluster(mode, nodes=[mk_node("n0"), mk_node("n1")])
+    store.add_pod(mk_pod("p0"))
+    store.add_pod(mk_pod("p1"))
+    sched.run_until_idle()
+    got = bound_map(store)
+    assert got["p0"] and got["p1"]
+    assert len(sched.events.by_reason("Scheduled")) == 2
+
+
+def test_cpu_tpu_decision_parity():
+    import random
+    from helpers import random_cluster
+
+    rng = random.Random(321)
+    snap = random_cluster(rng, n_nodes=12, n_pods=30, with_taints=True,
+                          with_selectors=True, with_pairwise=True)
+    results = {}
+    for mode in ("cpu", "tpu"):
+        store, sched = mk_cluster(mode, nodes=[*map(_copy_node, snap.nodes)])
+        for p in snap.pending_pods:
+            store.add_pod(p)
+        sched.run_until_idle()
+        results[mode] = bound_map(store)
+    assert results["cpu"] == results["tpu"]
+
+
+def _copy_node(nd):
+    import copy
+
+    return copy.deepcopy(nd)
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_preemption_evicts_lower_priority(mode):
+    clock = FakeClock()
+    store, sched = mk_cluster(mode, nodes=[mk_node("only", cpu=1000)], clock=clock)
+    store.add_pod(mk_pod("victim", cpu=800, priority=0))
+    sched.run_until_idle()
+    assert bound_map(store)["victim"] == "only"
+    # high-priority pod arrives; must preempt
+    store.add_pod(mk_pod("vip", cpu=800, priority=100))
+    sched.run_until_idle()
+    assert len(sched.events.by_reason("Preempted")) == 1
+    assert "victim" not in bound_map(store)  # evicted (deleted)
+    # retry after backoff
+    clock.step(2.0)
+    sched.run_until_idle()
+    assert bound_map(store)["vip"] == "only"
+
+
+def test_gated_pod_waits_for_update():
+    store, sched = mk_cluster("tpu", nodes=[mk_node("n0")])
+    store.add_pod(mk_pod("gated", scheduling_gates=("wait",)))
+    sched.run_until_idle()
+    assert bound_map(store)["gated"] is None
+    # gate removed -> Pod/Update wakes it
+    ungated = mk_pod("gated")
+    store.update_pod(ungated)
+    sched.run_until_idle()
+    assert bound_map(store)["gated"] == "n0"
+
+
+def test_unschedulable_wakes_on_node_add():
+    clock = FakeClock()
+    store, sched = mk_cluster("tpu", nodes=[mk_node("small", cpu=100)], clock=clock)
+    store.add_pod(mk_pod("big", cpu=4000))
+    sched.run_until_idle()
+    assert bound_map(store)["big"] is None
+    store.add_node(mk_node("large", cpu=8000))
+    clock.step(3.0)  # clear backoff
+    sched.run_until_idle()
+    assert bound_map(store)["big"] == "large"
+
+
+def test_backoff_is_exponential_and_capped():
+    clock = FakeClock()
+    store, sched = mk_cluster("tpu", clock=clock)  # no nodes: always fails
+    store.add_pod(mk_pod("p", cpu=100))
+    sched.run_until_idle()
+    q = sched.queue
+    assert q.backoff_duration("default/p") == 1.0
+    for _ in range(6):
+        clock.step(60)
+        sched.run_until_idle()
+    assert q.backoff_duration("default/p") == 10.0  # capped
+
+
+def test_config_yaml_roundtrip_and_validation():
+    cfg = from_yaml(
+        """
+profiles:
+  - schedulerName: default-scheduler
+    percentageOfNodesToScore: 100
+    plugins:
+      - {name: TaintToleration, weight: 3}
+      - {name: PodTopologySpread, weight: 2}
+      - {name: InterPodAffinity, enabled: false}
+    tpuScore: {sidecarAddress: local, deadlineMs: 500}
+mode: tpu
+parallelism: 16
+"""
+    )
+    assert cfg.profile().tpu_score.deadline_ms == 500
+    sc = cfg.score_config()
+    assert sc.interpod_weight == 0.0 and sc.taint_weight == 3.0
+    assert validate(cfg) == []
+    with pytest.raises(ValueError):
+        from_yaml("mode: gpu")
+
+
+def test_disabled_plugin_changes_decisions():
+    # weight-0 taint score: PreferNoSchedule stops steering
+    taint = (t.Taint(key="soft", effect=t.PREFER_NO_SCHEDULE),)
+    nodes = [mk_node("soft-tainted", taints=taint), mk_node("clean")]
+    prof = Profile(plugins=(PluginSpec(name="TaintToleration", enabled=False),))
+    for mode in ("tpu",):
+        store, sched = mk_cluster(
+            mode, nodes=[_copy_node(n) for n in nodes],
+            config=SchedulerConfiguration(mode=mode, profiles=(prof,)),
+        )
+        store.add_pod(mk_pod("p"))
+        sched.run_until_idle()
+        # without the taint score, both nodes tie -> lowest index (soft-tainted)
+        assert bound_map(store)["p"] == "soft-tainted"
+
+
+def test_feature_gate_validation():
+    from kubernetes_tpu.scheduler.features import FeatureGates
+
+    with pytest.raises(ValueError):
+        FeatureGates((("NoSuchGate", True),))
+    with pytest.raises(ValueError):
+        FeatureGates((("DefaultPreemption", False),))  # GA gates are locked
+    fg = FeatureGates((("GangScheduling", False),))
+    assert not fg.enabled("GangScheduling")
+
+
+def test_metrics_and_events_populate():
+    store, sched = mk_cluster("tpu", nodes=[mk_node("n0")])
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    assert sched.metrics.counters["scheduling_attempts_scheduled"] == 1
+    assert sched.metrics.hists["batch_scheduling_duration_seconds"].samples
+    assert sched.events.by_reason("Scheduled")[0].node == "n0"
