@@ -54,6 +54,15 @@ def _round_up_pow2(n: int, minimum: int = 8) -> int:
     return max(minimum, 1 << max(0, math.ceil(math.log2(max(1, n)))))
 
 
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Pad-and-bucket size: powers of two up to 2048, then multiples of 2048.
+    Bounds waste at scale (a 20k node axis pads to 20480, not 32768) while
+    keeping the number of distinct compiled shapes small."""
+    if n <= 2048:
+        return _round_up_pow2(n, minimum)
+    return ((n + 2047) // 2048) * 2048
+
+
 @dataclass
 class Snapshot:
     """Host-side cluster state handed to the encoder.
@@ -189,15 +198,34 @@ def activeq_order(pods: Sequence[t.Pod]) -> np.ndarray:
 def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArrays, EncodingMeta]:
     nodes, pending = snap.nodes, snap.pending_pods
     n, p = len(nodes), len(pending)
-    N = _round_up_pow2(n) if bucket else max(1, n)
-    P = _round_up_pow2(p) if bucket else max(1, p)
+    N = _bucket(n) if bucket else max(1, n)
+    P = _bucket(p) if bucket else max(1, p)
 
     resources = _resource_axis(snap)
     R = len(resources)
 
     # --- label vocab over node labels (selectors lower against this) ---
+    # Only label KEYS referenced by some pod's nodeSelector / node-affinity
+    # expression enter the literal vocab: unreferenced labels (notably the
+    # per-node kubernetes.io/hostname) cannot influence any selector, and
+    # would otherwise blow the L axis up to O(N).  Topology keys are interned
+    # separately as domains (api/pairwise.py).
+    referenced_keys = set()
+    for pod in pending:
+        for k, _ in pod.node_selector:
+            referenced_keys.add(k)
+        if pod.affinity:
+            for term in pod.affinity.required_node_terms:
+                for e in term.match_expressions:
+                    referenced_keys.add(e.key)
+            for pt in pod.affinity.preferred_node_terms:
+                for e in pt.preference.match_expressions:
+                    referenced_keys.add(e.key)
     lab = v.LabelVocab()
-    node_lits: List[List[int]] = [lab.add_labels(nd.labels) for nd in nodes]
+    node_lits: List[List[int]] = [
+        lab.add_labels({k: val for k, val in nd.labels.items() if k in referenced_keys})
+        for nd in nodes
+    ]
 
     # --- taint vocab ---
     # spec.unschedulable is modeled as the synthetic taint the reference's node

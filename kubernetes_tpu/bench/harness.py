@@ -1,0 +1,213 @@
+"""scheduler_perf-style benchmark harness — L6.
+
+Analog of test/integration/scheduler_perf/scheduler_perf.go: YAML-described
+workloads (createNodes / createPods / measure ops) run against the REAL
+in-process runtime — ClusterStore -> watch -> queue -> batched TPU cycle ->
+bind — measuring SchedulingThroughput (pods/s) and attempt-latency quantiles
+from the scheduler's own metrics, emitting perfdata JSON.
+
+Workload YAML:
+
+  name: Config3
+  ops:
+    - {op: createCluster, generator: spread_affinity, nodes: 5000, pods: 10000}
+    - {op: measure}
+
+Generators live in bench/workloads.py (the performance-config.yaml analog).
+
+Usage: python -m kubernetes_tpu.bench.harness [--config FILE] [--out FILE]
+       (no --config: runs the five BASELINE.md configs at reduced scale
+        unless --full is given).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..api.snapshot import Snapshot
+from ..scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from . import workloads
+
+
+@dataclass
+class PerfData:
+    name: str
+    n_nodes: int
+    n_pods: int
+    scheduled: int
+    unschedulable: int
+    wall_s: float
+    pods_per_sec: float
+    p50_ms: float
+    p90_ms: float
+    p99_ms: float
+
+    def to_json(self) -> Dict:
+        return self.__dict__
+
+
+def run_snapshot_workload(
+    name: str, snap: Snapshot, mode: str = "tpu", warmup: bool = True
+) -> PerfData:
+    """Measure one workload.  warmup=True first runs an identical throwaway
+    scheduler so the timed run hits the XLA compile cache — scheduler_perf
+    likewise measures a long-lived scheduler, not binary start-up."""
+    if warmup and mode == "tpu":
+        run_snapshot_workload(name, snap, mode, warmup=False)
+    store = ClusterStore()
+    for nd in snap.nodes:
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode=mode))
+    for g, pg in snap.pod_groups.items():
+        sched.cache.pod_groups[g] = pg
+    for p in snap.pending_pods:
+        store.add_pod(p)
+    for p in snap.bound_pods:
+        store.add_pod(p)
+
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    wall = time.perf_counter() - t0
+
+    scheduled = len(sched.events.by_reason("Scheduled"))
+    failed = len(sched.events.by_reason("FailedScheduling"))
+    hist = sched.metrics.hists.get("batch_scheduling_duration_seconds") or sched.metrics.hists.get(
+        "scheduling_attempt_duration_seconds"
+    )
+    q = (lambda p: hist.quantile(p) * 1e3) if hist else (lambda p: 0.0)
+    return PerfData(
+        name=name,
+        n_nodes=len(snap.nodes),
+        n_pods=len(snap.pending_pods),
+        scheduled=scheduled,
+        unschedulable=failed,
+        wall_s=round(wall, 3),
+        pods_per_sec=round(scheduled / wall, 1) if wall > 0 else 0.0,
+        p50_ms=round(q(0.50), 2),
+        p90_ms=round(q(0.90), 2),
+        p99_ms=round(q(0.99), 2),
+    )
+
+
+GENERATORS = {
+    "basic": lambda **kw: workloads.basic(kw["nodes"], kw["pods"], kw.get("seed", 0)),
+    "spread_affinity": lambda **kw: workloads.spread_affinity(
+        kw["nodes"], kw["pods"], kw.get("seed", 0), kw.get("zones", 3)
+    ),
+    "heterogeneous": lambda **kw: workloads.heterogeneous(
+        kw["nodes"], kw["pods"], kw.get("seed", 0)
+    ),
+    "gang": lambda **kw: workloads.gang(
+        kw["groups"], kw["group_size"], kw["nodes"], kw.get("seed", 0)
+    ),
+}
+
+
+def run_yaml(text: str, mode: str = "tpu") -> List[PerfData]:
+    import yaml
+
+    results = []
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        snap = None
+        for op in doc.get("ops", []):
+            kind = op.get("op")
+            if kind == "createCluster":
+                gen = GENERATORS[op["generator"]]
+                snap = gen(**{k: v for k, v in op.items() if k not in ("op", "generator")})
+            elif kind == "measure":
+                assert snap is not None, "createCluster must precede measure"
+                results.append(
+                    run_snapshot_workload(
+                        doc.get("name", "unnamed"), snap, mode, warmup=op.get("warmup", True)
+                    )
+                )
+    return results
+
+
+# The five BASELINE.md configs (full scale), and a reduced smoke variant.
+BASELINE_CONFIGS = """
+name: Config1_SchedulingBasic
+ops:
+  - {op: createCluster, generator: basic, nodes: 100, pods: 100}
+  - {op: measure}
+---
+name: Config2_NodeResourcesFit
+ops:
+  - {op: createCluster, generator: basic, nodes: 1000, pods: 5000}
+  - {op: measure}
+---
+name: Config3_SpreadAffinity
+ops:
+  - {op: createCluster, generator: spread_affinity, nodes: 5000, pods: 10000, zones: 3}
+  - {op: measure}
+---
+name: Config4_Heterogeneous
+ops:
+  - {op: createCluster, generator: heterogeneous, nodes: 20000, pods: 20000}
+  - {op: measure}
+---
+name: Config5_Gang
+ops:
+  - {op: createCluster, generator: gang, groups: 1000, group_size: 64, nodes: 2000}
+  - {op: measure}
+"""
+
+SMOKE_CONFIGS = """
+name: Config1_SchedulingBasic
+ops:
+  - {op: createCluster, generator: basic, nodes: 100, pods: 100}
+  - {op: measure}
+---
+name: Config2_NodeResourcesFit
+ops:
+  - {op: createCluster, generator: basic, nodes: 250, pods: 1000}
+  - {op: measure}
+---
+name: Config3_SpreadAffinity
+ops:
+  - {op: createCluster, generator: spread_affinity, nodes: 300, pods: 600, zones: 3}
+  - {op: measure}
+---
+name: Config4_Heterogeneous
+ops:
+  - {op: createCluster, generator: heterogeneous, nodes: 500, pods: 500}
+  - {op: measure}
+---
+name: Config5_Gang
+ops:
+  - {op: createCluster, generator: gang, groups: 20, group_size: 16, nodes: 100}
+  - {op: measure}
+"""
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", help="workload YAML file")
+    ap.add_argument("--out", help="perfdata JSON output path")
+    ap.add_argument("--mode", default="tpu", choices=["tpu", "cpu"])
+    ap.add_argument("--full", action="store_true", help="run BASELINE configs at full scale")
+    args = ap.parse_args(argv)
+    if args.config:
+        text = open(args.config).read()
+    else:
+        text = BASELINE_CONFIGS if args.full else SMOKE_CONFIGS
+    results = run_yaml(text, args.mode)
+    data = [r.to_json() for r in results]
+    for r in data:
+        print(json.dumps(r), file=sys.stderr)
+    out = json.dumps({"perfdata": data}, indent=2)
+    if args.out:
+        open(args.out, "w").write(out)
+    else:
+        print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,89 @@ def basic(n_nodes: int, n_pods: int, seed: int = 0) -> Snapshot:
     return Snapshot(nodes=nodes, pending_pods=pods)
 
 
+def spread_affinity(n_nodes: int, n_pods: int, seed: int = 0, zones: int = 3) -> Snapshot:
+    """Config 3: PodTopologySpread + InterPodAffinity across zones."""
+    rng = random.Random(seed)
+    nodes = [
+        t.Node(
+            name=f"node-{i}",
+            allocatable={t.CPU: 32 * MILLI, t.MEMORY: 128 * GI, t.PODS: 110},
+            labels={t.LABEL_ZONE: f"zone-{i % zones}"},
+        )
+        for i in range(n_nodes)
+    ]
+    apps = [f"svc-{i}" for i in range(max(4, n_pods // 250))]
+    pods = []
+    for i in range(n_pods):
+        app = rng.choice(apps)
+        kind = rng.random()
+        spread = ()
+        aff = None
+        if kind < 0.5:
+            spread = (
+                t.TopologySpreadConstraint(
+                    max_skew=rng.choice([1, 2]),
+                    topology_key=t.LABEL_ZONE,
+                    when_unsatisfiable=t.DO_NOT_SCHEDULE if kind < 0.25 else t.SCHEDULE_ANYWAY,
+                    label_selector=t.LabelSelector.of(app=app),
+                ),
+            )
+        elif kind < 0.7:
+            if kind < 0.6:
+                term = t.PodAffinityTerm(
+                    topology_key=t.LABEL_ZONE, label_selector=t.LabelSelector.of(app=app)
+                )
+                aff = t.Affinity(required_pod_affinity=(term,))
+            else:
+                # anti-affinity at hostname scope: "one replica per node"
+                term = t.PodAffinityTerm(
+                    topology_key=t.LABEL_HOSTNAME, label_selector=t.LabelSelector.of(app=app)
+                )
+                aff = t.Affinity(required_pod_anti_affinity=(term,))
+        pods.append(
+            t.Pod(
+                name=f"pod-{i}",
+                labels={"app": app},
+                requests={
+                    t.CPU: rng.choice([100, 250, 500]),
+                    t.MEMORY: rng.choice([128, 256, 512]) * 1024**2,
+                },
+                topology_spread=spread,
+                affinity=aff,
+            )
+        )
+    return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+def gang(n_groups: int, group_size: int, n_nodes: int, seed: int = 0) -> Snapshot:
+    """Config 5: gang-scheduled ML jobs (PodGroups, all-or-nothing)."""
+    rng = random.Random(seed)
+    nodes = [
+        t.Node(
+            name=f"node-{i}",
+            allocatable={t.CPU: 64 * MILLI, t.MEMORY: 256 * GI, t.PODS: 256},
+            labels={t.LABEL_ZONE: f"zone-{i % 4}"},
+        )
+        for i in range(n_nodes)
+    ]
+    pods, groups = [], {}
+    for g in range(n_groups):
+        name = f"job-{g}"
+        groups[name] = t.PodGroup(name=name, min_member=group_size)
+        cpu = rng.choice([500, 1000, 2000])
+        for m in range(group_size):
+            pods.append(
+                t.Pod(
+                    name=f"{name}-w{m}",
+                    labels={"job": name},
+                    requests={t.CPU: cpu, t.MEMORY: 2 * GI},
+                    pod_group=name,
+                    priority=rng.choice([0, 10]),
+                )
+            )
+    return Snapshot(nodes=nodes, pending_pods=pods, pod_groups=groups)
+
+
 def heterogeneous(n_nodes: int, n_pods: int, seed: int = 0) -> Snapshot:
     """Config 4: heterogeneous capacities + extended resources + taints/tolerations."""
     rng = random.Random(seed)

@@ -98,10 +98,29 @@ def schedule_scan(
         & nodesel
         & nodename_ok
     )
-    pref_taints = taint_prefer_counts(arr)  # [P, Nl]
-    na_raw = _preferred_node_affinity_raw(arr, tm)  # [P, Nl]
     n_alloc = arr.node_alloc
     node_dom, term_key = arr.node_dom, arr.term_key
+
+    # Scan inputs assembled conditionally: disabled stages (cfg.enable_*) never
+    # materialize their [P, N] matrices — a constant-per-pod score term cannot
+    # change argmax, so pruning is decision-preserving.
+    xs = {"req": arr.pod_req, "sf": sf, "valid": arr.pod_valid}
+    if cfg.enable_taint_score:
+        xs["pref"] = taint_prefer_counts(arr)  # [P, Nl]
+    if cfg.enable_node_pref:
+        xs["na"] = _preferred_node_affinity_raw(arr, tm)  # [P, Nl]
+    if cfg.enable_pairwise:
+        xs.update(
+            nodesel=nodesel,
+            aff=arr.pod_aff_terms,
+            anti=arr.pod_anti_terms,
+            spread_t=arr.pod_spread_terms,
+            spread_skew=arr.pod_spread_maxskew,
+            spread_hard=arr.pod_spread_hard,
+            m=arr.m_pend.T,
+        )
+    if cfg.enable_ports:
+        xs["ports"] = arr.pod_ports
 
     def norm_reverse(counts, feasible):
         mx = _rmax(jnp.where(feasible, counts, 0.0), axis_name)
@@ -109,35 +128,38 @@ def schedule_scan(
 
     def step(state, xs):
         used, counts, anti_counts, ports_used = state
-        (req, feas_row, nodesel_row, pref_row, na_row, valid,
-         aff_terms, anti_terms, spread_terms, spread_skew, spread_hard,
-         m_col, ports_row) = xs
+        req, feas_row, valid = xs["req"], xs["sf"], xs["valid"]
 
         feasible = feas_row & filters.fit_ok(req, used, n_alloc)
         if cfg.enable_ports:
-            feasible &= pairwise.ports_ok(ports_used, ports_row)
+            feasible &= pairwise.ports_ok(ports_used, xs["ports"])
         if cfg.enable_pairwise:
             spread_ok, spread_raw = pairwise.spread_step(
-                counts, node_dom, term_key, spread_terms, spread_skew, spread_hard,
-                nodesel_row & arr.node_valid, axis_name,
+                counts, node_dom, term_key, xs["spread_t"], xs["spread_skew"],
+                xs["spread_hard"], xs["nodesel"] & arr.node_valid, axis_name,
             )
             feasible &= spread_ok & pairwise.interpod_required_ok(
-                counts, anti_counts, node_dom, term_key, aff_terms, anti_terms, m_col
+                counts, anti_counts, node_dom, term_key, xs["aff"], xs["anti"], xs["m"]
             )
-        else:
-            spread_raw = jnp.zeros_like(feas_row, dtype=jnp.float32)
         requested = used + req[None, :]
-        # NodeAffinity preferred: DefaultNormalizeScore (not reversed)
-        na_max = _rmax(jnp.where(feasible, na_row, 0.0), axis_name)
-        na_sc = jnp.where(na_max > 0, na_row * MAX_NODE_SCORE / na_max, 0.0)
-        total = (
-            cfg.fit_weight * least_allocated(requested, n_alloc, cfg.score_resources)
-            + cfg.balanced_weight
-            * balanced_allocation(requested, n_alloc, cfg.score_resources)
-            + cfg.taint_weight * norm_reverse(pref_row, feasible)
-            + cfg.node_affinity_weight * na_sc
-            + cfg.spread_weight * norm_reverse(spread_raw, feasible)
+        # score accumulation order mirrors the oracle exactly (float32 parity):
+        # fit, balanced, taint, nodeAffinity, spread
+        total = cfg.fit_weight * least_allocated(
+            requested, n_alloc, cfg.score_resources
+        ) + cfg.balanced_weight * balanced_allocation(
+            requested, n_alloc, cfg.score_resources
         )
+        if cfg.enable_taint_score:
+            total = total + cfg.taint_weight * norm_reverse(xs["pref"], feasible)
+        if cfg.enable_node_pref:
+            # NodeAffinity preferred: DefaultNormalizeScore (not reversed)
+            na_row = xs["na"]
+            na_max = _rmax(jnp.where(feasible, na_row, 0.0), axis_name)
+            total = total + cfg.node_affinity_weight * jnp.where(
+                na_max > 0, na_row * MAX_NODE_SCORE / na_max, 0.0
+            )
+        if cfg.enable_pairwise:
+            total = total + cfg.spread_weight * norm_reverse(spread_raw, feasible)
         total = jnp.where(feasible, total, -jnp.inf)
         best = _rmax(total, axis_name)
         schedulable = (best > -jnp.inf) & valid
@@ -155,19 +177,13 @@ def schedule_scan(
             if axis_name:
                 dom_col = lax.psum(dom_col, axis_name)
             counts, anti_counts = pairwise.commit_counts(
-                counts, anti_counts, choice, dom_col, m_col, anti_terms
+                counts, anti_counts, choice, dom_col, xs["m"], xs["anti"]
             )
         if cfg.enable_ports:
-            ports_used = ports_used | (placed & ports_row[None, :])
+            ports_used = ports_used | (placed & xs["ports"][None, :])
         return (used, counts, anti_counts, ports_used), choice
 
     state0 = (arr.node_used, arr.term_counts0, arr.anti_counts0, arr.node_ports0)
-    xs = (
-        arr.pod_req, sf, nodesel, pref_taints, na_raw, arr.pod_valid,
-        arr.pod_aff_terms, arr.pod_anti_terms, arr.pod_spread_terms,
-        arr.pod_spread_maxskew, arr.pod_spread_hard,
-        arr.m_pend.T, arr.pod_ports,
-    )
     (used_final, _, _, _), choices = lax.scan(step, state0, xs)
     return choices, used_final
 

@@ -47,6 +47,11 @@ class ScoreConfig:
     # prunes provably-dead work.  See infer_score_config.
     enable_pairwise: bool = True
     enable_ports: bool = True
+    # Prune the [P, N] taint-score / preferred-node-affinity matrices when no
+    # PreferNoSchedule taint / preferred term exists: their contribution is a
+    # constant (or zero) per pod, which cannot change argmax.
+    enable_taint_score: bool = True
+    enable_node_pref: bool = True
 
 
 DEFAULT_SCORE_CONFIG = ScoreConfig()
@@ -66,7 +71,15 @@ def infer_score_config(arr, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG) -> ScoreCon
         or np.any(arr.anti_counts0 > 0)
     )
     has_ports = bool(np.any(arr.pod_ports) or np.any(arr.node_ports0))
-    return dataclasses.replace(cfg, enable_pairwise=has_terms, enable_ports=has_ports)
+    has_prefer_taints = bool(np.any(arr.node_taint_pref))
+    has_node_pref = bool(np.any(arr.pod_pref_terms >= 0))
+    return dataclasses.replace(
+        cfg,
+        enable_pairwise=has_terms,
+        enable_ports=has_ports,
+        enable_taint_score=has_prefer_taints,
+        enable_node_pref=has_node_pref,
+    )
 
 
 def least_allocated(

@@ -188,16 +188,30 @@ class Scheduler:
             else:
                 failed.append(pod)
                 result[pod.name] = None
-        # failure path: preemption through the CPU PostFilter, then requeue
+        # failure path: preemption through the CPU PostFilter, then requeue.
+        # The what-if state is built once per batch (not per pod) and only
+        # rebuilt after an actual eviction; pods that cannot possibly preempt
+        # (no bound pod anywhere with lower priority) skip PostFilter outright.
+        state = None
+        snap2 = None
+        min_bound_prio: Optional[int] = None
         for pod in failed:
-            snap2 = self.cache.update_snapshot()
-            infos = self.cache.node_infos(snap2)
-            state = CycleState()
-            state.data["scaled"] = ScaledState(snap2, infos)
-            nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
+            if state is None:
+                snap2 = self.cache.update_snapshot()
+                infos = self.cache.node_infos(snap2)
+                state = CycleState()
+                state.data["scaled"] = ScaledState(snap2, infos)
+                min_bound_prio = min(
+                    (q.priority for q in snap2.bound_pods), default=None
+                )
             self.events.record("FailedScheduling", pod.name)
-            if pst.ok and nominated:
-                self.events.record("Preempted", pod.name, node=nominated)
+            if min_bound_prio is None or pod.priority <= min_bound_prio:
+                pst = Status.unschedulable("preemption: no lower-priority pods")
+            else:
+                nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
+                if pst.ok and nominated:
+                    self.events.record("Preempted", pod.name, node=nominated)
+                    state = None  # evictions changed the cluster: rebuild lazily
             self.queue.add_unschedulable(pod, backoff=True)
         dt = time.perf_counter() - t0
         self.metrics.observe("batch_scheduling_duration_seconds", dt)

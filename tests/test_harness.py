@@ -1,0 +1,37 @@
+"""Benchmark harness smoke tests (scheduler_perf analog, tiny scale)."""
+
+from kubernetes_tpu.bench.harness import run_yaml
+
+
+def test_harness_runs_tiny_configs():
+    text = """
+name: Tiny
+ops:
+  - {op: createCluster, generator: basic, nodes: 16, pods: 40}
+  - {op: measure}
+---
+name: TinyGang
+ops:
+  - {op: createCluster, generator: gang, groups: 3, group_size: 4, nodes: 8}
+  - {op: measure}
+"""
+    results = run_yaml(text)
+    assert [r.name for r in results] == ["Tiny", "TinyGang"]
+    basic = results[0]
+    assert basic.scheduled == 40 and basic.unschedulable == 0
+    assert basic.pods_per_sec > 0
+    gang = results[1]
+    assert gang.scheduled == 12
+
+
+def test_harness_cpu_mode_matches_tpu():
+    text = """
+name: T
+ops:
+  - {op: createCluster, generator: heterogeneous, nodes: 12, pods: 24}
+  - {op: measure}
+"""
+    tpu = run_yaml(text, "tpu")[0]
+    cpu = run_yaml(text, "cpu")[0]
+    assert tpu.scheduled == cpu.scheduled
+    assert tpu.unschedulable == cpu.unschedulable
