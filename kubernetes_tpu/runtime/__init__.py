@@ -1,0 +1,2 @@
+from .sidecar import TPUScoreServer  # noqa: F401
+from .client import TPUScoreClient, SidecarUnavailable  # noqa: F401

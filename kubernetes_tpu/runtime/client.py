@@ -1,0 +1,61 @@
+"""TPUScore client — the scheduler side of the sidecar protocol.
+
+Wraps the gRPC channel with the fallback contract the north star mandates:
+deadline exceeded or transport failure raises SidecarUnavailable, and the
+caller (scheduler.py) falls back to the stock CPU path — exactly how the
+reference tolerates a misbehaving HTTP extender (extender.go ignorable errors).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import grpc
+
+from ..api.snapshot import Snapshot
+from . import tpuscore_pb2 as pb
+from .convert import snapshot_to_proto
+from .sidecar import SERVICE
+
+
+class SidecarUnavailable(Exception):
+    pass
+
+
+class TPUScoreClient:
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(address)
+        self._schedule = self._channel.unary_unary(
+            f"/{SERVICE}/Schedule",
+            request_serializer=pb.ScheduleRequest.SerializeToString,
+            response_deserializer=pb.ScheduleResponse.FromString,
+        )
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+
+    def health(self, timeout_s: float = 2.0) -> pb.HealthResponse:
+        try:
+            return self._health(pb.HealthRequest(), timeout=timeout_s)
+        except grpc.RpcError as e:
+            raise SidecarUnavailable(str(e.code())) from e
+
+    def schedule(
+        self, snap: Snapshot, deadline_ms: float = 1000.0, gang: bool = True
+    ) -> Dict[str, Optional[str]]:
+        """-> pod uid -> node name (None = unschedulable).  Raises
+        SidecarUnavailable on deadline/transport failure (caller falls back)."""
+        req = pb.ScheduleRequest(
+            snapshot=snapshot_to_proto(snap), deadline_ms=deadline_ms, gang=gang
+        )
+        try:
+            resp = self._schedule(req, timeout=deadline_ms / 1e3)
+        except grpc.RpcError as e:
+            raise SidecarUnavailable(str(e.code())) from e
+        return {v.pod_uid: (v.node if v.scheduled else None) for v in resp.verdicts}
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,212 @@
+"""proto <-> object-model conversion for the TPUScore sidecar protocol."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..api.snapshot import Snapshot
+from . import tpuscore_pb2 as pb
+
+
+# ---------- to proto ----------
+
+def _quantities(d: Dict[str, int]):
+    return [pb.Quantity(resource=k, value=int(v)) for k, v in d.items()]
+
+
+def _labels(d: Dict[str, str]):
+    return [pb.Label(key=k, value=v) for k, v in d.items()]
+
+
+def _selector(sel: Optional[t.LabelSelector]) -> pb.LabelSelector:
+    if sel is None:
+        return pb.LabelSelector(present=False)
+    return pb.LabelSelector(
+        present=True,
+        match_labels=[pb.Label(key=k, value=v) for k, v in sel.match_labels],
+        match_expressions=[
+            pb.LabelSelectorRequirement(key=e.key, op=e.operator, values=list(e.values))
+            for e in sel.match_expressions
+        ],
+    )
+
+
+def _nst(term: t.NodeSelectorTerm) -> pb.NodeSelectorTerm:
+    return pb.NodeSelectorTerm(
+        match_expressions=[
+            pb.LabelSelectorRequirement(key=e.key, op=e.operator, values=list(e.values))
+            for e in term.match_expressions
+        ]
+    )
+
+
+def _pat(term: t.PodAffinityTerm) -> pb.PodAffinityTerm:
+    return pb.PodAffinityTerm(
+        topology_key=term.topology_key,
+        selector=_selector(term.label_selector),
+        namespaces=list(term.namespaces),
+    )
+
+
+def pod_to_proto(p: t.Pod) -> pb.Pod:
+    msg = pb.Pod(
+        name=p.name,
+        namespace=p.namespace,
+        uid=p.uid,
+        requests=_quantities(p.requests),
+        labels=_labels(p.labels),
+        node_name=p.node_name,
+        priority=p.priority,
+        tolerations=[
+            pb.Toleration(key=x.key, op=x.operator, value=x.value, effect=x.effect)
+            for x in p.tolerations
+        ],
+        node_selector=[pb.Label(key=k, value=v) for k, v in p.node_selector],
+        host_ports=[pb.HostPort(protocol=pr, port=po) for pr, po in p.host_ports],
+        scheduling_gates=list(p.scheduling_gates),
+        pod_group=p.pod_group,
+        topology_spread=[
+            pb.TopologySpreadConstraint(
+                max_skew=c.max_skew,
+                topology_key=c.topology_key,
+                when_unsatisfiable=c.when_unsatisfiable,
+                selector=_selector(c.label_selector),
+            )
+            for c in p.topology_spread
+        ],
+    )
+    if p.affinity:
+        msg.required_node_terms.extend(_nst(x) for x in p.affinity.required_node_terms)
+        msg.preferred_node_terms.extend(
+            pb.PreferredSchedulingTerm(weight=x.weight, preference=_nst(x.preference))
+            for x in p.affinity.preferred_node_terms
+        )
+        msg.required_pod_affinity.extend(_pat(x) for x in p.affinity.required_pod_affinity)
+        msg.required_pod_anti_affinity.extend(
+            _pat(x) for x in p.affinity.required_pod_anti_affinity
+        )
+    return msg
+
+
+def node_to_proto(n: t.Node) -> pb.Node:
+    return pb.Node(
+        name=n.name,
+        allocatable=_quantities(n.allocatable),
+        labels=_labels(n.labels),
+        taints=[pb.Taint(key=x.key, value=x.value, effect=x.effect) for x in n.taints],
+        unschedulable=n.unschedulable,
+    )
+
+
+def snapshot_to_proto(s: Snapshot) -> pb.Snapshot:
+    return pb.Snapshot(
+        nodes=[node_to_proto(n) for n in s.nodes],
+        pending_pods=[pod_to_proto(p) for p in s.pending_pods],
+        bound_pods=[pod_to_proto(p) for p in s.bound_pods],
+        pod_groups=[
+            pb.PodGroup(name=g.name, min_member=g.min_member) for g in s.pod_groups.values()
+        ],
+    )
+
+
+# ---------- from proto ----------
+
+def _from_selector(msg: pb.LabelSelector) -> Optional[t.LabelSelector]:
+    if not msg.present:
+        return None
+    return t.LabelSelector(
+        match_labels=tuple((l.key, l.value) for l in msg.match_labels),
+        match_expressions=tuple(
+            t.LabelSelectorRequirement(key=e.key, operator=e.op, values=tuple(e.values))
+            for e in msg.match_expressions
+        ),
+    )
+
+
+def _from_nst(msg: pb.NodeSelectorTerm) -> t.NodeSelectorTerm:
+    return t.NodeSelectorTerm(
+        match_expressions=tuple(
+            t.NodeSelectorRequirement(key=e.key, operator=e.op, values=tuple(e.values))
+            for e in msg.match_expressions
+        )
+    )
+
+
+def _from_pat(msg: pb.PodAffinityTerm) -> t.PodAffinityTerm:
+    return t.PodAffinityTerm(
+        topology_key=msg.topology_key,
+        label_selector=_from_selector(msg.selector),
+        namespaces=tuple(msg.namespaces),
+    )
+
+
+def pod_from_proto(msg: pb.Pod) -> t.Pod:
+    affinity = None
+    if (
+        msg.required_node_terms
+        or msg.preferred_node_terms
+        or msg.required_pod_affinity
+        or msg.required_pod_anti_affinity
+    ):
+        affinity = t.Affinity(
+            required_node_terms=tuple(_from_nst(x) for x in msg.required_node_terms),
+            preferred_node_terms=tuple(
+                t.PreferredSchedulingTerm(weight=x.weight, preference=_from_nst(x.preference))
+                for x in msg.preferred_node_terms
+            ),
+            required_pod_affinity=tuple(_from_pat(x) for x in msg.required_pod_affinity),
+            required_pod_anti_affinity=tuple(
+                _from_pat(x) for x in msg.required_pod_anti_affinity
+            ),
+        )
+    return t.Pod(
+        name=msg.name,
+        namespace=msg.namespace or "default",
+        uid=msg.uid,
+        requests={q.resource: int(q.value) for q in msg.requests},
+        labels={l.key: l.value for l in msg.labels},
+        node_name=msg.node_name,
+        priority=msg.priority,
+        tolerations=tuple(
+            t.Toleration(key=x.key, operator=x.op or "Equal", value=x.value, effect=x.effect)
+            for x in msg.tolerations
+        ),
+        node_selector=tuple(sorted((l.key, l.value) for l in msg.node_selector)),
+        affinity=affinity,
+        topology_spread=tuple(
+            t.TopologySpreadConstraint(
+                max_skew=c.max_skew,
+                topology_key=c.topology_key,
+                when_unsatisfiable=c.when_unsatisfiable or t.DO_NOT_SCHEDULE,
+                label_selector=_from_selector(c.selector),
+            )
+            for c in msg.topology_spread
+        ),
+        host_ports=tuple((h.protocol, h.port) for h in msg.host_ports),
+        scheduling_gates=tuple(msg.scheduling_gates),
+        pod_group=msg.pod_group,
+    )
+
+
+def node_from_proto(msg: pb.Node) -> t.Node:
+    return t.Node(
+        name=msg.name,
+        allocatable={q.resource: int(q.value) for q in msg.allocatable},
+        labels={l.key: l.value for l in msg.labels},
+        taints=tuple(
+            t.Taint(key=x.key, value=x.value, effect=x.effect) for x in msg.taints
+        ),
+        unschedulable=msg.unschedulable,
+    )
+
+
+def snapshot_from_proto(msg: pb.Snapshot) -> Snapshot:
+    return Snapshot(
+        nodes=[node_from_proto(n) for n in msg.nodes],
+        pending_pods=[pod_from_proto(p) for p in msg.pending_pods],
+        bound_pods=[pod_from_proto(p) for p in msg.bound_pods],
+        pod_groups={
+            g.name: t.PodGroup(name=g.name, min_member=g.min_member) for g in msg.pod_groups
+        },
+    )

@@ -1,0 +1,125 @@
+"""TPUScore gRPC sidecar — L4.
+
+The JAX process that owns the TPU: receives activeQ + NodeInfo snapshots over
+gRPC, runs the batched filter/score/commit kernels, streams binding verdicts
+back.  Single-writer by construction: one server thread owns the device
+(SURVEY.md §5 race-detection note — design the host side single-writer),
+gRPC concurrency is serialized through a lock rather than locks in the engine.
+
+Crash-only: the server keeps no state a reconnecting client cannot re-send —
+every request carries the full snapshot (delta streaming is a planned
+optimization; the contract already allows it because verdicts are pure
+functions of the snapshot).
+
+Service stubs are hand-wired with grpc.method_handlers_generic_handler (the
+image has grpcio but not grpc_tools' codegen plugin).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from . import tpuscore_pb2 as pb
+from .convert import snapshot_from_proto
+
+SERVICE = "tpuscore.TPUScore"
+
+
+class _Engine:
+    """The in-process scheduling engine the server fronts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def schedule(self, snap, gang: bool):
+        from ..api.snapshot import encode_snapshot
+        from ..ops import schedule_batch
+        from ..ops.gang import schedule_with_gangs
+        from ..ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
+
+        with self._lock:  # single writer on the device
+            arr, meta = encode_snapshot(snap)
+            cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+            if gang:
+                choices, _ = schedule_with_gangs(arr, cfg)
+            else:
+                choices = np.asarray(schedule_batch(arr, cfg)[0])
+            return choices, meta
+
+
+class TPUScoreServer:
+    def __init__(self, address: str = "127.0.0.1:0", engine: Optional[_Engine] = None):
+        self.engine = engine or _Engine()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        handlers = {
+            "Schedule": grpc.unary_unary_rpc_method_handler(
+                self._schedule,
+                request_deserializer=pb.ScheduleRequest.FromString,
+                response_serializer=pb.ScheduleResponse.SerializeToString,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                self._health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(address)
+
+    # --- RPCs ---
+    def _schedule(self, request: pb.ScheduleRequest, context) -> pb.ScheduleResponse:
+        t0 = time.perf_counter()
+        snap = snapshot_from_proto(request.snapshot)
+        uid_of = {p.name: p.uid for p in snap.pending_pods}
+        choices, meta = self.engine.schedule(snap, request.gang)
+        resp = pb.ScheduleResponse()
+        for k in range(meta.n_pods):
+            c = int(choices[k])
+            name = meta.pod_names[k]
+            resp.verdicts.append(
+                pb.Verdict(
+                    pod_uid=uid_of[name],
+                    node=meta.node_names[c] if c >= 0 else "",
+                    scheduled=c >= 0,
+                )
+            )
+        resp.elapsed_ms = (time.perf_counter() - t0) * 1e3
+        return resp
+
+    def _health(self, request, context) -> pb.HealthResponse:
+        import jax
+
+        devs = jax.devices()
+        return pb.HealthResponse(ok=True, platform=devs[0].platform, device_count=len(devs))
+
+    # --- lifecycle ---
+    def start(self) -> int:
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+def main() -> None:  # pragma: no cover - manual entry point
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--listen", default="127.0.0.1:50151")
+    args = ap.parse_args()
+    srv = TPUScoreServer(args.listen)
+    port = srv.start()
+    print(f"tpuscore sidecar listening on port {port}")
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,7 @@ class Scheduler:
         self.framework = Framework(
             default_plugins(store, filter_fn=self._filter_one)
         )
+        self._sidecar = None  # lazy TPUScoreClient when profile configures one
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -165,22 +166,47 @@ class Scheduler:
             bound_pods=snap.bound_pods,
             pod_groups=snap.pod_groups,
         )
-        arr, meta = encode_snapshot(snap)
-        cfg = infer_score_config(arr, self.config.score_config())
-        if self.features.enabled("GangScheduling"):
-            choices, _ = schedule_with_gangs(arr, cfg)
-        else:
-            from ..ops import schedule_batch as kernel
+        gang = self.features.enabled("GangScheduling")
+        prof = self.config.profile()
+        verdicts: Optional[Dict[str, Optional[str]]] = None  # uid -> node|None
+        if prof.tpu_score is not None and prof.tpu_score.sidecar_address != "local":
+            # offload to the gRPC sidecar; deadline/transport failure -> the
+            # mandated CPU fallback (per-pod plugin path)
+            from ..runtime import SidecarUnavailable, TPUScoreClient
 
-            choices = np.asarray(kernel(arr, cfg)[0])
-        by_name = {p.name: p for p in snap.pending_pods}
+            try:
+                if self._sidecar is None:
+                    self._sidecar = TPUScoreClient(prof.tpu_score.sidecar_address)
+                verdicts = self._sidecar.schedule(
+                    snap, deadline_ms=prof.tpu_score.deadline_ms, gang=gang
+                )
+            except SidecarUnavailable:
+                self.metrics.inc("tpuscore_fallback_total")
+                result = {}
+                for pod in snap.pending_pods:
+                    result[pod.name] = self.schedule_one(pod)
+                return result
+        if verdicts is None:
+            arr, meta = encode_snapshot(snap)
+            cfg = infer_score_config(arr, self.config.score_config())
+            if gang:
+                choices, _ = schedule_with_gangs(arr, cfg)
+            else:
+                from ..ops import schedule_batch as kernel
+
+                choices = np.asarray(kernel(arr, cfg)[0])
+            uid_of = {p.name: p.uid for p in snap.pending_pods}
+            verdicts = {
+                uid_of[meta.pod_names[k]]: (
+                    meta.node_names[int(choices[k])] if int(choices[k]) >= 0 else None
+                )
+                for k in range(meta.n_pods)
+            }
         result: Dict[str, Optional[str]] = {}
         failed: List[t.Pod] = []
-        for k in range(meta.n_pods):
-            pod = by_name[meta.pod_names[k]]
-            c = int(choices[k])
-            if c >= 0:
-                node_name = meta.node_names[c]
+        for pod in snap.pending_pods:
+            node_name = verdicts.get(pod.uid)
+            if node_name:
                 self.cache.assume(pod.uid, node_name)
                 self.store.bind(pod.uid, node_name)
                 self.events.record("Scheduled", pod.name, node=node_name)

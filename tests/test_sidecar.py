@@ -1,0 +1,93 @@
+"""gRPC sidecar: loopback end-to-end, proto roundtrip, deadline fallback —
+the integration analog of the extender tests + the north star's fallback
+contract."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.oracle import oracle_schedule
+from kubernetes_tpu.runtime import SidecarUnavailable, TPUScoreClient, TPUScoreServer
+from kubernetes_tpu.runtime.convert import snapshot_from_proto, snapshot_to_proto
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.config import Profile, TPUScoreArgs
+from helpers import mk_node, mk_pod, random_cluster
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = TPUScoreServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_proto_roundtrip_preserves_snapshot():
+    rng = random.Random(9)
+    snap = random_cluster(rng, n_nodes=6, n_pods=12, with_taints=True,
+                          with_selectors=True, with_pairwise=True)
+    snap.pod_groups["g"] = t.PodGroup(name="g", min_member=2)
+    back = snapshot_from_proto(snapshot_to_proto(snap))
+    # decisions over the roundtripped snapshot must be identical
+    assert oracle_schedule(back) == oracle_schedule(snap)
+    assert back.pod_groups["g"].min_member == 2
+
+
+def test_sidecar_schedules_over_loopback(server):
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    h = client.health()
+    assert h.ok and h.device_count >= 1
+    snap = Snapshot(
+        nodes=[mk_node("a"), mk_node("b")],
+        pending_pods=[mk_pod("p0"), mk_pod("p1"), mk_pod("huge", cpu=10**6)],
+    )
+    verdicts = client.schedule(snap, deadline_ms=60_000)
+    assert verdicts["default/p0"] in ("a", "b")
+    assert verdicts["default/huge"] is None
+    # parity with the oracle through the wire
+    want = dict(oracle_schedule(snap))
+    got = {uid.split("/")[1]: node for uid, node in verdicts.items()}
+    assert got == want
+    client.close()
+
+
+def test_sidecar_matches_gang_semantics(server):
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    pods = [mk_pod(f"g-{i}", cpu=600, pod_group="job") for i in range(3)]
+    snap = Snapshot(nodes=[mk_node("n0", cpu=1000)], pending_pods=pods)
+    verdicts = client.schedule(snap, deadline_ms=60_000, gang=True)
+    assert all(v is None for v in verdicts.values())  # all-or-nothing revoked
+    client.close()
+
+
+def test_client_raises_on_dead_endpoint():
+    client = TPUScoreClient("127.0.0.1:1")  # nothing listens here
+    with pytest.raises(SidecarUnavailable):
+        client.schedule(Snapshot(nodes=[mk_node("n")], pending_pods=[mk_pod("p")]),
+                        deadline_ms=300)
+    client.close()
+
+
+def test_scheduler_offloads_to_sidecar(server):
+    prof = Profile(tpu_score=TPUScoreArgs(sidecar_address=f"127.0.0.1:{server.port}",
+                                          deadline_ms=60_000))
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu", profiles=(prof,)))
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    assert store.pods["default/p"].node_name == "n0"
+
+
+def test_scheduler_falls_back_to_cpu_when_sidecar_down():
+    prof = Profile(tpu_score=TPUScoreArgs(sidecar_address="127.0.0.1:1", deadline_ms=200))
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu", profiles=(prof,)))
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    # still scheduled — through the CPU plugin path
+    assert store.pods["default/p"].node_name == "n0"
+    assert sched.metrics.counters["tpuscore_fallback_total"] == 1
