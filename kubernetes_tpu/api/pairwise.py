@@ -68,6 +68,59 @@ def _matches(term: TermKey, pod: t.Pod) -> bool:
     return pod.namespace in term.namespaces and term.selector.matches(pod.labels)
 
 
+_NS_KEY = "\x00ns"  # pseudo label key carrying the pod's namespace
+
+
+def _match_matrix(terms: List[TermKey], pods: Sequence[t.Pod]) -> np.ndarray:
+    """f32[T, P] 0/1 selector+namespace matches, vectorized.
+
+    Reuses the AnyOf/NoneOf lowering (api/vocab.py) over a POD-label literal
+    vocab — the namespace test becomes one more AnyOf over pseudo-literals —
+    so the whole match is a handful of numpy matmuls instead of T x P Python
+    selector evaluations.  Semantics identical to _matches (property-tested).
+    """
+    T, P = len(terms), len(pods)
+    if T == 0 or P == 0:
+        return np.zeros((max(1, T), max(1, P)), dtype=np.float32)
+    voc = v.LabelVocab()
+    pod_lits = [
+        voc.add_labels({**pod.labels, _NS_KEY: pod.namespace}) for pod in pods
+    ]
+    L = max(1, len(voc))
+    labels = np.zeros((P, L), dtype=np.float32)
+    for i, lits in enumerate(pod_lits):
+        labels[i, lits] = 1.0
+
+    table = v.TermTable()
+    ids = []
+    for term in terms:
+        if term.selector is None:
+            ids.append(table.intern(v.FALSE_TERM))
+            continue
+        reqs = v.label_selector_to_requirements(term.selector)
+        lowered = v.lower_node_term(reqs, voc)
+        if lowered is not v.FALSE_TERM:
+            ns_lits = frozenset(
+                l
+                for ns in term.namespaces
+                if (l := voc.lit(_NS_KEY, ns)) is not None
+            )
+            if not ns_lits:
+                lowered = v.FALSE_TERM
+            else:
+                lowered = tuple(sorted([*lowered, (v.KIND_ANY, ns_lits)],
+                                       key=lambda e: (e[0], sorted(e[1]))))
+        ids.append(table.intern(lowered))
+    mask, kind = table.encode(L)  # [S, E, L], [S, E]
+    counts = np.einsum("sel,pl->sep", mask, labels)
+    ok = np.where(
+        kind[:, :, None] == v.KIND_ANY,
+        counts > 0,
+        np.where(kind[:, :, None] == v.KIND_NONE, counts == 0, kind[:, :, None] == v.KIND_PAD),
+    ).all(axis=1)  # [S, P]
+    return ok[np.array(ids)].astype(np.float32)
+
+
 def build_pairwise(
     nodes: Sequence[t.Node],
     pending: Sequence[t.Pod],  # already in activeQ order
@@ -131,21 +184,18 @@ def build_pairwise(
     for ti, term in enumerate(voc.terms.items):
         term_key[ti] = voc.topo_keys.get(term.topology_key)
 
-    # ---- host-side match matrices (the one O(T x pods) pass) ----
+    # ---- host-side match matrices: vectorized AnyOf/NoneOf matmuls ----
+    terms_list = list(voc.terms.items)
+    m_real = _match_matrix(terms_list, pending)  # [T, p]
     m_pend = np.zeros((T, P), dtype=np.float32)
-    for ti, term in enumerate(voc.terms.items):
-        for pi, pod in enumerate(pending):
-            if _matches(term, pod):
-                m_pend[ti, pi] = 1.0
+    m_pend[: m_real.shape[0], : len(pending)] = m_real[:, : len(pending)]
+    placed = [(q, node_index[q.node_name]) for q in bound if q.node_name in node_index]
     term_counts0 = np.zeros((T, D + 1), dtype=np.float32)
-    for pod in bound:
-        ni = node_index.get(pod.node_name)
-        if ni is None:
-            continue
-        for ti, term in enumerate(voc.terms.items):
-            if _matches(term, pod):
-                k = term_key[ti]
-                term_counts0[ti, node_dom[k, ni]] += 1.0
+    if placed and terms_list:
+        m_bound = _match_matrix(terms_list, [q for q, _ in placed])  # [T, Q]
+        bnodes = np.array([ni for _, ni in placed], dtype=np.int64)
+        for ti in range(len(terms_list)):
+            np.add.at(term_counts0[ti], node_dom[term_key[ti], bnodes], m_bound[ti])
     anti_counts0 = np.zeros((T, D + 1), dtype=np.float32)
     for pod, ids in zip(bound, bound_anti):
         ni = node_index.get(pod.node_name)

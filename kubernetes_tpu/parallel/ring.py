@@ -1,0 +1,98 @@
+"""Ring-blockwise pairwise matching + all-to-all resharding — the framework's
+sequence/context-parallel layer (SURVEY.md §2.4).
+
+The reference's pairwise hot spot is the (pending-pods x existing-pods) label
+match inside InterPodAffinity (interpodaffinity/filtering.go — O(pods x nodes)
+with per-pod string work).  This framework normally never materializes that
+matrix (interned terms + counts, api/pairwise.py) — but the selector-vs-pod
+match matrix M[T, P] itself still scales with the pod axis, and at 100k+ pods
+per chip it outgrows HBM next to the [P, N] score matrices.  ring_match
+computes it blockwise, ring-attention style: selector rows stay resident
+(queries), pod-label blocks rotate around the mesh via lax.ppermute (keys),
+each shard filling one [T/d, P/d] output tile per hop.  d hops, peak memory
+1/d of the dense product, traffic rides the ICI ring.
+
+all_to_all_pods_to_nodes is the Ulysses-analog reshard: a pods-sharded [P, N]
+intermediate (natural layout for the batched static phase) redistributes to
+node-sharded (the layout the commit scan wants) with one lax.all_to_all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import NODE_AXIS
+
+PODS_AXIS = NODE_AXIS  # one mesh axis; it shards whichever array axis a stage needs
+
+
+def _eval_block(sel_mask, sel_kind, labels):
+    """[S, E, L] selectors vs [B, L] labels -> bool[S, B] (same evaluation as
+    ops/filters.term_match)."""
+    counts = jnp.einsum("sel,bl->seb", sel_mask, labels,
+                        precision=jax.lax.Precision.HIGHEST)
+    kind = sel_kind[:, :, None]
+    ok = jnp.where(
+        kind == 1, counts > 0, jnp.where(kind == 2, counts == 0, kind == 0)
+    )
+    return jnp.all(ok, axis=1)
+
+
+def ring_match(sel_mask: jax.Array, sel_kind: jax.Array, labels: jax.Array, mesh: Mesh):
+    """bool[S, P] = selectors x entity labels, computed blockwise on the mesh.
+
+    sel_mask [S, E, L] / sel_kind [S, E] sharded on S; labels [P, L] sharded on
+    P; output sharded on S.  Peak per-device memory is the [S/d, P/d] tile.
+    """
+    d = mesh.shape[PODS_AXIS]
+    S, P_total = sel_mask.shape[0], labels.shape[0]
+    if S % d or P_total % d:
+        raise ValueError(f"S={S} and P={P_total} must divide mesh size {d}")
+    p_local = P_total // d
+
+    def f(sel_m, sel_k, lab):
+        idx = lax.axis_index(PODS_AXIS)
+        perm = [(j, (j - 1) % d) for j in range(d)]
+
+        def body(i, carry):
+            lab_blk, out = carry
+            src = (idx + i) % d  # origin shard of the block we currently hold
+            tile = _eval_block(sel_m, sel_k, lab_blk)  # [S/d, P/d]
+            out = lax.dynamic_update_slice(out, tile, (0, src * p_local))
+            lab_blk = lax.ppermute(lab_blk, PODS_AXIS, perm)
+            return (lab_blk, out)
+
+        out0 = lax.pvary(
+            jnp.zeros((sel_m.shape[0], P_total), dtype=jnp.bool_), (PODS_AXIS,)
+        )
+        _, out = lax.fori_loop(0, d, body, (lab, out0))
+        return out
+
+    fn = jax.shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(P(PODS_AXIS, None, None), P(PODS_AXIS, None), P(PODS_AXIS, None)),
+        out_specs=P(PODS_AXIS, None),
+    )
+    return jax.jit(fn)(sel_mask, sel_kind, labels)
+
+
+def all_to_all_pods_to_nodes(x: jax.Array, mesh: Mesh):
+    """[P, N] sharded on the pods axis -> the same values sharded on the node
+    axis, via one all_to_all (the §2.4 'Ulysses' re-partitioning)."""
+    d = mesh.shape[PODS_AXIS]
+    if x.shape[0] % d or x.shape[1] % d:
+        raise ValueError(f"both axes of {x.shape} must divide mesh size {d}")
+
+    def f(blk):  # [P/d, N]
+        # split the node axis into d chunks, exchange, concat on the pod axis
+        return lax.all_to_all(blk, PODS_AXIS, split_axis=1, concat_axis=0, tiled=True)
+
+    fn = jax.shard_map(f, mesh=mesh, in_specs=(P(PODS_AXIS, None),),
+                       out_specs=P(None, PODS_AXIS))
+    return jax.jit(fn)(x)

@@ -1,0 +1,57 @@
+"""Property test: vectorized selector matching == per-pod object matching."""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.pairwise import TermKey, _match_matrix, _matches
+
+
+def random_selector(rng):
+    kind = rng.random()
+    if kind < 0.15:
+        return None
+    if kind < 0.3:
+        return t.LabelSelector()  # empty: matches everything
+    exprs = []
+    for _ in range(rng.randint(1, 3)):
+        op = rng.choice([t.OP_IN, t.OP_NOT_IN, t.OP_EXISTS, t.OP_DOES_NOT_EXIST])
+        key = rng.choice(["app", "tier", "env", "ghost"])
+        vals = tuple(rng.sample(["a", "b", "c", "zz"], k=rng.randint(1, 2)))
+        exprs.append(
+            t.LabelSelectorRequirement(
+                key=key, operator=op, values=() if op in (t.OP_EXISTS, t.OP_DOES_NOT_EXIST) else vals
+            )
+        )
+    ml = ()
+    if rng.random() < 0.5:
+        ml = ((rng.choice(["app", "tier"]), rng.choice(["a", "b"])),)
+    return t.LabelSelector(match_labels=ml, match_expressions=tuple(exprs))
+
+
+def test_match_matrix_equals_object_matching():
+    rng = random.Random(11)
+    pods = [
+        t.Pod(
+            name=f"p{i}",
+            namespace=rng.choice(["default", "prod", "dev"]),
+            labels={
+                k: rng.choice(["a", "b", "c"])
+                for k in rng.sample(["app", "tier", "env"], k=rng.randint(0, 3))
+            },
+        )
+        for i in range(60)
+    ]
+    terms = [
+        TermKey(
+            topology_key="zone",
+            namespaces=tuple(rng.sample(["default", "prod", "dev"], k=rng.randint(1, 2))),
+            selector=random_selector(rng),
+        )
+        for _ in range(40)
+    ]
+    M = _match_matrix(terms, pods)
+    for ti, term in enumerate(terms):
+        for pi, pod in enumerate(pods):
+            assert bool(M[ti, pi]) == _matches(term, pod), (term, pod)
