@@ -191,7 +191,7 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", help="workload YAML file")
     ap.add_argument("--out", help="perfdata JSON output path")
-    ap.add_argument("--mode", default="tpu", choices=["tpu", "cpu"])
+    ap.add_argument("--mode", default="tpu", choices=["tpu", "native", "cpu"])
     ap.add_argument("--full", action="store_true", help="run BASELINE configs at full scale")
     args = ap.parse_args(argv)
     if args.config:

@@ -1,0 +1,158 @@
+"""Native (C++) sequential commit engine — ctypes loader + wrapper.
+
+Builds scheduler.cpp on first use (g++, -ffp-contract=off so float math stays
+bit-identical to the XLA kernels' f32 semantics) and exposes
+
+    schedule_batch_native(arr, cfg) -> (choices i32[P], used i32[N, R])
+    schedule_with_gangs_native(arr, cfg) -> same, honoring PodGroups
+
+decision-parity-tested against both the jitted path and the oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..api.snapshot import ClusterArrays
+from ..ops.scores import ScoreConfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "scheduler.cpp")
+_SO = os.path.join(_DIR, "libnative_sched.so")
+_lib = None
+
+
+class _View(ctypes.Structure):
+    _fields_ = [
+        ("N", ctypes.c_int32), ("P", ctypes.c_int32), ("R", ctypes.c_int32),
+        ("T", ctypes.c_int32), ("K", ctypes.c_int32), ("D1", ctypes.c_int32),
+        ("C", ctypes.c_int32), ("A1", ctypes.c_int32), ("A2", ctypes.c_int32),
+        ("PT", ctypes.c_int32),
+        ("alloc", ctypes.c_void_p), ("used", ctypes.c_void_p),
+        ("node_dom", ctypes.c_void_p), ("ports_used", ctypes.c_void_p),
+        ("req", ctypes.c_void_p), ("sf", ctypes.c_void_p),
+        ("pref", ctypes.c_void_p), ("na_raw", ctypes.c_void_p),
+        ("pod_valid", ctypes.c_void_p), ("nodesel", ctypes.c_void_p),
+        ("pod_ports", ctypes.c_void_p),
+        ("term_key", ctypes.c_void_p), ("m_pend", ctypes.c_void_p),
+        ("counts", ctypes.c_void_p), ("anti_counts", ctypes.c_void_p),
+        ("aff_terms", ctypes.c_void_p), ("anti_terms", ctypes.c_void_p),
+        ("spread_terms", ctypes.c_void_p), ("spread_skew", ctypes.c_void_p),
+        ("spread_hard", ctypes.c_void_p),
+        ("w_fit", ctypes.c_float), ("w_bal", ctypes.c_float),
+        ("w_taint", ctypes.c_float), ("w_na", ctypes.c_float),
+        ("w_spread", ctypes.c_float),
+        ("r0", ctypes.c_int32), ("r1", ctypes.c_int32),
+        ("enable_pairwise", ctypes.c_uint8), ("enable_ports", ctypes.c_uint8),
+        ("enable_taint", ctypes.c_uint8), ("enable_na", ctypes.c_uint8),
+    ]
+
+
+def _build() -> str:
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-ffp-contract=off",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True,
+        )
+    return _SO
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(_build())
+        _lib.schedule_native.restype = ctypes.c_int
+        _lib.schedule_native.argtypes = [ctypes.POINTER(_View), ctypes.c_void_p]
+    return _lib
+
+
+def _ptr(a: Optional[np.ndarray]):
+    return a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+
+
+def schedule_batch_native(
+    arr: ClusterArrays, cfg: ScoreConfig
+) -> Tuple[np.ndarray, np.ndarray]:
+    from .static_np import preferred_na_raw, static_feasible, taint_prefer_counts
+
+    lib = _load()
+    if arr.pod_spread_terms.shape[1] > 8:
+        raise ValueError("native engine supports at most 8 spread constraints per pod")
+    sf, nodesel, tm = static_feasible(arr)
+    nodesel = (nodesel & arr.node_valid[None, :].astype(np.uint8)).astype(np.uint8)
+    pref = (
+        np.ascontiguousarray(taint_prefer_counts(arr)) if cfg.enable_taint_score else None
+    )
+    na = np.ascontiguousarray(preferred_na_raw(arr, tm)) if cfg.enable_node_pref else None
+
+    used = np.ascontiguousarray(arr.node_used.astype(np.int32)).copy()
+    counts = np.ascontiguousarray(arr.term_counts0.astype(np.float32)).copy()
+    anti = np.ascontiguousarray(arr.anti_counts0.astype(np.float32)).copy()
+    ports_used = np.ascontiguousarray(arr.node_ports0.astype(np.uint8)).copy()
+    choices = np.full(arr.P, -1, dtype=np.int32)
+
+    c = lambda a, dt: np.ascontiguousarray(a.astype(dt))
+    keep = dict(  # keep references alive across the C call
+        alloc=c(arr.node_alloc, np.int32), req=c(arr.pod_req, np.int32),
+        sf=sf, nodesel=nodesel, pod_valid=c(arr.pod_valid, np.uint8),
+        node_dom=c(arr.node_dom, np.int32), term_key=c(arr.term_key, np.int32),
+        m_pend=c(arr.m_pend, np.float32),
+        aff=c(arr.pod_aff_terms, np.int32), anti_t=c(arr.pod_anti_terms, np.int32),
+        st=c(arr.pod_spread_terms, np.int32), sk=c(arr.pod_spread_maxskew, np.int32),
+        sh=c(arr.pod_spread_hard, np.uint8), pp=c(arr.pod_ports, np.uint8),
+    )
+    view = _View(
+        N=arr.N, P=arr.P, R=arr.R,
+        T=arr.term_key.shape[0], K=arr.node_dom.shape[0],
+        D1=arr.term_counts0.shape[1],
+        C=arr.pod_spread_terms.shape[1], A1=arr.pod_aff_terms.shape[1],
+        A2=arr.pod_anti_terms.shape[1], PT=arr.pod_ports.shape[1],
+        alloc=_ptr(keep["alloc"]), used=_ptr(used),
+        node_dom=_ptr(keep["node_dom"]), ports_used=_ptr(ports_used),
+        req=_ptr(keep["req"]), sf=_ptr(keep["sf"]),
+        pref=_ptr(pref), na_raw=_ptr(na),
+        pod_valid=_ptr(keep["pod_valid"]), nodesel=_ptr(keep["nodesel"]),
+        pod_ports=_ptr(keep["pp"]),
+        term_key=_ptr(keep["term_key"]), m_pend=_ptr(keep["m_pend"]),
+        counts=_ptr(counts), anti_counts=_ptr(anti),
+        aff_terms=_ptr(keep["aff"]), anti_terms=_ptr(keep["anti_t"]),
+        spread_terms=_ptr(keep["st"]), spread_skew=_ptr(keep["sk"]),
+        spread_hard=_ptr(keep["sh"]),
+        w_fit=cfg.fit_weight, w_bal=cfg.balanced_weight,
+        w_taint=cfg.taint_weight, w_na=cfg.node_affinity_weight,
+        w_spread=cfg.spread_weight,
+        r0=cfg.score_resources[0], r1=cfg.score_resources[1],
+        enable_pairwise=int(cfg.enable_pairwise), enable_ports=int(cfg.enable_ports),
+        enable_taint=int(cfg.enable_taint_score), enable_na=int(cfg.enable_node_pref),
+    )
+    rc = lib.schedule_native(ctypes.byref(view), _ptr(choices))
+    if rc != 0:
+        raise RuntimeError(f"native scheduler failed rc={rc}")
+    return choices, used
+
+
+def schedule_with_gangs_native(
+    arr: ClusterArrays, cfg: ScoreConfig
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gang fixpoint (ops/gang.py semantics) over the native engine."""
+    import dataclasses
+
+    from ..ops.gang import failed_groups
+
+    pod_valid = np.asarray(arr.pod_valid).copy()
+    while True:
+        arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
+        choices, used = schedule_batch_native(arr_i, cfg)
+        pod_group = np.asarray(arr.pod_group)
+        bad = failed_groups(choices, pod_group, np.asarray(arr.group_min), active=pod_valid)
+        if not bad.any():
+            return choices, used
+        in_bad = bad[np.maximum(pod_group, 0)] & (pod_group >= 0) & pod_valid
+        first_g = pod_group[int(np.argmax(in_bad))]
+        pod_valid = pod_valid & ~((pod_group == first_g) & pod_valid)

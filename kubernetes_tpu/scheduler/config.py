@@ -52,7 +52,9 @@ class SchedulerConfiguration:
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     feature_gates: Tuple[Tuple[str, bool], ...] = ()
-    mode: str = "tpu"  # "tpu" (batched kernels) | "cpu" (per-pod plugin path)
+    # "tpu" (batched XLA kernels) | "native" (batched C++ engine — the fast
+    # CPU fallback) | "cpu" (per-pod plugin path — the reference's exact shape)
+    mode: str = "tpu"
 
     def profile(self, name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -100,7 +102,7 @@ def validate(cfg: SchedulerConfiguration) -> List[str]:
         for s in p.plugins:
             if s.weight < 0:
                 errs.append(f"{p.scheduler_name}/{s.name}: negative weight")
-    if cfg.mode not in ("tpu", "cpu"):
+    if cfg.mode not in ("tpu", "native", "cpu"):
         errs.append(f"unknown mode {cfg.mode!r}")
     if cfg.parallelism <= 0:
         errs.append("parallelism must be positive")

@@ -189,7 +189,12 @@ class Scheduler:
         if verdicts is None:
             arr, meta = encode_snapshot(snap)
             cfg = infer_score_config(arr, self.config.score_config())
-            if gang:
+            if self.config.mode == "native":
+                from ..native import schedule_batch_native, schedule_with_gangs_native
+
+                fn = schedule_with_gangs_native if gang else schedule_batch_native
+                choices = fn(arr, cfg)[0]
+            elif gang:
                 choices, _ = schedule_with_gangs(arr, cfg)
             else:
                 from ..ops import schedule_batch as kernel
@@ -251,7 +256,7 @@ class Scheduler:
         """Schedule until the activeQ drains (backoff/unschedulable pods wait
         for their clock/events — the test harness advances a FakeClock)."""
         for _ in range(max_cycles):
-            if self.config.mode == "tpu":
+            if self.config.mode in ("tpu", "native"):
                 if not self.schedule_batch():
                     return
             else:

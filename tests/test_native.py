@@ -1,0 +1,50 @@
+"""Native C++ engine: decision parity with the jitted kernels and the oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.snapshot import encode_snapshot
+from kubernetes_tpu.native import schedule_batch_native, schedule_with_gangs_native
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
+from kubernetes_tpu.ops.gang import schedule_with_gangs
+from helpers import mk_node, mk_pod, random_cluster
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_native_matches_kernel(seed):
+    rng = random.Random(4000 + seed)
+    snap = random_cluster(rng, n_nodes=17, n_pods=43, with_taints=True,
+                          with_selectors=True, with_pairwise=True)
+    arr, _ = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want, want_used = schedule_batch(arr, cfg)
+    got, got_used = schedule_batch_native(arr, cfg)
+    np.testing.assert_array_equal(got, np.asarray(want))
+    np.testing.assert_array_equal(got_used, np.asarray(want_used))
+
+
+def test_native_medium_scale_matches_kernel():
+    rng = random.Random(99)
+    snap = random_cluster(rng, n_nodes=96, n_pods=400, with_taints=True,
+                          with_selectors=True, with_pairwise=True)
+    arr, _ = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want, _ = schedule_batch(arr, cfg)
+    got, _ = schedule_batch_native(arr, cfg)
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_native_gang_matches_kernel_gang():
+    pods = [mk_pod(f"g-{i}", cpu=600, pod_group="job") for i in range(3)]
+    pods += [mk_pod(f"s-{i}", cpu=400, pod_group="small") for i in range(2)]
+    from kubernetes_tpu.api.snapshot import Snapshot
+
+    snap = Snapshot(nodes=[mk_node("n0", cpu=1000), mk_node("n1", cpu=1000)],
+                    pending_pods=pods)
+    arr, _ = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want, _ = schedule_with_gangs(arr, cfg)
+    got, _ = schedule_with_gangs_native(arr, cfg)
+    np.testing.assert_array_equal(got, want)
