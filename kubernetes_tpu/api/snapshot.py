@@ -75,6 +75,8 @@ class Snapshot:
     pending_pods: List[t.Pod] = field(default_factory=list)
     bound_pods: List[t.Pod] = field(default_factory=list)
     pod_groups: Dict[str, t.PodGroup] = field(default_factory=dict)
+    pvs: List[t.PersistentVolume] = field(default_factory=list)
+    pvcs: Dict[str, t.PersistentVolumeClaim] = field(default_factory=dict)  # "ns/name" ->
 
 
 @dataclass
@@ -135,6 +137,10 @@ class ClusterArrays:
     # gang scheduling (BASELINE config 5; analog of the coscheduling PodGroup)
     pod_group: np.ndarray  # i32[P] group index or -1
     group_min: np.ndarray  # i32[G] minMember per group
+    # ImageLocality static score matrix (f32[P, N]; [P, 1] zeros when no
+    # images anywhere — computed once at encode time, consumed verbatim by
+    # every backend so parity is structural)
+    image_score: np.ndarray
 
     @property
     def N(self) -> int:
@@ -195,7 +201,58 @@ def activeq_order(pods: Sequence[t.Pod]) -> np.ndarray:
     )
 
 
+_IMG_MIN_MB = 23.0  # imagelocality/image_locality.go — minThreshold (23 MB)
+_IMG_MAX_MB = 1000.0  # maxThreshold
+
+
+def image_score_value(sum_mb: float) -> np.float32:
+    """ImageLocality score from summed present-image megabytes (f32,
+    mirrored by the oracle): 100 * (clip(sum) - min) / (max - min)."""
+    s = np.float32(min(max(float(sum_mb), _IMG_MIN_MB), _IMG_MAX_MB))
+    return np.float32(
+        (s - np.float32(_IMG_MIN_MB))
+        * np.float32(100.0)
+        / np.float32(_IMG_MAX_MB - _IMG_MIN_MB)
+    )
+
+
+def _image_score_matrix(nodes, pending_sorted, N: int, P: int) -> np.ndarray:
+    """f32[P, N] ImageLocality scores, or f32[P, 1] zeros when irrelevant.
+
+    Image sizes quantize to whole MB so sums are integer-exact in f32 across
+    numpy/XLA/C++ (reference computes in int64; imagelocality/image_locality.go
+    — calculatePriority, sumImageScores without the spread factor — deviation
+    documented in PARITY.md)."""
+    img_ids: Dict[str, int] = {}
+    for pod in pending_sorted:
+        for im in pod.images:
+            img_ids.setdefault(im, len(img_ids))
+    if not img_ids or not any(nd.images for nd in nodes):
+        return np.zeros((P, 1), dtype=np.float32)
+    I = len(img_ids)
+    node_mb = np.zeros((N, I), dtype=np.float32)
+    for i, nd in enumerate(nodes):
+        for im, size in nd.images.items():
+            j = img_ids.get(im)
+            if j is not None:
+                node_mb[i, j] = np.float32(size // (1024 * 1024))
+    pod_has = np.zeros((P, I), dtype=np.float32)
+    for k, pod in enumerate(pending_sorted):
+        for im in pod.images:
+            pod_has[k, img_ids[im]] = 1.0
+    raw = pod_has @ node_mb.T  # integer-valued f32 MB sums
+    s = np.clip(raw, _IMG_MIN_MB, _IMG_MAX_MB).astype(np.float32)
+    return (
+        (s - np.float32(_IMG_MIN_MB))
+        * np.float32(100.0)
+        / np.float32(_IMG_MAX_MB - _IMG_MIN_MB)
+    ).astype(np.float32)
+
+
 def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArrays, EncodingMeta]:
+    from .volumes import resolve_snapshot
+
+    snap = resolve_snapshot(snap)
     nodes, pending = snap.nodes, snap.pending_pods
     n, p = len(nodes), len(pending)
     N = _bucket(n) if bucket else max(1, n)
@@ -402,6 +459,7 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
         pod_pref_weights=pod_pref_weights,
         pod_group=pod_group,
         group_min=group_min,
+        image_score=_image_score_matrix(nodes, sorted_pending, N, P),
         **pair,
     )
     meta = EncodingMeta(

@@ -201,6 +201,48 @@ class TopologySpreadConstraint:
     min_domains: Optional[int] = None
 
 
+@dataclass(frozen=True)
+class PersistentVolume:
+    """Static-provisioned volume (core/v1 — type PersistentVolume), reduced to
+    the scheduling-relevant surface: capacity, class, and topology (the node
+    affinity the volume carries, typically a zone restriction)."""
+
+    name: str
+    capacity: int = 0  # bytes
+    storage_class: str = ""
+    # zone restriction: nodes must carry one of these (key, value) labels;
+    # empty = accessible from everywhere
+    allowed_topology: Tuple[Tuple[str, str], ...] = ()
+    claim_ref: str = ""  # "namespace/name" of the bound PVC ("" = available)
+
+
+@dataclass(frozen=True)
+class PersistentVolumeClaim:
+    """core/v1 — type PersistentVolumeClaim (scheduling surface)."""
+
+    name: str
+    namespace: str = "default"
+    request: int = 0  # bytes
+    storage_class: str = ""
+    volume_name: str = ""  # pre-bound PV ("" = unbound)
+    # WaitForFirstConsumer claims don't constrain scheduling (delayed binding)
+    wait_for_first_consumer: bool = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class ResourceClaimRef:
+    """DRA-lite (resource.k8s.io ResourceClaim reduced to counted device
+    classes — the dynamicresources plugin's schedulable core): a claim for
+    `count` devices of `device_class`, modeled as extended resources."""
+
+    device_class: str
+    count: int = 1
+
+
 @dataclass
 class Node:
     """Scheduling view of a node.
@@ -214,6 +256,11 @@ class Node:
     labels: Dict[str, str] = field(default_factory=dict)
     taints: Tuple[Taint, ...] = ()
     unschedulable: bool = False  # spec.unschedulable
+    # image name -> size bytes present on the node (NodeStatus.Images;
+    # ImageLocality's input)
+    images: Dict[str, int] = field(default_factory=dict)
+    # CSI attachable-volume limit (NodeVolumeLimits/csi.go); 0 = unlimited
+    volume_attach_limit: int = 0
 
     def __post_init__(self) -> None:
         self.labels.setdefault(LABEL_HOSTNAME, self.name)
@@ -242,6 +289,9 @@ class Pod:
     host_ports: Tuple[Tuple[str, int], ...] = ()  # (protocol, port)
     scheduling_gates: Tuple[str, ...] = ()
     pod_group: str = ""  # gang-scheduling group name ("" = none)
+    images: Tuple[str, ...] = ()  # container images (ImageLocality's input)
+    pvcs: Tuple[str, ...] = ()  # claimed PVC names (in the pod's namespace)
+    resource_claims: Tuple[ResourceClaimRef, ...] = ()  # DRA-lite
     uid: str = ""
 
     def __post_init__(self) -> None:

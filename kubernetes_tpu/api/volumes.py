@@ -1,0 +1,198 @@
+"""Volume + DRA resolution: lowers storage and device-claim constraints onto
+the core scheduling model, shared by every execution path (TPU kernels, native
+engine, CPU plugins, oracle).
+
+reference semantics covered (SURVEY.md §2.2 volume rows + DRA):
+  - VolumeZone / bound-PVC topology (volumezone/volume_zone.go,
+    volumebinding's feasibility for statically-bound claims): a pod claiming a
+    PVC bound to a PV with allowedTopology {zone=a} can only run on nodes
+    labeled zone=a -> folded into the pod's required node-affinity terms.
+  - VolumeBinding for unbound claims (volumebinding/binder.go): immediate-mode
+    unbound claims must have SOME compatible PV (class + capacity); if none
+    exists the pod is unschedulable everywhere.  If candidate PVs exist, node
+    feasibility is restricted to the union of their topologies.
+    WaitForFirstConsumer claims place no scheduling constraint (delayed
+    binding happens at Reserve/PreBind in the reference).
+  - NodeVolumeLimits (nodevolumelimits/csi.go): per-node attachable-volume
+    cap -> a synthetic "attachable-volumes-csi" resource: nodes with a limit
+    allocate it, each PVC consumes 1, and NodeResourcesFit enforces the cap.
+  - DynamicResources-lite (dynamicresources/): ResourceClaims for counted
+    device classes -> extended resources named "claim/<deviceClass>".
+
+resolve_snapshot returns a NEW snapshot with these constraints folded in;
+the original objects are not mutated.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple
+
+from . import types as t
+from .snapshot import Snapshot
+
+ATTACH_RESOURCE = "attachable-volumes-csi"
+CLAIM_PREFIX = "claim/"
+
+
+def _pv_topology_term(pv: t.PersistentVolume) -> Optional[t.NodeSelectorTerm]:
+    if not pv.allowed_topology:
+        return None
+    # one term per topology pair would OR them; a PV's allowed topology is a
+    # single conjunction in this reduced model
+    return t.NodeSelectorTerm(
+        match_expressions=tuple(
+            t.NodeSelectorRequirement(key=k, operator=t.OP_IN, values=(v,))
+            for k, v in pv.allowed_topology
+        )
+    )
+
+
+def _unsatisfiable_term() -> t.NodeSelectorTerm:
+    return t.NodeSelectorTerm(
+        match_expressions=(
+            t.NodeSelectorRequirement(
+                key="volume.kubernetes.io/unsatisfiable", operator=t.OP_IN, values=("true",)
+            ),
+        )
+    )
+
+
+def resolve_pod(
+    pod: t.Pod,
+    pvcs: Dict[str, t.PersistentVolumeClaim],
+    pvs: Dict[str, t.PersistentVolume],
+) -> t.Pod:
+    """Fold the pod's storage/claim constraints into requests + node affinity."""
+    extra_terms: List[t.NodeSelectorTerm] = []
+    attach_count = 0
+    req_extra: Dict[str, int] = {}
+    for claim_name in pod.pvcs:
+        pvc = pvcs.get(f"{pod.namespace}/{claim_name}")
+        if pvc is None:
+            extra_terms.append(_unsatisfiable_term())  # missing claim: pending
+            continue
+        attach_count += 1
+        if pvc.volume_name:
+            pv = pvs.get(pvc.volume_name)
+            term = _pv_topology_term(pv) if pv else _unsatisfiable_term()
+            if pv is None:
+                extra_terms.append(_unsatisfiable_term())
+            elif term is not None:
+                extra_terms.append(term)
+        elif not pvc.wait_for_first_consumer:
+            # immediate binding: some available compatible PV must exist
+            candidates = [
+                pv
+                for pv in pvs.values()
+                if not pv.claim_ref
+                and pv.storage_class == pvc.storage_class
+                and pv.capacity >= pvc.request
+            ]
+            if not candidates:
+                extra_terms.append(_unsatisfiable_term())
+            else:
+                topos = [c for c in candidates if c.allowed_topology]
+                if len(topos) == len(candidates):
+                    # all candidates are topology-restricted: node must match one
+                    # (terms inside one affinity list are ORed, but the pod may
+                    # already have affinity terms which AND against these via
+                    # distribution — handled below by merging conjunctively
+                    # through a single-term union when possible)
+                    union = tuple(
+                        _pv_topology_term(c) for c in candidates if _pv_topology_term(c)
+                    )
+                    extra_terms.append(union[0] if len(union) == 1 else _or_marker(union))
+    if attach_count:
+        req_extra[ATTACH_RESOURCE] = attach_count
+    for rc in pod.resource_claims:
+        key = CLAIM_PREFIX + rc.device_class
+        req_extra[key] = req_extra.get(key, 0) + rc.count
+    if not extra_terms and not req_extra:
+        return pod
+    q = copy.copy(pod)
+    if req_extra:
+        q.requests = {**pod.requests}
+        for k, v in req_extra.items():
+            q.requests[k] = q.requests.get(k, 0) + v
+    if extra_terms:
+        q.affinity = _and_affinity(pod.affinity, extra_terms)
+    return q
+
+
+class _OrTerms(tuple):
+    """Marker: a disjunction of terms that must AND with the pod's own terms."""
+
+
+def _or_marker(terms: Tuple[t.NodeSelectorTerm, ...]) -> "_OrTerms":
+    return _OrTerms(terms)
+
+
+def _and_affinity(aff: Optional[t.Affinity], extra) -> t.Affinity:
+    """AND extra conjunction terms (or OR-groups) into required node affinity.
+
+    required_node_terms is an OR of conjunctions; to AND a new constraint we
+    distribute it into every existing term (the same trick the encoder uses
+    for spec.nodeSelector — api/vocab.pod_required_node_terms).
+    """
+    base_terms: List[t.NodeSelectorTerm] = (
+        list(aff.required_node_terms) if aff and aff.required_node_terms else [t.NodeSelectorTerm()]
+    )
+    for item in extra:
+        groups = list(item) if isinstance(item, _OrTerms) else [item]
+        new_terms = []
+        for bt in base_terms:
+            for g in groups:
+                new_terms.append(
+                    t.NodeSelectorTerm(
+                        match_expressions=tuple(bt.match_expressions) + tuple(g.match_expressions)
+                    )
+                )
+        base_terms = new_terms
+    if aff is None:
+        return t.Affinity(required_node_terms=tuple(base_terms))
+    return t.Affinity(
+        required_node_terms=tuple(base_terms),
+        preferred_node_terms=aff.preferred_node_terms,
+        required_pod_affinity=aff.required_pod_affinity,
+        required_pod_anti_affinity=aff.required_pod_anti_affinity,
+        preferred_pod_affinity=aff.preferred_pod_affinity,
+        preferred_pod_anti_affinity=aff.preferred_pod_anti_affinity,
+    )
+
+
+def resolve_snapshot(snap: Snapshot) -> Snapshot:
+    """Returns a snapshot with volume/claim constraints folded in (no-op when
+    the snapshot has no PVs/PVCs/claims/attach limits)."""
+    has_storage = bool(
+        snap.pvs
+        or snap.pvcs
+        or any(p.pvcs for p in [*snap.pending_pods, *snap.bound_pods])
+    )
+    has_claims = any(p.resource_claims for p in [*snap.pending_pods, *snap.bound_pods])
+    has_limits = any(nd.volume_attach_limit for nd in snap.nodes)
+    if not (has_storage or has_claims or has_limits):
+        return snap
+    pvs = {pv.name: pv for pv in snap.pvs}
+    pvcs = dict(snap.pvcs)
+    nodes = snap.nodes
+    if has_limits or has_storage:
+        # every node advertises the synthetic attach resource: its declared
+        # limit, or effectively-unlimited when none (csi.go treats a missing
+        # limit as no cap)
+        nodes = []
+        for nd in snap.nodes:
+            nd2 = copy.copy(nd)
+            nd2.allocatable = {
+                **nd.allocatable,
+                ATTACH_RESOURCE: nd.volume_attach_limit or 1_000_000,
+            }
+            nodes.append(nd2)
+    return Snapshot(
+        nodes=nodes,
+        pending_pods=[resolve_pod(p, pvcs, pvs) for p in snap.pending_pods],
+        bound_pods=[resolve_pod(p, pvcs, pvs) for p in snap.bound_pods],
+        pod_groups=snap.pod_groups,
+        pvs=snap.pvs,
+        pvcs=snap.pvcs,
+    )

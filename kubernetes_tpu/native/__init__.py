@@ -43,13 +43,14 @@ class _View(ctypes.Structure):
         ("counts", ctypes.c_void_p), ("anti_counts", ctypes.c_void_p),
         ("aff_terms", ctypes.c_void_p), ("anti_terms", ctypes.c_void_p),
         ("spread_terms", ctypes.c_void_p), ("spread_skew", ctypes.c_void_p),
-        ("spread_hard", ctypes.c_void_p),
+        ("spread_hard", ctypes.c_void_p), ("img", ctypes.c_void_p),
         ("w_fit", ctypes.c_float), ("w_bal", ctypes.c_float),
         ("w_taint", ctypes.c_float), ("w_na", ctypes.c_float),
-        ("w_spread", ctypes.c_float),
+        ("w_spread", ctypes.c_float), ("w_img", ctypes.c_float),
         ("r0", ctypes.c_int32), ("r1", ctypes.c_int32),
         ("enable_pairwise", ctypes.c_uint8), ("enable_ports", ctypes.c_uint8),
         ("enable_taint", ctypes.c_uint8), ("enable_na", ctypes.c_uint8),
+        ("enable_img", ctypes.c_uint8),
     ]
 
 
@@ -90,6 +91,8 @@ def schedule_batch_native(
         np.ascontiguousarray(taint_prefer_counts(arr)) if cfg.enable_taint_score else None
     )
     na = np.ascontiguousarray(preferred_na_raw(arr, tm)) if cfg.enable_node_pref else None
+    enable_img = cfg.enable_image and arr.image_score.shape[1] == arr.N
+    img = np.ascontiguousarray(arr.image_score.astype(np.float32)) if enable_img else None
 
     used = np.ascontiguousarray(arr.node_used.astype(np.int32)).copy()
     counts = np.ascontiguousarray(arr.term_counts0.astype(np.float32)).copy()
@@ -123,13 +126,14 @@ def schedule_batch_native(
         counts=_ptr(counts), anti_counts=_ptr(anti),
         aff_terms=_ptr(keep["aff"]), anti_terms=_ptr(keep["anti_t"]),
         spread_terms=_ptr(keep["st"]), spread_skew=_ptr(keep["sk"]),
-        spread_hard=_ptr(keep["sh"]),
+        spread_hard=_ptr(keep["sh"]), img=_ptr(img),
         w_fit=cfg.fit_weight, w_bal=cfg.balanced_weight,
         w_taint=cfg.taint_weight, w_na=cfg.node_affinity_weight,
-        w_spread=cfg.spread_weight,
+        w_spread=cfg.spread_weight, w_img=cfg.image_weight,
         r0=cfg.score_resources[0], r1=cfg.score_resources[1],
         enable_pairwise=int(cfg.enable_pairwise), enable_ports=int(cfg.enable_ports),
         enable_taint=int(cfg.enable_taint_score), enable_na=int(cfg.enable_node_pref),
+        enable_img=int(enable_img),
     )
     rc = lib.schedule_native(ctypes.byref(view), _ptr(choices))
     if rc != 0:

@@ -50,10 +50,11 @@ struct View {
   const int32_t *spread_terms; // [P,C]
   const int32_t *spread_skew;  // [P,C]
   const uint8_t *spread_hard;  // [P,C]
+  const float *img;            // [P,N] ImageLocality static scores or null
   // config
-  float w_fit, w_bal, w_taint, w_na, w_spread;
+  float w_fit, w_bal, w_taint, w_na, w_spread, w_img;
   int32_t r0, r1;  // scored resource indices
-  uint8_t enable_pairwise, enable_ports, enable_taint, enable_na;
+  uint8_t enable_pairwise, enable_ports, enable_taint, enable_na, enable_img;
 };
 
 inline float least_alloc(const int32_t *alloc_row, const int64_t *req_tot,
@@ -252,6 +253,8 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
         float sc = max_spread > 0.f ? MAXS - MAXS * spread_raw[n] / max_spread : MAXS;
         total = total + v->w_spread * sc;
       }
+      if (v->enable_img)
+        total = total + v->w_img * v->img[(size_t)p * N + n];
       if (total > best) { best = total; best_n = n; }
     }
     if (best_n < 0) continue;

@@ -121,6 +121,8 @@ def schedule_scan(
         )
     if cfg.enable_ports:
         xs["ports"] = arr.pod_ports
+    if cfg.enable_image and arr.image_score.shape[1] == arr.N:
+        xs["img"] = arr.image_score
 
     def norm_reverse(counts, feasible):
         mx = _rmax(jnp.where(feasible, counts, 0.0), axis_name)
@@ -160,6 +162,8 @@ def schedule_scan(
             )
         if cfg.enable_pairwise:
             total = total + cfg.spread_weight * norm_reverse(spread_raw, feasible)
+        if "img" in xs:  # ImageLocality: static, no per-pod normalization
+            total = total + cfg.image_weight * xs["img"]
         total = jnp.where(feasible, total, -jnp.inf)
         best = _rmax(total, axis_name)
         schedulable = (best > -jnp.inf) & valid

@@ -40,6 +40,7 @@ class ScoreConfig:
     node_affinity_weight: float = 2.0  # NodeAffinity (preferred terms)
     spread_weight: float = 2.0  # PodTopologySpread
     interpod_weight: float = 2.0  # InterPodAffinity
+    image_weight: float = 1.0  # ImageLocality
     score_resources: Tuple[int, ...] = (0, 1)  # indices into the R axis
     # Static specialization: when a snapshot carries no pairwise terms / host
     # ports, the jitted program omits that per-step state entirely (XLA sees
@@ -52,6 +53,7 @@ class ScoreConfig:
     # constant (or zero) per pod, which cannot change argmax.
     enable_taint_score: bool = True
     enable_node_pref: bool = True
+    enable_image: bool = True
 
 
 DEFAULT_SCORE_CONFIG = ScoreConfig()
@@ -73,12 +75,14 @@ def infer_score_config(arr, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG) -> ScoreCon
     has_ports = bool(np.any(arr.pod_ports) or np.any(arr.node_ports0))
     has_prefer_taints = bool(np.any(arr.node_taint_pref))
     has_node_pref = bool(np.any(arr.pod_pref_terms >= 0))
+    has_image = arr.image_score.shape[1] == arr.N and bool(np.any(arr.image_score))
     return dataclasses.replace(
         cfg,
         enable_pairwise=has_terms,
         enable_ports=has_ports,
         enable_taint_score=has_prefer_taints,
         enable_node_pref=has_node_pref,
+        enable_image=has_image,
     )
 
 

@@ -236,6 +236,13 @@ def _preferred_na_raw(pod, nd) -> f32:
     return raw
 
 
+def _image_score(pod: t.Pod, nd: t.Node) -> f32:
+    from ..api.snapshot import image_score_value
+
+    sum_mb = sum(nd.images[im] // (1024 * 1024) for im in pod.images if im in nd.images)
+    return image_score_value(np.float32(sum_mb))
+
+
 def oracle_schedule(
     snap: Snapshot,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
@@ -244,6 +251,9 @@ def oracle_schedule(
     """Sequentially schedule all pending pods; returns [(pod name, node name | None)]
     in activeQ order.  Pods whose uid is in `exclude` are skipped (used by the
     gang iteration — mirrors pod_valid masking on the device path)."""
+    from ..api.volumes import resolve_snapshot
+
+    snap = resolve_snapshot(snap)
     resources = snap_mod._resource_axis(snap)
     nodes = snap.nodes
     n = len(nodes)
@@ -349,6 +359,7 @@ def oracle_schedule(
                 + f32(cfg.taint_weight) * taint_sc
                 + f32(cfg.node_affinity_weight) * na_sc
                 + f32(cfg.spread_weight) * spread_sc
+                + f32(cfg.image_weight) * _image_score(pod, nodes[i])
             )
             if s > best_s:
                 best_s, best_i = s, i

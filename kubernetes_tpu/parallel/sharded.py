@@ -31,7 +31,7 @@ from ..ops.scores import ScoreConfig
 from .mesh import NODE_AXIS
 
 
-def _node_sharding_specs() -> ClusterArrays:
+def _node_sharding_specs(image_sharded: bool) -> ClusterArrays:
     """PartitionSpec pytree: [N, ...] / [*, N] arrays sharded on the node axis,
     pod-axis and vocab-table arrays replicated."""
     return ClusterArrays(
@@ -68,6 +68,7 @@ def _node_sharding_specs() -> ClusterArrays:
         node_ports0=P(NODE_AXIS, None),
         pod_group=P(),
         group_min=P(),
+        image_score=P(None, NODE_AXIS) if image_sharded else P(None, None),
     )
 
 
@@ -84,7 +85,7 @@ def sharded_schedule_batch(
     fn = jax.shard_map(
         partial(schedule_scan, cfg=cfg, axis_name=NODE_AXIS),
         mesh=mesh,
-        in_specs=(_node_sharding_specs(),),
+        in_specs=(_node_sharding_specs(arr.image_score.shape[1] == arr.N),),
         out_specs=(P(), P(NODE_AXIS, None)),
     )
     return jax.jit(fn)(arr)

@@ -25,10 +25,18 @@ class SchedulerCache:
         self.pods: Dict[str, t.Pod] = {}  # all pods by uid (pending + bound)
         self.assumed: Dict[str, str] = {}  # pod uid -> node (optimistic binds)
         self.pod_groups: Dict[str, t.PodGroup] = {}
+        self.pvs: Dict[str, t.PersistentVolume] = {}
+        self.pvcs: Dict[str, t.PersistentVolumeClaim] = {}
         store.watch(self._on_event)
 
     def _on_event(self, ev: Event) -> None:
         with self._lock:
+            if ev.obj_type == "PV":
+                self.pvs[ev.obj.name] = ev.obj
+                return
+            if ev.obj_type == "PVC":
+                self.pvcs[ev.obj.key] = ev.obj
+                return
             if ev.obj_type == "Node":
                 if ev.kind == "Deleted":
                     self.nodes.pop(ev.obj.name, None)
@@ -73,6 +81,8 @@ class SchedulerCache:
                 pending_pods=pending,
                 bound_pods=bound,
                 pod_groups=dict(self.pod_groups),
+                pvs=list(self.pvs.values()),
+                pvcs=dict(self.pvcs),
             )
 
     def node_infos(self, snap: Snapshot) -> List[NodeInfo]:

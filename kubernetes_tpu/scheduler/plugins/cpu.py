@@ -181,6 +181,18 @@ class PodTopologySpread(Plugin):
             scores[:] = f32(MAX_NODE_SCORE)
 
 
+class ImageLocality(Plugin):
+    """imagelocality/image_locality.go — Score (no NormalizeScore): summed MB
+    of the pod's images already on the node, threshold-scaled to [0,100]."""
+
+    name = "ImageLocality"
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        from ...oracle.reference import _image_score
+
+        return float(_image_score(pod, info.node))
+
+
 class InterPodAffinity(Plugin):
     """interpodaffinity/filtering.go — Filter (required affinity with first-pod
     waiver, own + symmetric anti-affinity)."""
@@ -272,16 +284,20 @@ def default_plugins(store, filter_fn=None) -> List[PluginWeight]:
     """The default profile — plugin set and weights mirroring
     default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
     TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2)."""
+    # Score-plugin order mirrors the kernels' float32 accumulation order
+    # (ops/assign.py: fit, balanced, taint, nodeAffinity, spread, image) so the
+    # CPU path's weighted sum is bit-identical to the TPU/native paths.
     pls = [
         PluginWeight(SchedulingGates()),
         PluginWeight(NodeName()),
         PluginWeight(NodePorts()),
-        PluginWeight(TaintToleration(), 3.0),
-        PluginWeight(NodeAffinity(), 2.0),
         PluginWeight(NodeResourcesFit(), 1.0),
         PluginWeight(NodeResourcesBalancedAllocation(), 1.0),
+        PluginWeight(TaintToleration(), 3.0),
+        PluginWeight(NodeAffinity(), 2.0),
         PluginWeight(PodTopologySpread(), 2.0),
         PluginWeight(InterPodAffinity(), 2.0),
+        PluginWeight(ImageLocality(), 1.0),
     ]
     if filter_fn is not None:
         pls.append(PluginWeight(DefaultPreemption(filter_fn, store)))
@@ -303,6 +319,7 @@ def default_registry() -> Dict[str, type]:
             NodeResourcesBalancedAllocation,
             PodTopologySpread,
             InterPodAffinity,
+            ImageLocality,
             DefaultPreemption,
             DefaultBinder,
         ]

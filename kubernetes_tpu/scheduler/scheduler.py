@@ -96,8 +96,12 @@ class Scheduler:
 
     # --- the CPU scheduling cycle (ScheduleOne) ---
     def schedule_one(self, pod: t.Pod) -> Optional[str]:
+        from ..api.volumes import resolve_snapshot
+
         t0 = time.perf_counter()
-        snap = self.cache.update_snapshot()
+        snap = resolve_snapshot(self.cache.update_snapshot())
+        # the popped pod may have gained folded volume/claim constraints
+        pod = next((q for q in snap.pending_pods if q.uid == pod.uid), pod)
         infos = self.cache.node_infos(snap)
         state = CycleState()
         state.data["scaled"] = ScaledState(snap, infos)
@@ -228,7 +232,9 @@ class Scheduler:
         min_bound_prio: Optional[int] = None
         for pod in failed:
             if state is None:
-                snap2 = self.cache.update_snapshot()
+                from ..api.volumes import resolve_snapshot
+
+                snap2 = resolve_snapshot(self.cache.update_snapshot())
                 infos = self.cache.node_infos(snap2)
                 state = CycleState()
                 state.data["scaled"] = ScaledState(snap2, infos)

@@ -90,6 +90,15 @@ class ClusterStore:
             if p is not None:
                 self._emit(Event("Deleted", "Pod", p, self._bump()))
 
+    # --- storage objects (PV/PVC — the volumebinding plugin's informers) ---
+    def add_pv(self, pv) -> None:
+        with self._lock:
+            self._emit(Event("Added", "PV", pv, self._bump()))
+
+    def add_pvc(self, pvc) -> None:
+        with self._lock:
+            self._emit(Event("Added", "PVC", pvc, self._bump()))
+
     def bind(self, pod_uid: str, node_name: str) -> None:
         """The pods/{name}/binding subresource (defaultbinder's POST)."""
         with self._lock:

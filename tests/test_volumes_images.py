@@ -1,0 +1,115 @@
+"""ImageLocality, volume family (VolumeZone/VolumeBinding-lite/
+NodeVolumeLimits), DRA-lite resource claims — across all execution paths."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.native import schedule_batch_native
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
+from kubernetes_tpu.oracle import oracle_schedule
+from helpers import GI, mk_node, mk_pod
+
+
+def run_all_paths(snap):
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    tpu = np.asarray(schedule_batch(arr, cfg)[0])
+    native = schedule_batch_native(arr, cfg)[0]
+    np.testing.assert_array_equal(native, tpu)
+    got = [
+        (meta.pod_names[k], meta.node_names[tpu[k]] if tpu[k] >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+    want = oracle_schedule(snap)
+    assert got == want, f"kernel={got} oracle={want}"
+    return dict(got)
+
+
+def test_image_locality_steers_to_cached_node():
+    img = "registry.io/model-server:v3"
+    nodes = [
+        mk_node("cold"),
+        mk_node("warm"),
+    ]
+    nodes[1].images[img] = 800 * 1024 * 1024  # 800 MB cached
+    got = run_all_paths(Snapshot(nodes=nodes, pending_pods=[mk_pod("p", images=(img,))]))
+    assert got["p"] == "warm"
+
+
+def test_image_below_threshold_is_ignored():
+    img = "tiny:latest"
+    nodes = [mk_node("a"), mk_node("b")]
+    nodes[1].images[img] = 10 * 1024 * 1024  # 10 MB < 23 MB threshold
+    got = run_all_paths(Snapshot(nodes=nodes, pending_pods=[mk_pod("p", images=(img,))]))
+    assert got["p"] == "a"  # tie -> lowest index
+
+
+def test_bound_pvc_zone_restricts_nodes():
+    pv = t.PersistentVolume(
+        name="pv-a", capacity=100 * GI, storage_class="std",
+        allowed_topology=((t.LABEL_ZONE, "a"),), claim_ref="default/data",
+    )
+    pvc = t.PersistentVolumeClaim(name="data", request=50 * GI, storage_class="std",
+                                  volume_name="pv-a")
+    nodes = [
+        mk_node("n-b", labels={t.LABEL_ZONE: "b"}),
+        mk_node("n-a", labels={t.LABEL_ZONE: "a"}),
+    ]
+    snap = Snapshot(nodes=nodes, pending_pods=[mk_pod("p", pvcs=("data",))],
+                    pvs=[pv], pvcs={pvc.key: pvc})
+    got = run_all_paths(snap)
+    assert got["p"] == "n-a"
+
+
+def test_unbound_immediate_claim_without_pv_is_unschedulable():
+    pvc = t.PersistentVolumeClaim(name="data", request=50 * GI, storage_class="fast")
+    snap = Snapshot(nodes=[mk_node("n")], pending_pods=[mk_pod("p", pvcs=("data",))],
+                    pvcs={pvc.key: pvc})
+    got = run_all_paths(snap)
+    assert got["p"] is None
+
+
+def test_wait_for_first_consumer_claim_does_not_block():
+    pvc = t.PersistentVolumeClaim(name="data", request=50 * GI, storage_class="fast",
+                                  wait_for_first_consumer=True)
+    snap = Snapshot(nodes=[mk_node("n")], pending_pods=[mk_pod("p", pvcs=("data",))],
+                    pvcs={pvc.key: pvc})
+    got = run_all_paths(snap)
+    assert got["p"] == "n"
+
+
+def test_volume_attach_limit_enforced():
+    nodes = [mk_node("small"), mk_node("big")]
+    nodes[0].volume_attach_limit = 1
+    nodes[1].volume_attach_limit = 8
+    pvcs = {}
+    pods = []
+    for i in range(3):
+        pvc = t.PersistentVolumeClaim(name=f"d{i}", request=GI, storage_class="std",
+                                      wait_for_first_consumer=True)
+        pvcs[pvc.key] = pvc
+        pods.append(mk_pod(f"p{i}", pvcs=(f"d{i}",)))
+    got = run_all_paths(Snapshot(nodes=nodes, pending_pods=pods, pvcs=pvcs))
+    # node "small" accepts at most 1 attached volume
+    assert sum(1 for v in got.values() if v == "small") <= 1
+    assert all(v is not None for v in got.values())
+
+
+def test_resource_claims_consume_device_class():
+    nodes = [mk_node("accel"), mk_node("plain")]
+    nodes[0].allocatable["claim/tpu-v5e"] = 2
+    pods = [
+        mk_pod(f"p{i}", resource_claims=(t.ResourceClaimRef(device_class="tpu-v5e"),))
+        for i in range(3)
+    ]
+    got = run_all_paths(Snapshot(nodes=nodes, pending_pods=pods))
+    assert sum(1 for v in got.values() if v == "accel") == 2
+    assert sum(1 for v in got.values() if v is None) == 1  # plain lacks the class
+
+
+def test_missing_pvc_leaves_pod_pending():
+    snap = Snapshot(nodes=[mk_node("n")], pending_pods=[mk_pod("p", pvcs=("ghost",))])
+    got = run_all_paths(snap)
+    assert got["p"] is None
