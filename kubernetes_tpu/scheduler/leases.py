@@ -1,0 +1,150 @@
+"""Leases + leader election + node lifecycle — the failure-detection stack.
+
+reference (SURVEY.md §5):
+  - kubelet heartbeats as coordination.k8s.io Lease objects
+    (pkg/kubelet/nodelease); here: Lease records renewed against the store
+  - pkg/controller/nodelifecycle/node_lifecycle_controller.go: nodes whose
+    lease goes stale past the 40 s grace become NotReady, get the
+    node.kubernetes.io/unreachable:NoExecute taint, and their pods are
+    evicted after tolerationSeconds (default 300 s)
+  - client-go tools/leaderelection: active-passive HA via lease CAS
+    (15 s lease / 10 s renew / 2 s retry)
+
+All clocks injectable (FakeClock) for deterministic tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+from ..api import types as t
+from .queue import Clock
+from .store import ClusterStore
+
+UNREACHABLE_TAINT_KEY = "node.kubernetes.io/unreachable"
+NOT_READY_TAINT_KEY = "node.kubernetes.io/not-ready"
+DEFAULT_GRACE_S = 40.0
+DEFAULT_EVICTION_S = 300.0
+
+LEASE_DURATION_S = 15.0
+RENEW_DEADLINE_S = 10.0
+RETRY_PERIOD_S = 2.0
+
+
+@dataclass
+class Lease:
+    holder: str
+    renew_time: float
+    resource_version: int = 0
+
+
+class LeaseStore:
+    """coordination.k8s.io-style lease table with compare-and-swap semantics
+    (the optimistic-concurrency primitive every reference component HA story
+    rests on)."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._leases: Dict[str, Lease] = {}
+
+    def get(self, name: str) -> Optional[Lease]:
+        return self._leases.get(name)
+
+    def try_acquire_or_renew(self, name: str, holder: str, duration_s: float) -> bool:
+        """CAS: acquire if absent/expired/ours; fail if another live holder."""
+        now = self.clock.now()
+        cur = self._leases.get(name)
+        if cur is not None and cur.holder != holder and now < cur.renew_time + duration_s:
+            return False
+        rv = (cur.resource_version + 1) if cur else 1
+        self._leases[name] = Lease(holder=holder, renew_time=now, resource_version=rv)
+        return True
+
+    def renew_node_heartbeat(self, node_name: str) -> None:
+        self.try_acquire_or_renew(f"node/{node_name}", node_name, float("inf"))
+
+
+class LeaderElector:
+    """tools/leaderelection — LeaderElector.Run reduced to tick()."""
+
+    def __init__(self, leases: LeaseStore, identity: str, name: str = "kube-scheduler"):
+        self.leases = leases
+        self.identity = identity
+        self.name = name
+
+    def tick(self) -> bool:
+        """Attempt acquire/renew; returns True while this identity leads."""
+        return self.leases.try_acquire_or_renew(self.name, self.identity, LEASE_DURATION_S)
+
+    @property
+    def is_leader(self) -> bool:
+        cur = self.leases.get(self.name)
+        return cur is not None and cur.holder == self.identity
+
+
+class NodeLifecycleController:
+    """node_lifecycle_controller.go: stale heartbeat -> unreachable taint ->
+    taint-based eviction after tolerationSeconds."""
+
+    def __init__(
+        self,
+        store: ClusterStore,
+        leases: LeaseStore,
+        grace_s: float = DEFAULT_GRACE_S,
+        eviction_s: float = DEFAULT_EVICTION_S,
+    ):
+        self.store = store
+        self.leases = leases
+        self.grace_s = grace_s
+        self.eviction_s = eviction_s
+        self._tainted_at: Dict[str, float] = {}
+
+    def tick(self) -> List[str]:
+        """Reconcile once; returns uids of pods evicted this pass."""
+        now = self.leases.clock.now()
+        evicted: List[str] = []
+        for name, node in list(self.store.nodes.items()):
+            lease = self.leases.get(f"node/{name}")
+            stale = lease is None or now > lease.renew_time + self.grace_s
+            has_taint = any(tn.key == UNREACHABLE_TAINT_KEY for tn in node.taints)
+            if stale and not has_taint:
+                node2 = _copy_node(node)
+                node2.taints = tuple(node.taints) + (
+                    t.Taint(key=UNREACHABLE_TAINT_KEY, effect=t.NO_EXECUTE),
+                )
+                self.store.update_node(node2)
+                self._tainted_at[name] = now
+            elif not stale and has_taint:
+                node2 = _copy_node(node)
+                node2.taints = tuple(
+                    tn for tn in node.taints if tn.key != UNREACHABLE_TAINT_KEY
+                )
+                self.store.update_node(node2)
+                self._tainted_at.pop(name, None)
+        # taint-based eviction (NoExecute + tolerationSeconds)
+        for uid, pod in list(self.store.pods.items()):
+            if not pod.node_name:
+                continue
+            tainted = self._tainted_at.get(pod.node_name)
+            if tainted is None:
+                continue
+            deadline = tainted + self._toleration_window(pod)
+            if now >= deadline:
+                self.store.delete_pod(uid)
+                evicted.append(uid)
+        return evicted
+
+    def _toleration_window(self, pod: t.Pod) -> float:
+        for tol in pod.tolerations:
+            if tol.key in (UNREACHABLE_TAINT_KEY, "") and tol.effect in (t.NO_EXECUTE, ""):
+                if tol.toleration_seconds is None:
+                    return float("inf")  # tolerates forever
+                return float(tol.toleration_seconds)
+        return self.eviction_s  # default added by admission in the reference
+
+
+def _copy_node(node: t.Node) -> t.Node:
+    import copy
+
+    return copy.copy(node)

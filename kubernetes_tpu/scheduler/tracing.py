@@ -1,0 +1,42 @@
+"""Phase tracing — spans over the scheduling cycle.
+
+reference: component-base/tracing (OpenTelemetry spans in apiserver/kubelet;
+SURVEY.md §5 notes the scheduler itself is metrics-first with per-extension-
+point histograms).  Here: lightweight spans feeding the Metrics histograms
+(<phase>_duration_seconds), plus an optional jax.profiler bridge so a bench
+run can emit a real XLA trace for profile-guided work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from .metrics import Metrics
+
+
+class Tracer:
+    def __init__(self, metrics: Optional[Metrics] = None):
+        self.metrics = metrics or Metrics()
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.metrics.observe(f"{name}_duration_seconds", time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler trace (TensorBoard-compatible) around a region — the
+    jax-native analog of the reference's pprof endpoints."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

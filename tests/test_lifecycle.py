@@ -1,0 +1,120 @@
+"""Failure detection / HA / checkpoint: node lifecycle, leader election,
+assumed-pod checkpoint, crash-only recovery (SURVEY.md §5)."""
+
+import os
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.checkpoint import CheckpointManager, load_assumed, save_assumed
+from kubernetes_tpu.scheduler.leases import (
+    LeaderElector,
+    LeaseStore,
+    NodeLifecycleController,
+    UNREACHABLE_TAINT_KEY,
+)
+from kubernetes_tpu.scheduler.queue import FakeClock
+from helpers import mk_node, mk_pod
+
+
+def test_stale_lease_taints_then_evicts():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    leases = LeaseStore(clock)
+    ctl = NodeLifecycleController(store, leases, grace_s=40, eviction_s=300)
+    leases.renew_node_heartbeat("n0")
+    store.add_pod(mk_pod("p", node_name="n0"))
+
+    clock.step(10)
+    assert ctl.tick() == []
+    assert store.nodes["n0"].taints == ()
+    # heartbeat stops; grace passes
+    clock.step(50)
+    assert ctl.tick() == []  # tainted, not yet evicted
+    assert any(tn.key == UNREACHABLE_TAINT_KEY for tn in store.nodes["n0"].taints)
+    clock.step(299)
+    assert ctl.tick() == []
+    clock.step(2)
+    assert ctl.tick() == ["default/p"]
+    assert "default/p" not in store.pods
+
+
+def test_toleration_seconds_respected_and_recovery_untaints():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    leases = LeaseStore(clock)
+    ctl = NodeLifecycleController(store, leases)
+    tol = (t.Toleration(key=UNREACHABLE_TAINT_KEY, operator=t.OP_EXISTS,
+                        effect=t.NO_EXECUTE, toleration_seconds=30),)
+    store.add_pod(mk_pod("tolerant", node_name="n0", tolerations=tol))
+    clock.step(100)  # no heartbeat at all
+    ctl.tick()
+    clock.step(20)
+    assert ctl.tick() == []  # within 30s window
+    # node comes back: taint removed, pod survives
+    leases.renew_node_heartbeat("n0")
+    assert ctl.tick() == []
+    assert store.nodes["n0"].taints == ()
+    clock.step(1000)
+    leases.renew_node_heartbeat("n0")
+    assert ctl.tick() == []
+
+
+def test_scheduler_avoids_unreachable_node():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("dead"))
+    store.add_node(mk_node("alive"))
+    leases = LeaseStore(clock)
+    ctl = NodeLifecycleController(store, leases)
+    clock.step(100)
+    leases.renew_node_heartbeat("alive")  # alive keeps heartbeating; dead doesn't
+    ctl.tick()  # "dead" gets the NoExecute taint
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"), clock=clock)
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    assert store.pods["default/p"].node_name == "alive"
+
+
+def test_leader_election_single_active_and_failover():
+    clock = FakeClock()
+    leases = LeaseStore(clock)
+    a = LeaderElector(leases, "sched-a")
+    b = LeaderElector(leases, "sched-b")
+    assert a.tick() and not b.tick()
+    # a renews within the deadline: b stays passive
+    clock.step(10)
+    assert a.tick() and not b.tick()
+    # a dies; lease expires after 15 s -> b takes over
+    clock.step(16)
+    assert b.tick()
+    assert b.is_leader and not a.is_leader
+
+
+def test_checkpoint_roundtrip_and_corruption(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    save_assumed(cm, {"default/p": "n0"})
+    assert load_assumed(cm) == {"default/p": "n0"}
+    # corruption -> discarded, crash-only rebuild
+    path = os.path.join(str(tmp_path), "assumed_pods.json")
+    with open(path, "w") as f:
+        f.write('{"checksum": "bad", "data": {"assumed": {"x": "y"}}}')
+    assert load_assumed(cm) == {}
+
+
+def test_crash_only_recovery_from_watch():
+    # a fresh scheduler on the same store rebuilds all state via LIST+WATCH
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    s1 = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(mk_pod("p0"))
+    s1.run_until_idle()
+    # s1 "crashes"; s2 attaches and schedules new work with full state
+    s2 = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(mk_pod("p1", cpu=100))
+    s2.run_until_idle()
+    assert store.pods["default/p0"].node_name == "n0"
+    assert store.pods["default/p1"].node_name == "n0"
+    snap = s2.cache.update_snapshot()
+    assert len(snap.bound_pods) == 2
