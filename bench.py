@@ -1,14 +1,15 @@
 #!/usr/bin/env python
-"""Headline benchmark: batched TPU scheduling throughput.
+"""Headline benchmark: the BASELINE.md north star.
 
-scheduler_perf-analog workload (BASELINE.md config 2 shape: NodeResourcesFit-only,
-homogeneous requests): 5000 pending pods vs 1000 nodes, full filter+score+commit
-with exact sequential semantics.  Metric: pods scheduled per second, steady-state
-(post-compile), best of 3.
+50,000 pending pods vs 20,000 simulated nodes (heterogeneous capacities,
+extended resources, taints/tolerations — BASELINE config-4 shape at north-star
+scale), full filter+score+sequential-commit with exact one-pod-at-a-time
+semantics.  Metric: pods scheduled per second, steady-state (post-compile),
+best of 3.
 
 vs_baseline: the reference default scheduler's scheduler_perf throughput on
-simple profiles is O(100-300) pods/s (BASELINE.md "typical" row; no published
-table exists for the fork) — vs_baseline = value / 300 (the generous end).
+simple profiles is O(100-300) pods/s (BASELINE.md; no published table exists
+for the fork) — vs_baseline = pods_per_sec / 300 (the generous end).
 
 Prints exactly one JSON line on stdout.
 """
@@ -17,8 +18,8 @@ import json
 import sys
 import time
 
-N_NODES = 1000
-N_PODS = 5000
+N_NODES = 20_000
+N_PODS = 50_000
 BASELINE_PODS_PER_SEC = 300.0
 
 
@@ -26,11 +27,11 @@ def main() -> None:
     import jax
 
     from kubernetes_tpu.api.snapshot import encode_snapshot
-    from kubernetes_tpu.bench.workloads import basic
+    from kubernetes_tpu.bench.workloads import heterogeneous
     from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
 
     print(f"devices: {jax.devices()}", file=sys.stderr)
-    snap = basic(N_NODES, N_PODS, seed=0)
+    snap = heterogeneous(N_NODES, N_PODS, seed=0)
     t0 = time.perf_counter()
     arr, meta = encode_snapshot(snap)
     cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
@@ -48,7 +49,7 @@ def main() -> None:
     print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     best = float("inf")
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
         choices = np.asarray(schedule_batch(arr, cfg)[0])
         best = min(best, time.perf_counter() - t0)
@@ -61,7 +62,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "scheduling_throughput_5kpods_1knodes",
+                "metric": "north_star_50kpods_20knodes_throughput",
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
