@@ -136,14 +136,24 @@ def build_pairwise(
     # terms constrain incoming pods symmetrically) ----
     pod_aff: List[List[int]] = []
     pod_anti: List[List[int]] = []
+    pod_pref: List[List[Tuple[int, float]]] = []  # (term, signed weight)
     pod_spread: List[List[Tuple[int, int, int]]] = []  # (term, maxSkew, mode)
     for pod in pending:
         aff_ids, anti_ids, spread_ids = [], [], []
+        pref_ids: List[Tuple[int, float]] = []
         if pod.affinity:
             for term in pod.affinity.required_pod_affinity:
                 aff_ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
             for term in pod.affinity.required_pod_anti_affinity:
                 anti_ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
+            for wt in pod.affinity.preferred_pod_affinity:
+                pref_ids.append(
+                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), float(wt.weight))
+                )
+            for wt in pod.affinity.preferred_pod_anti_affinity:
+                pref_ids.append(
+                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), -float(wt.weight))
+                )
         for c in pod.topology_spread:
             spread_ids.append(
                 (
@@ -154,14 +164,26 @@ def build_pairwise(
             )
         pod_aff.append(aff_ids)
         pod_anti.append(anti_ids)
+        pod_pref.append(pref_ids)
         pod_spread.append(spread_ids)
     bound_anti: List[List[int]] = []
+    bound_pref: List[List[Tuple[int, float]]] = []
     for pod in bound:
         ids = []
+        pref_ids = []
         if pod.affinity:
             for term in pod.affinity.required_pod_anti_affinity:
                 ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
+            for wt in pod.affinity.preferred_pod_affinity:
+                pref_ids.append(
+                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), float(wt.weight))
+                )
+            for wt in pod.affinity.preferred_pod_anti_affinity:
+                pref_ids.append(
+                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), -float(wt.weight))
+                )
         bound_anti.append(ids)
+        bound_pref.append(pref_ids)
 
     # ---- topology keys + domains over the node set ----
     for tk in [tm.topology_key for tm in voc.terms.items]:
@@ -203,13 +225,25 @@ def build_pairwise(
             continue
         for ti in ids:
             anti_counts0[ti, node_dom[term_key[ti], ni]] += 1.0
+    # weight-weighted counts of existing pods OWNING preferred terms, per their
+    # domain (the symmetric half of preferred inter-pod affinity scoring)
+    pref_own0 = np.zeros((T, D + 1), dtype=np.float32)
+    for pod, prefs in zip(bound, bound_pref):
+        ni = node_index.get(pod.node_name)
+        if ni is None:
+            continue
+        for ti, w in prefs:
+            pref_own0[ti, node_dom[term_key[ti], ni]] += np.float32(w)
 
     # ---- per-pod term id arrays (padded) ----
     A1 = max(1, max((len(x) for x in pod_aff), default=1))
     A2 = max(1, max((len(x) for x in pod_anti), default=1))
+    B = max(1, max((len(x) for x in pod_pref), default=1))
     C = max(1, max((len(x) for x in pod_spread), default=1))
     pod_aff_terms = np.full((P, A1), -1, dtype=np.int32)
     pod_anti_terms = np.full((P, A2), -1, dtype=np.int32)
+    pod_pref_aff_terms = np.full((P, B), -1, dtype=np.int32)
+    pod_pref_aff_w = np.zeros((P, B), dtype=np.float32)
     pod_spread_terms = np.full((P, C), -1, dtype=np.int32)
     pod_spread_maxskew = np.zeros((P, C), dtype=np.int32)
     pod_spread_hard = np.zeros((P, C), dtype=bool)
@@ -218,6 +252,9 @@ def build_pairwise(
             pod_aff_terms[pi, a] = ti
         for a, ti in enumerate(pod_anti[pi]):
             pod_anti_terms[pi, a] = ti
+        for a, (ti, w) in enumerate(pod_pref[pi]):
+            pod_pref_aff_terms[pi, a] = ti
+            pod_pref_aff_w[pi, a] = np.float32(w)
         for c, (ti, skew, mode) in enumerate(pod_spread[pi]):
             pod_spread_terms[pi, c] = ti
             pod_spread_maxskew[pi, c] = skew
@@ -248,6 +285,9 @@ def build_pairwise(
         anti_counts0=anti_counts0,
         pod_aff_terms=pod_aff_terms,
         pod_anti_terms=pod_anti_terms,
+        pod_pref_aff_terms=pod_pref_aff_terms,
+        pod_pref_aff_w=pod_pref_aff_w,
+        pref_own0=pref_own0,
         pod_spread_terms=pod_spread_terms,
         pod_spread_maxskew=pod_spread_maxskew,
         pod_spread_hard=pod_spread_hard,

@@ -129,6 +129,9 @@ class ClusterArrays:
     anti_counts0: np.ndarray  # f32[T, D+1] bound pods OWNING anti term t
     pod_aff_terms: np.ndarray  # i32[P, A1] required pod-affinity term ids
     pod_anti_terms: np.ndarray  # i32[P, A2] required pod-anti-affinity term ids
+    pod_pref_aff_terms: np.ndarray  # i32[P, B] preferred (anti-)affinity term ids
+    pod_pref_aff_w: np.ndarray  # f32[P, B] signed weights (anti = negative)
+    pref_own0: np.ndarray  # f32[T, D+1] weight-sums of bound pods owning pref terms
     pod_spread_terms: np.ndarray  # i32[P, C] topology-spread term ids
     pod_spread_maxskew: np.ndarray  # i32[P, C]
     pod_spread_hard: np.ndarray  # bool[P, C] DoNotSchedule?

@@ -32,7 +32,7 @@ class _View(ctypes.Structure):
         ("N", ctypes.c_int32), ("P", ctypes.c_int32), ("R", ctypes.c_int32),
         ("T", ctypes.c_int32), ("K", ctypes.c_int32), ("D1", ctypes.c_int32),
         ("C", ctypes.c_int32), ("A1", ctypes.c_int32), ("A2", ctypes.c_int32),
-        ("PT", ctypes.c_int32),
+        ("PT", ctypes.c_int32), ("B", ctypes.c_int32),
         ("alloc", ctypes.c_void_p), ("used", ctypes.c_void_p),
         ("node_dom", ctypes.c_void_p), ("ports_used", ctypes.c_void_p),
         ("req", ctypes.c_void_p), ("sf", ctypes.c_void_p),
@@ -44,13 +44,16 @@ class _View(ctypes.Structure):
         ("aff_terms", ctypes.c_void_p), ("anti_terms", ctypes.c_void_p),
         ("spread_terms", ctypes.c_void_p), ("spread_skew", ctypes.c_void_p),
         ("spread_hard", ctypes.c_void_p), ("img", ctypes.c_void_p),
+        ("pref_t", ctypes.c_void_p), ("pref_w", ctypes.c_void_p),
+        ("pref_own", ctypes.c_void_p),
         ("w_fit", ctypes.c_float), ("w_bal", ctypes.c_float),
         ("w_taint", ctypes.c_float), ("w_na", ctypes.c_float),
         ("w_spread", ctypes.c_float), ("w_img", ctypes.c_float),
+        ("w_interpod", ctypes.c_float),
         ("r0", ctypes.c_int32), ("r1", ctypes.c_int32),
         ("enable_pairwise", ctypes.c_uint8), ("enable_ports", ctypes.c_uint8),
         ("enable_taint", ctypes.c_uint8), ("enable_na", ctypes.c_uint8),
-        ("enable_img", ctypes.c_uint8),
+        ("enable_img", ctypes.c_uint8), ("enable_ip", ctypes.c_uint8),
     ]
 
 
@@ -97,6 +100,7 @@ def schedule_batch_native(
     used = np.ascontiguousarray(arr.node_used.astype(np.int32)).copy()
     counts = np.ascontiguousarray(arr.term_counts0.astype(np.float32)).copy()
     anti = np.ascontiguousarray(arr.anti_counts0.astype(np.float32)).copy()
+    pref_own = np.ascontiguousarray(arr.pref_own0.astype(np.float32)).copy()
     ports_used = np.ascontiguousarray(arr.node_ports0.astype(np.uint8)).copy()
     choices = np.full(arr.P, -1, dtype=np.int32)
 
@@ -109,6 +113,7 @@ def schedule_batch_native(
         aff=c(arr.pod_aff_terms, np.int32), anti_t=c(arr.pod_anti_terms, np.int32),
         st=c(arr.pod_spread_terms, np.int32), sk=c(arr.pod_spread_maxskew, np.int32),
         sh=c(arr.pod_spread_hard, np.uint8), pp=c(arr.pod_ports, np.uint8),
+        pt=c(arr.pod_pref_aff_terms, np.int32), pw=c(arr.pod_pref_aff_w, np.float32),
     )
     view = _View(
         N=arr.N, P=arr.P, R=arr.R,
@@ -116,6 +121,7 @@ def schedule_batch_native(
         D1=arr.term_counts0.shape[1],
         C=arr.pod_spread_terms.shape[1], A1=arr.pod_aff_terms.shape[1],
         A2=arr.pod_anti_terms.shape[1], PT=arr.pod_ports.shape[1],
+        B=arr.pod_pref_aff_terms.shape[1],
         alloc=_ptr(keep["alloc"]), used=_ptr(used),
         node_dom=_ptr(keep["node_dom"]), ports_used=_ptr(ports_used),
         req=_ptr(keep["req"]), sf=_ptr(keep["sf"]),
@@ -127,13 +133,16 @@ def schedule_batch_native(
         aff_terms=_ptr(keep["aff"]), anti_terms=_ptr(keep["anti_t"]),
         spread_terms=_ptr(keep["st"]), spread_skew=_ptr(keep["sk"]),
         spread_hard=_ptr(keep["sh"]), img=_ptr(img),
+        pref_t=_ptr(keep["pt"]), pref_w=_ptr(keep["pw"]), pref_own=_ptr(pref_own),
         w_fit=cfg.fit_weight, w_bal=cfg.balanced_weight,
         w_taint=cfg.taint_weight, w_na=cfg.node_affinity_weight,
         w_spread=cfg.spread_weight, w_img=cfg.image_weight,
+        w_interpod=cfg.interpod_weight,
         r0=cfg.score_resources[0], r1=cfg.score_resources[1],
         enable_pairwise=int(cfg.enable_pairwise), enable_ports=int(cfg.enable_ports),
         enable_taint=int(cfg.enable_taint_score), enable_na=int(cfg.enable_node_pref),
         enable_img=int(enable_img),
+        enable_ip=int(cfg.enable_pairwise and cfg.enable_interpod_score),
     )
     rc = lib.schedule_native(ctypes.byref(view), _ptr(choices))
     if rc != 0:

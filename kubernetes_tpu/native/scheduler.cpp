@@ -26,7 +26,7 @@ const float MAXS = 100.0f;
 
 struct View {
   // dims
-  int32_t N, P, R, T, K, D1, C, A1, A2, PT;
+  int32_t N, P, R, T, K, D1, C, A1, A2, PT, B;
   // nodes
   const int32_t *alloc;     // [N,R]
   int32_t *used;            // [N,R] in/out
@@ -51,10 +51,14 @@ struct View {
   const int32_t *spread_skew;  // [P,C]
   const uint8_t *spread_hard;  // [P,C]
   const float *img;            // [P,N] ImageLocality static scores or null
+  const int32_t *pref_t;       // [P,B] preferred interpod term ids or null
+  const float *pref_w;         // [P,B] signed weights
+  float *pref_own;             // [T,D1] in/out
   // config
-  float w_fit, w_bal, w_taint, w_na, w_spread, w_img;
+  float w_fit, w_bal, w_taint, w_na, w_spread, w_img, w_interpod;
   int32_t r0, r1;  // scored resource indices
-  uint8_t enable_pairwise, enable_ports, enable_taint, enable_na, enable_img;
+  uint8_t enable_pairwise, enable_ports, enable_taint, enable_na, enable_img,
+      enable_ip;
 };
 
 inline float least_alloc(const int32_t *alloc_row, const int64_t *req_tot,
@@ -102,6 +106,9 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
   std::vector<float> spread_raw(v->enable_pairwise ? N : 0);
   std::vector<float> agg;  // [K, D1] per-pod symmetric-anti aggregation
   if (v->enable_pairwise) agg.resize((size_t)K * D1);
+  std::vector<float> ip_raw(v->enable_ip ? N : 0);
+  std::vector<float> agg_pref;  // [K, D1] symmetric preferred aggregation
+  if (v->enable_ip) agg_pref.resize((size_t)K * D1);
 
   for (int p = 0; p < P; p++) {
     choices[p] = -1;
@@ -146,11 +153,23 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
         const float *src = v->anti_counts + (size_t)t * D1;
         for (int d = 0; d < D; d++) dst[d] += m * src[d];  // column D excluded
       }
+      if (v->enable_ip) {
+        std::memset(agg_pref.data(), 0, agg_pref.size() * sizeof(float));
+        for (int t = 0; t < T; t++) {
+          float m = v->m_pend[(size_t)t * P + p];
+          if (m == 0.f) continue;
+          float *dst = agg_pref.data() + (size_t)v->term_key[t] * D1;
+          const float *src = v->pref_own + (size_t)t * D1;
+          for (int d = 0; d < D; d++) dst[d] += m * src[d];
+        }
+      }
     }
     bool waiver = has_aff && total_any == 0.f && self_all;
 
     // ---- pass A: feasibility (+ raw spread score), maxima over feasible ----
     float max_pref = 0.f, max_na = 0.f, max_spread = 0.f;
+    float ip_max = -std::numeric_limits<float>::infinity();
+    float ip_min = std::numeric_limits<float>::infinity();
     bool any_feasible = false;
     for (int n = 0; n < N; n++) {
       bool ok = sf[n];
@@ -213,6 +232,22 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
         }
         spread_raw[n] = raw;
       }
+      if (v->enable_ip) {
+        // own preferred terms + existing pods' preferred terms toward p
+        float r2 = 0.f;
+        for (int b = 0; b < v->B; b++) {
+          int t = v->pref_t[(size_t)p * v->B + b];
+          if (t < 0) continue;
+          int d = v->node_dom[(size_t)v->term_key[t] * N + n];
+          if (d < D)
+            r2 += v->pref_w[(size_t)p * v->B + b] * v->counts[(size_t)t * D1 + d];
+        }
+        for (int k = 0; k < K; k++) {
+          int d = v->node_dom[(size_t)k * N + n];
+          if (d < D) r2 += agg_pref[(size_t)k * D1 + d];
+        }
+        ip_raw[n] = r2;
+      }
       feasible[n] = ok;
       if (ok) {
         any_feasible = true;
@@ -225,6 +260,10 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
           if (c > max_na) max_na = c;
         }
         if (v->enable_pairwise && raw > max_spread) max_spread = raw;
+        if (v->enable_ip) {
+          if (ip_raw[n] > ip_max) ip_max = ip_raw[n];
+          if (ip_raw[n] < ip_min) ip_min = ip_raw[n];
+        }
       }
     }
     if (!any_feasible) continue;
@@ -252,6 +291,12 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
       if (v->enable_pairwise) {
         float sc = max_spread > 0.f ? MAXS - MAXS * spread_raw[n] / max_spread : MAXS;
         total = total + v->w_spread * sc;
+      }
+      if (v->enable_ip) {
+        float sc = ip_max > ip_min
+                       ? MAXS * (ip_raw[n] - ip_min) / (ip_max - ip_min)
+                       : 0.0f;
+        total = total + v->w_interpod * sc;
       }
       if (v->enable_img)
         total = total + v->w_img * v->img[(size_t)p * N + n];
@@ -281,6 +326,14 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
         if (t < 0) continue;
         int d = v->node_dom[(size_t)v->term_key[t] * N + best_n];
         v->anti_counts[(size_t)t * D1 + d] += 1.0f;
+      }
+      if (v->enable_ip) {
+        for (int b = 0; b < v->B; b++) {
+          int t = v->pref_t[(size_t)p * v->B + b];
+          if (t < 0) continue;
+          int d = v->node_dom[(size_t)v->term_key[t] * N + best_n];
+          v->pref_own[(size_t)t * D1 + d] += v->pref_w[(size_t)p * v->B + b];
+        }
       }
     }
   }

@@ -119,6 +119,9 @@ def schedule_scan(
             spread_hard=arr.pod_spread_hard,
             m=arr.m_pend.T,
         )
+        if cfg.enable_interpod_score:
+            xs["pref_t"] = arr.pod_pref_aff_terms
+            xs["pref_w"] = arr.pod_pref_aff_w
     if cfg.enable_ports:
         xs["ports"] = arr.pod_ports
     if cfg.enable_image and arr.image_score.shape[1] == arr.N:
@@ -129,7 +132,7 @@ def schedule_scan(
         return jnp.where(mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / mx, MAX_NODE_SCORE)
 
     def step(state, xs):
-        used, counts, anti_counts, ports_used = state
+        used, counts, anti_counts, pref_own, ports_used = state
         req, feas_row, valid = xs["req"], xs["sf"], xs["valid"]
 
         feasible = feas_row & filters.fit_ok(req, used, n_alloc)
@@ -162,6 +165,18 @@ def schedule_scan(
             )
         if cfg.enable_pairwise:
             total = total + cfg.spread_weight * norm_reverse(spread_raw, feasible)
+        if cfg.enable_pairwise and cfg.enable_interpod_score:
+            # preferred inter-pod affinity: min/max normalization over feasible
+            # (interpodaffinity/scoring.go — NormalizeScore)
+            ip_raw = pairwise.interpod_pref_raw(
+                counts, pref_own, node_dom, term_key, xs["pref_t"], xs["pref_w"], xs["m"]
+            )
+            mx = _rmax(jnp.where(feasible, ip_raw, -jnp.inf), axis_name)
+            mn = -_rmax(jnp.where(feasible, -ip_raw, -jnp.inf), axis_name)
+            ip_sc = jnp.where(
+                mx > mn, MAX_NODE_SCORE * (ip_raw - mn) / (mx - mn), 0.0
+            )
+            total = total + cfg.interpod_weight * ip_sc
         if "img" in xs:  # ImageLocality: static, no per-pod normalization
             total = total + cfg.image_weight * xs["img"]
         total = jnp.where(feasible, total, -jnp.inf)
@@ -183,12 +198,21 @@ def schedule_scan(
             counts, anti_counts = pairwise.commit_counts(
                 counts, anti_counts, choice, dom_col, xs["m"], xs["anti"]
             )
+            if cfg.enable_interpod_score:
+                # the committed pod's own preferred terms join the symmetric
+                # half for later pods
+                bids = jnp.maximum(xs["pref_t"], 0)
+                bw = jnp.where((xs["pref_t"] >= 0) & (choice >= 0), xs["pref_w"], 0.0)
+                pref_own = pref_own.at[bids, dom_col[bids]].add(bw)
         if cfg.enable_ports:
             ports_used = ports_used | (placed & xs["ports"][None, :])
-        return (used, counts, anti_counts, ports_used), choice
+        return (used, counts, anti_counts, pref_own, ports_used), choice
 
-    state0 = (arr.node_used, arr.term_counts0, arr.anti_counts0, arr.node_ports0)
-    (used_final, _, _, _), choices = lax.scan(step, state0, xs)
+    state0 = (
+        arr.node_used, arr.term_counts0, arr.anti_counts0, arr.pref_own0,
+        arr.node_ports0,
+    )
+    (used_final, _, _, _, _), choices = lax.scan(step, state0, xs)
     return choices, used_final
 
 

@@ -85,6 +85,30 @@ def interpod_required_ok(
     return aff_ok & anti_ok & (blocked == 0)
 
 
+def interpod_pref_raw(
+    counts, pref_own, node_dom, term_key, pref_terms, pref_w, m_pend_col
+):
+    """f32[N]: preferred inter-pod affinity raw score (interpodaffinity/
+    scoring.go — processExistingPod, both directions):
+
+      own half:       sum_b w_b * counts[t_b, dom(key_b, n)]   (anti: w<0)
+      symmetric half: sum_t m[t, p] * pref_own[t, dom(key_t, n)]
+
+    (column D — keyless nodes/pods — excluded on both halves.)"""
+    D = counts.shape[1] - 1
+    # own preferred terms
+    cnt, has_key, valid = _term_rows(counts, node_dom, term_key, pref_terms)
+    w = jnp.where(valid, pref_w, 0.0)[:, None]
+    own = (jnp.where(has_key, cnt, 0.0) * w).sum(axis=0)
+    # existing pods' preferred terms toward this pod, aggregated per key
+    K = node_dom.shape[0]
+    contrib = m_pend_col[:, None] * pref_own[:, :D]  # [T, D]
+    per_key = jax.ops.segment_sum(contrib, term_key, num_segments=K)
+    per_key = jnp.concatenate([per_key, jnp.zeros((K, 1), per_key.dtype)], axis=1)
+    sym = jnp.take_along_axis(per_key, node_dom, axis=1).sum(axis=0)
+    return own + sym
+
+
 def ports_ok(ports_used, pod_ports_row):
     """-> ok[N]: no hostPort conflict (nodeports/node_ports.go — Fits)."""
     return ~jnp.any(ports_used & pod_ports_row[None, :], axis=1)

@@ -54,6 +54,7 @@ class ScoreConfig:
     enable_taint_score: bool = True
     enable_node_pref: bool = True
     enable_image: bool = True
+    enable_interpod_score: bool = True  # preferred (soft) inter-pod affinity
 
 
 DEFAULT_SCORE_CONFIG = ScoreConfig()
@@ -76,13 +77,17 @@ def infer_score_config(arr, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG) -> ScoreCon
     has_prefer_taints = bool(np.any(arr.node_taint_pref))
     has_node_pref = bool(np.any(arr.pod_pref_terms >= 0))
     has_image = arr.image_score.shape[1] == arr.N and bool(np.any(arr.image_score))
+    has_interpod_pref = bool(
+        np.any(arr.pod_pref_aff_terms >= 0) or np.any(arr.pref_own0 != 0)
+    )
     return dataclasses.replace(
         cfg,
-        enable_pairwise=has_terms,
+        enable_pairwise=has_terms or has_interpod_pref,
         enable_ports=has_ports,
         enable_taint_score=has_prefer_taints,
         enable_node_pref=has_node_pref,
         enable_image=has_image,
+        enable_interpod_score=has_interpod_pref,
     )
 
 

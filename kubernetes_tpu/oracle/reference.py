@@ -227,6 +227,44 @@ def _interpod_ok(pod, nodes, existing, n) -> bool:
     return True
 
 
+def _interpod_pref_raw(pod, nodes, existing, n) -> f32:
+    """Mirrors ops/pairwise.interpod_pref_raw: own preferred terms vs existing
+    pods (anti negative) + existing pods' preferred terms vs this pod."""
+    nd = nodes[n]
+    raw = f32(0.0)
+    if pod.affinity:
+        for wt, sign in [
+            *[(x, 1.0) for x in pod.affinity.preferred_pod_affinity],
+            *[(x, -1.0) for x in pod.affinity.preferred_pod_anti_affinity],
+        ]:
+            term = wt.term
+            val = nd.labels.get(term.topology_key)
+            if val is None:
+                continue
+            ns = _aff_namespaces(term, pod)
+            for q, qn in existing:
+                if nodes[qn].labels.get(term.topology_key) == val and _term_matches_pod(
+                    term.label_selector, ns, q
+                ):
+                    raw = f32(raw + f32(sign * wt.weight))
+    for q, qn in existing:
+        if not q.affinity:
+            continue
+        for wt, sign in [
+            *[(x, 1.0) for x in q.affinity.preferred_pod_affinity],
+            *[(x, -1.0) for x in q.affinity.preferred_pod_anti_affinity],
+        ]:
+            term = wt.term
+            qval = nodes[qn].labels.get(term.topology_key)
+            if qval is None:
+                continue
+            if nd.labels.get(term.topology_key) != qval:
+                continue
+            if _term_matches_pod(term.label_selector, _aff_namespaces(term, q), pod):
+                raw = f32(raw + f32(sign * wt.weight))
+    return raw
+
+
 def _preferred_na_raw(pod, nd) -> f32:
     raw = f32(0.0)
     if pod.affinity:
@@ -339,6 +377,8 @@ def oracle_schedule(
         na_raws = {i: _preferred_na_raw(pod, nodes[i]) for i in feasible}
         max_na = f32(max(na_raws.values()))
         max_spread = f32(max(spread_raws.values()))
+        ip_raws = {i: _interpod_pref_raw(pod, nodes, existing, i) for i in feasible}
+        ip_max, ip_min = f32(max(ip_raws.values())), f32(min(ip_raws.values()))
         best_i, best_s = -1, -np.inf
         for i in feasible:
             requested = used[i] + req
@@ -359,6 +399,12 @@ def oracle_schedule(
                 + f32(cfg.taint_weight) * taint_sc
                 + f32(cfg.node_affinity_weight) * na_sc
                 + f32(cfg.spread_weight) * spread_sc
+                + f32(cfg.interpod_weight)
+                * (
+                    f32(f32(MAX_NODE_SCORE) * (ip_raws[i] - ip_min) / (ip_max - ip_min))
+                    if ip_max > ip_min
+                    else f32(0.0)
+                )
                 + f32(cfg.image_weight) * _image_score(pod, nodes[i])
             )
             if s > best_s:

@@ -61,6 +61,9 @@ def _node_sharding_specs(image_sharded: bool) -> ClusterArrays:
         anti_counts0=P(None, None),
         pod_aff_terms=P(None, None),
         pod_anti_terms=P(None, None),
+        pod_pref_aff_terms=P(None, None),
+        pod_pref_aff_w=P(None, None),
+        pref_own0=P(None, None),
         pod_spread_terms=P(None, None),
         pod_spread_maxskew=P(None, None),
         pod_spread_hard=P(None, None),

@@ -195,7 +195,8 @@ class ImageLocality(Plugin):
 
 class InterPodAffinity(Plugin):
     """interpodaffinity/filtering.go — Filter (required affinity with first-pod
-    waiver, own + symmetric anti-affinity)."""
+    waiver, own + symmetric anti-affinity) + scoring.go — Score (preferred
+    terms, both directions, min/max-normalized)."""
 
     name = "InterPodAffinity"
 
@@ -205,6 +206,20 @@ class InterPodAffinity(Plugin):
         if not oref._interpod_ok(pod, sc.nodes, sc.existing, i):
             return Status.unschedulable("node(s) didn't satisfy pod affinity/anti-affinity")
         return Status()
+
+    def Score(self, state, snap, pod, info: NodeInfo) -> float:
+        sc = state.data["scaled"]
+        i = sc.index[info.node.name]
+        return float(oref._interpod_pref_raw(pod, sc.nodes, sc.existing, i))
+
+    def NormalizeScore(self, state, snap, pod, scores: np.ndarray) -> None:
+        if not len(scores):
+            return
+        mx, mn = f32(scores.max()), f32(scores.min())
+        if mx > mn:
+            scores[:] = f32(MAX_NODE_SCORE) * (scores - mn) / (mx - mn)
+        else:
+            scores[:] = f32(0.0)
 
 
 class DefaultBinder(Plugin):

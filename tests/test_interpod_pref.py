@@ -1,0 +1,122 @@
+"""Preferred (soft) inter-pod affinity scoring — all paths (closes PARITY D6)."""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.native import schedule_batch_native
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
+from kubernetes_tpu.oracle import oracle_schedule
+from helpers import mk_node, mk_pod
+
+
+def run_all_paths(snap):
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    tpu = np.asarray(schedule_batch(arr, cfg)[0])
+    native = schedule_batch_native(arr, cfg)[0]
+    np.testing.assert_array_equal(native, tpu)
+    got = [
+        (meta.pod_names[k], meta.node_names[tpu[k]] if tpu[k] >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+    want = oracle_schedule(snap)
+    assert got == want, f"kernel={got} oracle={want}"
+    return dict(got)
+
+
+def pref_aff(weight=50, anti=False, key=t.LABEL_ZONE, **sel):
+    term = t.WeightedPodAffinityTerm(
+        weight=weight,
+        term=t.PodAffinityTerm(topology_key=key, label_selector=t.LabelSelector.of(**sel)),
+    )
+    return t.Affinity(
+        preferred_pod_affinity=() if anti else (term,),
+        preferred_pod_anti_affinity=(term,) if anti else (),
+    )
+
+
+def zone_nodes():
+    return [
+        mk_node("n-a", labels={t.LABEL_ZONE: "a"}),
+        mk_node("n-b", labels={t.LABEL_ZONE: "b"}),
+    ]
+
+
+def test_preferred_affinity_pulls_toward_companion():
+    bound = [mk_pod("db", labels={"app": "db"}, node_name="n-b")]
+    pod = mk_pod("web", affinity=pref_aff(app="db"))
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=[pod], bound_pods=bound))
+    assert got["web"] == "n-b"
+
+
+def test_preferred_anti_pushes_away():
+    bound = [mk_pod("noisy", labels={"app": "noisy"}, node_name="n-a")]
+    pod = mk_pod("quiet", affinity=pref_aff(anti=True, app="noisy"))
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=[pod], bound_pods=bound))
+    assert got["quiet"] == "n-b"
+
+
+def test_symmetric_existing_preference_attracts():
+    # the BOUND pod prefers app=web near it; incoming web pod feels the pull
+    bound = [mk_pod("magnet", labels={"app": "db"}, node_name="n-b",
+                    affinity=pref_aff(app="web"))]
+    pod = mk_pod("web", labels={"app": "web"})
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=[pod], bound_pods=bound))
+    assert got["web"] == "n-b"
+
+
+def test_weight_tradeoff_between_terms():
+    # strong pull to db (80) vs weak anti on cache (10): db wins
+    bound = [
+        mk_pod("db", labels={"app": "db"}, node_name="n-a"),
+        mk_pod("cache", labels={"app": "cache"}, node_name="n-a"),
+    ]
+    aff = t.Affinity(
+        preferred_pod_affinity=(
+            t.WeightedPodAffinityTerm(
+                weight=80,
+                term=t.PodAffinityTerm(topology_key=t.LABEL_ZONE,
+                                       label_selector=t.LabelSelector.of(app="db")),
+            ),
+        ),
+        preferred_pod_anti_affinity=(
+            t.WeightedPodAffinityTerm(
+                weight=10,
+                term=t.PodAffinityTerm(topology_key=t.LABEL_ZONE,
+                                       label_selector=t.LabelSelector.of(app="cache")),
+            ),
+        ),
+    )
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=[mk_pod("p", affinity=aff)],
+                                 bound_pods=bound))
+    assert got["p"] == "n-a"
+
+
+def test_committed_pods_preferences_affect_later_pods():
+    # first pod (with a preference for app=web) commits; the second (web) pod
+    # should be pulled to wherever the first landed
+    pods = [
+        mk_pod("early", priority=10, affinity=pref_aff(app="web")),
+        mk_pod("web", labels={"app": "web"}),
+    ]
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=pods))
+    assert got["web"] == got["early"]
+
+
+def test_random_parity_with_preferred_interpod():
+    rng = random.Random(8)
+    nodes = zone_nodes() + [mk_node("n-c", labels={t.LABEL_ZONE: "c"})]
+    pods = []
+    apps = ["web", "db", "cache"]
+    for i in range(40):
+        app = rng.choice(apps)
+        aff = None
+        if rng.random() < 0.5:
+            aff = pref_aff(weight=rng.choice([10, 50, 100]),
+                           anti=rng.random() < 0.4, app=rng.choice(apps))
+        pods.append(mk_pod(f"p{i}", labels={"app": app}, affinity=aff,
+                           cpu=rng.choice([100, 200]), priority=rng.choice([0, 5])))
+    run_all_paths(Snapshot(nodes=nodes, pending_pods=pods))
