@@ -235,16 +235,38 @@ class DefaultBinder(Plugin):
         return Status()
 
 
+def _split_pdb_violating(
+    pods: List[t.Pod], pdbs: List[t.PodDisruptionBudget]
+) -> Tuple[List[t.Pod], List[t.Pod]]:
+    """framework/preemption — filterPodsWithPDBViolation: a pod is "violating"
+    if evicting it would exceed some matching PDB's disruptions_allowed,
+    accounting for the evictions this very candidate set already charges."""
+    remaining = {pdb.key: pdb.disruptions_allowed for pdb in pdbs}
+    violating: List[t.Pod] = []
+    non_violating: List[t.Pod] = []
+    for q in pods:
+        hit = [pdb for pdb in pdbs if pdb.matches(q)]
+        if any(remaining[pdb.key] <= 0 for pdb in hit):
+            violating.append(q)
+        else:
+            for pdb in hit:
+                remaining[pdb.key] -= 1
+            non_violating.append(q)
+    return violating, non_violating
+
+
 class DefaultPreemption(Plugin):
     """defaultpreemption/default_preemption.go + framework/preemption/
     preemption.go — Evaluator: PostFilter that picks victims on one node,
     evicts them, and nominates the node.
 
-    Victim selection: remove all lower-priority pods; if the pod then passes
-    every Filter, reprieve victims highest-priority-first (re-add while still
-    feasible).  Node choice: lexicographic (lowest max victim priority,
-    smallest priority sum, fewest victims, lowest node index) — the PDB term
-    of the reference's ordering is vacuous here (no PDB objects yet).
+    Victim selection (SelectVictimsOnNode): remove all lower-priority pods;
+    if the pod then passes every Filter, reprieve victims while still
+    feasible — PDB-violating victims get reprieve priority first, then
+    non-violating, each highest-priority-first — and count the PDB
+    violations among the survivors.  Node choice (pickOneNodeForPreemption's
+    lexicographic order): fewest PDB violations, lowest max victim priority,
+    smallest priority sum, fewest victims, lowest node index.
     """
 
     name = "DefaultPreemption"
@@ -255,7 +277,8 @@ class DefaultPreemption(Plugin):
 
     def PostFilter(self, state, snap, pod, statuses) -> Tuple[Optional[str], Status]:
         sc = state.data["scaled"]
-        best = None  # (max_prio, sum_prio, count, node_idx, victims, node_name)
+        pdbs = list(getattr(self.store, "pdbs", {}).values())
+        best = None  # ((violations, max_prio, sum_prio, count, node_idx), victims, name)
         for i, info in enumerate(sc.infos):
             lower = [q for q in info.pods if q.priority < pod.priority]
             if not lower:
@@ -265,21 +288,28 @@ class DefaultPreemption(Plugin):
             try:
                 if not self.filter_fn(state, snap, pod, sim).ok:
                     continue
-                # reprieve: re-add highest-priority victims while still feasible
-                victims = []
-                for q in sorted(lower, key=lambda q: (-q.priority, q.uid)):
-                    sim.add_pod(q, sc.resources)
-                    sc.refresh_sim(i, sim)
-                    if self.filter_fn(state, snap, pod, sim).ok:
-                        continue  # reprieved
-                    sim.remove_pod(q, sc.resources)
-                    sc.refresh_sim(i, sim)
-                    victims.append(q)
+                # reprieve: re-add while still feasible; violating pods first
+                # so the final victim set avoids PDB damage when possible
+                violating, non_violating = _split_pdb_violating(lower, pdbs)
+                victims: List[t.Pod] = []
+                n_violations = 0
+                for group, counts in ((violating, True), (non_violating, False)):
+                    for q in sorted(group, key=lambda q: (-q.priority, q.uid)):
+                        sim.add_pod(q, sc.resources)
+                        sc.refresh_sim(i, sim)
+                        if self.filter_fn(state, snap, pod, sim).ok:
+                            continue  # reprieved
+                        sim.remove_pod(q, sc.resources)
+                        sc.refresh_sim(i, sim)
+                        victims.append(q)
+                        if counts:
+                            n_violations += 1
             finally:
                 sc.pop_sim(i)
             if not victims:
                 continue
             key = (
+                n_violations,
                 max(q.priority for q in victims),
                 sum(q.priority for q in victims),
                 len(victims),

@@ -34,6 +34,7 @@ class ClusterStore:
         self._rv = 0
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # by uid
+        self.pdbs: Dict[str, t.PodDisruptionBudget] = {}  # by namespace/name
         self._watchers: List[Callable[[Event], None]] = []
 
     # --- watch ---
@@ -89,6 +90,23 @@ class ClusterStore:
             p = self.pods.pop(uid, None)
             if p is not None:
                 self._emit(Event("Deleted", "Pod", p, self._bump()))
+
+    # --- PodDisruptionBudgets (the preemption evaluator's PDB lister) ---
+    def add_pdb(self, pdb: t.PodDisruptionBudget) -> None:
+        with self._lock:
+            self.pdbs[pdb.key] = pdb
+            self._emit(Event("Added", "PDB", pdb, self._bump()))
+
+    def update_pdb(self, pdb: t.PodDisruptionBudget) -> None:
+        with self._lock:
+            self.pdbs[pdb.key] = pdb
+            self._emit(Event("Modified", "PDB", pdb, self._bump()))
+
+    def delete_pdb(self, key: str) -> None:
+        with self._lock:
+            pdb = self.pdbs.pop(key, None)
+            if pdb is not None:
+                self._emit(Event("Deleted", "PDB", pdb, self._bump()))
 
     # --- storage objects (PV/PVC — the volumebinding plugin's informers) ---
     def add_pv(self, pv) -> None:

@@ -1,0 +1,104 @@
+"""PodDisruptionBudget: disruption-controller status math + PDB-aware
+preemption (reference: pkg/controller/disruption — updatePdbStatus;
+framework/preemption — filterPodsWithPDBViolation, pickOneNodeForPreemption's
+fewest-violations-first criterion)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.disruption import DisruptionController
+from kubernetes_tpu.scheduler.plugins.cpu import _split_pdb_violating
+from kubernetes_tpu.scheduler.queue import FakeClock
+
+from helpers import mk_node, mk_pod
+
+
+def mk_pdb(name, min_available=None, max_unavailable=None, **labels):
+    return t.PodDisruptionBudget(
+        name=name,
+        selector=t.LabelSelector.of(**labels),
+        min_available=min_available,
+        max_unavailable=max_unavailable,
+    )
+
+
+def test_disruption_controller_status_min_available():
+    store = ClusterStore()
+    store.add_pdb(mk_pdb("web-pdb", min_available=2, app="web"))
+    for i in range(3):
+        store.add_pod(mk_pod(f"w{i}", labels={"app": "web"}, node_name="n0"))
+    store.add_pod(mk_pod("other", labels={"app": "db"}, node_name="n0"))
+    (pdb,) = DisruptionController(store).tick()
+    assert pdb.expected_pods == 3
+    assert pdb.current_healthy == 3
+    assert pdb.desired_healthy == 2
+    assert pdb.disruptions_allowed == 1
+
+
+def test_disruption_controller_status_max_unavailable_and_unbound():
+    store = ClusterStore()
+    store.add_pdb(mk_pdb("web-pdb", max_unavailable=1, app="web"))
+    store.add_pod(mk_pod("w0", labels={"app": "web"}, node_name="n0"))
+    store.add_pod(mk_pod("w1", labels={"app": "web"}))  # pending: not healthy
+    (pdb,) = DisruptionController(store).tick()
+    assert pdb.expected_pods == 2
+    assert pdb.current_healthy == 1
+    assert pdb.desired_healthy == 1  # 2 expected - 1 maxUnavailable
+    assert pdb.disruptions_allowed == 0
+
+
+def test_split_pdb_violating_charges_evictions():
+    pdb = mk_pdb("pdb", min_available=1, app="web")
+    pdb.disruptions_allowed = 1
+    pods = [mk_pod(f"w{i}", labels={"app": "web"}) for i in range(3)]
+    violating, non_violating = _split_pdb_violating(pods, [pdb])
+    # first eviction consumes the budget; the rest violate
+    assert [p.name for p in non_violating] == ["w0"]
+    assert [p.name for p in violating] == ["w1", "w2"]
+
+
+def test_preemption_prefers_node_without_pdb_violation():
+    clock = FakeClock()
+    store = ClusterStore()
+    # two identical one-pod nodes; victim on n0 is PDB-protected
+    store.add_node(mk_node("n0", cpu=1000))
+    store.add_node(mk_node("n1", cpu=1000))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"), clock=clock)
+    store.add_pod(mk_pod("protected", cpu=800, labels={"app": "web"},
+                         node_selector={t.LABEL_HOSTNAME: "n0"}))
+    store.add_pod(mk_pod("plain", cpu=800, labels={"app": "db"},
+                         node_selector={t.LABEL_HOSTNAME: "n1"}))
+    sched.run_until_idle()
+    pdb = mk_pdb("web-pdb", min_available=1, app="web")
+    store.add_pdb(pdb)
+    DisruptionController(store).tick()
+    assert store.pdbs["default/web-pdb"].disruptions_allowed == 0
+
+    # without PDBs the tie-break would pick n0 (lowest node index); the PDB
+    # must steer the victim search to n1's unprotected pod
+    store.add_pod(mk_pod("vip", cpu=800, priority=100))
+    sched.run_until_idle()
+    names = {p.name for p in store.pods.values()}
+    assert "protected" in names
+    assert "plain" not in names
+    clock.step(2.0)
+    sched.run_until_idle()
+    assert store.pods["default/vip"].node_name == "n1"
+
+
+def test_preemption_violates_pdb_only_as_last_resort():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("only", cpu=1000))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"), clock=clock)
+    store.add_pod(mk_pod("protected", cpu=800, labels={"app": "web"}))
+    sched.run_until_idle()
+    store.add_pdb(mk_pdb("web-pdb", min_available=1, app="web"))
+    DisruptionController(store).tick()
+    # only candidate violates the PDB; preemption still proceeds (the
+    # reference's preemption ignores PDBs as a hard constraint — best effort)
+    store.add_pod(mk_pod("vip", cpu=800, priority=100))
+    sched.run_until_idle()
+    assert "default/protected" not in store.pods
+    clock.step(2.0)
+    sched.run_until_idle()
+    assert store.pods["default/vip"].node_name == "only"
