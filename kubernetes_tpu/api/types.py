@@ -281,6 +281,9 @@ class Pod:
     requests: ResourceList = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
     node_name: str = ""  # spec.nodeName: "" = pending; set = bound/running
+    # status.nominatedNodeName: set by preemption; the node this pod's victims
+    # were evicted from, reserved against lower-priority competitors
+    nominated_node_name: str = ""
     priority: int = 0
     tolerations: Tuple[Toleration, ...] = ()
     node_selector: Tuple[Tuple[str, str], ...] = ()  # spec.nodeSelector (AND of k=v)

@@ -271,9 +271,13 @@ class DefaultPreemption(Plugin):
 
     name = "DefaultPreemption"
 
-    def __init__(self, filter_fn, store):
+    def __init__(self, filter_fn, store, nominated_fn=None):
         self.filter_fn = filter_fn  # (state, snap, pod, NodeInfo) -> Status
         self.store = store
+        # node_name -> [nominated pods] (the queue's nominator); preemption
+        # must respect other preemptors' reservations (the reference's
+        # SelectVictimsOnNode filters through RunFilterPluginsWithNominatedPods)
+        self.nominated_fn = nominated_fn
 
     def PostFilter(self, state, snap, pod, statuses) -> Tuple[Optional[str], Status]:
         sc = state.data["scaled"]
@@ -283,7 +287,18 @@ class DefaultPreemption(Plugin):
             lower = [q for q in info.pods if q.priority < pod.priority]
             if not lower:
                 continue
-            sim = NodeInfo(node=info.node, pods=[q for q in info.pods if q.priority >= pod.priority])
+            nom = [
+                q
+                for q in (self.nominated_fn(info.node.name) if self.nominated_fn else [])
+                if q.uid != pod.uid and q.priority >= pod.priority
+            ]
+            nom_uids = {q.uid for q in nom}
+            # nominated pods ride in the sim so their reservation holds and
+            # they are never victims (they're not on the node, so not in lower)
+            sim = NodeInfo(
+                node=info.node,
+                pods=[q for q in info.pods if q.priority >= pod.priority] + nom,
+            )
             sc.push_sim(i, sim)
             try:
                 if not self.filter_fn(state, snap, pod, sim).ok:
@@ -304,6 +319,16 @@ class DefaultPreemption(Plugin):
                         victims.append(q)
                         if counts:
                             n_violations += 1
+                if victims and nom:
+                    # second pass of the two-pass nominated filter: feasibility
+                    # must not DEPEND on a nominated pod that may never arrive
+                    base = NodeInfo(
+                        node=info.node,
+                        pods=[q for q in sim.pods if q.uid not in nom_uids],
+                    )
+                    sc.refresh_sim(i, base)
+                    if not self.filter_fn(state, snap, pod, base).ok:
+                        continue
             finally:
                 sc.pop_sim(i)
             if not victims:
@@ -325,7 +350,7 @@ class DefaultPreemption(Plugin):
         return node_name, Status()
 
 
-def default_plugins(store, filter_fn=None) -> List[PluginWeight]:
+def default_plugins(store, filter_fn=None, nominated_fn=None) -> List[PluginWeight]:
     """The default profile — plugin set and weights mirroring
     default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
     TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2)."""
@@ -345,7 +370,7 @@ def default_plugins(store, filter_fn=None) -> List[PluginWeight]:
         PluginWeight(ImageLocality(), 1.0),
     ]
     if filter_fn is not None:
-        pls.append(PluginWeight(DefaultPreemption(filter_fn, store)))
+        pls.append(PluginWeight(DefaultPreemption(filter_fn, store, nominated_fn)))
     pls.append(PluginWeight(DefaultBinder(store)))
     return pls
 

@@ -70,6 +70,9 @@ class PriorityQueue:
         self._unschedulable: Dict[str, Tuple[t.Pod, Set[str]]] = {}  # uid -> (pod, events)
         self._attempts: Dict[str, int] = {}
         self._arrival: Dict[str, int] = {}
+        self._nominated: Dict[str, Tuple[t.Pod, str]] = {}  # uid -> (pod, node)
+        self._gone: Set[str] = set()  # deleted uids still sitting in backoff
+        self._in_backoff: Dict[str, int] = {}  # uid -> live backoff entries
 
     def __len__(self) -> int:
         self._flush_backoff()
@@ -85,6 +88,7 @@ class PriorityQueue:
         return (-pod.priority, arr)
 
     def add(self, pod: t.Pod) -> None:
+        self._gone.discard(pod.uid)
         if pod.uid in self._active_uids:
             return
         heapq.heappush(self._active, _Item(self._key(pod), pod))
@@ -94,6 +98,15 @@ class PriorityQueue:
         now = self.clock.now()
         while self._backoff and self._backoff[0][0] <= now:
             _, _, pod = heapq.heappop(self._backoff)
+            left = self._in_backoff.get(pod.uid, 1) - 1
+            if left > 0:
+                self._in_backoff[pod.uid] = left
+            else:
+                self._in_backoff.pop(pod.uid, None)
+            if pod.uid in self._gone:
+                if left <= 0:
+                    self._gone.discard(pod.uid)  # tombstone fully drained
+                continue
             self.add(pod)
 
     def pop(self) -> Optional[t.Pod]:
@@ -119,6 +132,7 @@ class PriorityQueue:
         if backoff:
             ready = self.clock.now() + self.backoff_duration(pod.uid)
             heapq.heappush(self._backoff, (ready, next(self._seq), pod))
+            self._in_backoff[pod.uid] = self._in_backoff.get(pod.uid, 0) + 1
         else:
             self._unschedulable[pod.uid] = (pod, events or {EV_ALL})
 
@@ -131,8 +145,27 @@ class PriorityQueue:
                 del self._unschedulable[uid]
                 ready = self.clock.now() + self.backoff_duration(uid)
                 heapq.heappush(self._backoff, (ready, next(self._seq), pod))
+                self._in_backoff[uid] = self._in_backoff.get(uid, 0) + 1
         return len(moved)
 
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)
         self._unschedulable.pop(pod_uid, None)
+        self._nominated.pop(pod_uid, None)
+        if self._in_backoff.get(pod_uid):
+            self._gone.add(pod_uid)  # tombstone drains with its backoff entries
+
+    # --- nominator (scheduling_queue.go — nominator: AddNominatedPod /
+    # DeleteNominatedPodIfExists / NominatedPodsForNode) ---
+    def add_nominated(self, pod: t.Pod, node_name: str) -> None:
+        self._nominated[pod.uid] = (pod, node_name)
+
+    def delete_nominated(self, pod_uid: str) -> None:
+        self._nominated.pop(pod_uid, None)
+
+    def nominated_pods_for_node(self, node_name: str) -> List[t.Pod]:
+        return [p for p, n in self._nominated.values() if n == node_name]
+
+    @property
+    def nominated(self) -> Dict[str, Tuple[t.Pod, str]]:
+        return dict(self._nominated)

@@ -34,7 +34,7 @@ import numpy as np
 from ..api import types as t
 from ..api.snapshot import Snapshot, encode_snapshot
 from ..ops.scores import infer_score_config
-from .cache import SchedulerCache
+from .cache import SchedulerCache, _with_node
 from .config import SchedulerConfiguration
 from .events import EventRecorder
 from .features import FeatureGates
@@ -67,7 +67,11 @@ class Scheduler:
         self.metrics = Metrics()
         self.events = EventRecorder()
         self.framework = Framework(
-            default_plugins(store, filter_fn=self._filter_one)
+            default_plugins(
+                store,
+                filter_fn=self._filter_one,
+                nominated_fn=lambda n: self.queue.nominated_pods_for_node(n),
+            )
         )
         self._sidecar = None  # lazy TPUScoreClient when profile configures one
         store.watch(self._on_event)
@@ -79,6 +83,8 @@ class Scheduler:
             if ev.kind == "Deleted":
                 self.queue.delete(pod.uid)
                 self.queue.move_all_to_active_or_backoff(EV_POD_DELETE)
+            elif ev.kind == "ModifiedStatus":
+                pass  # status-only write (nominatedNodeName/phase): no requeue
             elif not pod.node_name:
                 st = self.framework.run_pre_enqueue(pod)
                 if st.ok:
@@ -92,6 +98,33 @@ class Scheduler:
             )
 
     def _filter_one(self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo) -> Status:
+        return self.framework.run_filters(state, snap, pod, info)
+
+    def _filter_with_nominated(
+        self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo, i: int
+    ) -> Status:
+        """schedule_one.go — RunFilterPluginsWithNominatedPods: when
+        equal-or-higher-priority pods are nominated onto this node, the pod
+        must pass Filter both WITH their resources/affinity terms counted
+        (resource-type plugins must respect the reservation) and WITHOUT them
+        (anti-affinity against a nominated pod that may never arrive must not
+        grant feasibility)."""
+        nominated = [
+            q
+            for q in self.queue.nominated_pods_for_node(info.node.name)
+            if q.uid != pod.uid and q.priority >= pod.priority
+        ]
+        if not nominated:
+            return self.framework.run_filters(state, snap, pod, info)
+        sc = state.data["scaled"]
+        sim = NodeInfo(node=info.node, pods=list(info.pods) + list(nominated))
+        sc.push_sim(i, sim)
+        try:
+            st = self.framework.run_filters(state, snap, pod, sim)
+        finally:
+            sc.pop_sim(i)
+        if not st.ok:
+            return st
         return self.framework.run_filters(state, snap, pod, info)
 
     # --- the CPU scheduling cycle (ScheduleOne) ---
@@ -110,7 +143,7 @@ class Scheduler:
         statuses: Dict[str, Status] = {}
         if st.ok:
             for i, info in enumerate(infos):
-                fst = self.framework.run_filters(state, snap, pod, info)
+                fst = self._filter_with_nominated(state, snap, pod, info, i)
                 if fst.ok:
                     feasible.append(i)
                 else:
@@ -123,6 +156,9 @@ class Scheduler:
             )
             if pst.ok and nominated:
                 self.events.record("Preempted", pod.name, node=nominated)
+                self._nominate(pod, nominated)
+            else:
+                self._clear_nomination(pod)  # clearNominatedNode: stale
             self.queue.add_unschedulable(pod, backoff=True)
             self.metrics.inc("scheduling_attempts_unschedulable")
             return None
@@ -143,6 +179,7 @@ class Scheduler:
             self.queue.add_unschedulable(pod, backoff=True)
             return None
         self.framework.run_post_bind(state, snap, pod, node_name)
+        self.queue.delete_nominated(pod.uid)
         self.events.record("Scheduled", pod.name, node=node_name)
         self.metrics.observe("scheduling_attempt_duration_seconds", time.perf_counter() - t0)
         self.metrics.inc("scheduling_attempts_scheduled")
@@ -164,10 +201,22 @@ class Scheduler:
             return {}
         snap = self.cache.update_snapshot()
         bound_uids = {p.uid for p in snap.bound_pods}
+        batch_uids = {p.uid for p in batch}
+        node_names = {nd.name for nd in snap.nodes}
+        # reserve out-of-batch nominated pods (still in backoff after their
+        # preemption) by treating them as bound to their nominated node — the
+        # batched rendering of RunFilterPluginsWithNominatedPods' reservation
+        # (conservative: reserves against the whole batch, not only
+        # lower-priority members)
+        reserved = [
+            _with_node(q, node)
+            for uid, (q, node) in self.queue.nominated.items()
+            if uid not in batch_uids and uid not in bound_uids and node in node_names
+        ]
         snap = Snapshot(
             nodes=snap.nodes,
             pending_pods=[p for p in batch if p.uid not in bound_uids],
-            bound_pods=snap.bound_pods,
+            bound_pods=snap.bound_pods + reserved,
             pod_groups=snap.pod_groups,
         )
         gang = self.features.enabled("GangScheduling")
@@ -218,6 +267,7 @@ class Scheduler:
             if node_name:
                 self.cache.assume(pod.uid, node_name)
                 self.store.bind(pod.uid, node_name)
+                self.queue.delete_nominated(pod.uid)
                 self.events.record("Scheduled", pod.name, node=node_name)
                 result[pod.name] = node_name
             else:
@@ -244,11 +294,15 @@ class Scheduler:
             self.events.record("FailedScheduling", pod.name)
             if min_bound_prio is None or pod.priority <= min_bound_prio:
                 pst = Status.unschedulable("preemption: no lower-priority pods")
+                self._clear_nomination(pod)
             else:
                 nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
                 if pst.ok and nominated:
                     self.events.record("Preempted", pod.name, node=nominated)
+                    self._nominate(pod, nominated)
                     state = None  # evictions changed the cluster: rebuild lazily
+                else:
+                    self._clear_nomination(pod)
             self.queue.add_unschedulable(pod, backoff=True)
         dt = time.perf_counter() - t0
         self.metrics.observe("batch_scheduling_duration_seconds", dt)
@@ -256,6 +310,29 @@ class Scheduler:
         self.metrics.inc("scheduling_attempts_unschedulable", len(failed))
         self.metrics.set("pending_pods", self.queue.pending_total)
         return result
+
+    def _nominate(self, pod: t.Pod, node_name: str) -> None:
+        """Record the nomination (queue nominator) and publish it on the pod's
+        status (the reference's PATCH of status.nominatedNodeName)."""
+        import copy
+
+        q = copy.copy(pod)
+        q.nominated_node_name = node_name
+        self.queue.add_nominated(q, node_name)
+        if pod.uid in self.store.pods:
+            self.store.update_pod_status(q)
+
+    def _clear_nomination(self, pod: t.Pod) -> None:
+        """clearNominatedNode: a failed retry that produced no fresh nomination
+        must not leave a phantom reservation blocking the node."""
+        import copy
+
+        self.queue.delete_nominated(pod.uid)
+        cur = self.store.pods.get(pod.uid)
+        if cur is not None and cur.nominated_node_name:
+            q = copy.copy(cur)
+            q.nominated_node_name = ""
+            self.store.update_pod_status(q)
 
     # --- driver ---
     def run_until_idle(self, max_cycles: int = 100) -> None:

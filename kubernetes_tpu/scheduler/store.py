@@ -85,6 +85,15 @@ class ClusterStore:
             self.pods[pod.uid] = pod
             self._emit(Event("Modified", "Pod", pod, self._bump()))
 
+    def update_pod_status(self, pod: t.Pod) -> None:
+        """The pods/{name}/status subresource: status-only writes (e.g.
+        nominatedNodeName, phase) — watchers can tell them apart so the
+        scheduler's queue does not treat them as spec changes (the reference's
+        isPodUpdated check)."""
+        with self._lock:
+            self.pods[pod.uid] = pod
+            self._emit(Event("ModifiedStatus", "Pod", pod, self._bump()))
+
     def delete_pod(self, uid: str) -> None:
         with self._lock:
             p = self.pods.pop(uid, None)

@@ -1,0 +1,107 @@
+"""Nominated-node reservation (reference: schedule_one.go —
+RunFilterPluginsWithNominatedPods; scheduling_queue.go — nominator): after
+preemption, the freed node is reserved against lower-priority competitors
+while the preemptor waits out its backoff."""
+
+import pytest
+
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.queue import FakeClock
+
+from helpers import mk_node, mk_pod
+
+
+def _preempt_setup(mode):
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("only", cpu=1000))
+    sched = Scheduler(store, SchedulerConfiguration(mode=mode), clock=clock)
+    store.add_pod(mk_pod("victim", cpu=800, priority=0))
+    sched.run_until_idle()
+    store.add_pod(mk_pod("vip", cpu=800, priority=100))
+    sched.run_until_idle()  # preempts victim; vip now in backoff, nominated
+    assert "default/victim" not in store.pods
+    assert sched.queue.nominated_pods_for_node("only")
+    assert store.pods["default/vip"].nominated_node_name == "only"
+    return clock, store, sched
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_lower_priority_pod_cannot_steal_nominated_capacity(mode):
+    clock, store, sched = _preempt_setup(mode)
+    # a lower-priority pod arrives while vip sits in backoff: the freed
+    # capacity is reserved, so it must NOT bind
+    store.add_pod(mk_pod("sneak", cpu=800, priority=0))
+    sched.run_until_idle()
+    assert store.pods["default/sneak"].node_name == ""
+    # vip's backoff expires -> it takes the nominated node
+    clock.step(2.0)
+    sched.run_until_idle()
+    assert store.pods["default/vip"].node_name == "only"
+    assert store.pods["default/sneak"].node_name == ""
+    assert not sched.queue.nominated_pods_for_node("only")  # cleared on bind
+
+
+def test_higher_priority_pod_ignores_nomination_cpu():
+    clock, store, sched = _preempt_setup("cpu")
+    # an even-higher-priority pod may take the node despite the nomination
+    # (the reservation only holds against priority <= the nominated pod's)
+    store.add_pod(mk_pod("super", cpu=800, priority=200))
+    sched.run_until_idle()
+    assert store.pods["default/super"].node_name == "only"
+
+
+def test_stale_nomination_cleared_on_failed_retry_cpu():
+    clock, store, sched = _preempt_setup("cpu")
+    # super steals the node before vip's backoff expires (priority 200 > 100
+    # ignores the reservation); vip's retry then fails with no preemption
+    # candidates -> its stale nomination must be cleared (clearNominatedNode)
+    store.add_pod(mk_pod("super", cpu=800, priority=200))
+    sched.run_until_idle()
+    assert store.pods["default/super"].node_name == "only"
+    clock.step(2.0)
+    sched.run_until_idle()  # vip retries, cannot fit or preempt
+    assert not sched.queue.nominated_pods_for_node("only")
+    assert store.pods["default/vip"].nominated_node_name == ""
+    # a small pod that fits beside super must not be blocked by a phantom
+    # 800-cpu reservation
+    store.add_pod(mk_pod("small", cpu=100, priority=0))
+    sched.run_until_idle()
+    assert store.pods["default/small"].node_name == "only"
+
+
+def test_preemption_respects_other_pods_nomination_cpu():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=1000))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"), clock=clock)
+    store.add_pod(mk_pod("v1", cpu=200, priority=0))
+    store.add_pod(mk_pod("filler", cpu=800, priority=0))
+    sched.run_until_idle()
+    # A (prio 100) preempts filler and is nominated to n0
+    store.add_pod(mk_pod("A", cpu=800, priority=100))
+    sched.run_until_idle()
+    assert "default/filler" not in store.pods
+    assert sched.queue.nominated_pods_for_node("n0")
+    # B (prio 50, cpu 900) arrives: n0 is blocked by A's reservation, and
+    # preemption's what-if must ALSO see the reservation -> evicting v1 would
+    # be pointless, so v1 must survive and B gets no nomination
+    store.add_pod(mk_pod("B", cpu=900, priority=50))
+    sched.run_until_idle()
+    assert "default/v1" in store.pods
+    assert store.pods["default/B"].nominated_node_name == ""
+    clock.step(2.0)
+    sched.run_until_idle()
+    assert store.pods["default/A"].node_name == "n0"
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_nomination_cleared_on_pod_delete(mode):
+    clock, store, sched = _preempt_setup(mode)
+    store.delete_pod("default/vip")
+    assert not sched.queue.nominated_pods_for_node("only")
+    # capacity is free again for anyone
+    store.add_pod(mk_pod("sneak", cpu=800, priority=0))
+    clock.step(2.0)
+    sched.run_until_idle()
+    assert store.pods["default/sneak"].node_name == "only"
