@@ -128,6 +128,7 @@ def build_pairwise(
     node_index: Dict[str, int],
     N: int,
     P: int,
+    hard_pod_affinity_weight: float = 1.0,
 ):
     """Returns (PairwiseVocab, dict of arrays) — see ClusterArrays for shapes."""
     voc = PairwiseVocab(v.Interner(), v.Interner(), v.Interner(), v.Interner())
@@ -182,6 +183,16 @@ def build_pairwise(
                 pref_ids.append(
                     (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), -float(wt.weight))
                 )
+            if hard_pod_affinity_weight:
+                # existing pods' REQUIRED affinity terms score toward incoming
+                # pods at hardPodAffinityWeight (scoring.go — processExistingPod)
+                for term in pod.affinity.required_pod_affinity:
+                    pref_ids.append(
+                        (
+                            voc.terms.intern(_term_of_affinity(term, pod.namespace)),
+                            float(hard_pod_affinity_weight),
+                        )
+                    )
         bound_anti.append(ids)
         bound_pref.append(pref_ids)
 

@@ -252,7 +252,9 @@ def _image_score_matrix(nodes, pending_sorted, N: int, P: int) -> np.ndarray:
     ).astype(np.float32)
 
 
-def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArrays, EncodingMeta]:
+def encode_snapshot(
+    snap: Snapshot, *, bucket: bool = True, hard_pod_affinity_weight: float = 1.0
+) -> Tuple[ClusterArrays, EncodingMeta]:
     from .volumes import resolve_snapshot
 
     snap = resolve_snapshot(snap)
@@ -437,7 +439,8 @@ def encode_snapshot(snap: Snapshot, *, bucket: bool = True) -> Tuple[ClusterArra
 
     sorted_pending = [pending[i] for i in perm]
     _pair_voc, pair = build_pairwise(
-        nodes, sorted_pending, snap.bound_pods, node_index, N, P
+        nodes, sorted_pending, snap.bound_pods, node_index, N, P,
+        hard_pod_affinity_weight=hard_pod_affinity_weight,
     )
 
     arrays = ClusterArrays(

@@ -49,7 +49,7 @@ class _View(ctypes.Structure):
         ("w_fit", ctypes.c_float), ("w_bal", ctypes.c_float),
         ("w_taint", ctypes.c_float), ("w_na", ctypes.c_float),
         ("w_spread", ctypes.c_float), ("w_img", ctypes.c_float),
-        ("w_interpod", ctypes.c_float),
+        ("w_interpod", ctypes.c_float), ("w_hard", ctypes.c_float),
         ("r0", ctypes.c_int32), ("r1", ctypes.c_int32),
         ("enable_pairwise", ctypes.c_uint8), ("enable_ports", ctypes.c_uint8),
         ("enable_taint", ctypes.c_uint8), ("enable_na", ctypes.c_uint8),
@@ -138,6 +138,7 @@ def schedule_batch_native(
         w_taint=cfg.taint_weight, w_na=cfg.node_affinity_weight,
         w_spread=cfg.spread_weight, w_img=cfg.image_weight,
         w_interpod=cfg.interpod_weight,
+        w_hard=cfg.hard_pod_affinity_weight,
         r0=cfg.score_resources[0], r1=cfg.score_resources[1],
         enable_pairwise=int(cfg.enable_pairwise), enable_ports=int(cfg.enable_ports),
         enable_taint=int(cfg.enable_taint_score), enable_na=int(cfg.enable_node_pref),

@@ -56,6 +56,7 @@ struct View {
   float *pref_own;             // [T,D1] in/out
   // config
   float w_fit, w_bal, w_taint, w_na, w_spread, w_img, w_interpod;
+  float w_hard;  // hardPodAffinityWeight: committed required-affinity terms
   int32_t r0, r1;  // scored resource indices
   uint8_t enable_pairwise, enable_ports, enable_taint, enable_na, enable_img,
       enable_ip;
@@ -333,6 +334,15 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
           if (t < 0) continue;
           int d = v->node_dom[(size_t)v->term_key[t] * N + best_n];
           v->pref_own[(size_t)t * D1 + d] += v->pref_w[(size_t)p * v->B + b];
+        }
+        if (v->w_hard != 0.f) {
+          // committed pod's REQUIRED affinity terms at hardPodAffinityWeight
+          for (int a = 0; a < v->A1; a++) {
+            int t = v->aff_terms[(size_t)p * v->A1 + a];
+            if (t < 0) continue;
+            int d = v->node_dom[(size_t)v->term_key[t] * N + best_n];
+            v->pref_own[(size_t)t * D1 + d] += v->w_hard;
+          }
         }
       }
     }

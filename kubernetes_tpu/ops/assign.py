@@ -204,6 +204,16 @@ def schedule_scan(
                 bids = jnp.maximum(xs["pref_t"], 0)
                 bw = jnp.where((xs["pref_t"] >= 0) & (choice >= 0), xs["pref_w"], 0.0)
                 pref_own = pref_own.at[bids, dom_col[bids]].add(bw)
+                if cfg.hard_pod_affinity_weight:
+                    # ... and its REQUIRED affinity terms at hardPodAffinityWeight
+                    # (interpodaffinity/scoring.go — processExistingPod)
+                    aids = jnp.maximum(xs["aff"], 0)
+                    aw = jnp.where(
+                        (xs["aff"] >= 0) & (choice >= 0),
+                        jnp.float32(cfg.hard_pod_affinity_weight),
+                        0.0,
+                    )
+                    pref_own = pref_own.at[aids, dom_col[aids]].add(aw)
         if cfg.enable_ports:
             ports_used = ports_used | (placed & xs["ports"][None, :])
         return (used, counts, anti_counts, pref_own, ports_used), choice

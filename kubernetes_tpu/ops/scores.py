@@ -40,6 +40,9 @@ class ScoreConfig:
     node_affinity_weight: float = 2.0  # NodeAffinity (preferred terms)
     spread_weight: float = 2.0  # PodTopologySpread
     interpod_weight: float = 2.0  # InterPodAffinity
+    # InterPodAffinityArgs.hardPodAffinityWeight: existing pods' REQUIRED
+    # affinity terms toward the incoming pod score at this weight (default 1)
+    hard_pod_affinity_weight: float = 1.0
     image_weight: float = 1.0  # ImageLocality
     score_resources: Tuple[int, ...] = (0, 1)  # indices into the R axis
     # Static specialization: when a snapshot carries no pairwise terms / host
@@ -78,7 +81,11 @@ def infer_score_config(arr, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG) -> ScoreCon
     has_node_pref = bool(np.any(arr.pod_pref_terms >= 0))
     has_image = arr.image_score.shape[1] == arr.N and bool(np.any(arr.image_score))
     has_interpod_pref = bool(
-        np.any(arr.pod_pref_aff_terms >= 0) or np.any(arr.pref_own0 != 0)
+        np.any(arr.pod_pref_aff_terms >= 0)
+        or np.any(arr.pref_own0 != 0)
+        # committed pods' REQUIRED affinity terms score toward later pods
+        # at hardPodAffinityWeight, so required terms alone need the stage
+        or (cfg.hard_pod_affinity_weight > 0 and np.any(arr.pod_aff_terms >= 0))
     )
     return dataclasses.replace(
         cfg,

@@ -227,9 +227,11 @@ def _interpod_ok(pod, nodes, existing, n) -> bool:
     return True
 
 
-def _interpod_pref_raw(pod, nodes, existing, n) -> f32:
+def _interpod_pref_raw(pod, nodes, existing, n, hard_w: float = 1.0) -> f32:
     """Mirrors ops/pairwise.interpod_pref_raw: own preferred terms vs existing
-    pods (anti negative) + existing pods' preferred terms vs this pod."""
+    pods (anti negative) + existing pods' preferred terms vs this pod +
+    existing pods' REQUIRED affinity terms vs this pod at hardPodAffinityWeight
+    (interpodaffinity/scoring.go — processExistingPod)."""
     nd = nodes[n]
     raw = f32(0.0)
     if pod.affinity:
@@ -250,18 +252,22 @@ def _interpod_pref_raw(pod, nodes, existing, n) -> f32:
     for q, qn in existing:
         if not q.affinity:
             continue
-        for wt, sign in [
-            *[(x, 1.0) for x in q.affinity.preferred_pod_affinity],
-            *[(x, -1.0) for x in q.affinity.preferred_pod_anti_affinity],
+        for term, w in [
+            *[(x.term, float(x.weight)) for x in q.affinity.preferred_pod_affinity],
+            *[(x.term, -float(x.weight)) for x in q.affinity.preferred_pod_anti_affinity],
+            *(
+                [(x, float(hard_w)) for x in q.affinity.required_pod_affinity]
+                if hard_w
+                else []
+            ),
         ]:
-            term = wt.term
             qval = nodes[qn].labels.get(term.topology_key)
             if qval is None:
                 continue
             if nd.labels.get(term.topology_key) != qval:
                 continue
             if _term_matches_pod(term.label_selector, _aff_namespaces(term, q), pod):
-                raw = f32(raw + f32(sign * wt.weight))
+                raw = f32(raw + f32(w))
     return raw
 
 
@@ -377,7 +383,12 @@ def oracle_schedule(
         na_raws = {i: _preferred_na_raw(pod, nodes[i]) for i in feasible}
         max_na = f32(max(na_raws.values()))
         max_spread = f32(max(spread_raws.values()))
-        ip_raws = {i: _interpod_pref_raw(pod, nodes, existing, i) for i in feasible}
+        ip_raws = {
+            i: _interpod_pref_raw(
+                pod, nodes, existing, i, cfg.hard_pod_affinity_weight
+            )
+            for i in feasible
+        }
         ip_max, ip_min = f32(max(ip_raws.values())), f32(min(ip_raws.values()))
         best_i, best_s = -1, -np.inf
         for i in feasible:

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Set
 from ..api import types as t
 from ..api.snapshot import Snapshot
 from .framework import NodeInfo
-from .store import ClusterStore, Event
+from .store import ClusterStore, Event, replace_pod_nodename
 
 
 class SchedulerCache:
@@ -72,7 +72,7 @@ class SchedulerCache:
             for p in self.pods.values():
                 node = self._effective_node(p)
                 if node:
-                    q = p if p.node_name else _with_node(p, node)
+                    q = p if p.node_name else replace_pod_nodename(p, node)
                     bound.append(q)
                 else:
                     pending.append(p)
@@ -94,11 +94,3 @@ class SchedulerCache:
             if q.node_name in infos:
                 infos[q.node_name].add_pod(q, resources)
         return list(infos.values())
-
-
-def _with_node(pod: t.Pod, node: str) -> t.Pod:
-    import copy
-
-    q = copy.copy(pod)
-    q.node_name = node
-    return q

@@ -43,6 +43,8 @@ class Profile:
     # field for config parity + validation
     percentage_of_nodes_to_score: int = 100
     tpu_score: Optional[TPUScoreArgs] = None
+    # InterPodAffinityArgs.hardPodAffinityWeight (pluginConfig; default 1)
+    hard_pod_affinity_weight: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -73,6 +75,7 @@ class SchedulerConfiguration:
             node_affinity_weight=w.get("NodeAffinity", 2.0),
             spread_weight=w.get("PodTopologySpread", 2.0),
             interpod_weight=w.get("InterPodAffinity", 2.0),
+            hard_pod_affinity_weight=self.profile().hard_pod_affinity_weight,
         )
         for name in disabled:
             key = {

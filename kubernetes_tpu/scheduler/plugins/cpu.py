@@ -200,6 +200,9 @@ class InterPodAffinity(Plugin):
 
     name = "InterPodAffinity"
 
+    def __init__(self, hard_pod_affinity_weight: float = 1.0):
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         sc = state.data["scaled"]
         i = sc.index[info.node.name]
@@ -210,7 +213,11 @@ class InterPodAffinity(Plugin):
     def Score(self, state, snap, pod, info: NodeInfo) -> float:
         sc = state.data["scaled"]
         i = sc.index[info.node.name]
-        return float(oref._interpod_pref_raw(pod, sc.nodes, sc.existing, i))
+        return float(
+            oref._interpod_pref_raw(
+                pod, sc.nodes, sc.existing, i, self.hard_pod_affinity_weight
+            )
+        )
 
     def NormalizeScore(self, state, snap, pod, scores: np.ndarray) -> None:
         if not len(scores):
@@ -350,7 +357,9 @@ class DefaultPreemption(Plugin):
         return node_name, Status()
 
 
-def default_plugins(store, filter_fn=None, nominated_fn=None) -> List[PluginWeight]:
+def default_plugins(
+    store, filter_fn=None, nominated_fn=None, hard_pod_affinity_weight: float = 1.0
+) -> List[PluginWeight]:
     """The default profile — plugin set and weights mirroring
     default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
     TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2)."""
@@ -366,7 +375,7 @@ def default_plugins(store, filter_fn=None, nominated_fn=None) -> List[PluginWeig
         PluginWeight(TaintToleration(), 3.0),
         PluginWeight(NodeAffinity(), 2.0),
         PluginWeight(PodTopologySpread(), 2.0),
-        PluginWeight(InterPodAffinity(), 2.0),
+        PluginWeight(InterPodAffinity(hard_pod_affinity_weight), 2.0),
         PluginWeight(ImageLocality(), 1.0),
     ]
     if filter_fn is not None:

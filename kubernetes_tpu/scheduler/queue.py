@@ -71,7 +71,10 @@ class PriorityQueue:
         self._attempts: Dict[str, int] = {}
         self._arrival: Dict[str, int] = {}
         self._nominated: Dict[str, Tuple[t.Pod, str]] = {}  # uid -> (pod, node)
-        self._gone: Set[str] = set()  # deleted uids still sitting in backoff
+        # uid -> count of STALE backoff entries to swallow (set at delete();
+        # entries pushed before the delete mature earlier than any pushed
+        # after a re-add, so draining by count pairs them correctly)
+        self._gone: Dict[str, int] = {}
         self._in_backoff: Dict[str, int] = {}  # uid -> live backoff entries
 
     def __len__(self) -> int:
@@ -88,7 +91,6 @@ class PriorityQueue:
         return (-pod.priority, arr)
 
     def add(self, pod: t.Pod) -> None:
-        self._gone.discard(pod.uid)
         if pod.uid in self._active_uids:
             return
         heapq.heappush(self._active, _Item(self._key(pod), pod))
@@ -103,9 +105,12 @@ class PriorityQueue:
                 self._in_backoff[pod.uid] = left
             else:
                 self._in_backoff.pop(pod.uid, None)
-            if pod.uid in self._gone:
-                if left <= 0:
-                    self._gone.discard(pod.uid)  # tombstone fully drained
+            stale = self._gone.get(pod.uid, 0)
+            if stale > 0:
+                if stale > 1:
+                    self._gone[pod.uid] = stale - 1
+                else:
+                    del self._gone[pod.uid]
                 continue
             self.add(pod)
 
@@ -153,7 +158,8 @@ class PriorityQueue:
         self._unschedulable.pop(pod_uid, None)
         self._nominated.pop(pod_uid, None)
         if self._in_backoff.get(pod_uid):
-            self._gone.add(pod_uid)  # tombstone drains with its backoff entries
+            # every entry currently in backoff predates this delete: all stale
+            self._gone[pod_uid] = self._in_backoff[pod_uid]
 
     # --- nominator (scheduling_queue.go — nominator: AddNominatedPod /
     # DeleteNominatedPodIfExists / NominatedPodsForNode) ---

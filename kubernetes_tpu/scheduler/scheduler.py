@@ -34,7 +34,8 @@ import numpy as np
 from ..api import types as t
 from ..api.snapshot import Snapshot, encode_snapshot
 from ..ops.scores import infer_score_config
-from .cache import SchedulerCache, _with_node
+from .cache import SchedulerCache
+from .store import replace_pod_nodename
 from .config import SchedulerConfiguration
 from .events import EventRecorder
 from .features import FeatureGates
@@ -71,6 +72,7 @@ class Scheduler:
                 store,
                 filter_fn=self._filter_one,
                 nominated_fn=lambda n: self.queue.nominated_pods_for_node(n),
+                hard_pod_affinity_weight=config.profile().hard_pod_affinity_weight,
             )
         )
         self._sidecar = None  # lazy TPUScoreClient when profile configures one
@@ -209,7 +211,7 @@ class Scheduler:
         # (conservative: reserves against the whole batch, not only
         # lower-priority members)
         reserved = [
-            _with_node(q, node)
+            replace_pod_nodename(q, node)
             for uid, (q, node) in self.queue.nominated.items()
             if uid not in batch_uids and uid not in bound_uids and node in node_names
         ]
@@ -240,8 +242,11 @@ class Scheduler:
                     result[pod.name] = self.schedule_one(pod)
                 return result
         if verdicts is None:
-            arr, meta = encode_snapshot(snap)
-            cfg = infer_score_config(arr, self.config.score_config())
+            base_cfg = self.config.score_config()
+            arr, meta = encode_snapshot(
+                snap, hard_pod_affinity_weight=base_cfg.hard_pod_affinity_weight
+            )
+            cfg = infer_score_config(arr, base_cfg)
             if self.config.mode == "native":
                 from ..native import schedule_batch_native, schedule_with_gangs_native
 

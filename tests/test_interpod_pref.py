@@ -120,3 +120,79 @@ def test_random_parity_with_preferred_interpod():
         pods.append(mk_pod(f"p{i}", labels={"app": app}, affinity=aff,
                            cpu=rng.choice([100, 200]), priority=rng.choice([0, 5])))
     run_all_paths(Snapshot(nodes=nodes, pending_pods=pods))
+
+
+def req_aff(key=t.LABEL_ZONE, **sel):
+    return t.Affinity(
+        required_pod_affinity=(
+            t.PodAffinityTerm(topology_key=key, label_selector=t.LabelSelector.of(**sel)),
+        ),
+    )
+
+
+def test_hard_pod_affinity_weight_attracts():
+    # BOUND pod carries REQUIRED affinity toward app=web; the incoming web pod
+    # scores hardPodAffinityWeight (default 1) toward its zone — with all else
+    # equal, it lands beside the requirer (scoring.go — processExistingPod)
+    bound = [mk_pod("requirer", labels={"app": "db"}, node_name="n-b",
+                    affinity=req_aff(app="web"))]
+    pod = mk_pod("web", labels={"app": "web"})
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=[pod], bound_pods=bound))
+    assert got["web"] == "n-b"
+
+
+def test_hard_pod_affinity_from_committed_pod():
+    # a pod whose required affinity is satisfied by the first-pod waiver
+    # commits; its required term then pulls the matching pod to its zone
+    pods = [
+        mk_pod("early", priority=10, labels={"app": "web"}, affinity=req_aff(app="web")),
+        mk_pod("web2", labels={"app": "web"}),
+    ]
+    got = run_all_paths(Snapshot(nodes=zone_nodes(), pending_pods=pods))
+    assert got["web2"] == got["early"]
+
+
+def test_random_parity_with_required_and_preferred_interpod():
+    rng = random.Random(11)
+    nodes = zone_nodes() + [mk_node("n-c", labels={t.LABEL_ZONE: "c"})]
+    pods = []
+    apps = ["web", "db", "cache"]
+    for i in range(40):
+        app = rng.choice(apps)
+        aff = None
+        r = rng.random()
+        if r < 0.3:
+            aff = pref_aff(weight=rng.choice([10, 50, 100]),
+                           anti=rng.random() < 0.4, app=rng.choice(apps))
+        elif r < 0.5:
+            aff = req_aff(app=rng.choice(apps))
+        pods.append(mk_pod(f"p{i}", labels={"app": app}, affinity=aff,
+                           cpu=rng.choice([100, 200]), priority=rng.choice([0, 5])))
+    run_all_paths(Snapshot(nodes=nodes, pending_pods=pods))
+
+
+def test_hard_pod_affinity_weight_configurable():
+    # weight 0 disables the hard contribution end-to-end (encoder + kernels +
+    # oracle); weight 100 dominates. Exercises the cfg plumbing through
+    # encode_snapshot(hard_pod_affinity_weight=...) and ScoreConfig.
+    import dataclasses
+
+    bound = [mk_pod("requirer", labels={"app": "db"}, node_name="n-b",
+                    affinity=req_aff(app="web"))]
+    pod = mk_pod("web", labels={"app": "web"})
+    snap = Snapshot(nodes=zone_nodes(), pending_pods=[pod], bound_pods=bound)
+    for hw in (0.0, 100.0):
+        arr, meta = encode_snapshot(snap, hard_pod_affinity_weight=hw)
+        cfg = infer_score_config(
+            arr, dataclasses.replace(DEFAULT_SCORE_CONFIG, hard_pod_affinity_weight=hw)
+        )
+        tpu = np.asarray(schedule_batch(arr, cfg)[0])
+        native = schedule_batch_native(arr, cfg)[0]
+        np.testing.assert_array_equal(native, tpu)
+        want = dict(oracle_schedule(snap, cfg))
+        got = meta.node_names[tpu[0]] if tpu[0] >= 0 else None
+        assert got == want["web"]
+        if hw == 0.0:
+            assert got == "n-a"  # no pull: ties break to the lowest index
+        else:
+            assert got == "n-b"

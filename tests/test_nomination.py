@@ -105,3 +105,22 @@ def test_nomination_cleared_on_pod_delete(mode):
     clock.step(2.0)
     sched.run_until_idle()
     assert store.pods["default/sneak"].node_name == "only"
+
+
+def test_deleted_pod_does_not_resurrect_after_same_uid_readd():
+    # delete-while-in-backoff then recreate with the same uid: the stale
+    # backoff entry must drain silently, the fresh pod must survive
+    from kubernetes_tpu.scheduler.queue import FakeClock, PriorityQueue
+
+    clock = FakeClock()
+    q = PriorityQueue(clock)
+    old = mk_pod("p", cpu=100)
+    q.add(old)
+    assert q.pop() is old
+    q.add_unschedulable(old, backoff=True)  # enters backoff
+    q.delete(old.uid)  # deleted while in backoff
+    new = mk_pod("p", cpu=200)  # recreated, same uid
+    q.add(new)
+    assert q.pop() is new
+    clock.step(60.0)  # stale entry matures
+    assert q.pop() is None  # the deleted pod must NOT come back
