@@ -266,6 +266,24 @@ class Node:
         self.labels.setdefault(LABEL_HOSTNAME, self.name)
 
 
+@dataclass(frozen=True)
+class OwnerReference:
+    """metav1 — type OwnerReference (the GC graph edge + controller adoption)."""
+
+    kind: str  # ReplicaSet | Deployment | Job | ...
+    name: str
+    uid: str
+    controller: bool = True
+
+
+# Pod phases (core/v1/types.go — type PodPhase); "" on a Pod means the phase
+# machinery is not in play (bare scheduling harness objects)
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+
 @dataclass
 class Pod:
     """Scheduling view of a pod (pending or running).
@@ -295,6 +313,12 @@ class Pod:
     images: Tuple[str, ...] = ()  # container images (ImageLocality's input)
     pvcs: Tuple[str, ...] = ()  # claimed PVC names (in the pod's namespace)
     resource_claims: Tuple[ResourceClaimRef, ...] = ()  # DRA-lite
+    owner_references: Tuple[OwnerReference, ...] = ()  # GC graph + adoption
+    # status.phase ("": phase machinery not in play — bound implies running)
+    phase: str = ""
+    # lifecycle knob for the hollow kubelet: pods whose workload completes
+    # (Job pods) run for run_seconds then succeed; 0 = run forever
+    run_seconds: float = 0.0
     uid: str = ""
 
     def __post_init__(self) -> None:
@@ -309,6 +333,81 @@ class PodGroup:
 
     name: str
     min_member: int
+
+
+@dataclass
+class ReplicaSet:
+    """apps/v1 — type ReplicaSet (workload-controller surface): desired
+    replicas + selector + pod template.  `template` is a prototype Pod whose
+    name becomes the stamped pods' name prefix."""
+
+    name: str
+    namespace: str = "default"
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional["Pod"] = None
+    owner_references: Tuple[OwnerReference, ...] = ()
+    uid: str = ""
+    # status
+    ready_replicas: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"rs/{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Deployment:
+    """apps/v1 — type Deployment: declarative rollout over ReplicaSets.
+    Strategy reduced to RollingUpdate with maxSurge/maxUnavailable counts."""
+
+    name: str
+    namespace: str = "default"
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional["Pod"] = None
+    max_surge: int = 1
+    max_unavailable: int = 0
+    uid: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"deploy/{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Job:
+    """batch/v1 — type Job: run pods to completion (completions/parallelism)."""
+
+    name: str
+    namespace: str = "default"
+    completions: int = 1
+    parallelism: int = 1
+    template: Optional["Pod"] = None
+    uid: str = ""
+    # status
+    succeeded: int = 0
+    active: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"job/{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def complete(self) -> bool:
+        return self.succeeded >= self.completions
 
 
 @dataclass

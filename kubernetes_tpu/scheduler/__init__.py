@@ -2,3 +2,6 @@ from .framework import Framework, Status, CycleState  # noqa: F401
 from .config import SchedulerConfiguration, Profile  # noqa: F401
 from .scheduler import Scheduler  # noqa: F401
 from .store import ClusterStore  # noqa: F401
+from .controllers import ControllerManager  # noqa: F401
+from .kubelet import HollowCluster, HollowKubelet  # noqa: F401
+from .disruption import DisruptionController  # noqa: F401

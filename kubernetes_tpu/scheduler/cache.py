@@ -70,6 +70,8 @@ class SchedulerCache:
             nodes = list(self.nodes.values())
             pending, bound = [], []
             for p in self.pods.values():
+                if p.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+                    continue  # terminated pods release their capacity
                 node = self._effective_node(p)
                 if node:
                     q = p if p.node_name else replace_pod_nodename(p, node)

@@ -1,0 +1,314 @@
+"""Workload controllers + garbage collector — the kube-controller-manager
+analog (SURVEY.md §2.3: "each controller = informer→workqueue→sync loop").
+
+Representative set per the reference's pkg/controller/*:
+
+  ReplicaSetController   replica_set.go — syncReplicaSet/manageReplicas:
+                         diff desired vs actual owned pods, create/delete
+  DeploymentController   deployment/ — rollout via template-hashed ReplicaSets
+                         (RollingUpdate with maxSurge/maxUnavailable)
+  JobController          job/ — run pods to completion (completions/parallelism)
+  GarbageCollector       garbagecollector/ — cascading delete of orphans whose
+                         controller ownerReference points at a vanished owner
+
+The workqueue is collapsed to a full reconcile pass per tick() — the same
+level-triggered semantics (sync is idempotent, diff-driven), minus the
+per-key scheduling, which only matters for fairness at scale.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as t
+from .store import ClusterStore
+
+
+def _is_finished(pod: t.Pod) -> bool:
+    return pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED)
+
+
+def _is_ready(pod: t.Pod) -> bool:
+    """Bound and running ("" phase = harness objects without lifecycle)."""
+    return bool(pod.node_name) and pod.phase in ("", t.PHASE_RUNNING)
+
+
+def _controller_of(pod: t.Pod) -> Optional[t.OwnerReference]:
+    for ref in pod.owner_references:
+        if ref.controller:
+            return ref
+    return None
+
+
+def _stamp(template: t.Pod, name: str, namespace: str, owner: t.OwnerReference) -> t.Pod:
+    import copy
+
+    q = copy.copy(template)
+    q.name = name
+    q.namespace = namespace
+    q.node_name = ""
+    q.phase = t.PHASE_PENDING
+    q.owner_references = (owner,)
+    q.uid = f"{namespace}/{name}"
+    q.labels = dict(template.labels)
+    return q
+
+
+class ReplicaSetController:
+    """replica_set.go — syncReplicaSet: adopt matching orphans, then
+    manageReplicas (create the shortfall / delete the excess, preferring
+    pending and unready pods for deletion — getPodsToDelete's ranking)."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+        self._seq = itertools.count()
+
+    def _owned(self, rs: t.ReplicaSet) -> List[t.Pod]:
+        out = []
+        for pod in self.store.pods.values():
+            if pod.namespace != rs.namespace:
+                continue
+            ctrl = _controller_of(pod)
+            if ctrl is not None:
+                if ctrl.uid == rs.uid:
+                    out.append(pod)
+            elif rs.selector is not None and rs.selector.matches(pod.labels):
+                # adoption: matching orphan gains the controller ref
+                import copy
+
+                q = copy.copy(pod)
+                q.owner_references = (
+                    t.OwnerReference(kind="ReplicaSet", name=rs.name, uid=rs.uid),
+                )
+                self.store.update_pod(q)
+                out.append(q)
+        return out
+
+    def sync(self, rs: t.ReplicaSet) -> None:
+        owned = self._owned(rs)
+        active = [p for p in owned if not _is_finished(p)]
+        diff = rs.replicas - len(active)
+        if diff > 0:
+            owner = t.OwnerReference(kind="ReplicaSet", name=rs.name, uid=rs.uid)
+            for _ in range(diff):
+                name = f"{rs.name}-{next(self._seq):05d}"
+                self.store.add_pod(
+                    _stamp(rs.template or t.Pod(name="x"), name, rs.namespace, owner)
+                )
+        elif diff < 0:
+            # delete excess: pending (unscheduled) first, then unready, then by name
+            ranked = sorted(
+                active,
+                key=lambda p: (bool(p.node_name), _is_ready(p), p.name),
+            )
+            doomed = ranked[: -rs.replicas] if rs.replicas else ranked
+            for p in doomed:
+                self.store.delete_pod(p.uid)
+            gone = {p.uid for p in doomed}
+            active = [p for p in active if p.uid not in gone]
+        ready = sum(1 for p in active if _is_ready(p))
+        if ready != rs.ready_replicas:
+            self.store.update_workload("ReplicaSet", replace(rs, ready_replicas=ready))
+
+    def tick(self) -> None:
+        for rs in list(self.store.replicasets.values()):
+            self.sync(rs)
+
+
+def _template_hash(template: Optional[t.Pod]) -> str:
+    """pod-template-hash: stable digest of the rollout-relevant template
+    fields (deployment_util.go — ComputeHash)."""
+    if template is None:
+        return "0"
+    h = hashlib.sha256()
+    h.update(repr((
+        sorted(template.requests.items()),
+        sorted(template.labels.items()),
+        template.tolerations,
+        template.node_selector,
+        template.affinity,
+        template.topology_spread,
+        template.priority,
+        template.host_ports,
+        template.pvcs,
+        template.resource_claims,
+        template.scheduling_gates,
+        template.images,
+        template.run_seconds,
+    )).encode())
+    return h.hexdigest()[:10]
+
+
+class DeploymentController:
+    """deployment/sync.go — getAllReplicaSetsAndSyncRevision + the rolling
+    update loop (rolling.go — reconcileNewReplicaSet/reconcileOldReplicaSets):
+    scale the template-hashed new RS up within maxSurge, old RSes down within
+    maxUnavailable, delete old RSes once drained."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def sync(self, d: t.Deployment) -> None:
+        hash_ = _template_hash(d.template)
+        new_name = f"{d.name}-{hash_}"
+        mine = [
+            rs
+            for rs in self.store.replicasets.values()
+            if rs.namespace == d.namespace
+            and any(r.uid == d.uid for r in rs.owner_references)
+        ]
+        new_rs = next((rs for rs in mine if rs.name == new_name), None)
+        if new_rs is None:
+            tmpl = None
+            if d.template is not None:
+                import copy
+
+                tmpl = copy.copy(d.template)
+                tmpl.labels = {**d.template.labels, "pod-template-hash": hash_}
+            sel = d.selector or (
+                t.LabelSelector.of(**d.template.labels) if d.template else None
+            )
+            new_rs = t.ReplicaSet(
+                name=new_name,
+                namespace=d.namespace,
+                replicas=0,
+                selector=sel,
+                template=tmpl,
+                owner_references=(
+                    t.OwnerReference(kind="Deployment", name=d.name, uid=d.uid),
+                ),
+            )
+            self.store.add_workload("ReplicaSet", new_rs)
+        old = [rs for rs in mine if rs.name != new_name]
+
+        total = new_rs.replicas + sum(rs.replicas for rs in old)
+        ready_total = new_rs.ready_replicas + sum(rs.ready_replicas for rs in old)
+        if new_rs.replicas > d.replicas:
+            # the Deployment itself was scaled down: shrink the new RS directly
+            self.store.update_workload(
+                "ReplicaSet", replace(new_rs, replicas=d.replicas)
+            )
+        else:
+            # scale new RS up within the surge budget
+            allowed = d.replicas + d.max_surge - total
+            if allowed > 0 and new_rs.replicas < d.replicas:
+                grown = min(d.replicas, new_rs.replicas + allowed)
+                self.store.update_workload(
+                    "ReplicaSet", replace(new_rs, replicas=grown)
+                )
+        # scale old RSes down within the availability budget
+        can_remove = ready_total - (d.replicas - d.max_unavailable)
+        for rs in sorted(old, key=lambda r: r.name):
+            if can_remove <= 0:
+                break
+            if rs.replicas > 0:
+                drop = min(rs.replicas, can_remove)
+                self.store.update_workload(
+                    "ReplicaSet", replace(rs, replicas=rs.replicas - drop)
+                )
+                can_remove -= drop
+        for rs in old:
+            if rs.replicas == 0 and rs.ready_replicas == 0 and rs.key in self.store.replicasets:
+                self.store.delete_workload("ReplicaSet", rs.key)
+
+    def tick(self) -> None:
+        for d in list(self.store.deployments.values()):
+            self.sync(d)
+
+
+class JobController:
+    """job_controller.go — syncJob: keep min(parallelism, remaining) pods
+    active until `completions` pods have succeeded."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+        self._seq = itertools.count()
+
+    def sync(self, job: t.Job) -> None:
+        owned = [
+            p
+            for p in self.store.pods.values()
+            if p.namespace == job.namespace
+            and any(r.uid == job.uid for r in p.owner_references)
+        ]
+        succeeded = sum(1 for p in owned if p.phase == t.PHASE_SUCCEEDED)
+        active = [p for p in owned if not _is_finished(p)]
+        want_active = min(job.parallelism, max(0, job.completions - succeeded))
+        owner = t.OwnerReference(kind="Job", name=job.name, uid=job.uid)
+        for _ in range(want_active - len(active)):
+            name = f"{job.name}-{next(self._seq):05d}"
+            tmpl = job.template or t.Pod(name="x", run_seconds=1.0)
+            self.store.add_pod(_stamp(tmpl, name, job.namespace, owner))
+        for p in active[want_active:] if want_active < len(active) else []:
+            self.store.delete_pod(p.uid)
+        if succeeded != job.succeeded or len(active) != job.active:
+            self.store.update_workload(
+                "Job", replace(job, succeeded=succeeded, active=len(active))
+            )
+
+    def tick(self) -> None:
+        for job in list(self.store.jobs.values()):
+            self.sync(job)
+
+
+class GarbageCollector:
+    """garbagecollector/ — the dependency graph reduced to one cascading rule:
+    an object whose controller ownerReference names a vanished uid is deleted.
+    Covers Deployment→ReplicaSet→Pod and Job→Pod chains transitively (a pass
+    per level; tick until quiescent for full cascades)."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def _live_uids(self) -> set:
+        live = set()
+        for table in (self.store.replicasets, self.store.deployments, self.store.jobs):
+            for obj in table.values():
+                live.add(obj.uid)
+        return live
+
+    def tick(self) -> int:
+        """One pass; returns number of objects deleted."""
+        deleted = 0
+        live = self._live_uids()
+        for rs in list(self.store.replicasets.values()):
+            ctrl = next((r for r in rs.owner_references if r.controller), None)
+            if ctrl is not None and ctrl.uid not in live:
+                self.store.delete_workload("ReplicaSet", rs.key)
+                deleted += 1
+        live = self._live_uids()
+        for pod in list(self.store.pods.values()):
+            ctrl = _controller_of(pod)
+            if ctrl is not None and ctrl.uid not in live:
+                self.store.delete_pod(pod.uid)
+                deleted += 1
+        return deleted
+
+
+class ControllerManager:
+    """cmd/kube-controller-manager — runs the controller set; tick() is one
+    reconcile round across all of them (deployment before replicaset so a
+    rollout's RS scaling lands in the same round)."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+        self.deployments = DeploymentController(store)
+        self.replicasets = ReplicaSetController(store)
+        self.jobs = JobController(store)
+        self.gc = GarbageCollector(store)
+
+    def tick(self) -> None:
+        self.deployments.tick()
+        self.replicasets.tick()
+        self.jobs.tick()
+        self.gc.tick()
+
+    def tick_until_quiescent(self, max_rounds: int = 20) -> None:
+        for _ in range(max_rounds):
+            before = self.store._rv
+            self.tick()
+            if self.store._rv == before:
+                return

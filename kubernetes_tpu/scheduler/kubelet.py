@@ -1,0 +1,88 @@
+"""Hollow kubelet — the kubemark analog (SURVEY.md §2.3 kubemark row: "real
+kubelet code, mocked CRI/runtime"; §4: "run real code against fake backends").
+
+A HollowKubelet plays the node agent's role against the in-process store:
+
+  - watches for pods bound to its node (the reference's syncLoop source:
+    pods with spec.nodeName == me), runs the pod phase machine
+    Pending -> Running -> Succeeded (pods with run_seconds > 0 complete;
+    others run forever — the service-pod shape)
+  - heartbeats its node Lease every tick (pkg/kubelet/nodelease), which the
+    NodeLifecycleController consumes for failure detection
+  - publishes phase transitions through the pods/status subresource so the
+    scheduler's queue ignores them (no spec change)
+
+No CRI/container runtime is modeled: the pod "runs" by clock alone — exactly
+kubemark's hollow_kubelet.go trade (pkg/kubemark).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api import types as t
+from .leases import LeaseStore
+from .queue import Clock
+from .store import ClusterStore
+
+
+class HollowKubelet:
+    def __init__(
+        self,
+        store: ClusterStore,
+        leases: LeaseStore,
+        node_name: str,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.leases = leases
+        self.node_name = node_name
+        self.clock = clock or leases.clock
+        self._started_at: Dict[str, float] = {}  # pod uid -> Running since
+
+    def tick(self) -> None:
+        """One syncLoop iteration: heartbeat + pod state machine."""
+        self.leases.renew_node_heartbeat(self.node_name)
+        now = self.clock.now()
+        for pod in list(self.store.pods.values()):
+            if pod.node_name != self.node_name:
+                continue
+            if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+                self._started_at.pop(pod.uid, None)
+                continue
+            if pod.phase in ("", t.PHASE_PENDING):
+                # sandbox+containers "started": Pending -> Running
+                self._set_phase(pod, t.PHASE_RUNNING)
+                self._started_at[pod.uid] = now
+            elif pod.phase == t.PHASE_RUNNING:
+                started = self._started_at.setdefault(pod.uid, now)
+                if pod.run_seconds > 0 and now - started >= pod.run_seconds:
+                    self._set_phase(pod, t.PHASE_SUCCEEDED)
+                    self._started_at.pop(pod.uid, None)
+
+    def _set_phase(self, pod: t.Pod, phase: str) -> None:
+        import copy
+
+        q = copy.copy(pod)
+        q.phase = phase
+        self.store.update_pod_status(q)
+
+
+class HollowCluster:
+    """kubemark's hollow-node fleet: one HollowKubelet per node in the store
+    (nodes added later get a kubelet on the next tick)."""
+
+    def __init__(self, store: ClusterStore, leases: LeaseStore):
+        self.store = store
+        self.leases = leases
+        self.kubelets: Dict[str, HollowKubelet] = {}
+
+    def tick(self) -> None:
+        for name in self.store.nodes:
+            if name not in self.kubelets:
+                self.kubelets[name] = HollowKubelet(self.store, self.leases, name)
+        for name in list(self.kubelets):
+            if name not in self.store.nodes:
+                del self.kubelets[name]
+                continue
+            self.kubelets[name].tick()

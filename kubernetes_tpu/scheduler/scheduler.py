@@ -86,7 +86,11 @@ class Scheduler:
                 self.queue.delete(pod.uid)
                 self.queue.move_all_to_active_or_backoff(EV_POD_DELETE)
             elif ev.kind == "ModifiedStatus":
-                pass  # status-only write (nominatedNodeName/phase): no requeue
+                # status-only write: no requeue of THIS pod — but a bound pod
+                # reaching a terminal phase releases capacity, which is an
+                # AssignedPodDelete move event for waiting unschedulable pods
+                if pod.node_name and pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+                    self.queue.move_all_to_active_or_backoff(EV_POD_DELETE)
             elif not pod.node_name:
                 st = self.framework.run_pre_enqueue(pod)
                 if st.ok:

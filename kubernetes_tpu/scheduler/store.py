@@ -35,6 +35,10 @@ class ClusterStore:
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # by uid
         self.pdbs: Dict[str, t.PodDisruptionBudget] = {}  # by namespace/name
+        # workload objects (apps/v1, batch/v1), by namespace/name
+        self.replicasets: Dict[str, t.ReplicaSet] = {}
+        self.deployments: Dict[str, t.Deployment] = {}
+        self.jobs: Dict[str, t.Job] = {}
         self._watchers: List[Callable[[Event], None]] = []
 
     # --- watch ---
@@ -99,6 +103,30 @@ class ClusterStore:
             p = self.pods.pop(uid, None)
             if p is not None:
                 self._emit(Event("Deleted", "Pod", p, self._bump()))
+
+    # --- workload objects (the controller-manager's informers) ---
+    def _workload_table(self, kind: str) -> Dict[str, object]:
+        return {
+            "ReplicaSet": self.replicasets,
+            "Deployment": self.deployments,
+            "Job": self.jobs,
+        }[kind]
+
+    def add_workload(self, kind: str, obj) -> None:
+        with self._lock:
+            self._workload_table(kind)[obj.key] = obj
+            self._emit(Event("Added", kind, obj, self._bump()))
+
+    def update_workload(self, kind: str, obj) -> None:
+        with self._lock:
+            self._workload_table(kind)[obj.key] = obj
+            self._emit(Event("Modified", kind, obj, self._bump()))
+
+    def delete_workload(self, kind: str, key: str) -> None:
+        with self._lock:
+            obj = self._workload_table(kind).pop(key, None)
+            if obj is not None:
+                self._emit(Event("Deleted", kind, obj, self._bump()))
 
     # --- PodDisruptionBudgets (the preemption evaluator's PDB lister) ---
     def add_pdb(self, pdb: t.PodDisruptionBudget) -> None:

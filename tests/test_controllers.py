@@ -1,0 +1,219 @@
+"""Workload controllers + hollow kubelet + GC — the kube-controller-manager /
+kubemark tier (reference: pkg/controller/replicaset — syncReplicaSet,
+deployment rolling update, job_controller — syncJob, garbagecollector;
+pkg/kubemark — hollow kubelet)."""
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.controllers import ControllerManager
+from kubernetes_tpu.scheduler.kubelet import HollowCluster
+from kubernetes_tpu.scheduler.leases import LeaseStore
+from kubernetes_tpu.scheduler.queue import FakeClock
+
+from helpers import mk_node, mk_pod
+
+
+def mk_world(mode="tpu", n_nodes=3, cpu=4000):
+    clock = FakeClock()
+    store = ClusterStore()
+    for i in range(n_nodes):
+        store.add_node(mk_node(f"n{i}", cpu=cpu))
+    sched = Scheduler(store, SchedulerConfiguration(mode=mode), clock=clock)
+    cm = ControllerManager(store)
+    leases = LeaseStore(clock)
+    hollow = HollowCluster(store, leases)
+    return clock, store, sched, cm, hollow
+
+
+def converge(clock, sched, cm, hollow, rounds=10, dt=2.0):
+    for _ in range(rounds):
+        cm.tick()
+        sched.run_until_idle()
+        hollow.tick()
+        clock.step(dt)
+
+
+def rs_pods(store, rs_uid):
+    return [
+        p for p in store.pods.values()
+        if any(r.uid == rs_uid for r in p.owner_references)
+    ]
+
+
+def test_replicaset_scales_up_and_down():
+    clock, store, sched, cm, hollow = mk_world()
+    rs = t.ReplicaSet(
+        name="web", replicas=5,
+        selector=t.LabelSelector.of(app="web"),
+        template=mk_pod("tmpl", cpu=100, labels={"app": "web"}),
+    )
+    store.add_workload("ReplicaSet", rs)
+    converge(clock, sched, cm, hollow)
+    pods = rs_pods(store, rs.uid)
+    assert len(pods) == 5
+    assert all(p.node_name for p in pods)  # all scheduled
+    assert all(p.phase == t.PHASE_RUNNING for p in pods)  # kubelets ran them
+    assert store.replicasets["default/web"].ready_replicas == 5
+    # scale down to 2
+    store.update_workload("ReplicaSet", t.ReplicaSet(
+        name="web", replicas=2, selector=rs.selector, template=rs.template, uid=rs.uid,
+    ))
+    converge(clock, sched, cm, hollow)
+    assert len(rs_pods(store, rs.uid)) == 2
+
+
+def test_replicaset_replaces_deleted_pod():
+    clock, store, sched, cm, hollow = mk_world()
+    store.add_workload("ReplicaSet", t.ReplicaSet(
+        name="web", replicas=3,
+        selector=t.LabelSelector.of(app="web"),
+        template=mk_pod("tmpl", cpu=100, labels={"app": "web"}),
+    ))
+    converge(clock, sched, cm, hollow)
+    victim = next(iter(p for p in store.pods.values() if p.owner_references))
+    store.delete_pod(victim.uid)
+    converge(clock, sched, cm, hollow)
+    alive = [p for p in store.pods.values() if p.owner_references]
+    assert len(alive) == 3
+    assert all(p.node_name for p in alive)
+
+
+def test_job_runs_to_completion():
+    clock, store, sched, cm, hollow = mk_world()
+    store.add_workload("Job", t.Job(
+        name="batch", completions=6, parallelism=2,
+        template=mk_pod("tmpl", cpu=100, labels={"app": "batch"}, run_seconds=1.0),
+    ))
+    converge(clock, sched, cm, hollow, rounds=20)
+    job = store.jobs["default/batch"]
+    assert job.succeeded == 6
+    assert job.complete
+    done = [p for p in store.pods.values() if p.phase == t.PHASE_SUCCEEDED]
+    assert len(done) == 6
+
+
+def test_job_parallelism_cap():
+    clock, store, sched, cm, hollow = mk_world()
+    store.add_workload("Job", t.Job(
+        name="batch", completions=8, parallelism=3,
+        template=mk_pod("tmpl", cpu=100, run_seconds=5.0),
+    ))
+    cm.tick()
+    active = [p for p in store.pods.values() if p.phase != t.PHASE_SUCCEEDED]
+    assert len(active) == 3  # never more than parallelism in flight
+
+
+def test_deployment_rollout_replaces_pods():
+    clock, store, sched, cm, hollow = mk_world()
+    d = t.Deployment(
+        name="api", replicas=4, max_surge=2, max_unavailable=1,
+        selector=t.LabelSelector.of(app="api"),
+        template=mk_pod("tmpl", cpu=100, labels={"app": "api"}),
+    )
+    store.add_workload("Deployment", d)
+    converge(clock, sched, cm, hollow)
+    v1_pods = [p for p in store.pods.values() if p.owner_references]
+    assert len(v1_pods) == 4
+    v1_rs = {rs.name for rs in store.replicasets.values()}
+    assert len(v1_rs) == 1
+    # roll out a new template (different resources -> different hash)
+    store.update_workload("Deployment", t.Deployment(
+        name="api", replicas=4, max_surge=2, max_unavailable=1,
+        selector=d.selector,
+        template=mk_pod("tmpl", cpu=200, labels={"app": "api"}),
+        uid=d.uid,
+    ))
+    converge(clock, sched, cm, hollow, rounds=20)
+    # old RS drained and collected; 4 pods of the new template
+    assert len(store.replicasets) == 1
+    assert set(store.replicasets) != {f"default/{name}" for name in v1_rs}
+    pods = [p for p in store.pods.values() if p.owner_references]
+    assert len(pods) == 4
+    assert all(p.requests[t.CPU] == 200 for p in pods)
+    assert all(p.phase == t.PHASE_RUNNING for p in pods)
+
+
+def test_gc_cascades_deployment_delete():
+    clock, store, sched, cm, hollow = mk_world()
+    d = t.Deployment(
+        name="api", replicas=3,
+        selector=t.LabelSelector.of(app="api"),
+        template=mk_pod("tmpl", cpu=100, labels={"app": "api"}),
+    )
+    store.add_workload("Deployment", d)
+    converge(clock, sched, cm, hollow)
+    assert len([p for p in store.pods.values() if p.owner_references]) == 3
+    store.delete_workload("Deployment", d.key)
+    converge(clock, sched, cm, hollow)
+    assert not store.replicasets  # RS collected
+    assert not [p for p in store.pods.values() if p.owner_references]  # pods too
+
+
+def test_finished_pods_release_capacity():
+    # one small node: a completed job pod must not block the next pod
+    clock, store, sched, cm, hollow = mk_world(n_nodes=1, cpu=1000)
+    store.add_workload("Job", t.Job(
+        name="batch", completions=3, parallelism=1,
+        template=mk_pod("tmpl", cpu=900, run_seconds=1.0),
+    ))
+    converge(clock, sched, cm, hollow, rounds=20)
+    assert store.jobs["default/batch"].succeeded == 3
+
+
+def test_deployment_scale_down():
+    clock, store, sched, cm, hollow = mk_world()
+    d = t.Deployment(
+        name="api", replicas=4,
+        selector=t.LabelSelector.of(app="api"),
+        template=mk_pod("tmpl", cpu=100, labels={"app": "api"}),
+    )
+    store.add_workload("Deployment", d)
+    converge(clock, sched, cm, hollow)
+    assert len([p for p in store.pods.values() if p.owner_references]) == 4
+    store.update_workload("Deployment", t.Deployment(
+        name="api", replicas=2, selector=d.selector, template=d.template, uid=d.uid,
+    ))
+    converge(clock, sched, cm, hollow)
+    assert len([p for p in store.pods.values() if p.owner_references]) == 2
+
+
+def test_rollout_on_affinity_only_template_change():
+    clock, store, sched, cm, hollow = mk_world()
+    d = t.Deployment(
+        name="api", replicas=2,
+        selector=t.LabelSelector.of(app="api"),
+        template=mk_pod("tmpl", cpu=100, labels={"app": "api"}),
+    )
+    store.add_workload("Deployment", d)
+    converge(clock, sched, cm, hollow)
+    v1 = set(store.replicasets)
+    aff = t.Affinity(required_node_terms=(t.NodeSelectorTerm(
+        match_expressions=(t.NodeSelectorRequirement(
+            key=t.LABEL_HOSTNAME, operator=t.OP_EXISTS),)),))
+    store.update_workload("Deployment", t.Deployment(
+        name="api", replicas=2, selector=d.selector,
+        template=mk_pod("tmpl", cpu=100, labels={"app": "api"}, affinity=aff),
+        uid=d.uid,
+    ))
+    converge(clock, sched, cm, hollow, rounds=20)
+    assert set(store.replicasets) != v1  # affinity-only change still rolls
+
+
+def test_unschedulable_pod_wakes_when_bound_pod_completes():
+    # AssignedPodDelete analog: a terminal phase releases capacity and must
+    # requeue unschedulable waiters (scheduler._on_event ModifiedStatus path)
+    clock, store, sched, cm, hollow = mk_world(n_nodes=1, cpu=1000)
+    store.add_pod(mk_pod("runner", cpu=900, run_seconds=1.0))
+    sched.run_until_idle()
+    hollow.tick()  # runner: Pending -> Running
+    store.add_pod(mk_pod("waiter", cpu=900))
+    sched.run_until_idle()
+    assert store.pods["default/waiter"].node_name == ""
+    clock.step(30.0)
+    hollow.tick()  # runner completes -> Succeeded (status write wakes waiter)
+    assert store.pods["default/runner"].phase == t.PHASE_SUCCEEDED
+    clock.step(30.0)  # clear waiter's backoff
+    sched.run_until_idle()
+    assert store.pods["default/waiter"].node_name == "n0"
