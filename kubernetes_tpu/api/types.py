@@ -299,6 +299,8 @@ class Pod:
     requests: ResourceList = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
     node_name: str = ""  # spec.nodeName: "" = pending; set = bound/running
+    priority_class_name: str = ""  # resolved to `priority` by Priority admission
+    pod_ip: str = ""  # status.podIP, assigned by the kubelet when Running
     # status.nominatedNodeName: set by preemption; the node this pod's victims
     # were evicted from, reserved against lower-priority competitors
     nominated_node_name: str = ""

@@ -1,0 +1,257 @@
+"""Admission chain — mutating plugins, then validating plugins.
+
+reference: staging/src/k8s.io/apiserver/pkg/admission (the chain the
+apiserver's createHandler runs between decode and storage: mutation first,
+validation second) plus the in-tree plugins the scheduling path depends on:
+
+  NamespaceLifecycle   plugin/pkg/admission/namespace/lifecycle — reject
+                       creates into missing/terminating namespaces
+  LimitRanger          plugin/pkg/admission/limitranger — default container
+                       requests from LimitRange.defaultRequest; enforce max
+  Priority             plugin/pkg/admission/priority — resolve
+                       priorityClassName -> spec.priority; reject unknown
+                       classes; apply the global default
+  ResourceQuota        plugin/pkg/admission/resourcequota — reject writes
+                       that would push aggregate namespace usage over hard
+                       caps (pods count + summed requests)
+
+Validating-policy analog (ValidatingAdmissionPolicy / CEL): `PolicyPlugin`
+holds named predicates over (attributes) — the expression language is a
+Python callable instead of CEL, same shape: match constraints + validation
+that must hold (apiserver/pkg/admission/plugin/policy/validating).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import cluster as c
+from ..api import types as t
+from .store import ClusterStore
+
+
+class AdmissionDenied(Exception):
+    """A validating (or mutating) plugin rejected the request — the Status
+    Failure the reference returns as HTTP 4xx."""
+
+
+@dataclass
+class Attributes:
+    """admission.Attributes — what every plugin sees."""
+
+    verb: str  # create | update | delete
+    kind: str  # Pod | Node | Service | ...
+    namespace: str
+    obj: object
+    user: Optional[c.UserInfo] = None
+
+
+class AdmissionPlugin:
+    name = "plugin"
+
+    def admit(self, attrs: Attributes) -> None:
+        """Mutating pass — may replace attrs.obj."""
+
+    def validate(self, attrs: Attributes) -> None:
+        """Validating pass — raise AdmissionDenied to reject."""
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    name = "NamespaceLifecycle"
+    _exempt = ("default", "kube-system")
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def validate(self, attrs: Attributes) -> None:
+        if attrs.verb != "create" or not attrs.namespace:
+            return
+        if attrs.kind == "Namespace":
+            return
+        ns = self.store.get_object("Namespace", attrs.namespace)
+        if ns is None:
+            if attrs.namespace in self._exempt:
+                return  # implicit system namespaces
+            raise AdmissionDenied(f"namespace {attrs.namespace!r} not found")
+        if ns.phase == "Terminating":
+            raise AdmissionDenied(
+                f"namespace {attrs.namespace!r} is terminating: new objects forbidden"
+            )
+
+
+class LimitRanger(AdmissionPlugin):
+    name = "LimitRanger"
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def _ranges(self, namespace: str) -> List[c.LimitRange]:
+        return self.store.list_objects("LimitRange", namespace)
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.kind != "Pod" or attrs.verb != "create":
+            return
+        pod: t.Pod = attrs.obj  # type: ignore[assignment]
+        for lr in self._ranges(attrs.namespace):
+            missing = {
+                r: v for r, v in lr.default_request.items() if r not in pod.requests
+            }
+            if missing:
+                pod = copy.copy(pod)
+                pod.requests = {**pod.requests, **missing}
+                attrs.obj = pod
+
+    def validate(self, attrs: Attributes) -> None:
+        if attrs.kind != "Pod" or attrs.verb != "create":
+            return
+        pod: t.Pod = attrs.obj  # type: ignore[assignment]
+        for lr in self._ranges(attrs.namespace):
+            for r, cap in lr.max_per_pod.items():
+                if pod.requests.get(r, 0) > cap:
+                    raise AdmissionDenied(
+                        f"maximum {r} usage per Pod is {cap}, but request is "
+                        f"{pod.requests[r]} (limitrange {lr.name})"
+                    )
+
+
+class PriorityAdmission(AdmissionPlugin):
+    name = "Priority"
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.kind != "Pod" or attrs.verb != "create":
+            return
+        pod: t.Pod = attrs.obj  # type: ignore[assignment]
+        if pod.priority_class_name:
+            pc = self.store.get_object("PriorityClass", pod.priority_class_name)
+            if pc is None:
+                raise AdmissionDenied(
+                    f"no PriorityClass with name {pod.priority_class_name} was found"
+                )
+            value = pc.value
+        elif pod.priority != 0:
+            # the reference rejects user-supplied spec.priority: only this
+            # admission plugin may compute it (plugin/pkg/admission/priority)
+            raise AdmissionDenied(
+                "the integer value of priority must not be provided in pod spec; "
+                "priority admission controller computes it from priorityClassName"
+            )
+        else:
+            default = next(
+                (
+                    pc
+                    for pc in self.store.list_objects("PriorityClass")
+                    if pc.global_default
+                ),
+                None,
+            )
+            if default is None:
+                return
+            value = default.value
+        if pod.priority != value:
+            pod = copy.copy(pod)
+            pod.priority = value
+            attrs.obj = pod
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    name = "ResourceQuota"
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def _usage(self, namespace: str) -> Dict[str, int]:
+        used: Dict[str, int] = {"pods": 0}
+        for pod in self.store.pods.values():
+            if pod.namespace != namespace or pod.phase in (
+                t.PHASE_SUCCEEDED,
+                t.PHASE_FAILED,
+            ):
+                continue
+            used["pods"] += 1
+            for r, v in pod.requests.items():
+                used[r] = used.get(r, 0) + v
+        return used
+
+    def validate(self, attrs: Attributes) -> None:
+        if attrs.kind != "Pod" or attrs.verb != "create":
+            return
+        pod: t.Pod = attrs.obj  # type: ignore[assignment]
+        quotas = self.store.list_objects("ResourceQuota", attrs.namespace)
+        if not quotas:
+            return
+        used = self._usage(attrs.namespace)
+        for q in quotas:
+            for r, hard in q.hard.items():
+                delta = 1 if r == "pods" else pod.requests.get(r, 0)
+                if used.get(r, 0) + delta > hard:
+                    raise AdmissionDenied(
+                        f"exceeded quota: {q.name}, requested: {r}={delta}, "
+                        f"used: {r}={used.get(r, 0)}, limited: {r}={hard}"
+                    )
+            # record status for observability (the quota controller's job)
+            self.store.objects["ResourceQuota"][q.key] = replace(
+                q, used={r: used.get(r, 0) for r in q.hard}
+            )
+
+
+@dataclass(frozen=True)
+class ValidatingPolicy:
+    """ValidatingAdmissionPolicy-lite: match by kind, check must hold."""
+
+    name: str
+    check: Callable[[Attributes], bool]
+    message: str = "policy denied"
+    kinds: Tuple[str, ...] = ("*",)
+
+
+class PolicyPlugin(AdmissionPlugin):
+    """apiserver/pkg/admission/plugin/policy/validating — the CEL policy
+    evaluator with the expression language swapped for Python callables."""
+
+    name = "ValidatingAdmissionPolicy"
+
+    def __init__(self) -> None:
+        self.policies: List[ValidatingPolicy] = []
+
+    def add(self, policy: ValidatingPolicy) -> None:
+        self.policies.append(policy)
+
+    def validate(self, attrs: Attributes) -> None:
+        for p in self.policies:
+            if "*" not in p.kinds and attrs.kind not in p.kinds:
+                continue
+            if not p.check(attrs):
+                raise AdmissionDenied(f"{p.name}: {p.message}")
+
+
+class AdmissionChain:
+    """admission.NewChainHandler — all mutating admits, then all validates."""
+
+    def __init__(self, plugins: List[AdmissionPlugin]):
+        self.plugins = plugins
+
+    @staticmethod
+    def default(store: ClusterStore, policies: Optional[PolicyPlugin] = None
+                ) -> "AdmissionChain":
+        plugins: List[AdmissionPlugin] = [
+            NamespaceLifecycle(store),
+            LimitRanger(store),
+            PriorityAdmission(store),
+            ResourceQuotaAdmission(store),
+        ]
+        if policies is not None:
+            plugins.append(policies)
+        return AdmissionChain(plugins)
+
+    def run(self, attrs: Attributes) -> object:
+        """-> the (possibly mutated) object; raises AdmissionDenied."""
+        for p in self.plugins:
+            p.admit(attrs)
+        for p in self.plugins:
+            p.validate(attrs)
+        return attrs.obj

@@ -1,0 +1,260 @@
+"""APIServer facade — the generic server's handler chain, in-process.
+
+reference: staging/src/k8s.io/apiserver/pkg/server/config.go —
+DefaultBuildHandlerChain: panic-recovery -> timeout -> authentication ->
+audit -> Priority&Fairness -> authorization -> admission -> registry store.
+This facade reproduces that order over the in-process ClusterStore: each
+`handle()` call is one API request.  Components that want the unfiltered
+fast path (the scheduler's own binding loop, the harness) keep talking to
+ClusterStore directly — the reference's loopback client is similarly exempted
+from APF (the "exempt" priority level).
+
+Also owns the Service ClusterIP allocator (the core/v1 Service REST strategy's
+ipallocator — pkg/registry/core/service/ipallocator).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..api import cluster as c
+from ..api import types as t
+from .admission import AdmissionChain, AdmissionDenied, Attributes, PolicyPlugin
+from .auth import RBACAuthorizer, TokenAuthenticator
+from .flowcontrol import APFController, Request, RequestRejected
+from .store import ClusterStore
+
+
+class Unauthenticated(Exception):
+    """HTTP 401."""
+
+
+class Forbidden(Exception):
+    """HTTP 403."""
+
+
+# kind -> RBAC resource name (lowercased plural, the RESTMapper's job)
+_RESOURCES = {
+    "Pod": "pods",
+    "Node": "nodes",
+    "PDB": "poddisruptionbudgets",
+    "Service": "services",
+    "EndpointSlice": "endpointslices",
+    "Namespace": "namespaces",
+    "ReplicaSet": "replicasets",
+    "Deployment": "deployments",
+    "Job": "jobs",
+    "StatefulSet": "statefulsets",
+    "DaemonSet": "daemonsets",
+    "CronJob": "cronjobs",
+    "PriorityClass": "priorityclasses",
+    "ResourceQuota": "resourcequotas",
+    "LimitRange": "limitranges",
+    "HorizontalPodAutoscaler": "horizontalpodautoscalers",
+    "Role": "roles",
+    "RoleBinding": "rolebindings",
+    "Lease": "leases",
+}
+
+
+def resource_of(kind: str) -> str:
+    return _RESOURCES.get(kind, kind.lower() + "s")
+
+
+@dataclass
+class AuditEvent:
+    """audit/v1 — the fields that matter for the log (apiserver/pkg/audit)."""
+
+    user: str
+    verb: str
+    resource: str
+    namespace: str
+    name: str
+    allowed: bool
+    reason: str = ""
+
+
+class ClusterIPAllocator:
+    """pkg/registry/core/service/ipallocator — sequential allocator over a
+    /16 service CIDR with reuse of freed addresses."""
+
+    def __init__(self, prefix: str = "10.96"):
+        self.prefix = prefix
+        self._next = 1
+        self._free: List[int] = []
+        self._used: set = set()
+
+    def allocate(self) -> str:
+        if self._free:
+            n = self._free.pop()
+        else:
+            n = self._next
+            self._next += 1
+        self._used.add(n)
+        return f"{self.prefix}.{n >> 8 & 0xFF}.{n & 0xFF}"
+
+    def release(self, ip: str) -> None:
+        parts = ip.split(".")
+        n = (int(parts[2]) << 8) | int(parts[3])
+        if n in self._used:
+            self._used.discard(n)
+            self._free.append(n)
+
+
+class APIServer:
+    def __init__(
+        self,
+        store: ClusterStore,
+        authenticator: Optional[TokenAuthenticator] = None,
+        policies: Optional[PolicyPlugin] = None,
+        total_concurrency: int = 600,
+        queue_wait_s: float = 5.0,
+    ):
+        self.store = store
+        self.queue_wait_s = queue_wait_s
+        self.authn = authenticator or TokenAuthenticator()
+        self.authz = RBACAuthorizer(store)
+        self.apf = APFController(store, total_concurrency=total_concurrency)
+        self.admission = AdmissionChain.default(store, policies)
+        self.audit_log: List[AuditEvent] = []
+        self.ips = ClusterIPAllocator()
+
+    # -- the handler chain --
+    def handle(
+        self,
+        token: Optional[str],
+        verb: str,
+        kind: str,
+        obj: object = None,
+        namespace: str = "",
+        name: str = "",
+    ):
+        """One request through the full chain.  Returns the stored object for
+        writes / the object (list) for reads."""
+        resource = resource_of(kind)
+        ns = namespace or getattr(obj, "namespace", "") or ""
+        nm = name or getattr(obj, "name", "") or ""
+
+        # authentication
+        user = self.authn.authenticate(token)
+        if user is None:
+            self._audit("anonymous", verb, resource, ns, nm, False, "unauthenticated")
+            raise Unauthenticated("invalid or missing bearer token")
+
+        # priority & fairness: classify + fair-queue; in this synchronous
+        # facade the request must come out of dispatch() before proceeding
+        # (exempt levels release immediately and never queue)
+        req = Request(user=user.name, verb=verb, resource=resource, namespace=ns)
+        self.apf.admit(req)  # raises RequestRejected (429) when queues overflow
+        deadline = time.monotonic() + self.queue_wait_s
+        while not req.released:
+            self.apf.dispatch()
+            if req.released:
+                break
+            if time.monotonic() > deadline:
+                raise RequestRejected(
+                    f"request from {user.name!r} timed out waiting for a seat "
+                    f"at level {req.level!r}"
+                )
+            time.sleep(0.001)  # seats held by concurrent callers
+
+        try:
+            # authorization
+            if not self.authz.authorize(user, verb, resource, ns, nm):
+                self._audit(user.name, verb, resource, ns, nm, False, "forbidden")
+                raise Forbidden(
+                    f'user "{user.name}" cannot {verb} resource "{resource}"'
+                    + (f' in namespace "{ns}"' if ns else "")
+                )
+
+            # admission (writes only), then the registry
+            if verb in ("create", "update"):
+                attrs = Attributes(verb=verb, kind=kind, namespace=ns, obj=obj,
+                                   user=user)
+                obj = self.admission.run(attrs)  # raises AdmissionDenied (400)
+                out = self._write(verb, kind, obj)
+            elif verb == "delete":
+                self._delete(kind, ns, nm)
+                out = None
+            elif verb == "list":
+                out = self._list(kind, ns or None)
+            elif verb == "get":
+                out = self._get(kind, ns, nm)
+            else:
+                raise ValueError(f"unsupported verb {verb!r}")
+            self._audit(user.name, verb, resource, ns, nm, True)
+            return out
+        finally:
+            self.apf.finish(req)
+
+    # -- registry dispatch --
+    def _write(self, verb: str, kind: str, obj):
+        if kind == "Pod":
+            (self.store.add_pod if verb == "create" else self.store.update_pod)(obj)
+        elif kind == "Node":
+            (self.store.add_node if verb == "create" else self.store.update_node)(obj)
+        elif kind == "PDB":
+            (self.store.add_pdb if verb == "create" else self.store.update_pdb)(obj)
+        else:
+            if kind == "Service" and verb == "create" and not obj.cluster_ip:
+                obj.cluster_ip = self.ips.allocate()
+            (self.store.add_object if verb == "create" else self.store.update_object)(
+                kind, obj
+            )
+        return obj
+
+    def _find_pod(self, ns: str, name: str):
+        """Pods are stored by uid; API identity is namespace/name.  Try the
+        defaulted-uid fast path, then scan (the registry's name index)."""
+        p = self.store.pods.get(f"{ns}/{name}")
+        if p is not None and p.namespace == ns and p.name == name:
+            return p
+        for p in self.store.pods.values():
+            if p.namespace == ns and p.name == name:
+                return p
+        return None
+
+    def _delete(self, kind: str, ns: str, name: str) -> None:
+        key = f"{ns}/{name}" if ns else name
+        if kind == "Pod":
+            p = self._find_pod(ns, name)
+            if p is not None:
+                self.store.delete_pod(p.uid)
+        elif kind == "Node":
+            self.store.delete_node(name)
+        elif kind == "PDB":
+            self.store.delete_pdb(key)
+        else:
+            if kind == "Service":
+                svc = self.store.get_object("Service", key)
+                if svc is not None and svc.cluster_ip:
+                    self.ips.release(svc.cluster_ip)
+            self.store.delete_object(kind, key)
+
+    def _get(self, kind: str, ns: str, name: str):
+        if kind == "Pod":
+            return self._find_pod(ns, name)
+        if kind == "Node":
+            return self.store.nodes.get(name)
+        if kind == "PDB":
+            return self.store.pdbs.get(f"{ns}/{name}")
+        return self.store.get_object(kind, f"{ns}/{name}" if ns else name)
+
+    def _list(self, kind: str, ns: Optional[str]):
+        if kind == "Pod":
+            return [p for p in self.store.pods.values()
+                    if ns is None or p.namespace == ns]
+        if kind == "Node":
+            return list(self.store.nodes.values())
+        if kind == "PDB":
+            return [p for p in self.store.pdbs.values()
+                    if ns is None or p.namespace == ns]
+        return self.store.list_objects(kind, ns)
+
+    def _audit(self, user: str, verb: str, resource: str, ns: str, name: str,
+               allowed: bool, reason: str = "") -> None:
+        self.audit_log.append(
+            AuditEvent(user, verb, resource, ns, name, allowed, reason)
+        )

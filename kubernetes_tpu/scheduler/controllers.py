@@ -24,7 +24,7 @@ from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
-from .store import ClusterStore
+from .store import ClusterStore, _key_of
 
 
 def _is_finished(pod: t.Pod) -> bool:
@@ -265,20 +265,34 @@ class GarbageCollector:
 
     def _live_uids(self) -> set:
         live = set()
-        for table in (self.store.replicasets, self.store.deployments, self.store.jobs):
+        for table in self.store.objects.values():
             for obj in table.values():
-                live.add(obj.uid)
+                uid = getattr(obj, "uid", "")
+                if uid:
+                    live.add(uid)
+        # pods and nodes can own objects too (EndpointSlice<-Service is the
+        # common case, but Pod- and Node-owned objects exist in the reference)
+        for pod in self.store.pods.values():
+            live.add(pod.uid)
+        for name in self.store.nodes:
+            live.add(f"node/{name}")
         return live
 
     def tick(self) -> int:
-        """One pass; returns number of objects deleted."""
+        """One pass; returns number of objects deleted.  Covers every
+        registered kind (CRDs included) whose objects carry owner_references,
+        then pods — the reference GC's dependency graph walks all GVRs the
+        same way (garbagecollector/graph_builder.go monitors every
+        deletable resource)."""
         deleted = 0
         live = self._live_uids()
-        for rs in list(self.store.replicasets.values()):
-            ctrl = next((r for r in rs.owner_references if r.controller), None)
-            if ctrl is not None and ctrl.uid not in live:
-                self.store.delete_workload("ReplicaSet", rs.key)
-                deleted += 1
+        for kind in list(self.store.objects):
+            for obj in list(self.store.objects[kind].values()):
+                refs = getattr(obj, "owner_references", ())
+                ctrl = next((r for r in refs if r.controller), None)
+                if ctrl is not None and ctrl.uid not in live:
+                    self.store.delete_object(kind, _key_of(obj))
+                    deleted += 1
         live = self._live_uids()
         for pod in list(self.store.pods.values()):
             ctrl = _controller_of(pod)

@@ -1,0 +1,201 @@
+"""API Priority & Fairness — flow classification + shuffle-sharded fair queuing.
+
+reference: staging/src/k8s.io/apiserver/pkg/util/flowcontrol —
+apf_controller.go (match request -> FlowSchema by precedence -> priority
+level) and fairqueuing/queueset/queueset.go (per-priority-level queue set:
+a flow is hashed to a `hand_size` shuffle-shard of the level's queues, lands
+on the shortest; dispatch picks the queue with the least virtual finish time,
+so one elephant flow cannot starve mice sharing the level).  Seats/concurrency
+are normalized to 1 seat per request; virtual time advances by 1/width per
+dispatch, the reference's R(t) progress with unit service time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..api import cluster as c
+from .store import ClusterStore
+
+
+class RequestRejected(Exception):
+    """Queue full (or no schema matched) — HTTP 429 in the reference."""
+
+
+@dataclass
+class Request:
+    user: str
+    verb: str = "get"
+    resource: str = "pods"
+    namespace: str = ""
+    # set by classify/enqueue
+    flow: str = ""
+    level: str = ""
+    released: bool = False  # set by dispatch() (or immediately when exempt)
+    _queue: Optional["_Queue"] = None
+
+
+@dataclass
+class _Queue:
+    index: int
+    requests: Deque[Request] = field(default_factory=deque)
+    virtual_start: float = 0.0
+    executing: int = 0
+
+
+def _hand(flow_key: str, n_queues: int, hand_size: int) -> List[int]:
+    """Shuffle-sharding dealer: derive `hand_size` distinct queue indices from
+    the flow hash (fairqueuing — shufflesharding.Dealer.DealIntoHand)."""
+    h = int.from_bytes(hashlib.sha256(flow_key.encode()).digest()[:8], "big")
+    hand: List[int] = []
+    remaining = list(range(n_queues))
+    for _ in range(min(hand_size, n_queues)):
+        h, idx = divmod(h, len(remaining))
+        hand.append(remaining.pop(idx))
+    return hand
+
+
+class QueueSet:
+    """One priority level's fair-queuing state."""
+
+    def __init__(self, plc: c.PriorityLevelConfiguration, concurrency: int):
+        self.plc = plc
+        self.concurrency = max(1, concurrency)
+        self.queues = [_Queue(i) for i in range(max(1, plc.queues))]
+        self.in_flight = 0
+        self.virtual_time = 0.0
+
+    def enqueue(self, req: Request) -> None:
+        if self.plc.exempt:
+            # exempt levels never queue or limit (flowcontrol/v1 Exempt type)
+            self.in_flight += 1
+            req.released = True
+            return
+        hand = _hand(req.flow, len(self.queues), self.plc.hand_size)
+        q = min((self.queues[i] for i in hand), key=lambda q: len(q.requests))
+        if len(q.requests) >= self.plc.queue_length_limit:
+            raise RequestRejected(
+                f"too many requests for flow {req.flow!r} at level {self.plc.name}"
+            )
+        if not q.requests and q.executing == 0:
+            # empty queue (re)joins at current virtual time (queueset.go —
+            # the queue's virtual start clock catches up while idle)
+            q.virtual_start = self.virtual_time
+        q.requests.append(req)
+        req._queue = q
+
+    def dispatch(self) -> List[Request]:
+        """Release as many requests as free seats allow, fair-queue order."""
+        out: List[Request] = []
+        while self.in_flight < self.concurrency:
+            nonempty = [q for q in self.queues if q.requests]
+            if not nonempty:
+                break
+            # least virtual finish time of the head request (width 1)
+            q = min(nonempty, key=lambda q: (q.virtual_start, q.index))
+            req = q.requests.popleft()
+            q.virtual_start += 1.0
+            q.executing += 1
+            self.virtual_time = max(self.virtual_time, q.virtual_start - 1.0)
+            self.in_flight += 1
+            req.released = True
+            out.append(req)
+        return out
+
+    def finish(self, req: Request) -> None:
+        self.in_flight -= 1
+        if req._queue is not None:
+            req._queue.executing -= 1
+
+
+DEFAULT_LEVELS = (
+    c.PriorityLevelConfiguration(name="exempt", exempt=True),
+    c.PriorityLevelConfiguration(name="leader-election", concurrency_shares=10,
+                                 queues=16, hand_size=4),
+    c.PriorityLevelConfiguration(name="workload-high", concurrency_shares=40),
+    c.PriorityLevelConfiguration(name="workload-low", concurrency_shares=100),
+    c.PriorityLevelConfiguration(name="catch-all", concurrency_shares=5,
+                                 queues=1, hand_size=1),
+)
+
+DEFAULT_SCHEMAS = (
+    c.FlowSchema(name="system-leader-election", priority_level="leader-election",
+                 matching_precedence=100, resources=("leases",)),
+    c.FlowSchema(name="kube-scheduler", priority_level="exempt",
+                 matching_precedence=100, subjects=("system:kube-scheduler",)),
+    c.FlowSchema(name="service-accounts", priority_level="workload-low",
+                 matching_precedence=9000),
+    c.FlowSchema(name="catch-all", priority_level="catch-all",
+                 matching_precedence=10000),
+)
+
+
+class APFController:
+    """apf_controller.go — owns the schema/level config and the queue sets.
+    total_concurrency is divided between levels by concurrency_shares."""
+
+    def __init__(self, store: ClusterStore, total_concurrency: int = 600):
+        self.store = store
+        self.total_concurrency = total_concurrency
+        if not store.objects["PriorityLevelConfiguration"]:
+            for plc in DEFAULT_LEVELS:
+                store.add_object("PriorityLevelConfiguration", plc)
+        if not store.objects["FlowSchema"]:
+            for fs in DEFAULT_SCHEMAS:
+                store.add_object("FlowSchema", fs)
+        self.queue_sets: Dict[str, QueueSet] = {}
+        self.resync()
+
+    def resync(self) -> None:
+        levels: List[c.PriorityLevelConfiguration] = self.store.list_objects(
+            "PriorityLevelConfiguration"
+        )
+        total_shares = sum(p.concurrency_shares for p in levels if not p.exempt) or 1
+        for plc in levels:
+            cl = max(1, round(self.total_concurrency * plc.concurrency_shares
+                              / total_shares))
+            existing = self.queue_sets.get(plc.name)
+            if existing is None or existing.plc is not plc or existing.concurrency != cl:
+                self.queue_sets[plc.name] = QueueSet(plc, cl)
+
+    def classify(self, req: Request) -> Tuple[c.FlowSchema, QueueSet]:
+        schemas = sorted(
+            self.store.list_objects("FlowSchema"),
+            key=lambda s: (s.matching_precedence, s.name),
+        )
+        for fs in schemas:
+            if "*" not in fs.subjects and req.user not in fs.subjects:
+                continue
+            if "*" not in fs.resources and req.resource not in fs.resources:
+                continue
+            if "*" not in fs.verbs and req.verb not in fs.verbs:
+                continue
+            qs = self.queue_sets.get(fs.priority_level)
+            if qs is None:
+                continue
+            return fs, qs
+        raise RequestRejected(f"no FlowSchema matches request from {req.user!r}")
+
+    def admit(self, req: Request) -> None:
+        """Classify + enqueue.  Call dispatch() to release runnable requests."""
+        fs, qs = self.classify(req)
+        req.level = fs.priority_level
+        if fs.distinguisher == "ByUser":
+            req.flow = f"{fs.name}/{req.user}"
+        elif fs.distinguisher == "ByNamespace":
+            req.flow = f"{fs.name}/{req.namespace}"
+        else:
+            req.flow = fs.name
+        qs.enqueue(req)
+
+    def dispatch(self) -> List[Request]:
+        out: List[Request] = []
+        for qs in self.queue_sets.values():
+            out.extend(qs.dispatch())
+        return out
+
+    def finish(self, req: Request) -> None:
+        self.queue_sets[req.level].finish(req)

@@ -39,14 +39,17 @@ class HollowKubelet:
         self.node_name = node_name
         self.clock = clock or leases.clock
         self._started_at: Dict[str, float] = {}  # pod uid -> Running since
+        self._ip_seq = 0  # pod IP allocator cursor (status.podIP)
 
     def tick(self) -> None:
         """One syncLoop iteration: heartbeat + pod state machine."""
         self.leases.renew_node_heartbeat(self.node_name)
         now = self.clock.now()
+        mine = set()
         for pod in list(self.store.pods.values()):
             if pod.node_name != self.node_name:
                 continue
+            mine.add(pod.uid)
             if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
                 self._started_at.pop(pod.uid, None)
                 continue
@@ -59,13 +62,28 @@ class HollowKubelet:
                 if pod.run_seconds > 0 and now - started >= pod.run_seconds:
                     self._set_phase(pod, t.PHASE_SUCCEEDED)
                     self._started_at.pop(pod.uid, None)
+        # housekeeping: drop state for pods deleted while Running
+        for uid in list(self._started_at):
+            if uid not in mine:
+                del self._started_at[uid]
 
     def _set_phase(self, pod: t.Pod, phase: str) -> None:
         import copy
 
         q = copy.copy(pod)
         q.phase = phase
+        if phase == t.PHASE_RUNNING and not q.pod_ip:
+            # status.podIP from the node's pod CIDR (nodeipam's per-node
+            # 10.244.x.0/24 shape; the sandbox IP the CRI would report)
+            q.pod_ip = self._alloc_ip()
         self.store.update_pod_status(q)
+
+    def _alloc_ip(self) -> str:
+        import zlib
+
+        subnet = zlib.crc32(self.node_name.encode()) & 0xFF  # run-stable
+        self._ip_seq += 1
+        return f"10.244.{subnet}.{self._ip_seq & 0xFF}"
 
 
 class HollowCluster:

@@ -6,6 +6,16 @@ objects): a strongly-ordered object store with monotonically increasing
 resourceVersion and level-triggered watch fan-out (one event stream -> N
 subscribers, the cacher pattern from apiserver/pkg/storage/cacher).
 
+Besides the dedicated hot-path tables (nodes, pods, PDBs), the store carries a
+**dynamic kind registry**: `register_kind()` creates a new keyed table with
+full add/update/delete + watch semantics at runtime.  This is the framework's
+CustomResourceDefinition mechanism (the apiextensions-apiserver analog —
+reference: staging/src/k8s.io/apiextensions-apiserver serves user-defined
+types through the same generic registry.Store the built-ins use); built-in
+workload kinds (ReplicaSet, Deployment, Job, ...) are simply pre-registered
+kinds in the same tables, exactly as CRDs and built-ins share one storage
+layer in the reference.
+
 Single-writer by design (one lock around mutations) — the framework's answer
 to the reference's optimistic-concurrency CAS: there is exactly one scheduler
 mutating bindings in-process, so CAS degenerates to serialized apply.
@@ -14,7 +24,7 @@ mutating bindings in-process, so CAS degenerates to serialized apply.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, replace
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as t
@@ -22,10 +32,46 @@ from ..api import types as t
 
 @dataclass(frozen=True)
 class Event:
-    kind: str  # Added | Modified | Deleted
-    obj_type: str  # Node | Pod
+    kind: str  # Added | Modified | ModifiedStatus | Deleted
+    obj_type: str  # Node | Pod | PDB | any registered kind
     obj: object
     resource_version: int
+
+
+# kinds every store starts with (the reference's built-in API groups); more
+# arrive via register_kind (the CRD path)
+BUILTIN_KINDS = (
+    "ReplicaSet",
+    "Deployment",
+    "Job",
+    "StatefulSet",
+    "DaemonSet",
+    "CronJob",
+    "Service",
+    "EndpointSlice",
+    "Namespace",
+    "PriorityClass",
+    "ResourceQuota",
+    "LimitRange",
+    "HorizontalPodAutoscaler",
+    "Role",
+    "RoleBinding",
+    "FlowSchema",
+    "PriorityLevelConfiguration",
+    "StorageClass",
+    "ResourceSlice",
+    "DeviceClass",
+)
+
+
+def _key_of(obj) -> str:
+    """namespace/name key (metav1 ObjectMeta identity)."""
+    key = getattr(obj, "key", None)
+    if key is not None:
+        return key
+    ns = getattr(obj, "namespace", "")
+    name = getattr(obj, "name", "")
+    return f"{ns}/{name}" if ns else name
 
 
 class ClusterStore:
@@ -35,11 +81,23 @@ class ClusterStore:
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # by uid
         self.pdbs: Dict[str, t.PodDisruptionBudget] = {}  # by namespace/name
-        # workload objects (apps/v1, batch/v1), by namespace/name
-        self.replicasets: Dict[str, t.ReplicaSet] = {}
-        self.deployments: Dict[str, t.Deployment] = {}
-        self.jobs: Dict[str, t.Job] = {}
+        # dynamic kind registry: kind -> {key -> obj}
+        self.objects: Dict[str, Dict[str, object]] = {k: {} for k in BUILTIN_KINDS}
         self._watchers: List[Callable[[Event], None]] = []
+
+    # --- CRD mechanism ---
+    def register_kind(self, kind: str) -> None:
+        """Create a new object table at runtime — the CustomResourceDefinition
+        path (apiextensions-apiserver: established CRDs get REST storage wired
+        into the same generic registry as built-ins)."""
+        with self._lock:
+            if kind in ("Node", "Pod", "PDB"):
+                raise ValueError(f"{kind} is a dedicated table")
+            if kind not in self.objects:
+                self.objects[kind] = {}
+
+    def kinds(self) -> List[str]:
+        return ["Node", "Pod", "PDB", *self.objects.keys()]
 
     # --- watch ---
     def watch(self, fn: Callable[[Event], None], replay: bool = True) -> None:
@@ -104,29 +162,59 @@ class ClusterStore:
             if p is not None:
                 self._emit(Event("Deleted", "Pod", p, self._bump()))
 
-    # --- workload objects (the controller-manager's informers) ---
-    def _workload_table(self, kind: str) -> Dict[str, object]:
-        return {
-            "ReplicaSet": self.replicasets,
-            "Deployment": self.deployments,
-            "Job": self.jobs,
-        }[kind]
+    # --- generic objects (built-in workload kinds + CRDs) ---
+    def _table(self, kind: str) -> Dict[str, object]:
+        try:
+            return self.objects[kind]
+        except KeyError:
+            raise KeyError(f"kind {kind!r} not registered (register_kind first)")
 
-    def add_workload(self, kind: str, obj) -> None:
+    def add_object(self, kind: str, obj) -> None:
         with self._lock:
-            self._workload_table(kind)[obj.key] = obj
+            self._table(kind)[_key_of(obj)] = obj
             self._emit(Event("Added", kind, obj, self._bump()))
 
-    def update_workload(self, kind: str, obj) -> None:
+    def update_object(self, kind: str, obj) -> None:
         with self._lock:
-            self._workload_table(kind)[obj.key] = obj
+            self._table(kind)[_key_of(obj)] = obj
             self._emit(Event("Modified", kind, obj, self._bump()))
 
-    def delete_workload(self, kind: str, key: str) -> None:
+    def delete_object(self, kind: str, key: str) -> None:
         with self._lock:
-            obj = self._workload_table(kind).pop(key, None)
+            obj = self._table(kind).pop(key, None)
             if obj is not None:
                 self._emit(Event("Deleted", kind, obj, self._bump()))
+
+    def get_object(self, kind: str, key: str):
+        return self._table(kind).get(key)
+
+    def list_objects(self, kind: str, namespace: Optional[str] = None) -> list:
+        out = list(self._table(kind).values())
+        if namespace is not None:
+            out = [o for o in out if getattr(o, "namespace", "") == namespace]
+        return out
+
+    # --- workload aliases (original controller-facing API) ---
+    @property
+    def replicasets(self) -> Dict[str, t.ReplicaSet]:
+        return self.objects["ReplicaSet"]  # type: ignore[return-value]
+
+    @property
+    def deployments(self) -> Dict[str, t.Deployment]:
+        return self.objects["Deployment"]  # type: ignore[return-value]
+
+    @property
+    def jobs(self) -> Dict[str, t.Job]:
+        return self.objects["Job"]  # type: ignore[return-value]
+
+    def add_workload(self, kind: str, obj) -> None:
+        self.add_object(kind, obj)
+
+    def update_workload(self, kind: str, obj) -> None:
+        self.update_object(kind, obj)
+
+    def delete_workload(self, kind: str, key: str) -> None:
+        self.delete_object(kind, key)
 
     # --- PodDisruptionBudgets (the preemption evaluator's PDB lister) ---
     def add_pdb(self, pdb: t.PodDisruptionBudget) -> None:
