@@ -387,17 +387,21 @@ class Deployment:
 
 @dataclass
 class Job:
-    """batch/v1 — type Job: run pods to completion (completions/parallelism)."""
+    """batch/v1 — type Job: run pods to completion (completions/parallelism).
+    ttl_seconds_after_finished drives the TTL-after-finished controller."""
 
     name: str
     namespace: str = "default"
     completions: int = 1
     parallelism: int = 1
     template: Optional["Pod"] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    owner_references: Tuple[OwnerReference, ...] = ()  # CronJob -> Job edge
     uid: str = ""
     # status
     succeeded: int = 0
     active: int = 0
+    completion_time: float = -1.0  # clock time the job finished (-1 = not yet)
 
     def __post_init__(self) -> None:
         if not self.uid:

@@ -193,10 +193,12 @@ class ResourceQuotaAdmission(AdmissionPlugin):
                         f"exceeded quota: {q.name}, requested: {r}={delta}, "
                         f"used: {r}={used.get(r, 0)}, limited: {r}={hard}"
                     )
-            # record status for observability (the quota controller's job)
-            self.store.objects["ResourceQuota"][q.key] = replace(
-                q, used={r: used.get(r, 0) for r in q.hard}
-            )
+        # all quotas passed: record status through the store (locked write +
+        # watch event — the quota controller's updateQuota role)
+        for q in quotas:
+            new_used = {r: used.get(r, 0) for r in q.hard}
+            if new_used != q.used:
+                self.store.update_object("ResourceQuota", replace(q, used=new_used))
 
 
 @dataclass(frozen=True)

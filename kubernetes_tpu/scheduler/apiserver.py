@@ -154,6 +154,7 @@ class APIServer:
             if req.released:
                 break
             if time.monotonic() > deadline:
+                self.apf.cancel(req)  # dequeue (or return a late-released seat)
                 raise RequestRejected(
                     f"request from {user.name!r} timed out waiting for a seat "
                     f"at level {req.level!r}"

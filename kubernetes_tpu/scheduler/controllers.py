@@ -221,10 +221,14 @@ class DeploymentController:
 
 class JobController:
     """job_controller.go — syncJob: keep min(parallelism, remaining) pods
-    active until `completions` pods have succeeded."""
+    active until `completions` pods have succeeded; stamp completionTime when
+    done (consumed by the TTL-after-finished controller)."""
 
-    def __init__(self, store: ClusterStore):
+    def __init__(self, store: ClusterStore, clock=None):
+        from .queue import Clock
+
         self.store = store
+        self.clock = clock or Clock()
         self._seq = itertools.count()
 
     def sync(self, job: t.Job) -> None:
@@ -244,9 +248,18 @@ class JobController:
             self.store.add_pod(_stamp(tmpl, name, job.namespace, owner))
         for p in active[want_active:] if want_active < len(active) else []:
             self.store.delete_pod(p.uid)
-        if succeeded != job.succeeded or len(active) != job.active:
+        done_now = succeeded >= job.completions and job.completion_time < 0
+        if succeeded != job.succeeded or len(active) != job.active or done_now:
             self.store.update_workload(
-                "Job", replace(job, succeeded=succeeded, active=len(active))
+                "Job",
+                replace(
+                    job,
+                    succeeded=succeeded,
+                    active=len(active),
+                    completion_time=(
+                        self.clock.now() if done_now else job.completion_time
+                    ),
+                ),
             )
 
     def tick(self) -> None:
@@ -302,22 +315,358 @@ class GarbageCollector:
         return deleted
 
 
-class ControllerManager:
-    """cmd/kube-controller-manager — runs the controller set; tick() is one
-    reconcile round across all of them (deployment before replicaset so a
-    rollout's RS scaling lands in the same round)."""
+class StatefulSetController:
+    """statefulset/stateful_set_control.go — UpdateStatefulSet: stable ordinal
+    identities `name-0 .. name-N-1`.  OrderedReady (default): ordinal i is
+    created only after 0..i-1 are ready, and scale-down removes the highest
+    ordinal one at a time; Parallel creates/deletes all at once."""
 
     def __init__(self, store: ClusterStore):
         self.store = store
+
+    def _pod_name(self, sts, ordinal: int) -> str:
+        return f"{sts.name}-{ordinal}"
+
+    def sync(self, sts) -> None:
+        owner = t.OwnerReference(kind="StatefulSet", name=sts.name, uid=sts.uid)
+        by_ordinal: Dict[int, t.Pod] = {}
+        for pod in self.store.pods.values():
+            if pod.namespace == sts.namespace and any(
+                r.uid == sts.uid for r in pod.owner_references
+            ):
+                try:
+                    by_ordinal[int(pod.name.rsplit("-", 1)[1])] = pod
+                except (IndexError, ValueError):
+                    pass
+        ordered = sts.pod_management_policy == "OrderedReady"
+        # create missing ordinals (in order; gate on predecessor readiness)
+        for i in range(sts.replicas):
+            if i in by_ordinal:
+                if ordered and not _is_ready(by_ordinal[i]):
+                    break  # wait for this ordinal before touching later ones
+                continue
+            tmpl = sts.template or t.Pod(name="x")
+            pod = _stamp(tmpl, self._pod_name(sts, i), sts.namespace, owner)
+            self.store.add_pod(pod)
+            if ordered:
+                break  # one at a time
+        # delete excess ordinals: highest first, one per round when ordered
+        excess = sorted((o for o in by_ordinal if o >= sts.replicas), reverse=True)
+        for o in excess if not ordered else excess[:1]:
+            self.store.delete_pod(by_ordinal[o].uid)
+        ready = sum(
+            1 for o, p in by_ordinal.items() if o < sts.replicas and _is_ready(p)
+        )
+        if ready != sts.ready_replicas:
+            self.store.update_object("StatefulSet", replace(sts, ready_replicas=ready))
+
+    def tick(self) -> None:
+        for sts in list(self.store.objects["StatefulSet"].values()):
+            self.sync(sts)
+
+
+class DaemonSetController:
+    """daemon/daemon_controller.go — syncDaemonSet: one pod per eligible node.
+    Since 1.12 daemon pods go through the default scheduler, pinned with a
+    nodeAffinity on metadata.name (here: the kubernetes.io/hostname label the
+    Node carries) — NodeShouldRunDaemonPod reduced to unschedulable/taint
+    checks against the template's tolerations."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def _eligible(self, ds, node: t.Node) -> bool:
+        tmpl = ds.template or t.Pod(name="x")
+        if node.unschedulable:
+            # daemon pods tolerate unschedulable only if template says so
+            if not any(
+                tol.key == "node.kubernetes.io/unschedulable" for tol in tmpl.tolerations
+            ):
+                return False
+        for taint in node.taints:
+            # NoSchedule AND NoExecute are both hard filters in this
+            # framework's scheduler (ops/filters.py), so both gate eligibility
+            if taint.effect == t.PREFER_NO_SCHEDULE:
+                continue
+            if not any(tol.tolerates(taint) for tol in tmpl.tolerations):
+                return False
+        return True
+
+    def sync(self, ds) -> None:
+        owner = t.OwnerReference(kind="DaemonSet", name=ds.name, uid=ds.uid)
+        have: Dict[str, t.Pod] = {}
+        for pod in self.store.pods.values():
+            if pod.namespace == ds.namespace and any(
+                r.uid == ds.uid for r in pod.owner_references
+            ):
+                target = pod.node_name or _pinned_node(pod)
+                if target:
+                    have[target] = pod
+        want = {
+            name for name, node in self.store.nodes.items() if self._eligible(ds, node)
+        }
+        for name in sorted(want - set(have)):
+            tmpl = ds.template or t.Pod(name="x")
+            pod = _stamp(tmpl, f"{ds.name}-{name}", ds.namespace, owner)
+            # pin via required node affinity on the hostname label (the
+            # scheduler still runs filters — resources, ports, etc.)
+            pod.affinity = t.Affinity(
+                required_node_terms=(
+                    t.NodeSelectorTerm(
+                        match_expressions=(
+                            t.NodeSelectorRequirement(
+                                key=t.LABEL_HOSTNAME, operator=t.OP_IN, values=(name,)
+                            ),
+                        )
+                    ),
+                )
+            )
+            self.store.add_pod(pod)
+        for name in set(have) - want:
+            self.store.delete_pod(have[name].uid)
+        ready = sum(1 for n, p in have.items() if n in want and _is_ready(p))
+        if ds.desired_number_scheduled != len(want) or ds.number_ready != ready:
+            self.store.update_object(
+                "DaemonSet",
+                replace(ds, desired_number_scheduled=len(want), number_ready=ready),
+            )
+
+    def tick(self) -> None:
+        for ds in list(self.store.objects["DaemonSet"].values()):
+            self.sync(ds)
+
+
+def _pinned_node(pod: t.Pod) -> str:
+    """Node a daemon pod is pinned to via its hostname affinity ("" if none)."""
+    if pod.affinity is None:
+        return ""
+    for term in pod.affinity.required_node_terms:
+        for req in term.match_expressions:
+            if req.key == t.LABEL_HOSTNAME and req.operator == t.OP_IN and req.values:
+                return req.values[0]
+    return ""
+
+
+class CronJobController:
+    """cronjob/cronjob_controllerv2.go — syncCronJob: spawn a Job each period;
+    concurrencyPolicy Allow (default) / Forbid (skip while one is active) /
+    Replace (delete the active one first)."""
+
+    def __init__(self, store: ClusterStore, clock=None):
+        from .queue import Clock
+
+        self.store = store
+        self.clock = clock or Clock()
+
+    def sync(self, cj) -> None:
+        if cj.suspend:
+            return
+        now = self.clock.now()
+        last = cj.last_schedule_time
+        if last >= 0 and now - last < cj.period_seconds:
+            return
+        active = [
+            j
+            for j in self.store.jobs.values()
+            if j.namespace == cj.namespace
+            and any(r.uid == cj.uid for r in j.owner_references)
+            and not j.complete
+        ]
+        if active:
+            if cj.concurrency_policy == "Forbid":
+                # missed run skipped entirely (not queued for catch-up)
+                self.store.update_object(
+                    "CronJob", replace(cj, last_schedule_time=now)
+                )
+                return
+            if cj.concurrency_policy == "Replace":
+                for j in active:
+                    self.store.delete_object("Job", j.key)
+        seq = int(now // max(cj.period_seconds, 1e-9))
+        job = t.Job(
+            name=f"{cj.name}-{seq}",
+            namespace=cj.namespace,
+            completions=cj.completions,
+            parallelism=cj.parallelism,
+            template=cj.job_template,
+            owner_references=(
+                t.OwnerReference(kind="CronJob", name=cj.name, uid=cj.uid),
+            ),
+        )
+        if job.key not in self.store.jobs:
+            self.store.add_object("Job", job)
+        self.store.update_object("CronJob", replace(cj, last_schedule_time=now))
+
+    def tick(self) -> None:
+        for cj in list(self.store.objects["CronJob"].values()):
+            self.sync(cj)
+
+
+class HPAController:
+    """podautoscaler/horizontal.go + replica_calculator.go — the core ratio
+    rule: desired = ceil(current * metricValue / target), no-op inside the
+    tolerance band, clamped to [min,max]; scales the target Deployment."""
+
+    def __init__(self, store: ClusterStore, metrics=None):
+        # metrics(namespace, pods) -> average metric value per pod; pods is
+        # the target's current pod list (the metrics-server role)
+        self.store = store
+        self.metrics = metrics
+
+    def sync(self, hpa) -> None:
+        if self.metrics is None or hpa.target_kind != "Deployment":
+            return
+        d = self.store.get_object("Deployment", f"{hpa.namespace}/{hpa.target_name}")
+        if d is None:
+            return
+        pods = [
+            p
+            for p in self.store.pods.values()
+            if p.namespace == hpa.namespace
+            and d.selector is not None
+            and d.selector.matches(p.labels)
+            and not _is_finished(p)
+        ]
+        # ratio applies to the scale subresource's spec.replicas (the
+        # reference's currentReplicas), NOT the observed pod count — pods only
+        # feed the metric average (replica_calculator.go GetMetricReplicas)
+        current = d.replicas
+        if current == 0 or not pods:
+            return
+        value = self.metrics(hpa.namespace, pods)
+        ratio = value / hpa.target_value if hpa.target_value else 1.0
+        desired = current
+        if abs(ratio - 1.0) > hpa.tolerance:
+            import math
+
+            desired = math.ceil(current * ratio)
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        if desired != d.replicas:
+            self.store.update_object("Deployment", replace(d, replicas=desired))
+        if hpa.current_replicas != current or hpa.desired_replicas != desired:
+            self.store.update_object(
+                "HorizontalPodAutoscaler",
+                replace(hpa, current_replicas=current, desired_replicas=desired),
+            )
+
+    def tick(self) -> None:
+        for hpa in list(self.store.objects["HorizontalPodAutoscaler"].values()):
+            self.sync(hpa)
+
+
+class NamespaceController:
+    """namespace/namespace_controller.go — a Terminating namespace drains:
+    delete every object in it across all kinds, then remove the namespace
+    (the deletion finalizer's syncNamespaceFromKey)."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def tick(self) -> None:
+        for ns in list(self.store.objects["Namespace"].values()):
+            if ns.phase != "Terminating":
+                continue
+            remaining = 0
+            for pod in list(self.store.pods.values()):
+                if pod.namespace == ns.name:
+                    self.store.delete_pod(pod.uid)
+                    remaining += 1
+            for pdb in list(self.store.pdbs.values()):
+                if pdb.namespace == ns.name:
+                    self.store.delete_pdb(pdb.key)
+                    remaining += 1
+            for kind in list(self.store.objects):
+                if kind == "Namespace":
+                    continue
+                for obj in list(self.store.objects[kind].values()):
+                    if getattr(obj, "namespace", None) == ns.name:
+                        self.store.delete_object(kind, _key_of(obj))
+                        remaining += 1
+            if remaining == 0:
+                self.store.delete_object("Namespace", ns.name)
+
+
+class PodGCController:
+    """podgc/gc_controller.go — three sweeps: orphaned pods bound to vanished
+    nodes (force-deleted), unscheduled terminating pods, and terminated pods
+    beyond the --terminated-pod-gc-threshold (oldest first)."""
+
+    def __init__(self, store: ClusterStore, terminated_threshold: int = 12500):
+        self.store = store
+        self.terminated_threshold = terminated_threshold
+
+    def tick(self) -> int:
+        deleted = 0
+        for pod in list(self.store.pods.values()):
+            if pod.node_name and pod.node_name not in self.store.nodes:
+                self.store.delete_pod(pod.uid)
+                deleted += 1
+        finished = sorted(
+            (p for p in self.store.pods.values() if _is_finished(p)),
+            key=lambda p: p.uid,
+        )
+        for pod in finished[: max(0, len(finished) - self.terminated_threshold)]:
+            self.store.delete_pod(pod.uid)
+            deleted += 1
+        return deleted
+
+
+class TTLAfterFinishedController:
+    """ttlafterfinished/ttlafterfinished_controller.go — delete Jobs whose
+    ttlSecondsAfterFinished has elapsed since completion (pods cascade via GC)."""
+
+    def __init__(self, store: ClusterStore, clock=None):
+        from .queue import Clock
+
+        self.store = store
+        self.clock = clock or Clock()
+
+    def tick(self) -> None:
+        now = self.clock.now()
+        for job in list(self.store.jobs.values()):
+            if (
+                job.ttl_seconds_after_finished is not None
+                and job.completion_time >= 0
+                and now - job.completion_time >= job.ttl_seconds_after_finished
+            ):
+                self.store.delete_object("Job", job.key)
+
+
+class ControllerManager:
+    """cmd/kube-controller-manager — runs the controller set; tick() is one
+    reconcile round across all of them (deployment before replicaset so a
+    rollout's RS scaling lands in the same round; cronjob before job so a
+    spawned Job's pods land in the same round; HPA after metrics exist)."""
+
+    def __init__(self, store: ClusterStore, clock=None, metrics=None):
+        from .network import EndpointSliceController
+
+        self.store = store
         self.deployments = DeploymentController(store)
         self.replicasets = ReplicaSetController(store)
-        self.jobs = JobController(store)
+        self.statefulsets = StatefulSetController(store)
+        self.daemonsets = DaemonSetController(store)
+        self.cronjobs = CronJobController(store, clock=clock)
+        self.jobs = JobController(store, clock=clock)
+        self.hpa = HPAController(store, metrics=metrics)
+        self.endpointslices = EndpointSliceController(store)
+        self.namespaces = NamespaceController(store)
+        self.podgc = PodGCController(store)
+        self.ttl = TTLAfterFinishedController(store, clock=clock)
         self.gc = GarbageCollector(store)
 
     def tick(self) -> None:
+        self.hpa.tick()
         self.deployments.tick()
         self.replicasets.tick()
+        self.statefulsets.tick()
+        self.daemonsets.tick()
+        self.cronjobs.tick()
         self.jobs.tick()
+        self.endpointslices.tick()
+        self.namespaces.tick()
+        self.podgc.tick()
+        self.ttl.tick()
         self.gc.tick()
 
     def tick_until_quiescent(self, max_rounds: int = 20) -> None:

@@ -110,6 +110,16 @@ class QueueSet:
         if req._queue is not None:
             req._queue.executing -= 1
 
+    def cancel(self, req: Request) -> bool:
+        """Remove a still-queued request (caller gave up waiting).  Returns
+        False if the request was already released — the caller must then
+        finish() it to return the seat."""
+        q = req._queue
+        if q is not None and req in q.requests:
+            q.requests.remove(req)
+            return True
+        return False
+
 
 DEFAULT_LEVELS = (
     c.PriorityLevelConfiguration(name="exempt", exempt=True),
@@ -138,8 +148,11 @@ class APFController:
     total_concurrency is divided between levels by concurrency_shares."""
 
     def __init__(self, store: ClusterStore, total_concurrency: int = 600):
+        import threading
+
         self.store = store
         self.total_concurrency = total_concurrency
+        self._lock = threading.Lock()  # guards all queue-set state
         if not store.objects["PriorityLevelConfiguration"]:
             for plc in DEFAULT_LEVELS:
                 store.add_object("PriorityLevelConfiguration", plc)
@@ -189,13 +202,24 @@ class APFController:
             req.flow = f"{fs.name}/{req.namespace}"
         else:
             req.flow = fs.name
-        qs.enqueue(req)
+        with self._lock:
+            qs.enqueue(req)
 
     def dispatch(self) -> List[Request]:
-        out: List[Request] = []
-        for qs in self.queue_sets.values():
-            out.extend(qs.dispatch())
-        return out
+        with self._lock:
+            out: List[Request] = []
+            for qs in self.queue_sets.values():
+                out.extend(qs.dispatch())
+            return out
 
     def finish(self, req: Request) -> None:
-        self.queue_sets[req.level].finish(req)
+        with self._lock:
+            self.queue_sets[req.level].finish(req)
+
+    def cancel(self, req: Request) -> None:
+        """Caller gave up waiting (queue-wait timeout): dequeue, or if a
+        concurrent dispatch already released it, return the seat — either way
+        no seat leaks."""
+        with self._lock:
+            if not self.queue_sets[req.level].cancel(req) and req.released:
+                self.queue_sets[req.level].finish(req)

@@ -18,7 +18,12 @@ kubemark's hollow_kubelet.go trade (pkg/kubemark).
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional
+
+# process-wide fallback for standalone HollowKubelets (HollowCluster assigns
+# its own dense indices)
+_DEFAULT_CIDR_SEQ = itertools.count()
 
 from ..api import types as t
 from .leases import LeaseStore
@@ -33,13 +38,19 @@ class HollowKubelet:
         leases: LeaseStore,
         node_name: str,
         clock: Optional[Clock] = None,
+        pod_cidr_index: Optional[int] = None,
     ):
         self.store = store
         self.leases = leases
         self.node_name = node_name
         self.clock = clock or leases.clock
         self._started_at: Dict[str, float] = {}  # pod uid -> Running since
-        self._ip_seq = 0  # pod IP allocator cursor (status.podIP)
+        # pod CIDR: a disjoint per-node subnet index (nodeipam's per-node /24)
+        self._cidr_index = (
+            pod_cidr_index
+            if pod_cidr_index is not None
+            else next(_DEFAULT_CIDR_SEQ)
+        )
 
     def tick(self) -> None:
         """One syncLoop iteration: heartbeat + pod state machine."""
@@ -79,11 +90,18 @@ class HollowKubelet:
         self.store.update_pod_status(q)
 
     def _alloc_ip(self) -> str:
-        import zlib
-
-        subnet = zlib.crc32(self.node_name.encode()) & 0xFF  # run-stable
-        self._ip_seq += 1
-        return f"10.244.{subnet}.{self._ip_seq & 0xFF}"
+        """Lowest free host address in this node's /24 — collision-free
+        across nodes (disjoint subnets from the nodeipam-style index) and
+        within the node (scan live pods; max ~110 pods/node keeps this O(n))."""
+        n = self._cidr_index
+        prefix = f"10.{128 + (n >> 8 & 0x7F)}.{n & 0xFF}"  # avoids 10.96/16 VIPs
+        in_use = {
+            int(p.pod_ip.rsplit(".", 1)[1])
+            for p in self.store.pods.values()
+            if p.node_name == self.node_name and p.pod_ip.startswith(prefix + ".")
+        }
+        host = next(h for h in range(1, 255) if h not in in_use)
+        return f"{prefix}.{host}"
 
 
 class HollowCluster:
@@ -94,11 +112,15 @@ class HollowCluster:
         self.store = store
         self.leases = leases
         self.kubelets: Dict[str, HollowKubelet] = {}
+        self._cidr_seq = itertools.count()
 
     def tick(self) -> None:
         for name in self.store.nodes:
             if name not in self.kubelets:
-                self.kubelets[name] = HollowKubelet(self.store, self.leases, name)
+                self.kubelets[name] = HollowKubelet(
+                    self.store, self.leases, name,
+                    pod_cidr_index=next(self._cidr_seq),
+                )
         for name in list(self.kubelets):
             if name not in self.store.nodes:
                 del self.kubelets[name]

@@ -422,7 +422,7 @@ def test_hollow_kubelet_assigns_pod_ip_and_prunes_state():
     store.add_pod(_pod("p", node_name="n0", phase=t.PHASE_PENDING))
     kubelet.tick()
     pod = store.pods["default/p"]
-    assert pod.phase == t.PHASE_RUNNING and pod.pod_ip.startswith("10.244.")
+    assert pod.phase == t.PHASE_RUNNING and pod.pod_ip.startswith("10.1")
     store.delete_pod("default/p")
     kubelet.tick()
     assert not kubelet._started_at  # no leak after deletion while Running

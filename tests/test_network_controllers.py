@@ -1,0 +1,383 @@
+"""EndpointSlice controller + kube-proxy analog; wave-2 controllers:
+StatefulSet, DaemonSet, CronJob, HPA, Namespace, PodGC, TTLAfterFinished.
+
+Test style mirrors the reference's controller unit tests (fake store + sync
+loop assertions, e.g. pkg/controller/statefulset/stateful_set_control_test.go)."""
+
+import pytest
+
+from kubernetes_tpu.api import cluster as c
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler.controllers import (
+    ControllerManager,
+    CronJobController,
+    DaemonSetController,
+    HPAController,
+    JobController,
+    NamespaceController,
+    PodGCController,
+    StatefulSetController,
+    TTLAfterFinishedController,
+)
+from kubernetes_tpu.scheduler.kubelet import HollowCluster
+from kubernetes_tpu.scheduler.leases import LeaseStore
+from kubernetes_tpu.scheduler.network import EndpointSliceController, Proxier
+from kubernetes_tpu.scheduler.queue import FakeClock
+from kubernetes_tpu.scheduler.store import ClusterStore
+
+
+def _store_with_nodes(n=2):
+    store = ClusterStore()
+    for i in range(n):
+        store.add_node(t.Node(name=f"n{i}", allocatable={t.CPU: 8000, t.PODS: 20}))
+    return store
+
+
+def _running_pod(name, node="n0", labels=None, ip=None):
+    return t.Pod(name=name, node_name=node, phase=t.PHASE_RUNNING,
+                 labels=dict(labels or {}), pod_ip=ip or f"10.244.0.{name[-1]}")
+
+
+# ----------------------------------------------------- EndpointSlice + proxy
+
+
+def test_endpointslice_sync_and_gc_ownership():
+    store = _store_with_nodes()
+    ctrl = EndpointSliceController(store)
+    svc = c.Service(name="web", selector=(("app", "web"),),
+                    ports=(c.ServicePort(80, target_port=8080),),
+                    cluster_ip="10.96.0.1")
+    store.add_object("Service", svc)
+    store.add_pod(_running_pod("w1", labels={"app": "web"}))
+    store.add_pod(_running_pod("w2", labels={"app": "web"}))
+    store.add_pod(_running_pod("x1", labels={"app": "other"}))
+    # pending pod: not an endpoint
+    store.add_pod(t.Pod(name="w3", labels={"app": "web"}))
+    ctrl.tick()
+    slices = store.list_objects("EndpointSlice")
+    assert len(slices) == 1
+    eps = slices[0].endpoints
+    assert {e.pod_uid for e in eps} == {"default/w1", "default/w2"}
+    assert all(e.ready for e in eps)
+    assert slices[0].owner_references[0].uid == svc.uid
+    # service deleted -> GC collects the slice
+    store.delete_object("Service", svc.key)
+    cm = ControllerManager(store)
+    cm.gc.tick()
+    assert not store.list_objects("EndpointSlice")
+
+
+def test_endpointslice_chunking_over_100():
+    store = _store_with_nodes(1)
+    ctrl = EndpointSliceController(store)
+    store.add_object("Service", c.Service(
+        name="big", selector=(("app", "big"),), ports=(c.ServicePort(80),),
+        cluster_ip="10.96.0.2"))
+    for i in range(250):
+        store.add_pod(t.Pod(name=f"b{i}", node_name="n0", phase=t.PHASE_RUNNING,
+                            labels={"app": "big"}, pod_ip=f"10.244.{i // 250}.{i % 250}"))
+    ctrl.tick()
+    slices = sorted(store.list_objects("EndpointSlice"), key=lambda s: s.name)
+    assert [len(s.endpoints) for s in slices] == [100, 100, 50]
+    # scale down -> shrink + drop empty trailing slices
+    for i in range(120, 250):
+        store.delete_pod(f"default/b{i}")
+    ctrl.tick()
+    slices = sorted(store.list_objects("EndpointSlice"), key=lambda s: s.name)
+    assert [len(s.endpoints) for s in slices] == [100, 20]
+
+
+def test_proxier_balances_and_session_affinity():
+    store = _store_with_nodes()
+    ctrl = EndpointSliceController(store)
+    store.add_object("Service", c.Service(
+        name="web", selector=(("app", "web"),),
+        ports=(c.ServicePort(80, target_port=8080),), cluster_ip="10.96.0.1"))
+    store.add_object("Service", c.Service(
+        name="sticky", selector=(("app", "web"),),
+        ports=(c.ServicePort(443),), cluster_ip="10.96.0.9",
+        session_affinity="ClientIP"))
+    for i in range(3):
+        store.add_pod(_running_pod(f"w{i}", labels={"app": "web"},
+                                   ip=f"10.244.0.{i}"))
+    ctrl.tick()
+    proxy = Proxier(store, seed=7)
+    proxy.sync()
+    # VIP lookup balances over all ready backends at the target port
+    seen = {proxy.lookup(f"client-{i}", "10.96.0.1", 80) for i in range(60)}
+    assert seen == {(f"10.244.0.{i}", 8080) for i in range(3)}
+    # unknown VIP/port -> REJECT
+    assert proxy.lookup("c", "10.96.0.1", 81) is None
+    # ClientIP affinity is sticky per client
+    first = proxy.lookup("alice", "10.96.0.9", 443)
+    assert all(proxy.lookup("alice", "10.96.0.9", 443) == first for _ in range(20))
+    # backend removal invalidates affinity and routing
+    store.delete_pod("default/w0")
+    store.delete_pod("default/w1")
+    store.delete_pod("default/w2")
+    ctrl.tick()
+    proxy.sync()
+    assert proxy.lookup("alice", "10.96.0.9", 443) is None
+
+
+def test_proxier_skips_not_ready_endpoints():
+    store = _store_with_nodes()
+    ctrl = EndpointSliceController(store)
+    store.add_object("Service", c.Service(
+        name="web", selector=(("app", "web"),), ports=(c.ServicePort(80),),
+        cluster_ip="10.96.0.1"))
+    store.add_pod(_running_pod("w1", labels={"app": "web"}, ip="10.244.0.1"))
+    # bound but no IP yet -> endpoint exists, not ready
+    store.add_pod(t.Pod(name="w2", node_name="n0", phase=t.PHASE_PENDING,
+                        labels={"app": "web"}))
+    ctrl.tick()
+    proxy = Proxier(store)
+    proxy.sync()
+    assert {proxy.lookup(f"c{i}", "10.96.0.1", 80) for i in range(20)} == {
+        ("10.244.0.1", 80)
+    }
+
+
+# ------------------------------------------------------------- StatefulSet
+
+
+def test_statefulset_ordered_creation_and_scale_down():
+    store = _store_with_nodes()
+    ctrl = StatefulSetController(store)
+    sts = c.StatefulSet(name="db", replicas=3, template=t.Pod(name="x"))
+    store.add_object("StatefulSet", sts)
+    ctrl.tick()
+    assert sorted(p.name for p in store.pods.values()) == ["db-0"]  # one at a time
+    ctrl.tick()
+    assert len(store.pods) == 1  # db-0 not ready yet: gate holds
+    # mark ready (bound + running)
+    p0 = store.pods["default/db-0"]
+    p0.node_name, p0.phase = "n0", t.PHASE_RUNNING
+    ctrl.tick()
+    assert sorted(p.name for p in store.pods.values()) == ["db-0", "db-1"]
+    p1 = store.pods["default/db-1"]
+    p1.node_name, p1.phase = "n1", t.PHASE_RUNNING
+    ctrl.tick()
+    assert sorted(p.name for p in store.pods.values()) == ["db-0", "db-1", "db-2"]
+    # scale down: highest ordinal first, one per round
+    store.update_object("StatefulSet",
+                        store.get_object("StatefulSet", "default/db").__class__(
+                            **{**store.get_object("StatefulSet", "default/db").__dict__,
+                               "replicas": 1}))
+    ctrl.tick()
+    assert sorted(p.name for p in store.pods.values()) == ["db-0", "db-1"]
+    ctrl.tick()
+    assert sorted(p.name for p in store.pods.values()) == ["db-0"]
+
+
+def test_statefulset_parallel_policy():
+    store = _store_with_nodes()
+    ctrl = StatefulSetController(store)
+    store.add_object("StatefulSet", c.StatefulSet(
+        name="par", replicas=4, template=t.Pod(name="x"),
+        pod_management_policy="Parallel"))
+    ctrl.tick()
+    assert len(store.pods) == 4
+
+
+# --------------------------------------------------------------- DaemonSet
+
+
+def test_daemonset_one_pod_per_eligible_node():
+    store = _store_with_nodes(3)
+    store.add_node(t.Node(name="tainted", allocatable={t.CPU: 8000},
+                          taints=(t.Taint(key="gpu", effect=t.NO_SCHEDULE),)))
+    store.add_node(t.Node(name="cordoned", allocatable={t.CPU: 8000},
+                          unschedulable=True))
+    ctrl = DaemonSetController(store)
+    ds = c.DaemonSet(name="agent", template=t.Pod(name="x"))
+    store.add_object("DaemonSet", ds)
+    ctrl.tick()
+    pods = list(store.pods.values())
+    assert len(pods) == 3  # tainted + cordoned excluded
+    # every pod pinned to a distinct node via hostname affinity
+    from kubernetes_tpu.scheduler.controllers import _pinned_node
+    assert {_pinned_node(p) for p in pods} == {"n0", "n1", "n2"}
+    # node added -> next tick grows; node deleted -> pod removed
+    store.add_node(t.Node(name="n3", allocatable={t.CPU: 8000}))
+    ctrl.tick()
+    assert len(store.pods) == 4
+    store.delete_node("n1")
+    ctrl.tick()
+    assert {_pinned_node(p) for p in store.pods.values()} == {"n0", "n2", "n3"}
+
+
+def test_daemonset_toleration_admits_tainted_node():
+    store = ClusterStore()
+    store.add_node(t.Node(name="gpu0", allocatable={t.CPU: 8000},
+                          taints=(t.Taint(key="gpu", effect=t.NO_SCHEDULE),)))
+    ctrl = DaemonSetController(store)
+    store.add_object("DaemonSet", c.DaemonSet(
+        name="gpu-agent",
+        template=t.Pod(name="x", tolerations=(
+            t.Toleration(key="gpu", operator=t.OP_EXISTS),))))
+    ctrl.tick()
+    assert len(store.pods) == 1
+
+
+# ------------------------------------------------------------ CronJob + TTL
+
+
+def test_cronjob_spawns_jobs_on_period():
+    store = ClusterStore()
+    clock = FakeClock(start=100.0)
+    cron = CronJobController(store, clock=clock)
+    jobs = JobController(store, clock=clock)
+    store.add_object("CronJob", c.CronJob(
+        name="tick", period_seconds=60, job_template=t.Pod(name="x", run_seconds=1)))
+    cron.tick()
+    assert len(store.jobs) == 1
+    cron.tick()
+    assert len(store.jobs) == 1  # within the period: no new job
+    clock.step(61)
+    cron.tick()
+    assert len(store.jobs) == 2
+    jobs.tick()
+    assert len(store.pods) == 2  # one pod per spawned job
+    # jobs carry the CronJob owner ref (GC edge)
+    assert all(j.owner_references[0].kind == "CronJob" for j in store.jobs.values())
+
+
+def test_cronjob_forbid_and_replace_policies():
+    store = ClusterStore()
+    clock = FakeClock(start=0.0)
+    cron = CronJobController(store, clock=clock)
+    store.add_object("CronJob", c.CronJob(
+        name="fb", period_seconds=10, concurrency_policy="Forbid",
+        job_template=t.Pod(name="x")))
+    cron.tick()
+    clock.step(11)
+    cron.tick()  # previous job still active -> skipped
+    assert len(store.jobs) == 1
+    store.objects["CronJob"]["default/fb"].concurrency_policy = "Replace"
+    clock.step(11)
+    cron.tick()  # Replace: old active job deleted, new one spawned
+    assert len(store.jobs) == 1
+    assert list(store.jobs.values())[0].name.startswith("fb-")
+
+
+def test_ttl_after_finished_deletes_job_and_cascades():
+    store = ClusterStore()
+    clock = FakeClock(start=0.0)
+    cm = ControllerManager(store, clock=clock)
+    store.add_object("Job", t.Job(
+        name="once", completions=1, parallelism=1,
+        template=t.Pod(name="x", run_seconds=1), ttl_seconds_after_finished=30))
+    cm.tick()
+    assert len(store.pods) == 1
+    # finish the pod
+    pod = next(iter(store.pods.values()))
+    pod.phase = t.PHASE_SUCCEEDED
+    cm.tick()
+    job = store.jobs["default/once"]
+    assert job.complete and job.completion_time == clock.now()
+    clock.step(31)
+    cm.tick()
+    assert not store.jobs  # TTL elapsed
+    cm.tick()
+    assert not store.pods  # GC cascaded the pod
+
+
+# -------------------------------------------------------------------- HPA
+
+
+def test_hpa_scales_deployment_up_and_down_with_tolerance():
+    store = _store_with_nodes()
+    load = {"value": 1.0}
+    hpa_ctrl = HPAController(store, metrics=lambda ns, pods: load["value"])
+    d = t.Deployment(name="web", replicas=2, selector=t.LabelSelector.of(app="w"),
+                     template=t.Pod(name="x", labels={"app": "w"}))
+    store.add_object("Deployment", d)
+    store.add_object("HorizontalPodAutoscaler", c.HorizontalPodAutoscaler(
+        name="web", target_name="web", min_replicas=1, max_replicas=6,
+        target_value=0.5, tolerance=0.1))
+    for i in range(2):
+        store.add_pod(_running_pod(f"w{i}", labels={"app": "w"}))
+    hpa_ctrl.tick()
+    assert store.deployments["default/web"].replicas == 4  # 2 * 1.0/0.5
+    # inside tolerance: no change
+    load["value"] = 0.52
+    hpa_ctrl.tick()
+    assert store.deployments["default/web"].replicas == 4
+    # low load: scale down, clamped to min
+    load["value"] = 0.01
+    hpa_ctrl.tick()
+    assert store.deployments["default/web"].replicas == 1
+    hpa = store.get_object("HorizontalPodAutoscaler", "default/web")
+    # status reflects the scale target's replicas at decision time
+    assert hpa.current_replicas == 4 and hpa.desired_replicas == 1
+
+
+# ------------------------------------------------- Namespace + PodGC sweeps
+
+
+def test_namespace_termination_drains_all_kinds():
+    store = _store_with_nodes()
+    ctrl = NamespaceController(store)
+    store.add_object("Namespace", c.Namespace(name="team-a"))
+    store.add_pod(t.Pod(name="p1", namespace="team-a"))
+    store.add_object("Service", c.Service(name="s1", namespace="team-a"))
+    store.add_object("Deployment", t.Deployment(name="d1", namespace="team-a"))
+    store.add_pdb(t.PodDisruptionBudget(name="pdb1", namespace="team-a"))
+    ctrl.tick()
+    assert store.pods  # Active: untouched
+    store.objects["Namespace"]["team-a"].phase = "Terminating"
+    ctrl.tick()
+    assert not store.pods and not store.list_objects("Service")
+    assert not store.deployments and not store.pdbs
+    ctrl.tick()  # empty now -> namespace itself removed
+    assert store.get_object("Namespace", "team-a") is None
+
+
+def test_podgc_sweeps_orphans_and_terminated_overflow():
+    store = _store_with_nodes(1)
+    gc = PodGCController(store, terminated_threshold=2)
+    store.add_pod(t.Pod(name="orphan", node_name="gone-node"))
+    for i in range(5):
+        store.add_pod(t.Pod(name=f"done{i}", node_name="n0",
+                            phase=t.PHASE_SUCCEEDED))
+    assert gc.tick() == 1 + 3  # orphan + (5 terminated - threshold 2)
+    assert "default/orphan" not in store.pods
+    assert sum(1 for p in store.pods.values()
+               if p.phase == t.PHASE_SUCCEEDED) == 2
+
+
+# ------------------------------------------------------- integration: fleet
+
+
+def test_full_stack_daemonset_through_scheduler_and_kubelet():
+    """DaemonSet -> controller stamps affinity-pinned pods -> real scheduler
+    binds them -> hollow kubelet runs them -> endpoint slices see them."""
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    store = _store_with_nodes(3)
+    cm = ControllerManager(store)
+    sched = Scheduler(store)
+    leases = LeaseStore()
+    fleet = HollowCluster(store, leases)
+    store.add_object("DaemonSet", c.DaemonSet(
+        name="exporter", template=t.Pod(
+            name="x", requests={t.CPU: 100, t.PODS: 1},
+            labels={"app": "exporter"})))
+    store.add_object("Service", c.Service(
+        name="exporter", selector=(("app", "exporter"),),
+        ports=(c.ServicePort(9100),), cluster_ip="10.96.0.5"))
+    cm.tick()
+    sched.run_until_idle()
+    bound = [p for p in store.pods.values() if p.node_name]
+    assert len(bound) == 3
+    # each daemon pod landed exactly on its pinned node
+    from kubernetes_tpu.scheduler.controllers import _pinned_node
+    assert all(p.node_name == _pinned_node(p) for p in bound)
+    fleet.tick()
+    cm.tick()
+    slices = store.list_objects("EndpointSlice")
+    assert len(slices) == 1 and len(slices[0].endpoints) == 3
+    proxy = Proxier(store)
+    proxy.sync()
+    assert proxy.lookup("client", "10.96.0.5", 9100) is not None
