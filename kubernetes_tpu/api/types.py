@@ -318,6 +318,9 @@ class Pod:
     owner_references: Tuple[OwnerReference, ...] = ()  # GC graph + adoption
     # status.phase ("": phase machinery not in play — bound implies running)
     phase: str = ""
+    # clock time the pod reached Succeeded/Failed (-1 = not finished or
+    # untimed); stamped by the kubelet, consumed by PodGC's oldest-first sweep
+    finished_at: float = -1.0
     # lifecycle knob for the hollow kubelet: pods whose workload completes
     # (Job pods) run for run_seconds then succeed; 0 = run forever
     run_seconds: float = 0.0

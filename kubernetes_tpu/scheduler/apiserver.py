@@ -56,6 +56,10 @@ _RESOURCES = {
     "Role": "roles",
     "RoleBinding": "rolebindings",
     "Lease": "leases",
+    "PV": "persistentvolumes",
+    "PVC": "persistentvolumeclaims",
+    "StorageClass": "storageclasses",
+    "DeviceClass": "deviceclasses",
 }
 
 
@@ -198,6 +202,10 @@ class APIServer:
             (self.store.add_node if verb == "create" else self.store.update_node)(obj)
         elif kind == "PDB":
             (self.store.add_pdb if verb == "create" else self.store.update_pdb)(obj)
+        elif kind == "PV":
+            (self.store.add_pv if verb == "create" else self.store.update_pv)(obj)
+        elif kind == "PVC":
+            (self.store.add_pvc if verb == "create" else self.store.update_pvc)(obj)
         else:
             if kind == "Service" and verb == "create" and not obj.cluster_ip:
                 obj.cluster_ip = self.ips.allocate()
@@ -227,6 +235,10 @@ class APIServer:
             self.store.delete_node(name)
         elif kind == "PDB":
             self.store.delete_pdb(key)
+        elif kind == "PV":
+            self.store.delete_pv(name)
+        elif kind == "PVC":
+            self.store.delete_pvc(key)
         else:
             if kind == "Service":
                 svc = self.store.get_object("Service", key)
@@ -241,6 +253,10 @@ class APIServer:
             return self.store.nodes.get(name)
         if kind == "PDB":
             return self.store.pdbs.get(f"{ns}/{name}")
+        if kind == "PV":
+            return self.store.pvs.get(name)
+        if kind == "PVC":
+            return self.store.pvcs.get(f"{ns}/{name}")
         return self.store.get_object(kind, f"{ns}/{name}" if ns else name)
 
     def _list(self, kind: str, ns: Optional[str]):
@@ -251,6 +267,11 @@ class APIServer:
             return list(self.store.nodes.values())
         if kind == "PDB":
             return [p for p in self.store.pdbs.values()
+                    if ns is None or p.namespace == ns]
+        if kind == "PV":
+            return list(self.store.pvs.values())
+        if kind == "PVC":
+            return [p for p in self.store.pvcs.values()
                     if ns is None or p.namespace == ns]
         return self.store.list_objects(kind, ns)
 

@@ -32,10 +32,16 @@ class SchedulerCache:
     def _on_event(self, ev: Event) -> None:
         with self._lock:
             if ev.obj_type == "PV":
-                self.pvs[ev.obj.name] = ev.obj
+                if ev.kind == "Deleted":
+                    self.pvs.pop(ev.obj.name, None)
+                else:
+                    self.pvs[ev.obj.name] = ev.obj
                 return
             if ev.obj_type == "PVC":
-                self.pvcs[ev.obj.key] = ev.obj
+                if ev.kind == "Deleted":
+                    self.pvcs.pop(ev.obj.key, None)
+                else:
+                    self.pvcs[ev.obj.key] = ev.obj
                 return
             if ev.obj_type == "Node":
                 if ev.kind == "Deleted":

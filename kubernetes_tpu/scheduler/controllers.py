@@ -232,6 +232,11 @@ class JobController:
         self._seq = itertools.count()
 
     def sync(self, job: t.Job) -> None:
+        if job.completion_time >= 0:
+            # Job status is authoritative once complete (the reference never
+            # un-completes a Job): PodGC may have deleted the succeeded pods,
+            # and recounting them would respawn the whole workload.
+            return
         owned = [
             p
             for p in self.store.pods.values()
@@ -338,6 +343,12 @@ class StatefulSetController:
                     by_ordinal[int(pod.name.rsplit("-", 1)[1])] = pod
                 except (IndexError, ValueError):
                     pass
+        # a finished pod never becomes ready again: delete it and treat the
+        # ordinal as vacant so it is recreated under the same identity
+        for o, pod in list(by_ordinal.items()):
+            if _is_finished(pod):
+                self.store.delete_pod(pod.uid)
+                del by_ordinal[o]
         ordered = sts.pod_management_policy == "OrderedReady"
         # create missing ordinals (in order; gate on predecessor readiness)
         for i in range(sts.replicas):
@@ -395,10 +406,16 @@ class DaemonSetController:
     def sync(self, ds) -> None:
         owner = t.OwnerReference(kind="DaemonSet", name=ds.name, uid=ds.uid)
         have: Dict[str, t.Pod] = {}
-        for pod in self.store.pods.values():
+        for pod in list(self.store.pods.values()):
             if pod.namespace == ds.namespace and any(
                 r.uid == ds.uid for r in pod.owner_references
             ):
+                if _is_finished(pod):
+                    # daemon pods must run for the node's lifetime: a
+                    # Succeeded/Failed daemon pod is deleted and recreated
+                    # (daemon_controller.go treats failed pods this way)
+                    self.store.delete_pod(pod.uid)
+                    continue
                 target = pod.node_name or _pinned_node(pod)
                 if target:
                     have[target] = pod
@@ -575,6 +592,10 @@ class NamespaceController:
                 if pdb.namespace == ns.name:
                     self.store.delete_pdb(pdb.key)
                     remaining += 1
+            for pvc in list(self.store.pvcs.values()):
+                if pvc.namespace == ns.name:
+                    self.store.delete_pvc(pvc.key)
+                    remaining += 1
             for kind in list(self.store.objects):
                 if kind == "Namespace":
                     continue
@@ -603,7 +624,9 @@ class PodGCController:
                 deleted += 1
         finished = sorted(
             (p for p in self.store.pods.values() if _is_finished(p)),
-            key=lambda p: p.uid,
+            # oldest first by finish time (stamped by the kubelet; untimed
+            # pods sort first = oldest), uid as the deterministic tie-break
+            key=lambda p: (p.finished_at, p.uid),
         )
         for pod in finished[: max(0, len(finished) - self.terminated_threshold)]:
             self.store.delete_pod(pod.uid)

@@ -18,17 +18,26 @@ kubemark's hollow_kubelet.go trade (pkg/kubemark).
 
 from __future__ import annotations
 
-import itertools
 from typing import Dict, List, Optional
-
-# process-wide fallback for standalone HollowKubelets (HollowCluster assigns
-# its own dense indices)
-_DEFAULT_CIDR_SEQ = itertools.count()
+from weakref import WeakKeyDictionary
 
 from ..api import types as t
 from .leases import LeaseStore
 from .queue import Clock
 from .store import ClusterStore
+
+# store -> {node_name: dense index}.  Scoping CIDR indices to the store (not
+# the allocator instance) keeps per-node /24s disjoint even when several
+# HollowClusters / standalone HollowKubelets share one store, and gives the
+# same node the same subnet across kubelet restarts.
+_CIDR_REGISTRY: "WeakKeyDictionary[ClusterStore, Dict[str, int]]" = WeakKeyDictionary()
+
+
+def _cidr_index_for(store: ClusterStore, node_name: str) -> int:
+    table = _CIDR_REGISTRY.setdefault(store, {})
+    if node_name not in table:
+        table[node_name] = len(table)
+    return table[node_name]
 
 
 class HollowKubelet:
@@ -49,7 +58,7 @@ class HollowKubelet:
         self._cidr_index = (
             pod_cidr_index
             if pod_cidr_index is not None
-            else next(_DEFAULT_CIDR_SEQ)
+            else _cidr_index_for(store, node_name)
         )
 
     def tick(self) -> None:
@@ -83,6 +92,8 @@ class HollowKubelet:
 
         q = copy.copy(pod)
         q.phase = phase
+        if phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+            q.finished_at = self.clock.now()
         if phase == t.PHASE_RUNNING and not q.pod_ip:
             # status.podIP from the node's pod CIDR (nodeipam's per-node
             # 10.244.x.0/24 shape; the sandbox IP the CRI would report)
@@ -112,15 +123,11 @@ class HollowCluster:
         self.store = store
         self.leases = leases
         self.kubelets: Dict[str, HollowKubelet] = {}
-        self._cidr_seq = itertools.count()
 
     def tick(self) -> None:
         for name in self.store.nodes:
             if name not in self.kubelets:
-                self.kubelets[name] = HollowKubelet(
-                    self.store, self.leases, name,
-                    pod_cidr_index=next(self._cidr_seq),
-                )
+                self.kubelets[name] = HollowKubelet(self.store, self.leases, name)
         for name in list(self.kubelets):
             if name not in self.store.nodes:
                 del self.kubelets[name]

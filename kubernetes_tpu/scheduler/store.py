@@ -81,6 +81,8 @@ class ClusterStore:
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # by uid
         self.pdbs: Dict[str, t.PodDisruptionBudget] = {}  # by namespace/name
+        self.pvs: Dict[str, t.PersistentVolume] = {}  # by name
+        self.pvcs: Dict[str, t.PersistentVolumeClaim] = {}  # by namespace/name
         # dynamic kind registry: kind -> {key -> obj}
         self.objects: Dict[str, Dict[str, object]] = {k: {} for k in BUILTIN_KINDS}
         self._watchers: List[Callable[[Event], None]] = []
@@ -109,6 +111,10 @@ class ClusterStore:
                     fn(Event("Added", "Node", nd, self._rv))
                 for p in self.pods.values():
                     fn(Event("Added", "Pod", p, self._rv))
+                for pv in self.pvs.values():
+                    fn(Event("Added", "PV", pv, self._rv))
+                for pvc in self.pvcs.values():
+                    fn(Event("Added", "PVC", pvc, self._rv))
             self._watchers.append(fn)
 
     def _emit(self, ev: Event) -> None:
@@ -236,11 +242,35 @@ class ClusterStore:
     # --- storage objects (PV/PVC — the volumebinding plugin's informers) ---
     def add_pv(self, pv) -> None:
         with self._lock:
+            self.pvs[pv.name] = pv
             self._emit(Event("Added", "PV", pv, self._bump()))
+
+    def update_pv(self, pv) -> None:
+        with self._lock:
+            self.pvs[pv.name] = pv
+            self._emit(Event("Modified", "PV", pv, self._bump()))
+
+    def delete_pv(self, name: str) -> None:
+        with self._lock:
+            pv = self.pvs.pop(name, None)
+            if pv is not None:
+                self._emit(Event("Deleted", "PV", pv, self._bump()))
 
     def add_pvc(self, pvc) -> None:
         with self._lock:
+            self.pvcs[pvc.key] = pvc
             self._emit(Event("Added", "PVC", pvc, self._bump()))
+
+    def update_pvc(self, pvc) -> None:
+        with self._lock:
+            self.pvcs[pvc.key] = pvc
+            self._emit(Event("Modified", "PVC", pvc, self._bump()))
+
+    def delete_pvc(self, key: str) -> None:
+        with self._lock:
+            pvc = self.pvcs.pop(key, None)
+            if pvc is not None:
+                self._emit(Event("Deleted", "PVC", pvc, self._bump()))
 
     def bind(self, pod_uid: str, node_name: str) -> None:
         """The pods/{name}/binding subresource (defaultbinder's POST)."""

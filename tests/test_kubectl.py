@@ -1,0 +1,237 @@
+"""kubectl analog (the CLI/UX layer) + the manifest codec behind it.
+
+reference: staging/src/k8s.io/kubectl/pkg/cmd/ verbs over client-go, and
+apimachinery's universal decoder (kind-dispatched strict decoding).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import cluster as c
+from kubernetes_tpu.api import serialize as ser
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.kubectl import Kubectl, KubectlError, make_admin_kubectl, resolve_kind
+from kubernetes_tpu.scheduler.auth import TokenAuthenticator, bind_cluster_role
+from kubernetes_tpu.scheduler.apiserver import APIServer
+from kubernetes_tpu.scheduler.store import ClusterStore
+
+
+# ------------------------------------------------------------- serializer
+
+
+def test_serialize_roundtrip_pod_with_nested_constraints():
+    p = t.Pod(
+        name="web-1",
+        requests={"cpu": 1000, "memory": 1 << 30},
+        labels={"app": "web"},
+        node_selector=(("disk", "ssd"),),
+        tolerations=(t.Toleration(key="gpu", operator="Exists", effect="NoSchedule"),),
+        affinity=t.Affinity(
+            required_node_terms=(
+                t.NodeSelectorTerm(
+                    match_expressions=(
+                        t.NodeSelectorRequirement("zone", "In", ("a", "b")),
+                    )
+                ),
+            )
+        ),
+        topology_spread=(
+            t.TopologySpreadConstraint(1, "zone", label_selector=t.LabelSelector.of(app="web")),
+        ),
+    )
+    [p2] = ser.load_yaml(ser.dump_yaml(p))
+    assert p2 == p
+
+
+def test_serialize_mapping_sugar_for_pair_tuples():
+    [p] = ser.load_yaml("kind: Pod\nname: x\nnode_selector: {disk: ssd}\n")
+    assert p.node_selector == (("disk", "ssd"),)
+
+
+def test_serialize_strict_unknown_field_and_kind():
+    with pytest.raises(ser.DecodeError):
+        ser.load_yaml("kind: Pod\nname: x\nbogus: 1\n")
+    with pytest.raises(ser.DecodeError):
+        ser.load_yaml("kind: Gadget\nname: x\n")
+
+
+def test_serialize_list_document_flattens():
+    objs = ser.load_yaml(
+        "kind: List\nitems:\n- {kind: Node, name: n1}\n- {kind: Node, name: n2}\n"
+    )
+    assert [o.name for o in objs] == ["n1", "n2"]
+
+
+# ------------------------------------------------------------- kubectl
+
+
+@pytest.fixture
+def kc():
+    k = make_admin_kubectl()
+    k.run("apply -f -") if False else None
+    for name, cpu in (("n1", 4000), ("n2", 8000)):
+        k.api.store.add_node(t.Node(name=name, allocatable={"cpu": cpu, "memory": 1 << 33}))
+    return k
+
+
+def test_get_nodes_table_and_yaml(kc):
+    out = kc.run("get nodes")
+    assert "n1" in out and "n2" in out and "NAME" in out
+    out = kc.run("get node n1 -o yaml")
+    [n] = ser.load_yaml(out)
+    assert n.name == "n1" and n.allocatable["cpu"] == 4000
+
+
+def test_apply_create_get_delete_pod(kc, tmp_path):
+    f = tmp_path / "pod.yaml"
+    f.write_text("kind: Pod\nname: web-0\nrequests: {cpu: 500}\nlabels: {app: web}\n")
+    assert "created" in kc.run(f"apply -f {f}")
+    assert "configured" in kc.run(f"apply -f {f}")  # idempotent update
+    out = kc.run("get pods")
+    assert "web-0" in out and "Pending" in out
+    # selector filtering
+    assert "web-0" in kc.run("get pods -l app=web")
+    assert "No resources found" in kc.run("get pods -l app=nope")
+    assert "deleted" in kc.run("delete pod web-0")
+    with pytest.raises(KubectlError, match="NotFound"):
+        kc.run("get pod web-0")
+
+
+def test_create_rejects_duplicate(kc, tmp_path):
+    f = tmp_path / "ns.yaml"
+    f.write_text("kind: Namespace\nname: prod\n")
+    kc.run(f"create -f {f}")
+    with pytest.raises(KubectlError, match="AlreadyExists"):
+        kc.run(f"create -f {f}")
+
+
+def test_cordon_uncordon_and_taint(kc):
+    assert "cordoned" in kc.run("cordon n1")
+    assert kc.api.store.nodes["n1"].unschedulable
+    assert "uncordoned" in kc.run("uncordon n1")
+    assert not kc.api.store.nodes["n1"].unschedulable
+
+    kc.run("taint nodes n1 dedicated=tpu:NoSchedule")
+    assert kc.api.store.nodes["n1"].taints == (t.Taint("dedicated", "tpu", "NoSchedule"),)
+    kc.run("taint nodes n1 dedicated:NoSchedule-")
+    assert kc.api.store.nodes["n1"].taints == ()
+
+
+def test_label_add_overwrite_remove(kc):
+    kc.run("label node n1 tier=hot")
+    assert kc.api.store.nodes["n1"].labels["tier"] == "hot"
+    with pytest.raises(KubectlError, match="overwrite"):
+        kc.run("label node n1 tier=cold")
+    kc.run("label node n1 tier=cold --overwrite")
+    assert kc.api.store.nodes["n1"].labels["tier"] == "cold"
+    kc.run("label node n1 tier-")
+    assert "tier" not in kc.api.store.nodes["n1"].labels
+
+
+def test_scale_deployment(kc):
+    kc.api.store.add_object("Deployment", t.Deployment(name="web", replicas=1))
+    assert "scaled" in kc.run("scale deployment/web --replicas=5")
+    assert kc.api.store.objects["Deployment"]["default/web"].replicas == 5
+
+
+def test_top_nodes_uses_requests(kc):
+    kc.api.store.add_pod(
+        t.Pod(name="p", requests={"cpu": 2000, "memory": 1 << 32}, node_name="n1")
+    )
+    out = kc.run("top nodes")
+    assert "50%" in out  # 2000/4000 cpu on n1
+
+
+def test_drain_respects_pdb_then_force(kc):
+    store = kc.api.store
+    store.add_pod(t.Pod(name="a", labels={"app": "db"}, node_name="n1"))
+    store.add_pdb(
+        t.PodDisruptionBudget(
+            name="db-pdb", selector=t.LabelSelector.of(app="db"), min_available=1
+        )
+    )
+    with pytest.raises(KubectlError, match="PodDisruptionBudget"):
+        kc.run("drain n1")
+    # budget blocks eviction but the node is already cordoned
+    assert store.nodes["n1"].unschedulable
+    out = kc.run("drain n1 --disable-eviction")
+    assert "drained" in out
+    assert not any(p.node_name == "n1" for p in store.pods.values())
+
+
+def test_drain_daemonset_pods_need_flag(kc):
+    store = kc.api.store
+    store.add_pod(
+        t.Pod(
+            name="ds-x",
+            node_name="n2",
+            owner_references=(t.OwnerReference("DaemonSet", "ds", "ds/default/ds"),),
+        )
+    )
+    with pytest.raises(KubectlError, match="ignore-daemonsets"):
+        kc.run("drain n2")
+    assert "drained" in kc.run("drain n2 --ignore-daemonsets")
+    # DaemonSet pod survives the drain
+    assert any(p.name == "ds-x" for p in store.pods.values())
+
+
+def test_rollout_status(kc):
+    store = kc.api.store
+    d = t.Deployment(name="web", replicas=2)
+    store.add_object("Deployment", d)
+    rs = t.ReplicaSet(
+        name="web-abc",
+        replicas=2,
+        ready_replicas=0,
+        owner_references=(t.OwnerReference("Deployment", "web", d.uid),),
+    )
+    store.add_object("ReplicaSet", rs)
+    assert "Waiting" in kc.run("rollout status deployment/web")
+    rs.ready_replicas = 2
+    store.update_object("ReplicaSet", rs)
+    assert "successfully rolled out" in kc.run("rollout status deployment/web")
+
+
+def test_auth_can_i_respects_rbac():
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    authn.add_token("admin-token", "admin", groups=("system:masters",))
+    authn.add_token("viewer-token", "viewer")
+    store.add_object(
+        "Role",
+        c.Role(name="view", rules=(c.PolicyRule(verbs=("get", "list"), resources=("pods",)),)),
+    )
+    bind_cluster_role(store, "view-binding", "view", [("User", "viewer")])
+    api = APIServer(store, authenticator=authn)
+    admin = Kubectl(api, "admin-token")
+    viewer = Kubectl(api, "viewer-token")
+    assert admin.run("auth can-i delete nodes").strip() == "yes"
+    assert viewer.run("auth can-i list pods").strip() == "yes"
+    assert viewer.run("auth can-i delete pods").strip() == "no"
+    # and the verbs actually enforce it
+    with pytest.raises(KubectlError, match="Forbidden|cannot"):
+        viewer.run("cordon n1")
+
+
+def test_pv_pvc_via_api_and_cli(kc, tmp_path):
+    f = tmp_path / "vol.yaml"
+    f.write_text(
+        "kind: PersistentVolume\nname: pv-a\ncapacity: 100\nstorage_class: fast\n"
+        "---\nkind: PersistentVolumeClaim\nname: claim-a\nrequest: 50\nstorage_class: fast\n"
+    )
+    kc.run(f"apply -f {f}")
+    assert "pv-a" in kc.run("get pv")
+    out = kc.run("get pvc")
+    assert "claim-a" in out and "Pending" in out
+    kc.run("delete pvc claim-a")
+    assert "No resources found" in kc.run("get pvc")
+
+
+def test_api_resources_and_version(kc):
+    out = kc.run("api-resources")
+    assert "pods" in out and "storageclasses" in out
+    assert "kubectl" in kc.run("version")
+
+
+def test_resolve_kind_rejects_unknown():
+    with pytest.raises(KubectlError):
+        resolve_kind("gadgets")

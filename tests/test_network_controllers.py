@@ -381,3 +381,95 @@ def test_full_stack_daemonset_through_scheduler_and_kubelet():
     proxy = Proxier(store)
     proxy.sync()
     assert proxy.lookup("client", "10.96.0.5", 9100) is not None
+
+
+# ------------------------------------------- review regressions (wave 2 fixes)
+
+
+def test_completed_job_never_reruns_after_podgc():
+    """Job status is authoritative once complete: GC-deleting the succeeded
+    pods must not respawn the workload (completion_time guard)."""
+    store = _store_with_nodes()
+    clock = FakeClock()
+    ctrl = JobController(store, clock=clock)
+    job = t.Job(name="batch", completions=2, parallelism=2,
+                template=t.Pod(name="x", run_seconds=1.0))
+    store.add_object("Job", job)
+    ctrl.tick()
+    for p in list(store.pods.values()):
+        p.phase = t.PHASE_SUCCEEDED
+    ctrl.tick()
+    done = store.get_object("Job", "default/batch")
+    assert done.complete and done.completion_time >= 0
+    # PodGC wipes the succeeded pods
+    for p in list(store.pods.values()):
+        store.delete_pod(p.uid)
+    ctrl.tick()
+    assert store.pods == {}  # no respawn
+    assert store.get_object("Job", "default/batch").complete
+
+
+def test_daemonset_replaces_finished_daemon_pod():
+    store = _store_with_nodes(1)
+    ctrl = DaemonSetController(store)
+    store.add_object("DaemonSet", c.DaemonSet(name="agent", template=t.Pod(name="x")))
+    ctrl.tick()
+    [pod] = store.pods.values()
+    pod.phase = t.PHASE_FAILED
+    ctrl.tick()
+    pods = list(store.pods.values())
+    assert len(pods) == 1 and pods[0].phase != t.PHASE_FAILED  # recreated fresh
+
+
+def test_statefulset_recreates_finished_ordinal():
+    store = _store_with_nodes()
+    ctrl = StatefulSetController(store)
+    store.add_object("StatefulSet", c.StatefulSet(name="db", replicas=2,
+                                                  template=t.Pod(name="x")))
+    ctrl.tick()
+    p0 = store.pods["default/db-0"]
+    p0.node_name, p0.phase = "n0", t.PHASE_FAILED
+    ctrl.tick()  # db-0 deleted + recreated at the same ordinal, gate intact
+    assert sorted(p.name for p in store.pods.values()) == ["db-0"]
+    assert store.pods["default/db-0"].phase != t.PHASE_FAILED
+
+
+def test_namespace_controller_drains_pvcs():
+    store = ClusterStore()
+    store.add_object("Namespace", c.Namespace(name="team-a", phase="Terminating"))
+    store.add_pvc(t.PersistentVolumeClaim(name="data", namespace="team-a"))
+    ctrl = NamespaceController(store)
+    ctrl.tick()
+    ctrl.tick()
+    assert store.pvcs == {}
+    assert store.get_object("Namespace", "team-a") is None
+
+
+def test_podgc_terminated_sweep_oldest_finish_time_first():
+    store = _store_with_nodes(1)
+    gc = PodGCController(store, terminated_threshold=1)
+    for name, at in (("late", 30.0), ("early", 10.0)):
+        store.add_pod(t.Pod(name=name, node_name="n0",
+                            phase=t.PHASE_SUCCEEDED, finished_at=at))
+    gc.tick()
+    assert [p.name for p in store.pods.values()] == ["late"]
+
+
+def test_hollow_kubelets_share_store_get_disjoint_cidrs():
+    """Two allocators over one store must hand out disjoint per-node /24s."""
+    from kubernetes_tpu.scheduler.kubelet import HollowKubelet
+
+    store = _store_with_nodes(2)
+    leases = LeaseStore(FakeClock())
+    cluster = HollowCluster(store, leases)
+    store.add_pod(t.Pod(name="a", node_name="n0"))
+    store.add_pod(t.Pod(name="b", node_name="n1"))
+    cluster.tick()  # n0, n1 via the fleet
+    direct = HollowKubelet(store, leases, "n1")  # standalone, same store
+    store.add_pod(t.Pod(name="c", node_name="n1"))
+    direct.tick()
+    ips = {p.name: p.pod_ip for p in store.pods.values()}
+    assert len(set(ips.values())) == 3, ips
+    # same node -> same subnet regardless of which kubelet allocated
+    assert ips["b"].rsplit(".", 1)[0] == ips["c"].rsplit(".", 1)[0]
+    assert ips["a"].rsplit(".", 1)[0] != ips["b"].rsplit(".", 1)[0]
