@@ -77,6 +77,12 @@ class Snapshot:
     pod_groups: Dict[str, t.PodGroup] = field(default_factory=dict)
     pvs: List[t.PersistentVolume] = field(default_factory=list)
     pvcs: Dict[str, t.PersistentVolumeClaim] = field(default_factory=dict)  # "ns/name" ->
+    # storage.k8s.io StorageClasses by name (dynamic-provisioning feasibility)
+    storage_classes: Dict[str, object] = field(default_factory=dict)
+    # resource.k8s.io structured parameters: published device inventories and
+    # the class selectors resolved against them (api/cluster.py types)
+    resource_slices: List[object] = field(default_factory=list)
+    device_classes: Dict[str, object] = field(default_factory=dict)
 
 
 @dataclass

@@ -58,12 +58,25 @@ def _unsatisfiable_term() -> t.NodeSelectorTerm:
     )
 
 
+def _class_topology_term(sc) -> Optional[t.NodeSelectorTerm]:
+    if not sc.allowed_topology:
+        return None
+    return t.NodeSelectorTerm(
+        match_expressions=tuple(
+            t.NodeSelectorRequirement(key=k, operator=t.OP_IN, values=(v,))
+            for k, v in sc.allowed_topology
+        )
+    )
+
+
 def resolve_pod(
     pod: t.Pod,
     pvcs: Dict[str, t.PersistentVolumeClaim],
     pvs: Dict[str, t.PersistentVolume],
+    classes: Optional[Dict[str, object]] = None,
 ) -> t.Pod:
     """Fold the pod's storage/claim constraints into requests + node affinity."""
+    classes = classes or {}
     extra_terms: List[t.NodeSelectorTerm] = []
     attach_count = 0
     req_extra: Dict[str, int] = {}
@@ -80,8 +93,14 @@ def resolve_pod(
                 extra_terms.append(_unsatisfiable_term())
             elif term is not None:
                 extra_terms.append(term)
-        elif not pvc.wait_for_first_consumer:
-            # immediate binding: some available compatible PV must exist
+        else:
+            # Unbound claim (binder.go — FindPodVolumes): the node must admit
+            # SOME binding option — a compatible static PV, or dynamic
+            # provisioning through the claim's StorageClass.
+            sc = classes.get(pvc.storage_class)
+            wffc = pvc.wait_for_first_consumer or (
+                sc is not None and sc.volume_binding_mode == "WaitForFirstConsumer"
+            )
             candidates = [
                 pv
                 for pv in pvs.values()
@@ -89,20 +108,30 @@ def resolve_pod(
                 and pv.storage_class == pvc.storage_class
                 and pv.capacity >= pvc.request
             ]
-            if not candidates:
-                extra_terms.append(_unsatisfiable_term())
+            provisionable = sc is not None and bool(sc.provisioner)
+            # any option with no topology restriction => no constraint at all
+            unconstrained = any(not c.allowed_topology for c in candidates) or (
+                provisionable and not sc.allowed_topology
+            )
+            if unconstrained:
+                continue
+            options = [
+                term
+                for term in (_pv_topology_term(c) for c in candidates)
+                if term is not None
+            ]
+            if provisionable:
+                ct = _class_topology_term(sc)
+                if ct is not None:
+                    options.append(ct)
+            if options:
+                extra_terms.append(options[0] if len(options) == 1 else _or_marker(tuple(options)))
+            elif wffc and sc is None:
+                # delayed binding through an unknown class: no constraint can
+                # be derived at filter time (the pre-StorageClass behavior)
+                continue
             else:
-                topos = [c for c in candidates if c.allowed_topology]
-                if len(topos) == len(candidates):
-                    # all candidates are topology-restricted: node must match one
-                    # (terms inside one affinity list are ORed, but the pod may
-                    # already have affinity terms which AND against these via
-                    # distribution — handled below by merging conjunctively
-                    # through a single-term union when possible)
-                    union = tuple(
-                        _pv_topology_term(c) for c in candidates if _pv_topology_term(c)
-                    )
-                    extra_terms.append(union[0] if len(union) == 1 else _or_marker(union))
+                extra_terms.append(_unsatisfiable_term())
     if attach_count:
         req_extra[ATTACH_RESOURCE] = attach_count
     for rc in pod.resource_claims:
@@ -161,9 +190,32 @@ def _and_affinity(aff: Optional[t.Affinity], extra) -> t.Affinity:
     )
 
 
+def _device_counts(snap: Snapshot) -> Dict[str, Dict[str, int]]:
+    """node -> {claim/<class>: count} from published ResourceSlices resolved
+    through DeviceClass selectors — the structured-parameter allocator
+    (resource.k8s.io) reduced to per-node per-class counting, which is what
+    the vectorized Fit kernel consumes."""
+    out: Dict[str, Dict[str, int]] = {}
+    # devices are allocated exclusively in the reference; a device matching
+    # several class selectors counts toward only ONE class here — the first
+    # in name order (deterministic reduction of exclusive allocation)
+    classes = sorted(snap.device_classes.values(), key=lambda dc: dc.name)
+    for sl in snap.resource_slices:
+        if not sl.node_name:
+            continue
+        per = out.setdefault(sl.node_name, {})
+        for dev in sl.devices:
+            for dc in classes:
+                if dc.selector.matches(dev):
+                    key = CLAIM_PREFIX + dc.name
+                    per[key] = per.get(key, 0) + 1
+                    break
+    return out
+
+
 def resolve_snapshot(snap: Snapshot) -> Snapshot:
     """Returns a snapshot with volume/claim constraints folded in (no-op when
-    the snapshot has no PVs/PVCs/claims/attach limits)."""
+    the snapshot has no PVs/PVCs/claims/attach limits/device slices)."""
     has_storage = bool(
         snap.pvs
         or snap.pvcs
@@ -171,28 +223,36 @@ def resolve_snapshot(snap: Snapshot) -> Snapshot:
     )
     has_claims = any(p.resource_claims for p in [*snap.pending_pods, *snap.bound_pods])
     has_limits = any(nd.volume_attach_limit for nd in snap.nodes)
-    if not (has_storage or has_claims or has_limits):
+    has_devices = bool(snap.resource_slices and snap.device_classes)
+    if not (has_storage or has_claims or has_limits or has_devices):
         return snap
     pvs = {pv.name: pv for pv in snap.pvs}
     pvcs = dict(snap.pvcs)
+    classes = dict(snap.storage_classes)
     nodes = snap.nodes
-    if has_limits or has_storage:
-        # every node advertises the synthetic attach resource: its declared
-        # limit, or effectively-unlimited when none (csi.go treats a missing
-        # limit as no cap)
+    devices = _device_counts(snap) if has_devices else {}
+    if has_limits or has_storage or devices:
         nodes = []
         for nd in snap.nodes:
             nd2 = copy.copy(nd)
+            # every node advertises the synthetic attach resource: its declared
+            # limit, or effectively-unlimited when none (csi.go treats a
+            # missing limit as no cap)
             nd2.allocatable = {
                 **nd.allocatable,
                 ATTACH_RESOURCE: nd.volume_attach_limit or 1_000_000,
+                # device inventory from slices overrides any hand-set counts
+                **devices.get(nd.name, {}),
             }
             nodes.append(nd2)
     return Snapshot(
         nodes=nodes,
-        pending_pods=[resolve_pod(p, pvcs, pvs) for p in snap.pending_pods],
-        bound_pods=[resolve_pod(p, pvcs, pvs) for p in snap.bound_pods],
+        pending_pods=[resolve_pod(p, pvcs, pvs, classes) for p in snap.pending_pods],
+        bound_pods=[resolve_pod(p, pvcs, pvs, classes) for p in snap.bound_pods],
         pod_groups=snap.pod_groups,
         pvs=snap.pvs,
         pvcs=snap.pvcs,
+        storage_classes=snap.storage_classes,
+        resource_slices=snap.resource_slices,
+        device_classes=snap.device_classes,
     )

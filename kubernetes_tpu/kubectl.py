@@ -86,6 +86,13 @@ _CLUSTER_SCOPED = {"Node", "Namespace", "PriorityClass", "PV", "StorageClass",
                    "PriorityLevelConfiguration"}
 
 
+def _singular(resource: str) -> str:
+    """storageclasses -> storageclass, pods -> pod (the kubectl name printer)."""
+    if resource.endswith("classes"):
+        return resource[:-2]
+    return resource[:-1] if resource.endswith("s") else resource
+
+
 def resolve_kind(word: str) -> str:
     k = _KIND_WORDS.get(word.lower())
     if k is None:
@@ -207,9 +214,19 @@ class Kubectl:
                 objs = [o for o in objs if getattr(o, "namespace", ns) == ns]
         sel = flags.get("selector")
         if sel:
-            want = dict(kv.split("=", 1) for kv in sel.split(","))
-            objs = [o for o in objs
-                    if all(getattr(o, "labels", {}).get(k) == v for k, v in want.items())]
+            # key=value equality and bare-key existence terms, comma-ANDed
+            def _matches(o) -> bool:
+                labels = getattr(o, "labels", {})
+                for term in sel.split(","):
+                    if "=" in term:
+                        k, v = term.split("=", 1)
+                        if labels.get(k) != v:
+                            return False
+                    elif term not in labels:
+                        return False
+                return True
+
+            objs = [o for o in objs if _matches(o)]
         out = flags.get("output", "")
         if out == "yaml":
             return ser.dump_yaml(objs if len(objs) != 1 else objs[0])
@@ -221,7 +238,7 @@ class Kubectl:
                               {"kind": "List", "items": docs}, indent=2) + "\n"
         if out == "name":
             return "".join(
-                f"{resource_of(kind)[:-1] if resource_of(kind).endswith('s') else kind.lower()}"
+                f"{_singular(resource_of(kind))}"
                 f"/{o.name}\n" for o in objs)
         return self._table(kind, objs, wide=out == "wide")
 
@@ -348,7 +365,7 @@ class Kubectl:
             verb = "update" if existing is not None else "create"
             self._handle(verb, kind, obj)
             what = "configured" if verb == "update" else "created"
-            lines.append(f"{resource_of(kind)[:-1]}/{obj.name} {what}\n")
+            lines.append(f"{_singular(resource_of(kind))}/{obj.name} {what}\n")
         return "".join(lines)
 
     def _cmd_create(self, pos, flags):
@@ -361,7 +378,7 @@ class Kubectl:
                     f'Error from server (AlreadyExists): {resource_of(kind)} '
                     f'"{obj.name}" already exists')
             self._handle("create", kind, obj)
-            lines.append(f"{resource_of(kind)[:-1]}/{obj.name} created\n")
+            lines.append(f"{_singular(resource_of(kind))}/{obj.name} created\n")
         return "".join(lines)
 
     # --------------------------------------------------------------- delete
@@ -380,7 +397,7 @@ class Kubectl:
         for kind, ns, name in targets:
             self._get_required(kind, ns, name)
             self._handle("delete", kind, namespace=ns, name=name)
-            lines.append(f'{resource_of(kind)[:-1]} "{name}" deleted\n')
+            lines.append(f'{_singular(resource_of(kind))} "{name}" deleted\n')
         return "".join(lines)
 
     # ---------------------------------------------------------------- scale
@@ -403,7 +420,7 @@ class Kubectl:
         obj = copy.copy(self._get_required(kind, ns, name))
         obj.replicas = n
         self._handle("update", kind, obj)
-        return f"{resource_of(kind)[:-1]}/{name} scaled\n"
+        return f"{_singular(resource_of(kind))}/{name} scaled\n"
 
     # ------------------------------------------------------ cordon / uncordon
     def _set_unschedulable(self, name: str, value: bool) -> str:
@@ -511,7 +528,7 @@ class Kubectl:
                 labels[k] = v
         obj.labels = labels
         self._handle("update", kind, obj)
-        return f"{resource_of(kind)[:-1]}/{pos[1]} labeled\n"
+        return f"{_singular(resource_of(kind))}/{pos[1]} labeled\n"
 
     # ------------------------------------------------------------------ top
     def _cmd_top(self, pos, flags):
@@ -553,8 +570,10 @@ class Kubectl:
             raise KubectlError("usage: rollout status deployment/<name>")
         if "/" in pos[1]:
             kw, name = pos[1].split("/", 1)
-        else:
+        elif len(pos) >= 3:
             kw, name = pos[1], pos[2]
+        else:
+            raise KubectlError("usage: rollout status deployment/<name>")
         if resolve_kind(kw) != "Deployment":
             raise KubectlError("rollout status supports deployments")
         ns = self._ns(flags) or "default"

@@ -21,6 +21,10 @@ from .store import ClusterStore, Event, replace_pod_nodename
 class SchedulerCache:
     def __init__(self, store: ClusterStore):
         self._lock = threading.Lock()
+        # registry kinds the snapshot LISTs at build time (StorageClass /
+        # ResourceSlice / DeviceClass churn far less than pods; a per-cycle
+        # LIST matches the reference's informer-cache read)
+        self._store = store
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # all pods by uid (pending + bound)
         self.assumed: Dict[str, str] = {}  # pod uid -> node (optimistic binds)
@@ -91,6 +95,13 @@ class SchedulerCache:
                 pod_groups=dict(self.pod_groups),
                 pvs=list(self.pvs.values()),
                 pvcs=dict(self.pvcs),
+                storage_classes={
+                    sc.name: sc for sc in self._store.list_objects("StorageClass")
+                },
+                resource_slices=self._store.list_objects("ResourceSlice"),
+                device_classes={
+                    dc.name: dc for dc in self._store.list_objects("DeviceClass")
+                },
             )
 
     def node_infos(self, snap: Snapshot) -> List[NodeInfo]:

@@ -243,7 +243,11 @@ class JobController:
             if p.namespace == job.namespace
             and any(r.uid == job.uid for r in p.owner_references)
         ]
-        succeeded = sum(1 for p in owned if p.phase == t.PHASE_SUCCEEDED)
+        # monotonic like the reference's status.succeeded: pods GC'd after
+        # finishing must not decrease the count (or rerun completed work)
+        succeeded = max(
+            job.succeeded, sum(1 for p in owned if p.phase == t.PHASE_SUCCEEDED)
+        )
         active = [p for p in owned if not _is_finished(p)]
         want_active = min(job.parallelism, max(0, job.completions - succeeded))
         owner = t.OwnerReference(kind="Job", name=job.name, uid=job.uid)

@@ -229,6 +229,26 @@ class InterPodAffinity(Plugin):
             scores[:] = f32(0.0)
 
 
+class VolumeBinding(Plugin):
+    """volumebinding/volume_binding.go — PreBind: BindPodVolumes.  The
+    feasibility half (FindPodVolumes) is folded into the snapshot by
+    api/volumes.resolve_pod and shared with the batch paths; this plugin
+    commits the chosen binding (static PV match or dynamic provisioning)."""
+
+    name = "VolumeBinding"
+
+    def __init__(self, store):
+        self.store = store
+
+    def PreBind(self, state, snap, pod, node_name) -> Status:
+        if not pod.pvcs:
+            return Status()
+        from ..volumebinder import bind_pod_volumes
+
+        err = bind_pod_volumes(self.store, pod, node_name)
+        return Status() if err is None else Status.unschedulable(err)
+
+
 class DefaultBinder(Plugin):
     """defaultbinder/default_binder.go — Bind: POST pods/{name}/binding."""
 
@@ -380,6 +400,7 @@ def default_plugins(
     ]
     if filter_fn is not None:
         pls.append(PluginWeight(DefaultPreemption(filter_fn, store, nominated_fn)))
+    pls.append(PluginWeight(VolumeBinding(store)))
     pls.append(PluginWeight(DefaultBinder(store)))
     return pls
 
@@ -400,6 +421,7 @@ def default_registry() -> Dict[str, type]:
             InterPodAffinity,
             ImageLocality,
             DefaultPreemption,
+            VolumeBinding,
             DefaultBinder,
         ]
     }

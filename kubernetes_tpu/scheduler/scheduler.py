@@ -224,6 +224,11 @@ class Scheduler:
             pending_pods=[p for p in batch if p.uid not in bound_uids],
             bound_pods=snap.bound_pods + reserved,
             pod_groups=snap.pod_groups,
+            pvs=snap.pvs,
+            pvcs=snap.pvcs,
+            storage_classes=snap.storage_classes,
+            resource_slices=snap.resource_slices,
+            device_classes=snap.device_classes,
         )
         gang = self.features.enabled("GangScheduling")
         prof = self.config.profile()
@@ -273,6 +278,14 @@ class Scheduler:
         failed: List[t.Pod] = []
         for pod in snap.pending_pods:
             node_name = verdicts.get(pod.uid)
+            if node_name and pod.pvcs:
+                # PreBind volume commitment (static match / provisioning);
+                # failure sends the pod down the ordinary retry path
+                from .volumebinder import bind_pod_volumes
+
+                err = bind_pod_volumes(self.store, pod, node_name)
+                if err is not None:
+                    node_name = None
             if node_name:
                 self.cache.assume(pod.uid, node_name)
                 self.store.bind(pod.uid, node_name)

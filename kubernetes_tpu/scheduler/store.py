@@ -76,7 +76,8 @@ def _key_of(obj) -> str:
 
 class ClusterStore:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # re-entrant: watchers are invoked under the lock and may read back
+        self._lock = threading.RLock()
         self._rv = 0
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # by uid
@@ -192,10 +193,12 @@ class ClusterStore:
                 self._emit(Event("Deleted", kind, obj, self._bump()))
 
     def get_object(self, kind: str, key: str):
-        return self._table(kind).get(key)
+        with self._lock:
+            return self._table(kind).get(key)
 
     def list_objects(self, kind: str, namespace: Optional[str] = None) -> list:
-        out = list(self._table(kind).values())
+        with self._lock:
+            out = list(self._table(kind).values())
         if namespace is not None:
             out = [o for o in out if getattr(o, "namespace", "") == namespace]
         return out

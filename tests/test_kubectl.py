@@ -67,7 +67,6 @@ def test_serialize_list_document_flattens():
 @pytest.fixture
 def kc():
     k = make_admin_kubectl()
-    k.run("apply -f -") if False else None
     for name, cpu in (("n1", 4000), ("n2", 8000)):
         k.api.store.add_node(t.Node(name=name, allocatable={"cpu": cpu, "memory": 1 << 33}))
     return k
@@ -235,3 +234,11 @@ def test_api_resources_and_version(kc):
 def test_resolve_kind_rejects_unknown():
     with pytest.raises(KubectlError):
         resolve_kind("gadgets")
+
+
+def test_get_selector_existence_and_bad_rollout_usage(kc):
+    kc.api.store.add_pod(t.Pod(name="lbl", labels={"app": "x", "canary": ""}))
+    assert "lbl" in kc.run("get pods -l canary")          # existence term
+    assert "No resources found" in kc.run("get pods -l nope")
+    with pytest.raises(KubectlError, match="usage"):
+        kc.run("rollout status deployment")

@@ -113,3 +113,232 @@ def test_missing_pvc_leaves_pod_pending():
     snap = Snapshot(nodes=[mk_node("n")], pending_pods=[mk_pod("p", pvcs=("ghost",))])
     got = run_all_paths(snap)
     assert got["p"] is None
+
+
+# ------------------------- StorageClass dynamic provisioning (binder.go shape)
+
+
+def _sc(name, provisioner="csi.example.com", mode="WaitForFirstConsumer", topo=()):
+    from kubernetes_tpu.api import cluster as c
+
+    return c.StorageClass(name=name, provisioner=provisioner,
+                          volume_binding_mode=mode, allowed_topology=tuple(topo))
+
+
+def test_wffc_provisioner_topology_constrains_nodes():
+    """An unbound WaitForFirstConsumer claim whose class provisions only in
+    zone a must steer the pod to zone a — on every execution path."""
+    sc = _sc("zonal", topo=((t.LABEL_ZONE, "a"),))
+    pvc = t.PersistentVolumeClaim(name="data", request=10 * GI, storage_class="zonal",
+                                  wait_for_first_consumer=True)
+    nodes = [
+        mk_node("n-b", labels={t.LABEL_ZONE: "b"}),
+        mk_node("n-a", labels={t.LABEL_ZONE: "a"}),
+    ]
+    snap = Snapshot(nodes=nodes, pending_pods=[mk_pod("p", pvcs=("data",))],
+                    pvcs={pvc.key: pvc}, storage_classes={"zonal": sc})
+    assert run_all_paths(snap)["p"] == "n-a"
+
+
+def test_immediate_unbound_claim_provisionable_schedules_anywhere():
+    """No static PV exists, but the class provisions without topology limits:
+    previously unschedulable, now feasible everywhere."""
+    sc = _sc("fast", mode="Immediate")
+    pvc = t.PersistentVolumeClaim(name="scratch", request=GI, storage_class="fast")
+    snap = Snapshot(nodes=[mk_node("a"), mk_node("b")],
+                    pending_pods=[mk_pod("p", pvcs=("scratch",))],
+                    pvcs={pvc.key: pvc}, storage_classes={"fast": sc})
+    assert run_all_paths(snap)["p"] == "a"
+
+
+def test_unbound_claim_class_without_provisioner_unschedulable():
+    sc = _sc("static-only", provisioner="")
+    pvc = t.PersistentVolumeClaim(name="data", request=GI, storage_class="static-only",
+                                  wait_for_first_consumer=True)
+    snap = Snapshot(nodes=[mk_node("a")],
+                    pending_pods=[mk_pod("p", pvcs=("data",))],
+                    pvcs={pvc.key: pvc}, storage_classes={"static-only": sc})
+    assert run_all_paths(snap)["p"] is None
+
+
+def test_static_candidates_or_with_provisioner_topology():
+    """Options are ORed: a static PV in zone b OR provisioning in zone a."""
+    sc = _sc("mixed", topo=((t.LABEL_ZONE, "a"),))
+    pv = t.PersistentVolume(name="pv-b", capacity=100 * GI, storage_class="mixed",
+                            allowed_topology=((t.LABEL_ZONE, "b"),))
+    pvc = t.PersistentVolumeClaim(name="data", request=GI, storage_class="mixed",
+                                  wait_for_first_consumer=True)
+    nodes = [
+        mk_node("n-c", labels={t.LABEL_ZONE: "c"}),
+        mk_node("n-b", labels={t.LABEL_ZONE: "b"}),
+        mk_node("n-a", labels={t.LABEL_ZONE: "a"}),
+    ]
+    snap = Snapshot(nodes=nodes, pending_pods=[mk_pod("p", pvcs=("data",))],
+                    pvs=[pv], pvcs={pvc.key: pvc}, storage_classes={"mixed": sc})
+    got = run_all_paths(snap)
+    assert got["p"] in ("n-a", "n-b")  # never zone c
+
+
+# --------------------------------- DRA structured parameters (resource.k8s.io)
+
+
+def _tpu_slices_and_class():
+    from kubernetes_tpu.api import cluster as c
+
+    dc = c.DeviceClass(name="tpu", selector=c.DeviceSelector(terms=(("type", "v5e"),)))
+    slices = [
+        c.ResourceSlice(
+            name="n0-tpus", node_name="n0", driver="tpu.dev",
+            devices=(
+                c.DraDevice("d0", attributes=(("type", "v5e"),)),
+                c.DraDevice("d1", attributes=(("type", "v5e"),)),
+                c.DraDevice("d2", attributes=(("type", "v5e"),)),
+                c.DraDevice("x0", attributes=(("type", "cpu"),)),  # not matched
+            ),
+        )
+    ]
+    return slices, {"tpu": dc}
+
+
+def test_resource_slices_publish_per_node_device_counts():
+    slices, classes = _tpu_slices_and_class()
+    pod = mk_pod("p", resource_claims=(t.ResourceClaimRef("tpu", 2),))
+    snap = Snapshot(nodes=[mk_node("n1"), mk_node("n0")], pending_pods=[pod],
+                    resource_slices=slices, device_classes=classes)
+    # only n0 publishes tpu devices (3 of 4 match the class selector)
+    assert run_all_paths(snap)["p"] == "n0"
+
+
+def test_device_claims_deplete_against_slice_inventory():
+    slices, classes = _tpu_slices_and_class()
+    first = mk_pod("a", resource_claims=(t.ResourceClaimRef("tpu", 2),))
+    second = mk_pod("b", resource_claims=(t.ResourceClaimRef("tpu", 2),))
+    snap = Snapshot(nodes=[mk_node("n0")], pending_pods=[first, second],
+                    resource_slices=slices, device_classes=classes)
+    got = run_all_paths(snap)
+    assert got["a"] == "n0" and got["b"] is None  # 3 devices: 2 + 2 > 3
+
+
+def test_oversized_claim_unschedulable():
+    slices, classes = _tpu_slices_and_class()
+    pod = mk_pod("p", resource_claims=(t.ResourceClaimRef("tpu", 5),))
+    snap = Snapshot(nodes=[mk_node("n0")], pending_pods=[pod],
+                    resource_slices=slices, device_classes=classes)
+    assert run_all_paths(snap)["p"] is None
+
+
+# ----------------------------------- PreBind volume binding + provisioning
+
+
+def test_scheduler_binds_and_provisions_wffc_claim():
+    """End-to-end through the CPU cycle: the WFFC claim is provisioned at
+    PreBind — a PV appears, pinned to the chosen node's zone, and the PVC
+    binds to it; a second pod sharing the claim must follow into the zone."""
+    from kubernetes_tpu.api import cluster as c
+    from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.scheduler.store import ClusterStore
+
+    store = ClusterStore()
+    store.add_object("StorageClass", _sc("zonal"))
+    for name, zone in (("n-a", "a"), ("n-b", "b")):
+        store.add_node(t.Node(name=name, allocatable={t.CPU: 4000},
+                              labels={t.LABEL_ZONE: zone}))
+    store.add_pvc(t.PersistentVolumeClaim(name="data", request=10 * GI,
+                                          storage_class="zonal",
+                                          wait_for_first_consumer=True))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"))
+    store.add_pod(t.Pod(name="writer", requests={t.CPU: 500}, pvcs=("data",)))
+    sched.run_until_idle()
+    writer = store.pods["default/writer"]
+    assert writer.node_name in ("n-a", "n-b")
+    zone = store.nodes[writer.node_name].labels[t.LABEL_ZONE]
+    pvc = store.pvcs["default/data"]
+    assert pvc.volume_name == "pvc-default-data"
+    pv = store.pvs[pvc.volume_name]
+    assert pv.claim_ref == "default/data"
+    assert pv.allowed_topology == ((t.LABEL_ZONE, zone),)
+    # a second consumer of the (now bound) claim must land in the same zone
+    store.add_pod(t.Pod(name="reader", requests={t.CPU: 500}, pvcs=("data",)))
+    sched.run_until_idle()
+    reader = store.pods["default/reader"]
+    assert store.nodes[reader.node_name].labels[t.LABEL_ZONE] == zone
+
+
+def test_batch_mode_binds_volumes_and_keeps_pvc_constraints():
+    """schedule_batch must carry PV/PVC/StorageClass state into its snapshot
+    (regression: the rebuilt batch snapshot used to drop them) and run the
+    PreBind volume commitment."""
+    from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.scheduler.store import ClusterStore
+
+    store = ClusterStore()
+    store.add_object("StorageClass", _sc("zonal", topo=((t.LABEL_ZONE, "a"),)))
+    for name, zone in (("n-b", "b"), ("n-a", "a")):
+        store.add_node(t.Node(name=name, allocatable={t.CPU: 4000},
+                              labels={t.LABEL_ZONE: zone}))
+    store.add_pvc(t.PersistentVolumeClaim(name="data", request=10 * GI,
+                                          storage_class="zonal",
+                                          wait_for_first_consumer=True))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(t.Pod(name="writer", requests={t.CPU: 500}, pvcs=("data",)))
+    sched.run_until_idle()
+    writer = store.pods["default/writer"]
+    assert writer.node_name == "n-a"  # the class only provisions in zone a
+    assert store.pvcs["default/data"].volume_name == "pvc-default-data"
+
+
+def test_prebind_rejects_node_outside_provisioning_topology():
+    """A same-batch sibling can consume the static PV a verdict relied on;
+    PreBind must then refuse to provision outside the class topology instead
+    of creating an unreachable volume."""
+    from kubernetes_tpu.scheduler.store import ClusterStore
+    from kubernetes_tpu.scheduler.volumebinder import bind_pod_volumes
+
+    store = ClusterStore()
+    store.add_object("StorageClass", _sc("mixed", topo=((t.LABEL_ZONE, "a"),)))
+    store.add_node(t.Node(name="n-b", allocatable={t.CPU: 4000},
+                          labels={t.LABEL_ZONE: "b"}))
+    store.add_pvc(t.PersistentVolumeClaim(name="d", request=GI, storage_class="mixed",
+                                          wait_for_first_consumer=True))
+    err = bind_pod_volumes(store, t.Pod(name="p", pvcs=("d",)), "n-b")
+    assert err is not None and "cannot provision" in err
+    assert store.pvs == {}  # nothing was created
+
+
+def test_prebind_rechecks_claim_bound_by_sibling():
+    """A claim bound (by a sibling) after this pod's verdict must be
+    topology-checked against the chosen node at PreBind."""
+    from kubernetes_tpu.scheduler.store import ClusterStore
+    from kubernetes_tpu.scheduler.volumebinder import bind_pod_volumes
+
+    store = ClusterStore()
+    store.add_node(t.Node(name="n-b", allocatable={t.CPU: 4000},
+                          labels={t.LABEL_ZONE: "b"}))
+    store.add_pv(t.PersistentVolume(name="pv-a", capacity=GI, storage_class="s",
+                                    allowed_topology=((t.LABEL_ZONE, "a"),),
+                                    claim_ref="default/d"))
+    store.add_pvc(t.PersistentVolumeClaim(name="d", request=GI, storage_class="s",
+                                          volume_name="pv-a"))
+    err = bind_pod_volumes(store, t.Pod(name="p", pvcs=("d",)), "n-b")
+    assert err is not None and "not reachable" in err
+
+
+def test_multi_class_device_counts_are_exclusive():
+    """One physical device matching two class selectors must satisfy only one
+    class's capacity (exclusive allocation, first class in name order)."""
+    from kubernetes_tpu.api import cluster as c
+    from kubernetes_tpu.api.volumes import resolve_snapshot
+
+    both = c.DraDevice("d0", attributes=(("type", "v5e"), ("fast", "yes")))
+    slices = [c.ResourceSlice(name="s", node_name="n0", driver="d", devices=(both,))]
+    classes = {
+        "tpu": c.DeviceClass(name="tpu", selector=c.DeviceSelector(terms=(("type", "v5e"),))),
+        "accel": c.DeviceClass(name="accel", selector=c.DeviceSelector(terms=(("fast", "yes"),))),
+    }
+    snap = resolve_snapshot(Snapshot(nodes=[mk_node("n0")], pending_pods=[mk_pod("p")],
+                                     resource_slices=slices, device_classes=classes))
+    alloc = snap.nodes[0].allocatable
+    assert alloc.get("claim/accel", 0) == 1  # "accel" < "tpu" in name order
+    assert alloc.get("claim/tpu", 0) == 0
