@@ -35,17 +35,21 @@ ATTACH_RESOURCE = "attachable-volumes-csi"
 CLAIM_PREFIX = "claim/"
 
 
-def _pv_topology_term(pv: t.PersistentVolume) -> Optional[t.NodeSelectorTerm]:
-    if not pv.allowed_topology:
+def _topology_term(allowed_topology) -> Optional[t.NodeSelectorTerm]:
+    """Allowed-topology pairs (from a PV or a StorageClass) → one conjunction
+    term; pairs within one object AND together in this reduced model."""
+    if not allowed_topology:
         return None
-    # one term per topology pair would OR them; a PV's allowed topology is a
-    # single conjunction in this reduced model
     return t.NodeSelectorTerm(
         match_expressions=tuple(
             t.NodeSelectorRequirement(key=k, operator=t.OP_IN, values=(v,))
-            for k, v in pv.allowed_topology
+            for k, v in allowed_topology
         )
     )
+
+
+def _pv_topology_term(pv: t.PersistentVolume) -> Optional[t.NodeSelectorTerm]:
+    return _topology_term(pv.allowed_topology)
 
 
 def _unsatisfiable_term() -> t.NodeSelectorTerm:
@@ -54,17 +58,6 @@ def _unsatisfiable_term() -> t.NodeSelectorTerm:
             t.NodeSelectorRequirement(
                 key="volume.kubernetes.io/unsatisfiable", operator=t.OP_IN, values=("true",)
             ),
-        )
-    )
-
-
-def _class_topology_term(sc) -> Optional[t.NodeSelectorTerm]:
-    if not sc.allowed_topology:
-        return None
-    return t.NodeSelectorTerm(
-        match_expressions=tuple(
-            t.NodeSelectorRequirement(key=k, operator=t.OP_IN, values=(v,))
-            for k, v in sc.allowed_topology
         )
     )
 
@@ -121,7 +114,7 @@ def resolve_pod(
                 if term is not None
             ]
             if provisionable:
-                ct = _class_topology_term(sc)
+                ct = _topology_term(sc.allowed_topology)
                 if ct is not None:
                     options.append(ct)
             if options:

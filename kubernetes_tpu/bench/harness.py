@@ -94,6 +94,40 @@ def run_snapshot_workload(
     )
 
 
+def run_streaming_workload(
+    name: str,
+    waves: List[Snapshot],
+    warmup: bool = True,
+) -> Dict:
+    """Measure the host↔device pipeline (parallel/pipeline.py) against the
+    serial encode→run→block loop on a stream of independent snapshot waves —
+    the PP-analog overlap benchmark.  Returns both wall times and the
+    identical-verdict check."""
+    from ..parallel.pipeline import PipelinedRunner, run_serial
+
+    runner = PipelinedRunner()
+    if warmup:  # hit the XLA cache so the timed runs measure steady state
+        for _ in runner.run(waves[:1]):
+            pass
+    t0 = time.perf_counter()
+    serial = list(run_serial(waves))
+    t_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    pipelined = list(runner.run(waves))
+    t_pipe = time.perf_counter() - t0
+    assert pipelined == serial, "pipelined verdicts diverged from serial"
+    pods = sum(len(w.pending_pods) for w in waves)
+    return {
+        "name": name,
+        "waves": len(waves),
+        "n_pods": pods,
+        "serial_s": round(t_serial, 3),
+        "pipelined_s": round(t_pipe, 3),
+        "overlap_gain": round(t_serial / t_pipe, 3) if t_pipe > 0 else 0.0,
+        "pods_per_sec": round(pods / t_pipe, 1) if t_pipe > 0 else 0.0,
+    }
+
+
 GENERATORS = {
     "basic": lambda **kw: workloads.basic(kw["nodes"], kw["pods"], kw.get("seed", 0)),
     "spread_affinity": lambda **kw: workloads.spread_affinity(
@@ -193,7 +227,16 @@ def main(argv=None) -> None:
     ap.add_argument("--out", help="perfdata JSON output path")
     ap.add_argument("--mode", default="tpu", choices=["tpu", "native", "cpu"])
     ap.add_argument("--full", action="store_true", help="run BASELINE configs at full scale")
+    ap.add_argument("--stream", type=int, metavar="WAVES",
+                    help="run the host<->device pipelining benchmark instead")
     args = ap.parse_args(argv)
+    if args.stream:
+        waves = [
+            workloads.heterogeneous(2000, 5000, seed=s) for s in range(args.stream)
+        ]
+        out = run_streaming_workload(f"stream-{args.stream}x5000", waves)
+        print(json.dumps(out))
+        return
     if args.config:
         text = open(args.config).read()
     else:

@@ -1,0 +1,93 @@
+"""Host↔device pipelining — the pipeline-parallelism analog.
+
+SURVEY.md §2.4: the reference has no PP; its counterpart here is overlapping
+host work (snapshot encode + H2D transfer of batch k+1) with device compute
+(the filter/score/commit program still running on batch k), exactly how the
+reference's binding goroutine overlaps the next pod's scheduling cycle
+(schedule_one.go: bindingCycle runs async under the next schedulingCycle).
+
+JAX dispatch is asynchronous: `schedule_batch` returns device futures
+immediately, so the pipeline is expressed with ordinary control flow — encode
+batch k+1 while batch k's program runs, then block on k's (tiny) choices
+vector.  Two device programs are never enqueued back-to-back for the same
+buffer, so this is classic double-buffering with depth 1.
+
+Use `PipelinedRunner` for streams of INDEPENDENT snapshots (separate virtual
+clusters, sidecar request streams, replayed scheduler_perf waves).  When wave
+k+1's pending set depends on wave k's placements (the sequential-commit
+semantics across waves), the dependency forbids overlap — the scheduler's
+in-wave `lax.scan` already covers that case on-device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..api.snapshot import Snapshot, encode_snapshot
+from ..ops import DEFAULT_SCORE_CONFIG
+from ..ops.scores import ScoreConfig, infer_score_config
+
+
+def _decode(choices, meta) -> Dict[str, Optional[str]]:
+    ch = np.asarray(choices)  # blocks until the device program finishes
+    return {
+        meta.pod_names[k]: (
+            meta.node_names[int(ch[k])] if int(ch[k]) >= 0 else None
+        )
+        for k in range(meta.n_pods)
+    }
+
+
+class PipelinedRunner:
+    """Double-buffered snapshot stream executor.
+
+    >>> runner = PipelinedRunner()
+    >>> for verdicts in runner.run(snapshots):
+    ...     apply(verdicts)  # {pod_name: node_name | None}
+    """
+
+    def __init__(
+        self,
+        base_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
+        hard_pod_affinity_weight: float = 1.0,
+    ):
+        self.base_config = base_config
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+
+    def _dispatch(self, snap: Snapshot) -> Tuple[jax.Array, object]:
+        from ..ops import schedule_batch
+
+        arr, meta = encode_snapshot(
+            snap, hard_pod_affinity_weight=self.hard_pod_affinity_weight
+        )
+        cfg = infer_score_config(arr, self.base_config)
+        arr = jax.device_put(arr)  # async H2D
+        choices, _used = schedule_batch(arr, cfg)  # async dispatch
+        return choices, meta
+
+    def run(self, snapshots: Iterable[Snapshot]) -> Iterator[Dict[str, Optional[str]]]:
+        """Yields one verdict dict per snapshot, in order.  Encode/transfer of
+        snapshot k+1 overlaps the device program of snapshot k."""
+        prev: Optional[Tuple[jax.Array, object]] = None
+        for snap in snapshots:
+            nxt = self._dispatch(snap)  # host encodes while prev computes
+            if prev is not None:
+                yield _decode(*prev)
+            prev = nxt
+        if prev is not None:
+            yield _decode(*prev)
+
+
+def run_serial(
+    snapshots: Iterable[Snapshot],
+    base_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    hard_pod_affinity_weight: float = 1.0,
+) -> Iterator[Dict[str, Optional[str]]]:
+    """The unpipelined oracle for the same stream: encode -> run -> block,
+    one snapshot at a time (used by tests and the overlap benchmark)."""
+    runner = PipelinedRunner(base_config, hard_pod_affinity_weight)
+    for snap in snapshots:
+        yield _decode(*runner._dispatch(snap))

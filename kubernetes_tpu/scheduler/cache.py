@@ -76,6 +76,17 @@ class SchedulerCache:
 
     def update_snapshot(self) -> Snapshot:
         """Snapshot for the batch/TPU path: bound = running + assumed pods."""
+        # LIST the registry kinds BEFORE taking the cache lock: the store lock
+        # is held inside list_objects, and store->watcher->_on_event already
+        # acquires cache._lock under the store lock — taking them here in the
+        # opposite order would be an ABBA inversion
+        storage_classes = {
+            sc.name: sc for sc in self._store.list_objects("StorageClass")
+        }
+        resource_slices = self._store.list_objects("ResourceSlice")
+        device_classes = {
+            dc.name: dc for dc in self._store.list_objects("DeviceClass")
+        }
         with self._lock:
             nodes = list(self.nodes.values())
             pending, bound = [], []
@@ -95,13 +106,9 @@ class SchedulerCache:
                 pod_groups=dict(self.pod_groups),
                 pvs=list(self.pvs.values()),
                 pvcs=dict(self.pvcs),
-                storage_classes={
-                    sc.name: sc for sc in self._store.list_objects("StorageClass")
-                },
-                resource_slices=self._store.list_objects("ResourceSlice"),
-                device_classes={
-                    dc.name: dc for dc in self._store.list_objects("DeviceClass")
-                },
+                storage_classes=storage_classes,
+                resource_slices=resource_slices,
+                device_classes=device_classes,
             )
 
     def node_infos(self, snap: Snapshot) -> List[NodeInfo]:

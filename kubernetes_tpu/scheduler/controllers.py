@@ -18,6 +18,7 @@ per-key scheduling, which only matters for fairness at scale.
 
 from __future__ import annotations
 
+import copy as copy_module
 import hashlib
 import itertools
 from dataclasses import replace
@@ -219,6 +220,11 @@ class DeploymentController:
             self.sync(d)
 
 
+# marker applied to succeeded Job pods once their completion has been added
+# to status.succeeded — the finalizer-removal half of job tracking
+_COUNTED_MARK = "batch.kubernetes.io/completion-counted"
+
+
 class JobController:
     """job_controller.go — syncJob: keep min(parallelism, remaining) pods
     active until `completions` pods have succeeded; stamp completionTime when
@@ -243,11 +249,20 @@ class JobController:
             if p.namespace == job.namespace
             and any(r.uid == job.uid for r in p.owner_references)
         ]
-        # monotonic like the reference's status.succeeded: pods GC'd after
-        # finishing must not decrease the count (or rerun completed work)
-        succeeded = max(
-            job.succeeded, sum(1 for p in owned if p.phase == t.PHASE_SUCCEEDED)
-        )
+        # once-only completion accounting (the reference's finalizer-based
+        # job tracking): each succeeded pod increments status.succeeded
+        # exactly once and is then marked, so PodGC deleting it later can
+        # never lose (or double-count) a completion
+        fresh = [
+            p
+            for p in owned
+            if p.phase == t.PHASE_SUCCEEDED and _COUNTED_MARK not in p.labels
+        ]
+        for p in fresh:
+            q = copy_module.copy(p)
+            q.labels = {**p.labels, _COUNTED_MARK: "true"}
+            self.store.update_pod_status(q)
+        succeeded = job.succeeded + len(fresh)
         active = [p for p in owned if not _is_finished(p)]
         want_active = min(job.parallelism, max(0, job.completions - succeeded))
         owner = t.OwnerReference(kind="Job", name=job.name, uid=job.uid)

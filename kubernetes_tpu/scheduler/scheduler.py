@@ -238,11 +238,18 @@ class Scheduler:
             # mandated CPU fallback (per-pod plugin path)
             from ..runtime import SidecarUnavailable, TPUScoreClient
 
+            from ..api.volumes import resolve_snapshot
+
             try:
                 if self._sidecar is None:
                     self._sidecar = TPUScoreClient(prof.tpu_score.sidecar_address)
+                # resolve BEFORE transmit: volume/DRA constraints fold into
+                # plain requests + affinity, which the wire format carries —
+                # the sidecar needs no PV/PVC/StorageClass/slice schema
                 verdicts = self._sidecar.schedule(
-                    snap, deadline_ms=prof.tpu_score.deadline_ms, gang=gang
+                    resolve_snapshot(snap),
+                    deadline_ms=prof.tpu_score.deadline_ms,
+                    gang=gang,
                 )
             except SidecarUnavailable:
                 self.metrics.inc("tpuscore_fallback_total")

@@ -15,6 +15,7 @@ else pinned to the node's hostname (local-volume shape).
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import replace
 from typing import Dict, Optional
 
@@ -88,8 +89,11 @@ def bind_pod_volumes(store: ClusterStore, pod: t.Pod, node_name: str) -> Optiona
                     f"claim {pvc.key!r}: class {sc.name!r} cannot provision "
                     f"a volume reachable from {node_name}"
                 )
+            # the hash disambiguates ns/name pairs whose dash-joined forms
+            # collide (the reference names provisioned PVs by claim UID)
+            tag = hashlib.sha1(pvc.key.encode()).hexdigest()[:8]
             pv = t.PersistentVolume(
-                name=f"pvc-{pvc.namespace}-{pvc.name}",
+                name=f"pvc-{pvc.namespace}-{pvc.name}-{tag}",
                 capacity=pvc.request,
                 storage_class=pvc.storage_class,
                 allowed_topology=tuple(sc.allowed_topology) or _node_topology(node),

@@ -473,3 +473,31 @@ def test_hollow_kubelets_share_store_get_disjoint_cidrs():
     # same node -> same subnet regardless of which kubelet allocated
     assert ips["b"].rsplit(".", 1)[0] == ips["c"].rsplit(".", 1)[0]
     assert ips["a"].rsplit(".", 1)[0] != ips["b"].rsplit(".", 1)[0]
+
+
+def test_job_completions_survive_podgc_between_waves():
+    """Once-only accounting: completions counted into status must persist even
+    when PodGC deletes the succeeded pods between controller syncs."""
+    store = _store_with_nodes()
+    clock = FakeClock()
+    ctrl = JobController(store, clock=clock)
+    store.add_object("Job", t.Job(name="waves", completions=4, parallelism=2,
+                                  template=t.Pod(name="x", run_seconds=1.0)))
+    ctrl.tick()
+    for p in list(store.pods.values()):
+        p.phase = t.PHASE_SUCCEEDED
+    ctrl.tick()  # counts wave 1 (2 completions), spawns wave 2
+    assert store.get_object("Job", "default/waves").succeeded == 2
+    # PodGC wipes wave 1's succeeded pods before the next sync
+    for p in list(store.pods.values()):
+        if p.phase == t.PHASE_SUCCEEDED:
+            store.delete_pod(p.uid)
+    ctrl.tick()
+    assert store.get_object("Job", "default/waves").succeeded == 2  # not lost
+    for p in list(store.pods.values()):
+        p.phase = t.PHASE_SUCCEEDED
+    ctrl.tick()
+    job = store.get_object("Job", "default/waves")
+    assert job.succeeded == 4 and job.complete
+    ctrl.tick()
+    assert store.get_object("Job", "default/waves").succeeded == 4  # no double count

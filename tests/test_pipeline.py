@@ -1,0 +1,53 @@
+"""Host↔device double-buffered pipelining (the PP analog, SURVEY §2.4)."""
+
+import numpy as np
+
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.parallel.pipeline import PipelinedRunner, run_serial
+from helpers import mk_node, mk_pod
+
+
+def _wave(seed: int, n_nodes: int = 12, n_pods: int = 24) -> Snapshot:
+    rng = np.random.default_rng(seed)
+    nodes = [
+        mk_node(f"w{seed}-n{i}", cpu=int(rng.integers(2000, 8000)))
+        for i in range(n_nodes)
+    ]
+    pods = [
+        mk_pod(f"w{seed}-p{j}", cpu=int(rng.integers(100, 1500)))
+        for j in range(n_pods)
+    ]
+    return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+def test_pipelined_results_identical_to_serial():
+    waves = [_wave(s) for s in range(5)]
+    pipelined = list(PipelinedRunner().run(waves))
+    serial = list(run_serial(waves))
+    assert pipelined == serial
+    assert len(pipelined) == 5
+    # every wave actually placed pods
+    for verdicts in pipelined:
+        assert sum(1 for v in verdicts.values() if v) > 0
+
+
+def test_pipeline_handles_empty_and_single_streams():
+    assert list(PipelinedRunner().run([])) == []
+    [only] = list(PipelinedRunner().run([_wave(7)]))
+    assert dict(only) == list(run_serial([_wave(7)]))[0]
+
+
+def test_pipeline_preserves_wave_order():
+    waves = [_wave(s, n_pods=8) for s in range(4)]
+    out = list(PipelinedRunner().run(waves))
+    for s, verdicts in enumerate(out):
+        assert all(name.startswith(f"w{s}-") for name in verdicts)
+
+
+def test_streaming_workload_harness_reports_gain_fields():
+    from kubernetes_tpu.bench.harness import run_streaming_workload
+
+    waves = [_wave(s, n_nodes=6, n_pods=10) for s in range(3)]
+    out = run_streaming_workload("t", waves, warmup=False)
+    assert out["waves"] == 3 and out["n_pods"] == 30
+    assert out["pipelined_s"] > 0 and out["serial_s"] > 0

@@ -91,3 +91,35 @@ def test_scheduler_falls_back_to_cpu_when_sidecar_down():
     # still scheduled — through the CPU plugin path
     assert store.pods["default/p"].node_name == "n0"
     assert sched.metrics.counters["tpuscore_fallback_total"] == 1
+
+
+def test_sidecar_receives_resolved_volume_and_dra_constraints(server):
+    """The wire format has no PV/PVC/StorageClass/slice schema: the scheduler
+    resolves them into plain requests + affinity BEFORE transmitting, so
+    sidecar verdicts honor storage topology and device capacity."""
+    from kubernetes_tpu.api import cluster as c
+
+    prof = Profile(tpu_score=TPUScoreArgs(sidecar_address=f"127.0.0.1:{server.port}",
+                                          deadline_ms=60_000))
+    store = ClusterStore()
+    store.add_object("StorageClass", c.StorageClass(
+        name="zonal", provisioner="csi", volume_binding_mode="WaitForFirstConsumer",
+        allowed_topology=((t.LABEL_ZONE, "a"),)))
+    store.add_object("DeviceClass", c.DeviceClass(
+        name="tpu", selector=c.DeviceSelector(terms=(("type", "v5e"),))))
+    store.add_object("ResourceSlice", c.ResourceSlice(
+        name="s", node_name="n-a", driver="d",
+        devices=(c.DraDevice("d0", attributes=(("type", "v5e"),)),)))
+    for name, zone in (("n-b", "b"), ("n-a", "a")):
+        store.add_node(mk_node(name, labels={t.LABEL_ZONE: zone}))
+    store.add_pvc(t.PersistentVolumeClaim(name="data", request=1, storage_class="zonal",
+                                          wait_for_first_consumer=True))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu", profiles=(prof,)))
+    store.add_pod(mk_pod("vol-pod", pvcs=("data",)))
+    store.add_pod(t.Pod(name="dra-pod", requests={t.CPU: 100},
+                        resource_claims=(t.ResourceClaimRef("tpu", 1),)))
+    sched.run_until_idle()
+    # storage class only provisions in zone a; devices only exist on n-a
+    assert store.pods["default/vol-pod"].node_name == "n-a"
+    assert store.pods["default/dra-pod"].node_name == "n-a"
+    assert store.pvcs["default/data"].volume_name  # PreBind bound it locally

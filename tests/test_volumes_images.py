@@ -254,7 +254,7 @@ def test_scheduler_binds_and_provisions_wffc_claim():
     assert writer.node_name in ("n-a", "n-b")
     zone = store.nodes[writer.node_name].labels[t.LABEL_ZONE]
     pvc = store.pvcs["default/data"]
-    assert pvc.volume_name == "pvc-default-data"
+    assert pvc.volume_name.startswith("pvc-default-data-")
     pv = store.pvs[pvc.volume_name]
     assert pv.claim_ref == "default/data"
     assert pv.allowed_topology == ((t.LABEL_ZONE, zone),)
@@ -286,7 +286,7 @@ def test_batch_mode_binds_volumes_and_keeps_pvc_constraints():
     sched.run_until_idle()
     writer = store.pods["default/writer"]
     assert writer.node_name == "n-a"  # the class only provisions in zone a
-    assert store.pvcs["default/data"].volume_name == "pvc-default-data"
+    assert store.pvcs["default/data"].volume_name.startswith("pvc-default-data-")
 
 
 def test_prebind_rejects_node_outside_provisioning_topology():
