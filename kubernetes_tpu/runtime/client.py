@@ -44,12 +44,19 @@ class TPUScoreClient:
             raise SidecarUnavailable(str(e.code())) from e
 
     def schedule(
-        self, snap: Snapshot, deadline_ms: float = 1000.0, gang: bool = True
+        self,
+        snap: Snapshot,
+        deadline_ms: float = 1000.0,
+        gang: bool = True,
+        hard_pod_affinity_weight: float = 1.0,
     ) -> Dict[str, Optional[str]]:
         """-> pod uid -> node name (None = unschedulable).  Raises
         SidecarUnavailable on deadline/transport failure (caller falls back)."""
         req = pb.ScheduleRequest(
-            snapshot=snapshot_to_proto(snap), deadline_ms=deadline_ms, gang=gang
+            snapshot=snapshot_to_proto(snap),
+            deadline_ms=deadline_ms,
+            gang=gang,
+            hard_pod_affinity_weight=hard_pod_affinity_weight,
         )
         try:
             resp = self._schedule(req, timeout=deadline_ms / 1e3)

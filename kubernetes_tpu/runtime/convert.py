@@ -75,6 +75,7 @@ def pod_to_proto(p: t.Pod) -> pb.Pod:
             )
             for c in p.topology_spread
         ],
+        images=list(p.images),
     )
     if p.affinity:
         msg.required_node_terms.extend(_nst(x) for x in p.affinity.required_node_terms)
@@ -86,6 +87,14 @@ def pod_to_proto(p: t.Pod) -> pb.Pod:
         msg.required_pod_anti_affinity.extend(
             _pat(x) for x in p.affinity.required_pod_anti_affinity
         )
+        msg.preferred_pod_affinity.extend(
+            pb.WeightedPodAffinityTerm(weight=x.weight, term=_pat(x.term))
+            for x in p.affinity.preferred_pod_affinity
+        )
+        msg.preferred_pod_anti_affinity.extend(
+            pb.WeightedPodAffinityTerm(weight=x.weight, term=_pat(x.term))
+            for x in p.affinity.preferred_pod_anti_affinity
+        )
     return msg
 
 
@@ -96,6 +105,7 @@ def node_to_proto(n: t.Node) -> pb.Node:
         labels=_labels(n.labels),
         taints=[pb.Taint(key=x.key, value=x.value, effect=x.effect) for x in n.taints],
         unschedulable=n.unschedulable,
+        images=[pb.ImageEntry(name=k, size_bytes=v) for k, v in n.images.items()],
     )
 
 
@@ -148,6 +158,8 @@ def pod_from_proto(msg: pb.Pod) -> t.Pod:
         or msg.preferred_node_terms
         or msg.required_pod_affinity
         or msg.required_pod_anti_affinity
+        or msg.preferred_pod_affinity
+        or msg.preferred_pod_anti_affinity
     ):
         affinity = t.Affinity(
             required_node_terms=tuple(_from_nst(x) for x in msg.required_node_terms),
@@ -158,6 +170,14 @@ def pod_from_proto(msg: pb.Pod) -> t.Pod:
             required_pod_affinity=tuple(_from_pat(x) for x in msg.required_pod_affinity),
             required_pod_anti_affinity=tuple(
                 _from_pat(x) for x in msg.required_pod_anti_affinity
+            ),
+            preferred_pod_affinity=tuple(
+                t.WeightedPodAffinityTerm(weight=x.weight, term=_from_pat(x.term))
+                for x in msg.preferred_pod_affinity
+            ),
+            preferred_pod_anti_affinity=tuple(
+                t.WeightedPodAffinityTerm(weight=x.weight, term=_from_pat(x.term))
+                for x in msg.preferred_pod_anti_affinity
             ),
         )
     return t.Pod(
@@ -186,6 +206,7 @@ def pod_from_proto(msg: pb.Pod) -> t.Pod:
         host_ports=tuple((h.protocol, h.port) for h in msg.host_ports),
         scheduling_gates=tuple(msg.scheduling_gates),
         pod_group=msg.pod_group,
+        images=tuple(msg.images),
     )
 
 
@@ -198,6 +219,7 @@ def node_from_proto(msg: pb.Node) -> t.Node:
             t.Taint(key=x.key, value=x.value, effect=x.effect) for x in msg.taints
         ),
         unschedulable=msg.unschedulable,
+        images={e.name: int(e.size_bytes) for e in msg.images},
     )
 
 

@@ -17,6 +17,7 @@ image has grpcio but not grpc_tools' codegen plugin).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from concurrent import futures
@@ -37,15 +38,22 @@ class _Engine:
     def __init__(self):
         self._lock = threading.Lock()
 
-    def schedule(self, snap, gang: bool):
+    def schedule(self, snap, gang: bool, hard_pod_affinity_weight: float = 1.0):
         from ..api.snapshot import encode_snapshot
         from ..ops import schedule_batch
         from ..ops.gang import schedule_with_gangs
         from ..ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
 
         with self._lock:  # single writer on the device
-            arr, meta = encode_snapshot(snap)
-            cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+            # the weight applies in BOTH stages: pre-bound pods at encode
+            # time, batch-committed pods through the kernel config
+            arr, meta = encode_snapshot(
+                snap, hard_pod_affinity_weight=hard_pod_affinity_weight
+            )
+            base = dataclasses.replace(
+                DEFAULT_SCORE_CONFIG, hard_pod_affinity_weight=hard_pod_affinity_weight
+            )
+            cfg = infer_score_config(arr, base)
             if gang:
                 choices, _ = schedule_with_gangs(arr, cfg)
             else:
@@ -79,7 +87,12 @@ class TPUScoreServer:
         t0 = time.perf_counter()
         snap = snapshot_from_proto(request.snapshot)
         uid_of = {p.name: p.uid for p in snap.pending_pods}
-        choices, meta = self.engine.schedule(snap, request.gang)
+        hpaw = (
+            request.hard_pod_affinity_weight
+            if request.HasField("hard_pod_affinity_weight")
+            else 1.0
+        )
+        choices, meta = self.engine.schedule(snap, request.gang, hpaw)
         resp = pb.ScheduleResponse()
         for k in range(meta.n_pods):
             c = int(choices[k])

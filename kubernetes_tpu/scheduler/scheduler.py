@@ -250,6 +250,7 @@ class Scheduler:
                     resolve_snapshot(snap),
                     deadline_ms=prof.tpu_score.deadline_ms,
                     gang=gang,
+                    hard_pod_affinity_weight=prof.hard_pod_affinity_weight,
                 )
             except SidecarUnavailable:
                 self.metrics.inc("tpuscore_fallback_total")

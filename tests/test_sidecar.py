@@ -123,3 +123,65 @@ def test_sidecar_receives_resolved_volume_and_dra_constraints(server):
     assert store.pods["default/vol-pod"].node_name == "n-a"
     assert store.pods["default/dra-pod"].node_name == "n-a"
     assert store.pvcs["default/data"].volume_name  # PreBind bound it locally
+
+
+def test_wire_carries_preferred_affinity_and_images(server):
+    """Preferred (soft) inter-pod affinity and node image caches now survive
+    the proto roundtrip, so sidecar verdicts score them identically (D10)."""
+    img = "registry.io/model:v1"
+    warm = mk_node("warm", labels={t.LABEL_ZONE: "z1"})
+    warm.images[img] = 900 * 1024 * 1024
+    anchor = mk_pod("anchor", labels={"app": "db"})
+    anchor.node_name = "warm"
+    follower = mk_pod("follower", images=(img,))
+    follower.affinity = t.Affinity(
+        preferred_pod_affinity=(
+            t.WeightedPodAffinityTerm(
+                weight=100,
+                term=t.PodAffinityTerm(
+                    topology_key=t.LABEL_ZONE,
+                    label_selector=t.LabelSelector.of(app="db"),
+                ),
+            ),
+        )
+    )
+    snap = Snapshot(
+        nodes=[mk_node("cold"), warm],
+        pending_pods=[follower],
+        bound_pods=[anchor],
+    )
+    back = snapshot_from_proto(snapshot_to_proto(snap))
+    assert back.pending_pods[0].affinity.preferred_pod_affinity[0].weight == 100
+    assert back.nodes[1].images == warm.images
+    assert oracle_schedule(back) == oracle_schedule(snap)
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    verdicts = client.schedule(snap, deadline_ms=60_000)
+    want = {f"default/{n}": node for n, node in oracle_schedule(snap)}
+    assert verdicts == want and verdicts["default/follower"] == "warm"
+    client.close()
+
+
+def test_wire_preserves_zero_hard_pod_affinity_weight(server):
+    """weight=0 (disable hard-affinity scoring) must survive proto3 —
+    presence-tracked, not coerced to the server default of 1.0."""
+    anchor = mk_pod("anchor", labels={"app": "db"})
+    anchor.affinity = t.Affinity(
+        required_pod_affinity=(
+            t.PodAffinityTerm(topology_key=t.LABEL_ZONE,
+                              label_selector=t.LabelSelector.of(app="db")),
+        )
+    )
+    anchor.node_name = "n-z2"
+    # follower matches the anchor's REQUIRED term -> scores toward n-z2 at
+    # hardPodAffinityWeight; with weight 0 the pull disappears and the
+    # lowest-index tie-break wins
+    follower = mk_pod("follower", labels={"app": "db"})
+    nodes = [mk_node("n-z1", labels={t.LABEL_ZONE: "z1"}),
+             mk_node("n-z2", labels={t.LABEL_ZONE: "z2"})]
+    snap = Snapshot(nodes=nodes, pending_pods=[follower], bound_pods=[anchor])
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    pulled = client.schedule(snap, deadline_ms=60_000, hard_pod_affinity_weight=10.0)
+    flat = client.schedule(snap, deadline_ms=60_000, hard_pod_affinity_weight=0.0)
+    assert pulled["default/follower"] == "n-z2"
+    assert flat["default/follower"] == "n-z1"
+    client.close()
