@@ -48,12 +48,22 @@ class HollowKubelet:
         node_name: str,
         clock: Optional[Clock] = None,
         pod_cidr_index: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
     ):
+        from .checkpoint import CheckpointManager
+        from .devicemanager import DeviceManager
+
         self.store = store
         self.leases = leases
         self.node_name = node_name
         self.clock = clock or leases.clock
         self._started_at: Dict[str, float] = {}  # pod uid -> Running since
+        # cm/devicemanager analog: concrete device IDs per admitted pod,
+        # checkpointed when a directory is given (restart-safe allocations)
+        self.devices = DeviceManager(
+            node_name,
+            CheckpointManager(checkpoint_dir) if checkpoint_dir else None,
+        )
         # pod CIDR: a disjoint per-node subnet index (nodeipam's per-node /24)
         self._cidr_index = (
             pod_cidr_index
@@ -66,14 +76,25 @@ class HollowKubelet:
         self.leases.renew_node_heartbeat(self.node_name)
         now = self.clock.now()
         mine = set()
+        inventory = None  # (slices, classes), fetched at most once per tick
         for pod in list(self.store.pods.values()):
             if pod.node_name != self.node_name:
                 continue
             mine.add(pod.uid)
             if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
                 self._started_at.pop(pod.uid, None)
+                self.devices.free(pod.uid)  # terminated pods release devices
                 continue
             if pod.phase in ("", t.PHASE_PENDING):
+                if pod.resource_claims:
+                    if inventory is None:  # fetched once per tick, lazily
+                        inventory = (
+                            self.store.list_objects("ResourceSlice"),
+                            {dc.name: dc
+                             for dc in self.store.list_objects("DeviceClass")},
+                        )
+                    if not self._admit_devices(pod, *inventory):
+                        continue  # admission failed: pod marked Failed
                 # sandbox+containers "started": Pending -> Running
                 self._set_phase(pod, t.PHASE_RUNNING)
                 self._started_at[pod.uid] = now
@@ -86,6 +107,21 @@ class HollowKubelet:
         for uid in list(self._started_at):
             if uid not in mine:
                 del self._started_at[uid]
+        for uid in list(self.devices.allocations):
+            if uid not in mine:
+                self.devices.free(uid)
+
+    def _admit_devices(self, pod: t.Pod, slices, classes) -> bool:
+        """devicemanager Allocate at admission; failure fails the pod (the
+        reference's UnexpectedAdmissionError path)."""
+        from .devicemanager import AllocationError
+
+        try:
+            self.devices.allocate(pod, slices, classes)
+            return True
+        except AllocationError:
+            self._set_phase(pod, t.PHASE_FAILED)
+            return False
 
     def _set_phase(self, pod: t.Pod, phase: str) -> None:
         import copy

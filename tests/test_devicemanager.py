@@ -1,0 +1,126 @@
+"""Device manager + topology manager analog: concrete allocation, NUMA
+alignment, checkpoint/restore, admission failure.
+
+reference: pkg/kubelet/cm/devicemanager (ManagerImpl.Allocate + checkpoint)
+and cm/topologymanager (single-numa-node preference).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import cluster as c
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler.checkpoint import CheckpointManager
+from kubernetes_tpu.scheduler.devicemanager import AllocationError, DeviceManager
+from kubernetes_tpu.scheduler.kubelet import HollowKubelet
+from kubernetes_tpu.scheduler.leases import LeaseStore
+from kubernetes_tpu.scheduler.queue import FakeClock
+from kubernetes_tpu.scheduler.store import ClusterStore
+
+
+def _inventory():
+    """n0: 4 tpus split over 2 NUMA nodes + one unrelated device."""
+    devs = tuple(
+        c.DraDevice(f"tpu{i}", attributes=(("type", "v5e"), ("numa", str(i // 2))))
+        for i in range(4)
+    ) + (c.DraDevice("nic0", attributes=(("type", "nic"),)),)
+    slices = [c.ResourceSlice(name="n0-s", node_name="n0", driver="tpu.dev", devices=devs)]
+    classes = {"tpu": c.DeviceClass(name="tpu",
+                                    selector=c.DeviceSelector(terms=(("type", "v5e"),)))}
+    return slices, classes
+
+
+def _claim_pod(name, count):
+    return t.Pod(name=name, resource_claims=(t.ResourceClaimRef("tpu", count),))
+
+
+def test_allocate_prefers_single_numa_node():
+    slices, classes = _inventory()
+    dm = DeviceManager("n0")
+    got = dm.allocate(_claim_pod("p", 2), slices, classes)
+    assert got == {"tpu": ["tpu.dev/tpu0", "tpu.dev/tpu1"]}  # both numa 0
+    assert dm.numa_aligned("default/p", slices)
+
+
+def test_allocate_spans_numa_when_no_single_node_fits():
+    slices, classes = _inventory()
+    dm = DeviceManager("n0")
+    got = dm.allocate(_claim_pod("big", 3), slices, classes)
+    assert len(got["tpu"]) == 3
+    assert not dm.numa_aligned("default/big", slices)
+
+
+def test_devices_are_exclusive_and_freed():
+    slices, classes = _inventory()
+    dm = DeviceManager("n0")
+    a = dm.allocate(_claim_pod("a", 2), slices, classes)["tpu"]
+    b = dm.allocate(_claim_pod("b", 2), slices, classes)["tpu"]
+    assert not set(a) & set(b)
+    with pytest.raises(AllocationError):
+        dm.allocate(_claim_pod("c", 1), slices, classes)
+    dm.free("default/a")
+    assert dm.allocate(_claim_pod("c", 1), slices, classes)["tpu"][0] in a
+
+
+def test_allocation_idempotent_per_pod():
+    slices, classes = _inventory()
+    dm = DeviceManager("n0")
+    first = dm.allocate(_claim_pod("p", 2), slices, classes)
+    again = dm.allocate(_claim_pod("p", 2), slices, classes)
+    assert first == again
+    assert len(dm._in_use()) == 2
+
+
+def test_checkpoint_survives_restart(tmp_path):
+    slices, classes = _inventory()
+    cm = CheckpointManager(str(tmp_path))
+    dm = DeviceManager("n0", cm)
+    got = dm.allocate(_claim_pod("p", 2), slices, classes)
+    # "restarted kubelet": fresh manager over the same checkpoint dir
+    dm2 = DeviceManager("n0", CheckpointManager(str(tmp_path)))
+    assert dm2.allocations["default/p"] == got
+    # the restored allocation still blocks double-hand-out
+    b = dm2.allocate(_claim_pod("q", 2), slices, classes)["tpu"]
+    assert not set(b) & set(got["tpu"])
+
+
+def test_kubelet_admits_allocates_and_fails_oversized(tmp_path):
+    slices, classes = _inventory()
+    store = ClusterStore()
+    store.add_node(t.Node(name="n0", allocatable={t.CPU: 8000}))
+    for sl in slices:
+        store.add_object("ResourceSlice", sl)
+    for dc in classes.values():
+        store.add_object("DeviceClass", dc)
+    leases = LeaseStore(FakeClock())
+    kubelet = HollowKubelet(store, leases, "n0", checkpoint_dir=str(tmp_path))
+
+    ok = _claim_pod("fits", 2)
+    ok.node_name = "n0"
+    toobig = _claim_pod("toobig", 9)
+    toobig.node_name = "n0"
+    store.add_pod(ok)
+    store.add_pod(toobig)
+    kubelet.tick()
+    assert store.pods["default/fits"].phase == t.PHASE_RUNNING
+    assert kubelet.devices.allocations["default/fits"]["tpu"]
+    # oversized claim -> UnexpectedAdmissionError path: pod Failed
+    assert store.pods["default/toobig"].phase == t.PHASE_FAILED
+    # deletion frees the devices on the next housekeeping pass
+    store.delete_pod("default/fits")
+    kubelet.tick()
+    assert "default/fits" not in kubelet.devices.allocations
+
+
+def test_duplicate_class_claims_accumulate():
+    """Two claims for the same class on one pod commit ALL their devices
+    (regression: the second claim used to overwrite the first's record)."""
+    slices, classes = _inventory()
+    dm = DeviceManager("n0")
+    pod = t.Pod(name="dup", resource_claims=(
+        t.ResourceClaimRef("tpu", 2), t.ResourceClaimRef("tpu", 2)))
+    got = dm.allocate(pod, slices, classes)
+    assert len(got["tpu"]) == 4 and len(set(got["tpu"])) == 4
+    with pytest.raises(AllocationError):
+        dm.allocate(_claim_pod("other", 1), slices, classes)
+    dm.free(pod.uid)
+    assert dm._in_use() == set()
