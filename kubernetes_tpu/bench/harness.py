@@ -59,6 +59,17 @@ def run_snapshot_workload(
     likewise measures a long-lived scheduler, not binary start-up."""
     if warmup and mode == "tpu":
         run_snapshot_workload(name, snap, mode, warmup=False)
+    sched = _setup_cluster(snap, mode)
+
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    wall = time.perf_counter() - t0
+    return _perfdata(name, snap, sched, len(snap.pending_pods), wall)
+
+
+def _setup_cluster(snap: Snapshot, mode: str):
+    """Store + scheduler seeded from a snapshot (pod groups and pre-bound
+    pods included) — shared by the measure and churn ops."""
     store = ClusterStore()
     for nd in snap.nodes:
         store.add_node(nd)
@@ -69,11 +80,10 @@ def run_snapshot_workload(
         store.add_pod(p)
     for p in snap.bound_pods:
         store.add_pod(p)
+    return sched
 
-    t0 = time.perf_counter()
-    sched.run_until_idle()
-    wall = time.perf_counter() - t0
 
+def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> PerfData:
     scheduled = len(sched.events.by_reason("Scheduled"))
     failed = len(sched.events.by_reason("FailedScheduling"))
     hist = sched.metrics.hists.get("batch_scheduling_duration_seconds") or sched.metrics.hists.get(
@@ -83,7 +93,7 @@ def run_snapshot_workload(
     return PerfData(
         name=name,
         n_nodes=len(snap.nodes),
-        n_pods=len(snap.pending_pods),
+        n_pods=n_pods,
         scheduled=scheduled,
         unschedulable=failed,
         wall_s=round(wall, 3),
@@ -142,6 +152,48 @@ GENERATORS = {
 }
 
 
+def run_churn_workload(
+    name: str,
+    snap: Snapshot,
+    rounds: int = 5,
+    churn_fraction: float = 0.2,
+    mode: str = "tpu",
+    seed: int = 0,
+    warmup: bool = True,
+) -> PerfData:
+    """scheduler_perf's churn workloads: after the initial wave binds, each
+    round deletes a fraction of the bound pods and re-creates equivalents —
+    measuring steady-state throughput under arrival/departure pressure, not
+    just the cold bulk placement."""
+    import copy
+    import random
+
+    if warmup and mode == "tpu":  # same steady-state rule as the measure op
+        run_snapshot_workload(name, snap, mode, warmup=False)
+    rng = random.Random(seed)
+    sched = _setup_cluster(snap, mode)
+    store = sched.store
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    for r in range(rounds):
+        bound = [p for p in store.pods.values() if p.node_name]
+        if not bound:
+            break  # nothing scheduled: nothing to churn
+        k = min(len(bound), max(1, int(len(bound) * churn_fraction)))
+        for v in rng.sample(bound, k):
+            store.delete_pod(v.uid)
+            q = copy.copy(v)
+            q.name = f"{v.name}-r{r}"
+            q.uid = ""
+            q.node_name = ""
+            q.__post_init__()
+            store.add_pod(q)
+        sched.run_until_idle()
+    wall = time.perf_counter() - t0
+    scheduled = len(sched.events.by_reason("Scheduled"))
+    return _perfdata(name, snap, sched, scheduled, wall)
+
+
 def run_yaml(text: str, mode: str = "tpu") -> List[PerfData]:
     import yaml
 
@@ -160,6 +212,18 @@ def run_yaml(text: str, mode: str = "tpu") -> List[PerfData]:
                 results.append(
                     run_snapshot_workload(
                         doc.get("name", "unnamed"), snap, mode, warmup=op.get("warmup", True)
+                    )
+                )
+            elif kind == "churn":
+                assert snap is not None, "createCluster must precede churn"
+                results.append(
+                    run_churn_workload(
+                        doc.get("name", "unnamed") + "_churn",
+                        snap,
+                        rounds=op.get("rounds", 5),
+                        churn_fraction=op.get("fraction", 0.2),
+                        mode=mode,
+                        seed=op.get("seed", 0),
                     )
                 )
     return results

@@ -90,8 +90,16 @@ class DeviceManager:
         """Admit `pod`: pick concrete devices for each of its claims.
         Idempotent per pod (restart-safe).  Raises AllocationError when the
         inventory cannot satisfy a claim."""
-        if pod.uid in self.allocations:
-            return self.allocations[pod.uid]
+        wanted: Dict[str, int] = {}
+        for claim in pod.resource_claims:
+            wanted[claim.device_class] = wanted.get(claim.device_class, 0) + claim.count
+        cached = self.allocations.get(pod.uid)
+        if cached is not None:
+            if {cls: len(ids) for cls, ids in cached.items()} == wanted:
+                return cached
+            # same uid, different claims: a recreated pod reusing the name —
+            # the old allocation is stale, release it and allocate afresh
+            self.free(pod.uid)
         if not pod.resource_claims:
             return {}
         picked: Dict[str, List[str]] = {}

@@ -124,3 +124,15 @@ def test_duplicate_class_claims_accumulate():
         dm.allocate(_claim_pod("other", 1), slices, classes)
     dm.free(pod.uid)
     assert dm._in_use() == set()
+
+
+def test_recreated_pod_with_different_claims_reallocates():
+    """A pod recreated under the same name (= same uid) but with different
+    claims must not inherit the predecessor's stale allocation."""
+    slices, classes = _inventory()
+    dm = DeviceManager("n0")
+    dm.allocate(_claim_pod("p", 1), slices, classes)
+    bigger = _claim_pod("p", 3)  # same uid default/p, larger claim
+    got = dm.allocate(bigger, slices, classes)
+    assert len(got["tpu"]) == 3
+    assert len(dm._in_use()) == 3  # the stale 1-device record was released

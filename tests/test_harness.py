@@ -35,3 +35,14 @@ ops:
     cpu = run_yaml(text, "cpu")[0]
     assert tpu.scheduled == cpu.scheduled
     assert tpu.unschedulable == cpu.unschedulable
+
+
+def test_churn_workload_keeps_scheduling_replacements():
+    from kubernetes_tpu.bench.harness import run_churn_workload
+    from kubernetes_tpu.bench.workloads import basic
+
+    snap = basic(16, 32, seed=3)
+    out = run_churn_workload("t", snap, rounds=3, churn_fraction=0.25, mode="cpu")
+    # initial 32 + 3 rounds of replacements all found homes
+    assert out.scheduled > 32 and out.unschedulable == 0
+    assert out.pods_per_sec > 0

@@ -1,0 +1,121 @@
+"""Threaded full-stack soak: scheduler + controllers + kubelets + proxier
+hammering one ClusterStore concurrently.
+
+SURVEY.md §5 race posture: the reference relies on `go test -race`; Python
+has no race detector, so the locking story (store RLock + single-writer
+components + watch fan-out under the lock) is proven by running every
+component in its own thread against a shared store and checking the system
+still converges to a consistent state — the disruptive-suite analog.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import cluster as c
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+from kubernetes_tpu.scheduler.controllers import ControllerManager
+from kubernetes_tpu.scheduler.kubelet import HollowCluster
+from kubernetes_tpu.scheduler.leases import LeaseStore
+from kubernetes_tpu.scheduler.network import Proxier
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.store import ClusterStore
+
+N_NODES = 6
+N_DEPLOYMENTS = 4
+SOAK_SECONDS = 3.0
+
+
+def _loop(stop, errors, fn, pause=0.002):
+    while not stop.is_set():
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — the assertion surface
+            errors.append(e)
+            return
+        time.sleep(pause)
+
+
+def test_full_stack_soak_converges():
+    store = ClusterStore()
+    for i in range(N_NODES):
+        store.add_node(t.Node(name=f"n{i}", allocatable={t.CPU: 64_000, t.PODS: 200}))
+    for d in range(N_DEPLOYMENTS):
+        store.add_object(
+            "Deployment",
+            t.Deployment(
+                name=f"app{d}",
+                replicas=5,
+                selector=t.LabelSelector.of(app=f"app{d}"),
+                template=t.Pod(
+                    name=f"app{d}",
+                    requests={t.CPU: 100},
+                    labels={"app": f"app{d}"},
+                ),
+            ),
+        )
+        store.add_object(
+            "Service",
+            c.Service(name=f"svc{d}", selector=(("app", f"app{d}"),),
+                      ports=(c.ServicePort(80),), cluster_ip=f"10.96.0.{d + 1}"),
+        )
+
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"))
+    leases = LeaseStore()
+    cm = ControllerManager(store)
+    fleet = HollowCluster(store, leases)
+    proxy = Proxier(store)
+    rng = random.Random(7)
+
+    def chaos():
+        # delete a random running pod; its controller must replace it
+        pods = [p for p in store.pods.values() if p.node_name]
+        if pods:
+            store.delete_pod(rng.choice(pods).uid)
+
+    stop = threading.Event()
+    errors: list = []
+    threads = [
+        threading.Thread(target=_loop, args=(stop, errors, lambda: sched.run_until_idle(20))),
+        threading.Thread(target=_loop, args=(stop, errors, cm.tick)),
+        threading.Thread(target=_loop, args=(stop, errors, fleet.tick)),
+        threading.Thread(target=_loop, args=(stop, errors, proxy.sync)),
+        threading.Thread(target=_loop, args=(stop, errors, chaos, 0.05)),
+    ]
+    for th in threads:
+        th.start()
+    time.sleep(SOAK_SECONDS)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive(), "component thread wedged"
+    assert errors == [], f"component crashed under concurrency: {errors!r}"
+
+    # quiesce: a few synchronous rounds must converge the survivors
+    for _ in range(30):
+        cm.tick()
+        sched.run_until_idle(50)
+        fleet.tick()
+    proxy.sync()
+
+    for d in range(N_DEPLOYMENTS):
+        running = [
+            p for p in store.pods.values()
+            if p.labels.get("app") == f"app{d}" and p.node_name
+            and p.phase == t.PHASE_RUNNING
+        ]
+        assert len(running) == 5, (
+            f"app{d}: {len(running)} running of 5 after quiesce"
+        )
+    # pod IPs unique across the cluster (the nodeipam invariant)
+    ips = [p.pod_ip for p in store.pods.values() if p.pod_ip]
+    assert len(ips) == len(set(ips)), "duplicate pod IPs"
+    # every service routes to its running backends
+    for d in range(N_DEPLOYMENTS):
+        backends = {
+            proxy.lookup(f"client-{i}", f"10.96.0.{d + 1}", 80) for i in range(40)
+        }
+        assert backends and None not in backends
