@@ -284,6 +284,36 @@ class HorizontalPodAutoscaler:
         return f"{self.namespace}/{self.name}"
 
 
+# ------------------------------------------------------------------ Events
+
+
+@dataclass
+class ClusterEvent:
+    """core/v1 — type Event (kind "Event"), reduced to the scheduling event
+    surface with the reference's count-based aggregation: repeated identical
+    events bump `count`/`last_seen` instead of creating new objects
+    (client-go tools/record — EventAggregator)."""
+
+    name: str
+    namespace: str = "default"
+    reason: str = ""  # Scheduled | FailedScheduling | Preempted | ...
+    involved_object: str = ""  # "Pod/<ns>/<name>"
+    node: str = ""
+    message: str = ""
+    count: int = 1
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+    uid: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"ev/{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
 # ------------------------------------------------------------------ RBAC
 
 

@@ -58,6 +58,7 @@ KINDS: Dict[str, type] = {
     "StorageClass": c.StorageClass,
     "ResourceSlice": c.ResourceSlice,
     "DeviceClass": c.DeviceClass,
+    "Event": c.ClusterEvent,
 }
 # aliases accepted on decode (the store's table name for PodDisruptionBudget)
 _KIND_ALIASES = {"PDB": "PodDisruptionBudget"}

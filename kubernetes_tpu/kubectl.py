@@ -70,6 +70,7 @@ _register_words("PVC", "persistentvolumeclaim", "persistentvolumeclaims", "pvc")
 _register_words("StorageClass", "storageclass", "storageclasses", "sc")
 _register_words("ResourceSlice", "resourceslice", "resourceslices")
 _register_words("DeviceClass", "deviceclass", "deviceclasses")
+_register_words("Event", "event", "events", "ev")
 _register_words("FlowSchema", "flowschema", "flowschemas")
 _register_words("PriorityLevelConfiguration", "prioritylevelconfiguration",
                 "prioritylevelconfigurations")
@@ -301,6 +302,12 @@ class Kubectl:
                 ["NAME", "STATUS", "VOLUME", "STORAGECLASS"],
                 [[v.name, "Bound" if v.volume_name else "Pending",
                   v.volume_name or "<none>", v.storage_class or "<none>"] for v in objs])
+        if kind == "Event":
+            return _fmt_table(
+                ["LAST SEEN", "COUNT", "REASON", "OBJECT", "NODE", "MESSAGE"],
+                [[f"{e.last_seen:.0f}", e.count, e.reason, e.involved_object,
+                  e.node or "", e.message]
+                 for e in sorted(objs, key=lambda e: e.last_seen)])
         # generic fallback: NAME (+NAMESPACE)
         if kind in _CLUSTER_SCOPED:
             return _fmt_table(["NAME"], [[o.name] for o in objs])
@@ -619,6 +626,14 @@ class Kubectl:
 
     # ---------------------------------------------------------------- events
     def _cmd_events(self, pos, flags):
+        # Event API objects first (what the scheduler's recorder publishes);
+        # a raw recorder is the fallback for recorder-only wiring
+        ns = self._ns(flags)
+        objs = list(self._handle("list", "Event", namespace=ns or ""))
+        if ns is not None:
+            objs = [e for e in objs if e.namespace == ns]
+        if objs:
+            return self._table("Event", objs)
         if self.recorder is None:
             return "No events.\n"
         rows = [[e.reason, e.pod, e.node or "", e.message]

@@ -67,9 +67,11 @@ def ring_match(sel_mask: jax.Array, sel_kind: jax.Array, labels: jax.Array, mesh
             lab_blk = lax.ppermute(lab_blk, PODS_AXIS, perm)
             return (lab_blk, out)
 
-        out0 = lax.pvary(
-            jnp.zeros((sel_m.shape[0], P_total), dtype=jnp.bool_), (PODS_AXIS,)
-        )
+        zeros = jnp.zeros((sel_m.shape[0], P_total), dtype=jnp.bool_)
+        if hasattr(lax, "pcast"):
+            out0 = lax.pcast(zeros, (PODS_AXIS,), to="varying")
+        else:  # older jax
+            out0 = lax.pvary(zeros, (PODS_AXIS,))
         _, out = lax.fori_loop(0, d, body, (lab, out0))
         return out
 

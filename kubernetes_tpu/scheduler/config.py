@@ -14,6 +14,7 @@ from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
 from ..ops.scores import ScoreConfig
+from .extender import ExtenderConfig
 
 
 @dataclass(frozen=True)
@@ -50,6 +51,10 @@ class Profile:
 @dataclass(frozen=True)
 class SchedulerConfiguration:
     profiles: Tuple[Profile, ...] = (Profile(),)
+    # HTTP extenders (apis/config — KubeSchedulerConfiguration.Extenders);
+    # honored on the CPU path for wire compatibility with existing extenders —
+    # the batched paths use the gRPC sidecar instead (scheduler/extender.py)
+    extenders: Tuple["ExtenderConfig", ...] = ()
     parallelism: int = 16  # reference default goroutine fan-out; informational here
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
@@ -107,6 +112,11 @@ def validate(cfg: SchedulerConfiguration) -> List[str]:
                 errs.append(f"{p.scheduler_name}/{s.name}: negative weight")
     if cfg.mode not in ("tpu", "native", "cpu"):
         errs.append(f"unknown mode {cfg.mode!r}")
+    for e in cfg.extenders:
+        if not e.url_prefix:
+            errs.append("extender: urlPrefix required")
+        if e.bind_verb and not e.filter_verb:
+            errs.append(f"extender {e.url_prefix}: bindVerb requires filterVerb")
     if cfg.parallelism <= 0:
         errs.append("parallelism must be positive")
     return errs
@@ -145,8 +155,21 @@ def from_yaml(text: str) -> SchedulerConfiguration:
                 tpu_score=tpu,
             )
         )
+    extenders = tuple(
+        ExtenderConfig(
+            url_prefix=e.get("urlPrefix", ""),
+            filter_verb=e.get("filterVerb", ""),
+            prioritize_verb=e.get("prioritizeVerb", ""),
+            bind_verb=e.get("bindVerb", ""),
+            weight=float(e.get("weight", 1.0)),
+            ignorable=bool(e.get("ignorable", False)),
+            timeout_s=float(e.get("httpTimeout", 5.0)),
+        )
+        for e in doc.get("extenders") or []
+    )
     cfg = SchedulerConfiguration(
         profiles=tuple(profiles) or (Profile(),),
+        extenders=extenders,
         parallelism=int(doc.get("parallelism", 16)),
         pod_initial_backoff_seconds=float(doc.get("podInitialBackoffSeconds", 1.0)),
         pod_max_backoff_seconds=float(doc.get("podMaxBackoffSeconds", 10.0)),

@@ -66,7 +66,13 @@ class Scheduler:
         self.cache = SchedulerCache(store)
         self.queue = PriorityQueue(clock)
         self.metrics = Metrics()
-        self.events = EventRecorder()
+        self.events = EventRecorder(store=store)
+        from .extender import HTTPExtender
+
+        self.extenders = [HTTPExtender(e) for e in config.extenders]
+        # findNodesThatFitPod's rotating cursor (schedule_one.go —
+        # nextStartNodeIndex): spreads partial-scoring passes over the cluster
+        self._next_start_node_index = 0
         self.framework = Framework(
             default_plugins(
                 store,
@@ -133,6 +139,84 @@ class Scheduler:
             return st
         return self.framework.run_filters(state, snap, pod, info)
 
+    # --- findNodesThatFitPod helpers (CPU path) ---
+    def _num_feasible_nodes_to_find(self, num_nodes: int) -> int:
+        """schedule_one.go — numFeasibleNodesToFind: percentageOfNodesToScore
+        (0 = adaptive max(5, 50 - nodes/125)%), floored at
+        minFeasibleNodesToFind = 100."""
+        pct = self.config.profile().percentage_of_nodes_to_score
+        if pct == 0:
+            pct = max(5, 50 - num_nodes // 125)
+        if pct >= 100 or num_nodes <= 100:
+            return num_nodes
+        return max(100, num_nodes * pct // 100)
+
+    def _find_feasible(self, state, snap, pod, infos):
+        """Rotating-cursor filter fan-out with early stop at
+        numFeasibleNodesToFind (the adaptive-sampling half of D3; the batch
+        path always scores everything)."""
+        n = len(infos)
+        want = self._num_feasible_nodes_to_find(n)
+        feasible: List[int] = []
+        statuses: Dict[str, Status] = {}
+        processed = 0
+        start = self._next_start_node_index % n if n else 0
+        for k in range(n):
+            i = (start + k) % n
+            processed += 1
+            fst = self._filter_with_nominated(state, snap, pod, infos[i], i)
+            if fst.ok:
+                feasible.append(i)
+                if len(feasible) >= want:
+                    break
+            else:
+                statuses[infos[i].node.name] = fst
+        if n:
+            self._next_start_node_index = (start + processed) % n
+        feasible.sort()  # deterministic tie-break stays index-ordered
+        return feasible, statuses
+
+    def _extender_filter(self, pod, infos, feasible, statuses):
+        """findNodesThatPassExtenders: each extender prunes the feasible set;
+        transport failure from a non-ignorable extender fails the cycle."""
+        from .extender import ExtenderError
+
+        if not self.extenders or not feasible:
+            return feasible, statuses, True
+        names = [infos[i].node.name for i in feasible]
+        for ext in self.extenders:
+            if not ext.cfg.filter_verb:
+                continue
+            try:
+                names, failed = ext.filter(pod, names)
+            except ExtenderError as e:
+                if ext.cfg.ignorable:
+                    continue
+                statuses["*extender*"] = Status.unschedulable(str(e))
+                return [], statuses, False
+            for node, reason in failed.items():
+                statuses[node] = Status.unschedulable(f"extender: {reason}")
+        keep = set(names)
+        return [i for i in feasible if infos[i].node.name in keep], statuses, True
+
+    def _extender_prioritize(self, pod, chosen, scores):
+        from .extender import ExtenderError
+
+        if not self.extenders:
+            return scores
+        names = [info.node.name for info in chosen]
+        scores = list(scores)
+        for ext in self.extenders:
+            if not ext.cfg.prioritize_verb:
+                continue
+            try:
+                prio = ext.prioritize(pod, names)
+            except ExtenderError:
+                continue  # a failed prioritize zeroes that extender's votes
+            for j, name in enumerate(names):
+                scores[j] += prio.get(name, 0.0)
+        return scores
+
     # --- the CPU scheduling cycle (ScheduleOne) ---
     def schedule_one(self, pod: t.Pod) -> Optional[str]:
         from ..api.volumes import resolve_snapshot
@@ -148,20 +232,31 @@ class Scheduler:
         feasible: List[int] = []
         statuses: Dict[str, Status] = {}
         if st.ok:
-            for i, info in enumerate(infos):
-                fst = self._filter_with_nominated(state, snap, pod, info, i)
-                if fst.ok:
-                    feasible.append(i)
-                else:
-                    statuses[info.node.name] = fst
+            feasible, statuses = self._find_feasible(state, snap, pod, infos)
+            feasible, statuses, ext_ok = self._extender_filter(
+                pod, infos, feasible, statuses
+            )
+            if not ext_ok:
+                # extender transport failure is a cycle ERROR, not an
+                # unschedulable verdict: no preemption (evicting victims
+                # cannot help — the retry hits the same dead extender);
+                # the pod just backs off (schedule_one.go handleSchedulingFailure
+                # on a non-fitError)
+                self.events.record(
+                    "FailedScheduling", pod.uid,
+                    message=str(statuses.get("*extender*", "extender error")),
+                )
+                self.queue.add_unschedulable(pod, backoff=True)
+                self.metrics.inc("scheduling_attempts_error")
+                return None
         if not feasible:
             nominated, pst = self.framework.run_post_filters(state, snap, pod, statuses)
             self.events.record(
-                "FailedScheduling", pod.name,
+                "FailedScheduling", pod.uid,
                 message=f"0/{len(infos)} nodes available" + (f"; preemption nominated {nominated}" if pst.ok else ""),
             )
             if pst.ok and nominated:
-                self.events.record("Preempted", pod.name, node=nominated)
+                self.events.record("Preempted", pod.uid, node=nominated)
                 self._nominate(pod, nominated)
             else:
                 self._clear_nomination(pod)  # clearNominatedNode: stale
@@ -171,6 +266,7 @@ class Scheduler:
         chosen = [infos[i] for i in feasible]
         self.framework.run_pre_score(state, snap, pod, chosen)
         scores = self.framework.run_scores(state, snap, pod, chosen)
+        scores = self._extender_prioritize(pod, chosen, scores)
         best = feasible[int(np.argmax(scores))]  # first max == lowest node index
         node_name = infos[best].node.name
         # assume + binding cycle (synchronous here; the reference overlaps it)
@@ -179,14 +275,28 @@ class Scheduler:
         if st.ok:
             st = self.framework.run_pre_bind(state, snap, pod, node_name)
         if st.ok:
-            st = self.framework.run_bind(state, snap, pod, node_name)
+            binder = next(
+                (e for e in self.extenders if e.cfg.bind_verb), None
+            )
+            if binder is not None:
+                # extender binder takes precedence (extender.go — IsBinder);
+                # the in-process store stands in for the apiserver the
+                # extender would POST the Binding to
+                err = binder.bind(pod, node_name)
+                if err is None:
+                    self.store.bind(pod.uid, node_name)
+                    st = Status()
+                else:
+                    st = Status.unschedulable(f"extender bind: {err}")
+            else:
+                st = self.framework.run_bind(state, snap, pod, node_name)
         if not st.ok:
             self.cache.forget(pod.uid)
             self.queue.add_unschedulable(pod, backoff=True)
             return None
         self.framework.run_post_bind(state, snap, pod, node_name)
         self.queue.delete_nominated(pod.uid)
-        self.events.record("Scheduled", pod.name, node=node_name)
+        self.events.record("Scheduled", pod.uid, node=node_name)
         self.metrics.observe("scheduling_attempt_duration_seconds", time.perf_counter() - t0)
         self.metrics.inc("scheduling_attempts_scheduled")
         return node_name
@@ -298,7 +408,7 @@ class Scheduler:
                 self.cache.assume(pod.uid, node_name)
                 self.store.bind(pod.uid, node_name)
                 self.queue.delete_nominated(pod.uid)
-                self.events.record("Scheduled", pod.name, node=node_name)
+                self.events.record("Scheduled", pod.uid, node=node_name)
                 result[pod.name] = node_name
             else:
                 failed.append(pod)
@@ -321,14 +431,14 @@ class Scheduler:
                 min_bound_prio = min(
                     (q.priority for q in snap2.bound_pods), default=None
                 )
-            self.events.record("FailedScheduling", pod.name)
+            self.events.record("FailedScheduling", pod.uid)
             if min_bound_prio is None or pod.priority <= min_bound_prio:
                 pst = Status.unschedulable("preemption: no lower-priority pods")
                 self._clear_nomination(pod)
             else:
                 nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
                 if pst.ok and nominated:
-                    self.events.record("Preempted", pod.name, node=nominated)
+                    self.events.record("Preempted", pod.uid, node=nominated)
                     self._nominate(pod, nominated)
                     state = None  # evictions changed the cluster: rebuild lazily
                 else:

@@ -61,6 +61,7 @@ BUILTIN_KINDS = (
     "StorageClass",
     "ResourceSlice",
     "DeviceClass",
+    "Event",
 )
 
 

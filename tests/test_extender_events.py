@@ -1,0 +1,212 @@
+"""HTTP extender protocol (extender.go wire compat), Event API objects with
+aggregation, and percentageOfNodesToScore adaptive sampling."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler.config import Profile, SchedulerConfiguration, validate
+from kubernetes_tpu.scheduler.extender import ExtenderConfig
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.store import ClusterStore
+from kubernetes_tpu.kubectl import make_admin_kubectl
+from helpers import mk_node, mk_pod
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    """A toy extender: filters out nodes named *-banned, prefers *-gold (score
+    10), and records bind calls."""
+
+    binds = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if self.path.endswith("/filter"):
+            names = [n for n in body["nodenames"] if not n.endswith("-banned")]
+            failed = {n: "banned by extender" for n in body["nodenames"]
+                      if n.endswith("-banned")}
+            out = {"nodenames": names, "failedNodes": failed, "error": ""}
+        elif self.path.endswith("/prioritize"):
+            out = [{"host": n, "score": 10 if n.endswith("-gold") else 0}
+                   for n in body["nodenames"]]
+        elif self.path.endswith("/bind"):
+            _ExtenderHandler.binds.append((body["podUID"], body["node"]))
+            out = {"error": ""}
+        else:
+            out = {"error": f"unknown verb {self.path}"}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture(scope="module")
+def extender_server():
+    srv = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def _sched(store, url="", **ext_kw):
+    extenders = ()
+    if url:
+        extenders = (ExtenderConfig(url_prefix=url, **ext_kw),)
+    return Scheduler(store, SchedulerConfiguration(mode="cpu", extenders=extenders))
+
+
+def test_extender_filter_and_prioritize(extender_server):
+    store = ClusterStore()
+    store.add_node(mk_node("a-banned"))
+    store.add_node(mk_node("b"))
+    store.add_node(mk_node("c-gold"))
+    sched = _sched(store, extender_server, filter_verb="filter",
+                   prioritize_verb="prioritize")
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    # banned excluded; gold's +10 beats the index tie-break
+    assert store.pods["default/p"].node_name == "c-gold"
+
+
+def test_extender_bind_verb_takes_precedence(extender_server):
+    _ExtenderHandler.binds.clear()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    sched = _sched(store, extender_server, filter_verb="filter", bind_verb="bind")
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    assert _ExtenderHandler.binds == [("default/p", "n0")]
+    assert store.pods["default/p"].node_name == "n0"
+
+
+def test_nonignorable_extender_failure_requeues_pod():
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    # nothing listens here
+    sched = _sched(store, "http://127.0.0.1:9", filter_verb="filter")
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle(5)
+    assert store.pods["default/p"].node_name == ""  # cycle failed, requeued
+
+
+def test_ignorable_extender_failure_is_skipped():
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    sched = _sched(store, "http://127.0.0.1:9", filter_verb="filter",
+                   ignorable=True)
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    assert store.pods["default/p"].node_name == "n0"
+
+
+def test_extender_config_validation():
+    errs = validate(SchedulerConfiguration(
+        extenders=(ExtenderConfig(url_prefix="", bind_verb="bind"),)))
+    assert any("urlPrefix" in e for e in errs)
+    assert any("bindVerb requires filterVerb" in e for e in errs)
+
+
+# ------------------------------------------------- percentageOfNodesToScore
+
+
+def test_adaptive_sampling_stops_early_and_rotates():
+    store = ClusterStore()
+    for i in range(300):
+        store.add_node(mk_node(f"n{i:03d}"))
+    prof = Profile(percentage_of_nodes_to_score=40)  # want = max(100, 120)
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu", profiles=(prof,)))
+    calls = []
+    orig = sched._filter_with_nominated
+
+    def counting(state, snap, pod, info, i):
+        calls.append(info.node.name)
+        return orig(state, snap, pod, info, i)
+
+    sched._filter_with_nominated = counting
+    store.add_pod(mk_pod("p0"))
+    sched.run_until_idle()
+    first = len(calls)
+    assert first == 120  # stopped at numFeasibleNodesToFind, not 300
+    cursor = sched._next_start_node_index
+    assert cursor == 120  # rotating cursor advanced by processed count
+    calls.clear()
+    store.add_pod(mk_pod("p1"))
+    sched.run_until_idle()
+    assert calls[0] == f"n{cursor:03d}"  # next cycle starts where we left off
+
+
+def test_default_percentage_scores_all_nodes():
+    store = ClusterStore()
+    for i in range(150):
+        store.add_node(mk_node(f"n{i}"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"))
+    calls = []
+    orig = sched._filter_with_nominated
+    sched._filter_with_nominated = lambda *a: (calls.append(1), orig(*a))[1]
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle()
+    assert len(calls) == 150
+
+
+# --------------------------------------------------------- Event API objects
+
+
+def test_scheduler_publishes_aggregated_events_and_kubectl_lists_them():
+    kc = make_admin_kubectl()
+    store = kc.api.store
+    store.add_node(mk_node("n0", cpu=1000))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"))
+    store.add_pod(mk_pod("ok", cpu=500))
+    store.add_pod(mk_pod("huge", cpu=50_000))
+    sched.run_until_idle(5)
+    events = store.list_objects("Event")
+    reasons = {e.reason for e in events}
+    assert "Scheduled" in reasons and "FailedScheduling" in reasons
+    # retries of the same failure aggregate into count, not new objects
+    fails = [e for e in events if e.reason == "FailedScheduling"]
+    assert len(fails) == 1
+    out = kc.run("get events")
+    assert "Scheduled" in out and "FailedScheduling" in out
+    assert "Scheduled" in kc.run("events")  # the top-level alias works too
+
+
+def test_events_attributed_to_pod_namespace_and_bounded():
+    from kubernetes_tpu.scheduler.events import EventRecorder
+
+    store = ClusterStore()
+    rec = EventRecorder(store=store, publish_limit=3)
+    rec.record("Scheduled", "prod/web", node="n1")
+    rec.record("Scheduled", "default/web", node="n1")
+    evs = store.list_objects("Event")
+    assert {e.namespace for e in evs} == {"prod", "default"}  # no merging
+    assert all(e.count == 1 for e in evs)
+    # the cap evicts oldest objects
+    for i in range(5):
+        rec.record("Scheduled", f"default/p{i}", node="n1")
+    assert len(store.list_objects("Event")) == 3
+
+
+def test_extender_outage_does_not_trigger_preemption():
+    """A dead non-ignorable extender must NOT evict victims — the retry hits
+    the same dead extender, so preemption can never help."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=2000))
+    victim = mk_pod("victim", cpu=800)
+    victim.node_name = "n0"
+    store.add_pod(victim)
+    sched = _sched(store, "http://127.0.0.1:9", filter_verb="filter")
+    high = mk_pod("high", cpu=800)  # fits WITHOUT eviction; only the
+    high.priority = 100             # extender call fails
+    store.add_pod(high)
+    sched.run_until_idle(5)
+    assert "default/victim" in store.pods  # not evicted
+    assert store.pods["default/high"].node_name == ""
