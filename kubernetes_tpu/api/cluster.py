@@ -284,6 +284,34 @@ class HorizontalPodAutoscaler:
         return f"{self.namespace}/{self.name}"
 
 
+# ------------------------------------------------------------- ServiceAccount
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 — type ServiceAccount.  `token` is the minted bearer token
+    (the legacy token Secret collapsed onto the object; the token controller
+    fills it and registers it with the authenticator)."""
+
+    name: str
+    namespace: str = "default"
+    token: str = ""  # "" = not yet minted
+    uid: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"sa/{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def username(self) -> str:
+        """The authenticated identity (serviceaccount/util — MakeUsername)."""
+        return f"system:serviceaccount:{self.namespace}:{self.name}"
+
+
 # ------------------------------------------------------------------ Events
 
 

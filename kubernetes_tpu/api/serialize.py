@@ -59,6 +59,7 @@ KINDS: Dict[str, type] = {
     "ResourceSlice": c.ResourceSlice,
     "DeviceClass": c.DeviceClass,
     "Event": c.ClusterEvent,
+    "ServiceAccount": c.ServiceAccount,
 }
 # aliases accepted on decode (the store's table name for PodDisruptionBudget)
 _KIND_ALIASES = {"PDB": "PodDisruptionBudget"}

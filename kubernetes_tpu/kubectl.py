@@ -634,7 +634,9 @@ class Kubectl:
             objs = [e for e in objs if e.namespace == ns]
         if objs:
             return self._table("Event", objs)
-        if self.recorder is None:
+        if self._handle("list", "Event") or self.recorder is None:
+            # Event objects exist, just none in the requested namespace —
+            # the raw recorder has no namespace filter, don't dump it all
             return "No events.\n"
         rows = [[e.reason, e.pod, e.node or "", e.message]
                 for e in self.recorder.events[-200:]]

@@ -231,6 +231,87 @@ class PolicyPlugin(AdmissionPlugin):
                 raise AdmissionDenied(f"{p.name}: {p.message}")
 
 
+@dataclass(frozen=True)
+class WebhookConfig:
+    """admissionregistration.k8s.io — Mutating/ValidatingWebhookConfiguration
+    reduced to one webhook: target URL, rule match (kinds/verbs), and
+    failurePolicy.  Wire shape is AdmissionReview-like JSON:
+
+      POST url  {"request": {"operation", "kind", "namespace", "object"}}
+        -> {"response": {"allowed": bool, "message": str, "object": manifest?}}
+
+    Mutating webhooks return the full mutated object instead of a JSONPatch
+    (documented reduction; reinvocationPolicy is likewise not modeled)."""
+
+    url: str
+    mutating: bool = False
+    kinds: Tuple[str, ...] = ()  # empty = every kind
+    verbs: Tuple[str, ...] = ("create", "update")
+    failure_policy: str = "Fail"  # Fail | Ignore
+    timeout_s: float = 5.0
+
+
+class Webhook(AdmissionPlugin):
+    """apiserver/pkg/admission/plugin/webhook — the HTTP boundary member of
+    the chain (mutating/{mutating,validating} dispatchers)."""
+
+    def __init__(self, cfg: WebhookConfig):
+        self.cfg = cfg
+        self.name = f"webhook[{cfg.url}]"
+
+    def _matches(self, attrs: Attributes) -> bool:
+        if attrs.verb not in self.cfg.verbs:
+            return False
+        return not self.cfg.kinds or attrs.kind in self.cfg.kinds
+
+    def _call(self, attrs: Attributes) -> dict:
+        import urllib.error
+
+        from ..api.serialize import to_manifest
+        from .extender import post_json
+
+        payload = {
+            "request": {
+                "operation": attrs.verb.upper(),
+                "kind": attrs.kind,
+                "namespace": attrs.namespace,
+                "object": to_manifest(attrs.obj),
+            }
+        }
+        try:
+            return post_json(self.cfg.url, payload, self.cfg.timeout_s).get(
+                "response"
+            ) or {}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if self.cfg.failure_policy == "Ignore":
+                return {"allowed": True}
+            raise AdmissionDenied(f"{self.name}: {e}") from e
+
+    def admit(self, attrs: Attributes) -> None:
+        if not self.cfg.mutating or not self._matches(attrs):
+            return
+        resp = self._call(attrs)
+        if not resp.get("allowed", False):
+            raise AdmissionDenied(f"{self.name}: {resp.get('message', 'denied')}")
+        if resp.get("object") is not None:
+            from ..api.serialize import DecodeError, from_manifest
+
+            try:
+                attrs.obj = from_manifest(resp["object"])
+            except DecodeError as e:
+                # a webhook returning a malformed object is a webhook failure
+                # like any other: classified by failurePolicy
+                if self.cfg.failure_policy != "Ignore":
+                    raise AdmissionDenied(f"{self.name}: bad mutated object: {e}") from e
+
+    def validate(self, attrs: Attributes) -> None:
+        if self.cfg.mutating or not self._matches(attrs):
+            return
+        resp = self._call(attrs)
+        if not resp.get("allowed", False):
+            raise AdmissionDenied(f"{self.name}: {resp.get('message', 'denied')}")
+
+
 class AdmissionChain:
     """admission.NewChainHandler — all mutating admits, then all validates."""
 
@@ -238,8 +319,11 @@ class AdmissionChain:
         self.plugins = plugins
 
     @staticmethod
-    def default(store: ClusterStore, policies: Optional[PolicyPlugin] = None
-                ) -> "AdmissionChain":
+    def default(
+        store: ClusterStore,
+        policies: Optional[PolicyPlugin] = None,
+        webhooks: Tuple[WebhookConfig, ...] = (),
+    ) -> "AdmissionChain":
         plugins: List[AdmissionPlugin] = [
             NamespaceLifecycle(store),
             LimitRanger(store),
@@ -248,6 +332,9 @@ class AdmissionChain:
         ]
         if policies is not None:
             plugins.append(policies)
+        # webhooks after in-tree plugins: mutating webhooks see in-tree
+        # defaults applied; validating webhooks run in the validate pass
+        plugins.extend(Webhook(w) for w in webhooks)
         return AdmissionChain(plugins)
 
     def run(self, attrs: Attributes) -> object:

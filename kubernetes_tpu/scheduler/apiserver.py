@@ -113,6 +113,7 @@ class APIServer:
         store: ClusterStore,
         authenticator: Optional[TokenAuthenticator] = None,
         policies: Optional[PolicyPlugin] = None,
+        webhooks: tuple = (),
         total_concurrency: int = 600,
         queue_wait_s: float = 5.0,
     ):
@@ -121,7 +122,7 @@ class APIServer:
         self.authn = authenticator or TokenAuthenticator()
         self.authz = RBACAuthorizer(store)
         self.apf = APFController(store, total_concurrency=total_concurrency)
-        self.admission = AdmissionChain.default(store, policies)
+        self.admission = AdmissionChain.default(store, policies, webhooks)
         self.audit_log: List[AuditEvent] = []
         self.ips = ClusterIPAllocator()
 

@@ -55,6 +55,10 @@ class RBACAuthorizer:
             return sub.name == user.name
         if sub.kind == "Group":
             return sub.name in user.groups
+        if sub.kind == "ServiceAccount":
+            # Subject.name carries "namespace:name" (rbac/v1 splits these
+            # into two fields; folded here) — serviceaccount MakeUsername
+            return user.name == f"system:serviceaccount:{sub.name}"
         return False
 
     def _roles_for(self, user: c.UserInfo, namespace: str):

@@ -39,9 +39,10 @@ class TPUScoreArgs:
 class Profile:
     scheduler_name: str = "default-scheduler"
     plugins: Tuple[PluginSpec, ...] = ()
-    # percentageOfNodesToScore: 0 = adaptive default in the reference; this
-    # framework always scores all nodes (deterministic mode) and keeps the
-    # field for config parity + validation
+    # percentageOfNodesToScore: honored by the CPU path's filter fan-out
+    # (adaptive numFeasibleNodesToFind formula when 0, rotating cursor);
+    # default 100 = full deterministic scoring; batch/TPU paths always score
+    # everything (D3)
     percentage_of_nodes_to_score: int = 100
     tpu_score: Optional[TPUScoreArgs] = None
     # InterPodAffinityArgs.hardPodAffinityWeight (pluginConfig; default 1)

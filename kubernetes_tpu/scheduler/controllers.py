@@ -674,16 +674,62 @@ class TTLAfterFinishedController:
                 self.store.delete_object("Job", job.key)
 
 
+class ServiceAccountController:
+    """pkg/controller/serviceaccount — serviceaccounts_controller (ensure the
+    "default" ServiceAccount exists in every active namespace) fused with the
+    token controller (tokens_controller: mint a bearer token per SA and
+    register it with the authenticator; the token Secret is collapsed onto
+    the SA object)."""
+
+    def __init__(self, store: ClusterStore, authenticator=None):
+        from ..api import cluster as c
+
+        self._c = c
+        self.store = store
+        self.authn = authenticator
+
+    def tick(self) -> None:
+        c = self._c
+        namespaces = {"default"} | {
+            ns.name
+            for ns in self.store.list_objects("Namespace")
+            if ns.phase == "Active"
+        }
+        for ns in sorted(namespaces):
+            if self.store.get_object("ServiceAccount", f"{ns}/default") is None:
+                self.store.add_object(
+                    "ServiceAccount", c.ServiceAccount(name="default", namespace=ns)
+                )
+        for sa in list(self.store.list_objects("ServiceAccount")):
+            if sa.token:
+                continue
+            token = f"sa-token-{hashlib.sha1(sa.uid.encode()).hexdigest()[:16]}"
+            minted = copy_module.copy(sa)
+            minted.token = token
+            self.store.update_object("ServiceAccount", minted)
+            if self.authn is not None:
+                self.authn.add_token(
+                    token,
+                    sa.username,
+                    groups=(
+                        "system:serviceaccounts",
+                        f"system:serviceaccounts:{sa.namespace}",
+                    ),
+                )
+
+
 class ControllerManager:
     """cmd/kube-controller-manager — runs the controller set; tick() is one
     reconcile round across all of them (deployment before replicaset so a
     rollout's RS scaling lands in the same round; cronjob before job so a
     spawned Job's pods land in the same round; HPA after metrics exist)."""
 
-    def __init__(self, store: ClusterStore, clock=None, metrics=None):
+    def __init__(self, store: ClusterStore, clock=None, metrics=None,
+                 authenticator=None):
         from .network import EndpointSliceController
 
         self.store = store
+        self.serviceaccounts = ServiceAccountController(store, authenticator)
         self.deployments = DeploymentController(store)
         self.replicasets = ReplicaSetController(store)
         self.statefulsets = StatefulSetController(store)
@@ -698,6 +744,7 @@ class ControllerManager:
         self.gc = GarbageCollector(store)
 
     def tick(self) -> None:
+        self.serviceaccounts.tick()
         self.hpa.tick()
         self.deployments.tick()
         self.replicasets.tick()

@@ -38,6 +38,19 @@ class ExtenderError(Exception):
     scheduling attempt fails and it re-queues (extender.go — IsIgnorable)."""
 
 
+def post_json(url: str, payload: dict, timeout_s: float) -> dict:
+    """One JSON POST -> decoded JSON response.  Shared wire helper for the
+    extender and admission-webhook clients; raises the urllib/OS/ValueError
+    family for the caller's failure policy to classify."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
 @dataclass(frozen=True)
 class ExtenderConfig:
     """apis/config — type Extender (the fields this client honors)."""
@@ -57,13 +70,7 @@ class HTTPExtender:
 
     def _post(self, verb: str, payload: dict) -> dict:
         url = f"{self.cfg.url_prefix.rstrip('/')}/{verb}"
-        req = urllib.request.Request(
-            url,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=self.cfg.timeout_s) as resp:
-            return json.loads(resp.read().decode())
+        return post_json(url, payload, self.cfg.timeout_s)
 
     # ------------------------------------------------------------- filter
     def filter(

@@ -62,6 +62,7 @@ BUILTIN_KINDS = (
     "ResourceSlice",
     "DeviceClass",
     "Event",
+    "ServiceAccount",
 )
 
 

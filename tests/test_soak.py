@@ -63,9 +63,11 @@ def test_full_stack_soak_converges():
                       ports=(c.ServicePort(80),), cluster_ip=f"10.96.0.{d + 1}"),
         )
 
+    from kubernetes_tpu.scheduler.auth import TokenAuthenticator
+
     sched = Scheduler(store, SchedulerConfiguration(mode="cpu"))
     leases = LeaseStore()
-    cm = ControllerManager(store)
+    cm = ControllerManager(store, authenticator=TokenAuthenticator())
     fleet = HollowCluster(store, leases)
     proxy = Proxier(store)
     rng = random.Random(7)

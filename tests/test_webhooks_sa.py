@@ -1,0 +1,174 @@
+"""Admission webhooks (the HTTP boundary member of the chain) and the
+ServiceAccount + token controller with RBAC ServiceAccount subjects."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import cluster as c
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler.admission import AdmissionDenied, WebhookConfig
+from kubernetes_tpu.scheduler.apiserver import APIServer, Forbidden
+from kubernetes_tpu.scheduler.auth import TokenAuthenticator, bind_cluster_role
+from kubernetes_tpu.scheduler.controllers import ServiceAccountController
+from kubernetes_tpu.scheduler.store import ClusterStore
+
+
+class _WebhookHandler(BaseHTTPRequestHandler):
+    """Mutating endpoint /label: adds a label.  Validating endpoint /deny-big:
+    rejects pods requesting >4000 cpu."""
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        obj = body["request"]["object"]
+        if self.path == "/label":
+            obj.setdefault("labels", {})["injected"] = "yes"
+            out = {"response": {"allowed": True, "object": obj}}
+        elif self.path == "/deny-big":
+            big = obj.get("requests", {}).get("cpu", 0) > 4000
+            out = {"response": {"allowed": not big,
+                                "message": "cpu request too large"}}
+        else:
+            out = {"response": {"allowed": False, "message": "bad path"}}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def webhook_server():
+    srv = HTTPServer(("127.0.0.1", 0), _WebhookHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def _api(webhooks=()):
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    authn.add_token("admin", "admin", groups=("system:masters",))
+    return APIServer(store, authenticator=authn, webhooks=webhooks), store
+
+
+def test_mutating_webhook_rewrites_object(webhook_server):
+    api, store = _api((WebhookConfig(url=f"{webhook_server}/label",
+                                     mutating=True, kinds=("Pod",)),))
+    api.handle("admin", "create", "Pod", t.Pod(name="p"))
+    assert store.pods["default/p"].labels["injected"] == "yes"
+    # non-matching kind untouched
+    api.handle("admin", "create", "Node", t.Node(name="n"))
+    assert "injected" not in store.nodes["n"].labels
+
+
+def test_validating_webhook_rejects(webhook_server):
+    api, store = _api((WebhookConfig(url=f"{webhook_server}/deny-big",
+                                     kinds=("Pod",)),))
+    api.handle("admin", "create", "Pod", t.Pod(name="ok", requests={"cpu": 100}))
+    with pytest.raises(AdmissionDenied, match="too large"):
+        api.handle("admin", "create", "Pod",
+                   t.Pod(name="big", requests={"cpu": 9000}))
+    assert "default/big" not in store.pods
+
+
+def test_webhook_failure_policy():
+    down = "http://127.0.0.1:9/x"
+    api, _ = _api((WebhookConfig(url=down, kinds=("Pod",)),))
+    with pytest.raises(AdmissionDenied):  # Fail (default)
+        api.handle("admin", "create", "Pod", t.Pod(name="p"))
+    api2, store2 = _api((WebhookConfig(url=down, kinds=("Pod",),
+                                       failure_policy="Ignore"),))
+    api2.handle("admin", "create", "Pod", t.Pod(name="p"))
+    assert "default/p" in store2.pods
+
+
+# ------------------------------------------------------- ServiceAccounts
+
+
+def test_default_serviceaccount_and_token_minting():
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    store.add_object("Namespace", c.Namespace(name="team-a"))
+    ctrl = ServiceAccountController(store, authn)
+    ctrl.tick()
+    ctrl.tick()  # minting is a second pass over created SAs
+    sas = {sa.key: sa for sa in store.list_objects("ServiceAccount")}
+    assert "default/default" in sas and "team-a/default" in sas
+    sa = sas["team-a/default"]
+    assert sa.token
+    user = authn.authenticate(sa.token)
+    assert user.name == "system:serviceaccount:team-a:default"
+    assert "system:serviceaccounts:team-a" in user.groups
+    # idempotent: no re-mint
+    before = sa.token
+    ctrl.tick()
+    assert store.get_object("ServiceAccount", "team-a/default").token == before
+
+
+def test_serviceaccount_rbac_subject():
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    ctrl = ServiceAccountController(store, authn)
+    ctrl.tick()
+    ctrl.tick()
+    sa = store.get_object("ServiceAccount", "default/default")
+    store.add_object(
+        "Role",
+        c.Role(name="pod-reader",
+               rules=(c.PolicyRule(verbs=("get", "list"), resources=("pods",)),)),
+    )
+    store.add_object(
+        "RoleBinding",
+        c.RoleBinding(name="sa-read", role_name="pod-reader",
+                      subjects=(c.Subject("ServiceAccount", "default:default"),)),
+    )
+    api = APIServer(store, authenticator=authn)
+    assert api.handle(sa.token, "list", "Pod") == []
+    with pytest.raises(Forbidden):
+        api.handle(sa.token, "delete", "Pod", namespace="default", name="x")
+
+
+def test_malformed_mutation_honors_failure_policy():
+    """A webhook returning a garbage object is a webhook failure: Fail ->
+    AdmissionDenied (not a raw DecodeError), Ignore -> original object kept."""
+    class BadHandler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            d = json.dumps({"response": {"allowed": True,
+                                         "object": {"bogus": 1}}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(d)))
+            self.end_headers()
+            self.wfile.write(d)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), BadHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_port}/m"
+    api, _ = _api((WebhookConfig(url=url, mutating=True, kinds=("Pod",)),))
+    with pytest.raises(AdmissionDenied, match="bad mutated object"):
+        api.handle("admin", "create", "Pod", t.Pod(name="p"))
+    api2, store2 = _api((WebhookConfig(url=url, mutating=True, kinds=("Pod",),
+                                       failure_policy="Ignore"),))
+    api2.handle("admin", "create", "Pod", t.Pod(name="p", labels={"keep": "me"}))
+    assert store2.pods["default/p"].labels == {"keep": "me"}
+    srv.shutdown()
+
+
+def test_controller_manager_wires_sa_tokens():
+    """The production wiring: ControllerManager(authenticator=...) mints
+    tokens that actually authenticate."""
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    from kubernetes_tpu.scheduler.controllers import ControllerManager
+
+    ControllerManager(store, authenticator=authn).tick()
+    sa = store.get_object("ServiceAccount", "default/default")
+    assert authn.authenticate(sa.token).name == sa.username
