@@ -122,14 +122,82 @@ class TPUScoreServer:
         self._server.stop(grace)
 
 
+class HealthServer:
+    """component-base health + metrics endpoints: /healthz /readyz /livez
+    (apiserver/pkg/server/healthz) and a Prometheus-text /metrics —
+    "every binary serves /metrics, /healthz|readyz|livez" (SURVEY.md §5)."""
+
+    def __init__(self, address: str = "127.0.0.1:0", metrics=None,
+                 ready_check=None):
+        import http.server
+
+        self.metrics = metrics
+        self.ready_check = ready_check or (lambda: True)
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path in ("/healthz", "/livez"):
+                    body, code = b"ok", 200
+                elif self.path == "/readyz":
+                    ok = outer.ready_check()
+                    body, code = (b"ok", 200) if ok else (b"not ready", 503)
+                elif self.path == "/metrics":
+                    body, code = outer._render_metrics().encode(), 200
+                else:
+                    body, code = b"not found", 404
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        host, _, port = address.partition(":")
+        self._httpd = http.server.HTTPServer((host, int(port or 0)), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def _render_metrics(self) -> str:
+        lines = []
+        if self.metrics is not None:
+            counters, gauges, hists = self.metrics.snapshot()
+            for name, v in sorted(counters.items()):
+                lines.append(f"# TYPE {name} counter\n{name} {v}")
+            for name, v in sorted(gauges.items()):
+                lines.append(f"# TYPE {name} gauge\n{name} {v}")
+            for name, (p50, p99, count) in sorted(hists.items()):
+                lines.append(
+                    f"# TYPE {name} summary\n"
+                    f"{name}{{quantile=\"0.5\"}} {p50}\n"
+                    f"{name}{{quantile=\"0.99\"}} {p99}\n"
+                    f"{name}_count {count}"
+                )
+        return "\n".join(lines) + "\n"
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+
+
 def main() -> None:  # pragma: no cover - manual entry point
     import argparse
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--listen", default="127.0.0.1:50151")
+    ap.add_argument("--health-port", type=int, default=0,
+                    help="serve /healthz /readyz /livez /metrics (0 = off)")
     args = ap.parse_args()
     srv = TPUScoreServer(args.listen)
     port = srv.start()
+    if args.health_port:
+        hs = HealthServer(f"127.0.0.1:{args.health_port}",
+                          ready_check=lambda: True)
+        print(f"health endpoints on port {hs.start()}")
     print(f"tpuscore sidecar listening on port {port}")
     threading.Event().wait()
 

@@ -279,13 +279,18 @@ class Webhook(AdmissionPlugin):
             }
         }
         try:
-            return post_json(self.cfg.url, payload, self.cfg.timeout_s).get(
-                "response"
-            ) or {}
+            resp = post_json(self.cfg.url, payload, self.cfg.timeout_s).get("response")
         except (urllib.error.URLError, OSError, ValueError) as e:
             if self.cfg.failure_policy == "Ignore":
                 return {"allowed": True}
             raise AdmissionDenied(f"{self.name}: {e}") from e
+        if not isinstance(resp, dict):
+            # missing/garbage envelope is a webhook FAILURE (fail-open under
+            # Ignore), not a deny verdict
+            if self.cfg.failure_policy == "Ignore":
+                return {"allowed": True}
+            raise AdmissionDenied(f"{self.name}: malformed AdmissionReview response")
+        return resp
 
     def admit(self, attrs: Attributes) -> None:
         if not self.cfg.mutating or not self._matches(attrs):

@@ -28,6 +28,11 @@ class TokenAuthenticator:
     def add_token(self, token: str, user: str, groups: Iterable[str] = ()) -> None:
         self._tokens[token] = c.UserInfo(name=user, groups=tuple(groups))
 
+    def remove_token(self, token: str) -> None:
+        """Credential revocation (the tokens_controller deletes the token
+        Secret when its ServiceAccount goes away)."""
+        self._tokens.pop(token, None)
+
     def authenticate(self, token: Optional[str]) -> Optional[c.UserInfo]:
         """-> UserInfo, or None (unauthenticated => request rejected upstream)."""
         if token is None:

@@ -57,6 +57,10 @@ class SchedulerConfiguration:
     # the batched paths use the gRPC sidecar instead (scheduler/extender.py)
     extenders: Tuple["ExtenderConfig", ...] = ()
     parallelism: int = 16  # reference default goroutine fan-out; informational here
+    # >0: the CPU path's binding cycle (PreBind/Bind/PostBind) runs on this
+    # many worker threads, overlapping the next pod's scheduling cycle — the
+    # reference's async bindingCycle goroutine.  0 = synchronous binding.
+    binding_workers: int = 0
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     feature_gates: Tuple[Tuple[str, bool], ...] = ()
@@ -120,6 +124,8 @@ def validate(cfg: SchedulerConfiguration) -> List[str]:
             errs.append(f"extender {e.url_prefix}: bindVerb requires filterVerb")
     if cfg.parallelism <= 0:
         errs.append("parallelism must be positive")
+    if cfg.binding_workers < 0:
+        errs.append("bindingWorkers must be >= 0")
     return errs
 
 

@@ -687,9 +687,19 @@ class ServiceAccountController:
         self._c = c
         self.store = store
         self.authn = authenticator
+        self._minted: Dict[str, str] = {}  # SA key -> token
+        self._mint_seq = itertools.count()
 
     def tick(self) -> None:
         c = self._c
+        # revocation FIRST, against last tick's state: an SA that vanished
+        # loses its credential even if the ensure pass recreates the name
+        # (the recreated SA gets a fresh token below)
+        for key, token in list(self._minted.items()):
+            if self.store.get_object("ServiceAccount", key) is None:
+                if self.authn is not None:
+                    self.authn.remove_token(token)
+                del self._minted[key]
         namespaces = {"default"} | {
             ns.name
             for ns in self.store.list_objects("Namespace")
@@ -703,10 +713,16 @@ class ServiceAccountController:
         for sa in list(self.store.list_objects("ServiceAccount")):
             if sa.token:
                 continue
-            token = f"sa-token-{hashlib.sha1(sa.uid.encode()).hexdigest()[:16]}"
+            # nonce keeps a recreated SA from inheriting its predecessor's
+            # credential (the reference mints a fresh random Secret)
+            nonce = next(self._mint_seq)
+            token = (
+                f"sa-token-{hashlib.sha1(f'{sa.uid}:{nonce}'.encode()).hexdigest()[:16]}"
+            )
             minted = copy_module.copy(sa)
             minted.token = token
             self.store.update_object("ServiceAccount", minted)
+            self._minted[sa.key] = token
             if self.authn is not None:
                 self.authn.add_token(
                     token,

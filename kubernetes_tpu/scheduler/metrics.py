@@ -42,6 +42,8 @@ class Metrics:
     Prometheus mirroring."""
 
     def __init__(self, prometheus: bool = False):
+        # counters/gauges are bumped from binding-cycle worker threads too
+        self._lock = threading.Lock()
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = defaultdict(float)
         self.hists: Dict[str, _Hist] = defaultdict(_Hist)
@@ -58,16 +60,30 @@ class Metrics:
             }
 
     def inc(self, name: str, v: float = 1.0) -> None:
-        self.counters[name] += v
+        with self._lock:
+            self.counters[name] += v
         p = self._prom.get(name)
         if p is not None:
             p.inc(v)
 
     def set(self, name: str, v: float) -> None:
-        self.gauges[name] = v
+        with self._lock:
+            self.gauges[name] = v
         p = self._prom.get(name)
         if p is not None:
             p.set(v)
+
+    def snapshot(self):
+        """Consistent copies for scrapers: (counters, gauges,
+        {hist: (p50, p99, count)})."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = dict(self.hists)
+        return counters, gauges, {
+            name: (h.quantile(0.5), h.quantile(0.99), len(h.samples))
+            for name, h in hists.items()
+        }
 
     def observe(self, name: str, v: float) -> None:
         self.hists[name].observe(v)

@@ -18,8 +18,10 @@ k8s.io/utils/clock/testing the same way — SURVEY.md §4).
 
 from __future__ import annotations
 
+import functools
 import heapq
 import itertools
+import threading
 import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -60,8 +62,24 @@ class _Item:
     pod: t.Pod = field(compare=False)
 
 
+def _locked(fn):
+    """Run the method under the queue's re-entrant lock."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
 class PriorityQueue:
+    """Thread-safe: binding-cycle workers requeue/denominate concurrently with
+    the scheduling thread's pop (the reference's queue takes its own lock —
+    scheduling_queue.go guards activeQ/backoffQ with sync.Cond)."""
+
     def __init__(self, clock: Optional[Clock] = None):
+        self._lock = threading.RLock()
         self.clock = clock or Clock()
         self._seq = itertools.count()
         self._active: List[_Item] = []  # heap
@@ -77,11 +95,13 @@ class PriorityQueue:
         self._gone: Dict[str, int] = {}
         self._in_backoff: Dict[str, int] = {}  # uid -> live backoff entries
 
+    @_locked
     def __len__(self) -> int:
         self._flush_backoff()
         return len(self._active)
 
     @property
+    @_locked
     def pending_total(self) -> int:
         return len(self._active) + len(self._backoff) + len(self._unschedulable)
 
@@ -90,6 +110,7 @@ class PriorityQueue:
         arr = self._arrival.setdefault(pod.uid, next(self._seq))
         return (-pod.priority, arr)
 
+    @_locked
     def add(self, pod: t.Pod) -> None:
         if pod.uid in self._active_uids:
             return
@@ -114,6 +135,7 @@ class PriorityQueue:
                 continue
             self.add(pod)
 
+    @_locked
     def pop(self) -> Optional[t.Pod]:
         """Next pod in activeQ order, or None if activeQ is empty
         (scheduling_queue.go — Pop; non-blocking variant)."""
@@ -126,10 +148,12 @@ class PriorityQueue:
                 return item.pod
         return None
 
+    @_locked
     def backoff_duration(self, pod_uid: str) -> float:
         n = max(0, self._attempts.get(pod_uid, 1) - 1)
         return min(MAX_BACKOFF_S, INITIAL_BACKOFF_S * (2**n))
 
+    @_locked
     def add_unschedulable(self, pod: t.Pod, events: Optional[Set[str]] = None,
                           backoff: bool = True) -> None:
         """AddUnschedulableIfNotPresent: failed pods wait for a wake event; with
@@ -141,6 +165,7 @@ class PriorityQueue:
         else:
             self._unschedulable[pod.uid] = (pod, events or {EV_ALL})
 
+    @_locked
     def move_all_to_active_or_backoff(self, event: str) -> int:
         """MoveAllToActiveOrBackoffQueue on a cluster event; returns #moved."""
         moved = []
@@ -153,6 +178,7 @@ class PriorityQueue:
                 self._in_backoff[uid] = self._in_backoff.get(uid, 0) + 1
         return len(moved)
 
+    @_locked
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)
         self._unschedulable.pop(pod_uid, None)
@@ -163,15 +189,19 @@ class PriorityQueue:
 
     # --- nominator (scheduling_queue.go — nominator: AddNominatedPod /
     # DeleteNominatedPodIfExists / NominatedPodsForNode) ---
+    @_locked
     def add_nominated(self, pod: t.Pod, node_name: str) -> None:
         self._nominated[pod.uid] = (pod, node_name)
 
+    @_locked
     def delete_nominated(self, pod_uid: str) -> None:
         self._nominated.pop(pod_uid, None)
 
+    @_locked
     def nominated_pods_for_node(self, node_name: str) -> List[t.Pod]:
         return [p for p, n in self._nominated.values() if n == node_name]
 
     @property
+    @_locked
     def nominated(self) -> Dict[str, Tuple[t.Pod, str]]:
         return dict(self._nominated)

@@ -25,6 +25,7 @@ event kinds.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -70,6 +71,16 @@ class Scheduler:
         from .extender import HTTPExtender
 
         self.extenders = [HTTPExtender(e) for e in config.extenders]
+        self._bind_pool = None
+        self._bind_lock = threading.Lock()
+        self._bind_futures: list = []
+        if config.binding_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._bind_pool = ThreadPoolExecutor(
+                max_workers=config.binding_workers,
+                thread_name_prefix="binding-cycle",
+            )
         # findNodesThatFitPod's rotating cursor (schedule_one.go —
         # nextStartNodeIndex): spreads partial-scoring passes over the cluster
         self._next_start_node_index = 0
@@ -269,15 +280,32 @@ class Scheduler:
         scores = self._extender_prioritize(pod, chosen, scores)
         best = feasible[int(np.argmax(scores))]  # first max == lowest node index
         node_name = infos[best].node.name
-        # assume + binding cycle (synchronous here; the reference overlaps it)
+        # assume: the cycle becomes pipelinable — the assumed pod occupies
+        # capacity for the NEXT pod's cycle while this one's binding runs
         self.cache.assume(pod.uid, node_name)
         st = self.framework.run_permit(state, snap, pod, node_name)
-        if st.ok:
-            st = self.framework.run_pre_bind(state, snap, pod, node_name)
-        if st.ok:
-            binder = next(
-                (e for e in self.extenders if e.cfg.bind_verb), None
+        if not st.ok:
+            self.cache.forget(pod.uid)
+            self.queue.add_unschedulable(pod, backoff=True)
+            return None
+        if self._bind_pool is not None:
+            # bindingCycle as its own goroutine (schedule_one.go: `go func()`)
+            # overlapping the next pod's schedulingCycle
+            fut = self._bind_pool.submit(
+                self._binding_cycle, state, snap, pod, node_name, t0
             )
+            with self._bind_lock:
+                self._bind_futures = [f for f in self._bind_futures if not f.done()]
+                self._bind_futures.append(fut)
+            return node_name  # optimistic: assumed
+        return self._binding_cycle(state, snap, pod, node_name, t0)
+
+    def _binding_cycle(self, state, snap, pod, node_name, t0) -> Optional[str]:
+        """PreBind -> Bind -> PostBind (+ extender binder precedence); failure
+        forgets the assumption and requeues — schedule_one.go's bindingCycle."""
+        st = self.framework.run_pre_bind(state, snap, pod, node_name)
+        if st.ok:
+            binder = next((e for e in self.extenders if e.cfg.bind_verb), None)
             if binder is not None:
                 # extender binder takes precedence (extender.go — IsBinder);
                 # the in-process store stands in for the apiserver the
@@ -300,6 +328,20 @@ class Scheduler:
         self.metrics.observe("scheduling_attempt_duration_seconds", time.perf_counter() - t0)
         self.metrics.inc("scheduling_attempts_scheduled")
         return node_name
+
+    def wait_for_bindings(self) -> None:
+        """Drain in-flight binding cycles (the reference's graceful shutdown
+        waits on the binding goroutines the same way)."""
+        if self._bind_pool is None:
+            return
+        while True:
+            with self._bind_lock:
+                pending = [f for f in self._bind_futures if not f.done()]
+                self._bind_futures = pending
+            if not pending:
+                return
+            for f in pending:
+                f.result()
 
     # --- the TPU batch cycle ---
     def schedule_batch(self) -> Dict[str, Optional[str]]:
@@ -367,6 +409,13 @@ class Scheduler:
                 result = {}
                 for pod in snap.pending_pods:
                     result[pod.name] = self.schedule_one(pod)
+                # async binding cycles may still fail and requeue: report
+                # the SETTLED placements, not the optimistic returns
+                if self._bind_pool is not None:
+                    self.wait_for_bindings()
+                    for pod in snap.pending_pods:
+                        cur = self.store.pods.get(pod.uid)
+                        result[pod.name] = (cur.node_name or None) if cur else None
                 return result
         if verdicts is None:
             base_cfg = self.config.score_config()
@@ -481,9 +530,16 @@ class Scheduler:
         for _ in range(max_cycles):
             if self.config.mode in ("tpu", "native"):
                 if not self.schedule_batch():
-                    return
+                    self.wait_for_bindings()  # sidecar-fallback cycles
+                    if not len(self.queue):
+                        return
             else:
                 pod = self.queue.pop()
                 if pod is None:
-                    return
+                    # a failed async bind may requeue a pod after the drain
+                    self.wait_for_bindings()
+                    pod = self.queue.pop()
+                    if pod is None:
+                        return
                 self.schedule_one(pod)
+        self.wait_for_bindings()

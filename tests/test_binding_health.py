@@ -1,0 +1,99 @@
+"""Async binding cycle (schedule_one.go's bindingCycle goroutine) and the
+component-base health/metrics HTTP endpoints."""
+
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.runtime.sidecar import HealthServer
+from kubernetes_tpu.scheduler.config import SchedulerConfiguration, validate
+from kubernetes_tpu.scheduler.metrics import Metrics
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.store import ClusterStore
+from helpers import mk_node, mk_pod
+
+
+def test_async_binding_places_all_pods():
+    store = ClusterStore()
+    for i in range(4):
+        store.add_node(mk_node(f"n{i}"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu", binding_workers=4))
+    for j in range(20):
+        store.add_pod(mk_pod(f"p{j}", cpu=200))
+    sched.run_until_idle(200)
+    sched.wait_for_bindings()
+    assert all(p.node_name for p in store.pods.values())
+    assert len(sched.events.by_reason("Scheduled")) == 20
+
+
+def test_async_binding_matches_sync_decisions():
+    """The assume cache makes the pipelined cycle decision-identical to the
+    synchronous one: same pods, same nodes -> same placements."""
+    def run(workers):
+        store = ClusterStore()
+        for i in range(3):
+            store.add_node(mk_node(f"n{i}", cpu=4000))
+        sched = Scheduler(store, SchedulerConfiguration(
+            mode="cpu", binding_workers=workers))
+        for j in range(9):
+            store.add_pod(mk_pod(f"p{j}", cpu=1100))
+        sched.run_until_idle(100)
+        sched.wait_for_bindings()
+        return {p.name: p.node_name for p in store.pods.values()}
+
+    assert run(0) == run(4)
+
+
+def test_async_bind_failure_requeues():
+    """A failing PreBind (missing PVC appears feasible? use volume binder
+    failure) forgets the assumption and requeues the pod."""
+    from kubernetes_tpu.api import cluster as c
+
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    # unbound claim with an unknown class: feasibility lets it through
+    # (pre-StorageClass legacy path) but PreBind cannot bind it
+    store.add_pvc(t.PersistentVolumeClaim(name="d", storage_class="ghost",
+                                          wait_for_first_consumer=True))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu", binding_workers=2))
+    store.add_pod(mk_pod("p", pvcs=("d",)))
+    sched.run_until_idle(5)
+    sched.wait_for_bindings()
+    assert store.pods["default/p"].node_name == ""
+    assert sched.cache.assumed == {}
+
+
+def test_binding_workers_validation():
+    assert any("bindingWorkers" in e for e in validate(
+        SchedulerConfiguration(binding_workers=-1)))
+
+
+def test_health_and_metrics_endpoints():
+    m = Metrics()
+    m.inc("scheduling_attempts_scheduled", 7)
+    m.observe("scheduling_attempt_duration_seconds", 0.01)
+    ready = {"ok": False}
+    hs = HealthServer(metrics=m, ready_check=lambda: ready["ok"])
+    port = hs.start()
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    import urllib.error
+
+    assert get("/healthz") == (200, "ok")
+    assert get("/livez") == (200, "ok")
+    assert get("/readyz")[0] == 503  # not ready yet
+    ready["ok"] = True
+    assert get("/readyz") == (200, "ok")
+    code, body = get("/metrics")
+    assert code == 200
+    assert "scheduling_attempts_scheduled 7" in body
+    assert 'quantile="0.99"' in body
+    hs.stop()

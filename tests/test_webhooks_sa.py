@@ -172,3 +172,43 @@ def test_controller_manager_wires_sa_tokens():
     ControllerManager(store, authenticator=authn).tick()
     sa = store.get_object("ServiceAccount", "default/default")
     assert authn.authenticate(sa.token).name == sa.username
+
+
+def test_sa_token_revoked_on_deletion_and_fresh_on_recreate():
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    ctrl = ServiceAccountController(store, authn)
+    ctrl.tick()
+    old = store.get_object("ServiceAccount", "default/default").token
+    assert authn.authenticate(old) is not None
+    store.delete_object("ServiceAccount", "default/default")
+    ctrl.tick()  # recreates default SA, revokes the old credential
+    assert authn.authenticate(old) is None
+    new = store.get_object("ServiceAccount", "default/default").token
+    assert new and new != old
+    assert authn.authenticate(new) is not None
+
+
+def test_missing_response_envelope_honors_failure_policy():
+    class NoEnvelope(BaseHTTPRequestHandler):
+        def do_POST(self):
+            d = json.dumps({"allowed": True}).encode()  # missing "response"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(d)))
+            self.end_headers()
+            self.wfile.write(d)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), NoEnvelope)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_port}/v"
+    api, _ = _api((WebhookConfig(url=url, kinds=("Pod",)),))
+    with pytest.raises(AdmissionDenied, match="malformed"):
+        api.handle("admin", "create", "Pod", t.Pod(name="p"))
+    api2, store2 = _api((WebhookConfig(url=url, kinds=("Pod",),
+                                       failure_policy="Ignore"),))
+    api2.handle("admin", "create", "Pod", t.Pod(name="p"))
+    assert "default/p" in store2.pods  # fail-open
+    srv.shutdown()
