@@ -692,11 +692,12 @@ class ServiceAccountController:
 
     def tick(self) -> None:
         c = self._c
-        # revocation FIRST, against last tick's state: an SA that vanished
-        # loses its credential even if the ensure pass recreates the name
-        # (the recreated SA gets a fresh token below)
+        # revocation FIRST, against last tick's state: an SA that vanished —
+        # or was deleted AND recreated between ticks (its live token field no
+        # longer matches the minted credential) — loses the old credential
         for key, token in list(self._minted.items()):
-            if self.store.get_object("ServiceAccount", key) is None:
+            cur = self.store.get_object("ServiceAccount", key)
+            if cur is None or cur.token != token:
                 if self.authn is not None:
                     self.authn.remove_token(token)
                 del self._minted[key]

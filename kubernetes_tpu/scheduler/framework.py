@@ -35,6 +35,9 @@ ERROR = "Error"
 class Status:
     code: str = SUCCESS
     reasons: Tuple[str, ...] = ()
+    # the plugin that produced a failing status (framework.go stamps this via
+    # Status.WithPlugin; the queue derives QueueingHint events from it)
+    plugin: str = ""
 
     @property
     def ok(self) -> bool:
@@ -130,11 +133,30 @@ class Framework:
     def run_filters(
         self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo
     ) -> Status:
+        from dataclasses import replace as _replace
+
         for pw in self._at("Filter"):
             st = pw.plugin.Filter(state, snap, pod, info)
             if not st.ok:
-                return st
+                return st if st.plugin else _replace(st, plugin=pw.plugin.name)
         return Status()
+
+    def events_for_plugins(self, plugin_names) -> set:
+        """Union of the named plugins' EventsToRegister — the cluster events
+        that could make a pod they rejected schedulable (QueueingHint's
+        registration half).  Unknown plugins contribute the wildcard."""
+        from ..scheduler.queue import EV_ALL
+
+        out: set = set()
+        by_name = {pw.plugin.name: pw.plugin for pw in self.plugins}
+        for name in plugin_names:
+            plugin = by_name.get(name)
+            evs = getattr(plugin, "EventsToRegister", None)
+            if plugin is None or evs is None:
+                out.add(EV_ALL)
+            else:
+                out.update(evs())
+        return out or {EV_ALL}
 
     def run_post_filters(
         self, state: CycleState, snap: Snapshot, pod: t.Pod, statuses: Dict[str, Status]

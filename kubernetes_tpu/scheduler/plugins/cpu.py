@@ -28,6 +28,7 @@ from ..framework import (
     PluginWeight,
     Status,
 )
+from ..queue import EV_NODE_ADD, EV_NODE_UPDATE, EV_POD_ADD, EV_POD_DELETE
 
 f32 = np.float32
 
@@ -58,6 +59,11 @@ class TaintToleration(Plugin):
 
     name = "TaintToleration"
 
+    _EVENTS = (EV_NODE_ADD, EV_NODE_UPDATE)
+
+    def EventsToRegister(self):
+        return self._EVENTS
+
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         if not oref._tolerates_all(pod, oref._node_taints(info.node)):
             return Status.unschedulable("node taint not tolerated")
@@ -80,6 +86,11 @@ class NodeAffinity(Plugin):
 
     name = "NodeAffinity"
 
+    _EVENTS = (EV_NODE_ADD, EV_NODE_UPDATE)
+
+    def EventsToRegister(self):
+        return self._EVENTS
+
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         if not oref._node_selection_ok(pod, info.node):
             return Status.unschedulable("node(s) didn't match Pod's node affinity/selector")
@@ -98,6 +109,11 @@ class NodeName(Plugin):
 
     name = "NodeName"
 
+    _EVENTS = (EV_NODE_ADD,)
+
+    def EventsToRegister(self):
+        return self._EVENTS
+
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         if pod.node_name and pod.node_name != info.node.name:
             return Status.unschedulable("node didn't match the requested node name")
@@ -108,6 +124,11 @@ class NodePorts(Plugin):
     """nodeports/node_ports.go — Filter."""
 
     name = "NodePorts"
+
+    _EVENTS = (EV_NODE_ADD, EV_POD_DELETE)
+
+    def EventsToRegister(self):
+        return self._EVENTS
 
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         if oref._ports_conflict(pod, info.pods):
@@ -121,6 +142,11 @@ class NodeResourcesFit(Plugin):
     (LeastAllocated strategy)."""
 
     name = "NodeResourcesFit"
+
+    _EVENTS = (EV_NODE_ADD, EV_NODE_UPDATE, EV_POD_DELETE)
+
+    def EventsToRegister(self):
+        return self._EVENTS
 
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         sc = state.data["scaled"]
@@ -155,6 +181,11 @@ class PodTopologySpread(Plugin):
     """podtopologyspread/{filtering,scoring}.go — Filter skew check + Score."""
 
     name = "PodTopologySpread"
+
+    _EVENTS = (EV_NODE_ADD, EV_POD_ADD, EV_POD_DELETE)
+
+    def EventsToRegister(self):
+        return self._EVENTS
 
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         sc = state.data["scaled"]
@@ -199,6 +230,11 @@ class InterPodAffinity(Plugin):
     terms, both directions, min/max-normalized)."""
 
     name = "InterPodAffinity"
+
+    _EVENTS = (EV_NODE_ADD, EV_POD_ADD, EV_POD_DELETE)
+
+    def EventsToRegister(self):
+        return self._EVENTS
 
     def __init__(self, hard_pod_affinity_weight: float = 1.0):
         self.hard_pod_affinity_weight = hard_pod_affinity_weight

@@ -94,6 +94,18 @@ class PriorityQueue:
         # after a re-add, so draining by count pairs them correctly)
         self._gone: Dict[str, int] = {}
         self._in_backoff: Dict[str, int] = {}  # uid -> live backoff entries
+        self._parked_at: Dict[str, float] = {}  # uid -> when parked unschedulable
+        # gate-parked pods (backoff=False, e.g. SchedulingGates) wait for
+        # their re-add event only — the leftover flush must NOT resurrect
+        # them past PreEnqueue
+        self._no_flush: Set[str] = set()
+        # bumps on every move_all_to_active_or_backoff: schedulers compare
+        # against their cycle-start value to detect a move that fired while
+        # the cycle ran (the reference's moveRequestCycle guard)
+        self.move_seq = 0
+        # flushUnschedulablePodsLeftover: parked pods whose events never fire
+        # retry anyway after this long (podMaxInUnschedulablePodsDuration, 5m)
+        self.max_unschedulable_s = 300.0
 
     @_locked
     def __len__(self) -> int:
@@ -114,11 +126,29 @@ class PriorityQueue:
     def add(self, pod: t.Pod) -> None:
         if pod.uid in self._active_uids:
             return
+        # a re-added pod supersedes any parked copy (AddUnschedulableIfNotPresent
+        # dedupe — without this the leftover flush could resurrect a stale copy)
+        self._unschedulable.pop(pod.uid, None)
+        self._parked_at.pop(pod.uid, None)
+        self._no_flush.discard(pod.uid)
         heapq.heappush(self._active, _Item(self._key(pod), pod))
         self._active_uids.add(pod.uid)
 
     def _flush_backoff(self) -> None:
         now = self.clock.now()
+        # flushUnschedulablePodsLeftover: event-parked pods retry eventually
+        # even if their registered events never fire
+        for uid, since in list(self._parked_at.items()):
+            if uid not in self._unschedulable:
+                del self._parked_at[uid]
+            elif uid in self._no_flush:
+                continue  # gated: only its registered event may move it
+            elif now - since >= self.max_unschedulable_s:
+                pod, _ = self._unschedulable.pop(uid)
+                del self._parked_at[uid]
+                ready = now + self.backoff_duration(uid)
+                heapq.heappush(self._backoff, (ready, next(self._seq), pod))
+                self._in_backoff[uid] = self._in_backoff.get(uid, 0) + 1
         while self._backoff and self._backoff[0][0] <= now:
             _, _, pod = heapq.heappop(self._backoff)
             left = self._in_backoff.get(pod.uid, 1) - 1
@@ -156,23 +186,34 @@ class PriorityQueue:
     @_locked
     def add_unschedulable(self, pod: t.Pod, events: Optional[Set[str]] = None,
                           backoff: bool = True) -> None:
-        """AddUnschedulableIfNotPresent: failed pods wait for a wake event; with
-        backoff=True they first sit out their backoff window."""
-        if backoff:
+        """AddUnschedulableIfNotPresent.  With SPECIFIC events (QueueingHint
+        registrations from the failing plugins) the pod parks in
+        unschedulablePods until a matching cluster event moves it (through
+        backoff) or the leftover flush expires; without them (or with only
+        the wildcard) it takes the plain backoff retry path."""
+        if events and EV_ALL not in events and backoff:
+            self._unschedulable[pod.uid] = (pod, set(events))
+            self._parked_at[pod.uid] = self.clock.now()
+        elif backoff:
             ready = self.clock.now() + self.backoff_duration(pod.uid)
             heapq.heappush(self._backoff, (ready, next(self._seq), pod))
             self._in_backoff[pod.uid] = self._in_backoff.get(pod.uid, 0) + 1
         else:
             self._unschedulable[pod.uid] = (pod, events or {EV_ALL})
+            self._parked_at[pod.uid] = self.clock.now()
+            self._no_flush.add(pod.uid)
 
     @_locked
     def move_all_to_active_or_backoff(self, event: str) -> int:
         """MoveAllToActiveOrBackoffQueue on a cluster event; returns #moved."""
+        self.move_seq += 1
         moved = []
         for uid, (pod, events) in list(self._unschedulable.items()):
             if EV_ALL in events or event in events:
                 moved.append(uid)
                 del self._unschedulable[uid]
+                self._parked_at.pop(uid, None)
+                self._no_flush.discard(uid)
                 ready = self.clock.now() + self.backoff_duration(uid)
                 heapq.heappush(self._backoff, (ready, next(self._seq), pod))
                 self._in_backoff[uid] = self._in_backoff.get(uid, 0) + 1
@@ -182,6 +223,8 @@ class PriorityQueue:
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)
         self._unschedulable.pop(pod_uid, None)
+        self._parked_at.pop(pod_uid, None)
+        self._no_flush.discard(pod_uid)
         self._nominated.pop(pod_uid, None)
         if self._in_backoff.get(pod_uid):
             # every entry currently in backoff predates this delete: all stale

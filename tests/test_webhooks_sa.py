@@ -212,3 +212,20 @@ def test_missing_response_envelope_honors_failure_policy():
     api2.handle("admin", "create", "Pod", t.Pod(name="p"))
     assert "default/p" in store2.pods  # fail-open
     srv.shutdown()
+
+
+def test_sa_recreated_between_ticks_revokes_old_token():
+    """Delete + recreate in ONE controller interval: the predecessor's
+    credential must still be revoked (identity checked by live token, not
+    name presence)."""
+    store = ClusterStore()
+    authn = TokenAuthenticator()
+    ctrl = ServiceAccountController(store, authn)
+    ctrl.tick()
+    old = store.get_object("ServiceAccount", "default/default").token
+    store.delete_object("ServiceAccount", "default/default")
+    store.add_object("ServiceAccount", c.ServiceAccount(name="default"))
+    ctrl.tick()
+    assert authn.authenticate(old) is None
+    new = store.get_object("ServiceAccount", "default/default").token
+    assert new != old and authn.authenticate(new) is not None
