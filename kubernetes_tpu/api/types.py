@@ -254,8 +254,13 @@ class Node:
     name: str
     allocatable: ResourceList = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
     taints: Tuple[Taint, ...] = ()
     unschedulable: bool = False  # spec.unschedulable
+    # spec.podCIDR — assigned by the NodeIPAM controller; the kubelet carves
+    # pod IPs from it ("" = not yet assigned, kubelet falls back to a
+    # process-local registry)
+    pod_cidr: str = ""
     # image name -> size bytes present on the node (NodeStatus.Images;
     # ImageLocality's input)
     images: Dict[str, int] = field(default_factory=dict)
@@ -298,6 +303,7 @@ class Pod:
     namespace: str = "default"
     requests: ResourceList = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
     node_name: str = ""  # spec.nodeName: "" = pending; set = bound/running
     priority_class_name: str = ""  # resolved to `priority` by Priority admission
     pod_ip: str = ""  # status.podIP, assigned by the kubelet when Running

@@ -674,6 +674,36 @@ class TTLAfterFinishedController:
                 self.store.delete_object("Job", job.key)
 
 
+class NodeIPAMController:
+    """pkg/controller/nodeipam (range_allocator.go): assign each node a
+    disjoint /24 from the cluster CIDR as spec.podCIDR; freed ranges are
+    reused lowest-first when nodes go away."""
+
+    def __init__(self, store: ClusterStore, cluster_prefix: str = "10.128"):
+        self.store = store
+        self.cluster_prefix = cluster_prefix  # /16 carved into /24s
+
+    def tick(self) -> None:
+        used = set()
+        for nd in self.store.nodes.values():
+            if nd.pod_cidr.startswith(self.cluster_prefix + "."):
+                try:
+                    used.add(int(nd.pod_cidr.split(".")[2]))
+                except (IndexError, ValueError):
+                    pass
+        free = (i for i in range(256) if i not in used)
+        for nd in sorted(self.store.nodes.values(), key=lambda n: n.name):
+            if nd.pod_cidr:
+                continue
+            idx = next(free, None)
+            if idx is None:
+                return  # cluster CIDR exhausted
+            q = copy_module.copy(nd)
+            q.pod_cidr = f"{self.cluster_prefix}.{idx}.0/24"
+            self.store.update_node(q)
+            used.add(idx)
+
+
 class ServiceAccountController:
     """pkg/controller/serviceaccount — serviceaccounts_controller (ensure the
     "default" ServiceAccount exists in every active namespace) fused with the
@@ -746,6 +776,7 @@ class ControllerManager:
         from .network import EndpointSliceController
 
         self.store = store
+        self.nodeipam = NodeIPAMController(store)
         self.serviceaccounts = ServiceAccountController(store, authenticator)
         self.deployments = DeploymentController(store)
         self.replicasets = ReplicaSetController(store)
@@ -761,6 +792,7 @@ class ControllerManager:
         self.gc = GarbageCollector(store)
 
     def tick(self) -> None:
+        self.nodeipam.tick()
         self.serviceaccounts.tick()
         self.hpa.tick()
         self.deployments.tick()

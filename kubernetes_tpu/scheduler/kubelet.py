@@ -138,10 +138,18 @@ class HollowKubelet:
 
     def _alloc_ip(self) -> str:
         """Lowest free host address in this node's /24 — collision-free
-        across nodes (disjoint subnets from the nodeipam-style index) and
-        within the node (scan live pods; max ~110 pods/node keeps this O(n))."""
-        n = self._cidr_index
-        prefix = f"10.{128 + (n >> 8 & 0x7F)}.{n & 0xFF}"  # avoids 10.96/16 VIPs
+        across nodes (disjoint subnets) and within the node (scan live pods;
+        max ~110 pods/node keeps this O(n)).  The subnet is the node's
+        spec.podCIDR when the NodeIPAM controller assigned one; otherwise
+        the process-local registry index."""
+        node = self.store.nodes.get(self.node_name)
+        if node is not None and node.pod_cidr:
+            prefix = node.pod_cidr.rsplit(".", 1)[0]  # "10.128.3.0/24" -> 10.128.3
+        else:
+            # 10.192/12 block: disjoint from NodeIPAM's 10.128/16 and the
+            # 10.96/16 service VIP range
+            n = self._cidr_index
+            prefix = f"10.{192 + (n >> 8 & 0x3F)}.{n & 0xFF}"
         in_use = {
             int(p.pod_ip.rsplit(".", 1)[1])
             for p in self.store.pods.values()

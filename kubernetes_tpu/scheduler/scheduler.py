@@ -61,6 +61,7 @@ class Scheduler:
         store: ClusterStore,
         config: SchedulerConfiguration = SchedulerConfiguration(),
         clock: Optional[Clock] = None,
+        logger=None,
     ):
         self.store = store
         self.config = config
@@ -69,6 +70,11 @@ class Scheduler:
         self.queue = PriorityQueue(clock)
         self.metrics = Metrics()
         self.events = EventRecorder(store=store)
+        from .klog import Logger
+
+        # contextual logger (klog.LoggerWithValues shape); callers may pass
+        # their own configured backend
+        self.log = (logger or Logger()).with_values(component="scheduler")
         from .extender import HTTPExtender
 
         self.extenders = [HTTPExtender(e) for e in config.extenders]
@@ -272,6 +278,9 @@ class Scheduler:
                 "FailedScheduling", pod.uid,
                 message=f"0/{len(infos)} nodes available" + (f"; preemption nominated {nominated}" if pst.ok else ""),
             )
+            self.log.V(2).info("Unable to schedule pod", pod=pod.uid,
+                               nodes=len(infos), failed=len(statuses),
+                               nominated=nominated if pst.ok else "")
             if pst.ok and nominated:
                 self.events.record("Preempted", pod.uid, node=nominated)
                 self._nominate(pod, nominated)
@@ -360,8 +369,11 @@ class Scheduler:
         self.framework.run_post_bind(state, snap, pod, node_name)
         self.queue.delete_nominated(pod.uid)
         self.events.record("Scheduled", pod.uid, node=node_name)
-        self.metrics.observe("scheduling_attempt_duration_seconds", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.metrics.observe("scheduling_attempt_duration_seconds", dt)
         self.metrics.inc("scheduling_attempts_scheduled")
+        self.log.V(3).info("Scheduled pod", pod=pod.uid, node=node_name,
+                           latency_ms=round(dt * 1e3, 2))
         return node_name
 
     def wait_for_bindings(self) -> None:
@@ -529,6 +541,10 @@ class Scheduler:
                     self._clear_nomination(pod)
             self.queue.add_unschedulable(pod, backoff=True)
         dt = time.perf_counter() - t0
+        self.log.V(2).info("Batch scheduled", batch=len(batch),
+                           scheduled=len(batch) - len(failed),
+                           unschedulable=len(failed),
+                           duration_ms=round(dt * 1e3, 1))
         self.metrics.observe("batch_scheduling_duration_seconds", dt)
         self.metrics.inc("scheduling_attempts_scheduled", len(batch) - len(failed))
         self.metrics.inc("scheduling_attempts_unschedulable", len(failed))
