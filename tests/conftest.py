@@ -4,19 +4,23 @@ Mirrors the reference's determinism-first test posture (SURVEY.md §5 race
 detection: CPU sim mode for deterministic tests); sharding tests get a real
 8-device mesh without TPU hardware.
 
-Note: this machine's sitecustomize registers the axon TPU PJRT plugin and
-overwrites jax.config.jax_platforms at interpreter start, so setting the
-JAX_PLATFORMS env var is not enough — the config must be re-overridden after
-jax import (before any backend initialization).
+The sitecustomize workaround (env vars + post-import jax.config.update) lives
+in __graft_entry__.force_cpu_platform, shared with the driver's multi-chip
+dry run.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
+    "CPU sim platform not active — jax backend was initialized before "
+    f"conftest ran (platform={jax.devices()[0].platform}, n={len(jax.devices())})"
+)
