@@ -86,7 +86,10 @@ class Metrics:
         }
 
     def observe(self, name: str, v: float) -> None:
-        self.hists[name].observe(v)
+        # called from binding-cycle worker threads: the defaultdict __missing__
+        # + sample append must be serialized like inc/set
+        with self._lock:
+            self.hists[name].observe(v)
         p = self._prom.get(name)
         if p is not None:
             p.observe(v)

@@ -185,12 +185,21 @@ class PriorityQueue:
 
     @_locked
     def add_unschedulable(self, pod: t.Pod, events: Optional[Set[str]] = None,
-                          backoff: bool = True) -> None:
+                          backoff: bool = True,
+                          cycle_move_seq: Optional[int] = None) -> None:
         """AddUnschedulableIfNotPresent.  With SPECIFIC events (QueueingHint
         registrations from the failing plugins) the pod parks in
         unschedulablePods until a matching cluster event moves it (through
         backoff) or the leftover flush expires; without them (or with only
-        the wildcard) it takes the plain backoff retry path."""
+        the wildcard) it takes the plain backoff retry path.
+
+        cycle_move_seq is the caller's cycle-start move_seq: compared against
+        the live value HERE, under the queue lock (the reference's
+        moveRequestCycle guard inside AddUnschedulableIfNotPresent) — a move
+        that fired during the cycle means the pod's wake event may already be
+        gone, so it takes the plain backoff path instead of parking."""
+        if cycle_move_seq is not None and self.move_seq != cycle_move_seq:
+            events = None
         if events and EV_ALL not in events and backoff:
             self._unschedulable[pod.uid] = (pod, set(events))
             self._parked_at[pod.uid] = self.clock.now()

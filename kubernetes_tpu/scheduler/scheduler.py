@@ -297,12 +297,13 @@ class Scheduler:
             failing = {s.plugin for s in statuses.values() if s.plugin}
             hint_events = (
                 self.framework.events_for_plugins(failing)
-                if failing
-                and not (pst.ok and nominated)
-                and self.queue.move_seq == cycle_move_seq
+                if failing and not (pst.ok and nominated)
                 else None
             )
-            self.queue.add_unschedulable(pod, hint_events, backoff=True)
+            # move_seq compared inside add_unschedulable, under the queue lock
+            self.queue.add_unschedulable(
+                pod, hint_events, backoff=True, cycle_move_seq=cycle_move_seq
+            )
             self.metrics.inc("scheduling_attempts_unschedulable")
             return None
         chosen = [infos[i] for i in feasible]
@@ -575,12 +576,31 @@ class Scheduler:
             self.store.update_pod_status(q)
 
     # --- driver ---
-    def run_until_idle(self, max_cycles: int = 100) -> None:
-        """Schedule until the activeQ drains (backoff/unschedulable pods wait
-        for their clock/events — the test harness advances a FakeClock)."""
-        for _ in range(max_cycles):
+    def run_until_idle(self, max_cycles: Optional[int] = None,
+                       stall_limit: int = 1000) -> None:
+        """Schedule until the activeQ drains to a fixpoint (backoff and
+        unschedulable pods wait for their clock/events — the test harness
+        advances a FakeClock).
+
+        With max_cycles=None (the default) this drains completely and raises
+        RuntimeError if stall_limit consecutive cycles make no scheduling
+        progress while the queue stays non-empty (event ping-pong livelock)
+        — it never truncates silently.  An explicit max_cycles bounds the
+        work and returns possibly-non-idle (soak tests drive incremental
+        cycles this way on purpose)."""
+        cycles = 0
+        stall = 0
+        while max_cycles is None or cycles < max_cycles:
+            cycles += 1
+            # progress = a pod bound, or the activeQ net-shrank (a popped pod
+            # parked in backoff/unschedulable is normal quiescing, not
+            # livelock — only an event source that immediately re-activates
+            # failing pods keeps the length flat)
+            q_before = len(self.queue)
             if self.config.mode in ("tpu", "native"):
-                if not self.schedule_batch():
+                result = self.schedule_batch()
+                scheduled = any(v is not None for v in result.values())
+                if not result:
                     self.wait_for_bindings()  # sidecar-fallback cycles
                     if not len(self.queue):
                         return
@@ -592,5 +612,13 @@ class Scheduler:
                     pod = self.queue.pop()
                     if pod is None:
                         return
-                self.schedule_one(pod)
+                scheduled = self.schedule_one(pod) is not None
+            stall = 0 if scheduled or len(self.queue) < q_before else stall + 1
+            if max_cycles is None and stall >= stall_limit:
+                self.wait_for_bindings()
+                raise RuntimeError(
+                    f"run_until_idle: no scheduling progress after {stall} "
+                    f"consecutive cycles with {self.queue.pending_total} pods "
+                    "still pending (non-quiescent workload)"
+                )
         self.wait_for_bindings()

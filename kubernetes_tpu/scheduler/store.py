@@ -90,6 +90,14 @@ class ClusterStore:
         self.objects: Dict[str, Dict[str, object]] = {k: {} for k in BUILTIN_KINDS}
         self._watchers: List[Callable[[Event], None]] = []
 
+    def transaction(self):
+        """The store's re-entrant lock, for callers performing multi-object
+        read-modify-write sequences (e.g. volume binding's match-then-commit).
+        The reference relies on apiserver optimistic concurrency
+        (resourceVersion conflict on racing writers); this in-process analog
+        serializes the sequence instead."""
+        return self._lock
+
     # --- CRD mechanism ---
     def register_kind(self, kind: str) -> None:
         """Create a new object table at runtime — the CustomResourceDefinition

@@ -36,7 +36,19 @@ def _matches_node(topology: tuple, node: t.Node) -> bool:
 
 def bind_pod_volumes(store: ClusterStore, pod: t.Pod, node_name: str) -> Optional[str]:
     """Bind every unbound claim of `pod` for placement on `node_name`.
-    Returns an error string (PreBind failure → pod requeues) or None."""
+    Returns an error string (PreBind failure → pod requeues) or None.
+
+    Runs under the store's transaction lock: concurrent binding workers
+    (binding_workers > 0) must not both match the same unbound PV — the
+    find-then-write sequence here is check-and-commit, and the in-process
+    store has no resourceVersion conflict to catch the race."""
+    with store.transaction():
+        return _bind_pod_volumes_locked(store, pod, node_name)
+
+
+def _bind_pod_volumes_locked(
+    store: ClusterStore, pod: t.Pod, node_name: str
+) -> Optional[str]:
     node = store.nodes.get(node_name)
     if node is None:
         return f"node {node_name!r} vanished before volume binding"

@@ -116,11 +116,20 @@ def test_async_binding_exception_requeues_instead_of_stranding():
 
     sched.framework.run_pre_bind = exploding
     store.add_pod(mk_pod("p"))
-    sched.run_until_idle(50)
-    sched.wait_for_bindings()
+    # the injected failure requeues the pod through backoff (~1 s): drive
+    # cycles until the retry lands or the deadline proves it stranded
+    import time
+
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        sched.run_until_idle(50)
+        sched.wait_for_bindings()
+        if store.pods["default/p"].node_name:
+            break
+        time.sleep(0.05)
+    assert boom["count"] == 1  # the failure was actually injected
+    assert store.pods["default/p"].node_name == "n0"  # retry succeeded
     assert sched.cache.assumed == {}  # no phantom capacity
-    # the retry (after the one injected failure) succeeded
-    assert store.pods["default/p"].node_name == "n0" or len(sched.queue) >= 0
 
 
 def test_gated_pod_never_flushed_past_preenqueue():

@@ -9,7 +9,7 @@ from kubernetes_tpu.api import types as t
 from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
 from kubernetes_tpu.scheduler.config import Profile, PluginSpec, from_yaml, validate
 from kubernetes_tpu.scheduler.queue import FakeClock
-from helpers import mk_node, mk_pod
+from helpers import GI, MILLI, mk_node, mk_pod
 
 
 def mk_cluster(mode="tpu", nodes=(), clock=None, config=None):
@@ -209,3 +209,64 @@ def test_parked_pod_flushes_after_leftover_timeout():
     clock.step(30.0)
     pod = sched.queue.pop()
     assert pod is not None and pod.name == "big"
+
+
+def test_run_until_idle_drains_past_100_cycles():
+    """Regression: the old max_cycles=100 default silently returned with pods
+    still queued; the fixpoint default must drain a 150-pod workload (CPU mode
+    schedules one pod per cycle)."""
+    store, sched = mk_cluster(
+        "cpu", nodes=[mk_node("n0", cpu=200 * MILLI, mem=64 * GI, pods=200)]
+    )
+    for i in range(150):
+        store.add_pod(mk_pod(f"p{i}", cpu=10, mem=1024**2))
+    sched.run_until_idle()
+    got = bound_map(store)
+    assert sum(1 for v in got.values() if v == "n0") == 150
+    assert sched.queue.pending_total == 0
+
+
+def test_run_until_idle_raises_on_livelock():
+    """A workload that never quiesces (every cycle pops a pod that fails and
+    is immediately re-activated) must raise, not truncate silently."""
+    store, sched = mk_cluster("cpu", nodes=[mk_node("n0", pods=0)])
+    store.add_pod(mk_pod("p"))
+
+    orig = sched.queue.add_unschedulable
+
+    def ping_pong(pod, events=None, backoff=True, cycle_move_seq=None):
+        orig(pod, events, backoff, cycle_move_seq)
+        sched.queue.add(pod)  # a pathological event source re-activates it
+
+    sched.queue.add_unschedulable = ping_pong
+    with pytest.raises(RuntimeError, match="no scheduling progress"):
+        sched.run_until_idle(stall_limit=50)
+
+
+def test_run_until_idle_drains_large_unschedulable_backlog():
+    """A big backlog of legitimately-unschedulable pods is normal quiescing
+    (each cycle parks one pod), not livelock — must drain without raising."""
+    store, sched = mk_cluster("cpu", nodes=[mk_node("n0", pods=0)])
+    for i in range(60):
+        store.add_pod(mk_pod(f"u{i}"))
+    sched.run_until_idle(stall_limit=10)
+    assert len(sched.queue) == 0
+    assert all(v is None for v in bound_map(store).values())
+
+
+def test_run_until_idle_raises_on_tpu_mode_livelock():
+    """The batch path returns a verdict-per-pod dict even when every verdict
+    is None; an all-failed batch whose pods are instantly re-activated must
+    trip the stall guard, not loop forever."""
+    store, sched = mk_cluster("tpu", nodes=[mk_node("n0", pods=0)])
+    store.add_pod(mk_pod("p"))
+
+    orig = sched.queue.add_unschedulable
+
+    def ping_pong(pod, events=None, backoff=True, cycle_move_seq=None):
+        orig(pod, events, backoff, cycle_move_seq)
+        sched.queue.add(pod)
+
+    sched.queue.add_unschedulable = ping_pong
+    with pytest.raises(RuntimeError, match="no scheduling progress"):
+        sched.run_until_idle(stall_limit=10)
