@@ -123,14 +123,24 @@ def _match_matrix(terms: List[TermKey], pods: Sequence[t.Pod]) -> np.ndarray:
 
 def build_pairwise(
     nodes: Sequence[t.Node],
-    pending: Sequence[t.Pod],  # already in activeQ order
+    pending: Sequence[t.Pod],  # unique specs in first-occurrence activeQ order
     bound: Sequence[t.Pod],
     node_index: Dict[str, int],
     N: int,
     P: int,
     hard_pod_affinity_weight: float = 1.0,
+    pending_inv: Optional[np.ndarray] = None,
 ):
-    """Returns (PairwiseVocab, dict of arrays) — see ClusterArrays for shapes."""
+    """Returns (PairwiseVocab, dict of arrays) — see ClusterArrays for shapes.
+
+    `pending` holds the UNIQUE pending-pod specs (snapshot.group_by_spec) and
+    `pending_inv[i]` each sorted pod's spec index: per-spec term collection and
+    the match matmul run over U specs, and rows scatter to the P pod axis.
+    Omitting pending_inv treats `pending` as the literal per-pod list."""
+    if pending_inv is None:
+        pending_inv = np.arange(len(pending), dtype=np.int64)
+    inv = pending_inv
+    p = len(inv)
     voc = PairwiseVocab(v.Interner(), v.Interner(), v.Interner(), v.Interner())
 
     # ---- collect terms from every pending AND bound pod (bound pods' anti
@@ -167,9 +177,28 @@ def build_pairwise(
         pod_anti.append(anti_ids)
         pod_pref.append(pref_ids)
         pod_spread.append(spread_ids)
+
+    # bound pods intern by (labels, namespace, affinity): term collection and
+    # the bound-side match matmul run once per unique spec
+    b_ids: Dict[Tuple, int] = {}
+    b_reps: List[t.Pod] = []
+    b_inv: List[int] = []
+    b_nodes: List[int] = []
+    for q in bound:
+        ni = node_index.get(q.node_name)
+        if ni is None:
+            continue
+        key = (tuple(sorted(q.labels.items())), q.namespace, q.affinity)
+        u = b_ids.get(key)
+        if u is None:
+            u = len(b_reps)
+            b_ids[key] = u
+            b_reps.append(q)
+        b_inv.append(u)
+        b_nodes.append(ni)
     bound_anti: List[List[int]] = []
     bound_pref: List[List[Tuple[int, float]]] = []
-    for pod in bound:
+    for pod in b_reps:
         ids = []
         pref_ids = []
         if pod.affinity:
@@ -217,40 +246,67 @@ def build_pairwise(
     for ti, term in enumerate(voc.terms.items):
         term_key[ti] = voc.topo_keys.get(term.topology_key)
 
-    # ---- host-side match matrices: vectorized AnyOf/NoneOf matmuls ----
+    # ---- host-side match matrices: vectorized AnyOf/NoneOf matmuls over
+    # unique specs, gathered per pod ----
     terms_list = list(voc.terms.items)
-    m_real = _match_matrix(terms_list, pending)  # [T, p]
     m_pend = np.zeros((T, P), dtype=np.float32)
-    m_pend[: m_real.shape[0], : len(pending)] = m_real[:, : len(pending)]
-    placed = [(q, node_index[q.node_name]) for q in bound if q.node_name in node_index]
+    if p:
+        m_uniq = _match_matrix(terms_list, pending)  # [T, U]
+        m_pend[: m_uniq.shape[0], :p] = m_uniq[:, inv]
+    bnodes = np.array(b_nodes, dtype=np.int64)
+    binv = np.array(b_inv, dtype=np.int64)
     term_counts0 = np.zeros((T, D + 1), dtype=np.float32)
-    if placed and terms_list:
-        m_bound = _match_matrix(terms_list, [q for q, _ in placed])  # [T, Q]
-        bnodes = np.array([ni for _, ni in placed], dtype=np.int64)
+    if len(bnodes) and terms_list:
+        m_bound_u = _match_matrix(terms_list, b_reps)  # [T, Ub]
         for ti in range(len(terms_list)):
-            np.add.at(term_counts0[ti], node_dom[term_key[ti], bnodes], m_bound[ti])
+            np.add.at(
+                term_counts0[ti], node_dom[term_key[ti], bnodes], m_bound_u[ti, binv]
+            )
+    # group bound pods by unique spec once (argsort) so the anti/pref scatters
+    # touch only specs that own terms
     anti_counts0 = np.zeros((T, D + 1), dtype=np.float32)
-    for pod, ids in zip(bound, bound_anti):
-        ni = node_index.get(pod.node_name)
-        if ni is None:
-            continue
-        for ti in ids:
-            anti_counts0[ti, node_dom[term_key[ti], ni]] += 1.0
-    # weight-weighted counts of existing pods OWNING preferred terms, per their
-    # domain (the symmetric half of preferred inter-pod affinity scoring)
     pref_own0 = np.zeros((T, D + 1), dtype=np.float32)
-    for pod, prefs in zip(bound, bound_pref):
-        ni = node_index.get(pod.node_name)
-        if ni is None:
-            continue
-        for ti, w in prefs:
-            pref_own0[ti, node_dom[term_key[ti], ni]] += np.float32(w)
+    if len(bnodes):
+        order = np.argsort(binv, kind="stable")
+        starts = np.searchsorted(binv[order], np.arange(len(b_reps) + 1))
+        for u in range(len(b_reps)):
+            ids = bound_anti[u]
+            prefs = bound_pref[u]
+            if not ids and not prefs:
+                continue
+            rows = bnodes[order[starts[u] : starts[u + 1]]]
+            for ti in ids:
+                np.add.at(anti_counts0[ti], node_dom[term_key[ti], rows], 1.0)
+            # weight-weighted counts of existing pods OWNING preferred terms,
+            # per their domain (the symmetric half of preferred scoring)
+            for ti, w in prefs:
+                np.add.at(pref_own0[ti], node_dom[term_key[ti], rows], np.float32(w))
 
-    # ---- per-pod term id arrays (padded) ----
+    # ---- per-pod term id arrays (padded; built per spec, gathered) ----
     A1 = max(1, max((len(x) for x in pod_aff), default=1))
     A2 = max(1, max((len(x) for x in pod_anti), default=1))
     B = max(1, max((len(x) for x in pod_pref), default=1))
     C = max(1, max((len(x) for x in pod_spread), default=1))
+    Uq = max(1, len(pending))
+    u_aff = np.full((Uq, A1), -1, dtype=np.int32)
+    u_anti = np.full((Uq, A2), -1, dtype=np.int32)
+    u_pref_t = np.full((Uq, B), -1, dtype=np.int32)
+    u_pref_w = np.zeros((Uq, B), dtype=np.float32)
+    u_spread_t = np.full((Uq, C), -1, dtype=np.int32)
+    u_spread_skew = np.zeros((Uq, C), dtype=np.int32)
+    u_spread_hard = np.zeros((Uq, C), dtype=bool)
+    for ui in range(len(pending)):
+        for a, ti in enumerate(pod_aff[ui]):
+            u_aff[ui, a] = ti
+        for a, ti in enumerate(pod_anti[ui]):
+            u_anti[ui, a] = ti
+        for a, (ti, w) in enumerate(pod_pref[ui]):
+            u_pref_t[ui, a] = ti
+            u_pref_w[ui, a] = np.float32(w)
+        for c, (ti, skew, mode) in enumerate(pod_spread[ui]):
+            u_spread_t[ui, c] = ti
+            u_spread_skew[ui, c] = skew
+            u_spread_hard[ui, c] = mode == HARD
     pod_aff_terms = np.full((P, A1), -1, dtype=np.int32)
     pod_anti_terms = np.full((P, A2), -1, dtype=np.int32)
     pod_pref_aff_terms = np.full((P, B), -1, dtype=np.int32)
@@ -258,28 +314,30 @@ def build_pairwise(
     pod_spread_terms = np.full((P, C), -1, dtype=np.int32)
     pod_spread_maxskew = np.zeros((P, C), dtype=np.int32)
     pod_spread_hard = np.zeros((P, C), dtype=bool)
-    for pi in range(len(pending)):
-        for a, ti in enumerate(pod_aff[pi]):
-            pod_aff_terms[pi, a] = ti
-        for a, ti in enumerate(pod_anti[pi]):
-            pod_anti_terms[pi, a] = ti
-        for a, (ti, w) in enumerate(pod_pref[pi]):
-            pod_pref_aff_terms[pi, a] = ti
-            pod_pref_aff_w[pi, a] = np.float32(w)
-        for c, (ti, skew, mode) in enumerate(pod_spread[pi]):
-            pod_spread_terms[pi, c] = ti
-            pod_spread_maxskew[pi, c] = skew
-            pod_spread_hard[pi, c] = mode == HARD
+    if p:
+        pod_aff_terms[:p] = u_aff[inv]
+        pod_anti_terms[:p] = u_anti[inv]
+        pod_pref_aff_terms[:p] = u_pref_t[inv]
+        pod_pref_aff_w[:p] = u_pref_w[inv]
+        pod_spread_terms[:p] = u_spread_t[inv]
+        pod_spread_maxskew[:p] = u_spread_skew[inv]
+        pod_spread_hard[:p] = u_spread_hard[inv]
 
     # ---- host ports ----
-    for pod in [*pending, *bound]:
+    for pod in pending:
+        for proto, port in pod.host_ports:
+            voc.ports.intern((proto, port))
+    for pod in bound:
         for proto, port in pod.host_ports:
             voc.ports.intern((proto, port))
     PT = max(1, len(voc.ports))
-    pod_ports = np.zeros((P, PT), dtype=bool)
-    for pi, pod in enumerate(pending):
+    u_ports = np.zeros((Uq, PT), dtype=bool)
+    for ui, pod in enumerate(pending):
         for proto, port in pod.host_ports:
-            pod_ports[pi, voc.ports.get((proto, port))] = True
+            u_ports[ui, voc.ports.get((proto, port))] = True
+    pod_ports = np.zeros((P, PT), dtype=bool)
+    if p:
+        pod_ports[:p] = u_ports[inv]
     node_ports0 = np.zeros((N, PT), dtype=bool)
     for pod in bound:
         ni = node_index.get(pod.node_name)

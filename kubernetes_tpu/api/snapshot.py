@@ -180,17 +180,18 @@ def _resource_axis(snap: Snapshot) -> List[str]:
     return res
 
 
-def _scale_for(values: List[int]) -> int:
+def _scale_for(values) -> int:
     """Exact-where-possible int32 rescale: gcd unit, widened if the max still
-    overflows (widening rounds requests up / allocatable down — conservative)."""
-    nz = [abs(x) for x in values if x]
-    if not nz:
+    overflows (widening rounds requests up / allocatable down — conservative).
+    Accepts any int sequence or int64 ndarray; the single shared implementation
+    keeps the encoder, the oracle, and the native mirror bit-identical."""
+    nz = np.abs(np.asarray(values, dtype=np.int64).ravel())
+    nz = nz[nz != 0]
+    if nz.size == 0:
         return 1
-    g = 0
-    for x in nz:
-        g = math.gcd(g, x)
-    scale = max(1, g)
-    while max(nz) // scale > _INT32_MAX:
+    scale = max(1, int(np.gcd.reduce(nz)))
+    m = int(nz.max())
+    while m // scale > _INT32_MAX:
         scale *= 2
     return scale
 
@@ -225,15 +226,17 @@ def image_score_value(sum_mb: float) -> np.float32:
     )
 
 
-def _image_score_matrix(nodes, pending_sorted, N: int, P: int) -> np.ndarray:
+def _image_score_matrix(nodes, reps, inv, N: int, P: int) -> np.ndarray:
     """f32[P, N] ImageLocality scores, or f32[P, 1] zeros when irrelevant.
 
     Image sizes quantize to whole MB so sums are integer-exact in f32 across
     numpy/XLA/C++ (reference computes in int64; imagelocality/image_locality.go
     — calculatePriority, sumImageScores without the spread factor — deviation
-    documented in PARITY.md)."""
+    documented in PARITY.md).  `reps`/`inv` are the spec-interned unique
+    pending-pod specs and each sorted pod's spec index: the matmul runs over
+    unique specs and rows are gathered per pod."""
     img_ids: Dict[str, int] = {}
-    for pod in pending_sorted:
+    for pod in reps:
         for im in pod.images:
             img_ids.setdefault(im, len(img_ids))
     if not img_ids or not any(nd.images for nd in nodes):
@@ -245,17 +248,73 @@ def _image_score_matrix(nodes, pending_sorted, N: int, P: int) -> np.ndarray:
             j = img_ids.get(im)
             if j is not None:
                 node_mb[i, j] = np.float32(size // (1024 * 1024))
-    pod_has = np.zeros((P, I), dtype=np.float32)
-    for k, pod in enumerate(pending_sorted):
+    pod_has = np.zeros((len(reps), I), dtype=np.float32)
+    for k, pod in enumerate(reps):
         for im in pod.images:
             pod_has[k, img_ids[im]] = 1.0
-    raw = pod_has @ node_mb.T  # integer-valued f32 MB sums
+    raw = pod_has @ node_mb.T  # integer-valued f32 MB sums, [U, N]
     s = np.clip(raw, _IMG_MIN_MB, _IMG_MAX_MB).astype(np.float32)
-    return (
+    scored = (
         (s - np.float32(_IMG_MIN_MB))
         * np.float32(100.0)
         / np.float32(_IMG_MAX_MB - _IMG_MIN_MB)
     ).astype(np.float32)
+    out = np.zeros((P, N), dtype=np.float32)  # zero == the empty-image score
+    if len(inv):
+        out[: len(inv)] = scored[inv]
+    return out
+
+
+def _pod_spec_key(pod: t.Pod) -> Tuple:
+    """Encoding-relevant identity of a (volume-resolved) pending pod: pods from
+    one workload template collapse to one key, so the encoder does per-spec
+    work once and scatters rows (the host-side analog of keeping the MXU fed
+    with batched work instead of scalar loops)."""
+    return (
+        tuple(sorted(pod.requests.items())),
+        tuple(sorted(pod.labels.items())),
+        pod.namespace,
+        pod.node_name,
+        pod.priority,
+        pod.tolerations,
+        pod.node_selector,
+        pod.affinity,
+        pod.topology_spread,
+        pod.host_ports,
+        pod.scheduling_gates,
+        pod.pod_group,
+        pod.images,
+    )
+
+
+def group_by_spec(pods: Sequence[t.Pod]) -> Tuple[List[t.Pod], np.ndarray]:
+    """-> (reps, inv): unique encoding specs in first-occurrence order and each
+    pod's spec index.  Interner-order equivalence: because every vocab below
+    dedups on intern, processing unique specs in first-occurrence order assigns
+    ids identical to the old per-pod loops (bit-identical arrays)."""
+    ids: Dict[Tuple, int] = {}
+    reps: List[t.Pod] = []
+    inv = np.empty(len(pods), dtype=np.int64)
+    for i, pod in enumerate(pods):
+        k = _pod_spec_key(pod)
+        u = ids.get(k)
+        if u is None:
+            u = len(reps)
+            ids[k] = u
+            reps.append(pod)
+        inv[i] = u
+    return reps, inv
+
+
+def _node_taints(nd: t.Node) -> List[t.Taint]:
+    # spec.unschedulable is modeled as the synthetic taint the reference's node
+    # controller applies (node.kubernetes.io/unschedulable:NoSchedule), which makes
+    # the NodeUnschedulable plugin's toleration-aware check fall out of the taint
+    # kernel (reference: nodeunschedulable/node_unschedulable.go — Filter).
+    ts = list(nd.taints)
+    if nd.unschedulable:
+        ts.append(t.Taint(key="node.kubernetes.io/unschedulable", effect=t.NO_SCHEDULE))
+    return ts
 
 
 def encode_snapshot(
@@ -272,6 +331,16 @@ def encode_snapshot(
     resources = _resource_axis(snap)
     R = len(resources)
 
+    # Spec interning: pods stamped from one template share all
+    # encoding-relevant fields, so every per-pod computation below runs once
+    # per unique spec (U ≪ P for real workloads) and results scatter to pod
+    # rows through `inv` — the encoder's Python cost stops scaling with the
+    # wave size (SURVEY.md §7 hard part 4: the host must not be the bottleneck).
+    perm = activeq_order(pending)
+    sorted_pending = [pending[i] for i in perm]
+    reps, inv = group_by_spec(sorted_pending)
+    U = len(reps)
+
     # --- label vocab over node labels (selectors lower against this) ---
     # Only label KEYS referenced by some pod's nodeSelector / node-affinity
     # expression enter the literal vocab: unreferenced labels (notably the
@@ -279,7 +348,7 @@ def encode_snapshot(
     # would otherwise blow the L axis up to O(N).  Topology keys are interned
     # separately as domains (api/pairwise.py).
     referenced_keys = set()
-    for pod in pending:
+    for pod in reps:
         for k, _ in pod.node_selector:
             referenced_keys.add(k)
         if pod.affinity:
@@ -289,54 +358,101 @@ def encode_snapshot(
             for pt in pod.affinity.preferred_node_terms:
                 for e in pt.preference.match_expressions:
                     referenced_keys.add(e.key)
+    # nodes intern by filtered-label profile (zone-style labels repeat across
+    # the fleet; per-node hostname enters only when a pod references it)
     lab = v.LabelVocab()
-    node_lits: List[List[int]] = [
-        lab.add_labels({k: val for k, val in nd.labels.items() if k in referenced_keys})
-        for nd in nodes
-    ]
+    nlab_ids: Dict[Tuple, int] = {}
+    nlab_rows: List[List[int]] = []
+    nlab_inv = np.empty(n, dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        # sorted key: two nodes with equal filtered label SETS share a profile
+        # regardless of dict insertion order
+        fk = tuple(sorted((k, val) for k, val in nd.labels.items() if k in referenced_keys))
+        u = nlab_ids.get(fk)
+        if u is None:
+            u = len(nlab_rows)
+            nlab_ids[fk] = u
+            nlab_rows.append(lab.add_labels(dict(fk)))
+        nlab_inv[i] = u
 
-    # --- taint vocab ---
-    # spec.unschedulable is modeled as the synthetic taint the reference's node
-    # controller applies (node.kubernetes.io/unschedulable:NoSchedule), which makes
-    # the NodeUnschedulable plugin's toleration-aware check fall out of the taint
-    # kernel (reference: nodeunschedulable/node_unschedulable.go — Filter).
-    def _node_taints(nd: t.Node) -> List[t.Taint]:
-        ts = list(nd.taints)
-        if nd.unschedulable:
-            ts.append(t.Taint(key="node.kubernetes.io/unschedulable", effect=t.NO_SCHEDULE))
-        return ts
-
+    # --- taint vocab (interned by node taint profile) ---
     taints = v.Interner()
-    for nd in nodes:
-        for tn in _node_taints(nd):
-            taints.intern((tn.key, tn.value, tn.effect))
+    tprof_ids: Dict[Tuple, int] = {}
+    tprof: List[List[t.Taint]] = []
+    tinv = np.empty(n, dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        key = (nd.taints, nd.unschedulable)
+        u = tprof_ids.get(key)
+        if u is None:
+            u = len(tprof)
+            tprof_ids[key] = u
+            ts = _node_taints(nd)
+            tprof.append(ts)
+            for tn in ts:
+                taints.intern((tn.key, tn.value, tn.effect))
+        tinv[i] = u
     T = max(1, len(taints))
 
     # --- raw quantities, then per-resource rescale to int32 ---
-    alloc_raw = np.zeros((n, R), dtype=np.int64)
-    for i, nd in enumerate(nodes):
-        for j, r in enumerate(resources):
-            if r == t.PODS:
-                alloc_raw[i, j] = nd.allocatable.get(r, _DEFAULT_POD_LIMIT)
-            else:
-                alloc_raw[i, j] = nd.allocatable.get(r, 0)
-    perm = activeq_order(pending)
-    req_raw = np.zeros((p, R), dtype=np.int64)
-    for out_i, src_i in enumerate(perm):
-        req_raw[out_i] = pod_effective_requests(pending[src_i], resources)
-    used_raw = np.zeros((n, R), dtype=np.int64)
     node_index = {nd.name: i for i, nd in enumerate(nodes)}
+    aprof_ids: Dict[Tuple, int] = {}
+    arows: List[List[int]] = []
+    ainv = np.empty(n, dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        key = tuple(sorted(nd.allocatable.items()))
+        u = aprof_ids.get(key)
+        if u is None:
+            u = len(arows)
+            aprof_ids[key] = u
+            arows.append(
+                [
+                    nd.allocatable.get(r, _DEFAULT_POD_LIMIT if r == t.PODS else 0)
+                    for r in resources
+                ]
+            )
+        ainv[i] = u
+    alloc_uniq = (
+        np.array(arows, dtype=np.int64) if arows else np.zeros((1, R), dtype=np.int64)
+    )
+    alloc_raw = alloc_uniq[ainv] if n else np.zeros((0, R), dtype=np.int64)
+
+    req_uniq = (
+        np.array([pod_effective_requests(rp, resources) for rp in reps], dtype=np.int64)
+        if U
+        else np.zeros((1, R), dtype=np.int64)
+    )
+    req_raw = req_uniq[inv] if p else np.zeros((0, R), dtype=np.int64)
+
+    used_raw = np.zeros((n, R), dtype=np.int64)
+    breq_ids: Dict[Tuple, int] = {}
+    brows: List[List[int]] = []
+    b_nodes: List[int] = []
+    b_u: List[int] = []
     for bp in snap.bound_pods:
         i = node_index.get(bp.node_name)
-        if i is not None:
-            used_raw[i] += np.array(pod_effective_requests(bp, resources), dtype=np.int64)
+        if i is None:
+            continue
+        key = tuple(sorted(bp.requests.items()))
+        u = breq_ids.get(key)
+        if u is None:
+            u = len(brows)
+            breq_ids[key] = u
+            brows.append(pod_effective_requests(bp, resources))
+        b_nodes.append(i)
+        b_u.append(u)
+    if b_nodes:
+        np.add.at(
+            used_raw,
+            np.array(b_nodes, dtype=np.int64),
+            np.array(brows, dtype=np.int64)[np.array(b_u, dtype=np.int64)],
+        )
 
+    # per-resource int32 rescale: gcd over unique values (duplicates cannot
+    # change a gcd or max), vectorized
     scale = np.ones(R, dtype=np.int64)
+    stacked = np.concatenate([alloc_uniq, req_uniq, used_raw], axis=0)
     for j in range(R):
-        vals = [int(x) for x in alloc_raw[:, j]] + [int(x) for x in req_raw[:, j]] + [
-            int(x) for x in used_raw[:, j]
-        ]
-        scale[j] = _scale_for(vals)
+        scale[j] = _scale_for(stacked[:, j])
     # ceil for demand, floor for supply when the unit is inexact (conservative)
     req_s = -(-req_raw // scale)
     used_s = -(-used_raw // scale)
@@ -354,26 +470,32 @@ def encode_snapshot(
 
     L = max(1, len(lab))
     node_labels = np.zeros((N, L), dtype=np.float32)
-    for i, lits in enumerate(node_lits):
-        node_labels[i, lits] = 1.0
+    if n:
+        lab_uniq = np.zeros((max(1, len(nlab_rows)), L), dtype=np.float32)
+        for u, lits in enumerate(nlab_rows):
+            lab_uniq[u, lits] = 1.0
+        node_labels[:n] = lab_uniq[nlab_inv]
 
     node_taint_ns = np.zeros((N, T), dtype=bool)
     node_taint_pref = np.zeros((N, T), dtype=bool)
-    for i, nd in enumerate(nodes):
-        for tn in _node_taints(nd):
-            tid = taints.get((tn.key, tn.value, tn.effect))
-            if tn.effect == t.PREFER_NO_SCHEDULE:
-                node_taint_pref[i, tid] = True
-            else:
-                node_taint_ns[i, tid] = True
+    if n:
+        tns_uniq = np.zeros((max(1, len(tprof)), T), dtype=bool)
+        tpref_uniq = np.zeros((max(1, len(tprof)), T), dtype=bool)
+        for u, ts in enumerate(tprof):
+            for tn in ts:
+                tid = taints.get((tn.key, tn.value, tn.effect))
+                if tn.effect == t.PREFER_NO_SCHEDULE:
+                    tpref_uniq[u, tid] = True
+                else:
+                    tns_uniq[u, tid] = True
+        node_taint_ns[:n] = tns_uniq[tinv]
+        node_taint_pref[:n] = tpref_uniq[tinv]
 
-    # --- pods (in activeQ order) ---
+    # --- pods (in activeQ order; all per-spec, scattered through inv) ---
     # SchedulingGates: gated pods never enter the schedulable set (reference:
     # schedulinggates/scheduling_gates.go — PreEnqueue holds them out of activeQ);
     # they come back with verdict -1 (still pending).
     pod_valid = np.zeros(P, dtype=bool)
-    for out_i, src_i in enumerate(perm):
-        pod_valid[out_i] = not pending[src_i].scheduling_gates
     pod_req = np.zeros((P, R), dtype=np.int32)
     pod_req[:p] = req_s
     pod_prio = np.zeros(P, dtype=np.int32)
@@ -384,18 +506,32 @@ def encode_snapshot(
     table = v.TermTable()
     pod_term_lists: List[List[int]] = []
     pref_lists: List[List[Tuple[int, float]]] = []
-    for out_i, src_i in enumerate(perm):
-        pod = pending[src_i]
-        pod_prio[out_i] = pod.priority
-        for tid, (tk, tv, te) in enumerate(taints.items):
-            taint = t.Taint(tk, tv, te)
-            tol = any(tol.tolerates(taint) for tol in pod.tolerations)
-            if te == t.PREFER_NO_SCHEDULE:
-                pod_tol_pref[out_i, tid] = tol
-            else:
-                pod_tol_ns[out_i, tid] = tol
+    u_valid = np.empty(max(1, U), dtype=bool)
+    u_prio = np.zeros(max(1, U), dtype=np.int32)
+    u_tol_ns = np.ones((max(1, U), T), dtype=bool)
+    u_tol_pref = np.ones((max(1, U), T), dtype=bool)
+    u_nodename = np.full(max(1, U), -1, dtype=np.int32)
+    taint_objs = [t.Taint(tk, tv, te) for (tk, tv, te) in taints.items]
+    # a taint's effect class is a property of the vocab, not the pod: each
+    # tol row only masks its own effect class (the other stays default-True)
+    taint_is_pref = np.array(
+        [tn.effect == t.PREFER_NO_SCHEDULE for tn in taint_objs], dtype=bool
+    )
+    for ui, pod in enumerate(reps):
+        u_valid[ui] = not pod.scheduling_gates
+        u_prio[ui] = pod.priority
+        if pod.tolerations:
+            for tid, taint in enumerate(taint_objs):
+                tol = any(tol.tolerates(taint) for tol in pod.tolerations)
+                if taint.effect == t.PREFER_NO_SCHEDULE:
+                    u_tol_pref[ui, tid] = tol
+                else:
+                    u_tol_ns[ui, tid] = tol
+        elif taint_objs:
+            u_tol_ns[ui] = taint_is_pref  # no tolerations: intolerant of every
+            u_tol_pref[ui] = ~taint_is_pref  # taint in the row's effect class
         if pod.node_name:
-            pod_nodename[out_i] = node_index.get(pod.node_name, -2)
+            u_nodename[ui] = node_index.get(pod.node_name, -2)
         terms = v.pod_required_node_terms(pod, lab)
         pod_term_lists.append([] if terms is None else [table.intern(tm) for tm in terms])
         # preferred node affinity: weight per matching term (empty term matches
@@ -408,45 +544,65 @@ def encode_snapshot(
                         (table.intern(v.lower_node_term(pt.preference.match_expressions, lab)), float(pt.weight))
                     )
         pref_lists.append(prefs)
+    if p:
+        pod_valid[:p] = u_valid[inv]
+        pod_prio[:p] = u_prio[inv]
+        pod_tol_ns[:p] = u_tol_ns[inv]
+        pod_tol_pref[:p] = u_tol_pref[inv]
+        pod_nodename[:p] = u_nodename[inv]
 
     TT = max(1, max((len(x) for x in pod_term_lists), default=1))
+    u_terms = np.full((max(1, U), TT), -1, dtype=np.int32)
+    u_has_sel = np.zeros(max(1, U), dtype=bool)
+    for ui, ids in enumerate(pod_term_lists):
+        if ids:
+            u_has_sel[ui] = True
+            u_terms[ui, : len(ids)] = ids
     pod_terms = np.full((P, TT), -1, dtype=np.int32)
     pod_has_sel = np.zeros(P, dtype=bool)
-    for i, ids in enumerate(pod_term_lists):
-        if ids:
-            pod_has_sel[i] = True
-            pod_terms[i, : len(ids)] = ids
+    if p:
+        pod_terms[:p] = u_terms[inv]
+        pod_has_sel[:p] = u_has_sel[inv]
 
     PW = max(1, max((len(x) for x in pref_lists), default=1))
+    u_pref_terms = np.full((max(1, U), PW), -1, dtype=np.int32)
+    u_pref_weights = np.zeros((max(1, U), PW), dtype=np.float32)
+    for ui, prefs in enumerate(pref_lists):
+        for a, (tid, w) in enumerate(prefs):
+            u_pref_terms[ui, a] = tid
+            u_pref_weights[ui, a] = w
     pod_pref_terms = np.full((P, PW), -1, dtype=np.int32)
     pod_pref_weights = np.zeros((P, PW), dtype=np.float32)
-    for i, prefs in enumerate(pref_lists):
-        for a, (tid, w) in enumerate(prefs):
-            pod_pref_terms[i, a] = tid
-            pod_pref_weights[i, a] = w
+    if p:
+        pod_pref_terms[:p] = u_pref_terms[inv]
+        pod_pref_weights[:p] = u_pref_weights[inv]
 
     sel_mask, sel_kind = table.encode(L)
 
     # gang groups: pods referencing a PodGroup name share an index; minMember
     # defaults to the group's pod count when no PodGroup object is given
     group_ids = v.Interner()
+    u_group = np.full(max(1, U), -1, dtype=np.int32)
+    for ui, pod in enumerate(reps):
+        if pod.pod_group:
+            u_group[ui] = group_ids.intern(pod.pod_group)
     pod_group = np.full(P, -1, dtype=np.int32)
-    for out_i, src_i in enumerate(perm):
-        g = pending[src_i].pod_group
-        if g:
-            pod_group[out_i] = group_ids.intern(g)
+    if p:
+        pod_group[:p] = u_group[inv]
     G = max(1, len(group_ids))
     group_min = np.ones(G, dtype=np.int32)
-    for gi, gname in enumerate(group_ids.items):
-        pg = snap.pod_groups.get(gname)
-        group_min[gi] = pg.min_member if pg else int((pod_group == gi).sum())
+    if len(group_ids):
+        counts = np.bincount(pod_group[pod_group >= 0], minlength=G)
+        for gi, gname in enumerate(group_ids.items):
+            pg = snap.pod_groups.get(gname)
+            group_min[gi] = pg.min_member if pg else int(counts[gi])
 
     from .pairwise import build_pairwise
 
-    sorted_pending = [pending[i] for i in perm]
     _pair_voc, pair = build_pairwise(
-        nodes, sorted_pending, snap.bound_pods, node_index, N, P,
+        nodes, reps, snap.bound_pods, node_index, N, P,
         hard_pod_affinity_weight=hard_pod_affinity_weight,
+        pending_inv=inv,
     )
 
     arrays = ClusterArrays(
@@ -471,7 +627,7 @@ def encode_snapshot(
         pod_pref_weights=pod_pref_weights,
         pod_group=pod_group,
         group_min=group_min,
-        image_score=_image_score_matrix(nodes, sorted_pending, N, P),
+        image_score=_image_score_matrix(nodes, reps, inv, N, P),
         **pair,
     )
     meta = EncodingMeta(
