@@ -4,16 +4,25 @@
 50,000 pending pods vs 20,000 simulated nodes (heterogeneous capacities,
 extended resources, taints/tolerations — BASELINE config-4 shape at north-star
 scale), full filter+score+sequential-commit with exact one-pod-at-a-time
-semantics.  Metric: pods scheduled per second, steady-state (post-compile),
-best of 3.
+semantics.
 
-vs_baseline: the reference default scheduler's scheduler_perf throughput on
-simple profiles is O(100-300) pods/s (BASELINE.md; no published table exists
-for the fork) — vs_baseline = pods_per_sec / 300 (the generous end).
+Reported (stderr) and embedded in the JSON line:
+  encode_s      cold full snapshot encode (host)
+  delta_s       warm-cluster re-encode of a fresh 50k wave through the
+                resident DeltaEncoder (the steady-state host cost)
+  step_s        device step, steady state (best of 3)
+  end_to_end_s  delta_s + step_s — the north-star "<1 s wall-clock" metric
+                for a warm cluster absorbing a 50k-pod wave
+
+value / vs_baseline keep the round-over-round contract: steady-state device
+throughput vs the reference's O(300) pods/s scheduler_perf folklore
+(BASELINE.md — no published table exists for the fork).  The honest
+end-to-end number is end_to_end_pods_per_sec, also embedded.
 
 Prints exactly one JSON line on stdout.
 """
 
+import dataclasses
 import json
 import sys
 import time
@@ -26,18 +35,21 @@ BASELINE_PODS_PER_SEC = 300.0
 def main() -> None:
     import jax
 
-    from kubernetes_tpu.api.snapshot import encode_snapshot
+    from kubernetes_tpu.api.delta import DeltaEncoder
+    from kubernetes_tpu.api.snapshot import Snapshot
     from kubernetes_tpu.bench.workloads import heterogeneous
     from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
 
     print(f"devices: {jax.devices()}", file=sys.stderr)
     snap = heterogeneous(N_NODES, N_PODS, seed=0)
+    enc = DeltaEncoder()
+
     t0 = time.perf_counter()
-    arr, meta = encode_snapshot(snap)
-    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
-    arr = jax.device_put(arr)
+    arr, meta = enc.encode_device(snap)
     t_encode = time.perf_counter() - t0
-    print(f"encode: {t_encode:.3f}s  N={arr.N} P={arr.P} R={arr.R}", file=sys.stderr)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    print(f"encode (cold full): {t_encode:.3f}s  N={arr.N} P={arr.P} R={arr.R}",
+          file=sys.stderr)
 
     import numpy as np
 
@@ -48,16 +60,41 @@ def main() -> None:
     choices = np.asarray(schedule_batch(arr, cfg)[0])
     print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
-    best = float("inf")
+    t_step = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
         choices = np.asarray(schedule_batch(arr, cfg)[0])
-        best = min(best, time.perf_counter() - t0)
+        t_step = min(t_step, time.perf_counter() - t0)
+
+    # warm-cluster wave: the scheduled pods are now bound, a fresh 50k wave
+    # arrives — the resident encoder absorbs the bind delta + encodes the wave
+    bound = [
+        dataclasses.replace(p, node_name=meta.node_names[int(c)])
+        for p, c in zip(
+            (snap.pending_pods[i] for i in meta.pod_perm), choices[: meta.n_pods]
+        )
+        if int(c) >= 0
+    ]
+    wave = [dataclasses.replace(p, name=f"w2-{p.name}", uid="") for p in snap.pending_pods]
+    snap2 = Snapshot(nodes=snap.nodes, pending_pods=wave, bound_pods=bound)
+    t0 = time.perf_counter()
+    arr2, meta2 = enc.encode_device(snap2)
+    t_delta = time.perf_counter() - t0
+    assert enc.stats["delta"] >= 1, f"delta path did not engage: {enc.stats}"
+    t0 = time.perf_counter()
+    choices2 = np.asarray(schedule_batch(arr2, cfg)[0])
+    t_step2 = time.perf_counter() - t0
 
     scheduled = int((choices[: meta.n_pods] >= 0).sum())
-    pods_per_sec = meta.n_pods / best
+    end_to_end = t_delta + t_step2
+    pods_per_sec = meta.n_pods / t_step
+    e2e_pods_per_sec = meta2.n_pods / end_to_end
     print(
-        f"step: {best*1e3:.1f}ms  scheduled {scheduled}/{meta.n_pods}", file=sys.stderr
+        f"step: {t_step*1e3:.1f}ms  scheduled {scheduled}/{meta.n_pods}\n"
+        f"warm wave: delta-encode {t_delta*1e3:.1f}ms + step {t_step2*1e3:.1f}ms "
+        f"= end-to-end {end_to_end*1e3:.1f}ms "
+        f"({'PASS' if end_to_end < 1.0 else 'FAIL'} <1s north star)",
+        file=sys.stderr,
     )
     print(
         json.dumps(
@@ -66,6 +103,12 @@ def main() -> None:
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+                "encode_s": round(t_encode, 3),
+                "delta_s": round(t_delta, 3),
+                "step_s": round(t_step, 4),
+                "end_to_end_s": round(end_to_end, 3),
+                "end_to_end_pods_per_sec": round(e2e_pods_per_sec, 1),
+                "scheduled": scheduled,
             }
         )
     )

@@ -1,0 +1,1086 @@
+"""Incremental (delta) snapshot encoding — the watch-cache analog.
+
+The reference keeps one etcd watch feeding an incremental NodeInfo cache and
+re-snapshots per cycle in O(changes) (storage/cacher/cacher.go — type Cacher;
+pkg/scheduler/backend/cache — UpdateSnapshot).  This module is the TPU-first
+equivalent (SURVEY.md §2.4 "watch fan-out → snapshot-delta streaming",
+§7 hard part 4: snapshot deltas, not full re-uploads):
+
+  * The CLUSTER SIDE — node profiles, vocabularies, raw int64 resource usage,
+    pairwise term counts, host-port occupancy, per-bound-pod contribution
+    records — stays resident in a `ClusterSide` cache across scheduling
+    cycles.  Newly bound / deleted pods are absorbed as batched scatter
+    updates (np.add.at over the changed rows), never a rebuild.
+  * The POD SIDE — everything keyed by the pending wave (requests, selector
+    lowering, pairwise term ids, gang masks, image scores) — is (re)built
+    per cycle with the spec-interned vectorized path and scattered through
+    the wave's inverse index.
+
+Exactness: raw int64 resource sums live in the cache, and the int32 rescale
+is re-derived per cycle from raw values, so a delta-updated encode is
+BIT-IDENTICAL to a from-scratch encode of the same cluster state (asserted by
+tests/test_delta_encoder.py on randomized churn streams).  Whenever a delta
+cannot preserve that guarantee — a new vocabulary entry (label key, taint,
+pairwise term, host port, resource kind), a node set change, a bound pod the
+guards cannot absorb — the encoder falls back to a full cluster-side rebuild,
+which IS the one-shot path: `snapshot.encode_snapshot` delegates here, so the
+fast path and the fallback share one implementation.
+
+Known limitation: clusters using PVs/PVCs/attach limits/device slices defeat
+the cache — volumes.resolve_snapshot rebuilds node objects each cycle, so the
+node fingerprint (object identity) never matches and every cycle re-encodes
+fully.  That path is correct (it IS the full path), just not incremental;
+conditioning the cache on pre-resolution identity plus a storage-state
+fingerprint is future work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import types as t
+from . import vocab as v
+from .pairwise import (
+    HARD,
+    SOFT,
+    PairwiseVocab,
+    TermKey,
+    _match_matrix,
+    _term_of_affinity,
+    _term_of_spread,
+)
+
+
+# --------------------------------------------------------------------------
+# wave fingerprint: what the cluster-side cache is conditioned on
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WaveFingerprint:
+    """Wave-derived inputs the cluster side depends on.  Two waves with equal
+    fingerprints (the steady state for template-stamped workloads) can share
+    one cluster-side cache; a mismatch forces a rebuild."""
+
+    referenced_keys: frozenset
+    resources: Tuple[str, ...]
+    term_seq: Tuple[TermKey, ...]  # pairwise terms in first-intern order
+    port_seq: Tuple[Tuple[str, int], ...]
+
+
+def _pod_pairwise_terms(pod: t.Pod):
+    """(aff, anti, pref[(term, signed w)], spread[(term, maxSkew, mode)]) as
+    TermKey tuples, in the canonical intern order."""
+    aff: List[TermKey] = []
+    anti: List[TermKey] = []
+    pref: List[Tuple[TermKey, float]] = []
+    spread: List[Tuple[TermKey, int, int]] = []
+    if pod.affinity:
+        for term in pod.affinity.required_pod_affinity:
+            aff.append(_term_of_affinity(term, pod.namespace))
+        for term in pod.affinity.required_pod_anti_affinity:
+            anti.append(_term_of_affinity(term, pod.namespace))
+        for wt in pod.affinity.preferred_pod_affinity:
+            pref.append((_term_of_affinity(wt.term, pod.namespace), float(wt.weight)))
+        for wt in pod.affinity.preferred_pod_anti_affinity:
+            pref.append((_term_of_affinity(wt.term, pod.namespace), -float(wt.weight)))
+    for c in pod.topology_spread:
+        spread.append(
+            (
+                _term_of_spread(c, pod.namespace),
+                c.max_skew,
+                HARD if c.when_unsatisfiable == t.DO_NOT_SCHEDULE else SOFT,
+            )
+        )
+    return aff, anti, pref, spread
+
+
+def wave_fingerprint(reps: Sequence[t.Pod], resources: Sequence[str]) -> WaveFingerprint:
+    referenced: set = set()
+    term_seq: List[TermKey] = []
+    seen_terms: set = set()
+    port_seq: List[Tuple[str, int]] = []
+    seen_ports: set = set()
+    for pod in reps:
+        for k, _ in pod.node_selector:
+            referenced.add(k)
+        if pod.affinity:
+            for term in pod.affinity.required_node_terms:
+                for e in term.match_expressions:
+                    referenced.add(e.key)
+            for pt in pod.affinity.preferred_node_terms:
+                for e in pt.preference.match_expressions:
+                    referenced.add(e.key)
+        aff, anti, pref, spread = _pod_pairwise_terms(pod)
+        for tk in [*aff, *anti, *(tk for tk, _ in pref), *(tk for tk, _, _ in spread)]:
+            if tk not in seen_terms:
+                seen_terms.add(tk)
+                term_seq.append(tk)
+        for pp in pod.host_ports:
+            if pp not in seen_ports:
+                seen_ports.add(pp)
+                port_seq.append(pp)
+    return WaveFingerprint(
+        referenced_keys=frozenset(referenced),
+        resources=tuple(resources),
+        term_seq=tuple(term_seq),
+        port_seq=tuple(port_seq),
+    )
+
+
+# --------------------------------------------------------------------------
+# cluster side: resident, delta-updated state
+# --------------------------------------------------------------------------
+
+
+class _Fallback(Exception):
+    """A delta cannot be absorbed bit-exactly — rebuild the cluster side."""
+
+
+# One bound pod's exact contribution, for O(1) reversal on delete — a plain
+# tuple (not a dataclass): records are created at wave-bind rates (50k/cycle),
+# where dataclass __init__ overhead alone is ~100 ms.
+# Layout: (ni, req_u, spec_u, port_ids, anti_ids, pref, obj).  The record holds
+# the pod OBJECT (not its id()): the strong reference keeps the object alive,
+# so `rec[_OBJ] is q` is a sound unchanged-check — a freed address being
+# reallocated to a different pod can never alias it.
+_BoundRec = tuple
+_NI, _REQ_U, _SPEC_U, _PORT_IDS, _ANTI_IDS, _PREF, _OBJ = range(7)
+
+
+@dataclass
+class ClusterSide:
+    """Everything derivable from (nodes, bound pods, wave fingerprint); all
+    node-axis arrays UNPADDED ([n] rows) — padding happens at assembly."""
+
+    wfp: WaveFingerprint
+    hpaw: float
+    nodes: List[t.Node]
+    nodes_fp: Tuple
+    node_index: Dict[str, int]
+    # label vocab + rows (filtered to wfp.referenced_keys)
+    lab: v.LabelVocab
+    node_labels: np.ndarray  # f32[n, L]
+    # taints
+    taints: v.Interner
+    taint_objs: List[t.Taint]
+    node_taint_ns: np.ndarray  # bool[n, T]
+    node_taint_pref: np.ndarray
+    # resources (raw int64; scale derived per cycle)
+    alloc_raw: np.ndarray  # i64[n, R]
+    used_raw: np.ndarray  # i64[n, R]
+    breq_uniq_ids: Dict[Tuple, int]
+    breq_uniq: List[List[int]]  # raw effective-request rows of bound specs
+    # pairwise
+    voc: PairwiseVocab
+    terms_list: List[TermKey]
+    node_dom: np.ndarray  # i32[K, n]
+    term_key: np.ndarray  # i32[T2]
+    term_counts0: np.ndarray  # f32[T2, D+1]
+    anti_counts0: np.ndarray
+    pref_own0: np.ndarray
+    # bound-spec match columns (by (labels, ns, affinity) key)
+    bspec_ids: Dict[Tuple, int]
+    m_cols: List[np.ndarray]  # each f32[T2]
+    bspec_anti: List[Tuple[int, ...]]
+    bspec_pref: List[Tuple[Tuple[int, float], ...]]
+    # host ports (occupancy as counts: OR is not reversible, counts are)
+    node_port_count: np.ndarray  # i32[n, PT]
+    # per-uid records
+    records: Dict[str, _BoundRec] = field(default_factory=dict)
+    stats: Dict[str, int] = field(default_factory=lambda: {"rebuilds": 0, "deltas": 0})
+    # padded-array cache for _assemble: name -> (key, array).  Returning the
+    # SAME numpy object for unchanged state lets encode_device() skip the
+    # host->device transfer of resident buffers (true double-buffered device
+    # snapshot — SURVEY.md §2.4 watch fan-out row).
+    pad_cache: Dict[str, Tuple] = field(default_factory=dict)
+    # bumped whenever sync mutates used_raw/ports/counts in place; versioned
+    # cache entries copy once per version, so handed-out arrays are immutable
+    mut_version: int = 0
+    # fast bind-absorb: each wave pod's unique-spec representative by uid.
+    # A pod that binds was a recent wave's pending pod, and pod SPECS are
+    # immutable after creation (the reference's PodSpec immutability), so the
+    # rep's spec fields stand in for the bound copy's — record construction
+    # becomes O(1) dict lookups instead of per-pod key sorting.
+    wave_uid_rep: Dict[str, t.Pod] = field(default_factory=dict)
+    # bound-side info per wave rep (keyed by id(rep); reps are kept alive by
+    # wave_uid_rep)
+    rep_bound_info: Dict[int, Tuple[int, int, Tuple[int, ...]]] = field(
+        default_factory=dict
+    )
+
+
+def _nodes_fp(nodes: Sequence[t.Node]) -> Tuple:
+    return tuple((nd.name, id(nd)) for nd in nodes)
+
+
+def _bound_spec_key(q: t.Pod) -> Tuple:
+    return (tuple(sorted(q.labels.items())), q.namespace, q.affinity)
+
+
+def _bound_term_ids(voc: PairwiseVocab, pod: t.Pod, hpaw: float, intern: bool):
+    """anti term ids + signed pref (id, w) of a BOUND pod; existing pods'
+    REQUIRED affinity terms score toward incoming pods at hardPodAffinityWeight
+    (interpodaffinity/scoring.go — processExistingPod)."""
+    get = voc.terms.intern if intern else voc.terms.get
+    anti: List[int] = []
+    pref: List[Tuple[int, float]] = []
+    if pod.affinity:
+        for term in pod.affinity.required_pod_anti_affinity:
+            ti = get(_term_of_affinity(term, pod.namespace))
+            if ti is None:
+                raise _Fallback("new anti term from bound pod")
+            anti.append(ti)
+        for wt in pod.affinity.preferred_pod_affinity:
+            ti = get(_term_of_affinity(wt.term, pod.namespace))
+            if ti is None:
+                raise _Fallback("new pref term from bound pod")
+            pref.append((ti, float(wt.weight)))
+        for wt in pod.affinity.preferred_pod_anti_affinity:
+            ti = get(_term_of_affinity(wt.term, pod.namespace))
+            if ti is None:
+                raise _Fallback("new pref-anti term from bound pod")
+            pref.append((ti, -float(wt.weight)))
+        if hpaw:
+            for term in pod.affinity.required_pod_affinity:
+                ti = get(_term_of_affinity(term, pod.namespace))
+                if ti is None:
+                    raise _Fallback("new req-aff term from bound pod")
+                pref.append((ti, float(hpaw)))
+    return tuple(anti), tuple(pref)
+
+
+def build_cluster_side(
+    nodes: Sequence[t.Node],
+    bound: Sequence[t.Pod],
+    wfp: WaveFingerprint,
+    hpaw: float,
+) -> ClusterSide:
+    from .snapshot import _DEFAULT_POD_LIMIT, _node_taints, pod_effective_requests
+
+    n = len(nodes)
+    resources = list(wfp.resources)
+    R = len(resources)
+    node_index = {nd.name: i for i, nd in enumerate(nodes)}
+
+    # --- label vocab over node labels, interned by filtered profile ---
+    lab = v.LabelVocab()
+    nlab_ids: Dict[Tuple, int] = {}
+    nlab_rows: List[List[int]] = []
+    nlab_inv = np.empty(n, dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        fk = tuple(
+            sorted((k, val) for k, val in nd.labels.items() if k in wfp.referenced_keys)
+        )
+        u = nlab_ids.get(fk)
+        if u is None:
+            u = len(nlab_rows)
+            nlab_ids[fk] = u
+            nlab_rows.append(lab.add_labels(dict(fk)))
+        nlab_inv[i] = u
+    L = max(1, len(lab))
+    node_labels = np.zeros((n, L), dtype=np.float32)
+    if n:
+        lab_uniq = np.zeros((max(1, len(nlab_rows)), L), dtype=np.float32)
+        for u, lits in enumerate(nlab_rows):
+            lab_uniq[u, lits] = 1.0
+        node_labels[:] = lab_uniq[nlab_inv]
+
+    # --- taints, interned by node profile ---
+    taints = v.Interner()
+    tprof_ids: Dict[Tuple, int] = {}
+    tprof: List[List[t.Taint]] = []
+    tinv = np.empty(n, dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        key = (nd.taints, nd.unschedulable)
+        u = tprof_ids.get(key)
+        if u is None:
+            u = len(tprof)
+            tprof_ids[key] = u
+            ts = _node_taints(nd)
+            tprof.append(ts)
+            for tn in ts:
+                taints.intern((tn.key, tn.value, tn.effect))
+        tinv[i] = u
+    T = max(1, len(taints))
+    node_taint_ns = np.zeros((n, T), dtype=bool)
+    node_taint_pref = np.zeros((n, T), dtype=bool)
+    if n:
+        tns_uniq = np.zeros((max(1, len(tprof)), T), dtype=bool)
+        tpref_uniq = np.zeros((max(1, len(tprof)), T), dtype=bool)
+        for u, ts in enumerate(tprof):
+            for tn in ts:
+                tid = taints.get((tn.key, tn.value, tn.effect))
+                if tn.effect == t.PREFER_NO_SCHEDULE:
+                    tpref_uniq[u, tid] = True
+                else:
+                    tns_uniq[u, tid] = True
+        node_taint_ns[:] = tns_uniq[tinv]
+        node_taint_pref[:] = tpref_uniq[tinv]
+
+    # --- allocatable (raw), interned by profile ---
+    aprof_ids: Dict[Tuple, int] = {}
+    arows: List[List[int]] = []
+    ainv = np.empty(n, dtype=np.int64)
+    for i, nd in enumerate(nodes):
+        key = tuple(sorted(nd.allocatable.items()))
+        u = aprof_ids.get(key)
+        if u is None:
+            u = len(arows)
+            aprof_ids[key] = u
+            arows.append(
+                [
+                    nd.allocatable.get(r, _DEFAULT_POD_LIMIT if r == t.PODS else 0)
+                    for r in resources
+                ]
+            )
+        ainv[i] = u
+    alloc_uniq = (
+        np.array(arows, dtype=np.int64) if arows else np.zeros((1, R), dtype=np.int64)
+    )
+    alloc_raw = alloc_uniq[ainv] if n else np.zeros((0, R), dtype=np.int64)
+
+    # --- pairwise vocab: WAVE terms first (their intern order), then bound ---
+    voc = PairwiseVocab(v.Interner(), v.Interner(), v.Interner(), v.Interner())
+    for tk in wfp.term_seq:
+        voc.terms.intern(tk)
+    for pp in wfp.port_seq:
+        voc.ports.intern(pp)
+
+    # bound pods: requests + spec interning + term interning
+    used_raw = np.zeros((n, R), dtype=np.int64)
+    breq_uniq_ids: Dict[Tuple, int] = {}
+    breq_uniq: List[List[int]] = []
+    bspec_ids: Dict[Tuple, int] = {}
+    bspec_reps: List[t.Pod] = []
+    bspec_anti: List[Tuple[int, ...]] = []
+    bspec_pref: List[Tuple[Tuple[int, float], ...]] = []
+    records: Dict[str, _BoundRec] = {}
+    rec_ni: List[int] = []
+    rec_req: List[int] = []
+    rec_spec: List[int] = []
+    for q in bound:
+        ni = node_index.get(q.node_name)
+        if ni is None:
+            continue
+        rkey = tuple(sorted(q.requests.items()))
+        ru = breq_uniq_ids.get(rkey)
+        if ru is None:
+            ru = len(breq_uniq)
+            breq_uniq_ids[rkey] = ru
+            breq_uniq.append(pod_effective_requests(q, resources))
+        skey = _bound_spec_key(q)
+        su = bspec_ids.get(skey)
+        if su is None:
+            su = len(bspec_reps)
+            bspec_ids[skey] = su
+            bspec_reps.append(q)
+            anti, pref = _bound_term_ids(voc, q, hpaw, intern=True)
+            bspec_anti.append(anti)
+            bspec_pref.append(pref)
+        for proto, port in q.host_ports:
+            voc.ports.intern((proto, port))
+        records[q.uid] = (
+            ni,
+            ru,
+            su,
+            tuple(voc.ports.get(pp) for pp in q.host_ports),
+            bspec_anti[su],
+            bspec_pref[su],
+            q,
+        )
+        rec_ni.append(ni)
+        rec_req.append(ru)
+        rec_spec.append(su)
+
+    # --- topology keys + domains over the node set ---
+    for tk in [tm.topology_key for tm in voc.terms.items]:
+        voc.topo_keys.intern(tk)
+    K = max(1, len(voc.topo_keys))
+    for nd in nodes:
+        for tk in voc.topo_keys.items:
+            if tk in nd.labels:
+                voc.domains.intern((tk, nd.labels[tk]))
+    D = len(voc.domains)
+    node_dom = np.full((K, max(1, n)), D, dtype=np.int32)
+    for i, nd in enumerate(nodes):
+        for k, tk in enumerate(voc.topo_keys.items):
+            if tk in nd.labels:
+                node_dom[k, i] = voc.domains.get((tk, nd.labels[tk]))
+    T2 = max(1, len(voc.terms))
+    term_key = np.zeros(T2, dtype=np.int32)
+    for ti, term in enumerate(voc.terms.items):
+        term_key[ti] = voc.topo_keys.get(term.topology_key)
+
+    terms_list = list(voc.terms.items)
+    m_cols: List[np.ndarray] = []
+    if bspec_reps and terms_list:
+        m_u = _match_matrix(terms_list, bspec_reps)  # [T2, Ub]
+        m_cols = [np.ascontiguousarray(m_u[:, j]) for j in range(m_u.shape[1])]
+    elif bspec_reps:
+        m_cols = [np.zeros(T2, dtype=np.float32) for _ in bspec_reps]
+
+    term_counts0 = np.zeros((T2, D + 1), dtype=np.float32)
+    anti_counts0 = np.zeros((T2, D + 1), dtype=np.float32)
+    pref_own0 = np.zeros((T2, D + 1), dtype=np.float32)
+    PT = max(1, len(voc.ports))
+    node_port_count = np.zeros((max(1, n), PT), dtype=np.int32)
+
+    cs = ClusterSide(
+        wfp=wfp,
+        hpaw=hpaw,
+        nodes=list(nodes),
+        nodes_fp=_nodes_fp(nodes),
+        node_index=node_index,
+        lab=lab,
+        node_labels=node_labels,
+        taints=taints,
+        taint_objs=[t.Taint(tk, tv, te) for (tk, tv, te) in taints.items],
+        node_taint_ns=node_taint_ns,
+        node_taint_pref=node_taint_pref,
+        alloc_raw=alloc_raw,
+        used_raw=used_raw,
+        breq_uniq_ids=breq_uniq_ids,
+        breq_uniq=breq_uniq,
+        voc=voc,
+        terms_list=terms_list,
+        node_dom=node_dom,
+        term_key=term_key,
+        term_counts0=term_counts0,
+        anti_counts0=anti_counts0,
+        pref_own0=pref_own0,
+        bspec_ids=bspec_ids,
+        m_cols=m_cols,
+        bspec_anti=bspec_anti,
+        bspec_pref=bspec_pref,
+        node_port_count=node_port_count,
+        records=records,
+    )
+    # batched application of every bound pod's contribution
+    _apply_bound_batch(
+        cs,
+        np.array(rec_ni, dtype=np.int64),
+        np.array(rec_req, dtype=np.int64),
+        np.array(rec_spec, dtype=np.int64),
+        list(records.values()),
+        sign=1,
+    )
+    return cs
+
+
+def _apply_bound_batch(
+    cs: ClusterSide,
+    ni: np.ndarray,
+    req_u: np.ndarray,
+    spec_u: np.ndarray,
+    recs: List[_BoundRec],
+    sign: int,
+) -> None:
+    """Scatter-add (sign=+1) or -subtract (sign=-1) a batch of bound-pod
+    contributions.  All sums are integer-valued (weights are exact in f32 up
+    to 2^24), so addition order cannot change the result — deltas stay
+    bit-identical to a rebuild."""
+    if len(ni) == 0:
+        return
+    s = np.int64(sign)
+    np.add.at(
+        cs.used_raw, ni, s * np.array(cs.breq_uniq, dtype=np.int64)[req_u]
+    )
+    if cs.terms_list:
+        uniq, uinv = np.unique(spec_u, return_inverse=True)
+        m_u = np.stack([cs.m_cols[int(u)] for u in uniq], axis=1)  # [T2, Uq]
+        m = m_u[:, uinv]  # [T2, B]
+        dom_cols = cs.node_dom[cs.term_key][:, ni]  # [T2, B]
+        fs = np.float32(sign)
+        for ti in np.flatnonzero(m_u.any(axis=1)):
+            np.add.at(cs.term_counts0[ti], dom_cols[ti], fs * m[ti])
+    for rec in recs:
+        ni_r = rec[_NI]
+        for ti in rec[_ANTI_IDS]:
+            cs.anti_counts0[ti, cs.node_dom[cs.term_key[ti], ni_r]] += np.float32(sign)
+        for ti, w in rec[_PREF]:
+            cs.pref_own0[ti, cs.node_dom[cs.term_key[ti], ni_r]] += np.float32(
+                sign
+            ) * np.float32(w)
+        for pid in rec[_PORT_IDS]:
+            cs.node_port_count[ni_r, pid] += sign
+
+
+def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
+    """Absorb the bound-pod diff (binds + deletes since last cycle) into the
+    resident cluster side.  Raises _Fallback when a new pod needs a vocabulary
+    entry the cache lacks (new term / port / resource kind)."""
+    from .snapshot import pod_effective_requests
+
+    cur: Dict[str, t.Pod] = {}
+    for q in bound:
+        if q.node_name in cs.node_index:
+            cur[q.uid] = q
+    gone: List[str] = []
+    new: List[t.Pod] = []
+    for uid, rec in cs.records.items():
+        q = cur.get(uid)
+        if q is None:
+            gone.append(uid)
+        elif rec[_OBJ] is not q:
+            # the pod OBJECT was replaced (update / re-nomination / a
+            # volume-resolved copy): remove the old contribution, re-add the
+            # new one — identity comparison keeps the steady state O(diff)
+            gone.append(uid)
+            new.append(q)
+    for uid, q in cur.items():
+        if uid not in cs.records:
+            new.append(q)
+    if not gone and not new:
+        return
+    cs.stats["deltas"] += 1
+    cs.mut_version += 1
+    if gone:
+        recs = [cs.records.pop(uid) for uid in gone]
+        _apply_bound_batch(
+            cs,
+            np.array([r[_NI] for r in recs], dtype=np.int64),
+            np.array([r[_REQ_U] for r in recs], dtype=np.int64),
+            np.array([r[_SPEC_U] for r in recs], dtype=np.int64),
+            recs,
+            sign=-1,
+        )
+    if new:
+        resources = list(cs.wfp.resources)
+        res_set = set(resources)
+        fresh_specs: List[t.Pod] = []
+        add_recs: List[_BoundRec] = []
+
+        def _spec_info(q: t.Pod) -> Tuple[int, int, Tuple[int, ...]]:
+            """(req_u, spec_u, port_ids) — the sorting-heavy part, computed
+            once per unique spec."""
+            if any(k not in res_set for k in q.requests):
+                raise _Fallback("new resource kind from bound pod")
+            rkey = tuple(sorted(q.requests.items()))
+            ru = cs.breq_uniq_ids.get(rkey)
+            if ru is None:
+                ru = len(cs.breq_uniq)
+                cs.breq_uniq_ids[rkey] = ru
+                cs.breq_uniq.append(pod_effective_requests(q, resources))
+            skey = _bound_spec_key(q)
+            su = cs.bspec_ids.get(skey)
+            if su is None:
+                anti, pref = _bound_term_ids(cs.voc, q, cs.hpaw, intern=False)
+                su = len(cs.bspec_ids)
+                cs.bspec_ids[skey] = su
+                cs.bspec_anti.append(anti)
+                cs.bspec_pref.append(pref)
+                fresh_specs.append(q)
+            port_ids = []
+            for pp in q.host_ports:
+                pid = cs.voc.ports.get(pp)
+                if pid is None:
+                    raise _Fallback("new host port from bound pod")
+                port_ids.append(pid)
+            return ru, su, tuple(port_ids)
+
+        for q in new:
+            rep = cs.wave_uid_rep.pop(q.uid, None)
+            if rep is not None and not q.pvcs and not q.resource_claims:
+                # fast path: the pod was a recent wave's pending pod — its
+                # (immutable) spec is the rep's; bind-absorb is O(1) lookups.
+                # Pods with volume/device claims take the slow path: their
+                # RESOLVED spec (api/volumes.resolve_pod) can change between
+                # pending and bound as PVC/PV state moves, so it must be
+                # recomputed from the current resolved object.
+                ent = cs.rep_bound_info.get(id(rep))
+                if ent is None or ent[0] is not rep:
+                    # the entry VALUE holds the rep, so a live entry's id key
+                    # can never alias a reallocated address; the `is` check
+                    # guards the first insertion race all the same
+                    ent = (rep, _spec_info(rep))
+                    cs.rep_bound_info[id(rep)] = ent
+                ru, su, port_ids = ent[1]
+            else:
+                ru, su, port_ids = _spec_info(q)
+            rec = (
+                cs.node_index[q.node_name],
+                ru,
+                su,
+                port_ids,
+                cs.bspec_anti[su],
+                cs.bspec_pref[su],
+                q,
+            )
+            cs.records[q.uid] = rec
+            add_recs.append(rec)
+        if fresh_specs and cs.terms_list:
+            m_new = _match_matrix(cs.terms_list, fresh_specs)
+            for j in range(len(fresh_specs)):
+                cs.m_cols.append(np.ascontiguousarray(m_new[:, j]))
+        elif fresh_specs:
+            cs.m_cols.extend(
+                np.zeros(max(1, len(cs.terms_list)), dtype=np.float32)
+                for _ in fresh_specs
+            )
+        _apply_bound_batch(
+            cs,
+            np.array([r[_NI] for r in add_recs], dtype=np.int64),
+            np.array([r[_REQ_U] for r in add_recs], dtype=np.int64),
+            np.array([r[_SPEC_U] for r in add_recs], dtype=np.int64),
+            add_recs,
+            sign=1,
+        )
+
+
+# --------------------------------------------------------------------------
+# the encoder
+# --------------------------------------------------------------------------
+
+
+def _wave_compatible(cs: ClusterSide, wfp: WaveFingerprint) -> bool:
+    """A cached cluster side serves a new wave either EXACTLY (equal
+    fingerprint → bit-identical to a fresh full encode) or as a SUPERSET
+    (every vocabulary entry the wave needs already exists; surplus label
+    literals / terms / ports / resource columns are inert, so the encoding is
+    decision-identical — asserted by tests/test_delta_encoder.py)."""
+    if cs.wfp == wfp:
+        return True
+    return (
+        wfp.referenced_keys <= cs.wfp.referenced_keys
+        and set(wfp.resources) <= set(cs.wfp.resources)
+        and all(tk in cs.voc.terms for tk in wfp.term_seq)
+        and all(pp in cs.voc.ports for pp in wfp.port_seq)
+    )
+
+
+class DeltaEncoder:
+    """Watch-cache-shaped encoder: `encode(snap)` each scheduling cycle.
+
+    Cycle cost is O(wave) + O(bound-pod diff); the cluster side rebuilds only
+    on node-set changes, wave-fingerprint changes, or vocabulary growth.
+    `encode_snapshot` (snapshot.py) is this class used one-shot."""
+
+    def __init__(self, *, bucket: bool = True, hard_pod_affinity_weight: float = 1.0):
+        self.bucket = bucket
+        self.hpaw = hard_pod_affinity_weight
+        self._cs: Optional[ClusterSide] = None
+        self._dev: Dict[str, Tuple] = {}  # field -> (host array, device array)
+        self.stats = {"full": 0, "delta": 0}
+
+    def encode_device(self, snap):
+        """encode(), with the ClusterArrays placed on device — fields whose
+        host array is IDENTICAL (by object) to the previous cycle's reuse the
+        resident device buffer, so a warm cluster re-transfers only the wave's
+        pod-side arrays and the delta-touched cluster state."""
+        import dataclasses as _dc
+
+        import jax
+
+        arr, meta = self.encode(snap)
+        out = {}
+        for f in _dc.fields(type(arr)):
+            a = getattr(arr, f.name)
+            ent = self._dev.get(f.name)
+            if ent is not None and (
+                ent[0] is a
+                # value dedup: steady-state waves from one template family
+                # produce bit-identical pod-side arrays — a host memcmp
+                # (~µs/MB) is far cheaper than re-transfer over PCIe/tunnel
+                or (
+                    ent[0].shape == a.shape
+                    and ent[0].dtype == a.dtype
+                    and np.array_equal(ent[0], a)
+                )
+            ):
+                out[f.name] = ent[1]
+            else:
+                d = jax.device_put(a)
+                self._dev[f.name] = (a, d)
+                out[f.name] = d
+        return type(arr)(**out), meta
+
+    def encode(self, snap):
+        from .snapshot import _resource_axis, activeq_order, group_by_spec
+        from .volumes import resolve_snapshot
+
+        snap = resolve_snapshot(snap)
+        pending = snap.pending_pods
+        perm = activeq_order(pending)
+        sorted_pending = [pending[i] for i in perm]
+        reps, inv = group_by_spec(sorted_pending)
+        resources = _resource_axis(snap)
+        wfp = wave_fingerprint(reps, resources)
+
+        cs = self._cs
+        if (
+            cs is not None
+            and cs.hpaw == self.hpaw
+            and cs.nodes_fp == _nodes_fp(snap.nodes)
+            and _wave_compatible(cs, wfp)
+        ):
+            try:
+                sync_bound(cs, snap.bound_pods)
+                self.stats["delta"] += 1
+            except _Fallback:
+                cs = None
+        else:
+            cs = None
+        if cs is None:
+            cs = build_cluster_side(snap.nodes, snap.bound_pods, wfp, self.hpaw)
+            cs.stats["rebuilds"] += 1
+            self._cs = cs
+            self.stats["full"] += 1
+        # remember this wave's spec reps so the next cycle's bind-absorb is
+        # O(1) per pod; size-capped so never-scheduled uids can't accumulate
+        # unboundedly (evicted uids just re-take the per-pod slow path)
+        if len(cs.wave_uid_rep) > 4 * (len(cs.records) + len(sorted_pending) + 1024):
+            cs.wave_uid_rep.clear()
+            cs.rep_bound_info.clear()
+        inv_list = inv.tolist()
+        for i, pod in enumerate(sorted_pending):
+            cs.wave_uid_rep[pod.uid] = reps[inv_list[i]]
+        return _assemble(cs, snap, reps, inv, perm, self.bucket)
+
+
+def _cached(cs: ClusterSide, name: str, key, builder):
+    """Padded-array cache: rebuild only when `key` changes, else return the
+    SAME object (numpy identity drives encode_device's transfer skipping).
+    Cached arrays are never mutated in place — syncs bump mut_version and the
+    next key mismatch builds a fresh copy."""
+    ent = cs.pad_cache.get(name)
+    if ent is not None and ent[0] == key:
+        return ent[1]
+    a = builder()
+    cs.pad_cache[name] = (key, a)
+    return a
+
+
+def _assemble(
+    cs: ClusterSide,
+    snap,
+    reps: Sequence[t.Pod],
+    inv: np.ndarray,
+    perm: np.ndarray,
+    bucket: bool,
+):
+    """Build the wave (pod-side) arrays against the resident cluster side and
+    assemble the full ClusterArrays + EncodingMeta."""
+    from .snapshot import (
+        _INT32_MAX,
+        _bucket,
+        _image_score_matrix,
+        _scale_for,
+        ClusterArrays,
+        EncodingMeta,
+        pod_effective_requests,
+    )
+
+    nodes = cs.nodes
+    pending = snap.pending_pods
+    n, p = len(nodes), len(pending)
+    N = _bucket(n) if bucket else max(1, n)
+    P = _bucket(p) if bucket else max(1, p)
+    resources = list(cs.wfp.resources)
+    R = len(resources)
+    U = len(reps)
+
+    # --- resources: scale re-derived from raw each cycle (bit-exact) ---
+    req_uniq = (
+        np.array([pod_effective_requests(rp, resources) for rp in reps], dtype=np.int64)
+        if U
+        else np.zeros((1, R), dtype=np.int64)
+    )
+    req_raw = req_uniq[inv] if p else np.zeros((0, R), dtype=np.int64)
+    alloc_uniq = np.unique(cs.alloc_raw, axis=0) if n else np.zeros((1, R), np.int64)
+    scale = np.ones(R, dtype=np.int64)
+    stacked = np.concatenate([alloc_uniq, req_uniq, cs.used_raw], axis=0)
+    for j in range(R):
+        scale[j] = _scale_for(stacked[:, j])
+    req_s = -(-req_raw // scale)
+    used_s = -(-cs.used_raw // scale)
+    alloc_s = cs.alloc_raw // scale
+
+    skey = tuple(scale.tolist())
+
+    def _pad2(src, dtype, fill=0):
+        out = np.full((N, src.shape[1]), fill, dtype=dtype)
+        out[:n] = src
+        return out
+
+    node_alloc = _cached(cs, "node_alloc", (N, skey), lambda: _pad2(alloc_s, np.int32))
+    node_used = _cached(
+        cs, "node_used", (N, skey, cs.mut_version), lambda: _pad2(used_s, np.int32)
+    )
+
+    def _valid():
+        a = np.zeros(N, dtype=bool)
+        a[:n] = True
+        return a
+
+    node_valid = _cached(cs, "node_valid", N, _valid)
+
+    def _unsched():
+        a = np.zeros(N, dtype=bool)
+        a[:n] = [nd.unschedulable for nd in nodes]
+        return a
+
+    node_unsched = _cached(cs, "node_unsched", N, _unsched)
+
+    L = cs.node_labels.shape[1]
+    node_labels = _cached(
+        cs, "node_labels", N, lambda: _pad2(cs.node_labels, np.float32)
+    )
+    T = cs.node_taint_ns.shape[1]
+    node_taint_ns = _cached(
+        cs, "node_taint_ns", N, lambda: _pad2(cs.node_taint_ns, bool)
+    )
+    node_taint_pref = _cached(
+        cs, "node_taint_pref", N, lambda: _pad2(cs.node_taint_pref, bool)
+    )
+
+    # --- pod side (all per unique spec, scattered through inv) ---
+    pod_valid = np.zeros(P, dtype=bool)
+    pod_req = np.zeros((P, R), dtype=np.int32)
+    pod_req[:p] = req_s
+    pod_prio = np.zeros(P, dtype=np.int32)
+    pod_tol_ns = np.ones((P, T), dtype=bool)
+    pod_tol_pref = np.ones((P, T), dtype=bool)
+    pod_nodename = np.full(P, -1, dtype=np.int32)
+
+    table = v.TermTable()
+    pod_term_lists: List[List[int]] = []
+    pref_lists: List[List[Tuple[int, float]]] = []
+    u_valid = np.empty(max(1, U), dtype=bool)
+    u_prio = np.zeros(max(1, U), dtype=np.int32)
+    u_tol_ns = np.ones((max(1, U), T), dtype=bool)
+    u_tol_pref = np.ones((max(1, U), T), dtype=bool)
+    u_nodename = np.full(max(1, U), -1, dtype=np.int32)
+    taint_objs = cs.taint_objs
+    taint_is_pref = np.array(
+        [tn.effect == t.PREFER_NO_SCHEDULE for tn in taint_objs], dtype=bool
+    )
+    for ui, pod in enumerate(reps):
+        u_valid[ui] = not pod.scheduling_gates
+        u_prio[ui] = pod.priority
+        if pod.tolerations:
+            for tid, taint in enumerate(taint_objs):
+                tol = any(tol.tolerates(taint) for tol in pod.tolerations)
+                if taint.effect == t.PREFER_NO_SCHEDULE:
+                    u_tol_pref[ui, tid] = tol
+                else:
+                    u_tol_ns[ui, tid] = tol
+        elif taint_objs:
+            u_tol_ns[ui] = taint_is_pref
+            u_tol_pref[ui] = ~taint_is_pref
+        if pod.node_name:
+            u_nodename[ui] = cs.node_index.get(pod.node_name, -2)
+        terms = v.pod_required_node_terms(pod, cs.lab)
+        pod_term_lists.append(
+            [] if terms is None else [table.intern(tm) for tm in terms]
+        )
+        prefs: List[Tuple[int, float]] = []
+        if pod.affinity:
+            for pt in pod.affinity.preferred_node_terms:
+                if pt.preference.match_expressions:
+                    prefs.append(
+                        (
+                            table.intern(
+                                v.lower_node_term(pt.preference.match_expressions, cs.lab)
+                            ),
+                            float(pt.weight),
+                        )
+                    )
+        pref_lists.append(prefs)
+    if p:
+        pod_valid[:p] = u_valid[inv]
+        pod_prio[:p] = u_prio[inv]
+        pod_tol_ns[:p] = u_tol_ns[inv]
+        pod_tol_pref[:p] = u_tol_pref[inv]
+        pod_nodename[:p] = u_nodename[inv]
+
+    TT = max(1, max((len(x) for x in pod_term_lists), default=1))
+    u_terms = np.full((max(1, U), TT), -1, dtype=np.int32)
+    u_has_sel = np.zeros(max(1, U), dtype=bool)
+    for ui, ids in enumerate(pod_term_lists):
+        if ids:
+            u_has_sel[ui] = True
+            u_terms[ui, : len(ids)] = ids
+    pod_terms = np.full((P, TT), -1, dtype=np.int32)
+    pod_has_sel = np.zeros(P, dtype=bool)
+    if p:
+        pod_terms[:p] = u_terms[inv]
+        pod_has_sel[:p] = u_has_sel[inv]
+
+    PW = max(1, max((len(x) for x in pref_lists), default=1))
+    u_pref_terms = np.full((max(1, U), PW), -1, dtype=np.int32)
+    u_pref_weights = np.zeros((max(1, U), PW), dtype=np.float32)
+    for ui, prefs in enumerate(pref_lists):
+        for a, (tid, w) in enumerate(prefs):
+            u_pref_terms[ui, a] = tid
+            u_pref_weights[ui, a] = w
+    pod_pref_terms = np.full((P, PW), -1, dtype=np.int32)
+    pod_pref_weights = np.zeros((P, PW), dtype=np.float32)
+    if p:
+        pod_pref_terms[:p] = u_pref_terms[inv]
+        pod_pref_weights[:p] = u_pref_weights[inv]
+
+    sel_mask, sel_kind = table.encode(L)
+
+    # --- gangs ---
+    group_ids = v.Interner()
+    u_group = np.full(max(1, U), -1, dtype=np.int32)
+    for ui, pod in enumerate(reps):
+        if pod.pod_group:
+            u_group[ui] = group_ids.intern(pod.pod_group)
+    pod_group = np.full(P, -1, dtype=np.int32)
+    if p:
+        pod_group[:p] = u_group[inv]
+    G = max(1, len(group_ids))
+    group_min = np.ones(G, dtype=np.int32)
+    if len(group_ids):
+        counts = np.bincount(pod_group[pod_group >= 0], minlength=G)
+        for gi, gname in enumerate(group_ids.items):
+            pg = snap.pod_groups.get(gname)
+            group_min[gi] = pg.min_member if pg else int(counts[gi])
+
+    # --- pairwise wave side against the resident vocab/counts ---
+    T2 = max(1, len(cs.voc.terms))
+    K = cs.node_dom.shape[0]
+    D1 = cs.term_counts0.shape[1]
+    def _dom():
+        a = np.full((K, N), D1 - 1, dtype=np.int32)
+        if n:
+            a[:, :n] = cs.node_dom[:, :n]
+        return a
+
+    node_dom = _cached(cs, "node_dom", N, _dom)
+
+    pod_aff: List[List[int]] = []
+    pod_anti: List[List[int]] = []
+    pod_prefp: List[List[Tuple[int, float]]] = []
+    pod_spread: List[List[Tuple[int, int, int]]] = []
+    for pod in reps:
+        aff, anti, pref, spread = _pod_pairwise_terms(pod)
+        pod_aff.append([cs.voc.terms.get(tk) for tk in aff])
+        pod_anti.append([cs.voc.terms.get(tk) for tk in anti])
+        pod_prefp.append([(cs.voc.terms.get(tk), w) for tk, w in pref])
+        pod_spread.append(
+            [(cs.voc.terms.get(tk), skew, mode) for tk, skew, mode in spread]
+        )
+
+    m_pend = np.zeros((T2, P), dtype=np.float32)
+    if p and cs.terms_list:
+        m_uniq = _match_matrix(cs.terms_list, list(reps))  # [T2, U]
+        m_pend[:, :p] = m_uniq[:, inv]
+
+    A1 = max(1, max((len(x) for x in pod_aff), default=1))
+    A2 = max(1, max((len(x) for x in pod_anti), default=1))
+    B = max(1, max((len(x) for x in pod_prefp), default=1))
+    C = max(1, max((len(x) for x in pod_spread), default=1))
+    Uq = max(1, U)
+    u_aff = np.full((Uq, A1), -1, dtype=np.int32)
+    u_anti = np.full((Uq, A2), -1, dtype=np.int32)
+    u_pref_t = np.full((Uq, B), -1, dtype=np.int32)
+    u_pref_w = np.zeros((Uq, B), dtype=np.float32)
+    u_spread_t = np.full((Uq, C), -1, dtype=np.int32)
+    u_spread_skew = np.zeros((Uq, C), dtype=np.int32)
+    u_spread_hard = np.zeros((Uq, C), dtype=bool)
+    for ui in range(U):
+        for a, ti in enumerate(pod_aff[ui]):
+            u_aff[ui, a] = ti
+        for a, ti in enumerate(pod_anti[ui]):
+            u_anti[ui, a] = ti
+        for a, (ti, w) in enumerate(pod_prefp[ui]):
+            u_pref_t[ui, a] = ti
+            u_pref_w[ui, a] = np.float32(w)
+        for c, (ti, skew, mode) in enumerate(pod_spread[ui]):
+            u_spread_t[ui, c] = ti
+            u_spread_skew[ui, c] = skew
+            u_spread_hard[ui, c] = mode == HARD
+    pod_aff_terms = np.full((P, A1), -1, dtype=np.int32)
+    pod_anti_terms = np.full((P, A2), -1, dtype=np.int32)
+    pod_pref_aff_terms = np.full((P, B), -1, dtype=np.int32)
+    pod_pref_aff_w = np.zeros((P, B), dtype=np.float32)
+    pod_spread_terms = np.full((P, C), -1, dtype=np.int32)
+    pod_spread_maxskew = np.zeros((P, C), dtype=np.int32)
+    pod_spread_hard = np.zeros((P, C), dtype=bool)
+    if p:
+        pod_aff_terms[:p] = u_aff[inv]
+        pod_anti_terms[:p] = u_anti[inv]
+        pod_pref_aff_terms[:p] = u_pref_t[inv]
+        pod_pref_aff_w[:p] = u_pref_w[inv]
+        pod_spread_terms[:p] = u_spread_t[inv]
+        pod_spread_maxskew[:p] = u_spread_skew[inv]
+        pod_spread_hard[:p] = u_spread_hard[inv]
+
+    # --- ports ---
+    PT = cs.node_port_count.shape[1]
+    u_ports = np.zeros((Uq, PT), dtype=bool)
+    for ui, pod in enumerate(reps):
+        for pp in pod.host_ports:
+            u_ports[ui, cs.voc.ports.get(pp)] = True
+    pod_ports = np.zeros((P, PT), dtype=bool)
+    if p:
+        pod_ports[:p] = u_ports[inv]
+    node_ports0 = _cached(
+        cs,
+        "node_ports0",
+        (N, cs.mut_version),
+        lambda: _pad2(cs.node_port_count > 0, bool),
+    )
+
+    arrays = ClusterArrays(
+        node_valid=node_valid,
+        node_alloc=node_alloc,
+        node_used=node_used,
+        node_unsched=node_unsched,
+        node_labels=node_labels,
+        node_taint_ns=node_taint_ns,
+        node_taint_pref=node_taint_pref,
+        pod_valid=pod_valid,
+        pod_req=pod_req,
+        pod_prio=pod_prio,
+        pod_tol_ns=pod_tol_ns,
+        pod_tol_pref=pod_tol_pref,
+        pod_nodename=pod_nodename,
+        pod_terms=pod_terms,
+        pod_has_sel=pod_has_sel,
+        sel_mask=sel_mask,
+        sel_kind=sel_kind,
+        pod_pref_terms=pod_pref_terms,
+        pod_pref_weights=pod_pref_weights,
+        pod_group=pod_group,
+        group_min=group_min,
+        image_score=_image_score_matrix(nodes, reps, inv, N, P),
+        node_dom=node_dom,
+        term_key=_cached(cs, "term_key", 0, cs.term_key.copy),
+        m_pend=m_pend,
+        term_counts0=_cached(
+            cs, "term_counts0", cs.mut_version, cs.term_counts0.copy
+        ),
+        anti_counts0=_cached(
+            cs, "anti_counts0", cs.mut_version, cs.anti_counts0.copy
+        ),
+        pref_own0=_cached(cs, "pref_own0", cs.mut_version, cs.pref_own0.copy),
+        pod_aff_terms=pod_aff_terms,
+        pod_anti_terms=pod_anti_terms,
+        pod_pref_aff_terms=pod_pref_aff_terms,
+        pod_pref_aff_w=pod_pref_aff_w,
+        pod_spread_terms=pod_spread_terms,
+        pod_spread_maxskew=pod_spread_maxskew,
+        pod_spread_hard=pod_spread_hard,
+        pod_ports=pod_ports,
+        node_ports0=node_ports0,
+    )
+    meta = EncodingMeta(
+        node_names=[nd.name for nd in nodes],
+        pod_names=[pending[i].name for i in perm],
+        pod_perm=perm,
+        resources=resources,
+        resource_scale=scale,
+        label_vocab=cs.lab,
+        taint_vocab=cs.taints,
+        pairwise_vocab=cs.voc,
+        n_nodes=n,
+        n_pods=p,
+    )
+    return arrays, meta

@@ -121,246 +121,3 @@ def _match_matrix(terms: List[TermKey], pods: Sequence[t.Pod]) -> np.ndarray:
     return ok[np.array(ids)].astype(np.float32)
 
 
-def build_pairwise(
-    nodes: Sequence[t.Node],
-    pending: Sequence[t.Pod],  # unique specs in first-occurrence activeQ order
-    bound: Sequence[t.Pod],
-    node_index: Dict[str, int],
-    N: int,
-    P: int,
-    hard_pod_affinity_weight: float = 1.0,
-    pending_inv: Optional[np.ndarray] = None,
-):
-    """Returns (PairwiseVocab, dict of arrays) — see ClusterArrays for shapes.
-
-    `pending` holds the UNIQUE pending-pod specs (snapshot.group_by_spec) and
-    `pending_inv[i]` each sorted pod's spec index: per-spec term collection and
-    the match matmul run over U specs, and rows scatter to the P pod axis.
-    Omitting pending_inv treats `pending` as the literal per-pod list."""
-    if pending_inv is None:
-        pending_inv = np.arange(len(pending), dtype=np.int64)
-    inv = pending_inv
-    p = len(inv)
-    voc = PairwiseVocab(v.Interner(), v.Interner(), v.Interner(), v.Interner())
-
-    # ---- collect terms from every pending AND bound pod (bound pods' anti
-    # terms constrain incoming pods symmetrically) ----
-    pod_aff: List[List[int]] = []
-    pod_anti: List[List[int]] = []
-    pod_pref: List[List[Tuple[int, float]]] = []  # (term, signed weight)
-    pod_spread: List[List[Tuple[int, int, int]]] = []  # (term, maxSkew, mode)
-    for pod in pending:
-        aff_ids, anti_ids, spread_ids = [], [], []
-        pref_ids: List[Tuple[int, float]] = []
-        if pod.affinity:
-            for term in pod.affinity.required_pod_affinity:
-                aff_ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
-            for term in pod.affinity.required_pod_anti_affinity:
-                anti_ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
-            for wt in pod.affinity.preferred_pod_affinity:
-                pref_ids.append(
-                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), float(wt.weight))
-                )
-            for wt in pod.affinity.preferred_pod_anti_affinity:
-                pref_ids.append(
-                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), -float(wt.weight))
-                )
-        for c in pod.topology_spread:
-            spread_ids.append(
-                (
-                    voc.terms.intern(_term_of_spread(c, pod.namespace)),
-                    c.max_skew,
-                    HARD if c.when_unsatisfiable == t.DO_NOT_SCHEDULE else SOFT,
-                )
-            )
-        pod_aff.append(aff_ids)
-        pod_anti.append(anti_ids)
-        pod_pref.append(pref_ids)
-        pod_spread.append(spread_ids)
-
-    # bound pods intern by (labels, namespace, affinity): term collection and
-    # the bound-side match matmul run once per unique spec
-    b_ids: Dict[Tuple, int] = {}
-    b_reps: List[t.Pod] = []
-    b_inv: List[int] = []
-    b_nodes: List[int] = []
-    for q in bound:
-        ni = node_index.get(q.node_name)
-        if ni is None:
-            continue
-        key = (tuple(sorted(q.labels.items())), q.namespace, q.affinity)
-        u = b_ids.get(key)
-        if u is None:
-            u = len(b_reps)
-            b_ids[key] = u
-            b_reps.append(q)
-        b_inv.append(u)
-        b_nodes.append(ni)
-    bound_anti: List[List[int]] = []
-    bound_pref: List[List[Tuple[int, float]]] = []
-    for pod in b_reps:
-        ids = []
-        pref_ids = []
-        if pod.affinity:
-            for term in pod.affinity.required_pod_anti_affinity:
-                ids.append(voc.terms.intern(_term_of_affinity(term, pod.namespace)))
-            for wt in pod.affinity.preferred_pod_affinity:
-                pref_ids.append(
-                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), float(wt.weight))
-                )
-            for wt in pod.affinity.preferred_pod_anti_affinity:
-                pref_ids.append(
-                    (voc.terms.intern(_term_of_affinity(wt.term, pod.namespace)), -float(wt.weight))
-                )
-            if hard_pod_affinity_weight:
-                # existing pods' REQUIRED affinity terms score toward incoming
-                # pods at hardPodAffinityWeight (scoring.go — processExistingPod)
-                for term in pod.affinity.required_pod_affinity:
-                    pref_ids.append(
-                        (
-                            voc.terms.intern(_term_of_affinity(term, pod.namespace)),
-                            float(hard_pod_affinity_weight),
-                        )
-                    )
-        bound_anti.append(ids)
-        bound_pref.append(pref_ids)
-
-    # ---- topology keys + domains over the node set ----
-    for tk in [tm.topology_key for tm in voc.terms.items]:
-        voc.topo_keys.intern(tk)
-    K = max(1, len(voc.topo_keys))
-    for nd in nodes:
-        for tk in voc.topo_keys.items:
-            if tk in nd.labels:
-                voc.domains.intern((tk, nd.labels[tk]))
-    D = len(voc.domains)  # sentinel column D = key absent
-
-    node_dom = np.full((K, N), D, dtype=np.int32)
-    for i, nd in enumerate(nodes):
-        for k, tk in enumerate(voc.topo_keys.items):
-            if tk in nd.labels:
-                node_dom[k, i] = voc.domains.get((tk, nd.labels[tk]))
-
-    T = max(1, len(voc.terms))
-    term_key = np.zeros(T, dtype=np.int32)
-    for ti, term in enumerate(voc.terms.items):
-        term_key[ti] = voc.topo_keys.get(term.topology_key)
-
-    # ---- host-side match matrices: vectorized AnyOf/NoneOf matmuls over
-    # unique specs, gathered per pod ----
-    terms_list = list(voc.terms.items)
-    m_pend = np.zeros((T, P), dtype=np.float32)
-    if p:
-        m_uniq = _match_matrix(terms_list, pending)  # [T, U]
-        m_pend[: m_uniq.shape[0], :p] = m_uniq[:, inv]
-    bnodes = np.array(b_nodes, dtype=np.int64)
-    binv = np.array(b_inv, dtype=np.int64)
-    term_counts0 = np.zeros((T, D + 1), dtype=np.float32)
-    if len(bnodes) and terms_list:
-        m_bound_u = _match_matrix(terms_list, b_reps)  # [T, Ub]
-        for ti in range(len(terms_list)):
-            np.add.at(
-                term_counts0[ti], node_dom[term_key[ti], bnodes], m_bound_u[ti, binv]
-            )
-    # group bound pods by unique spec once (argsort) so the anti/pref scatters
-    # touch only specs that own terms
-    anti_counts0 = np.zeros((T, D + 1), dtype=np.float32)
-    pref_own0 = np.zeros((T, D + 1), dtype=np.float32)
-    if len(bnodes):
-        order = np.argsort(binv, kind="stable")
-        starts = np.searchsorted(binv[order], np.arange(len(b_reps) + 1))
-        for u in range(len(b_reps)):
-            ids = bound_anti[u]
-            prefs = bound_pref[u]
-            if not ids and not prefs:
-                continue
-            rows = bnodes[order[starts[u] : starts[u + 1]]]
-            for ti in ids:
-                np.add.at(anti_counts0[ti], node_dom[term_key[ti], rows], 1.0)
-            # weight-weighted counts of existing pods OWNING preferred terms,
-            # per their domain (the symmetric half of preferred scoring)
-            for ti, w in prefs:
-                np.add.at(pref_own0[ti], node_dom[term_key[ti], rows], np.float32(w))
-
-    # ---- per-pod term id arrays (padded; built per spec, gathered) ----
-    A1 = max(1, max((len(x) for x in pod_aff), default=1))
-    A2 = max(1, max((len(x) for x in pod_anti), default=1))
-    B = max(1, max((len(x) for x in pod_pref), default=1))
-    C = max(1, max((len(x) for x in pod_spread), default=1))
-    Uq = max(1, len(pending))
-    u_aff = np.full((Uq, A1), -1, dtype=np.int32)
-    u_anti = np.full((Uq, A2), -1, dtype=np.int32)
-    u_pref_t = np.full((Uq, B), -1, dtype=np.int32)
-    u_pref_w = np.zeros((Uq, B), dtype=np.float32)
-    u_spread_t = np.full((Uq, C), -1, dtype=np.int32)
-    u_spread_skew = np.zeros((Uq, C), dtype=np.int32)
-    u_spread_hard = np.zeros((Uq, C), dtype=bool)
-    for ui in range(len(pending)):
-        for a, ti in enumerate(pod_aff[ui]):
-            u_aff[ui, a] = ti
-        for a, ti in enumerate(pod_anti[ui]):
-            u_anti[ui, a] = ti
-        for a, (ti, w) in enumerate(pod_pref[ui]):
-            u_pref_t[ui, a] = ti
-            u_pref_w[ui, a] = np.float32(w)
-        for c, (ti, skew, mode) in enumerate(pod_spread[ui]):
-            u_spread_t[ui, c] = ti
-            u_spread_skew[ui, c] = skew
-            u_spread_hard[ui, c] = mode == HARD
-    pod_aff_terms = np.full((P, A1), -1, dtype=np.int32)
-    pod_anti_terms = np.full((P, A2), -1, dtype=np.int32)
-    pod_pref_aff_terms = np.full((P, B), -1, dtype=np.int32)
-    pod_pref_aff_w = np.zeros((P, B), dtype=np.float32)
-    pod_spread_terms = np.full((P, C), -1, dtype=np.int32)
-    pod_spread_maxskew = np.zeros((P, C), dtype=np.int32)
-    pod_spread_hard = np.zeros((P, C), dtype=bool)
-    if p:
-        pod_aff_terms[:p] = u_aff[inv]
-        pod_anti_terms[:p] = u_anti[inv]
-        pod_pref_aff_terms[:p] = u_pref_t[inv]
-        pod_pref_aff_w[:p] = u_pref_w[inv]
-        pod_spread_terms[:p] = u_spread_t[inv]
-        pod_spread_maxskew[:p] = u_spread_skew[inv]
-        pod_spread_hard[:p] = u_spread_hard[inv]
-
-    # ---- host ports ----
-    for pod in pending:
-        for proto, port in pod.host_ports:
-            voc.ports.intern((proto, port))
-    for pod in bound:
-        for proto, port in pod.host_ports:
-            voc.ports.intern((proto, port))
-    PT = max(1, len(voc.ports))
-    u_ports = np.zeros((Uq, PT), dtype=bool)
-    for ui, pod in enumerate(pending):
-        for proto, port in pod.host_ports:
-            u_ports[ui, voc.ports.get((proto, port))] = True
-    pod_ports = np.zeros((P, PT), dtype=bool)
-    if p:
-        pod_ports[:p] = u_ports[inv]
-    node_ports0 = np.zeros((N, PT), dtype=bool)
-    for pod in bound:
-        ni = node_index.get(pod.node_name)
-        if ni is None:
-            continue
-        for proto, port in pod.host_ports:
-            node_ports0[ni, voc.ports.get((proto, port))] = True
-
-    arrays = dict(
-        node_dom=node_dom,
-        term_key=term_key,
-        m_pend=m_pend,
-        term_counts0=term_counts0,
-        anti_counts0=anti_counts0,
-        pod_aff_terms=pod_aff_terms,
-        pod_anti_terms=pod_anti_terms,
-        pod_pref_aff_terms=pod_pref_aff_terms,
-        pod_pref_aff_w=pod_pref_aff_w,
-        pref_own0=pref_own0,
-        pod_spread_terms=pod_spread_terms,
-        pod_spread_maxskew=pod_spread_maxskew,
-        pod_spread_hard=pod_spread_hard,
-        pod_ports=pod_ports,
-        node_ports0=node_ports0,
-    )
-    return voc, arrays

@@ -205,10 +205,12 @@ def pod_effective_requests(pod: t.Pod, resources: Sequence[str]) -> List[int]:
 
 def activeq_order(pods: Sequence[t.Pod]) -> np.ndarray:
     """Indices sorting pods into activeQ pop order: priority desc, arrival asc
-    (reference: queue sort plugin — PrioritySort.Less)."""
-    return np.array(
-        sorted(range(len(pods)), key=lambda i: (-pods[i].priority, i)), dtype=np.int64
+    (reference: queue sort plugin — PrioritySort.Less).  Stable argsort on
+    -priority keeps arrival order within a priority band."""
+    prio = np.fromiter(
+        (p.priority for p in pods), dtype=np.int64, count=len(pods)
     )
+    return np.argsort(-prio, kind="stable")
 
 
 _IMG_MIN_MB = 23.0  # imagelocality/image_locality.go — minThreshold (23 MB)
@@ -320,326 +322,11 @@ def _node_taints(nd: t.Node) -> List[t.Taint]:
 def encode_snapshot(
     snap: Snapshot, *, bucket: bool = True, hard_pod_affinity_weight: float = 1.0
 ) -> Tuple[ClusterArrays, EncodingMeta]:
-    from .volumes import resolve_snapshot
+    """One-shot encode: a DeltaEncoder used for a single cycle (delta.py owns
+    the staged implementation, so the incremental watch-driven path and this
+    full path are one code body and cannot drift)."""
+    from .delta import DeltaEncoder
 
-    snap = resolve_snapshot(snap)
-    nodes, pending = snap.nodes, snap.pending_pods
-    n, p = len(nodes), len(pending)
-    N = _bucket(n) if bucket else max(1, n)
-    P = _bucket(p) if bucket else max(1, p)
-
-    resources = _resource_axis(snap)
-    R = len(resources)
-
-    # Spec interning: pods stamped from one template share all
-    # encoding-relevant fields, so every per-pod computation below runs once
-    # per unique spec (U ≪ P for real workloads) and results scatter to pod
-    # rows through `inv` — the encoder's Python cost stops scaling with the
-    # wave size (SURVEY.md §7 hard part 4: the host must not be the bottleneck).
-    perm = activeq_order(pending)
-    sorted_pending = [pending[i] for i in perm]
-    reps, inv = group_by_spec(sorted_pending)
-    U = len(reps)
-
-    # --- label vocab over node labels (selectors lower against this) ---
-    # Only label KEYS referenced by some pod's nodeSelector / node-affinity
-    # expression enter the literal vocab: unreferenced labels (notably the
-    # per-node kubernetes.io/hostname) cannot influence any selector, and
-    # would otherwise blow the L axis up to O(N).  Topology keys are interned
-    # separately as domains (api/pairwise.py).
-    referenced_keys = set()
-    for pod in reps:
-        for k, _ in pod.node_selector:
-            referenced_keys.add(k)
-        if pod.affinity:
-            for term in pod.affinity.required_node_terms:
-                for e in term.match_expressions:
-                    referenced_keys.add(e.key)
-            for pt in pod.affinity.preferred_node_terms:
-                for e in pt.preference.match_expressions:
-                    referenced_keys.add(e.key)
-    # nodes intern by filtered-label profile (zone-style labels repeat across
-    # the fleet; per-node hostname enters only when a pod references it)
-    lab = v.LabelVocab()
-    nlab_ids: Dict[Tuple, int] = {}
-    nlab_rows: List[List[int]] = []
-    nlab_inv = np.empty(n, dtype=np.int64)
-    for i, nd in enumerate(nodes):
-        # sorted key: two nodes with equal filtered label SETS share a profile
-        # regardless of dict insertion order
-        fk = tuple(sorted((k, val) for k, val in nd.labels.items() if k in referenced_keys))
-        u = nlab_ids.get(fk)
-        if u is None:
-            u = len(nlab_rows)
-            nlab_ids[fk] = u
-            nlab_rows.append(lab.add_labels(dict(fk)))
-        nlab_inv[i] = u
-
-    # --- taint vocab (interned by node taint profile) ---
-    taints = v.Interner()
-    tprof_ids: Dict[Tuple, int] = {}
-    tprof: List[List[t.Taint]] = []
-    tinv = np.empty(n, dtype=np.int64)
-    for i, nd in enumerate(nodes):
-        key = (nd.taints, nd.unschedulable)
-        u = tprof_ids.get(key)
-        if u is None:
-            u = len(tprof)
-            tprof_ids[key] = u
-            ts = _node_taints(nd)
-            tprof.append(ts)
-            for tn in ts:
-                taints.intern((tn.key, tn.value, tn.effect))
-        tinv[i] = u
-    T = max(1, len(taints))
-
-    # --- raw quantities, then per-resource rescale to int32 ---
-    node_index = {nd.name: i for i, nd in enumerate(nodes)}
-    aprof_ids: Dict[Tuple, int] = {}
-    arows: List[List[int]] = []
-    ainv = np.empty(n, dtype=np.int64)
-    for i, nd in enumerate(nodes):
-        key = tuple(sorted(nd.allocatable.items()))
-        u = aprof_ids.get(key)
-        if u is None:
-            u = len(arows)
-            aprof_ids[key] = u
-            arows.append(
-                [
-                    nd.allocatable.get(r, _DEFAULT_POD_LIMIT if r == t.PODS else 0)
-                    for r in resources
-                ]
-            )
-        ainv[i] = u
-    alloc_uniq = (
-        np.array(arows, dtype=np.int64) if arows else np.zeros((1, R), dtype=np.int64)
-    )
-    alloc_raw = alloc_uniq[ainv] if n else np.zeros((0, R), dtype=np.int64)
-
-    req_uniq = (
-        np.array([pod_effective_requests(rp, resources) for rp in reps], dtype=np.int64)
-        if U
-        else np.zeros((1, R), dtype=np.int64)
-    )
-    req_raw = req_uniq[inv] if p else np.zeros((0, R), dtype=np.int64)
-
-    used_raw = np.zeros((n, R), dtype=np.int64)
-    breq_ids: Dict[Tuple, int] = {}
-    brows: List[List[int]] = []
-    b_nodes: List[int] = []
-    b_u: List[int] = []
-    for bp in snap.bound_pods:
-        i = node_index.get(bp.node_name)
-        if i is None:
-            continue
-        key = tuple(sorted(bp.requests.items()))
-        u = breq_ids.get(key)
-        if u is None:
-            u = len(brows)
-            breq_ids[key] = u
-            brows.append(pod_effective_requests(bp, resources))
-        b_nodes.append(i)
-        b_u.append(u)
-    if b_nodes:
-        np.add.at(
-            used_raw,
-            np.array(b_nodes, dtype=np.int64),
-            np.array(brows, dtype=np.int64)[np.array(b_u, dtype=np.int64)],
-        )
-
-    # per-resource int32 rescale: gcd over unique values (duplicates cannot
-    # change a gcd or max), vectorized
-    scale = np.ones(R, dtype=np.int64)
-    stacked = np.concatenate([alloc_uniq, req_uniq, used_raw], axis=0)
-    for j in range(R):
-        scale[j] = _scale_for(stacked[:, j])
-    # ceil for demand, floor for supply when the unit is inexact (conservative)
-    req_s = -(-req_raw // scale)
-    used_s = -(-used_raw // scale)
-    alloc_s = alloc_raw // scale
-
-    node_alloc = np.zeros((N, R), dtype=np.int32)
-    node_used = np.zeros((N, R), dtype=np.int32)
-    node_alloc[:n] = alloc_s
-    node_used[:n] = used_s
-
-    node_valid = np.zeros(N, dtype=bool)
-    node_valid[:n] = True
-    node_unsched = np.zeros(N, dtype=bool)
-    node_unsched[:n] = [nd.unschedulable for nd in nodes]
-
-    L = max(1, len(lab))
-    node_labels = np.zeros((N, L), dtype=np.float32)
-    if n:
-        lab_uniq = np.zeros((max(1, len(nlab_rows)), L), dtype=np.float32)
-        for u, lits in enumerate(nlab_rows):
-            lab_uniq[u, lits] = 1.0
-        node_labels[:n] = lab_uniq[nlab_inv]
-
-    node_taint_ns = np.zeros((N, T), dtype=bool)
-    node_taint_pref = np.zeros((N, T), dtype=bool)
-    if n:
-        tns_uniq = np.zeros((max(1, len(tprof)), T), dtype=bool)
-        tpref_uniq = np.zeros((max(1, len(tprof)), T), dtype=bool)
-        for u, ts in enumerate(tprof):
-            for tn in ts:
-                tid = taints.get((tn.key, tn.value, tn.effect))
-                if tn.effect == t.PREFER_NO_SCHEDULE:
-                    tpref_uniq[u, tid] = True
-                else:
-                    tns_uniq[u, tid] = True
-        node_taint_ns[:n] = tns_uniq[tinv]
-        node_taint_pref[:n] = tpref_uniq[tinv]
-
-    # --- pods (in activeQ order; all per-spec, scattered through inv) ---
-    # SchedulingGates: gated pods never enter the schedulable set (reference:
-    # schedulinggates/scheduling_gates.go — PreEnqueue holds them out of activeQ);
-    # they come back with verdict -1 (still pending).
-    pod_valid = np.zeros(P, dtype=bool)
-    pod_req = np.zeros((P, R), dtype=np.int32)
-    pod_req[:p] = req_s
-    pod_prio = np.zeros(P, dtype=np.int32)
-    pod_tol_ns = np.ones((P, T), dtype=bool)  # default: padding tolerates all
-    pod_tol_pref = np.ones((P, T), dtype=bool)
-    pod_nodename = np.full(P, -1, dtype=np.int32)
-
-    table = v.TermTable()
-    pod_term_lists: List[List[int]] = []
-    pref_lists: List[List[Tuple[int, float]]] = []
-    u_valid = np.empty(max(1, U), dtype=bool)
-    u_prio = np.zeros(max(1, U), dtype=np.int32)
-    u_tol_ns = np.ones((max(1, U), T), dtype=bool)
-    u_tol_pref = np.ones((max(1, U), T), dtype=bool)
-    u_nodename = np.full(max(1, U), -1, dtype=np.int32)
-    taint_objs = [t.Taint(tk, tv, te) for (tk, tv, te) in taints.items]
-    # a taint's effect class is a property of the vocab, not the pod: each
-    # tol row only masks its own effect class (the other stays default-True)
-    taint_is_pref = np.array(
-        [tn.effect == t.PREFER_NO_SCHEDULE for tn in taint_objs], dtype=bool
-    )
-    for ui, pod in enumerate(reps):
-        u_valid[ui] = not pod.scheduling_gates
-        u_prio[ui] = pod.priority
-        if pod.tolerations:
-            for tid, taint in enumerate(taint_objs):
-                tol = any(tol.tolerates(taint) for tol in pod.tolerations)
-                if taint.effect == t.PREFER_NO_SCHEDULE:
-                    u_tol_pref[ui, tid] = tol
-                else:
-                    u_tol_ns[ui, tid] = tol
-        elif taint_objs:
-            u_tol_ns[ui] = taint_is_pref  # no tolerations: intolerant of every
-            u_tol_pref[ui] = ~taint_is_pref  # taint in the row's effect class
-        if pod.node_name:
-            u_nodename[ui] = node_index.get(pod.node_name, -2)
-        terms = v.pod_required_node_terms(pod, lab)
-        pod_term_lists.append([] if terms is None else [table.intern(tm) for tm in terms])
-        # preferred node affinity: weight per matching term (empty term matches
-        # nothing, mirroring the required path)
-        prefs: List[Tuple[int, float]] = []
-        if pod.affinity:
-            for pt in pod.affinity.preferred_node_terms:
-                if pt.preference.match_expressions:
-                    prefs.append(
-                        (table.intern(v.lower_node_term(pt.preference.match_expressions, lab)), float(pt.weight))
-                    )
-        pref_lists.append(prefs)
-    if p:
-        pod_valid[:p] = u_valid[inv]
-        pod_prio[:p] = u_prio[inv]
-        pod_tol_ns[:p] = u_tol_ns[inv]
-        pod_tol_pref[:p] = u_tol_pref[inv]
-        pod_nodename[:p] = u_nodename[inv]
-
-    TT = max(1, max((len(x) for x in pod_term_lists), default=1))
-    u_terms = np.full((max(1, U), TT), -1, dtype=np.int32)
-    u_has_sel = np.zeros(max(1, U), dtype=bool)
-    for ui, ids in enumerate(pod_term_lists):
-        if ids:
-            u_has_sel[ui] = True
-            u_terms[ui, : len(ids)] = ids
-    pod_terms = np.full((P, TT), -1, dtype=np.int32)
-    pod_has_sel = np.zeros(P, dtype=bool)
-    if p:
-        pod_terms[:p] = u_terms[inv]
-        pod_has_sel[:p] = u_has_sel[inv]
-
-    PW = max(1, max((len(x) for x in pref_lists), default=1))
-    u_pref_terms = np.full((max(1, U), PW), -1, dtype=np.int32)
-    u_pref_weights = np.zeros((max(1, U), PW), dtype=np.float32)
-    for ui, prefs in enumerate(pref_lists):
-        for a, (tid, w) in enumerate(prefs):
-            u_pref_terms[ui, a] = tid
-            u_pref_weights[ui, a] = w
-    pod_pref_terms = np.full((P, PW), -1, dtype=np.int32)
-    pod_pref_weights = np.zeros((P, PW), dtype=np.float32)
-    if p:
-        pod_pref_terms[:p] = u_pref_terms[inv]
-        pod_pref_weights[:p] = u_pref_weights[inv]
-
-    sel_mask, sel_kind = table.encode(L)
-
-    # gang groups: pods referencing a PodGroup name share an index; minMember
-    # defaults to the group's pod count when no PodGroup object is given
-    group_ids = v.Interner()
-    u_group = np.full(max(1, U), -1, dtype=np.int32)
-    for ui, pod in enumerate(reps):
-        if pod.pod_group:
-            u_group[ui] = group_ids.intern(pod.pod_group)
-    pod_group = np.full(P, -1, dtype=np.int32)
-    if p:
-        pod_group[:p] = u_group[inv]
-    G = max(1, len(group_ids))
-    group_min = np.ones(G, dtype=np.int32)
-    if len(group_ids):
-        counts = np.bincount(pod_group[pod_group >= 0], minlength=G)
-        for gi, gname in enumerate(group_ids.items):
-            pg = snap.pod_groups.get(gname)
-            group_min[gi] = pg.min_member if pg else int(counts[gi])
-
-    from .pairwise import build_pairwise
-
-    _pair_voc, pair = build_pairwise(
-        nodes, reps, snap.bound_pods, node_index, N, P,
-        hard_pod_affinity_weight=hard_pod_affinity_weight,
-        pending_inv=inv,
-    )
-
-    arrays = ClusterArrays(
-        node_valid=node_valid,
-        node_alloc=node_alloc,
-        node_used=node_used,
-        node_unsched=node_unsched,
-        node_labels=node_labels,
-        node_taint_ns=node_taint_ns,
-        node_taint_pref=node_taint_pref,
-        pod_valid=pod_valid,
-        pod_req=pod_req,
-        pod_prio=pod_prio,
-        pod_tol_ns=pod_tol_ns,
-        pod_tol_pref=pod_tol_pref,
-        pod_nodename=pod_nodename,
-        pod_terms=pod_terms,
-        pod_has_sel=pod_has_sel,
-        sel_mask=sel_mask,
-        sel_kind=sel_kind,
-        pod_pref_terms=pod_pref_terms,
-        pod_pref_weights=pod_pref_weights,
-        pod_group=pod_group,
-        group_min=group_min,
-        image_score=_image_score_matrix(nodes, reps, inv, N, P),
-        **pair,
-    )
-    meta = EncodingMeta(
-        node_names=[nd.name for nd in nodes],
-        pod_names=[pending[i].name for i in perm],
-        pod_perm=perm,
-        resources=resources,
-        resource_scale=scale,
-        label_vocab=lab,
-        taint_vocab=taints,
-        pairwise_vocab=_pair_voc,
-        n_nodes=n,
-        n_pods=p,
-    )
-    return arrays, meta
+    return DeltaEncoder(
+        bucket=bucket, hard_pod_affinity_weight=hard_pod_affinity_weight
+    ).encode(snap)

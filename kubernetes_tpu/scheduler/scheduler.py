@@ -100,6 +100,10 @@ class Scheduler:
             )
         )
         self._sidecar = None  # lazy TPUScoreClient when profile configures one
+        # resident incremental encoder for the batch path: cluster-side device
+        # state persists across cycles, absorbing bind/delete deltas
+        # (api/delta.py — the watch-cache analog)
+        self._delta_enc = None
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -467,9 +471,16 @@ class Scheduler:
                 return result
         if verdicts is None:
             base_cfg = self.config.score_config()
-            arr, meta = encode_snapshot(
-                snap, hard_pod_affinity_weight=base_cfg.hard_pod_affinity_weight
-            )
+            if (
+                self._delta_enc is None
+                or self._delta_enc.hpaw != base_cfg.hard_pod_affinity_weight
+            ):
+                from ..api.delta import DeltaEncoder
+
+                self._delta_enc = DeltaEncoder(
+                    hard_pod_affinity_weight=base_cfg.hard_pod_affinity_weight
+                )
+            arr, meta = self._delta_enc.encode(snap)
             cfg = infer_score_config(arr, base_cfg)
             if self.config.mode == "native":
                 from ..native import schedule_batch_native, schedule_with_gangs_native

@@ -1,0 +1,189 @@
+"""DeltaEncoder: incremental (watch-delta) encoding must be BIT-IDENTICAL to a
+from-scratch encode of the same cluster state, across randomized churn streams
+(SURVEY.md §7 hard part 4 — snapshot deltas, not full re-uploads; the analog of
+storage/cacher/cacher.go keeping one incremental view that every snapshot reads).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.delta import DeltaEncoder
+from kubernetes_tpu.api.snapshot import ClusterArrays, Snapshot, encode_snapshot
+from helpers import mk_node, mk_pod
+
+
+def assert_arrays_equal(got: ClusterArrays, want: ClusterArrays):
+    for f in dataclasses.fields(ClusterArrays):
+        a, b = getattr(got, f.name), getattr(want, f.name)
+        assert a.shape == b.shape, f"{f.name}: {a.shape} vs {b.shape}"
+        np.testing.assert_array_equal(a, b, err_msg=f.name)
+
+
+def mk_template_pod(name, kind, zone_pref=None):
+    """Pods stamped from a small template family (the steady-state shape)."""
+    if kind == 0:
+        return mk_pod(name, cpu=250, mem=256 * 1024**2, labels={"app": "web"})
+    if kind == 1:
+        return mk_pod(
+            name,
+            cpu=500,
+            labels={"app": "db"},
+            topology_spread=(
+                t.TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key=t.LABEL_ZONE,
+                    when_unsatisfiable=t.DO_NOT_SCHEDULE,
+                    label_selector=t.LabelSelector(match_labels=(("app", "db"),)),
+                ),
+            ),
+        )
+    if kind == 2:
+        return mk_pod(
+            name,
+            cpu=100,
+            labels={"app": "cache"},
+            affinity=t.Affinity(
+                required_pod_affinity=(
+                    t.PodAffinityTerm(
+                        topology_key=t.LABEL_ZONE,
+                        label_selector=t.LabelSelector(match_labels=(("app", "web"),)),
+                    ),
+                ),
+                preferred_pod_anti_affinity=(
+                    t.WeightedPodAffinityTerm(
+                        weight=3,
+                        term=t.PodAffinityTerm(
+                            topology_key=t.LABEL_ZONE,
+                            label_selector=t.LabelSelector(
+                                match_labels=(("app", "cache"),)
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        )
+    return mk_pod(
+        name,
+        cpu=50,
+        tolerations=(t.Toleration("gpu", "true", t.NO_SCHEDULE, "Equal"),),
+        node_selector={t.LABEL_ZONE: "z0"},
+        host_ports=(("TCP", 8080),),
+    )
+
+
+def mk_cluster_nodes(n):
+    nodes = []
+    for i in range(n):
+        taints = (t.Taint("gpu", "true", t.NO_SCHEDULE),) if i % 5 == 0 else ()
+        nodes.append(
+            mk_node(
+                f"n{i}",
+                labels={t.LABEL_ZONE: f"z{i % 3}"},
+                taints=taints,
+            )
+        )
+    return nodes
+
+
+def test_delta_equals_full_on_churn_stream():
+    """Bind waves, delete some bound pods, new waves arrive — every cycle the
+    resident encoder's DECISIONS must equal a fresh full encode's (subset-
+    compatible waves reuse the richer cached vocab, so arrays may differ in
+    inert columns while verdicts cannot)."""
+    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+
+    rng = np.random.default_rng(0)
+    nodes = mk_cluster_nodes(24)
+    bound = []
+    enc = DeltaEncoder()
+    serial = 0
+    for cycle in range(6):
+        if cycle == 0:
+            kinds = [0, 1, 2, 3, 0, 1, 2, 3]  # seed the vocab with all templates
+        else:
+            kinds = [int(rng.integers(0, 4)) for _ in range(int(rng.integers(4, 12)))]
+        pending = [
+            mk_template_pod(f"p{serial + i}", kind=k) for i, k in enumerate(kinds)
+        ]
+        serial += len(pending)
+        snap = Snapshot(nodes=nodes, pending_pods=pending, bound_pods=list(bound))
+        got, gm = enc.encode(snap)
+        want, wm = encode_snapshot(snap)
+        assert gm.pod_names == wm.pod_names
+        g_choices = np.asarray(schedule_batch(got, DEFAULT_SCORE_CONFIG)[0])
+        w_choices = np.asarray(schedule_batch(want, DEFAULT_SCORE_CONFIG)[0])
+        np.testing.assert_array_equal(
+            g_choices[: gm.n_pods], w_choices[: wm.n_pods], err_msg=f"cycle {cycle}"
+        )
+        # churn: bind a random subset of the wave, delete a random bound pod
+        for pod in pending:
+            if rng.random() < 0.7:
+                ni = int(rng.integers(0, len(nodes)))
+                bound.append(dataclasses.replace(pod, node_name=nodes[ni].name))
+        if bound and rng.random() < 0.8:
+            bound.pop(int(rng.integers(0, len(bound))))
+    assert enc.stats["delta"] >= 4, enc.stats  # the fast path actually ran
+
+
+def test_delta_falls_back_on_new_vocab():
+    """A wave introducing a new pairwise term / referenced label key must
+    rebuild (and still match full)."""
+    nodes = mk_cluster_nodes(8)
+    enc = DeltaEncoder()
+    snap1 = Snapshot(
+        nodes=nodes, pending_pods=[mk_template_pod("a", 0), mk_template_pod("b", 1)]
+    )
+    g1, _ = enc.encode(snap1)
+    w1, _ = encode_snapshot(snap1)
+    assert_arrays_equal(g1, w1)
+    full_before = enc.stats["full"]
+    # new spec family: references a new label key + new spread term
+    snap2 = Snapshot(
+        nodes=nodes,
+        pending_pods=[
+            mk_template_pod("c", 2),
+            mk_pod("d", node_selector={"disk": "ssd"}),
+        ],
+        bound_pods=[dataclasses.replace(mk_template_pod("a", 0), node_name="n1")],
+    )
+    g2, _ = enc.encode(snap2)
+    w2, _ = encode_snapshot(snap2)
+    assert_arrays_equal(g2, w2)
+    assert enc.stats["full"] == full_before + 1  # fingerprint mismatch -> rebuild
+
+
+def test_delta_falls_back_on_node_change():
+    nodes = mk_cluster_nodes(8)
+    enc = DeltaEncoder()
+    wave = lambda s: [mk_template_pod(f"p{s}", 0)]
+    snap1 = Snapshot(nodes=list(nodes), pending_pods=wave(0))
+    enc.encode(snap1)
+    # node replaced (e.g. taint update through the store)
+    nodes2 = list(nodes)
+    nodes2[3] = mk_node("n3", labels={t.LABEL_ZONE: "z0"}, unschedulable=True)
+    snap2 = Snapshot(nodes=nodes2, pending_pods=wave(1))
+    g, _ = enc.encode(snap2)
+    w, _ = encode_snapshot(snap2)
+    assert_arrays_equal(g, w)
+    assert enc.stats["full"] == 2
+
+
+def test_delta_same_template_wave_hits_fast_path():
+    """Steady state: same templates, growing bound set — no rebuilds after
+    the first."""
+    nodes = mk_cluster_nodes(12)
+    enc = DeltaEncoder()
+    bound = []
+    for cycle in range(4):
+        pending = [mk_template_pod(f"w{cycle}-{i}", kind=i % 4) for i in range(8)]
+        snap = Snapshot(nodes=nodes, pending_pods=pending, bound_pods=list(bound))
+        g, gm = enc.encode(snap)
+        w, _ = encode_snapshot(snap)
+        assert_arrays_equal(g, w)
+        for i, pod in enumerate(pending):
+            bound.append(dataclasses.replace(pod, node_name=f"n{(cycle + i) % 12}"))
+    assert enc.stats["full"] == 1
+    assert enc.stats["delta"] == 3
