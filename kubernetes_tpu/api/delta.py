@@ -200,12 +200,15 @@ class ClusterSide:
     # bumped whenever sync mutates used_raw/ports/counts in place; versioned
     # cache entries copy once per version, so handed-out arrays are immutable
     mut_version: int = 0
-    # fast bind-absorb: each wave pod's unique-spec representative by uid.
-    # A pod that binds was a recent wave's pending pod, and pod SPECS are
-    # immutable after creation (the reference's PodSpec immutability), so the
-    # rep's spec fields stand in for the bound copy's — record construction
-    # becomes O(1) dict lookups instead of per-pod key sorting.
-    wave_uid_rep: Dict[str, t.Pod] = field(default_factory=dict)
+    # fast bind-absorb: each wave pod's (own object, unique-spec rep) by uid.
+    # A pod that binds was a recent wave's pending pod; the rep's spec fields
+    # stand in for the bound copy's — record construction becomes O(1) dict
+    # lookups instead of per-pod key sorting.  The bound copy is revalidated
+    # against the ORIGINAL wave object first (bind copies share field objects,
+    # so that's five `is` checks) because pod labels are mutable metadata in
+    # the reference API — a label update racing the bind must not reuse the
+    # stale spec info (round-2 advisor finding).
+    wave_uid_rep: Dict[str, Tuple[t.Pod, t.Pod]] = field(default_factory=dict)
     # bound-side info per wave rep (keyed by id(rep); reps are kept alive by
     # wave_uid_rep)
     rep_bound_info: Dict[int, Tuple[int, int, Tuple[int, ...]]] = field(
@@ -215,6 +218,22 @@ class ClusterSide:
 
 def _nodes_fp(nodes: Sequence[t.Node]) -> Tuple:
     return tuple((nd.name, id(nd)) for nd in nodes)
+
+
+# The pod fields the bound-side absorb reads (what _spec_info/_bound_spec_key
+# consume).  Shared with the wire client's drift check (runtime/client.py) so
+# the two revalidation sites cannot diverge.
+BOUND_SPEC_FIELDS = ("labels", "namespace", "requests", "host_ports", "affinity")
+
+
+def bound_spec_fields_match(a: t.Pod, b: t.Pod) -> bool:
+    """Identity-first equality over BOUND_SPEC_FIELDS (copies made with
+    copy/replace share field objects, so the common case is five `is` checks)."""
+    for f in BOUND_SPEC_FIELDS:
+        x, y = getattr(a, f), getattr(b, f)
+        if x is not y and x != y:
+            return False
+    return True
 
 
 def _bound_spec_key(q: t.Pod) -> Tuple:
@@ -383,6 +402,12 @@ def build_cluster_side(
             bspec_pref.append(pref)
         for proto, port in q.host_ports:
             voc.ports.intern((proto, port))
+        if q.uid in records:
+            # records dedups by uid while rec_ni/rec_req/rec_spec are per-pod:
+            # a duplicate would double-count one set of arrays and not the
+            # other, and later sync_bound deltas (keyed by uid) would drift
+            # from a rebuild — enforce the convention instead of assuming it
+            raise ValueError(f"duplicate bound pod uid {q.uid!r} in snapshot")
         records[q.uid] = (
             ni,
             ru,
@@ -583,10 +608,23 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
             return ru, su, tuple(port_ids)
 
         for q in new:
-            rep = cs.wave_uid_rep.pop(q.uid, None)
-            if rep is not None and not q.pvcs and not q.resource_claims:
+            ent_wave = cs.wave_uid_rep.pop(q.uid, None)
+            orig = rep = None
+            if ent_wave is not None:
+                orig, rep = ent_wave
+            if (
+                rep is not None
+                and not q.pvcs
+                and not q.resource_claims
+                # The rep stands in for the bound copy only while every field
+                # _spec_info reads (BOUND_SPEC_FIELDS) is still equal to the
+                # WAVE-TIME object's: pod labels are mutable metadata in the
+                # reference API (unlike the spec), so a label update racing
+                # the bind must not record a stale affinity contribution.
+                and bound_spec_fields_match(q, orig)
+            ):
                 # fast path: the pod was a recent wave's pending pod — its
-                # (immutable) spec is the rep's; bind-absorb is O(1) lookups.
+                # spec is the rep's; bind-absorb is O(1) lookups.
                 # Pods with volume/device claims take the slow path: their
                 # RESOLVED spec (api/volumes.resolve_pod) can change between
                 # pending and bound as PVC/PV state moves, so it must be
@@ -659,12 +697,32 @@ class DeltaEncoder:
     on node-set changes, wave-fingerprint changes, or vocabulary growth.
     `encode_snapshot` (snapshot.py) is this class used one-shot."""
 
-    def __init__(self, *, bucket: bool = True, hard_pod_affinity_weight: float = 1.0):
+    def __init__(
+        self,
+        *,
+        bucket: bool = True,
+        hard_pod_affinity_weight: float = 1.0,
+        debug_verify: bool = False,
+    ):
         self.bucket = bucket
         self.hpaw = hard_pod_affinity_weight
         self._cs: Optional[ClusterSide] = None
         self._dev: Dict[str, Tuple] = {}  # field -> (host array, device array)
         self.stats = {"full": 0, "delta": 0}
+        # Cache validity is conditioned on OBJECT IDENTITY (_nodes_fp, record
+        # `is` checks) under the repo-wide copy-on-write convention for
+        # Node/Pod; an in-place mutation anywhere would silently serve stale
+        # encodings.  debug_verify (or KTPU_DELTA_VERIFY=1) cross-checks every
+        # delta-path cycle against a fresh rebuild to catch that early.
+        import os
+
+        self.debug_verify = debug_verify or os.environ.get("KTPU_DELTA_VERIFY") == "1"
+        # persistent identity-profile -> canonical spec key cache: successive
+        # waves stamped from the same objects (or wire-interned copies) share
+        # field objects, so the per-pod canonical keying (the sorting-heavy
+        # part of group_by_spec) is paid once per template, not once per pod
+        # per cycle.  Size-capped like wave_uid_rep.
+        self._spec_keys: Dict[Tuple, Tuple] = {}
 
     def encode_device(self, snap):
         """encode(), with the ClusterArrays placed on device — fields whose
@@ -698,15 +756,51 @@ class DeltaEncoder:
                 out[f.name] = d
         return type(arr)(**out), meta
 
+    def _group_cached(self, pods):
+        """group_by_spec with the encoder-resident identity->key cache: same
+        reps/inv as snapshot.group_by_spec (bit-identical arrays), plus each
+        rep's canonical key (the pod-side cache key input)."""
+        from .snapshot import _pod_spec_key
+
+        if len(self._spec_keys) > 2 * (len(pods) + 1024):
+            self._spec_keys.clear()
+        cache = self._spec_keys
+        can_ids: Dict[Tuple, int] = {}
+        reps: List[t.Pod] = []
+        rep_keys: List[Tuple] = []
+        inv = np.empty(len(pods), dtype=np.int64)
+        for i, pod in enumerate(pods):
+            ik = (
+                id(pod.requests), id(pod.labels), pod.namespace, pod.node_name,
+                pod.priority, id(pod.tolerations), id(pod.node_selector),
+                id(pod.affinity), id(pod.topology_spread), id(pod.host_ports),
+                id(pod.scheduling_gates), pod.pod_group, id(pod.images),
+            )
+            ent = cache.get(ik)
+            if ent is None:
+                # the VALUE keeps the pod (and so every id()'d field object)
+                # alive: a recycled address can never alias a live entry
+                ent = (_pod_spec_key(pod), pod)
+                cache[ik] = ent
+            k = ent[0]
+            su = can_ids.get(k)
+            if su is None:
+                su = len(reps)
+                can_ids[k] = su
+                reps.append(pod)
+                rep_keys.append(k)
+            inv[i] = su
+        return reps, inv, tuple(rep_keys)
+
     def encode(self, snap):
-        from .snapshot import _resource_axis, activeq_order, group_by_spec
+        from .snapshot import _resource_axis, activeq_order
         from .volumes import resolve_snapshot
 
         snap = resolve_snapshot(snap)
         pending = snap.pending_pods
         perm = activeq_order(pending)
         sorted_pending = [pending[i] for i in perm]
-        reps, inv = group_by_spec(sorted_pending)
+        reps, inv, rep_keys = self._group_cached(sorted_pending)
         resources = _resource_axis(snap)
         wfp = wave_fingerprint(reps, resources)
 
@@ -720,6 +814,8 @@ class DeltaEncoder:
             try:
                 sync_bound(cs, snap.bound_pods)
                 self.stats["delta"] += 1
+                if self.debug_verify:
+                    self._verify_against_rebuild(cs, snap, wfp)
             except _Fallback:
                 cs = None
         else:
@@ -737,8 +833,32 @@ class DeltaEncoder:
             cs.rep_bound_info.clear()
         inv_list = inv.tolist()
         for i, pod in enumerate(sorted_pending):
-            cs.wave_uid_rep[pod.uid] = reps[inv_list[i]]
-        return _assemble(cs, snap, reps, inv, perm, self.bucket)
+            cs.wave_uid_rep[pod.uid] = (pod, reps[inv_list[i]])
+        return _assemble(cs, snap, reps, inv, perm, self.bucket, rep_keys)
+
+    @staticmethod
+    def _verify_against_rebuild(cs: ClusterSide, snap, wfp: WaveFingerprint) -> None:
+        """debug_verify: the synced cluster side must equal a fresh rebuild
+        (catches identity-fingerprint violations — in-place Node/Pod mutation
+        that the id()-based cache checks cannot see).  Note the rebuild uses
+        the CURRENT wave's fingerprint: under superset reuse (_wave_compatible)
+        cs vocab axes may be strict supersets, so compare on the fresh side's
+        prefix — decisions are unaffected (documented on EncodingMeta)."""
+        fresh = build_cluster_side(snap.nodes, snap.bound_pods, cs.wfp, cs.hpaw)
+        for name in ("used_raw", "term_counts0", "anti_counts0", "pref_own0",
+                     "node_port_count"):
+            a, b = getattr(cs, name), getattr(fresh, name)
+            if a.shape != b.shape:
+                # vocab drift (e.g. departed bound pods whose terms stay
+                # interned in cs) — sizes are legitimately supersets; only
+                # equal-shape cycles are comparable
+                continue
+            if not np.array_equal(a, b):
+                raise AssertionError(
+                    f"delta debug_verify: {name} diverged from rebuild "
+                    "(in-place Node/Pod mutation defeating the identity "
+                    "fingerprint?)"
+                )
 
 
 def _cached(cs: ClusterSide, name: str, key, builder):
@@ -754,90 +874,15 @@ def _cached(cs: ClusterSide, name: str, key, builder):
     return a
 
 
-def _assemble(
-    cs: ClusterSide,
-    snap,
-    reps: Sequence[t.Pod],
-    inv: np.ndarray,
-    perm: np.ndarray,
-    bucket: bool,
-):
-    """Build the wave (pod-side) arrays against the resident cluster side and
-    assemble the full ClusterArrays + EncodingMeta."""
-    from .snapshot import (
-        _INT32_MAX,
-        _bucket,
-        _image_score_matrix,
-        _scale_for,
-        ClusterArrays,
-        EncodingMeta,
-        pod_effective_requests,
-    )
+def _pod_side(cs, snap, reps, inv, p, P, N, T, L, req_s):
+    """All wave-derived (pod-side) arrays as one dict — built per unique
+    spec and scattered through inv; cacheable as a unit (see _assemble).
+    reference: the per-cycle half of backend/cache/snapshot.go —
+    UpdateSnapshot, recast columnar."""
+    from .snapshot import _image_score_matrix, _round_up_pow2
 
-    nodes = cs.nodes
-    pending = snap.pending_pods
-    n, p = len(nodes), len(pending)
-    N = _bucket(n) if bucket else max(1, n)
-    P = _bucket(p) if bucket else max(1, p)
-    resources = list(cs.wfp.resources)
-    R = len(resources)
     U = len(reps)
-
-    # --- resources: scale re-derived from raw each cycle (bit-exact) ---
-    req_uniq = (
-        np.array([pod_effective_requests(rp, resources) for rp in reps], dtype=np.int64)
-        if U
-        else np.zeros((1, R), dtype=np.int64)
-    )
-    req_raw = req_uniq[inv] if p else np.zeros((0, R), dtype=np.int64)
-    alloc_uniq = np.unique(cs.alloc_raw, axis=0) if n else np.zeros((1, R), np.int64)
-    scale = np.ones(R, dtype=np.int64)
-    stacked = np.concatenate([alloc_uniq, req_uniq, cs.used_raw], axis=0)
-    for j in range(R):
-        scale[j] = _scale_for(stacked[:, j])
-    req_s = -(-req_raw // scale)
-    used_s = -(-cs.used_raw // scale)
-    alloc_s = cs.alloc_raw // scale
-
-    skey = tuple(scale.tolist())
-
-    def _pad2(src, dtype, fill=0):
-        out = np.full((N, src.shape[1]), fill, dtype=dtype)
-        out[:n] = src
-        return out
-
-    node_alloc = _cached(cs, "node_alloc", (N, skey), lambda: _pad2(alloc_s, np.int32))
-    node_used = _cached(
-        cs, "node_used", (N, skey, cs.mut_version), lambda: _pad2(used_s, np.int32)
-    )
-
-    def _valid():
-        a = np.zeros(N, dtype=bool)
-        a[:n] = True
-        return a
-
-    node_valid = _cached(cs, "node_valid", N, _valid)
-
-    def _unsched():
-        a = np.zeros(N, dtype=bool)
-        a[:n] = [nd.unschedulable for nd in nodes]
-        return a
-
-    node_unsched = _cached(cs, "node_unsched", N, _unsched)
-
-    L = cs.node_labels.shape[1]
-    node_labels = _cached(
-        cs, "node_labels", N, lambda: _pad2(cs.node_labels, np.float32)
-    )
-    T = cs.node_taint_ns.shape[1]
-    node_taint_ns = _cached(
-        cs, "node_taint_ns", N, lambda: _pad2(cs.node_taint_ns, bool)
-    )
-    node_taint_pref = _cached(
-        cs, "node_taint_pref", N, lambda: _pad2(cs.node_taint_pref, bool)
-    )
-
-    # --- pod side (all per unique spec, scattered through inv) ---
+    R = len(cs.wfp.resources)
     pod_valid = np.zeros(P, dtype=bool)
     pod_req = np.zeros((P, R), dtype=np.int32)
     pod_req[:p] = req_s
@@ -944,15 +989,6 @@ def _assemble(
 
     # --- pairwise wave side against the resident vocab/counts ---
     T2 = max(1, len(cs.voc.terms))
-    K = cs.node_dom.shape[0]
-    D1 = cs.term_counts0.shape[1]
-    def _dom():
-        a = np.full((K, N), D1 - 1, dtype=np.int32)
-        if n:
-            a[:, :n] = cs.node_dom[:, :n]
-        return a
-
-    node_dom = _cached(cs, "node_dom", N, _dom)
 
     pod_aff: List[List[int]] = []
     pod_anti: List[List[int]] = []
@@ -968,6 +1004,7 @@ def _assemble(
         )
 
     m_pend = np.zeros((T2, P), dtype=np.float32)
+    m_uniq = None
     if p and cs.terms_list:
         m_uniq = _match_matrix(cs.terms_list, list(reps))  # [T2, U]
         m_pend[:, :p] = m_uniq[:, inv]
@@ -996,6 +1033,28 @@ def _assemble(
             u_spread_t[ui, c] = ti
             u_spread_skew[ui, c] = skew
             u_spread_hard[ui, c] = mode == HARD
+    # matched-term slots: per unique spec, the nonzero entries of its m_pend
+    # column (M bucketed to a power of two to bound recompiles); plus the
+    # self-match bit per own required-affinity slot (the waiver's input)
+    MM = 1
+    u_mt = np.full((Uq, 1), -1, dtype=np.int32)
+    u_mv = np.zeros((Uq, 1), dtype=np.float32)
+    u_aself = np.zeros((Uq, A1), dtype=bool)
+    if m_uniq is not None:
+        nz = [np.flatnonzero(m_uniq[:, ui]) for ui in range(U)]
+        MM = _round_up_pow2(max((len(z) for z in nz), default=1), minimum=1)
+        u_mt = np.full((Uq, MM), -1, dtype=np.int32)
+        u_mv = np.zeros((Uq, MM), dtype=np.float32)
+        for ui, z in enumerate(nz):
+            u_mt[ui, : len(z)] = z
+            u_mv[ui, : len(z)] = m_uniq[z, ui]
+        rows, cols = np.nonzero(u_aff[:U] >= 0) if U else (np.array([], int),) * 2
+        if len(rows):
+            u_aself[rows, cols] = m_uniq[u_aff[rows, cols], rows] > 0
+    pod_match_terms = np.full((P, MM), -1, dtype=np.int32)
+    pod_match_vals = np.zeros((P, MM), dtype=np.float32)
+    pod_aff_self = np.zeros((P, A1), dtype=bool)
+
     pod_aff_terms = np.full((P, A1), -1, dtype=np.int32)
     pod_anti_terms = np.full((P, A2), -1, dtype=np.int32)
     pod_pref_aff_terms = np.full((P, B), -1, dtype=np.int32)
@@ -1004,6 +1063,9 @@ def _assemble(
     pod_spread_maxskew = np.zeros((P, C), dtype=np.int32)
     pod_spread_hard = np.zeros((P, C), dtype=bool)
     if p:
+        pod_match_terms[:p] = u_mt[inv]
+        pod_match_vals[:p] = u_mv[inv]
+        pod_aff_self[:p] = u_aself[inv]
         pod_aff_terms[:p] = u_aff[inv]
         pod_anti_terms[:p] = u_anti[inv]
         pod_pref_aff_terms[:p] = u_pref_t[inv]
@@ -1021,6 +1083,156 @@ def _assemble(
     pod_ports = np.zeros((P, PT), dtype=bool)
     if p:
         pod_ports[:p] = u_ports[inv]
+
+    return dict(
+        pod_valid=pod_valid,
+        pod_req=pod_req,
+        pod_prio=pod_prio,
+        pod_tol_ns=pod_tol_ns,
+        pod_tol_pref=pod_tol_pref,
+        pod_nodename=pod_nodename,
+        pod_terms=pod_terms,
+        pod_has_sel=pod_has_sel,
+        sel_mask=sel_mask,
+        sel_kind=sel_kind,
+        pod_pref_terms=pod_pref_terms,
+        pod_pref_weights=pod_pref_weights,
+        pod_group=pod_group,
+        group_min=group_min,
+        image_score=_image_score_matrix(cs.nodes, reps, inv, N, P),
+        m_pend=m_pend,
+        pod_match_terms=pod_match_terms,
+        pod_match_vals=pod_match_vals,
+        pod_aff_self=pod_aff_self,
+        pod_aff_terms=pod_aff_terms,
+        pod_anti_terms=pod_anti_terms,
+        pod_pref_aff_terms=pod_pref_aff_terms,
+        pod_pref_aff_w=pod_pref_aff_w,
+        pod_spread_terms=pod_spread_terms,
+        pod_spread_maxskew=pod_spread_maxskew,
+        pod_spread_hard=pod_spread_hard,
+        pod_ports=pod_ports,
+    )
+
+
+def _assemble(
+    cs: ClusterSide,
+    snap,
+    reps: Sequence[t.Pod],
+    inv: np.ndarray,
+    perm: np.ndarray,
+    bucket: bool,
+    rep_keys: Optional[Tuple] = None,
+):
+    """Build the wave (pod-side) arrays against the resident cluster side and
+    assemble the full ClusterArrays + EncodingMeta.
+
+    When `rep_keys` (each rep's canonical spec key) is given and matches the
+    previous cycle's (same specs, same inv, same padding/scale/groups), the
+    ENTIRE pod-side array set is reused from cs.pad_cache — steady-state waves
+    stamped from one template family cost only the cluster-side sync."""
+    from .snapshot import (
+        _INT32_MAX,
+        _bucket,
+        _image_score_matrix,
+        _round_up_pow2,
+        _scale_for,
+        ClusterArrays,
+        EncodingMeta,
+        pod_effective_requests,
+    )
+
+    nodes = cs.nodes
+    pending = snap.pending_pods
+    n, p = len(nodes), len(pending)
+    N = _bucket(n) if bucket else max(1, n)
+    P = _bucket(p) if bucket else max(1, p)
+    resources = list(cs.wfp.resources)
+    R = len(resources)
+    U = len(reps)
+
+    # --- resources: scale re-derived from raw each cycle (bit-exact) ---
+    req_uniq = (
+        np.array([pod_effective_requests(rp, resources) for rp in reps], dtype=np.int64)
+        if U
+        else np.zeros((1, R), dtype=np.int64)
+    )
+    req_raw = req_uniq[inv] if p else np.zeros((0, R), dtype=np.int64)
+    alloc_uniq = np.unique(cs.alloc_raw, axis=0) if n else np.zeros((1, R), np.int64)
+    scale = np.ones(R, dtype=np.int64)
+    stacked = np.concatenate([alloc_uniq, req_uniq, cs.used_raw], axis=0)
+    for j in range(R):
+        scale[j] = _scale_for(stacked[:, j])
+    req_s = -(-req_raw // scale)
+    used_s = -(-cs.used_raw // scale)
+    alloc_s = cs.alloc_raw // scale
+
+    skey = tuple(scale.tolist())
+
+    def _pad2(src, dtype, fill=0):
+        out = np.full((N, src.shape[1]), fill, dtype=dtype)
+        out[:n] = src
+        return out
+
+    node_alloc = _cached(cs, "node_alloc", (N, skey), lambda: _pad2(alloc_s, np.int32))
+    node_used = _cached(
+        cs, "node_used", (N, skey, cs.mut_version), lambda: _pad2(used_s, np.int32)
+    )
+
+    def _valid():
+        a = np.zeros(N, dtype=bool)
+        a[:n] = True
+        return a
+
+    node_valid = _cached(cs, "node_valid", N, _valid)
+
+    def _unsched():
+        a = np.zeros(N, dtype=bool)
+        a[:n] = [nd.unschedulable for nd in nodes]
+        return a
+
+    node_unsched = _cached(cs, "node_unsched", N, _unsched)
+
+    L = cs.node_labels.shape[1]
+    node_labels = _cached(
+        cs, "node_labels", N, lambda: _pad2(cs.node_labels, np.float32)
+    )
+    T = cs.node_taint_ns.shape[1]
+    node_taint_ns = _cached(
+        cs, "node_taint_ns", N, lambda: _pad2(cs.node_taint_ns, bool)
+    )
+    node_taint_pref = _cached(
+        cs, "node_taint_pref", N, lambda: _pad2(cs.node_taint_pref, bool)
+    )
+
+    # --- pod side (all per unique spec, scattered through inv) ---
+    groups_key = tuple(
+        sorted((g.name, g.min_member) for g in snap.pod_groups.values())
+    )
+    pod_key = (
+        (rep_keys, inv.tobytes(), P, skey, groups_key)
+        if rep_keys is not None
+        else None
+    )
+    ent = cs.pad_cache.get("podside") if pod_key is not None else None
+    if ent is not None and ent[0] == pod_key:
+        ps = ent[1]
+    else:
+        ps = _pod_side(cs, snap, reps, inv, p, P, N, T, L, req_s)
+        if pod_key is not None:
+            cs.pad_cache["podside"] = (pod_key, ps)
+
+    T2 = max(1, len(cs.voc.terms))
+    K = cs.node_dom.shape[0]
+    D1 = cs.term_counts0.shape[1]
+
+    def _dom():
+        a = np.full((K, N), D1 - 1, dtype=np.int32)
+        if n:
+            a[:, :n] = cs.node_dom[:, :n]
+        return a
+
+    node_dom = _cached(cs, "node_dom", N, _dom)
     node_ports0 = _cached(
         cs,
         "node_ports0",
@@ -1036,24 +1248,8 @@ def _assemble(
         node_labels=node_labels,
         node_taint_ns=node_taint_ns,
         node_taint_pref=node_taint_pref,
-        pod_valid=pod_valid,
-        pod_req=pod_req,
-        pod_prio=pod_prio,
-        pod_tol_ns=pod_tol_ns,
-        pod_tol_pref=pod_tol_pref,
-        pod_nodename=pod_nodename,
-        pod_terms=pod_terms,
-        pod_has_sel=pod_has_sel,
-        sel_mask=sel_mask,
-        sel_kind=sel_kind,
-        pod_pref_terms=pod_pref_terms,
-        pod_pref_weights=pod_pref_weights,
-        pod_group=pod_group,
-        group_min=group_min,
-        image_score=_image_score_matrix(nodes, reps, inv, N, P),
         node_dom=node_dom,
         term_key=_cached(cs, "term_key", 0, cs.term_key.copy),
-        m_pend=m_pend,
         term_counts0=_cached(
             cs, "term_counts0", cs.mut_version, cs.term_counts0.copy
         ),
@@ -1061,15 +1257,8 @@ def _assemble(
             cs, "anti_counts0", cs.mut_version, cs.anti_counts0.copy
         ),
         pref_own0=_cached(cs, "pref_own0", cs.mut_version, cs.pref_own0.copy),
-        pod_aff_terms=pod_aff_terms,
-        pod_anti_terms=pod_anti_terms,
-        pod_pref_aff_terms=pod_pref_aff_terms,
-        pod_pref_aff_w=pod_pref_aff_w,
-        pod_spread_terms=pod_spread_terms,
-        pod_spread_maxskew=pod_spread_maxskew,
-        pod_spread_hard=pod_spread_hard,
-        pod_ports=pod_ports,
         node_ports0=node_ports0,
+        **ps,
     )
     meta = EncodingMeta(
         node_names=[nd.name for nd in nodes],

@@ -87,7 +87,15 @@ class Snapshot:
 
 @dataclass
 class EncodingMeta:
-    """Host-side metadata needed to decode kernel outputs back to names."""
+    """Host-side metadata needed to decode kernel outputs back to names.
+
+    Under the delta path's superset reuse (api/delta.py — _wave_compatible),
+    `resources`, `label_vocab` and `pairwise_vocab` may be strict SUPERSETS of
+    what a fresh encode of the same snapshot would produce (surplus axes are
+    inert).  Decisions are unaffected; consumers must not assume
+    meta.resources == _resource_axis(snap) or compare metas across encoders —
+    cross-backend comparisons should be decision-based (as the parity tests
+    are)."""
 
     node_names: List[str]
     pod_names: List[str]  # in activeQ order == device pod index order
@@ -131,6 +139,12 @@ class ClusterArrays:
     node_dom: np.ndarray  # i32[K, N] domain id, D = key absent
     term_key: np.ndarray  # i32[T] -> topology key index
     m_pend: np.ndarray  # f32[T, P] pending pod matches term selector+ns
+    # m_pend's nonzeros as per-pod slots (M = max matches over the wave):
+    # the scan's symmetric-half reads/commits touch only these O(M) terms
+    # per step instead of all T (ops/pairwise.py — interpod_required_ok)
+    pod_match_terms: np.ndarray  # i32[P, M] matching term ids, -1 pad
+    pod_match_vals: np.ndarray  # f32[P, M] match values (m_pend entries)
+    pod_aff_self: np.ndarray  # bool[P, A1] pod matches its own required-affinity term
     term_counts0: np.ndarray  # f32[T, D+1] matching bound pods per domain
     anti_counts0: np.ndarray  # f32[T, D+1] bound pods OWNING anti term t
     pod_aff_terms: np.ndarray  # i32[P, A1] required pod-affinity term ids
@@ -293,18 +307,49 @@ def group_by_spec(pods: Sequence[t.Pod]) -> Tuple[List[t.Pod], np.ndarray]:
     """-> (reps, inv): unique encoding specs in first-occurrence order and each
     pod's spec index.  Interner-order equivalence: because every vocab below
     dedups on intern, processing unique specs in first-occurrence order assigns
-    ids identical to the old per-pod loops (bit-identical arrays)."""
-    ids: Dict[Tuple, int] = {}
+    ids identical to the old per-pod loops (bit-identical arrays).
+
+    Two-level interning: pods copied from a shared spec (copy.copy /
+    dataclasses.replace — e.g. the sidecar's wire-interned waves) SHARE their
+    field objects, so an identity-tuple fast path dedups them without sorting
+    dicts; only one pod per identity profile pays the canonical
+    `_pod_spec_key`.  Distinct-identity/equal-content profiles merge at the
+    canonical level, so reps order and inv are exactly what the one-level
+    loop produced (bit-identical arrays either way).  Workloads whose pods
+    own distinct field objects (identity never hits) would pay the tuple
+    overhead for nothing, so the fast path self-disables when its hit rate
+    over the first window is poor."""
+    id_ids: Dict[Tuple, int] = {}
+    can_ids: Dict[Tuple, int] = {}
+    id_to_spec: List[int] = []
     reps: List[t.Pod] = []
     inv = np.empty(len(pods), dtype=np.int64)
+    use_fast = len(pods) > 512
     for i, pod in enumerate(pods):
+        if use_fast:
+            ik = (
+                id(pod.requests), id(pod.labels), pod.namespace, pod.node_name,
+                pod.priority, id(pod.tolerations), id(pod.node_selector),
+                id(pod.affinity), id(pod.topology_spread), id(pod.host_ports),
+                id(pod.scheduling_gates), pod.pod_group, id(pod.images),
+            )
+            u = id_ids.get(ik)
+            if u is not None:
+                inv[i] = id_to_spec[u]
+                continue
+            if i == 1024 and len(id_ids) > 768:
+                use_fast = False  # identity never hits: stop paying for it
+            else:
+                id_ids[ik] = len(id_to_spec)
         k = _pod_spec_key(pod)
-        u = ids.get(k)
-        if u is None:
-            u = len(reps)
-            ids[k] = u
+        su = can_ids.get(k)
+        if su is None:
+            su = len(reps)
+            can_ids[k] = su
             reps.append(pod)
-        inv[i] = u
+        if use_fast:
+            id_to_spec.append(su)
+        inv[i] = su
     return reps, inv
 
 

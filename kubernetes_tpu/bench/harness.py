@@ -43,9 +43,17 @@ class PerfData:
     unschedulable: int
     wall_s: float
     pods_per_sec: float
+    # quantiles over the recorded attempt/batch durations.  HONESTY NOTE: in
+    # batch (tpu/native) mode a config is usually ONE batch, so p50==p99==the
+    # wave wall time — they are per-WAVE latencies, not a per-pod attempt
+    # distribution; the per-pod number is amortized_ms_per_pod (wall/pod,
+    # the batch path's analog of scheduling_attempt_duration).  cpu mode
+    # records a real per-pod distribution.
     p50_ms: float
     p90_ms: float
     p99_ms: float
+    batches: int = 1
+    amortized_ms_per_pod: float = 0.0
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -101,6 +109,8 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
         p50_ms=round(q(0.50), 2),
         p90_ms=round(q(0.90), 2),
         p99_ms=round(q(0.99), 2),
+        batches=len(hist.samples) if hist else 0,
+        amortized_ms_per_pod=round(wall * 1e3 / scheduled, 3) if scheduled else 0.0,
     )
 
 

@@ -10,9 +10,10 @@ re-evaluates only the state-dependent terms per step:
 
   - NodeResourcesFit.Filter against the running node_used
   - NodePorts.Filter against the running ports_used
-  - PodTopologySpread / InterPodAffinity against the running counts[T, D+1]
-    (committed pods become "existing" for every later pod — including their
-    own anti-affinity terms, via anti_counts)
+  - PodTopologySpread / InterPodAffinity against running PER-NODE count state
+    cnt_node/anti_node/pref_node[T, N] (committed pods become "existing" for
+    every later pod — including their own anti-affinity terms; see
+    ops/pairwise.py for why the state is per-node rather than per-domain)
   - LeastAllocated / BalancedAllocation scores against used + this pod's request
   - per-pod NormalizeScore over the *currently* feasible set
 
@@ -99,7 +100,12 @@ def schedule_scan(
         & nodename_ok
     )
     n_alloc = arr.node_alloc
-    node_dom, term_key = arr.node_dom, arr.term_key
+    # static per-term node->domain map + key presence, hoisted out of the scan
+    # (ops/pairwise.py module docstring: per-node state layout).  D is a
+    # static Python int — domain id D means "node lacks the key".
+    D = arr.term_counts0.shape[1] - 1
+    dom_by_term = arr.node_dom[arr.term_key]  # i32[T, Nl]
+    has_key_all = dom_by_term < D  # bool[T, Nl]
 
     # Scan inputs assembled conditionally: disabled stages (cfg.enable_*) never
     # materialize their [P, N] matrices — a constant-per-pod score term cannot
@@ -117,7 +123,9 @@ def schedule_scan(
             spread_t=arr.pod_spread_terms,
             spread_skew=arr.pod_spread_maxskew,
             spread_hard=arr.pod_spread_hard,
-            m=arr.m_pend.T,
+            mt=arr.pod_match_terms,
+            mv=arr.pod_match_vals,
+            aself=arr.pod_aff_self,
         )
         if cfg.enable_interpod_score:
             xs["pref_t"] = arr.pod_pref_aff_terms
@@ -132,7 +140,7 @@ def schedule_scan(
         return jnp.where(mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / mx, MAX_NODE_SCORE)
 
     def step(state, xs):
-        used, counts, anti_counts, pref_own, ports_used = state
+        used, cnt_node, anti_node, pref_node, total_t, ports_used = state
         req, feas_row, valid = xs["req"], xs["sf"], xs["valid"]
 
         feasible = feas_row & filters.fit_ok(req, used, n_alloc)
@@ -140,11 +148,12 @@ def schedule_scan(
             feasible &= pairwise.ports_ok(ports_used, xs["ports"])
         if cfg.enable_pairwise:
             spread_ok, spread_raw = pairwise.spread_step(
-                counts, node_dom, term_key, xs["spread_t"], xs["spread_skew"],
+                cnt_node, has_key_all, xs["spread_t"], xs["spread_skew"],
                 xs["spread_hard"], xs["nodesel"] & arr.node_valid, axis_name,
             )
             feasible &= spread_ok & pairwise.interpod_required_ok(
-                counts, anti_counts, node_dom, term_key, xs["aff"], xs["anti"], xs["m"]
+                cnt_node, anti_node, total_t, has_key_all, xs["aff"], xs["anti"],
+                xs["mt"], xs["mv"], xs["aself"],
             )
         requested = used + req[None, :]
         # score accumulation order mirrors the oracle exactly (float32 parity):
@@ -169,7 +178,8 @@ def schedule_scan(
             # preferred inter-pod affinity: min/max normalization over feasible
             # (interpodaffinity/scoring.go — NormalizeScore)
             ip_raw = pairwise.interpod_pref_raw(
-                counts, pref_own, node_dom, term_key, xs["pref_t"], xs["pref_w"], xs["m"]
+                cnt_node, pref_node, has_key_all, xs["pref_t"], xs["pref_w"],
+                xs["mt"], xs["mv"],
             )
             mx = _rmax(jnp.where(feasible, ip_raw, -jnp.inf), axis_name)
             mn = -_rmax(jnp.where(feasible, -ip_raw, -jnp.inf), axis_name)
@@ -192,18 +202,21 @@ def schedule_scan(
             # domain column of the chosen node, per term — owner shard broadcasts
             is_mine = (choice >= base) & (choice < base + local_n)
             local_col = jnp.clip(choice - base, 0, local_n - 1)
-            dom_col = jnp.where(is_mine, node_dom[term_key, local_col], 0)
+            dom_col = jnp.where(is_mine, dom_by_term[:, local_col], 0)
             if axis_name:
                 dom_col = lax.psum(dom_col, axis_name)
-            counts, anti_counts = pairwise.commit_counts(
-                counts, anti_counts, choice, dom_col, xs["m"], xs["anti"]
+            cnt_node, anti_node, total_t = pairwise.commit_counts(
+                cnt_node, anti_node, total_t, dom_by_term, D,
+                choice, dom_col, xs["mt"], xs["mv"], xs["anti"],
             )
             if cfg.enable_interpod_score:
                 # the committed pod's own preferred terms join the symmetric
                 # half for later pods
                 bids = jnp.maximum(xs["pref_t"], 0)
                 bw = jnp.where((xs["pref_t"] >= 0) & (choice >= 0), xs["pref_w"], 0.0)
-                pref_own = pref_own.at[bids, dom_col[bids]].add(bw)
+                pref_node = pref_node.at[bids].add(
+                    bw[:, None] * (dom_by_term[bids] == dom_col[bids][:, None])
+                )
                 if cfg.hard_pod_affinity_weight:
                     # ... and its REQUIRED affinity terms at hardPodAffinityWeight
                     # (interpodaffinity/scoring.go — processExistingPod)
@@ -213,16 +226,24 @@ def schedule_scan(
                         jnp.float32(cfg.hard_pod_affinity_weight),
                         0.0,
                     )
-                    pref_own = pref_own.at[aids, dom_col[aids]].add(aw)
+                    pref_node = pref_node.at[aids].add(
+                        aw[:, None] * (dom_by_term[aids] == dom_col[aids][:, None])
+                    )
         if cfg.enable_ports:
             ports_used = ports_used | (placed & xs["ports"][None, :])
-        return (used, counts, anti_counts, pref_own, ports_used), choice
+        return (used, cnt_node, anti_node, pref_node, total_t, ports_used), choice
 
+    # initial per-node state: ONE hoisted [T, N] gather each (cheap outside
+    # the scan), bit-identical to reading the [T, D+1] tables per step
+    cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
+    anti_node0 = jnp.take_along_axis(arr.anti_counts0, dom_by_term, axis=1)
+    pref_node0 = jnp.take_along_axis(arr.pref_own0, dom_by_term, axis=1)
+    total_t0 = arr.term_counts0[:, :D].sum(axis=1)
     state0 = (
-        arr.node_used, arr.term_counts0, arr.anti_counts0, arr.pref_own0,
+        arr.node_used, cnt_node0, anti_node0, pref_node0, total_t0,
         arr.node_ports0,
     )
-    (used_final, _, _, _, _), choices = lax.scan(step, state0, xs)
+    (used_final, _, _, _, _, _), choices = lax.scan(step, state0, xs)
     return choices, used_final
 
 

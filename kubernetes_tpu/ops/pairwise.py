@@ -1,9 +1,23 @@
 """Per-step kernels for PodTopologySpread, InterPodAffinity, NodePorts — L2's
-pairwise half, evaluated inside the commit scan against the running
-counts[T, D+1] / anti_counts[T, D+1] / ports_used[N, PT] state.
+pairwise half, evaluated inside the commit scan.
 
-Shapes: T interned terms, K topology keys, D domains (column D = key absent),
-N nodes, C/A1/A2 per-pod constraint slots (padded with -1).
+Shapes: T interned terms, K topology keys, D domains (id D = key absent),
+N nodes, M matched-term slots, C/A1/A2/B per-pod constraint slots (padded -1).
+
+TPU-first state layout: the scan carries PER-NODE materializations of the
+pairwise counts rather than the [T, D+1] per-domain tables —
+
+  cnt_node[T, N]  = counts[t, dom(key_t, n)]   (matching pods in n's domain)
+  anti_node[T, N] = anti_counts[t, dom(key_t, n)]
+  pref_node[T, N] = pref_own[t, dom(key_t, n)]
+  total_t[T]      = counts[t, :D].sum()        (matches anywhere with the key)
+
+because on TPU a 2D take_along_axis gather inside lax.scan costs ~100x a row
+dynamic-slice (measured ~135us vs ~3us at [2, 6144]); with per-node state every
+per-step read is a row slice + elementwise math, and a commit is a masked add
+on O(slots) rows through the STATIC dom_by_term[T, N] = node_dom[term_key] map
+(hoisted out of the scan by ops/assign.py).  All sums are integer-valued f32,
+so this layout is bit-identical to the per-domain formulation below 2^24.
 
 reference: podtopologyspread/filtering.go — calPreFilterState + Filter skew
 check; interpodaffinity/filtering.go — satisfyPodAffinity/satisfyPodAntiAffinity
@@ -16,20 +30,17 @@ import jax
 import jax.numpy as jnp
 
 
-def _term_rows(counts, node_dom, term_key, term_ids):
-    """For each term slot (id or -1): its per-node count row and key presence.
+def _rows(state_node, has_key_all, term_ids):
+    """For each term slot (id or -1): its per-node state row and key presence.
 
-    Returns (cnt[A, N], has_key[A, N], valid[A])."""
+    Returns (cnt[A, N], has_key[A, N], valid[A]).  Row dynamic-slices only —
+    no element gathers."""
     valid = term_ids >= 0
     tids = jnp.maximum(term_ids, 0)
-    keys = term_key[tids]  # [A]
-    dom_rows = node_dom[keys]  # [A, N]
-    D = counts.shape[1] - 1
-    cnt = jnp.take_along_axis(counts[tids], dom_rows, axis=1)  # [A, N]
-    return cnt, dom_rows < D, valid
+    return state_node[tids], has_key_all[tids], valid
 
 
-def spread_step(counts, node_dom, term_key, spread_terms, maxskew, hard, eligible,
+def spread_step(cnt_node, has_key_all, spread_terms, maxskew, hard, eligible,
                 axis_name=None):
     """-> (ok[N] hard-constraint feasibility, raw[N] score counts).
 
@@ -39,7 +50,7 @@ def spread_step(counts, node_dom, term_key, spread_terms, maxskew, hard, eligibl
     node-affinity filter (reference: TpKeyToCriticalPaths — the "critical path"
     min).  Nodes lacking the topology key fail hard constraints.
     """
-    cnt, has_key, valid = _term_rows(counts, node_dom, term_key, spread_terms)
+    cnt, has_key, valid = _rows(cnt_node, has_key_all, spread_terms)
     elig = eligible[None, :] & has_key
     min_match = jnp.min(jnp.where(elig, cnt, jnp.inf), axis=1)
     if axis_name:
@@ -52,60 +63,57 @@ def spread_step(counts, node_dom, term_key, spread_terms, maxskew, hard, eligibl
 
 
 def interpod_required_ok(
-    counts, anti_counts, node_dom, term_key, aff_terms, anti_terms, m_pend_col
+    cnt_node, anti_node, total_t, has_key_all, aff_terms, anti_terms,
+    match_terms, match_vals, aff_self,
 ):
     """-> ok[N]: required pod affinity + own anti-affinity + existing pods'
-    anti-affinity (symmetric), against current counts."""
-    D = counts.shape[1] - 1
-    N = node_dom.shape[1]
+    anti-affinity (symmetric), against current per-node counts.
 
+    The symmetric half iterates the pod's MATCHED-TERM slots (match_terms[M],
+    match_vals[M] — the nonzero entries of this pod's m_pend column, padded
+    with -1): blocked[n] = sum_j mv_j * anti_node[mt_j, n] over keyed nodes —
+    the scan-time form of interpodaffinity/filtering.go —
+    satisfyExistingPodsAntiAffinity."""
     # --- required affinity: every term's domain must already hold a match,
     # unless NO matching pod exists anywhere and the pod matches its own terms
-    cnt, has_key, valid = _term_rows(counts, node_dom, term_key, aff_terms)
+    cnt, has_key, valid = _rows(cnt_node, has_key_all, aff_terms)
     ok_a = jnp.where(valid[:, None], has_key & (cnt > 0), True)
     tids = jnp.maximum(aff_terms, 0)
-    total_any = jnp.where(valid, counts[tids, :D].sum(axis=1), 0.0).sum()
-    self_all = jnp.all(jnp.where(valid, m_pend_col[tids] > 0, True))
+    total_any = jnp.where(valid, total_t[tids], 0.0).sum()
+    self_all = jnp.all(jnp.where(valid, aff_self, True))
     has_aff = valid.any()
     waiver = has_aff & (total_any == 0) & self_all
     aff_ok = jnp.all(ok_a, axis=0) | waiver
 
     # --- own required anti-affinity: domain must hold no match (absent key
     # cannot be violated)
-    cnt2, has_key2, valid2 = _term_rows(counts, node_dom, term_key, anti_terms)
+    cnt2, has_key2, valid2 = _rows(cnt_node, has_key_all, anti_terms)
     anti_ok = jnp.all(jnp.where(valid2[:, None], ~(has_key2 & (cnt2 > 0)), True), axis=0)
 
-    # --- existing pods' anti-affinity vs this pod: aggregate per topology key
-    # (column D dropped: an anti term on a keyless node can't be violated)
-    K = node_dom.shape[0]
-    contrib = m_pend_col[:, None] * anti_counts[:, :D]  # [T, D]
-    per_key = jax.ops.segment_sum(contrib, term_key, num_segments=K)  # [K, D]
-    per_key = jnp.concatenate([per_key, jnp.zeros((K, 1), per_key.dtype)], axis=1)
-    blocked = jnp.take_along_axis(per_key, node_dom, axis=1).sum(axis=0)  # [N]
+    # --- existing pods' anti-affinity vs this pod, via the matched-term slots
+    # (keyless nodes dropped: an anti term there can't be violated)
+    acnt, ahas_key, avalid = _rows(anti_node, has_key_all, match_terms)
+    w = jnp.where(avalid, match_vals, 0.0)[:, None]
+    blocked = (jnp.where(ahas_key, acnt, 0.0) * w).sum(axis=0)  # [N]
     return aff_ok & anti_ok & (blocked == 0)
 
 
 def interpod_pref_raw(
-    counts, pref_own, node_dom, term_key, pref_terms, pref_w, m_pend_col
+    cnt_node, pref_node, has_key_all, pref_terms, pref_w, match_terms, match_vals
 ):
     """f32[N]: preferred inter-pod affinity raw score (interpodaffinity/
     scoring.go — processExistingPod, both directions):
 
-      own half:       sum_b w_b * counts[t_b, dom(key_b, n)]   (anti: w<0)
-      symmetric half: sum_t m[t, p] * pref_own[t, dom(key_t, n)]
+      own half:       sum_b w_b * cnt_node[t_b, n]    (anti: w<0)
+      symmetric half: sum_j mv_j * pref_node[mt_j, n]
 
-    (column D — keyless nodes/pods — excluded on both halves.)"""
-    D = counts.shape[1] - 1
-    # own preferred terms
-    cnt, has_key, valid = _term_rows(counts, node_dom, term_key, pref_terms)
+    (keyless nodes excluded on both halves via has_key_all.)"""
+    cnt, has_key, valid = _rows(cnt_node, has_key_all, pref_terms)
     w = jnp.where(valid, pref_w, 0.0)[:, None]
     own = (jnp.where(has_key, cnt, 0.0) * w).sum(axis=0)
-    # existing pods' preferred terms toward this pod, aggregated per key
-    K = node_dom.shape[0]
-    contrib = m_pend_col[:, None] * pref_own[:, :D]  # [T, D]
-    per_key = jax.ops.segment_sum(contrib, term_key, num_segments=K)
-    per_key = jnp.concatenate([per_key, jnp.zeros((K, 1), per_key.dtype)], axis=1)
-    sym = jnp.take_along_axis(per_key, node_dom, axis=1).sum(axis=0)
+    pcnt, phas_key, pvalid = _rows(pref_node, has_key_all, match_terms)
+    pw = jnp.where(pvalid, match_vals, 0.0)[:, None]
+    sym = (jnp.where(phas_key, pcnt, 0.0) * pw).sum(axis=0)
     return own + sym
 
 
@@ -114,17 +122,30 @@ def ports_ok(ports_used, pod_ports_row):
     return ~jnp.any(ports_used & pod_ports_row[None, :], axis=1)
 
 
-def commit_counts(counts, anti_counts, choice, dom_col, m_pend_col, anti_terms):
-    """Scatter the committed pod into the pairwise counts (no-op when choice<0).
+def commit_counts(cnt_node, anti_node, total_t, dom_by_term, n_domains,
+                  choice, dom_col, match_terms, match_vals, anti_terms):
+    """Absorb the committed pod into the per-node pairwise state (no-op when
+    choice < 0).
 
     `dom_col` is the chosen node's domain per term ([T], already resolved
     globally by the caller — under sharding the owner shard broadcasts it).
+    Only the pod's matched-term / own-anti-term rows are touched: row r gains
+    its weight at every node sharing the chosen node's domain
+    (dom_by_term[r] == dom_col[r]); pad slots add 0 at row 0.
     """
-    T = counts.shape[0]
-    placed = (choice >= 0).astype(counts.dtype)
-    counts = counts.at[jnp.arange(T), dom_col].add(placed * m_pend_col)
+    placed = choice >= 0
+    w = jnp.where((match_terms >= 0) & placed, match_vals, 0.0).astype(cnt_node.dtype)
+    tids = jnp.maximum(match_terms, 0)
+    same = dom_by_term[tids] == dom_col[tids][:, None]  # [M, N]
+    cnt_node = cnt_node.at[tids].add(w[:, None] * same)
+    # matches-anywhere total: only domains that HAVE the key count
+    # (domain id n_domains == "key absent", a static int from the caller)
+    keyed = dom_col[tids] < n_domains
+    total_t = total_t.at[tids].add(w * keyed)
     # the pod's own anti terms now constrain later pods
-    valid2 = (anti_terms >= 0) & (choice >= 0)
+    valid2 = (anti_terms >= 0) & placed
     tids2 = jnp.maximum(anti_terms, 0)
-    anti_counts = anti_counts.at[tids2, dom_col[tids2]].add(valid2.astype(anti_counts.dtype))
-    return counts, anti_counts
+    w2 = valid2.astype(anti_node.dtype)
+    same2 = dom_by_term[tids2] == dom_col[tids2][:, None]  # [A2, N]
+    anti_node = anti_node.at[tids2].add(w2[:, None] * same2)
+    return cnt_node, anti_node, total_t

@@ -57,6 +57,9 @@ def _node_sharding_specs(image_sharded: bool) -> ClusterArrays:
         node_dom=P(None, NODE_AXIS),
         term_key=P(),
         m_pend=P(None, None),
+        pod_match_terms=P(None, None),
+        pod_match_vals=P(None, None),
+        pod_aff_self=P(None, None),
         term_counts0=P(None, None),
         anti_counts0=P(None, None),
         pod_aff_terms=P(None, None),

@@ -1,20 +1,29 @@
 """TPUScore client — the scheduler side of the sidecar protocol.
 
 Wraps the gRPC channel with the fallback contract the north star mandates:
-deadline exceeded or transport failure raises SidecarUnavailable, and the
-caller (scheduler.py) falls back to the stock CPU path — exactly how the
-reference tolerates a misbehaving HTTP extender (extender.go ignorable errors).
-"""
+deadline exceeded, transport failure, or a cold (still-compiling) sidecar
+raises SidecarUnavailable and the caller (scheduler.py) falls back to the
+stock CPU path — exactly how the reference tolerates a misbehaving HTTP
+extender (extender.go ignorable errors).
+
+Round-3 sessions: the client ships the cluster once, then per cycle only the
+spec-interned wave + the bound-pod diff (tpuscore.proto — SessionDelta).  The
+diff is computed here against the last acknowledged state; any gap the server
+reports (resync_required — e.g. it restarted) triggers ONE full-snapshot
+retry inside the same call, which is the crash-only reconnect contract."""
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import dataclasses
+import uuid
+from typing import Dict, List, Optional, Tuple
 
 import grpc
 
+from ..api import types as t
 from ..api.snapshot import Snapshot
 from . import tpuscore_pb2 as pb
-from .convert import snapshot_to_proto
+from .convert import node_to_proto, pod_to_proto, wave_to_proto
 from .sidecar import SERVICE
 
 
@@ -22,10 +31,23 @@ class SidecarUnavailable(Exception):
     pass
 
 
+# one shared field list + comparator with the encoder's bind-absorb
+# revalidation — the two drift checks cannot diverge
+from ..api.delta import bound_spec_fields_match as _spec_fields_match
+
+
 class TPUScoreClient:
-    def __init__(self, address: str):
+    def __init__(self, address: str, session: bool = True):
+        from .sidecar import TPUScoreServer
+
         self.address = address
-        self._channel = grpc.insecure_channel(address)
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", TPUScoreServer.MAX_MSG),
+                ("grpc.max_send_message_length", TPUScoreServer.MAX_MSG),
+            ],
+        )
         self._schedule = self._channel.unary_unary(
             f"/{SERVICE}/Schedule",
             request_serializer=pb.ScheduleRequest.SerializeToString,
@@ -36,6 +58,14 @@ class TPUScoreClient:
             request_serializer=pb.HealthRequest.SerializeToString,
             response_deserializer=pb.HealthResponse.FromString,
         )
+        # session state (None session_id = legacy stateless requests)
+        self.session_id = uuid.uuid4().hex if session else ""
+        self._epoch = 0
+        self._synced = False
+        self._nodes_fp: Optional[Tuple] = None
+        self._last_wave: Dict[str, t.Pod] = {}
+        self._known_bound: Dict[str, t.Pod] = {}
+        self.stats = {"full": 0, "delta": 0, "resync": 0, "not_ready": 0}
 
     def health(self, timeout_s: float = 2.0) -> pb.HealthResponse:
         try:
@@ -43,6 +73,70 @@ class TPUScoreClient:
         except grpc.RpcError as e:
             raise SidecarUnavailable(str(e.code())) from e
 
+    # --- request builders ---
+    def _full_request(self, snap: Snapshot, deadline_ms, gang, hpaw):
+        req = pb.ScheduleRequest(
+            deadline_ms=deadline_ms,
+            gang=gang,
+            hard_pod_affinity_weight=hpaw,
+            session_id=self.session_id,
+            epoch=self._epoch,
+            wave=wave_to_proto(snap.pending_pods),
+        )
+        req.snapshot.nodes.extend(node_to_proto(n) for n in snap.nodes)
+        req.snapshot.bound_pods.extend(pod_to_proto(p) for p in snap.bound_pods)
+        req.snapshot.pod_groups.extend(
+            pb.PodGroup(name=g.name, min_member=g.min_member)
+            for g in snap.pod_groups.values()
+        )
+        self.stats["full"] += 1
+        return req
+
+    def _delta_request(self, snap: Snapshot, deadline_ms, gang, hpaw):
+        req = pb.ScheduleRequest(
+            deadline_ms=deadline_ms,
+            gang=gang,
+            hard_pod_affinity_weight=hpaw,
+            session_id=self.session_id,
+            epoch=self._epoch,
+            wave=wave_to_proto(snap.pending_pods),
+        )
+        req.delta.SetInParent()  # presence even when the diff is empty
+        d = req.delta
+        d.base_epoch = self._epoch - 1
+        for p in snap.bound_pods:
+            known = self._known_bound.get(p.uid)
+            if known is not None:
+                # already on the server — but a REPLACED object (label or
+                # other metadata update to a bound pod; the in-process
+                # encoder's `rec[_OBJ] is not q` case) must ship so the
+                # session doesn't silently diverge from the stateless path
+                if known is p or (
+                    p.node_name == known.node_name and _spec_fields_match(known, p)
+                ):
+                    continue
+                d.added_bound.append(pod_to_proto(p))
+                continue
+            prev = self._last_wave.get(p.uid)
+            if prev is not None and _spec_fields_match(prev, p):
+                d.binds.add(pod_uid=p.uid, node=p.node_name)
+            else:
+                # never seen pending (external bind), or the bound copy
+                # drifted from the wave spec (e.g. label update raced the
+                # bind): ship the object itself
+                d.added_bound.append(pod_to_proto(p))
+        bound_now = {p.uid for p in snap.bound_pods}
+        d.deleted_uids.extend(
+            uid for uid in self._known_bound if uid not in bound_now
+        )
+        req.snapshot.pod_groups.extend(
+            pb.PodGroup(name=g.name, min_member=g.min_member)
+            for g in snap.pod_groups.values()
+        )
+        self.stats["delta"] += 1
+        return req
+
+    # --- the call ---
     def schedule(
         self,
         snap: Snapshot,
@@ -51,12 +145,65 @@ class TPUScoreClient:
         hard_pod_affinity_weight: float = 1.0,
     ) -> Dict[str, Optional[str]]:
         """-> pod uid -> node name (None = unschedulable).  Raises
-        SidecarUnavailable on deadline/transport failure (caller falls back)."""
+        SidecarUnavailable on deadline/transport failure or a still-compiling
+        sidecar (caller falls back)."""
+        if not self.session_id:
+            return self._schedule_stateless(
+                snap, deadline_ms, gang, hard_pod_affinity_weight
+            )
+        nodes_fp = tuple((nd.name, id(nd)) for nd in snap.nodes)
+        self._epoch += 1
+        if self._synced and nodes_fp == self._nodes_fp:
+            req = self._delta_request(
+                snap, deadline_ms, gang, hard_pod_affinity_weight
+            )
+        else:
+            req = self._full_request(
+                snap, deadline_ms, gang, hard_pod_affinity_weight
+            )
+        try:
+            resp = self._schedule(req, timeout=deadline_ms / 1e3)
+            if resp.resync_required:
+                # server lost the session (restart / eviction): reconnect by
+                # re-sending the full snapshot once, same call
+                self.stats["resync"] += 1
+                self._synced = False
+                req = self._full_request(
+                    snap, deadline_ms, gang, hard_pod_affinity_weight
+                )
+                resp = self._schedule(req, timeout=deadline_ms / 1e3)
+                if resp.resync_required:
+                    raise SidecarUnavailable("resync loop")
+        except grpc.RpcError as e:
+            # transport/deadline failure: the server may or may not have
+            # applied this epoch — force a full resync next cycle
+            self._synced = False
+            raise SidecarUnavailable(str(e.code())) from e
+        # the server applied this request's state even when answering
+        # not_ready — record it so the next cycle's diff is correct
+        self._synced = True
+        self._nodes_fp = nodes_fp
+        self._last_wave = {p.uid: p for p in snap.pending_pods}
+        self._known_bound = {p.uid: p for p in snap.bound_pods}
+        if resp.not_ready:
+            self.stats["not_ready"] += 1
+            raise SidecarUnavailable("sidecar compiling (not ready)")
+        # aligned-array verdicts: assignment[i] is a node index (our own node
+        # list's order) for pending pod i in the order we sent the wave
+        names = [nd.name for nd in snap.nodes]
+        return {
+            p.uid: (names[c] if c >= 0 else None)
+            for p, c in zip(snap.pending_pods, resp.assignment)
+        }
+
+    def _schedule_stateless(self, snap, deadline_ms, gang, hpaw):
+        from .convert import snapshot_to_proto
+
         req = pb.ScheduleRequest(
             snapshot=snapshot_to_proto(snap),
             deadline_ms=deadline_ms,
             gang=gang,
-            hard_pod_affinity_weight=hard_pod_affinity_weight,
+            hard_pod_affinity_weight=hpaw,
         )
         try:
             resp = self._schedule(req, timeout=deadline_ms / 1e3)

@@ -2,17 +2,24 @@
 
 The JAX process that owns the TPU: receives activeQ + NodeInfo snapshots over
 gRPC, runs the batched filter/score/commit kernels, streams binding verdicts
-back.  Single-writer by construction: one server thread owns the device
-(SURVEY.md §5 race-detection note — design the host side single-writer),
-gRPC concurrency is serialized through a lock rather than locks in the engine.
+back.  Single-writer by construction: device work is serialized through one
+lock; session bookkeeping lives under a separate fast lock so control-plane
+answers (not_ready / resync_required) never wait on a compile.
 
-Crash-only: the server keeps no state a reconnecting client cannot re-send —
-every request carries the full snapshot (delta streaming is a planned
-optimization; the contract already allows it because verdicts are pure
-functions of the snapshot).
+Round-3 session/delta protocol (the watch-cache analog on the wire — see
+tpuscore.proto): a session-holding client ships the cluster once, then per
+cycle only the spec-interned pending wave + the bound-pod diff.  Server-side,
+each session owns a resident api/delta.py — DeltaEncoder, so the device
+encode is incremental exactly like the in-process scheduler path.  Crash-only:
+the server may drop any session at any time and answer resync_required; the
+client re-sends the full snapshot (storage/cacher — rebuilt from LIST on any
+gap).  Cold sessions warm up in the background (encode + compile + one run);
+until then Schedule answers not_ready immediately and the client takes the
+mandated CPU fallback — /readyz reflects this state instead of lying.
 
 Service stubs are hand-wired with grpc.method_handlers_generic_handler (the
-image has grpcio but not grpc_tools' codegen plugin).
+image has grpcio but not grpc_tools' codegen plugin; messages come from
+protoc --python_out).
 """
 
 from __future__ import annotations
@@ -21,23 +28,64 @@ import dataclasses
 import threading
 import time
 from concurrent import futures
-from typing import Optional
+from typing import Dict, List, Optional
 
 import grpc
 import numpy as np
 
+from ..api import types as t
+from ..api.snapshot import Snapshot
 from . import tpuscore_pb2 as pb
-from .convert import snapshot_from_proto
+from .convert import (
+    node_from_proto,
+    pod_from_proto,
+    snapshot_from_proto,
+    wave_from_proto,
+)
 
 SERVICE = "tpuscore.TPUScore"
 
 
+class _Session:
+    """Per-client resident cluster state + encoder (single-writer: mutated
+    only under _Engine._state_lock)."""
+
+    def __init__(self, hpaw: float):
+        from ..api.delta import DeltaEncoder
+
+        self.enc = DeltaEncoder(hard_pod_affinity_weight=hpaw)
+        self.hpaw = hpaw
+        self.nodes: List[t.Node] = []
+        self.bound: Dict[str, t.Pod] = {}
+        self.last_wave: Dict[str, t.Pod] = {}
+        self.pod_groups: Dict[str, t.PodGroup] = {}
+        self.epoch = 0
+        self.ready = False
+        self.warming = False
+
+
+class _ResyncRequired(Exception):
+    pass
+
+
 class _Engine:
-    """The in-process scheduling engine the server fronts."""
+    """The in-process scheduling engine the server fronts.
 
-    def __init__(self):
-        self._lock = threading.Lock()
+    warmup_threshold: wave x nodes size above which a COLD session (no
+    compiled kernel for that coarse shape yet) answers not_ready and compiles
+    in the background instead of blowing the client's deadline; smaller
+    problems compile inline (sub-second on any backend)."""
 
+    MAX_SESSIONS = 4  # LRU-evicted; each session pins cluster state + encoder
+
+    def __init__(self, warmup_threshold: int = 4_000_000):
+        self._lock = threading.Lock()  # device owner
+        self._state_lock = threading.Lock()  # session bookkeeping
+        self._sessions: Dict[str, _Session] = {}  # insertion == LRU order
+        self.warmup_threshold = warmup_threshold
+        self._compiled: set = set()  # coarse (P_bucket, N_bucket, gang) shapes
+
+    # --- legacy stateless path ---
     def schedule(self, snap, gang: bool, hard_pod_affinity_weight: float = 1.0):
         from ..api.snapshot import encode_snapshot
         from ..ops import schedule_batch
@@ -60,11 +108,135 @@ class _Engine:
                 choices = np.asarray(schedule_batch(arr, cfg)[0])
             return choices, meta
 
+    # --- session path ---
+    def apply_request(self, request: pb.ScheduleRequest):
+        """Update (or create) the session's cluster state from the request.
+        Returns (session, wave_pods).  Raises _ResyncRequired on any gap."""
+        hpaw = (
+            request.hard_pod_affinity_weight
+            if request.HasField("hard_pod_affinity_weight")
+            else 1.0
+        )
+        wave = wave_from_proto(request.wave)
+        with self._state_lock:
+            sess = self._sessions.get(request.session_id)
+            if sess is not None:
+                # refresh LRU position (dead clients' sessions age out)
+                self._sessions.pop(request.session_id)
+                self._sessions[request.session_id] = sess
+            if request.HasField("delta"):
+                d = request.delta
+                if sess is None or sess.epoch != d.base_epoch or sess.hpaw != hpaw:
+                    raise _ResyncRequired()
+                import copy
+
+                for b in d.binds:
+                    prev = sess.last_wave.get(b.pod_uid)
+                    if prev is None:
+                        raise _ResyncRequired()
+                    q = copy.copy(prev)  # spec fields verified client-side
+                    q.node_name = b.node
+                    sess.bound[b.pod_uid] = q
+                for uid in d.deleted_uids:
+                    sess.bound.pop(uid, None)
+                for msg in d.added_bound:
+                    p = pod_from_proto(msg)
+                    sess.bound[p.uid] = p
+            else:
+                # full sync (re)builds the session; LRU-evict beyond the cap
+                # (crash-only: an evicted client just resyncs)
+                sess = _Session(hpaw)
+                self._sessions[request.session_id] = sess
+                while len(self._sessions) > self.MAX_SESSIONS:
+                    oldest = next(iter(self._sessions))
+                    del self._sessions[oldest]
+                sess.nodes = [node_from_proto(n) for n in request.snapshot.nodes]
+                sess.bound = {
+                    p.uid: p
+                    for p in (pod_from_proto(m) for m in request.snapshot.bound_pods)
+                }
+            sess.pod_groups = {
+                g.name: t.PodGroup(name=g.name, min_member=g.min_member)
+                for g in request.snapshot.pod_groups
+            }
+            sess.last_wave = {p.uid: p for p in wave}
+            sess.epoch = request.epoch
+            return sess, wave
+
+    def session_snapshot(self, sess: _Session, wave: List[t.Pod]) -> Snapshot:
+        return Snapshot(
+            nodes=sess.nodes,
+            pending_pods=wave,
+            bound_pods=list(sess.bound.values()),
+            pod_groups=dict(sess.pod_groups),
+        )
+
+    def coarse_shape(self, snap: Snapshot, gang: bool):
+        from ..api.snapshot import _bucket
+
+        return (
+            _bucket(len(snap.pending_pods)),
+            _bucket(len(snap.nodes)),
+            gang,
+        )
+
+    def run_session(self, sess: _Session, snap: Snapshot, gang: bool):
+        from ..ops import schedule_batch
+        from ..ops.gang import schedule_with_gangs
+        from ..ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
+
+        with self._lock:
+            arr, meta = sess.enc.encode(snap)
+            base = dataclasses.replace(
+                DEFAULT_SCORE_CONFIG, hard_pod_affinity_weight=sess.hpaw
+            )
+            cfg = infer_score_config(arr, base)
+            if gang:
+                choices, _ = schedule_with_gangs(arr, cfg)
+            else:
+                choices = np.asarray(schedule_batch(arr, cfg)[0])
+            self._compiled.add(self.coarse_shape(snap, gang))
+            return choices, meta
+
+    def warmup(self, sess: _Session, snap: Snapshot, gang: bool) -> None:
+        """Background: encode + compile + run once, then mark ready.  The
+        results are discarded — the client already took the CPU fallback for
+        this cycle; what survives is the jit cache and the session's resident
+        encoder state.  A FAILED warmup drops the session (crash-only): the
+        client's next request resyncs instead of hitting a session that
+        claims ready but cannot serve."""
+        try:
+            self.run_session(sess, snap, gang)
+        except Exception:  # noqa: BLE001 — crash-only containment
+            with self._state_lock:
+                sess.warming = False
+                for sid, s in list(self._sessions.items()):
+                    if s is sess:
+                        del self._sessions[sid]
+            return
+        with self._state_lock:
+            sess.warming = False
+            sess.ready = True
+
+    @property
+    def ready(self) -> bool:
+        with self._state_lock:
+            return all(s.ready for s in self._sessions.values())
+
 
 class TPUScoreServer:
+    # full snapshots at north-star scale exceed gRPC's 4 MB default
+    MAX_MSG = 256 * 1024 * 1024
+
     def __init__(self, address: str = "127.0.0.1:0", engine: Optional[_Engine] = None):
         self.engine = engine or _Engine()
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4),
+            options=[
+                ("grpc.max_receive_message_length", self.MAX_MSG),
+                ("grpc.max_send_message_length", self.MAX_MSG),
+            ],
+        )
         handlers = {
             "Schedule": grpc.unary_unary_rpc_method_handler(
                 self._schedule,
@@ -85,7 +257,48 @@ class TPUScoreServer:
     # --- RPCs ---
     def _schedule(self, request: pb.ScheduleRequest, context) -> pb.ScheduleResponse:
         t0 = time.perf_counter()
+        if not request.session_id:
+            return self._schedule_stateless(request, t0)
+        try:
+            sess, wave = self.engine.apply_request(request)
+        except _ResyncRequired:
+            return pb.ScheduleResponse(resync_required=True)
+        snap = self.engine.session_snapshot(sess, wave)
+        if not sess.ready:
+            eng = self.engine
+            small = (
+                len(snap.pending_pods) * max(1, len(snap.nodes))
+                < eng.warmup_threshold
+            )
+            spawn = False
+            with eng._state_lock:  # check-then-act atomic across the RPC pool
+                if small or eng.coarse_shape(snap, request.gang) in eng._compiled:
+                    # compile affordable (or already paid): serve synchronously
+                    sess.ready = True
+                elif not sess.warming:
+                    sess.warming = True
+                    spawn = True
+            if spawn:
+                threading.Thread(
+                    target=eng.warmup, args=(sess, snap, request.gang), daemon=True
+                ).start()
+            if not sess.ready:
+                return pb.ScheduleResponse(not_ready=True, epoch=sess.epoch)
+        choices, meta = self.engine.run_session(sess, snap, request.gang)
+        # aligned-array verdicts: node index per wave pod in REQUEST order
+        # (meta.pod_perm maps device order -> request order; node indices are
+        # the session's node-list order == the client's own node list)
+        resp = pb.ScheduleResponse(epoch=sess.epoch)
+        out = np.full(meta.n_pods, -1, dtype=np.int64)
+        out[meta.pod_perm] = np.asarray(choices[: meta.n_pods], dtype=np.int64)
+        resp.assignment.extend(out.tolist())
+        resp.elapsed_ms = (time.perf_counter() - t0) * 1e3
+        return resp
+
+    def _schedule_stateless(self, request, t0) -> pb.ScheduleResponse:
         snap = snapshot_from_proto(request.snapshot)
+        if request.wave.uids or request.wave.specs:
+            snap.pending_pods = wave_from_proto(request.wave)
         uid_of = {p.name: p.uid for p in snap.pending_pods}
         hpaw = (
             request.hard_pod_affinity_weight
@@ -94,6 +307,12 @@ class TPUScoreServer:
         )
         choices, meta = self.engine.schedule(snap, request.gang, hpaw)
         resp = pb.ScheduleResponse()
+        self._fill_verdicts(resp, choices, meta, uid_of)
+        resp.elapsed_ms = (time.perf_counter() - t0) * 1e3
+        return resp
+
+    @staticmethod
+    def _fill_verdicts(resp, choices, meta, uid_of) -> None:
         for k in range(meta.n_pods):
             c = int(choices[k])
             name = meta.pod_names[k]
@@ -104,14 +323,17 @@ class TPUScoreServer:
                     scheduled=c >= 0,
                 )
             )
-        resp.elapsed_ms = (time.perf_counter() - t0) * 1e3
-        return resp
 
     def _health(self, request, context) -> pb.HealthResponse:
         import jax
 
         devs = jax.devices()
-        return pb.HealthResponse(ok=True, platform=devs[0].platform, device_count=len(devs))
+        return pb.HealthResponse(
+            ok=True,
+            platform=devs[0].platform,
+            device_count=len(devs),
+            ready=self.engine.ready,
+        )
 
     # --- lifecycle ---
     def start(self) -> int:
@@ -196,7 +418,7 @@ def main() -> None:  # pragma: no cover - manual entry point
     port = srv.start()
     if args.health_port:
         hs = HealthServer(f"127.0.0.1:{args.health_port}",
-                          ready_check=lambda: True)
+                          ready_check=lambda: srv.engine.ready)
         print(f"health endpoints on port {hs.start()}")
     print(f"tpuscore sidecar listening on port {port}")
     threading.Event().wait()

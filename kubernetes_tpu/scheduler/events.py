@@ -28,7 +28,8 @@ class SchedulingEvent:
 
 class EventRecorder:
     def __init__(self, capacity: int = 100_000, store=None,
-                 publish_limit: int = 10_000):
+                 publish_limit: int = 10_000, publish_qps: float = 200.0,
+                 publish_burst: int = 512):
         self._lock = threading.Lock()
         self.events: List[SchedulingEvent] = []
         self.capacity = capacity
@@ -39,13 +40,28 @@ class EventRecorder:
         # limit (the reference bounds events with an etcd TTL instead)
         self.publish_limit = publish_limit
         self._published = deque()  # (obj key, agg key), insertion order
+        # API-object publication is rate limited, dropping excess — the
+        # reference's EventBroadcaster likewise drops events when the sink
+        # can't keep up (client-go tools/record — record.go channel overflow;
+        # the in-memory decision log above stays complete either way)
+        self._qps = publish_qps
+        self._tokens = float(publish_burst)
+        self._burst = float(publish_burst)
+        self._last_refill = time.monotonic()
 
     def record(self, reason: str, pod: str, node: str = "", message: str = "") -> None:
         with self._lock:
             if len(self.events) < self.capacity:
                 self.events.append(SchedulingEvent(reason, pod, node, message))
             if self._store is not None:
-                self._publish(reason, pod, node, message)
+                now = time.monotonic()
+                self._tokens = min(
+                    self._burst, self._tokens + (now - self._last_refill) * self._qps
+                )
+                self._last_refill = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    self._publish(reason, pod, node, message)
 
     def _publish(self, reason: str, pod: str, node: str, message: str) -> None:
         from ..api.cluster import ClusterEvent

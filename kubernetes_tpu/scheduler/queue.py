@@ -179,6 +179,21 @@ class PriorityQueue:
         return None
 
     @_locked
+    def pop_all(self) -> List[t.Pod]:
+        """Drain the activeQ in pop order under ONE lock acquisition — the
+        batch cycle's bulk Pop (the reference pops one pod per cycle; the
+        batched path would otherwise pay P lock round-trips per cycle)."""
+        self._flush_backoff()
+        out: List[t.Pod] = []
+        while self._active:
+            item = heapq.heappop(self._active)
+            if item.pod.uid in self._active_uids:
+                self._active_uids.discard(item.pod.uid)
+                self._attempts[item.pod.uid] = self._attempts.get(item.pod.uid, 0) + 1
+                out.append(item.pod)
+        return out
+
+    @_locked
     def backoff_duration(self, pod_uid: str) -> float:
         n = max(0, self._attempts.get(pod_uid, 1) - 1)
         return min(MAX_BACKOFF_S, INITIAL_BACKOFF_S * (2**n))

@@ -25,6 +25,7 @@ event kinds.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
@@ -100,6 +101,12 @@ class Scheduler:
             )
         )
         self._sidecar = None  # lazy TPUScoreClient when profile configures one
+        # batched-bind move coalescing: while a batch commit loop runs, watch
+        # events' MoveAllToActiveOrBackoffQueue calls collapse into one move
+        # per event kind at loop exit (the reference fires one move per
+        # CLUSTER event; a 10k-pod batch bind is 10k events back-to-back)
+        self._move_lock = threading.Lock()
+        self._move_coalesce: Optional[set] = None
         # resident incremental encoder for the batch path: cluster-side device
         # state persists across cycles, absorbing bind/delete deltas
         # (api/delta.py — the watch-cache analog)
@@ -107,18 +114,42 @@ class Scheduler:
         store.watch(self._on_event)
 
     # --- watch plumbing ---
+    def _move_all(self, event_kind: str) -> None:
+        """MoveAllToActiveOrBackoffQueue, coalesced while a batch bind loop is
+        active (one real move per distinct event kind at loop exit)."""
+        with self._move_lock:
+            if self._move_coalesce is not None:
+                self._move_coalesce.add(event_kind)
+                return
+        self.queue.move_all_to_active_or_backoff(event_kind)
+
+    @contextlib.contextmanager
+    def _coalesced_moves(self):
+        with self._move_lock:
+            first = self._move_coalesce is None
+            if first:
+                self._move_coalesce = set()
+        try:
+            yield
+        finally:
+            if first:
+                with self._move_lock:
+                    kinds, self._move_coalesce = self._move_coalesce, None
+                for k in sorted(kinds):
+                    self.queue.move_all_to_active_or_backoff(k)
+
     def _on_event(self, ev: Event) -> None:
         if ev.obj_type == "Pod":
             pod = ev.obj
             if ev.kind == "Deleted":
                 self.queue.delete(pod.uid)
-                self.queue.move_all_to_active_or_backoff(EV_POD_DELETE)
+                self._move_all(EV_POD_DELETE)
             elif ev.kind == "ModifiedStatus":
                 # status-only write: no requeue of THIS pod — but a bound pod
                 # reaching a terminal phase releases capacity, which is an
                 # AssignedPodDelete move event for waiting unschedulable pods
                 if pod.node_name and pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
-                    self.queue.move_all_to_active_or_backoff(EV_POD_DELETE)
+                    self._move_all(EV_POD_DELETE)
             elif not pod.node_name:
                 st = self.framework.run_pre_enqueue(pod)
                 if st.ok:
@@ -129,9 +160,9 @@ class Scheduler:
             else:
                 # assigned-pod add/update: a newly bound pod can satisfy
                 # waiting pods' affinity/spread terms (AssignedPodAdd hint)
-                self.queue.move_all_to_active_or_backoff(EV_POD_ADD)
+                self._move_all(EV_POD_ADD)
         elif ev.obj_type == "Node":
-            self.queue.move_all_to_active_or_backoff(
+            self._move_all(
                 EV_NODE_ADD if ev.kind == "Added" else EV_NODE_UPDATE
             )
 
@@ -401,12 +432,7 @@ class Scheduler:
         from ..ops.gang import schedule_with_gangs
 
         t0 = time.perf_counter()
-        batch: List[t.Pod] = []
-        while True:
-            pod = self.queue.pop()
-            if pod is None:
-                break
-            batch.append(pod)
+        batch: List[t.Pod] = self.queue.pop_all()
         if not batch:
             return {}
         snap = self.cache.update_snapshot()
@@ -502,56 +528,57 @@ class Scheduler:
             }
         result: Dict[str, Optional[str]] = {}
         failed: List[t.Pod] = []
-        for pod in snap.pending_pods:
-            node_name = verdicts.get(pod.uid)
-            if node_name and pod.pvcs:
-                # PreBind volume commitment (static match / provisioning);
-                # failure sends the pod down the ordinary retry path
-                from .volumebinder import bind_pod_volumes
+        with self._coalesced_moves():
+            for pod in snap.pending_pods:
+                node_name = verdicts.get(pod.uid)
+                if node_name and pod.pvcs:
+                    # PreBind volume commitment (static match / provisioning);
+                    # failure sends the pod down the ordinary retry path
+                    from .volumebinder import bind_pod_volumes
 
-                err = bind_pod_volumes(self.store, pod, node_name)
-                if err is not None:
-                    node_name = None
-            if node_name:
-                self.cache.assume(pod.uid, node_name)
-                self.store.bind(pod.uid, node_name)
-                self.queue.delete_nominated(pod.uid)
-                self.events.record("Scheduled", pod.uid, node=node_name)
-                result[pod.name] = node_name
-            else:
-                failed.append(pod)
-                result[pod.name] = None
-        # failure path: preemption through the CPU PostFilter, then requeue.
-        # The what-if state is built once per batch (not per pod) and only
-        # rebuilt after an actual eviction; pods that cannot possibly preempt
-        # (no bound pod anywhere with lower priority) skip PostFilter outright.
-        state = None
-        snap2 = None
-        min_bound_prio: Optional[int] = None
-        for pod in failed:
-            if state is None:
-                from ..api.volumes import resolve_snapshot
-
-                snap2 = resolve_snapshot(self.cache.update_snapshot())
-                infos = self.cache.node_infos(snap2)
-                state = CycleState()
-                state.data["scaled"] = ScaledState(snap2, infos)
-                min_bound_prio = min(
-                    (q.priority for q in snap2.bound_pods), default=None
-                )
-            self.events.record("FailedScheduling", pod.uid)
-            if min_bound_prio is None or pod.priority <= min_bound_prio:
-                pst = Status.unschedulable("preemption: no lower-priority pods")
-                self._clear_nomination(pod)
-            else:
-                nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
-                if pst.ok and nominated:
-                    self.events.record("Preempted", pod.uid, node=nominated)
-                    self._nominate(pod, nominated)
-                    state = None  # evictions changed the cluster: rebuild lazily
+                    err = bind_pod_volumes(self.store, pod, node_name)
+                    if err is not None:
+                        node_name = None
+                if node_name:
+                    self.cache.assume(pod.uid, node_name)
+                    self.store.bind(pod.uid, node_name)
+                    self.queue.delete_nominated(pod.uid)
+                    self.events.record("Scheduled", pod.uid, node=node_name)
+                    result[pod.name] = node_name
                 else:
+                    failed.append(pod)
+                    result[pod.name] = None
+            # failure path: preemption through the CPU PostFilter, then requeue.
+            # The what-if state is built once per batch (not per pod) and only
+            # rebuilt after an actual eviction; pods that cannot possibly preempt
+            # (no bound pod anywhere with lower priority) skip PostFilter outright.
+            state = None
+            snap2 = None
+            min_bound_prio: Optional[int] = None
+            for pod in failed:
+                if state is None:
+                    from ..api.volumes import resolve_snapshot
+
+                    snap2 = resolve_snapshot(self.cache.update_snapshot())
+                    infos = self.cache.node_infos(snap2)
+                    state = CycleState()
+                    state.data["scaled"] = ScaledState(snap2, infos)
+                    min_bound_prio = min(
+                        (q.priority for q in snap2.bound_pods), default=None
+                    )
+                self.events.record("FailedScheduling", pod.uid)
+                if min_bound_prio is None or pod.priority <= min_bound_prio:
+                    pst = Status.unschedulable("preemption: no lower-priority pods")
                     self._clear_nomination(pod)
-            self.queue.add_unschedulable(pod, backoff=True)
+                else:
+                    nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
+                    if pst.ok and nominated:
+                        self.events.record("Preempted", pod.uid, node=nominated)
+                        self._nominate(pod, nominated)
+                        state = None  # evictions changed the cluster: rebuild lazily
+                    else:
+                        self._clear_nomination(pod)
+                self.queue.add_unschedulable(pod, backoff=True)
         dt = time.perf_counter() - t0
         self.log.V(2).info("Batch scheduled", batch=len(batch),
                            scheduled=len(batch) - len(failed),

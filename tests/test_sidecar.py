@@ -185,3 +185,172 @@ def test_wire_preserves_zero_hard_pod_affinity_weight(server):
     assert pulled["default/follower"] == "n-z2"
     assert flat["default/follower"] == "n-z1"
     client.close()
+
+
+# ------------------------------------------------------- session/delta wire
+
+
+def _wave(n, tag, cpu=100):
+    return [mk_pod(f"{tag}-{i}", cpu=cpu, labels={"app": f"svc-{i % 3}"}) for i in range(n)]
+
+
+def test_session_delta_stream_matches_stateless(server):
+    """Cycle 2+ ships only the wave + bound diff; verdicts must equal a
+    stateless full-snapshot request over the same cluster state."""
+    import dataclasses
+
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    stateless = TPUScoreClient(f"127.0.0.1:{server.port}", session=False)
+    nodes = [mk_node(f"n{i}", cpu=4000) for i in range(8)]
+    bound = []
+    for cycle in range(4):
+        wave = _wave(6, f"c{cycle}")
+        snap = Snapshot(nodes=nodes, pending_pods=wave, bound_pods=list(bound))
+        got = client.schedule(snap, deadline_ms=60_000)
+        want = stateless.schedule(snap, deadline_ms=60_000)
+        assert got == want, f"cycle {cycle}"
+        for p in wave:
+            node = got[p.uid]
+            if node:
+                bound.append(dataclasses.replace(p, node_name=node))
+        if bound:
+            bound.pop(0)  # churn: a bound pod departs each cycle
+    assert client.stats["full"] == 1 and client.stats["delta"] == 3, client.stats
+    client.close()
+    stateless.close()
+
+
+def test_session_resync_after_server_restart():
+    """Kill-and-reconnect: a new server has no session state; the client must
+    transparently resync with ONE full snapshot inside the same call."""
+    srv1 = TPUScoreServer()
+    srv1.start()
+    client = TPUScoreClient(f"127.0.0.1:{srv1.port}")
+    nodes = [mk_node(f"n{i}", cpu=4000) for i in range(4)]
+    v1 = client.schedule(Snapshot(nodes=nodes, pending_pods=_wave(4, "a")),
+                         deadline_ms=60_000)
+    assert any(v1.values())
+    port = srv1.port
+    srv1.stop(grace=0)
+    # restart on the SAME port: session gone, channel reconnects
+    srv2 = TPUScoreServer(f"127.0.0.1:{port}")
+    srv2.start()
+    try:
+        v2 = client.schedule(Snapshot(nodes=nodes, pending_pods=_wave(4, "b")),
+                             deadline_ms=60_000)
+        assert any(v2.values())
+        assert client.stats["resync"] == 1, client.stats
+    finally:
+        srv2.stop()
+        client.close()
+
+
+def test_cold_large_session_not_ready_exactly_once():
+    """A cold session above the warmup threshold answers not_ready (client
+    falls back) exactly once; after background warmup the same shapes serve."""
+    import time as _time
+
+    from kubernetes_tpu.runtime.sidecar import _Engine
+
+    srv = TPUScoreServer(engine=_Engine(warmup_threshold=1))  # everything is "large"
+    srv.start()
+    client = TPUScoreClient(f"127.0.0.1:{srv.port}")
+    try:
+        nodes = [mk_node(f"n{i}", cpu=4000) for i in range(4)]
+        snap = Snapshot(nodes=nodes, pending_pods=_wave(4, "a"))
+        assert not client.health().ready or not srv.engine._sessions
+        with pytest.raises(SidecarUnavailable, match="not ready"):
+            client.schedule(snap, deadline_ms=60_000)
+        # wait for background warmup, as /readyz consumers would
+        deadline = _time.monotonic() + 60
+        while not client.health().ready and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert client.health().ready
+        v = client.schedule(Snapshot(nodes=nodes, pending_pods=_wave(4, "b")),
+                            deadline_ms=60_000)
+        assert any(v.values())
+        assert client.stats["not_ready"] == 1, client.stats
+    finally:
+        srv.stop()
+        client.close()
+
+
+def test_session_bind_with_label_drift_ships_object(server):
+    """A bound copy whose labels drifted from the wave spec (label update
+    racing the bind) must ship as added_bound, not a bare uid bind — verdicts
+    stay identical to a stateless request over the true state."""
+    import dataclasses
+
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    stateless = TPUScoreClient(f"127.0.0.1:{server.port}", session=False)
+    nodes = [mk_node(f"n{i}", cpu=4000) for i in range(4)]
+    w1 = [
+        mk_pod(
+            "w1-0",
+            cpu=100,
+            labels={"app": "web"},
+            affinity=t.Affinity(
+                required_pod_anti_affinity=(
+                    t.PodAffinityTerm(
+                        topology_key=t.LABEL_HOSTNAME,
+                        label_selector=t.LabelSelector.of(app="web"),
+                    ),
+                ),
+            ),
+        ),
+        mk_pod("w1-1", cpu=100, labels={"app": "web"}),
+    ]
+    v1 = client.schedule(Snapshot(nodes=nodes, pending_pods=w1), deadline_ms=60_000)
+    # the bind lands with CHANGED labels
+    drifted = dataclasses.replace(w1[0], labels={"app": "db"}, node_name=v1[w1[0].uid])
+    bound = [drifted, dataclasses.replace(w1[1], node_name=v1[w1[1].uid])]
+    w2 = [dataclasses.replace(w1[0], name="w2-0", uid="")]
+    w2[0].__post_init__()
+    snap2 = Snapshot(nodes=nodes, pending_pods=w2, bound_pods=bound)
+    got = client.schedule(snap2, deadline_ms=60_000)
+    want = stateless.schedule(snap2, deadline_ms=60_000)
+    assert got == want
+    client.close()
+    stateless.close()
+
+
+def test_session_ships_bound_pod_updates(server):
+    """A bound pod whose OBJECT is replaced between cycles (e.g. label update
+    on a bound pod — legal metadata mutation) must reach the session; verdicts
+    stay identical to stateless over the true state (round-3 review finding)."""
+    import dataclasses
+
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    stateless = TPUScoreClient(f"127.0.0.1:{server.port}", session=False)
+    nodes = [mk_node(f"n{i}", cpu=4000) for i in range(4)]
+    w1 = [mk_pod("b0", cpu=100, labels={"app": "web"})]
+    v1 = client.schedule(Snapshot(nodes=nodes, pending_pods=w1), deadline_ms=60_000)
+    bound = [dataclasses.replace(w1[0], node_name=v1[w1[0].uid])]
+    # settle one delta cycle so the server holds the bound copy
+    w2 = [mk_pod("w2", cpu=100)]
+    client.schedule(Snapshot(nodes=nodes, pending_pods=w2, bound_pods=bound),
+                    deadline_ms=60_000)
+    # now the bound pod's labels change (new object, same uid)
+    bound2 = [dataclasses.replace(bound[0], labels={"app": "db"})]
+    w3 = [
+        mk_pod(
+            "anti-db",
+            cpu=100,
+            affinity=t.Affinity(
+                required_pod_anti_affinity=(
+                    t.PodAffinityTerm(
+                        topology_key=t.LABEL_HOSTNAME,
+                        label_selector=t.LabelSelector.of(app="db"),
+                    ),
+                ),
+            ),
+        )
+    ]
+    snap3 = Snapshot(nodes=nodes, pending_pods=w3, bound_pods=bound2)
+    got = client.schedule(snap3, deadline_ms=60_000)
+    want = stateless.schedule(snap3, deadline_ms=60_000)
+    assert got == want
+    # the anti-affinity pod must avoid the updated pod's node
+    assert got[w3[0].uid] != bound2[0].node_name
+    client.close()
+    stateless.close()
