@@ -26,12 +26,14 @@ guards cannot absorb — the encoder falls back to a full cluster-side rebuild,
 which IS the one-shot path: `snapshot.encode_snapshot` delegates here, so the
 fast path and the fallback share one implementation.
 
-Known limitation: clusters using PVs/PVCs/attach limits/device slices defeat
-the cache — volumes.resolve_snapshot rebuilds node objects each cycle, so the
-node fingerprint (object identity) never matches and every cycle re-encodes
-fully.  That path is correct (it IS the full path), just not incremental;
-conditioning the cache on pre-resolution identity plus a storage-state
-fingerprint is future work.
+Volume/DRA clusters stay incremental (round 3): the cache is conditioned on
+PRE-resolution node identity plus a storage-state fingerprint (PV/PVC/class/
+slice object identities — _storage_fp), because volumes.resolve_snapshot
+rebuilds node objects every cycle and post-resolution identity would never
+match.  While storage state is stable the delta path serves (only
+storage-USING bound pods re-absorb per cycle, their resolved copies being
+fresh objects); any storage change forces the full rebuild
+(tests/test_delta_encoder.py — test_delta_survives_volume_state).
 """
 
 from __future__ import annotations
@@ -214,10 +216,32 @@ class ClusterSide:
     rep_bound_info: Dict[int, Tuple[int, int, Tuple[int, ...]]] = field(
         default_factory=dict
     )
+    # PRE-resolution conditioning (volume/DRA clusters): the raw node-set
+    # identity plus a storage-state fingerprint; resolve_snapshot rebuilds
+    # node objects per cycle, so post-resolution identity alone would defeat
+    # the cache whenever any PV/PVC/class/slice exists.  raw_refs keeps the
+    # fingerprinted objects alive so ids cannot be recycled.
+    raw_nodes_fp: Tuple = ()
+    storage_fp: Tuple = ()
+    raw_refs: Tuple = ()
 
 
 def _nodes_fp(nodes: Sequence[t.Node]) -> Tuple:
     return tuple((nd.name, id(nd)) for nd in nodes)
+
+
+def _storage_fp(snap) -> Tuple:
+    """Identity fingerprint of every input volumes.resolve_snapshot reads
+    beyond nodes/pods: PVs, PVCs, StorageClasses, ResourceSlices,
+    DeviceClasses.  Identity-based under the repo-wide copy-on-write
+    convention (a state change replaces the object)."""
+    return (
+        tuple(id(pv) for pv in snap.pvs),
+        tuple((k, id(v)) for k, v in snap.pvcs.items()),
+        tuple(sorted((k, id(v)) for k, v in snap.storage_classes.items())),
+        tuple(id(sl) for sl in snap.resource_slices),
+        tuple(sorted((k, id(v)) for k, v in snap.device_classes.items())),
+    )
 
 
 # The pod fields the bound-side absorb reads (what _spec_info/_bound_spec_key
@@ -760,7 +784,7 @@ class DeltaEncoder:
         """group_by_spec with the encoder-resident identity->key cache: same
         reps/inv as snapshot.group_by_spec (bit-identical arrays), plus each
         rep's canonical key (the pod-side cache key input)."""
-        from .snapshot import _pod_spec_key
+        from .snapshot import _identity_key, _pod_spec_key
 
         if len(self._spec_keys) > 2 * (len(pods) + 1024):
             self._spec_keys.clear()
@@ -770,12 +794,7 @@ class DeltaEncoder:
         rep_keys: List[Tuple] = []
         inv = np.empty(len(pods), dtype=np.int64)
         for i, pod in enumerate(pods):
-            ik = (
-                id(pod.requests), id(pod.labels), pod.namespace, pod.node_name,
-                pod.priority, id(pod.tolerations), id(pod.node_selector),
-                id(pod.affinity), id(pod.topology_spread), id(pod.host_ports),
-                id(pod.scheduling_gates), pod.pod_group, id(pod.images),
-            )
+            ik = _identity_key(pod)
             ent = cache.get(ik)
             if ent is None:
                 # the VALUE keeps the pod (and so every id()'d field object)
@@ -796,6 +815,9 @@ class DeltaEncoder:
         from .snapshot import _resource_axis, activeq_order
         from .volumes import resolve_snapshot
 
+        raw_nodes_fp = _nodes_fp(snap.nodes)
+        storage_fp = _storage_fp(snap)
+        raw_snap = snap  # rebuilds capture keep-alive refs from the raw snap
         snap = resolve_snapshot(snap)
         pending = snap.pending_pods
         perm = activeq_order(pending)
@@ -808,7 +830,8 @@ class DeltaEncoder:
         if (
             cs is not None
             and cs.hpaw == self.hpaw
-            and cs.nodes_fp == _nodes_fp(snap.nodes)
+            and cs.raw_nodes_fp == raw_nodes_fp
+            and cs.storage_fp == storage_fp
             and _wave_compatible(cs, wfp)
         ):
             try:
@@ -822,6 +845,15 @@ class DeltaEncoder:
             cs = None
         if cs is None:
             cs = build_cluster_side(snap.nodes, snap.bound_pods, wfp, self.hpaw)
+            cs.raw_nodes_fp = raw_nodes_fp
+            cs.storage_fp = storage_fp
+            # keep-alive refs for every id() the fingerprints hold (built only
+            # here — steady-state delta cycles must not copy 20k-element lists)
+            cs.raw_refs = (
+                list(raw_snap.nodes), list(raw_snap.pvs), dict(raw_snap.pvcs),
+                dict(raw_snap.storage_classes), list(raw_snap.resource_slices),
+                dict(raw_snap.device_classes),
+            )
             cs.stats["rebuilds"] += 1
             self._cs = cs
             self.stats["full"] += 1

@@ -303,6 +303,20 @@ def _pod_spec_key(pod: t.Pod) -> Tuple:
     )
 
 
+def _identity_key(pod: t.Pod) -> Tuple:
+    """Field-object identity profile of a pod: pods copied from one template
+    (copy/replace) share these objects, so equal keys imply equal
+    `_pod_spec_key` — the fast first level of the two-level interning.  MUST
+    cover every field _pod_spec_key reads (one shared helper so the delta
+    encoder's resident cache and group_by_spec cannot drift)."""
+    return (
+        id(pod.requests), id(pod.labels), pod.namespace, pod.node_name,
+        pod.priority, id(pod.tolerations), id(pod.node_selector),
+        id(pod.affinity), id(pod.topology_spread), id(pod.host_ports),
+        id(pod.scheduling_gates), pod.pod_group, id(pod.images),
+    )
+
+
 def group_by_spec(pods: Sequence[t.Pod]) -> Tuple[List[t.Pod], np.ndarray]:
     """-> (reps, inv): unique encoding specs in first-occurrence order and each
     pod's spec index.  Interner-order equivalence: because every vocab below
@@ -327,12 +341,7 @@ def group_by_spec(pods: Sequence[t.Pod]) -> Tuple[List[t.Pod], np.ndarray]:
     use_fast = len(pods) > 512
     for i, pod in enumerate(pods):
         if use_fast:
-            ik = (
-                id(pod.requests), id(pod.labels), pod.namespace, pod.node_name,
-                pod.priority, id(pod.tolerations), id(pod.node_selector),
-                id(pod.affinity), id(pod.topology_spread), id(pod.host_ports),
-                id(pod.scheduling_gates), pod.pod_group, id(pod.images),
-            )
+            ik = _identity_key(pod)
             u = id_ids.get(ik)
             if u is not None:
                 inv[i] = id_to_spec[u]

@@ -1,0 +1,100 @@
+"""Batched preemption — L8: victim search as masked rescoring on device.
+
+reference: framework/preemption/preemption.go — type Evaluator +
+defaultpreemption/default_preemption.go — SelectVictimsOnNode.  The CPU
+evaluator (scheduler/plugins/cpu.py — DefaultPreemption, kept as the oracle)
+walks nodes in Python and re-runs every Filter per reprieve step: O(nodes x
+victims x plugins) interpreted work per failed pod.  Here the same semantics
+run as ONE device program vectorized over the node axis:
+
+  phase A  remove ALL lower-priority pods per node; feasibility =
+           static row (taints/selector/nodename, from the cycle's encoded
+           arrays) AND fit against (used - victims + nominated reservations)
+  phase B  reprieve scan over victim slots (host supplies them in the CPU
+           evaluator's exact order: PDB-violating first, then non-violating,
+           each by (-priority, uid)): re-add slot j on every candidate node
+           at once, keep it iff the preemptor still fits
+  phase C  candidate stats for pickOneNodeForPreemption's lexicographic key
+           (violations, max victim prio, prio sum, victim count, node index)
+           — the host does the final argmin and the eviction
+
+Scope gate (host side, scheduler/preemption.py): pods whose feasibility
+depends on pairwise terms, host ports, or volume topology take the CPU
+evaluator instead — removal-dependent pairwise state is per-candidate-node
+and does not vectorize exactly.  The gate preserves behavior; the batched
+path covers the fit-bound preemption that dominates at scale.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..api.snapshot import ClusterArrays
+from . import filters
+
+
+def _static_row(arr: ClusterArrays, pod_idx: jax.Array) -> jax.Array:
+    """bool[N]: the preemptor's capacity-independent feasibility row — same
+    terms as ops/assign.py — schedule_scan's `sf`, for one pod."""
+    tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)  # [S, N]
+    nodesel = filters.node_selection_ok_from(tm, arr)[pod_idx]  # [N]
+    pin = arr.pod_nodename[pod_idx]
+    my_nodes = jnp.arange(arr.N, dtype=jnp.int32)
+    nodename_ok = jnp.where(pin == -1, True, pin == my_nodes)
+    taints = filters.taints_ok(arr)[pod_idx]
+    return arr.node_valid & nodesel & nodename_ok & taints
+
+
+@partial(jax.jit, donate_argnums=())
+def preempt_eval(
+    arr: ClusterArrays,
+    pod_idx: jax.Array,  # i32 scalar: the preemptor's row in arr
+    used_now: jax.Array,  # i32[N, R] current per-node usage (scaled)
+    nom_extra: jax.Array,  # i32[N, R] nominated reservations (scaled)
+    has_nom: jax.Array,  # bool[N] nodes with >=1 relevant nominated pod
+    vict_req: jax.Array,  # i32[N, V, R] victim requests (scaled), 0 pad
+    vict_prio: jax.Array,  # i32[N, V] victim priorities
+    vict_viol: jax.Array,  # bool[N, V] victim counted as PDB-violating
+    vict_valid: jax.Array,  # bool[N, V]
+) -> Tuple[jax.Array, ...]:
+    """-> (cand[N], nvio[N], vmax[N], vsum[N], vcnt[N], is_victim[N, V])."""
+    req = arr.pod_req[pod_idx]  # [R]
+    alloc = arr.node_alloc
+    static_ok = _static_row(arr, pod_idx)
+
+    removed = (vict_req * vict_valid[:, :, None]).sum(axis=1)  # [N, R]
+    base = used_now + nom_extra - removed
+    okA = static_ok & filters.fit_ok(req, base, alloc)  # all-removed
+
+    def step(used_cur, xs):
+        vr, valid = xs  # [N, R], [N]
+        trial = used_cur + vr
+        fits = filters.fit_ok(req, trial, alloc)  # preemptor still fits?
+        keep = fits & valid & okA  # reprieved
+        used_cur = jnp.where(keep[:, None], trial, used_cur)
+        return used_cur, valid & okA & ~fits  # victim flag for this slot
+
+    xs = (jnp.moveaxis(vict_req, 1, 0), jnp.moveaxis(vict_valid, 1, 0))
+    used_final, victim_slots = lax.scan(step, base, xs)
+    is_victim = jnp.moveaxis(victim_slots, 0, 1)  # [N, V]
+
+    vcnt = is_victim.sum(axis=1)
+    # second pass of the nominated two-pass filter: feasibility must not
+    # DEPEND on a nominated pod that may never arrive (only checked when the
+    # node has victims AND nominated pods — plugins/cpu.py:385)
+    ok2 = jnp.where(
+        has_nom & (vcnt > 0),
+        filters.fit_ok(req, used_final - nom_extra, alloc),
+        True,
+    )
+    nvio = (is_victim & vict_viol).sum(axis=1)
+    neg_inf = jnp.iinfo(jnp.int32).min
+    vmax = jnp.where(is_victim, vict_prio, neg_inf).max(axis=1)
+    vsum = jnp.where(is_victim, vict_prio, 0).sum(axis=1)
+    cand = okA & ok2 & (vcnt > 0)
+    return cand, nvio, vmax, vsum, vcnt, is_victim

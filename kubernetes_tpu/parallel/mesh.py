@@ -24,3 +24,39 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
     import numpy as np
 
     return Mesh(np.array(devs), (NODE_AXIS,))
+
+
+def init_distributed(
+    coordinator: str, num_processes: int, process_id: int
+) -> Mesh:
+    """Multi-host (DCN) entry: join the jax.distributed cluster, then build
+    the node-axis mesh over ALL processes' devices.  The reference scales its
+    control plane over plain gRPC/HTTP2; here multi-host scheduling shards the
+    node axis across hosts with XLA collectives riding DCN between slices
+    (SURVEY.md §2.4 distributed-backend mapping).  Single-host callers never
+    need this — make_mesh over local devices is the ICI path.
+
+    Verified by tests/test_dcn_distributed.py: a 2-process CPU-sim cluster
+    runs the full sharded step with cross-process collectives and matches the
+    dense single-process decisions bit-for-bit."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return make_mesh()
+
+
+def global_arrays(mesh: Mesh, tree):
+    """Lift a pytree of process-replicated numpy arrays into global jax.Arrays
+    for multi-controller jit: every [*, N]/[N, *] array must enter a global-
+    mesh program as a jax.Array spanning processes; each process contributes
+    its addressable shards from its full local copy."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+
+    def lift(x):
+        return jax.make_array_from_process_local_data(rep, x)
+
+    return jax.tree_util.tree_map(lift, tree)

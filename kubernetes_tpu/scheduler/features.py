@@ -25,6 +25,9 @@ _GATES: Dict[str, Gate] = {
         Gate("TPUScore", BETA, True),  # batched TPU offload path
         Gate("GangScheduling", BETA, True),  # all-or-nothing PodGroups
         Gate("DefaultPreemption", GA, True),
+        # device-vectorized victim search on the batch path (ops/preempt.py);
+        # off -> every failed pod takes the CPU PostFilter evaluator
+        Gate("BatchedPreemption", BETA, True),
         Gate("SchedulingGates", GA, True),
         Gate("NodeInclusionPolicy", ALPHA, False),  # spread honors taints (future)
         Gate("MatchLabelKeys", ALPHA, False),  # spread matchLabelKeys (future)

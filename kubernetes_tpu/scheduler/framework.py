@@ -158,6 +158,32 @@ class Framework:
                 out.update(evs())
         return out or {EV_ALL}
 
+    def hints_for_plugins(self, plugin_names) -> Dict[str, list]:
+        """event kind -> [QueueingHintFn(obj, old, pod) -> bool] from the
+        named plugins (scheduling_queue.go — QueueingHintFn: per-EVENT-OBJECT
+        Queue/Skip, the precise half of the QueueingHint machinery).
+
+        A kind appears only when EVERY named plugin registering it supplies a
+        hint — one hintless registrant means that kind must wake
+        unconditionally, so it is left out (the queue's conservative path)."""
+        by_name = {pw.plugin.name: pw.plugin for pw in self.plugins}
+        fns: Dict[str, list] = {}
+        unconditional: set = set()
+        for name in plugin_names:
+            plugin = by_name.get(name)
+            evs = getattr(plugin, "EventsToRegister", None)
+            if plugin is None or evs is None:
+                continue
+            hint = getattr(plugin, "queueing_hint", None)
+            for ev in evs():
+                if hint is None:
+                    unconditional.add(ev)
+                else:
+                    fns.setdefault(ev, []).append(
+                        lambda obj, old, pod, _h=hint, _e=ev: _h(_e, obj, old, pod)
+                    )
+        return {ev: h for ev, h in fns.items() if ev not in unconditional}
+
     def run_post_filters(
         self, state: CycleState, snap: Snapshot, pod: t.Pod, statuses: Dict[str, Status]
     ) -> Tuple[Optional[str], Status]:

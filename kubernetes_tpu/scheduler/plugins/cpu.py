@@ -148,6 +148,18 @@ class NodeResourcesFit(Plugin):
     def EventsToRegister(self):
         return self._EVENTS
 
+    def queueing_hint(self, event, obj, old, pod) -> bool:
+        """noderesources/fit.go — isSchedulableAfterNodeChange: a Node/Update
+        requeues a fit-rejected pod only if some allocatable GREW; shrinking
+        or irrelevant updates (labels, heartbeats) cannot free capacity."""
+        if event == EV_NODE_UPDATE and old is not None:
+            new_alloc = getattr(obj, "allocatable", {})
+            old_alloc = getattr(old, "allocatable", {})
+            return any(
+                v > old_alloc.get(r, 0) for r, v in new_alloc.items()
+            )
+        return True  # Node/Add and Pod/Delete always free capacity
+
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         sc = state.data["scaled"]
         i = sc.index[info.node.name]
@@ -186,6 +198,19 @@ class PodTopologySpread(Plugin):
 
     def EventsToRegister(self):
         return self._EVENTS
+
+    def queueing_hint(self, event, obj, old, pod) -> bool:
+        """podtopologyspread/plugin.go — isSchedulableAfterPodChange: only an
+        assigned pod matching one of the rejected pod's spread selectors (in
+        its namespace — spread is namespace-scoped) can change the skew."""
+        if event in (EV_POD_ADD, EV_POD_DELETE) and hasattr(obj, "labels"):
+            return any(
+                c.label_selector is not None
+                and getattr(obj, "namespace", "") == pod.namespace
+                and c.label_selector.matches(obj.labels)
+                for c in pod.topology_spread
+            )
+        return True  # Node/Add introduces a new topology domain
 
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         sc = state.data["scaled"]
@@ -238,6 +263,47 @@ class InterPodAffinity(Plugin):
 
     def __init__(self, hard_pod_affinity_weight: float = 1.0):
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+
+    @staticmethod
+    def _term_matches(term, pod_ns, obj) -> bool:
+        ns = term.namespaces or (pod_ns,)
+        return (
+            term.label_selector is not None
+            and getattr(obj, "namespace", "") in ns
+            and term.label_selector.matches(obj.labels)
+        )
+
+    def queueing_hint(self, event, obj, old, pod) -> bool:
+        """interpodaffinity/plugin.go — isSchedulableAfterPodChange: an ADDED
+        assigned pod helps only if it matches a required-affinity selector;
+        a DELETED one helps only if it matched an anti-affinity selector (a
+        blocker left) or itself owned an anti term matching this pod."""
+        if event not in (EV_POD_ADD, EV_POD_DELETE) or not hasattr(obj, "labels"):
+            return True  # Node/Add: new placement options
+        a = pod.affinity
+        if event == EV_POD_ADD:
+            # an added pod can only help this pod's own REQUIRED affinity;
+            # symmetric anti-affinity only gains blockers from adds
+            return a is not None and any(
+                self._term_matches(tm, pod.namespace, obj)
+                for tm in a.required_pod_affinity
+            )
+        # EV_POD_DELETE: a pod this plugin rejected may have no affinity of
+        # its OWN — existing pods' symmetric anti terms also reject — so the
+        # departed pod's anti terms must be checked even when a is None
+        if a is not None and any(
+            self._term_matches(tm, pod.namespace, obj)
+            for tm in a.required_pod_anti_affinity
+        ):
+            return True
+        oa = getattr(obj, "affinity", None)
+        if oa is not None:
+            onm = getattr(obj, "namespace", "")
+            return any(
+                self._term_matches(tm, onm, pod)
+                for tm in oa.required_pod_anti_affinity
+            )
+        return False
 
     def Filter(self, state, snap, pod, info: NodeInfo) -> Status:
         sc = state.data["scaled"]

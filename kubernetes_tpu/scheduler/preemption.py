@@ -1,0 +1,236 @@
+"""Host orchestration for batched preemption (ops/preempt.py).
+
+Mirrors the CPU evaluator's semantics exactly (scheduler/plugins/cpu.py —
+DefaultPreemption, which stays the oracle): per failed pod, candidate victims
+are the lower-priority bound pods per node in the SAME reprieve order the CPU
+path uses (PDB-violating first, then non-violating, each by (-priority, uid),
+over NodeInfo.pods order = snapshot bound order), the device scan reprieves
+them against the preemptor's fit, and the host applies
+pickOneNodeForPreemption's lexicographic key.
+
+Scope gate (`applicable`): pods whose Filter outcome could depend on pairwise
+state, host ports, or volume/claim topology fall back to the CPU evaluator —
+the gate preserves oracle behavior while the fit-bound majority vectorizes.
+
+State is incremental across one failure loop: an eviction updates the victim
+node's row and usage in place; PDB-budget changes (watched objects) are
+fingerprinted per call and invalidate the per-priority victim tables.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import types as t
+from ..api.snapshot import EncodingMeta, pod_effective_requests
+from ..ops.scores import infer_score_config
+
+
+def _split_pdb_violating(pods, pdbs):
+    # the CPU evaluator's exact split (plugins/cpu.py — _split_pdb_violating)
+    remaining = {pdb.key: pdb.disruptions_allowed for pdb in pdbs}
+    violating, non_violating = [], []
+    for q in pods:
+        hit = [pdb for pdb in pdbs if pdb.matches(q)]
+        if any(remaining[pdb.key] <= 0 for pdb in hit):
+            violating.append(q)
+        else:
+            for pdb in hit:
+                remaining[pdb.key] -= 1
+            non_violating.append(q)
+    return violating, non_violating
+
+
+class BatchedPreemption:
+    """One failure loop's resident state: per-node bound pods + usage, the
+    encoded cycle arrays, and per-(priority, pdb-state) victim tables."""
+
+    def __init__(self, arr, meta: EncodingMeta, snap, store, queue):
+        self.arr = arr
+        self.meta = meta
+        self.store = store
+        self.queue = queue
+        self.scale = np.asarray(meta.resource_scale, dtype=np.int64)
+        self.resources = list(meta.resources)
+        self.node_idx: Dict[str, int] = {
+            name: i for i, name in enumerate(meta.node_names)
+        }
+        self.pod_row: Dict[str, int] = {}
+        for k in range(meta.n_pods):
+            self.pod_row.setdefault(meta.pod_names[k], k)
+        n = len(meta.node_names)
+        self.node_pods: List[List[t.Pod]] = [[] for _ in range(n)]
+        self.used_raw = np.zeros((n, len(self.resources)), dtype=np.int64)
+        for q in snap.bound_pods:
+            i = self.node_idx.get(q.node_name)
+            if i is not None:
+                self.node_pods[i].append(q)
+                self.used_raw[i] += np.array(
+                    pod_effective_requests(q, self.resources), dtype=np.int64
+                )
+        # pairwise anywhere in the cluster state? (existing pods' anti terms
+        # can constrain ANY preemptor, so their mere presence gates).  Derived
+        # from the FRESH post-bind snapshot, not the cycle's pre-bind arrays:
+        # anti-affinity pods bound earlier in this very batch must gate too.
+        self._has_anti = any(
+            q.affinity is not None and q.affinity.required_pod_anti_affinity
+            for q in snap.bound_pods
+        )
+        self._level_cache: Dict[Tuple, Tuple] = {}
+
+    # --- gate ---
+    def applicable(self, pod: t.Pod) -> bool:
+        if pod.name not in self.pod_row:
+            return False  # not in this cycle's encoding (shouldn't happen)
+        if pod.host_ports or pod.pvcs or pod.resource_claims:
+            return False
+        if self._has_anti:
+            return False
+        if pod.topology_spread:
+            return False
+        a = pod.affinity
+        if a is not None and (
+            a.required_pod_affinity or a.required_pod_anti_affinity
+        ):
+            return False
+        return True
+
+    # --- victim tables ---
+    def _pdb_fp(self):
+        pdbs = list(getattr(self.store, "pdbs", {}).values())
+        return tuple((p.key, p.disruptions_allowed) for p in pdbs), pdbs
+
+    def _tables(self, priority: int):
+        fp, pdbs = self._pdb_fp()
+        key = (priority, fp)
+        ent = self._level_cache.get(key)
+        if ent is None:
+            n = len(self.node_pods)
+            ordered: List[List[Tuple[t.Pod, bool]]] = []
+            vmax = 1
+            for pods in self.node_pods:
+                lower = [q for q in pods if q.priority < priority]
+                violating, non_violating = _split_pdb_violating(lower, pdbs)
+                row = [
+                    (q, True)
+                    for q in sorted(violating, key=lambda q: (-q.priority, q.uid))
+                ] + [
+                    (q, False)
+                    for q in sorted(
+                        non_violating, key=lambda q: (-q.priority, q.uid)
+                    )
+                ]
+                ordered.append(row)
+                vmax = max(vmax, len(row))
+            V = 1 << (vmax - 1).bit_length() if vmax > 1 else 1
+            N = self.arr.N
+            R = len(self.resources)
+            vict_req = np.zeros((N, V, R), dtype=np.int64)
+            vict_prio = np.zeros((N, V), dtype=np.int32)
+            vict_viol = np.zeros((N, V), dtype=bool)
+            vict_valid = np.zeros((N, V), dtype=bool)
+            for i, row in enumerate(ordered):
+                for j, (q, viol) in enumerate(row):
+                    vict_req[i, j] = pod_effective_requests(q, self.resources)
+                    vict_prio[i, j] = q.priority
+                    vict_viol[i, j] = viol
+                    vict_valid[i, j] = True
+            # scale exactly like the encoder (ceil division; gcd scales are
+            # exact so sums commute with the encoded node_used)
+            vict_req_s = -(-vict_req // self.scale)
+            ent = (ordered, vict_req_s.astype(np.int32), vict_prio, vict_viol,
+                   vict_valid)
+            self._level_cache[key] = ent
+        return ent
+
+    # --- the evaluation (one failed pod) ---
+    def evaluate(self, pod: t.Pod) -> Optional[Tuple[str, List[t.Pod]]]:
+        from ..ops.preempt import preempt_eval
+
+        ordered, vict_req, vict_prio, vict_viol, vict_valid = self._tables(
+            pod.priority
+        )
+        N = self.arr.N
+        R = len(self.resources)
+        used_s = np.zeros((N, R), dtype=np.int32)
+        n = len(self.node_pods)
+        used_s[:n] = -(-self.used_raw // self.scale)
+        nom_raw = np.zeros((N, R), dtype=np.int64)
+        has_nom = np.zeros(N, dtype=bool)
+        for uid, (q, node) in self.queue.nominated.items():
+            if uid == pod.uid or q.priority < pod.priority:
+                continue
+            i = self.node_idx.get(node)
+            if i is not None:
+                nom_raw[i] += np.array(
+                    pod_effective_requests(q, self.resources), dtype=np.int64
+                )
+                has_nom[i] = True
+        nom_s = (-(-nom_raw // self.scale)).astype(np.int32)
+        cand, nvio, vmax, vsum, vcnt, is_victim = (
+            np.asarray(x)
+            for x in preempt_eval(
+                self.arr,
+                np.int32(self.pod_row[pod.name]),
+                used_s,
+                nom_s,
+                has_nom,
+                vict_req,
+                vict_prio,
+                vict_viol,
+                vict_valid,
+            )
+        )
+        if not cand.any():
+            return None
+        # pickOneNodeForPreemption's lexicographic order, lowest node index
+        # breaking ties (plugins/cpu.py key)
+        idx = np.flatnonzero(cand)
+        order = np.lexsort((idx, vcnt[idx], vsum[idx], vmax[idx], nvio[idx]))
+        best = int(idx[order[0]])
+        victims = [ordered[best][j][0] for j in np.flatnonzero(is_victim[best])]
+        return self.meta.node_names[best], victims
+
+    # --- incremental state update after an eviction ---
+    def apply_eviction(self, node_name: str, victims: List[t.Pod]) -> None:
+        i = self.node_idx[node_name]
+        gone = {q.uid for q in victims}
+        self.node_pods[i] = [q for q in self.node_pods[i] if q.uid not in gone]
+        for q in victims:
+            self.used_raw[i] -= np.array(
+                pod_effective_requests(q, self.resources), dtype=np.int64
+            )
+        # victim tables reference the old row on this node only: RE-derive the
+        # row (split + reprieve order) from scratch — an evicted non-violating
+        # victim frees the PDB budget it consumed, which can flip later pods'
+        # violating flag, exactly as the CPU evaluator would see on its next
+        # PostFilter call.  (Arrays are private to this loop: in-place patch.)
+        for (priority, fp), ent in self._level_cache.items():
+            ordered, vict_req, vict_prio, vict_viol, vict_valid = ent
+            _, pdbs = self._pdb_fp()
+            lower = [q for q in self.node_pods[i] if q.priority < priority]
+            violating, non_violating = _split_pdb_violating(lower, pdbs)
+            row = [
+                (q, True)
+                for q in sorted(violating, key=lambda q: (-q.priority, q.uid))
+            ] + [
+                (q, False)
+                for q in sorted(non_violating, key=lambda q: (-q.priority, q.uid))
+            ]
+            ordered[i] = row
+            vict_req[i] = 0
+            vict_prio[i] = 0
+            vict_viol[i] = False
+            vict_valid[i] = False
+            for j, (q, viol) in enumerate(row[: vict_req.shape[1]]):
+                vict_req[i, j] = -(
+                    -np.array(
+                        pod_effective_requests(q, self.resources), dtype=np.int64
+                    )
+                    // self.scale
+                )
+                vict_prio[i, j] = q.priority
+                vict_viol[i, j] = viol
+                vict_valid[i, j] = True

@@ -85,7 +85,11 @@ class PriorityQueue:
         self._active: List[_Item] = []  # heap
         self._active_uids: Set[str] = set()
         self._backoff: List[Tuple[float, int, t.Pod]] = []  # (ready_at, seq, pod)
-        self._unschedulable: Dict[str, Tuple[t.Pod, Set[str]]] = {}  # uid -> (pod, events)
+        # uid -> (pod, events, hints); hints: event kind -> [(obj, old, pod)
+        # -> bool] callbacks (QueueingHintFn — scheduling_queue.go: a parked
+        # pod wakes on a registered event only if SOME failing plugin's hint
+        # answers Queue; hintless kinds wake unconditionally)
+        self._unschedulable: Dict[str, Tuple[t.Pod, Set[str], Dict]] = {}
         self._attempts: Dict[str, int] = {}
         self._arrival: Dict[str, int] = {}
         self._nominated: Dict[str, Tuple[t.Pod, str]] = {}  # uid -> (pod, node)
@@ -144,7 +148,7 @@ class PriorityQueue:
             elif uid in self._no_flush:
                 continue  # gated: only its registered event may move it
             elif now - since >= self.max_unschedulable_s:
-                pod, _ = self._unschedulable.pop(uid)
+                pod = self._unschedulable.pop(uid)[0]
                 del self._parked_at[uid]
                 ready = now + self.backoff_duration(uid)
                 heapq.heappush(self._backoff, (ready, next(self._seq), pod))
@@ -166,10 +170,9 @@ class PriorityQueue:
             self.add(pod)
 
     @_locked
-    def pop(self) -> Optional[t.Pod]:
-        """Next pod in activeQ order, or None if activeQ is empty
-        (scheduling_queue.go — Pop; non-blocking variant)."""
-        self._flush_backoff()
+    def _pop_one(self) -> Optional[t.Pod]:
+        """Heap-drain step shared by pop()/pop_all() (caller holds the lock):
+        skip superseded entries, bump the attempt counter."""
         while self._active:
             item = heapq.heappop(self._active)
             if item.pod.uid in self._active_uids:
@@ -178,6 +181,12 @@ class PriorityQueue:
                 return item.pod
         return None
 
+    def pop(self) -> Optional[t.Pod]:
+        """Next pod in activeQ order, or None if activeQ is empty
+        (scheduling_queue.go — Pop; non-blocking variant)."""
+        self._flush_backoff()
+        return self._pop_one()
+
     @_locked
     def pop_all(self) -> List[t.Pod]:
         """Drain the activeQ in pop order under ONE lock acquisition — the
@@ -185,13 +194,11 @@ class PriorityQueue:
         batched path would otherwise pay P lock round-trips per cycle)."""
         self._flush_backoff()
         out: List[t.Pod] = []
-        while self._active:
-            item = heapq.heappop(self._active)
-            if item.pod.uid in self._active_uids:
-                self._active_uids.discard(item.pod.uid)
-                self._attempts[item.pod.uid] = self._attempts.get(item.pod.uid, 0) + 1
-                out.append(item.pod)
-        return out
+        while True:
+            pod = self._pop_one()
+            if pod is None:
+                return out
+            out.append(pod)
 
     @_locked
     def backoff_duration(self, pod_uid: str) -> float:
@@ -201,7 +208,8 @@ class PriorityQueue:
     @_locked
     def add_unschedulable(self, pod: t.Pod, events: Optional[Set[str]] = None,
                           backoff: bool = True,
-                          cycle_move_seq: Optional[int] = None) -> None:
+                          cycle_move_seq: Optional[int] = None,
+                          hints: Optional[Dict] = None) -> None:
         """AddUnschedulableIfNotPresent.  With SPECIFIC events (QueueingHint
         registrations from the failing plugins) the pod parks in
         unschedulablePods until a matching cluster event moves it (through
@@ -216,24 +224,36 @@ class PriorityQueue:
         if cycle_move_seq is not None and self.move_seq != cycle_move_seq:
             events = None
         if events and EV_ALL not in events and backoff:
-            self._unschedulable[pod.uid] = (pod, set(events))
+            self._unschedulable[pod.uid] = (pod, set(events), hints or {})
             self._parked_at[pod.uid] = self.clock.now()
         elif backoff:
             ready = self.clock.now() + self.backoff_duration(pod.uid)
             heapq.heappush(self._backoff, (ready, next(self._seq), pod))
             self._in_backoff[pod.uid] = self._in_backoff.get(pod.uid, 0) + 1
         else:
-            self._unschedulable[pod.uid] = (pod, events or {EV_ALL})
+            self._unschedulable[pod.uid] = (pod, events or {EV_ALL}, hints or {})
             self._parked_at[pod.uid] = self.clock.now()
             self._no_flush.add(pod.uid)
 
     @_locked
-    def move_all_to_active_or_backoff(self, event: str) -> int:
-        """MoveAllToActiveOrBackoffQueue on a cluster event; returns #moved."""
+    def move_all_to_active_or_backoff(self, event: str, obj=None, old=None) -> int:
+        """MoveAllToActiveOrBackoffQueue on a cluster event; returns #moved.
+
+        With the event OBJECT available, a parked pod's per-plugin
+        QueueingHint callbacks decide Queue vs Skip (isPodWorthRequeuing);
+        without it (obj None — e.g. a coalesced batch flush) matching event
+        kinds wake unconditionally, the pre-hint conservative behavior."""
         self.move_seq += 1
         moved = []
-        for uid, (pod, events) in list(self._unschedulable.items()):
+        for uid, (pod, events, hints) in list(self._unschedulable.items()):
             if EV_ALL in events or event in events:
+                fns = hints.get(event)
+                if obj is not None and fns:
+                    try:
+                        if not any(fn(obj, old, pod) for fn in fns):
+                            continue  # every failing plugin answered Skip
+                    except Exception:  # noqa: BLE001 — hint bugs must not strand pods
+                        pass
                 moved.append(uid)
                 del self._unschedulable[uid]
                 self._parked_at.pop(uid, None)

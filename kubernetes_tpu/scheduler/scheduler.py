@@ -92,6 +92,13 @@ class Scheduler:
         # findNodesThatFitPod's rotating cursor (schedule_one.go —
         # nextStartNodeIndex): spreads partial-scoring passes over the cluster
         self._next_start_node_index = 0
+        # coscheduling waiting-pods map (framework/runtime/waiting_pods_map.go
+        # + the coscheduling plugin's Permit-wait): gang members on the CPU
+        # path hold their assumption here until minMember siblings arrive;
+        # quorum binds all, quiescence without quorum rejects all — so the
+        # sidecar-deadline fallback preserves all-or-nothing exactly like the
+        # batch path's gang fixpoint (ops/gang.py)
+        self._gang_waiting: Dict[str, List[Tuple[t.Pod, str, object, object]]] = {}
         self.framework = Framework(
             default_plugins(
                 store,
@@ -114,14 +121,16 @@ class Scheduler:
         store.watch(self._on_event)
 
     # --- watch plumbing ---
-    def _move_all(self, event_kind: str) -> None:
+    def _move_all(self, event_kind: str, obj=None, old=None) -> None:
         """MoveAllToActiveOrBackoffQueue, coalesced while a batch bind loop is
-        active (one real move per distinct event kind at loop exit)."""
+        active (one real move per distinct event kind at loop exit; the
+        coalesced flush carries no event object, so parked pods' QueueingHint
+        callbacks are skipped conservatively — they wake on kind match)."""
         with self._move_lock:
             if self._move_coalesce is not None:
                 self._move_coalesce.add(event_kind)
                 return
-        self.queue.move_all_to_active_or_backoff(event_kind)
+        self.queue.move_all_to_active_or_backoff(event_kind, obj=obj, old=old)
 
     @contextlib.contextmanager
     def _coalesced_moves(self):
@@ -143,13 +152,13 @@ class Scheduler:
             pod = ev.obj
             if ev.kind == "Deleted":
                 self.queue.delete(pod.uid)
-                self._move_all(EV_POD_DELETE)
+                self._move_all(EV_POD_DELETE, obj=pod)
             elif ev.kind == "ModifiedStatus":
                 # status-only write: no requeue of THIS pod — but a bound pod
                 # reaching a terminal phase releases capacity, which is an
                 # AssignedPodDelete move event for waiting unschedulable pods
                 if pod.node_name and pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
-                    self._move_all(EV_POD_DELETE)
+                    self._move_all(EV_POD_DELETE, obj=pod)
             elif not pod.node_name:
                 st = self.framework.run_pre_enqueue(pod)
                 if st.ok:
@@ -160,10 +169,12 @@ class Scheduler:
             else:
                 # assigned-pod add/update: a newly bound pod can satisfy
                 # waiting pods' affinity/spread terms (AssignedPodAdd hint)
-                self._move_all(EV_POD_ADD)
+                self._move_all(EV_POD_ADD, obj=pod)
         elif ev.obj_type == "Node":
             self._move_all(
-                EV_NODE_ADD if ev.kind == "Added" else EV_NODE_UPDATE
+                EV_NODE_ADD if ev.kind == "Added" else EV_NODE_UPDATE,
+                obj=ev.obj,
+                old=getattr(ev, "old", None),
             )
 
     def _filter_one(self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo) -> Status:
@@ -330,14 +341,13 @@ class Scheduler:
             # concurrent binding's AssignedPodAdd), the pod saw a stale
             # snapshot: plain backoff, or its wake event is already gone
             failing = {s.plugin for s in statuses.values() if s.plugin}
-            hint_events = (
-                self.framework.events_for_plugins(failing)
-                if failing and not (pst.ok and nominated)
-                else None
-            )
+            park = failing and not (pst.ok and nominated)
+            hint_events = self.framework.events_for_plugins(failing) if park else None
+            hints = self.framework.hints_for_plugins(failing) if park else None
             # move_seq compared inside add_unschedulable, under the queue lock
             self.queue.add_unschedulable(
-                pod, hint_events, backoff=True, cycle_move_seq=cycle_move_seq
+                pod, hint_events, backoff=True, cycle_move_seq=cycle_move_seq,
+                hints=hints,
             )
             self.metrics.inc("scheduling_attempts_unschedulable")
             return None
@@ -355,6 +365,34 @@ class Scheduler:
             self.cache.forget(pod.uid)
             self.queue.add_unschedulable(pod, backoff=True)
             return None
+        # coscheduling Permit-wait: a gang member holds its assumption (the
+        # capacity reservation IS the atomicity mechanism) until minMember
+        # siblings are assumed or bound; the arrival that completes the
+        # quorum binds every waiter
+        if pod.pod_group and self.features.enabled("GangScheduling"):
+            waiters = self._gang_waiting.setdefault(pod.pod_group, [])
+            # dedupe: a re-scheduled copy of an already-waiting member (e.g.
+            # a metadata update re-queued it) must REPLACE its entry, never
+            # inflate the quorum count
+            waiters[:] = [w for w in waiters if w[0].uid != pod.uid]
+            waiters.append((pod, node_name, state, snap))
+            pg = snap.pod_groups.get(pod.pod_group)
+            need = pg.min_member if pg else 1
+            waiting_uids = {w[0].uid for w in waiters}
+            bound = sum(
+                1
+                for q in snap.bound_pods
+                if q.pod_group == pod.pod_group and q.uid not in waiting_uids
+            )
+            if len(waiters) + bound < need:
+                return None  # waiting (assumed, not bound)
+            del self._gang_waiting[pod.pod_group]
+            out = None
+            for wpod, wnode, wstate, wsnap in waiters:
+                r = self._binding_cycle(wstate, wsnap, wpod, wnode, t0)
+                if wpod.uid == pod.uid:
+                    out = r
+            return out
         if self._bind_pool is not None:
             # bindingCycle as its own goroutine (schedule_one.go: `go func()`)
             # overlapping the next pod's schedulingCycle
@@ -411,6 +449,24 @@ class Scheduler:
         self.log.V(3).info("Scheduled pod", pod=pod.uid, node=node_name,
                            latency_ms=round(dt * 1e3, 2))
         return node_name
+
+    def reject_incomplete_gangs(self) -> int:
+        """Permit-timeout analog at a drain point: gangs still below quorum
+        release their assumptions and requeue with backoff — the reference's
+        WaitingPod.Reject fan-out (waiting_pods_map.go), and the CPU-path
+        equivalent of the batch fixpoint revoking a failed group."""
+        n = 0
+        for g, waiters in list(self._gang_waiting.items()):
+            del self._gang_waiting[g]
+            for wpod, _wnode, _s, _sn in waiters:
+                self.cache.forget(wpod.uid)
+                self.events.record(
+                    "FailedScheduling", wpod.uid,
+                    message=f"gang {g} below quorum; Permit rejected",
+                )
+                self.queue.add_unschedulable(wpod, backoff=True)
+                n += 1
+        return n
 
     def wait_for_bindings(self) -> None:
         """Drain in-flight binding cycles (the reference's graceful shutdown
@@ -487,14 +543,18 @@ class Scheduler:
                 result = {}
                 for pod in snap.pending_pods:
                     result[pod.name] = self.schedule_one(pod)
-                # async binding cycles may still fail and requeue: report
-                # the SETTLED placements, not the optimistic returns
-                if self._bind_pool is not None:
-                    self.wait_for_bindings()
-                    for pod in snap.pending_pods:
-                        cur = self.store.pods.get(pod.uid)
-                        result[pod.name] = (cur.node_name or None) if cur else None
+                # the fallback is a drain point: gangs still short of quorum
+                # reject here (Permit timeout analog), preserving the batch
+                # path's all-or-nothing outcome
+                self.wait_for_bindings()
+                self.reject_incomplete_gangs()
+                # async binding cycles and gang waits resolve after the loop:
+                # report the SETTLED placements, not the optimistic returns
+                for pod in snap.pending_pods:
+                    cur = self.store.pods.get(pod.uid)
+                    result[pod.name] = (cur.node_name or None) if cur else None
                 return result
+        arr = meta = None  # encoded cycle arrays (batched preemption reuses them)
         if verdicts is None:
             base_cfg = self.config.score_config()
             if (
@@ -554,6 +614,12 @@ class Scheduler:
             # (no bound pod anywhere with lower priority) skip PostFilter outright.
             state = None
             snap2 = None
+            batched = None  # ops/preempt.py evaluator, shared across the loop
+            use_batched = (
+                arr is not None
+                and self.features.enabled("BatchedPreemption")
+                and self.features.enabled("DefaultPreemption")
+            )
             min_bound_prio: Optional[int] = None
             for pod in failed:
                 if state is None:
@@ -566,16 +632,38 @@ class Scheduler:
                     min_bound_prio = min(
                         (q.priority for q in snap2.bound_pods), default=None
                     )
+                    if use_batched and batched is None:
+                        from .preemption import BatchedPreemption
+
+                        batched = BatchedPreemption(
+                            arr, meta, snap2, self.store, self.queue
+                        )
                 self.events.record("FailedScheduling", pod.uid)
                 if min_bound_prio is None or pod.priority <= min_bound_prio:
-                    pst = Status.unschedulable("preemption: no lower-priority pods")
                     self._clear_nomination(pod)
+                elif batched is not None and batched.applicable(pod):
+                    # device-vectorized victim search (decision-identical to
+                    # the CPU evaluator within its gate — see preemption.py)
+                    res = batched.evaluate(pod)
+                    if res is not None:
+                        node_name, victims = res
+                        for q in victims:
+                            self.store.delete_pod(q.uid)
+                        self.metrics.inc("preemption_victims", len(victims))
+                        batched.apply_eviction(node_name, victims)
+                        self.events.record("Preempted", pod.uid, node=node_name)
+                        self._nominate(pod, node_name)
+                        state = None  # CPU what-if state is stale now
+                    else:
+                        self._clear_nomination(pod)
                 else:
                     nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
                     if pst.ok and nominated:
                         self.events.record("Preempted", pod.uid, node=nominated)
                         self._nominate(pod, nominated)
                         state = None  # evictions changed the cluster: rebuild lazily
+                        if batched is not None:
+                            batched = None  # CPU path evicted outside our ledger
                     else:
                         self._clear_nomination(pod)
                 self.queue.add_unschedulable(pod, backoff=True)
@@ -649,6 +737,9 @@ class Scheduler:
                     self.wait_for_bindings()
                     pod = self.queue.pop()
                     if pod is None:
+                        # quiescence = the Permit-timeout drain point: gangs
+                        # still below quorum reject (members requeue w/backoff)
+                        self.reject_incomplete_gangs()
                         return
                 scheduled = self.schedule_one(pod) is not None
             stall = 0 if scheduled or len(self.queue) < q_before else stall + 1

@@ -36,6 +36,9 @@ class Event:
     obj_type: str  # Node | Pod | PDB | any registered kind
     obj: object
     resource_version: int
+    # previous object on Modified events (QueueingHints compare old vs new —
+    # framework/types.go ClusterEvent carries oldObj/newObj the same way)
+    old: object = None
 
 
 # kinds every store starts with (the reference's built-in API groups); more
@@ -144,8 +147,9 @@ class ClusterStore:
 
     def update_node(self, node: t.Node) -> None:
         with self._lock:
+            old = self.nodes.get(node.name)
             self.nodes[node.name] = node
-            self._emit(Event("Modified", "Node", node, self._bump()))
+            self._emit(Event("Modified", "Node", node, self._bump(), old=old))
 
     def delete_node(self, name: str) -> None:
         with self._lock:
@@ -161,8 +165,9 @@ class ClusterStore:
 
     def update_pod(self, pod: t.Pod) -> None:
         with self._lock:
+            old = self.pods.get(pod.uid)
             self.pods[pod.uid] = pod
-            self._emit(Event("Modified", "Pod", pod, self._bump()))
+            self._emit(Event("Modified", "Pod", pod, self._bump(), old=old))
 
     def update_pod_status(self, pod: t.Pod) -> None:
         """The pods/{name}/status subresource: status-only writes (e.g.

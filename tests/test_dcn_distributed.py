@@ -1,0 +1,94 @@
+"""DCN multi-host smoke: two REAL processes join via jax.distributed.initialize
+(the reference's multi-host story is plain gRPC between components; ours is the
+jax distributed runtime carrying XLA collectives across hosts — SURVEY.md §2.4),
+build one global mesh over both processes' CPU-sim devices, run the FULL
+sharded scheduling step, and require decisions identical to the dense
+single-process path.  Skips when the runtime can't form a multiprocess CPU
+cluster (e.g. no cross-process collectives support in the installed jaxlib)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, {repo!r})
+    from __graft_entry__ import force_cpu_platform
+    force_cpu_platform(4)  # 4 local CPU devices per process -> 8 global
+    import jax
+    import numpy as np
+    from kubernetes_tpu.parallel.mesh import init_distributed, global_arrays
+    mesh = init_distributed(f"127.0.0.1:{{port}}", 2, rank)
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.process_count() == 2
+    from kubernetes_tpu.bench import workloads
+    from kubernetes_tpu.api.snapshot import encode_snapshot
+    from kubernetes_tpu.ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
+    from kubernetes_tpu.ops import schedule_batch
+    from kubernetes_tpu.parallel.sharded import sharded_schedule_batch
+    snap = workloads.spread_affinity(8, 16, seed=3)
+    arr, meta = encode_snapshot(snap, bucket=False)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    dense = np.asarray(schedule_batch(arr, cfg)[0])  # local single-device oracle
+    garr = global_arrays(mesh, arr)
+    choices, _used = sharded_schedule_batch(garr, cfg, mesh)
+    got = np.asarray(jax.device_get(choices))
+    assert np.array_equal(got, dense), (got.tolist(), dense.tolist())
+    print(f"RANK{{rank}} OK", flush=True)
+    """
+).format(repo=REPO)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_step_matches_dense():
+    port = _free_port()
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(rank), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers hung")
+    joined = "\n---\n".join(outs)
+    if any(p.returncode != 0 for p in procs):
+        lowered = joined.lower()
+        if (
+            "distributed" in lowered
+            and ("unimplemented" in lowered or "not supported" in lowered)
+        ):
+            pytest.skip(f"multiprocess CPU collectives unavailable: {joined[-500:]}")
+        pytest.fail(joined[-4000:])
+    assert "RANK0 OK" in joined and "RANK1 OK" in joined, joined[-2000:]

@@ -244,3 +244,60 @@ def test_duplicate_bound_uid_rejected():
     snap = Snapshot(nodes=nodes, pending_pods=[], bound_pods=[p, q])
     with pytest.raises(ValueError, match="duplicate bound pod uid"):
         DeltaEncoder().encode(snap)
+
+
+def test_delta_survives_volume_state():
+    """Round-3: a cluster WITH PV/PVC/DRA state must keep incremental encoding
+    (pre-resolution identity + storage fingerprint conditioning) while the
+    storage state is stable, rebuild exactly when it changes, and stay
+    decision-identical to a fresh encode either way (round-2 verdict task 8)."""
+    import dataclasses as dc
+
+    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+
+    nodes = mk_cluster_nodes(12)
+    pv = t.PersistentVolume(
+        name="pv0", capacity=10 * 1024**3, storage_class="std",
+        allowed_topology=((t.LABEL_ZONE, "z1"),),
+    )
+    pvc = t.PersistentVolumeClaim(
+        name="claim0", request=5 * 1024**3, storage_class="std", volume_name="pv0"
+    )
+    enc = DeltaEncoder()
+    bound = []
+    serial = 0
+    for cycle in range(4):
+        pending = [mk_template_pod(f"p{serial + i}", kind=i % 4) for i in range(6)]
+        # one pod per wave uses the claim (its resolution folds PV topology)
+        pending.append(
+            dataclasses.replace(
+                mk_pod(f"vol{cycle}", cpu=100), pvcs=("claim0",)
+            )
+        )
+        serial += 6
+        snap = Snapshot(
+            nodes=nodes, pending_pods=pending, bound_pods=list(bound),
+            pvs=[pv], pvcs={pvc.key: pvc}, storage_classes={},
+        )
+        got, gm = enc.encode(snap)
+        want, wm = encode_snapshot(snap)
+        g = np.asarray(schedule_batch(got, DEFAULT_SCORE_CONFIG)[0])
+        w = np.asarray(schedule_batch(want, DEFAULT_SCORE_CONFIG)[0])
+        np.testing.assert_array_equal(g[: gm.n_pods], w[: wm.n_pods],
+                                      err_msg=f"cycle {cycle}")
+        for i, pod in enumerate(pending[:4]):
+            bound.append(dataclasses.replace(pod, node_name=f"n{(cycle + i) % 12}"))
+    assert enc.stats["delta"] >= 3, enc.stats  # incremental despite volumes
+    full_before = enc.stats["full"]
+    # a PVC state change (rebound to a new object) must force a rebuild...
+    pvc2 = dc.replace(pvc, volume_name="")
+    snap2 = Snapshot(
+        nodes=nodes, pending_pods=[mk_template_pod("q", 0)],
+        bound_pods=list(bound), pvs=[pv], pvcs={pvc2.key: pvc2},
+    )
+    g2, gm2 = enc.encode(snap2)
+    w2, wm2 = encode_snapshot(snap2)
+    assert enc.stats["full"] == full_before + 1
+    g = np.asarray(schedule_batch(g2, DEFAULT_SCORE_CONFIG)[0])
+    w = np.asarray(schedule_batch(w2, DEFAULT_SCORE_CONFIG)[0])
+    np.testing.assert_array_equal(g[: gm2.n_pods], w[: wm2.n_pods])

@@ -1,0 +1,126 @@
+"""Batched preemption (ops/preempt.py + scheduler/preemption.py) must be
+DECISION-IDENTICAL to the CPU DefaultPreemption evaluator (the oracle) within
+its gate: same Preempted nominations, same evicted victims, same surviving
+pods — across randomized priority workloads with PDBs.
+reference: framework/preemption/preemption.go — Evaluator;
+defaultpreemption/default_preemption.go — SelectVictimsOnNode."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from helpers import mk_node, mk_pod
+
+
+def _run(seed: int, batched: bool, with_pdb: bool = False, pairwise: bool = False):
+    """One preemption scenario; returns (preempted events, survivors,
+    nominations, scheduled)."""
+    rng = random.Random(seed)
+    store = ClusterStore()
+    n_nodes = rng.randint(3, 6)
+    for i in range(n_nodes):
+        store.add_node(mk_node(f"n{i}", cpu=2000, pods=8))
+    gates = () if batched else (("BatchedPreemption", False),)
+    sched = Scheduler(
+        store, SchedulerConfiguration(mode="tpu", feature_gates=gates)
+    )
+    # fill with low-priority victims (bound)
+    n_low = rng.randint(4, 10)
+    for i in range(n_low):
+        labels = {"app": rng.choice(["web", "db"])}
+        store.add_pod(
+            mk_pod(
+                f"low{i}",
+                cpu=rng.choice([300, 500, 800]),
+                priority=rng.choice([0, 5]),
+                node_name=f"n{rng.randrange(n_nodes)}",
+                labels=labels,
+            )
+        )
+    if with_pdb:
+        pdb = t.PodDisruptionBudget(
+            name="web-pdb",
+            selector=t.LabelSelector.of(app="web"),
+            disruptions_allowed=rng.choice([0, 1]),
+        )
+        store.pdbs[pdb.key] = pdb
+    # high-priority preemptors that exceed free capacity
+    n_hi = rng.randint(2, 4)
+    for i in range(n_hi):
+        kw = {}
+        if pairwise:
+            kw["affinity"] = t.Affinity(
+                required_pod_anti_affinity=(
+                    t.PodAffinityTerm(
+                        topology_key=t.LABEL_HOSTNAME,
+                        label_selector=t.LabelSelector.of(app="hi"),
+                    ),
+                ),
+            )
+        store.add_pod(
+            mk_pod(f"hi{i}", cpu=1800, priority=100, labels={"app": "hi"}, **kw)
+        )
+    sched.run_until_idle()
+    preempted = sorted((e.pod, e.node) for e in sched.events.by_reason("Preempted"))
+    survivors = sorted(store.pods.keys())
+    nominations = sorted(
+        (uid, node) for uid, (_, node) in sched.queue.nominated.items()
+    )
+    scheduled = sorted(
+        (e.pod, e.node) for e in sched.events.by_reason("Scheduled")
+    )
+    return preempted, survivors, nominations, scheduled
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_batched_preemption_matches_cpu_evaluator(seed):
+    assert _run(seed, batched=True) == _run(seed, batched=False)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_batched_preemption_matches_cpu_with_pdbs(seed):
+    got = _run(seed, batched=True, with_pdb=True)
+    want = _run(seed, batched=False, with_pdb=True)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pairwise_preemptors_fall_back_and_still_match(seed):
+    """Anti-affinity on the preemptor gates the batched path off — outcomes
+    must still equal the CPU evaluator (same code path, by construction)."""
+    got = _run(seed, batched=True, pairwise=True)
+    want = _run(seed, batched=False, pairwise=True)
+    assert got == want
+
+
+def test_batched_preemption_actually_engages():
+    """The batched path must really run (not silently fall back) for a plain
+    priority workload: verify via the gate predicate itself."""
+    from kubernetes_tpu.scheduler.preemption import BatchedPreemption
+
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(mk_node(f"n{i}", cpu=2000))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for i in range(4):
+        store.add_pod(mk_pod(f"low{i}", cpu=900, node_name=f"n{i % 3}"))
+    hi = mk_pod("hi", cpu=1800, priority=50)
+    store.add_pod(hi)
+    sched.run_until_idle()
+    assert sched.events.by_reason("Preempted"), "no preemption happened"
+    # the preemption_victims counter is bumped ONLY by the batched branch:
+    # proves the device path ran rather than silently falling back
+    assert sched.metrics.counters["preemption_victims"] > 0
+    # gate predicate holds for this pod shape
+    from kubernetes_tpu.api.volumes import resolve_snapshot
+
+    snap2 = resolve_snapshot(sched.cache.update_snapshot())
+    arr, meta = sched._delta_enc.encode(snap2)
+    bp = BatchedPreemption(arr, meta, snap2, store, sched.queue)
+    probe = mk_pod("probe", cpu=1800, priority=50)
+    snap2.pending_pods.append(probe)
+    arr, meta = sched._delta_enc.encode(snap2)
+    bp = BatchedPreemption(arr, meta, snap2, store, sched.queue)
+    assert bp.applicable(probe)

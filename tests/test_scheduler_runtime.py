@@ -234,8 +234,8 @@ def test_run_until_idle_raises_on_livelock():
 
     orig = sched.queue.add_unschedulable
 
-    def ping_pong(pod, events=None, backoff=True, cycle_move_seq=None):
-        orig(pod, events, backoff, cycle_move_seq)
+    def ping_pong(pod, events=None, backoff=True, cycle_move_seq=None, **kw):
+        orig(pod, events, backoff, cycle_move_seq, **kw)
         sched.queue.add(pod)  # a pathological event source re-activates it
 
     sched.queue.add_unschedulable = ping_pong
@@ -263,10 +263,65 @@ def test_run_until_idle_raises_on_tpu_mode_livelock():
 
     orig = sched.queue.add_unschedulable
 
-    def ping_pong(pod, events=None, backoff=True, cycle_move_seq=None):
-        orig(pod, events, backoff, cycle_move_seq)
+    def ping_pong(pod, events=None, backoff=True, cycle_move_seq=None, **kw):
+        orig(pod, events, backoff, cycle_move_seq, **kw)
         sched.queue.add(pod)
 
     sched.queue.add_unschedulable = ping_pong
     with pytest.raises(RuntimeError, match="no scheduling progress"):
         sched.run_until_idle(stall_limit=10)
+
+
+def test_irrelevant_node_update_does_not_wake_fit_rejected():
+    """QueueingHint callbacks (fit.go — isSchedulableAfterNodeChange): a
+    label-only node update cannot free capacity, so a fit-rejected pod stays
+    parked; an allocatable GROWTH wakes it."""
+    clock = FakeClock()
+    store, sched = mk_cluster("cpu", nodes=[mk_node("small", cpu=500)], clock=clock)
+    store.add_pod(mk_pod("big", cpu=2000))
+    sched.run_until_idle(5)
+    assert "default/big" in sched.queue._unschedulable
+    # label-only update: Skip — still parked
+    store.update_node(mk_node("small", cpu=500, labels={"team": "a"}))
+    assert "default/big" in sched.queue._unschedulable
+    clock.step(30.0)
+    assert sched.queue.pop() is None
+    # allocatable grows: Queue — moves through backoff and schedules
+    store.update_node(mk_node("small", cpu=4000, labels={"team": "a"}))
+    assert "default/big" not in sched.queue._unschedulable
+    clock.step(30.0)
+    sched.run_until_idle()
+    assert bound_map(store)["big"] == "small"
+
+
+def test_irrelevant_assigned_pod_does_not_wake_spread_rejected():
+    """An assigned-pod event wakes a spread-rejected pod only when the event
+    pod matches one of its spread selectors (podtopologyspread hint)."""
+    clock = FakeClock()
+    z0 = [mk_node(f"z0-{i}", labels={t.LABEL_ZONE: "z0"}) for i in range(2)]
+    tainted = mk_node(
+        "z1-0", labels={t.LABEL_ZONE: "z1"},
+        taints=(t.Taint(key="dedic", value="x", effect=t.NO_SCHEDULE),),
+    )
+    store, sched = mk_cluster("cpu", nodes=[*z0, tainted], clock=clock)
+    for i in range(3):
+        store.add_pod(
+            mk_pod(f"web-{i}", labels={"app": "web"}, node_name=f"z0-{i % 2}")
+        )
+    spread = (
+        t.TopologySpreadConstraint(
+            max_skew=1, topology_key=t.LABEL_ZONE,
+            when_unsatisfiable=t.DO_NOT_SCHEDULE,
+            label_selector=t.LabelSelector.of(app="web"),
+        ),
+    )
+    store.add_pod(mk_pod("w", labels={"app": "web"}, topology_spread=spread))
+    sched.run_until_idle(8)
+    assert bound_map(store)["w"] is None
+    assert "default/w" in sched.queue._unschedulable
+    # unrelated assigned pod (labels don't match the spread selector): Skip
+    store.add_pod(mk_pod("db-0", labels={"app": "db"}, node_name="z0-0"))
+    assert "default/w" in sched.queue._unschedulable
+    # a matching assigned pod event: Queue (skew inputs changed)
+    store.add_pod(mk_pod("web-new", labels={"app": "web"}, node_name="z0-1"))
+    assert "default/w" not in sched.queue._unschedulable
