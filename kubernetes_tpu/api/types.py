@@ -330,6 +330,14 @@ class Pod:
     # lifecycle knob for the hollow kubelet: pods whose workload completes
     # (Job pods) run for run_seconds then succeed; 0 = run forever
     run_seconds: float = 0.0
+    # spec.restartPolicy (Always | OnFailure | Never) — what the kubelet's
+    # pod worker does when the (hollow) container dies unexpectedly
+    restart_policy: str = "Always"
+    # fault-injection knob (hollow runtime): the container crashes this many
+    # seconds after each (re)start; 0 = never crashes
+    crash_after_seconds: float = 0.0
+    # status.containerStatuses[0].restartCount, stamped by the kubelet
+    restart_count: int = 0
     uid: str = ""
 
     def __post_init__(self) -> None:

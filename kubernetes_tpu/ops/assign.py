@@ -247,7 +247,127 @@ def schedule_scan(
     return choices, used_final
 
 
+_CHUNK = 128  # pods per chunk on the chunked path (buckets are multiples)
+
+
+def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
+    """The chunked scan applies when the ONLY scan-carried state is node
+    usage: no pairwise/ports stages and no per-pod normalization stages
+    (taint/nodeAffinity/image) — which is exactly the north-star
+    heterogeneous shape and the basic/gang configs."""
+    return (
+        not cfg.enable_pairwise
+        and not cfg.enable_ports
+        and not cfg.enable_taint_score
+        and not cfg.enable_node_pref
+        and not (cfg.enable_image and arr.image_score.shape[1] == arr.N)
+        and arr.P >= _CHUNK
+        and arr.P % _CHUNK == 0
+    )
+
+
+def schedule_scan_chunked(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
+    """Chunked sequential-commit scan, BIT-IDENTICAL to schedule_scan for
+    fit+balanced-only configs (tests/test_assign_parity.py — chunked case).
+
+    The per-pod scan pays ~10us/step of [N]-wide work at 20k nodes; here each
+    CHUNK of pods hoists its dense candidate scores [C, N] against the
+    chunk-start usage ONCE (MXU-friendly), and the inner commit scan touches
+    only [C]-sized slot state: a pod's true score differs from the hoisted
+    row exactly at nodes other chunk members committed to (at most C of
+    them), so each step rewrites those few entries and re-argmaxes.  Exact
+    because fit/least/balanced depend on per-node usage only — there are no
+    cross-node normalizations on this path."""
+    local_n = arr.N
+    my_nodes = jnp.arange(local_n, dtype=jnp.int32)
+
+    tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
+    nodesel = filters.node_selection_ok_from(tm, arr)
+    pin = arr.pod_nodename[:, None]
+    nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+    sf = (
+        arr.node_valid[None, :]
+        & arr.pod_valid[:, None]
+        & filters.taints_ok(arr)
+        & nodesel
+        & nodename_ok
+    )
+    n_alloc = arr.node_alloc
+    P, N, R = arr.P, arr.N, arr.R
+    C = _CHUNK
+    res = cfg.score_resources
+    neg_inf = -jnp.inf
+
+    reqs = arr.pod_req.reshape(P // C, C, R)
+    sfs = sf.reshape(P // C, C, N)
+    valids = arr.pod_valid.reshape(P // C, C)
+
+    def chunk(used0, xs):
+        creq, csf, cvalid = xs
+        # hoisted dense scores vs chunk-start usage (vmap = the per-step ops
+        # batched, so float32 results are bit-identical to the plain scan)
+        requested = used0[None, :, :] + creq[:, None, :]  # [C, N, R]
+        fit0 = jax.vmap(filters.fit_ok, (0, None, None))(creq, used0, n_alloc)
+        total0 = cfg.fit_weight * jax.vmap(
+            least_allocated, (0, None, None)
+        )(requested, n_alloc, res) + cfg.balanced_weight * jax.vmap(
+            balanced_allocation, (0, None, None)
+        )(requested, n_alloc, res)
+        total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, N]
+
+        def step(st, xs2):
+            tids, tused, talloc = st  # [C], [C, R], [C, R]
+            req_i, row0, sf_row, valid_i, slot_i = xs2
+            live = tids >= 0
+            # corrected score at touched nodes (same formulas on [C, R] rows)
+            requested_t = tused + req_i[None, :]
+            fit_t = jnp.all(
+                (req_i[None, :] == 0) | (req_i[None, :] <= talloc - tused), axis=1
+            )
+            sc_t = cfg.fit_weight * least_allocated(
+                requested_t, talloc, res
+            ) + cfg.balanced_weight * balanced_allocation(requested_t, talloc, res)
+            ok_t = live & fit_t & sf_row[jnp.maximum(tids, 0)]
+            val_t = jnp.where(ok_t, sc_t, neg_inf)
+            # overwrite the touched entries of the hoisted row (dead slots
+            # scatter out of bounds and are dropped)
+            row = row0.at[jnp.where(live, tids, N)].set(val_t, mode="drop")
+            best = row.max()
+            cand = jnp.where(row == best, my_nodes, _INT_MAX)
+            schedulable = (best > neg_inf) & valid_i
+            choice = jnp.where(schedulable, cand.min().astype(jnp.int32), -1)
+            # commit: add to the existing slot, or open THIS step's own slot
+            exists = live & (tids == choice)
+            placed = choice >= 0
+            tused = tused + (exists & placed)[:, None] * req_i[None, :]
+            new_here = placed & ~exists.any()
+            mine = (jnp.arange(C, dtype=jnp.int32) == slot_i) & new_here
+            cc = jnp.maximum(choice, 0)
+            tids = jnp.where(mine, choice, tids)
+            tused = jnp.where(mine[:, None], (used0[cc] + req_i)[None, :], tused)
+            talloc = jnp.where(mine[:, None], n_alloc[cc][None, :], talloc)
+            return (tids, tused, talloc), choice
+
+        st0 = (
+            jnp.full(C, -1, dtype=jnp.int32),
+            jnp.zeros((C, R), dtype=used0.dtype),
+            jnp.ones((C, R), dtype=used0.dtype),
+        )
+        xs2 = (creq, total0, csf, cvalid, jnp.arange(C, dtype=jnp.int32))
+        _, choices_c = lax.scan(step, st0, xs2)
+        placed = (choices_c >= 0)[:, None]
+        used0 = used0.at[jnp.maximum(choices_c, 0)].add(
+            placed * creq, mode="drop"
+        )
+        return used0, choices_c
+
+    used_final, choices = lax.scan(chunk, arr.node_used, (reqs, sfs, valids))
+    return choices.reshape(P), used_final
+
+
 def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
+    if _chunkable(arr, cfg):
+        return schedule_scan_chunked(arr, cfg)
     return schedule_scan(arr, cfg, axis_name=None)
 
 

@@ -125,6 +125,10 @@ class APIServer:
         self.admission = AdmissionChain.default(store, policies, webhooks)
         self.audit_log: List[AuditEvent] = []
         self.ips = ClusterIPAllocator()
+        from .crd import CRDRegistry
+
+        # apiextensions: dynamic kinds with per-version structural schemas
+        self.crds = CRDRegistry(store)
 
     # -- the handler chain --
     def handle(
@@ -208,6 +212,23 @@ class APIServer:
         elif kind == "PVC":
             (self.store.add_pvc if verb == "create" else self.store.update_pvc)(obj)
         else:
+            from .crd import CRDInvalid, CRValidationError
+
+            if kind == "CustomResourceDefinition":
+                if verb != "create":
+                    raise ValueError("CRD updates not supported; delete + recreate")
+                try:
+                    return self.crds.create(obj)
+                except CRDInvalid as e:
+                    raise AdmissionDenied(str(e)) from e
+            if self.crds.definition_for(kind) is not None:
+                # custom kind: served-version check + structural-schema
+                # validation + storage-version conversion
+                # (customresource_handler.go — the validation admission)
+                try:
+                    obj = self.crds.admit(obj)
+                except CRValidationError as e:
+                    raise AdmissionDenied(str(e)) from e
             if kind == "Service" and verb == "create" and not obj.cluster_ip:
                 obj.cluster_ip = self.ips.allocate()
             (self.store.add_object if verb == "create" else self.store.update_object)(
@@ -241,6 +262,9 @@ class APIServer:
         elif kind == "PVC":
             self.store.delete_pvc(key)
         else:
+            if kind == "CustomResourceDefinition":
+                self.crds.delete(name)
+                return
             if kind == "Service":
                 svc = self.store.get_object("Service", key)
                 if svc is not None and svc.cluster_ip:

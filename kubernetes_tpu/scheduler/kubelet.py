@@ -1,30 +1,42 @@
-"""Hollow kubelet — the kubemark analog (SURVEY.md §2.3 kubemark row: "real
-kubelet code, mocked CRI/runtime"; §4: "run real code against fake backends").
+"""Hollow kubelet — the kubemark analog, with the reference kubelet's actual
+control structure (SURVEY.md §2.3 kubelet row; §3.4 call stack):
 
-A HollowKubelet plays the node agent's role against the in-process store:
+  - WATCH-driven config source: the kubelet subscribes to the store and
+    routes only pods with spec.nodeName == me to per-pod WORKERS
+    (pkg/kubelet/kubelet.go — syncLoop's config channel; config/apiserver.go).
+    No O(cluster) scans per tick.
+  - POD WORKERS: one serialized state machine per pod UID
+    (pkg/kubelet/pod_workers.go — type podWorkers: per-pod goroutine fed by a
+    channel; here a per-UID worker object whose update() entries apply in
+    arrival order).  Workers own admission (device allocation), start,
+    completion, crash/restart, and teardown.
+  - PLEG: the Pod Lifecycle Event Generator relists the (hollow) runtime's
+    container states and emits ContainerStarted/ContainerDied events that
+    drive workers, exactly the reference's generic PLEG relist
+    (pkg/kubelet/pleg/generic.go — func (g *GenericPLEG) Relist).  The hollow
+    "runtime" is clock-driven: containers run for run_seconds then exit 0, or
+    crash_after_seconds then exit non-zero (the kubemark trade: real kubelet
+    shape, fake CRI — pkg/kubemark/hollow_kubelet.go).
+  - restartPolicy: a died container restarts (restartCount++) under Always /
+    OnFailure-with-nonzero-exit, else the pod goes Succeeded/Failed
+    (kuberuntime_manager.go — computePodActions' ShouldContainerBeRestarted).
+  - node Lease heartbeat per tick (pkg/kubelet/nodelease), consumed by the
+    NodeLifecycleController for failure detection.
 
-  - watches for pods bound to its node (the reference's syncLoop source:
-    pods with spec.nodeName == me), runs the pod phase machine
-    Pending -> Running -> Succeeded (pods with run_seconds > 0 complete;
-    others run forever — the service-pod shape)
-  - heartbeats its node Lease every tick (pkg/kubelet/nodelease), which the
-    NodeLifecycleController consumes for failure detection
-  - publishes phase transitions through the pods/status subresource so the
-    scheduler's queue ignores them (no spec change)
-
-No CRI/container runtime is modeled: the pod "runs" by clock alone — exactly
-kubemark's hollow_kubelet.go trade (pkg/kubemark).
+Phase transitions publish through the pods/status subresource so the
+scheduler's queue never mistakes them for spec changes.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 from weakref import WeakKeyDictionary
 
 from ..api import types as t
 from .leases import LeaseStore
 from .queue import Clock
-from .store import ClusterStore
+from .store import ClusterStore, Event
 
 # store -> {node_name: dense index}.  Scoping CIDR indices to the store (not
 # the allocator instance) keeps per-node /24s disjoint even when several
@@ -38,6 +50,94 @@ def _cidr_index_for(store: ClusterStore, node_name: str) -> int:
     if node_name not in table:
         table[node_name] = len(table)
     return table[node_name]
+
+
+# hollow container states (cri-api runtime states reduced)
+_WAITING, _RUNNING, _EXITED_OK, _EXITED_ERR = range(4)
+
+
+@dataclass
+class _Container:
+    """The hollow runtime's view of one pod's (single) container."""
+
+    state: int = _WAITING
+    started_at: float = 0.0
+    # restart increments this — the container-ID analog; PLEG keys its relist
+    # on (incarnation, state) so a crash of the RESTARTED container is a new
+    # event even when the previous relist also saw an exited state
+    incarnation: int = 0
+
+
+@dataclass
+class _PodWorker:
+    """pod_workers.go — one serialized lifecycle machine per pod UID.  The
+    worker owns the pod's sync state; updates apply in arrival order (the
+    reference serializes via a per-pod channel; in-process, call order IS
+    arrival order)."""
+
+    pod: t.Pod
+    admitted: bool = False
+    terminated: bool = False  # reached Succeeded/Failed
+    restarts: int = 0
+
+
+class HollowRuntime:
+    """The fake CRI: containers 'run' by clock alone.  PLEG relists this."""
+
+    def __init__(self, clock: Clock):
+        self.clock = clock
+        self.containers: Dict[str, _Container] = {}
+
+    def start(self, uid: str) -> None:
+        prev = self.containers.get(uid)
+        inc = prev.incarnation + 1 if prev is not None else 0
+        self.containers[uid] = _Container(_RUNNING, self.clock.now(), inc)
+
+    def remove(self, uid: str) -> None:
+        self.containers.pop(uid, None)
+
+    def tick(self, pods: Dict[str, t.Pod]) -> None:
+        """Advance container states (what a real runtime does on its own)."""
+        now = self.clock.now()
+        for uid, c in self.containers.items():
+            if c.state != _RUNNING:
+                continue
+            pod = pods.get(uid)
+            if pod is None:
+                continue
+            crash = pod.crash_after_seconds
+            if crash > 0 and now - c.started_at >= crash:
+                c.state = _EXITED_ERR
+            elif pod.run_seconds > 0 and now - c.started_at >= pod.run_seconds:
+                c.state = _EXITED_OK
+
+
+class PLEG:
+    """pleg/generic.go — Relist: diff the runtime's container states against
+    the previous relist and emit lifecycle events."""
+
+    def __init__(self, runtime: HollowRuntime):
+        self.runtime = runtime
+        self._last: Dict[str, Tuple[int, int]] = {}
+
+    def relist(self) -> List[Tuple[str, str]]:
+        events: List[Tuple[str, str]] = []
+        cur = {
+            uid: (c.incarnation, c.state)
+            for uid, c in self.runtime.containers.items()
+        }
+        for uid, (inc, state) in cur.items():
+            old = self._last.get(uid)
+            if old != (inc, state):
+                if state == _RUNNING:
+                    events.append((uid, "ContainerStarted"))
+                elif state in (_EXITED_OK, _EXITED_ERR):
+                    events.append((uid, "ContainerDied"))
+        for uid in self._last:
+            if uid not in cur:
+                events.append((uid, "ContainerRemoved"))
+        self._last = cur
+        return events
 
 
 class HollowKubelet:
@@ -57,76 +157,145 @@ class HollowKubelet:
         self.leases = leases
         self.node_name = node_name
         self.clock = clock or leases.clock
-        self._started_at: Dict[str, float] = {}  # pod uid -> Running since
+        self.workers: Dict[str, _PodWorker] = {}  # pod_workers.go map
+        self.runtime = HollowRuntime(self.clock)
+        self.pleg = PLEG(self.runtime)
         # cm/devicemanager analog: concrete device IDs per admitted pod,
         # checkpointed when a directory is given (restart-safe allocations)
         self.devices = DeviceManager(
             node_name,
             CheckpointManager(checkpoint_dir) if checkpoint_dir else None,
         )
-        # pod CIDR: a disjoint per-node subnet index (nodeipam's per-node /24)
         self._cidr_index = (
             pod_cidr_index
             if pod_cidr_index is not None
             else _cidr_index_for(store, node_name)
         )
+        # config source: route my pods' watch events to workers — the
+        # kubelet's syncLoop 'config updates' channel.  Seed from a LIST
+        # (informer semantics), then stay event-driven.
+        for pod in store.pods.values():
+            if pod.node_name == self.node_name:
+                self._dispatch(pod, removed=False)
+        store.watch(self._on_event, replay=False)  # seeded above: my pods only
 
+    # --- config channel ---
+    def _on_event(self, ev: Event) -> None:
+        if ev.obj_type != "Pod":
+            return
+        pod = ev.obj
+        if ev.kind == "Deleted":
+            if pod.uid in self.workers:
+                self._dispatch(pod, removed=True)
+        elif getattr(pod, "node_name", "") == self.node_name:
+            self._dispatch(pod, removed=False)
+
+    def _dispatch(self, pod: t.Pod, removed: bool) -> None:
+        """UpdatePod (pod_workers.go): create/feed the pod's worker."""
+        if removed:
+            w = self.workers.pop(pod.uid, None)
+            if w is not None:
+                self.runtime.remove(pod.uid)
+                self.devices.free(pod.uid)
+            return
+        w = self.workers.get(pod.uid)
+        if w is None:
+            w = self.workers[pod.uid] = _PodWorker(pod=pod)
+        else:
+            w.pod = pod
+        if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+            w.terminated = True
+            self.runtime.remove(pod.uid)
+            self.devices.free(pod.uid)
+
+    # --- the sync loop ---
     def tick(self) -> None:
-        """One syncLoop iteration: heartbeat + pod state machine."""
+        """One syncLoop iteration (syncLoopIteration's channel fan-in,
+        sequenced): heartbeat, runtime advance, PLEG relist -> worker syncs,
+        then housekeeping."""
         self.leases.renew_node_heartbeat(self.node_name)
-        now = self.clock.now()
-        mine = set()
-        inventory = None  # (slices, classes), fetched at most once per tick
-        for pod in list(self.store.pods.values()):
-            if pod.node_name != self.node_name:
+        pods = {uid: w.pod for uid, w in self.workers.items()}
+        self.runtime.tick(pods)
+        # PLEG events drive workers (syncLoopIteration's plegCh case)
+        for uid, what in self.pleg.relist():
+            w = self.workers.get(uid)
+            if w is None or w.terminated:
                 continue
-            mine.add(pod.uid)
-            if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
-                self._started_at.pop(pod.uid, None)
-                self.devices.free(pod.uid)  # terminated pods release devices
+            if what == "ContainerDied":
+                self._sync_died(w)
+        # config-driven syncs: admit + start pods whose worker is fresh
+        for uid, w in list(self.workers.items()):
+            if w.terminated or w.admitted:
                 continue
-            if pod.phase in ("", t.PHASE_PENDING):
-                if pod.resource_claims:
-                    if inventory is None:  # fetched once per tick, lazily
-                        inventory = (
-                            self.store.list_objects("ResourceSlice"),
-                            {dc.name: dc
-                             for dc in self.store.list_objects("DeviceClass")},
-                        )
-                    if not self._admit_devices(pod, *inventory):
-                        continue  # admission failed: pod marked Failed
-                # sandbox+containers "started": Pending -> Running
-                self._set_phase(pod, t.PHASE_RUNNING)
-                self._started_at[pod.uid] = now
-            elif pod.phase == t.PHASE_RUNNING:
-                started = self._started_at.setdefault(pod.uid, now)
-                if pod.run_seconds > 0 and now - started >= pod.run_seconds:
-                    self._set_phase(pod, t.PHASE_SUCCEEDED)
-                    self._started_at.pop(pod.uid, None)
-        # housekeeping: drop state for pods deleted while Running
-        for uid in list(self._started_at):
-            if uid not in mine:
-                del self._started_at[uid]
+            self._sync_start(w)
+        # housekeeping (housekeepingCh): drop terminated workers whose pod
+        # left the store (deletion events already handled; belt & braces),
+        # and reclaim checkpoint-restored device allocations whose pod
+        # vanished while the kubelet was down (no worker, no Deleted event)
+        for uid in list(self.workers):
+            if uid not in self.store.pods:
+                self._dispatch(self.workers[uid].pod, removed=True)
         for uid in list(self.devices.allocations):
-            if uid not in mine:
+            cur = self.store.pods.get(uid)
+            if cur is None or cur.node_name != self.node_name:
                 self.devices.free(uid)
 
-    def _admit_devices(self, pod: t.Pod, slices, classes) -> bool:
-        """devicemanager Allocate at admission; failure fails the pod (the
-        reference's UnexpectedAdmissionError path)."""
-        from .devicemanager import AllocationError
+    def close(self) -> None:
+        """Detach from the store's watch fan-out (a removed/restarted hollow
+        node must stop consuming events — and being retained — forever)."""
+        self.store.unwatch(self._on_event)
 
-        try:
-            self.devices.allocate(pod, slices, classes)
-            return True
-        except AllocationError:
-            self._set_phase(pod, t.PHASE_FAILED)
-            return False
+    # --- worker syncs (kubelet.go — SyncPod reduced to the hollow trade) ---
+    def _sync_start(self, w: _PodWorker) -> None:
+        pod = w.pod
+        if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+            w.terminated = True
+            return
+        if pod.resource_claims:
+            from .devicemanager import AllocationError
 
-    def _set_phase(self, pod: t.Pod, phase: str) -> None:
+            slices = self.store.list_objects("ResourceSlice")
+            classes = {dc.name: dc for dc in self.store.list_objects("DeviceClass")}
+            try:
+                self.devices.allocate(pod, slices, classes)
+            except AllocationError:
+                # UnexpectedAdmissionError: the pod fails on the node
+                w.terminated = True
+                self._set_phase(pod, t.PHASE_FAILED)
+                return
+        w.admitted = True
+        self.runtime.start(pod.uid)  # CreateSandbox + StartContainer
+        self._set_phase(pod, t.PHASE_RUNNING)
+
+    def _sync_died(self, w: _PodWorker) -> None:
+        """computePodActions — ShouldContainerBeRestarted: a CRASHED container
+        restarts under Always/OnFailure (restartCount++), else the pod goes
+        Failed; a clean exit is the hollow Job contract (run_seconds elapsed:
+        the workload is DONE) and terminates Succeeded."""
+        c = self.runtime.containers.get(w.pod.uid)
+        failed = c is not None and c.state == _EXITED_ERR
+        policy = w.pod.restart_policy or "Always"
+        if failed and policy in ("Always", "OnFailure"):
+            w.restarts += 1
+            self.runtime.start(w.pod.uid)
+            q = self._status_copy(w.pod)
+            q.restart_count = w.restarts
+            self.store.update_pod_status(q)
+            return
+        w.terminated = True
+        self.runtime.remove(w.pod.uid)
+        self.devices.free(w.pod.uid)
+        self._set_phase(w.pod, t.PHASE_FAILED if failed else t.PHASE_SUCCEEDED)
+
+    # --- status publication ---
+    def _status_copy(self, pod: t.Pod) -> t.Pod:
         import copy
 
-        q = copy.copy(pod)
+        cur = self.store.pods.get(pod.uid, pod)
+        return copy.copy(cur)
+
+    def _set_phase(self, pod: t.Pod, phase: str) -> None:
+        q = self._status_copy(pod)
         q.phase = phase
         if phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
             q.finished_at = self.clock.now()
@@ -174,6 +343,6 @@ class HollowCluster:
                 self.kubelets[name] = HollowKubelet(self.store, self.leases, name)
         for name in list(self.kubelets):
             if name not in self.store.nodes:
-                del self.kubelets[name]
+                self.kubelets.pop(name).close()
                 continue
             self.kubelets[name].tick()

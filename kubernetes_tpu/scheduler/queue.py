@@ -169,7 +169,6 @@ class PriorityQueue:
                 continue
             self.add(pod)
 
-    @_locked
     def _pop_one(self) -> Optional[t.Pod]:
         """Heap-drain step shared by pop()/pop_all() (caller holds the lock):
         skip superseded entries, bump the attempt counter."""
@@ -181,6 +180,7 @@ class PriorityQueue:
                 return item.pod
         return None
 
+    @_locked
     def pop(self) -> Optional[t.Pod]:
         """Next pod in activeQ order, or None if activeQ is empty
         (scheduling_queue.go — Pop; non-blocking variant)."""

@@ -152,6 +152,17 @@ class Scheduler:
             pod = ev.obj
             if ev.kind == "Deleted":
                 self.queue.delete(pod.uid)
+                # a gang member deleted while Permit-waiting must release its
+                # assumption and stop counting toward quorum
+                if pod.pod_group and pod.pod_group in self._gang_waiting:
+                    waiters = self._gang_waiting[pod.pod_group]
+                    kept = [w for w in waiters if w[0].uid != pod.uid]
+                    if len(kept) != len(waiters):
+                        self.cache.forget(pod.uid)
+                        if kept:
+                            self._gang_waiting[pod.pod_group] = kept
+                        else:
+                            del self._gang_waiting[pod.pod_group]
                 self._move_all(EV_POD_DELETE, obj=pod)
             elif ev.kind == "ModifiedStatus":
                 # status-only write: no requeue of THIS pod — but a bound pod

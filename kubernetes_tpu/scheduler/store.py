@@ -131,6 +131,14 @@ class ClusterStore:
                     fn(Event("Added", "PVC", pvc, self._rv))
             self._watchers.append(fn)
 
+    def unwatch(self, fn: Callable[[Event], None]) -> None:
+        """Drop a subscription (watch channel close) — no-op if absent."""
+        with self._lock:
+            try:
+                self._watchers.remove(fn)
+            except ValueError:
+                pass
+
     def _emit(self, ev: Event) -> None:
         for fn in self._watchers:
             fn(ev)

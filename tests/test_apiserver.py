@@ -425,4 +425,5 @@ def test_hollow_kubelet_assigns_pod_ip_and_prunes_state():
     assert pod.phase == t.PHASE_RUNNING and pod.pod_ip.startswith("10.1")
     store.delete_pod("default/p")
     kubelet.tick()
-    assert not kubelet._started_at  # no leak after deletion while Running
+    # no leak after deletion while Running: worker + runtime state pruned
+    assert not kubelet.workers and not kubelet.runtime.containers

@@ -114,3 +114,71 @@ def test_parity_random_pairwise_medium():
             rng, n_nodes=48, n_pods=150, with_taints=True, with_selectors=True, with_pairwise=True
         )
     )
+
+
+def test_chunked_scan_engages_and_matches_plain():
+    """>= 128 pods on a fit+balanced-only config take the CHUNKED commit scan
+    (ops/assign.py — schedule_scan_chunked); its decisions must be
+    bit-identical to the plain per-pod scan AND the oracle."""
+    import jax
+
+    from kubernetes_tpu.api.snapshot import encode_snapshot as _enc
+    from kubernetes_tpu.ops.assign import (
+        _chunkable,
+        schedule_scan,
+        schedule_scan_chunked,
+    )
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    rng = random.Random(7)
+    # few nodes + many pods: heavy intra-chunk contention (same nodes touched
+    # repeatedly) — the correction slots, not just the hoisted row, decide
+    # no PreferNoSchedule taints (they add a normalization stage and road
+    # back to the plain scan); heterogeneous's HARD taints are covered below
+    snap = random_cluster(rng, n_nodes=6, n_pods=200, with_taints=False,
+                          with_selectors=True, with_pairwise=False)
+    arr, meta = _enc(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg), (arr.P, cfg)
+    plain = np.asarray(jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0])
+    chunked, used = (
+        np.asarray(x)
+        for x in jax.jit(schedule_scan_chunked, static_argnames=("cfg",))(arr, cfg)
+    )
+    np.testing.assert_array_equal(chunked, plain)
+    # ... and the shared entry point routes to it with oracle parity
+    assert_parity(snap)
+    # node_used output matches the plain scan's too
+    plain_used = np.asarray(
+        jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[1]
+    )
+    np.testing.assert_array_equal(used, plain_used)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_chunked_scan_randomized_parity(seed):
+    rng = random.Random(1000 + seed)
+    snap = random_cluster(rng, n_nodes=rng.randint(3, 40),
+                          n_pods=rng.randint(128, 300),
+                          with_taints=False, with_selectors=True,
+                          with_pairwise=False)
+    assert_parity(snap)
+
+
+def test_chunked_heterogeneous_with_hard_taints_matches_plain():
+    """The north-star shape: NoSchedule taints + tolerations + extended
+    resources stay on the chunked path (hard taints are static filters)."""
+    import jax
+
+    from kubernetes_tpu.bench import workloads
+    from kubernetes_tpu.api.snapshot import encode_snapshot as _enc
+    from kubernetes_tpu.ops.assign import _chunkable, schedule_scan
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    snap = workloads.heterogeneous(40, 256, seed=5)
+    arr, meta = _enc(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg), cfg
+    plain = np.asarray(jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0])
+    routed = np.asarray(schedule_batch(arr, cfg)[0])
+    np.testing.assert_array_equal(routed, plain)

@@ -1,0 +1,148 @@
+"""CustomResourceDefinition machinery: per-version structural schemas,
+served/storage flags, storage-version conversion, instance GC on CRD delete —
+the apiextensions-apiserver analog (scheduler/crd.py) through the full
+APIServer handler chain."""
+
+import pytest
+
+from kubernetes_tpu.scheduler import ClusterStore
+from kubernetes_tpu.scheduler.admission import AdmissionDenied
+from kubernetes_tpu.scheduler.apiserver import APIServer
+from kubernetes_tpu.scheduler.crd import (
+    CRDInvalid,
+    CRDVersion,
+    CustomResource,
+    CustomResourceDefinition,
+    validate_schema_value,
+)
+
+
+def _admin_server():
+    store = ClusterStore()
+    srv = APIServer(store)
+    srv.authn.add_token("admin", "admin", groups=("system:masters",))
+    return store, srv
+
+
+def _crd():
+    schema_v1a1 = {
+        "type": "object",
+        "required": ["minMember"],
+        "properties": {
+            "minMember": {"type": "integer", "minimum": 1},
+            "queue": {"type": "string"},
+        },
+    }
+    schema_v1 = {
+        "type": "object",
+        "required": ["minMember"],
+        "properties": {
+            "minMember": {"type": "integer", "minimum": 1},
+            "queue": {"type": "string", "enum": ["default", "batch"]},
+            "workers": {
+                "type": "array",
+                "items": {"type": "object", "properties": {"cpu": {"type": "integer"}},
+                          "required": ["cpu"]},
+            },
+        },
+    }
+    return CustomResourceDefinition(
+        group="scheduling.example.com",
+        kind="TrainingJob",
+        plural="trainingjobs",
+        versions=(
+            CRDVersion("v1alpha1", served=True, storage=False, schema=schema_v1a1),
+            CRDVersion("v1", served=True, storage=True, schema=schema_v1),
+        ),
+    )
+
+
+def test_schema_validator_subset():
+    s = {"type": "object", "properties": {"n": {"type": "integer", "maximum": 5}},
+         "required": ["n"]}
+    assert validate_schema_value(s, {"n": 3}) == []
+    assert any("required" in e for e in validate_schema_value(s, {}))
+    assert any("expected integer" in e for e in validate_schema_value(s, {"n": "x"}))
+    assert any("maximum" in e for e in validate_schema_value(s, {"n": 9}))
+    assert any("unknown field" in e for e in validate_schema_value(s, {"n": 1, "z": 2}))
+    # booleans are not integers (the classic Python trap)
+    assert any("integer" in e for e in validate_schema_value(s, {"n": True}))
+
+
+def test_crd_lifecycle_through_apiserver():
+    store, srv = _admin_server()
+    crd = srv.handle("admin", "create", "CustomResourceDefinition", obj=_crd())
+    assert crd.established
+    # valid create at the storage version
+    ok = CustomResource(api_version="scheduling.example.com/v1", kind="TrainingJob",
+                        name="job1", spec={"minMember": 4, "queue": "batch"})
+    srv.handle("admin", "create", "TrainingJob", obj=ok)
+    assert store.get_object("TrainingJob", "default/job1") is ok
+    # invalid spec rejected with a schema path
+    bad = CustomResource(api_version="scheduling.example.com/v1", kind="TrainingJob",
+                         name="job2", spec={"minMember": 0})
+    with pytest.raises(AdmissionDenied, match="minimum"):
+        srv.handle("admin", "create", "TrainingJob", obj=bad)
+    # enum enforcement + nested array items
+    bad2 = CustomResource(api_version="scheduling.example.com/v1", kind="TrainingJob",
+                          name="job3",
+                          spec={"minMember": 1, "queue": "oops"})
+    with pytest.raises(AdmissionDenied, match="enum"):
+        srv.handle("admin", "create", "TrainingJob", obj=bad2)
+    bad3 = CustomResource(api_version="scheduling.example.com/v1", kind="TrainingJob",
+                          name="job4",
+                          spec={"minMember": 1, "workers": [{"cpu": "a lot"}]})
+    with pytest.raises(AdmissionDenied, match=r"workers\[0\].cpu"):
+        srv.handle("admin", "create", "TrainingJob", obj=bad3)
+
+
+def test_version_conversion_and_serving():
+    store, srv = _admin_server()
+    srv.handle("admin", "create", "CustomResourceDefinition", obj=_crd())
+    # a write at a non-storage served version converts to the storage version
+    old = CustomResource(api_version="scheduling.example.com/v1alpha1",
+                         kind="TrainingJob", name="legacy",
+                         spec={"minMember": 2, "queue": "anything"})
+    srv.handle("admin", "create", "TrainingJob", obj=old)
+    stored = store.get_object("TrainingJob", "default/legacy")
+    assert stored.api_version == "scheduling.example.com/v1"
+    # unknown / unserved versions rejected
+    with pytest.raises(AdmissionDenied, match="unknown version"):
+        srv.handle(
+            "admin", "create", "TrainingJob",
+            obj=CustomResource(api_version="scheduling.example.com/v9",
+                               kind="TrainingJob", name="x", spec={"minMember": 1}),
+        )
+
+
+def test_crd_definition_validation_and_delete_gc():
+    store, srv = _admin_server()
+    with pytest.raises(AdmissionDenied, match="storage version"):
+        srv.handle(
+            "admin", "create", "CustomResourceDefinition",
+            obj=CustomResourceDefinition(
+                group="g.io", kind="Two", plural="twos",
+                versions=(CRDVersion("v1", storage=True),
+                          CRDVersion("v2", storage=True)),
+            ),
+        )
+    with pytest.raises(AdmissionDenied, match="built-in"):
+        srv.handle(
+            "admin", "create", "CustomResourceDefinition",
+            obj=CustomResourceDefinition(
+                group="g.io", kind="Pod", plural="pods2",
+                versions=(CRDVersion("v1", storage=True),),
+            ),
+        )
+    srv.handle("admin", "create", "CustomResourceDefinition", obj=_crd())
+    srv.handle(
+        "admin", "create", "TrainingJob",
+        obj=CustomResource(api_version="scheduling.example.com/v1",
+                           kind="TrainingJob", name="gc-me",
+                           spec={"minMember": 1}),
+    )
+    # deleting the CRD garbage-collects its instances
+    srv.handle("admin", "delete", "CustomResourceDefinition",
+               name="trainingjobs.scheduling.example.com")
+    assert store.list_objects("TrainingJob") == []
+    assert store.list_objects("CustomResourceDefinition") == []

@@ -1,0 +1,87 @@
+"""Pod workers + PLEG + restartPolicy on the hollow kubelet — the reference
+kubelet's control structure (pod_workers.go serialized per-pod machines;
+pleg/generic.go Relist; kuberuntime computePodActions restart rules) run
+against the fake clock-driven runtime (the kubemark trade)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore
+from kubernetes_tpu.scheduler.kubelet import HollowKubelet
+from kubernetes_tpu.scheduler.leases import LeaseStore
+from kubernetes_tpu.scheduler.queue import FakeClock
+from helpers import mk_node, mk_pod
+
+
+def _rig():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    kubelet = HollowKubelet(store, LeaseStore(clock=clock), "n0", clock=clock)
+    return clock, store, kubelet
+
+
+def test_workers_are_watch_driven_and_scoped_to_node():
+    clock, store, kubelet = _rig()
+    store.add_node(mk_node("other"))
+    store.add_pod(mk_pod("mine", node_name="n0"))
+    store.add_pod(mk_pod("elsewhere", node_name="other"))
+    store.add_pod(mk_pod("pending"))  # unbound: not mine either
+    assert set(kubelet.workers) == {"default/mine"}
+    kubelet.tick()
+    assert store.pods["default/mine"].phase == t.PHASE_RUNNING
+    assert store.pods["default/elsewhere"].phase == ""
+    # late bind arrives purely via watch
+    store.bind("default/pending", "n0")
+    assert "default/pending" in kubelet.workers
+
+
+def test_pleg_emits_started_and_died():
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod("job", node_name="n0", run_seconds=5.0))
+    kubelet.tick()
+    kubelet.tick()  # relist observes RUNNING
+    assert kubelet.pleg._last.get("default/job") is not None
+    clock.step(6.0)
+    kubelet.tick()  # runtime exits 0 -> PLEG ContainerDied -> Succeeded
+    assert store.pods["default/job"].phase == t.PHASE_SUCCEEDED
+    assert "default/job" not in kubelet.runtime.containers
+
+
+def test_crash_restart_policy_always_bumps_restart_count():
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod("crashy", node_name="n0", crash_after_seconds=2.0))
+    kubelet.tick()
+    for i in range(3):
+        clock.step(3.0)
+        kubelet.tick()
+    pod = store.pods["default/crashy"]
+    assert pod.phase == t.PHASE_RUNNING  # still restarting (Always)
+    assert pod.restart_count == 3
+
+
+def test_crash_restart_policy_never_fails_pod():
+    clock, store, kubelet = _rig()
+    store.add_pod(
+        mk_pod("once", node_name="n0", crash_after_seconds=1.0,
+               restart_policy="Never")
+    )
+    kubelet.tick()
+    clock.step(2.0)
+    kubelet.tick()
+    pod = store.pods["default/once"]
+    assert pod.phase == t.PHASE_FAILED and pod.restart_count == 0
+
+
+def test_on_failure_restarts_crashes_but_not_completions():
+    clock, store, kubelet = _rig()
+    store.add_pod(
+        mk_pod("flaky-job", node_name="n0", run_seconds=10.0,
+               crash_after_seconds=3.0, restart_policy="OnFailure")
+    )
+    kubelet.tick()
+    clock.step(4.0)
+    kubelet.tick()  # crashed at 3s -> restarted
+    assert store.pods["default/flaky-job"].restart_count == 1
+    # after restart the crash timer resets; let it crash once more
+    clock.step(4.0)
+    kubelet.tick()
+    assert store.pods["default/flaky-job"].restart_count == 2
