@@ -741,12 +741,13 @@ class DeltaEncoder:
         import os
 
         self.debug_verify = debug_verify or os.environ.get("KTPU_DELTA_VERIFY") == "1"
-        # persistent identity-profile -> canonical spec key cache: successive
-        # waves stamped from the same objects (or wire-interned copies) share
-        # field objects, so the per-pod canonical keying (the sorting-heavy
-        # part of group_by_spec) is paid once per template, not once per pod
-        # per cycle.  Size-capped like wave_uid_rep.
-        self._spec_keys: Dict[Tuple, Tuple] = {}
+        # persistent identity-profile -> canonical spec key interning:
+        # successive waves stamped from the same objects (or wire-interned
+        # copies) share field objects, so per-pod canonical keying is paid
+        # once per template, not once per pod per cycle
+        from .snapshot import SpecInterner
+
+        self._interner = SpecInterner()
 
     def encode_device(self, snap):
         """encode(), with the ClusterArrays placed on device — fields whose
@@ -781,35 +782,10 @@ class DeltaEncoder:
         return type(arr)(**out), meta
 
     def _group_cached(self, pods):
-        """group_by_spec with the encoder-resident identity->key cache: same
+        """group_by_spec through the encoder-resident SpecInterner: same
         reps/inv as snapshot.group_by_spec (bit-identical arrays), plus each
         rep's canonical key (the pod-side cache key input)."""
-        from .snapshot import _identity_key, _pod_spec_key
-
-        if len(self._spec_keys) > 2 * (len(pods) + 1024):
-            self._spec_keys.clear()
-        cache = self._spec_keys
-        can_ids: Dict[Tuple, int] = {}
-        reps: List[t.Pod] = []
-        rep_keys: List[Tuple] = []
-        inv = np.empty(len(pods), dtype=np.int64)
-        for i, pod in enumerate(pods):
-            ik = _identity_key(pod)
-            ent = cache.get(ik)
-            if ent is None:
-                # the VALUE keeps the pod (and so every id()'d field object)
-                # alive: a recycled address can never alias a live entry
-                ent = (_pod_spec_key(pod), pod)
-                cache[ik] = ent
-            k = ent[0]
-            su = can_ids.get(k)
-            if su is None:
-                su = len(reps)
-                can_ids[k] = su
-                reps.append(pod)
-                rep_keys.append(k)
-            inv[i] = su
-        return reps, inv, tuple(rep_keys)
+        return self._interner.group(pods)
 
     def encode(self, snap):
         from .snapshot import _resource_axis, activeq_order

@@ -317,6 +317,43 @@ def _identity_key(pod: t.Pod) -> Tuple:
     )
 
 
+class SpecInterner:
+    """A PERSISTENT two-level interner: identity-profile -> canonical spec
+    key survives across calls (successive waves stamped from the same objects
+    share field objects), so steady-state group_by_spec costs O(P) dict hits
+    instead of O(P) sorted() canonicalizations.  Used by the delta encoder
+    and the sidecar client's wave interning.  Values keep the keyed pod alive
+    so recycled ids can never alias a live entry."""
+
+    def __init__(self):
+        self._keys: Dict[Tuple, Tuple] = {}
+
+    def group(self, pods: Sequence[t.Pod]):
+        """-> (reps, inv, rep_keys) — same reps/inv as group_by_spec."""
+        if len(self._keys) > 2 * (len(pods) + 1024):
+            self._keys.clear()
+        cache = self._keys
+        can_ids: Dict[Tuple, int] = {}
+        reps: List[t.Pod] = []
+        rep_keys: List[Tuple] = []
+        inv = np.empty(len(pods), dtype=np.int64)
+        for i, pod in enumerate(pods):
+            ik = _identity_key(pod)
+            ent = cache.get(ik)
+            if ent is None:
+                ent = (_pod_spec_key(pod), pod)
+                cache[ik] = ent
+            k = ent[0]
+            su = can_ids.get(k)
+            if su is None:
+                su = len(reps)
+                can_ids[k] = su
+                reps.append(pod)
+                rep_keys.append(k)
+            inv[i] = su
+        return reps, inv, tuple(rep_keys)
+
+
 def group_by_spec(pods: Sequence[t.Pod]) -> Tuple[List[t.Pod], np.ndarray]:
     """-> (reps, inv): unique encoding specs in first-occurrence order and each
     pod's spec index.  Interner-order equivalence: because every vocab below

@@ -23,7 +23,7 @@ import grpc
 from ..api import types as t
 from ..api.snapshot import Snapshot
 from . import tpuscore_pb2 as pb
-from .convert import node_to_proto, pod_to_proto, wave_to_proto
+from .convert import node_to_proto, pod_to_proto
 from .sidecar import SERVICE
 
 
@@ -59,6 +59,10 @@ class TPUScoreClient:
             response_deserializer=pb.HealthResponse.FromString,
         )
         # session state (None session_id = legacy stateless requests)
+        from ..api.snapshot import SpecInterner
+
+        self._interner = SpecInterner()  # persistent wave spec interning
+        self._spec_msgs: Dict[Tuple, object] = {}  # canonical key -> pb.Pod
         self.session_id = uuid.uuid4().hex if session else ""
         self._epoch = 0
         self._synced = False
@@ -74,6 +78,27 @@ class TPUScoreClient:
             raise SidecarUnavailable(str(e.code())) from e
 
     # --- request builders ---
+    def _wave_msg(self, pods) -> pb.InternedWave:
+        """wave_to_proto through the client-resident interner: per-template
+        canonical keying AND pb.Pod serialization happen once, not per cycle
+        (steady-state waves re-send only uids + spec indices)."""
+        reps, inv, rep_keys = self._interner.group(pods)
+        if len(self._spec_msgs) > 4 * (len(rep_keys) + 256):
+            self._spec_msgs.clear()
+        specs = []
+        for rep, k in zip(reps, rep_keys):
+            msg = self._spec_msgs.get(k)
+            if msg is None:
+                msg = pod_to_proto(rep)
+                msg.ClearField("name")
+                msg.ClearField("uid")
+                self._spec_msgs[k] = msg
+            specs.append(msg)
+        msg = pb.InternedWave(specs=specs)
+        msg.uids.extend(p.uid for p in pods)
+        msg.spec_idx.extend(inv.tolist())
+        return msg
+
     def _full_request(self, snap: Snapshot, deadline_ms, gang, hpaw):
         req = pb.ScheduleRequest(
             deadline_ms=deadline_ms,
@@ -81,7 +106,7 @@ class TPUScoreClient:
             hard_pod_affinity_weight=hpaw,
             session_id=self.session_id,
             epoch=self._epoch,
-            wave=wave_to_proto(snap.pending_pods),
+            wave=self._wave_msg(snap.pending_pods),
         )
         req.snapshot.nodes.extend(node_to_proto(n) for n in snap.nodes)
         req.snapshot.bound_pods.extend(pod_to_proto(p) for p in snap.bound_pods)
@@ -99,7 +124,7 @@ class TPUScoreClient:
             hard_pod_affinity_weight=hpaw,
             session_id=self.session_id,
             epoch=self._epoch,
-            wave=wave_to_proto(snap.pending_pods),
+            wave=self._wave_msg(snap.pending_pods),
         )
         req.delta.SetInParent()  # presence even when the diff is empty
         d = req.delta

@@ -66,3 +66,41 @@ def test_gangs_mixed_with_plain_pods():
     pods += [mk_pod(f"gang-{i}", cpu=900, pod_group="heavy") for i in range(4)]
     snap = Snapshot(nodes=[mk_node(f"n{i}", cpu=2000) for i in range(3)], pending_pods=pods)
     run_both(snap)
+
+
+def test_gang_fixpoint_on_chunked_scan_matches_plain():
+    """Config-5 scale gangs (>=128 pods) route through the CHUNKED scan inside
+    the gang revocation fixpoint; decisions must equal the plain per-pod scan
+    driven through the same fixpoint."""
+    import jax
+    import numpy as np
+
+    from kubernetes_tpu.api.snapshot import encode_snapshot
+    from kubernetes_tpu.bench import workloads
+    from kubernetes_tpu.ops.assign import _chunkable, schedule_scan
+    from kubernetes_tpu.ops.gang import failed_groups, schedule_with_gangs
+    from kubernetes_tpu.ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
+
+    snap = workloads.gang(n_groups=24, group_size=8, n_nodes=12, seed=11)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg), cfg
+    chunked, _ = schedule_with_gangs(arr, cfg)
+
+    # the same fixpoint over the plain scan
+    import dataclasses
+
+    plain_sb = jax.jit(schedule_scan, static_argnames=("cfg",))
+    pod_valid = np.asarray(arr.pod_valid).copy()
+    while True:
+        arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
+        choices = np.asarray(plain_sb(arr_i, cfg)[0])
+        bad = failed_groups(choices, np.asarray(arr.pod_group),
+                            np.asarray(arr.group_min), active=pod_valid)
+        if not bad.any():
+            break
+        pg = np.asarray(arr.pod_group)
+        in_bad = bad[np.maximum(pg, 0)] & (pg >= 0) & pod_valid
+        first_g = pg[int(np.argmax(in_bad))]
+        pod_valid = pod_valid & ~((pg == first_g) & pod_valid)
+    np.testing.assert_array_equal(chunked, choices)
