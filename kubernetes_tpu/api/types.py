@@ -227,6 +227,10 @@ class PersistentVolumeClaim:
     volume_name: str = ""  # pre-bound PV ("" = unbound)
     # WaitForFirstConsumer claims don't constrain scheduling (delayed binding)
     wait_for_first_consumer: bool = False
+    # accessModes contains ReadWriteOncePod: at most ONE pod cluster-wide may
+    # use the claim (volumerestrictions/volume_restrictions.go — the only
+    # non-deprecated restriction the reference's plugin enforces)
+    read_write_once_pod: bool = False
 
     @property
     def key(self) -> str:

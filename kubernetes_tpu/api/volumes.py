@@ -67,13 +67,19 @@ def resolve_pod(
     pvcs: Dict[str, t.PersistentVolumeClaim],
     pvs: Dict[str, t.PersistentVolume],
     classes: Optional[Dict[str, object]] = None,
+    rwop_blocked: Optional[set] = None,
 ) -> t.Pod:
-    """Fold the pod's storage/claim constraints into requests + node affinity."""
+    """Fold the pod's storage/claim constraints into requests + node affinity.
+    `rwop_blocked`: claim names this pod may NOT use right now (another pod
+    holds the ReadWriteOncePod claim) — folds an unsatisfiable term."""
     classes = classes or {}
     extra_terms: List[t.NodeSelectorTerm] = []
     attach_count = 0
     req_extra: Dict[str, int] = {}
     for claim_name in pod.pvcs:
+        if rwop_blocked and claim_name in rwop_blocked:
+            extra_terms.append(_unsatisfiable_term())
+            continue
         pvc = pvcs.get(f"{pod.namespace}/{claim_name}")
         if pvc is None:
             extra_terms.append(_unsatisfiable_term())  # missing claim: pending
@@ -254,9 +260,38 @@ def resolve_snapshot(snap: Snapshot) -> Snapshot:
                 **devices.get(nd.name, {}),
             }
             nodes.append(nd2)
+    # ReadWriteOncePod (volumerestrictions/volume_restrictions.go): at most
+    # one pod cluster-wide may use such a claim.  A live bound user blocks
+    # every pending user; otherwise pending users serialize in snapshot
+    # (arrival) order — the first keeps the claim, the rest fold an
+    # unsatisfiable term, matching the reference's one-at-a-time outcome
+    # (documented deviation: arrival order stands in for cycle order).
+    rwop_blocked: Dict[str, set] = {}
+    rwop_keys = {k for k, c in pvcs.items() if c.read_write_once_pod}
+    if rwop_keys:
+        held = set()
+        for q in snap.bound_pods:
+            if q.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
+                continue
+            for cn in q.pvcs:
+                ck = f"{q.namespace}/{cn}"
+                if ck in rwop_keys:
+                    held.add(ck)
+        claimed = set(held)
+        for q in snap.pending_pods:
+            for cn in q.pvcs:
+                ck = f"{q.namespace}/{cn}"
+                if ck in rwop_keys:
+                    if ck in claimed:
+                        rwop_blocked.setdefault(q.uid, set()).add(cn)
+                    else:
+                        claimed.add(ck)
     return Snapshot(
         nodes=nodes,
-        pending_pods=[resolve_pod(p, pvcs, pvs, classes) for p in snap.pending_pods],
+        pending_pods=[
+            resolve_pod(p, pvcs, pvs, classes, rwop_blocked.get(p.uid))
+            for p in snap.pending_pods
+        ],
         bound_pods=[resolve_pod(p, pvcs, pvs, classes) for p in snap.bound_pods],
         pod_groups=snap.pod_groups,
         pvs=snap.pvs,

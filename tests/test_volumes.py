@@ -1,0 +1,77 @@
+"""Volume family round-3 additions: ReadWriteOncePod serialization
+(volumerestrictions/volume_restrictions.go — the one non-deprecated
+restriction), resolved identically by every engine via api/volumes."""
+
+from kubernetes_tpu.api import types as t
+from helpers import mk_node, mk_pod
+
+
+
+# ------------------------------------------------- ReadWriteOncePod (round 3)
+
+
+def test_read_write_once_pod_serializes_users():
+    """volumerestrictions — ReadWriteOncePod: one pod cluster-wide may use
+    the claim; a live holder blocks new users; pending users serialize in
+    arrival order; the holder finishing releases the claim."""
+    import dataclasses
+
+    from kubernetes_tpu.api.snapshot import Snapshot
+    from kubernetes_tpu.oracle import oracle_schedule
+
+    pvc = t.PersistentVolumeClaim(
+        name="rwop", request=1, storage_class="", read_write_once_pod=True,
+        wait_for_first_consumer=True,
+    )
+    nodes = [mk_node("n0"), mk_node("n1")]
+    a = mk_pod("a", cpu=100)
+    b = mk_pod("b", cpu=100)
+    a = dataclasses.replace(a, pvcs=("rwop",))
+    b = dataclasses.replace(b, pvcs=("rwop",))
+    snap = Snapshot(nodes=nodes, pending_pods=[a, b], pvcs={pvc.key: pvc})
+    got = dict(oracle_schedule(snap))
+    assert got["a"] is not None and got["b"] is None  # arrival order wins
+    # a live bound holder blocks every pending user
+    holder = dataclasses.replace(a, name="holder", uid="", node_name="n0")
+    snap2 = Snapshot(nodes=nodes, pending_pods=[dataclasses.replace(b)],
+                     bound_pods=[holder], pvcs={pvc.key: pvc})
+    got2 = dict(oracle_schedule(snap2))
+    assert got2["b"] is None
+    # ... until the holder reaches a terminal phase
+    done = dataclasses.replace(holder, phase=t.PHASE_SUCCEEDED)
+    snap3 = Snapshot(nodes=nodes, pending_pods=[dataclasses.replace(b)],
+                     bound_pods=[done], pvcs={pvc.key: pvc})
+    got3 = dict(oracle_schedule(snap3))
+    assert got3["b"] is not None
+    # a non-RWOP claim shared by two pods schedules both
+    plain = t.PersistentVolumeClaim(name="shared", request=1,
+                                    wait_for_first_consumer=True)
+    c = dataclasses.replace(mk_pod("c", cpu=100), pvcs=("shared",))
+    d = dataclasses.replace(mk_pod("d", cpu=100), pvcs=("shared",))
+    snap4 = Snapshot(nodes=nodes, pending_pods=[c, d],
+                     pvcs={plain.key: plain})
+    got4 = dict(oracle_schedule(snap4))
+    assert got4["c"] is not None and got4["d"] is not None
+
+
+def test_read_write_once_pod_parity_through_batch_path():
+    import dataclasses
+
+    from kubernetes_tpu.api.snapshot import Snapshot
+    from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+
+    pvc = t.PersistentVolumeClaim(
+        name="rwop", request=1, read_write_once_pod=True,
+        wait_for_first_consumer=True,
+    )
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    store.add_node(mk_node("n1"))
+    store.add_pvc(pvc)
+    store.add_pv(t.PersistentVolume(name="pv0", capacity=10))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(dataclasses.replace(mk_pod("a", cpu=100), pvcs=("rwop",)))
+    store.add_pod(dataclasses.replace(mk_pod("b", cpu=100), pvcs=("rwop",)))
+    sched.run_until_idle()
+    bound = {p.name: bool(p.node_name) for p in store.pods.values()}
+    assert bound == {"a": True, "b": False}, bound
