@@ -65,6 +65,8 @@ _register_words(
 _register_words("Role", "role", "roles", "clusterrole", "clusterroles")
 _register_words("RoleBinding", "rolebinding", "rolebindings",
                 "clusterrolebinding", "clusterrolebindings")
+_register_words("CustomResourceDefinition", "customresourcedefinition",
+                "customresourcedefinitions", "crd", "crds")
 _register_words("PV", "persistentvolume", "persistentvolumes", "pv")
 _register_words("PVC", "persistentvolumeclaim", "persistentvolumeclaims", "pvc")
 _register_words("StorageClass", "storageclass", "storageclasses", "sc")
@@ -84,7 +86,7 @@ _STORE_KIND = {
 # kinds with no namespace column
 _CLUSTER_SCOPED = {"Node", "Namespace", "PriorityClass", "PV", "StorageClass",
                    "ResourceSlice", "DeviceClass", "FlowSchema",
-                   "PriorityLevelConfiguration"}
+                   "PriorityLevelConfiguration", "CustomResourceDefinition"}
 
 
 def _singular(resource: str) -> str:
@@ -94,8 +96,18 @@ def _singular(resource: str) -> str:
     return resource[:-1] if resource.endswith("s") else resource
 
 
-def resolve_kind(word: str) -> str:
+def resolve_kind(word: str, api=None) -> str:
     k = _KIND_WORDS.get(word.lower())
+    if k is None and api is not None:
+        # dynamic discovery: established CustomResourceDefinitions serve their
+        # plural / kind / full name as resource words (the reference's
+        # RESTMapper consults discovery the same way)
+        crds = getattr(api, "crds", None)
+        if crds is not None:
+            w = word.lower()
+            for crd in crds._by_kind.values():
+                if w in (crd.plural.lower(), crd.kind.lower(), crd.name.lower()):
+                    return crd.kind
     if k is None:
         raise KubectlError(f'the server doesn\'t have a resource type "{word}"')
     return k
@@ -205,7 +217,7 @@ class Kubectl:
     def _cmd_get(self, pos, flags):
         if not pos:
             raise KubectlError("get needs a resource type")
-        kind = resolve_kind(pos[0])
+        kind = resolve_kind(pos[0], self.api)
         ns = self._ns(flags) if kind not in _CLUSTER_SCOPED else None
         if len(pos) > 1:
             objs = [self._get_required(kind, ns or "default", pos[1])]
@@ -318,7 +330,7 @@ class Kubectl:
     def _cmd_describe(self, pos, flags):
         if len(pos) < 2:
             raise KubectlError("describe needs a resource type and a name")
-        kind = resolve_kind(pos[0])
+        kind = resolve_kind(pos[0], self.api)
         ns = self._ns(flags) or "default"
         obj = self._get_required(kind, ns, pos[1])
         buf = io.StringIO()
@@ -397,7 +409,7 @@ class Kubectl:
         else:
             if len(pos) < 2:
                 raise KubectlError("delete needs a resource type and a name")
-            kind = resolve_kind(pos[0])
+            kind = resolve_kind(pos[0], self.api)
             ns = (self._ns(flags) or "default") if kind not in _CLUSTER_SCOPED else ""
             targets.extend((kind, ns, name) for name in pos[1:])
         lines = []
@@ -420,7 +432,7 @@ class Kubectl:
             kw, name = pos[0], pos[1]
         else:
             raise KubectlError("scale needs a resource (kind/name)")
-        kind = resolve_kind(kw)
+        kind = resolve_kind(kw, self.api)
         if kind not in ("Deployment", "ReplicaSet", "StatefulSet"):
             raise KubectlError(f"cannot scale {resource_of(kind)}")
         ns = self._ns(flags) or "default"
@@ -488,7 +500,7 @@ class Kubectl:
 
     # ---------------------------------------------------------------- taint
     def _cmd_taint(self, pos, flags):
-        if len(pos) < 3 or resolve_kind(pos[0]) != "Node":
+        if len(pos) < 3 or resolve_kind(pos[0], self.api) != "Node":
             raise KubectlError("usage: taint nodes <name> key=value:Effect | key[:Effect]-")
         name = pos[1]
         node = copy.copy(self._get_required("Node", "", name))
@@ -516,7 +528,7 @@ class Kubectl:
     def _cmd_label(self, pos, flags):
         if len(pos) < 3:
             raise KubectlError("usage: label <kind> <name> key=value | key-")
-        kind = resolve_kind(pos[0])
+        kind = resolve_kind(pos[0], self.api)
         ns = (self._ns(flags) or "default") if kind not in _CLUSTER_SCOPED else ""
         obj = copy.copy(self._get_required(kind, ns, pos[1]))
         if not hasattr(obj, "labels"):
@@ -544,7 +556,7 @@ class Kubectl:
         analog the scheduler itself reasons about)."""
         if not pos:
             raise KubectlError("top needs `nodes` or `pods`")
-        what = resolve_kind(pos[0])
+        what = resolve_kind(pos[0], self.api)
         store = self.api.store
         if what == "Node":
             used: Dict[str, Dict[str, int]] = {}
@@ -581,7 +593,7 @@ class Kubectl:
             kw, name = pos[1], pos[2]
         else:
             raise KubectlError("usage: rollout status deployment/<name>")
-        if resolve_kind(kw) != "Deployment":
+        if resolve_kind(kw, self.api) != "Deployment":
             raise KubectlError("rollout status supports deployments")
         ns = self._ns(flags) or "default"
         d = self._get_required("Deployment", ns, name)
@@ -617,7 +629,7 @@ class Kubectl:
             raise KubectlError("Error from server: invalid or missing bearer token")
         verb, res = pos[1], pos[2]
         try:
-            res = resource_of(resolve_kind(res))
+            res = resource_of(resolve_kind(res, self.api))
         except KubectlError:
             pass  # raw resource word
         ns = flags.get("namespace", "")

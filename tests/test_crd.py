@@ -146,3 +146,33 @@ def test_crd_definition_validation_and_delete_gc():
                name="trainingjobs.scheduling.example.com")
     assert store.list_objects("TrainingJob") == []
     assert store.list_objects("CustomResourceDefinition") == []
+
+
+def test_kubectl_discovers_custom_resources():
+    """kubectl resolves CRD plurals/kinds dynamically (the RESTMapper-through-
+    discovery behavior) and lists instances through the same handler chain."""
+    from kubernetes_tpu.kubectl import Kubectl
+
+    store, srv = _admin_server()
+    kc = Kubectl(srv, token="admin")
+    srv.handle("admin", "create", "CustomResourceDefinition", obj=_crd())
+    srv.handle(
+        "admin", "create", "TrainingJob",
+        obj=CustomResource(api_version="scheduling.example.com/v1",
+                           kind="TrainingJob", name="tj1",
+                           spec={"minMember": 2}),
+    )
+    out = kc.run("get trainingjobs")
+    assert "tj1" in out
+    out2 = kc.run("get TrainingJob")
+    assert "tj1" in out2
+    # CRDs themselves list under their own words
+    out3 = kc.run("get crds")
+    assert "trainingjobs.scheduling.example.com" in out3
+    # unknown plural still errors cleanly
+    import pytest as _pytest
+
+    from kubernetes_tpu.kubectl import KubectlError
+
+    with _pytest.raises(KubectlError, match="resource type"):
+        kc.run("get flurbs")
