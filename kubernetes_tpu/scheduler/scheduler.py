@@ -99,6 +99,9 @@ class Scheduler:
         # sidecar-deadline fallback preserves all-or-nothing exactly like the
         # batch path's gang fixpoint (ops/gang.py)
         self._gang_waiting: Dict[str, List[Tuple[t.Pod, str, object, object]]] = {}
+        # watch callbacks fire on whichever thread mutates the store (e.g.
+        # binding-pool threads) — the waiting map needs its own lock
+        self._gang_lock = threading.Lock()
         self.framework = Framework(
             default_plugins(
                 store,
@@ -154,15 +157,19 @@ class Scheduler:
                 self.queue.delete(pod.uid)
                 # a gang member deleted while Permit-waiting must release its
                 # assumption and stop counting toward quorum
-                if pod.pod_group and pod.pod_group in self._gang_waiting:
-                    waiters = self._gang_waiting[pod.pod_group]
-                    kept = [w for w in waiters if w[0].uid != pod.uid]
-                    if len(kept) != len(waiters):
+                if pod.pod_group:
+                    dropped = False
+                    with self._gang_lock:
+                        waiters = self._gang_waiting.get(pod.pod_group)
+                        if waiters is not None:
+                            kept = [w for w in waiters if w[0].uid != pod.uid]
+                            dropped = len(kept) != len(waiters)
+                            if dropped and kept:
+                                self._gang_waiting[pod.pod_group] = kept
+                            elif dropped:
+                                del self._gang_waiting[pod.pod_group]
+                    if dropped:
                         self.cache.forget(pod.uid)
-                        if kept:
-                            self._gang_waiting[pod.pod_group] = kept
-                        else:
-                            del self._gang_waiting[pod.pod_group]
                 self._move_all(EV_POD_DELETE, obj=pod)
             elif ev.kind == "ModifiedStatus":
                 # status-only write: no requeue of THIS pod — but a bound pod
@@ -381,23 +388,24 @@ class Scheduler:
         # siblings are assumed or bound; the arrival that completes the
         # quorum binds every waiter
         if pod.pod_group and self.features.enabled("GangScheduling"):
-            waiters = self._gang_waiting.setdefault(pod.pod_group, [])
-            # dedupe: a re-scheduled copy of an already-waiting member (e.g.
-            # a metadata update re-queued it) must REPLACE its entry, never
-            # inflate the quorum count
-            waiters[:] = [w for w in waiters if w[0].uid != pod.uid]
-            waiters.append((pod, node_name, state, snap))
-            pg = snap.pod_groups.get(pod.pod_group)
-            need = pg.min_member if pg else 1
-            waiting_uids = {w[0].uid for w in waiters}
-            bound = sum(
-                1
-                for q in snap.bound_pods
-                if q.pod_group == pod.pod_group and q.uid not in waiting_uids
-            )
-            if len(waiters) + bound < need:
-                return None  # waiting (assumed, not bound)
-            del self._gang_waiting[pod.pod_group]
+            with self._gang_lock:
+                waiters = self._gang_waiting.setdefault(pod.pod_group, [])
+                # dedupe: a re-scheduled copy of an already-waiting member
+                # (e.g. a metadata update re-queued it) must REPLACE its
+                # entry, never inflate the quorum count
+                waiters[:] = [w for w in waiters if w[0].uid != pod.uid]
+                waiters.append((pod, node_name, state, snap))
+                pg = snap.pod_groups.get(pod.pod_group)
+                need = pg.min_member if pg else 1
+                waiting_uids = {w[0].uid for w in waiters}
+                bound = sum(
+                    1
+                    for q in snap.bound_pods
+                    if q.pod_group == pod.pod_group and q.uid not in waiting_uids
+                )
+                if len(waiters) + bound < need:
+                    return None  # waiting (assumed, not bound)
+                waiters = self._gang_waiting.pop(pod.pod_group)
             out = None
             for wpod, wnode, wstate, wsnap in waiters:
                 r = self._binding_cycle(wstate, wsnap, wpod, wnode, t0)
@@ -467,8 +475,10 @@ class Scheduler:
         WaitingPod.Reject fan-out (waiting_pods_map.go), and the CPU-path
         equivalent of the batch fixpoint revoking a failed group."""
         n = 0
-        for g, waiters in list(self._gang_waiting.items()):
-            del self._gang_waiting[g]
+        with self._gang_lock:
+            drained = list(self._gang_waiting.items())
+            self._gang_waiting.clear()
+        for g, waiters in drained:
             for wpod, _wnode, _s, _sn in waiters:
                 self.cache.forget(wpod.uid)
                 self.events.record(
