@@ -325,3 +325,61 @@ def test_irrelevant_assigned_pod_does_not_wake_spread_rejected():
     # a matching assigned pod event: Queue (skew inputs changed)
     store.add_pod(mk_pod("web-new", labels={"app": "web"}, node_name="z0-1"))
     assert "default/w" not in sched.queue._unschedulable
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_queueing_hints_never_change_outcomes(seed):
+    """QueueingHint callbacks may only SUPPRESS wakeups, never placements:
+    the same event-driven workload converges to identical final placements
+    with hints enabled and with hints disabled (leftover flush + backoff
+    guarantee liveness either way)."""
+    import random
+
+    rng_master = random.Random(900 + seed)
+    script = []  # replayable event script
+    for step in range(12):
+        r = rng_master.random()
+        if r < 0.5:
+            script.append(("pod", f"p{step}", rng_master.choice([200, 1500, 4500]),
+                           rng_master.choice(["web", "db"])))
+        elif r < 0.7:
+            script.append(("node", f"extra{step}", rng_master.choice([2000, 6000])))
+        elif r < 0.85:
+            script.append(("grow", rng_master.choice([0, 1]),
+                           rng_master.choice([4000, 8000])))
+        else:
+            script.append(("label", rng_master.choice([0, 1]), f"v{step}"))
+
+    def run(hints_enabled: bool):
+        clock = FakeClock()
+        store, sched = mk_cluster(
+            "cpu", nodes=[mk_node("n0", cpu=2000), mk_node("n1", cpu=500)],
+            clock=clock,
+        )
+        if not hints_enabled:
+            sched.framework.hints_for_plugins = lambda names: {}
+        for ev in script:
+            if ev[0] == "pod":
+                store.add_pod(mk_pod(ev[1], cpu=ev[2], labels={"app": ev[3]}))
+            elif ev[0] == "node":
+                store.add_node(mk_node(ev[1], cpu=ev[2]))
+            elif ev[0] == "grow":
+                name = f"n{ev[1]}"
+                nd = store.nodes[name]
+                grown = mk_node(name, cpu=ev[2])
+                grown.labels.update(nd.labels)
+                store.update_node(grown)
+            else:
+                name = f"n{ev[1]}"
+                nd = store.nodes[name]
+                relabeled = mk_node(name, cpu=nd.allocatable[t.CPU])
+                relabeled.labels = {**nd.labels, "team": ev[2]}
+                store.update_node(relabeled)
+            sched.run_until_idle(50)
+            clock.step(2.0)
+        for _ in range(6):  # drain through leftover flush + backoff
+            clock.step(400.0)
+            sched.run_until_idle(200)
+        return bound_map(store)
+
+    assert run(True) == run(False)
