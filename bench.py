@@ -71,6 +71,21 @@ def main() -> None:
         choices = np.asarray(schedule_batch(arr, cfg)[0])
         t_step = min(t_step, time.perf_counter() - t0)
 
+    # the pre-chunking per-pod scan, for the delta the chunked path buys
+    # (ops/assign.py — schedule_scan_chunked vs schedule_scan)
+    import jax as _jax
+
+    from kubernetes_tpu.ops.assign import schedule_scan as _plain
+
+    plain = _jax.jit(_plain, static_argnames=("cfg",))
+    t_plain = float("inf")
+    np.asarray(plain(arr, cfg)[0])  # compile
+    for _ in range(2):
+        t0 = time.perf_counter()
+        np.asarray(plain(arr, cfg)[0])
+        t_plain = min(t_plain, time.perf_counter() - t0)
+    print(f"per-pod (unchunked) scan step: {t_plain*1e3:.1f}ms", file=sys.stderr)
+
     # warm-cluster wave: the scheduled pods are now bound, a fresh 50k wave
     # arrives — the resident encoder absorbs the bind delta + encodes the wave
     bound = [
@@ -116,6 +131,7 @@ def main() -> None:
                 "encode_s": round(t_encode, 3),
                 "delta_s": round(t_delta, 3),
                 "step_s": round(t_step, 4),
+                "step_unchunked_s": round(t_plain, 4),
                 "end_to_end_s": round(end_to_end, 3),
                 "end_to_end_pods_per_sec": round(e2e_pods_per_sec, 1),
                 "scheduled": scheduled,

@@ -631,13 +631,19 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
                 port_ids.append(pid)
             return ru, su, tuple(port_ids)
 
+        # tight loop: a 50k-pod first-wave absorb runs this body 50k times on
+        # the steady-state encode path — locals for every hot attribute
+        wave_pop = cs.wave_uid_rep.pop
+        rb_get = cs.rep_bound_info.get
+        rb = cs.rep_bound_info
+        node_index = cs.node_index
+        records = cs.records
+        anti_l, pref_l = cs.bspec_anti, cs.bspec_pref
+        append = add_recs.append
         for q in new:
-            ent_wave = cs.wave_uid_rep.pop(q.uid, None)
-            orig = rep = None
-            if ent_wave is not None:
-                orig, rep = ent_wave
+            ent_wave = wave_pop(q.uid, None)
             if (
-                rep is not None
+                ent_wave is not None
                 and not q.pvcs
                 and not q.resource_claims
                 # The rep stands in for the bound copy only while every field
@@ -645,7 +651,7 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
                 # WAVE-TIME object's: pod labels are mutable metadata in the
                 # reference API (unlike the spec), so a label update racing
                 # the bind must not record a stale affinity contribution.
-                and bound_spec_fields_match(q, orig)
+                and bound_spec_fields_match(q, ent_wave[0])
             ):
                 # fast path: the pod was a recent wave's pending pod — its
                 # spec is the rep's; bind-absorb is O(1) lookups.
@@ -653,27 +659,28 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
                 # RESOLVED spec (api/volumes.resolve_pod) can change between
                 # pending and bound as PVC/PV state moves, so it must be
                 # recomputed from the current resolved object.
-                ent = cs.rep_bound_info.get(id(rep))
+                rep = ent_wave[1]
+                ent = rb_get(id(rep))
                 if ent is None or ent[0] is not rep:
                     # the entry VALUE holds the rep, so a live entry's id key
                     # can never alias a reallocated address; the `is` check
                     # guards the first insertion race all the same
                     ent = (rep, _spec_info(rep))
-                    cs.rep_bound_info[id(rep)] = ent
+                    rb[id(rep)] = ent
                 ru, su, port_ids = ent[1]
             else:
                 ru, su, port_ids = _spec_info(q)
             rec = (
-                cs.node_index[q.node_name],
+                node_index[q.node_name],
                 ru,
                 su,
                 port_ids,
-                cs.bspec_anti[su],
-                cs.bspec_pref[su],
+                anti_l[su],
+                pref_l[su],
                 q,
             )
-            cs.records[q.uid] = rec
-            add_recs.append(rec)
+            records[q.uid] = rec
+            append(rec)
         if fresh_specs and cs.terms_list:
             m_new = _match_matrix(cs.terms_list, fresh_specs)
             for j in range(len(fresh_specs)):
