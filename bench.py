@@ -73,11 +73,9 @@ def main() -> None:
 
     # the pre-chunking per-pod scan, for the delta the chunked path buys
     # (ops/assign.py — schedule_scan_chunked vs schedule_scan)
-    import jax as _jax
-
     from kubernetes_tpu.ops.assign import schedule_scan as _plain
 
-    plain = _jax.jit(_plain, static_argnames=("cfg",))
+    plain = jax.jit(_plain, static_argnames=("cfg",))
     t_plain = float("inf")
     np.asarray(plain(arr, cfg)[0])  # compile
     for _ in range(2):
