@@ -345,16 +345,22 @@ class TPUScoreServer:
 
 
 class HealthServer:
-    """component-base health + metrics endpoints: /healthz /readyz /livez
-    (apiserver/pkg/server/healthz) and a Prometheus-text /metrics —
-    "every binary serves /metrics, /healthz|readyz|livez" (SURVEY.md §5)."""
+    """component-base health + metrics + zpages endpoints: /healthz /readyz
+    /livez (apiserver/pkg/server/healthz), Prometheus-text /metrics, and the
+    zpages pair /statusz (component + uptime) and /flagz (effective
+    configuration) — "every binary serves /metrics, /healthz|readyz|livez"
+    plus component-base/zpages (SURVEY.md §5)."""
 
     def __init__(self, address: str = "127.0.0.1:0", metrics=None,
-                 ready_check=None):
+                 ready_check=None, component: str = "tpuscore-sidecar",
+                 flags=None):
         import http.server
 
         self.metrics = metrics
         self.ready_check = ready_check or (lambda: True)
+        self.component = component
+        self.flags = dict(flags or {})
+        self._started_at = time.time()
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -366,6 +372,19 @@ class HealthServer:
                     body, code = (b"ok", 200) if ok else (b"not ready", 503)
                 elif self.path == "/metrics":
                     body, code = outer._render_metrics().encode(), 200
+                elif self.path == "/statusz":
+                    up = time.time() - outer._started_at
+                    body = (
+                        f"{outer.component}\nstatus: "
+                        f"{'ok' if outer.ready_check() else 'not ready'}\n"
+                        f"uptime_seconds: {up:.1f}\n"
+                    ).encode()
+                    code = 200
+                elif self.path == "/flagz":
+                    body = "".join(
+                        f"{k}={v}\n" for k, v in sorted(outer.flags.items())
+                    ).encode() or b"(no flags)\n"
+                    code = 200
                 else:
                     body, code = b"not found", 404
                 self.send_response(code)

@@ -354,3 +354,27 @@ def test_session_ships_bound_pod_updates(server):
     assert got[w3[0].uid] != bound2[0].node_name
     client.close()
     stateless.close()
+
+
+def test_health_server_zpages():
+    """component-base zpages: /statusz (component + uptime) and /flagz
+    (effective config) alongside healthz/readyz/metrics."""
+    import urllib.request
+
+    from kubernetes_tpu.runtime.sidecar import HealthServer
+
+    hs = HealthServer(component="test-sidecar",
+                      flags={"listen": "127.0.0.1:0", "deadline_ms": 1000})
+    port = hs.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                return r.status, r.read().decode()
+        st, body = get("/statusz")
+        assert st == 200 and "test-sidecar" in body and "uptime_seconds" in body
+        st, body = get("/flagz")
+        assert st == 200 and "deadline_ms=1000" in body and "listen=" in body
+        st, _ = get("/healthz")
+        assert st == 200
+    finally:
+        hs.stop()
