@@ -61,6 +61,18 @@ KINDS: Dict[str, type] = {
     "Event": c.ClusterEvent,
     "ServiceAccount": c.ServiceAccount,
 }
+
+
+def _register_crd_kinds() -> None:
+    """CustomResourceDefinition joins the Scheme lazily (scheduler.crd imports
+    api modules; a top-level import here would be circular)."""
+    from ..scheduler.crd import CustomResourceDefinition
+
+    KINDS.setdefault("CustomResourceDefinition", CustomResourceDefinition)
+    _CLASS_TO_KIND.setdefault(CustomResourceDefinition, "CustomResourceDefinition")
+
+
+
 # aliases accepted on decode (the store's table name for PodDisruptionBudget)
 _KIND_ALIASES = {"PDB": "PodDisruptionBudget"}
 
@@ -74,6 +86,11 @@ class DecodeError(ValueError):
 def kind_of(obj: object) -> str:
     k = _CLASS_TO_KIND.get(type(obj))
     if k is None:
+        # dynamic kinds (CustomResource instances, the CRD object itself)
+        # carry their kind on the object — the unstructured path
+        k = getattr(obj, "kind", None)
+        if isinstance(k, str) and k:
+            return k
         raise DecodeError(f"{type(obj).__name__} is not a registered kind")
     return k
 
@@ -164,7 +181,12 @@ def _coerce(tp, val, path: str):
         return tuple(_coerce(a, v, f"{path}[{i}]")
                      for i, (a, v) in enumerate(zip(args, val)))
     if origin is dict:
-        kt, vt = get_args(tp)
+        args = get_args(tp)
+        if not args:  # bare Dict: free-form mapping (CRD structural schemas)
+            if not isinstance(val, dict):
+                raise DecodeError(f"{path}: expected mapping")
+            return dict(val)
+        kt, vt = args
         if not isinstance(val, dict):
             raise DecodeError(f"{path}: expected mapping")
         return {_coerce(kt, k, path): _coerce(vt, v, f"{path}.{k}")
@@ -190,14 +212,41 @@ def from_plain(cls: type, data: dict, path: str = ""):
 
 
 def from_manifest(doc: dict):
+    _register_crd_kinds()
     doc = dict(doc)
-    doc.pop("apiVersion", None)  # single-version scheme
+    api_version = doc.pop("apiVersion", None)  # single-version scheme
     kind = doc.pop("kind", None)
     if not kind:
         raise DecodeError("manifest document has no `kind`")
     kind = _KIND_ALIASES.get(kind, kind)
+    if kind == "CustomResourceDefinition" and "names" in doc:
+        # the manifest's top-level `kind` is the TYPE discriminator; the
+        # CRD's target kind/plural ride in the reference's names block
+        # (apiextensions/v1 — CustomResourceDefinitionNames)
+        names = dict(doc.pop("names") or {})
+        doc.setdefault("kind", names.get("kind", ""))
+        doc.setdefault("plural", names.get("plural", ""))
     cls = KINDS.get(kind)
     if cls is None:
+        # group-qualified apiVersion + unregistered kind = a custom resource:
+        # decode unstructured (apiextensions' Unstructured path); the server
+        # validates spec against the CRD's structural schema on write
+        if isinstance(api_version, str) and "/" in api_version:
+            from ..scheduler.crd import CustomResource
+
+            unknown = set(doc) - {"name", "namespace", "labels", "spec"}
+            if unknown:
+                raise DecodeError(
+                    f"unknown field(s) {sorted(unknown)} on custom kind {kind!r}"
+                )
+            return CustomResource(
+                api_version=api_version,
+                kind=kind,
+                name=doc.get("name", ""),
+                namespace=doc.get("namespace", "default"),
+                labels=dict(doc.get("labels") or {}),
+                spec=dict(doc.get("spec") or {}),
+            )
         raise DecodeError(f"unknown kind {kind!r}")
     return from_plain(cls, doc)
 

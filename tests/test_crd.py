@@ -176,3 +176,49 @@ def test_kubectl_discovers_custom_resources():
 
     with _pytest.raises(KubectlError, match="resource type"):
         kc.run("get flurbs")
+
+
+def test_crd_and_custom_resources_via_yaml_apply(tmp_path):
+    """The full CRD story through manifests: apply a CRD (reference names
+    block) then a custom resource from YAML; schema violations from YAML are
+    rejected with the structural path."""
+    from kubernetes_tpu.kubectl import Kubectl, KubectlError
+
+    store, srv = _admin_server()
+    kc = Kubectl(srv, token="admin")
+    crd_yaml = tmp_path / "crd.yaml"
+    crd_yaml.write_text(
+        """
+apiVersion: apiextensions.k8s.io/v1
+kind: CustomResourceDefinition
+group: scheduling.example.com
+names: {kind: TrainingJob, plural: trainingjobs}
+versions:
+  - name: v1
+    served: true
+    storage: true
+    schema:
+      type: object
+      required: [minMember]
+      properties:
+        minMember: {type: integer, minimum: 1}
+---
+apiVersion: scheduling.example.com/v1
+kind: TrainingJob
+name: tj-yaml
+spec: {minMember: 2}
+"""
+    )
+    kc.run(f"apply -f {crd_yaml}")
+    assert "tj-yaml" in kc.run("get trainingjobs")
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        """
+apiVersion: scheduling.example.com/v1
+kind: TrainingJob
+name: broken
+spec: {minMember: 0}
+"""
+    )
+    with pytest.raises(KubectlError, match="minimum"):
+        kc.run(f"apply -f {bad}")
