@@ -139,6 +139,7 @@ class APIServer:
         obj: object = None,
         namespace: str = "",
         name: str = "",
+        impersonate_user: Optional[str] = None,
     ):
         """One request through the full chain.  Returns the stored object for
         writes / the object (list) for reads."""
@@ -151,6 +152,21 @@ class APIServer:
         if user is None:
             self._audit("anonymous", verb, resource, ns, nm, False, "unauthenticated")
             raise Unauthenticated("invalid or missing bearer token")
+
+        # impersonation (the Impersonate-User header; chain position matches
+        # DefaultBuildHandlerChain: after authn, before audit/authz): the
+        # AUTHENTICATED user needs the `impersonate` verb on `users`, then
+        # the request proceeds as — and is audited as — the impersonated user
+        if impersonate_user is not None:
+            from ..api import cluster as c
+
+            if not self.authz.authorize(user, "impersonate", "users", "", impersonate_user):
+                self._audit(user.name, verb, resource, ns, nm, False,
+                            f"cannot impersonate {impersonate_user!r}")
+                raise Forbidden(
+                    f'user "{user.name}" cannot impersonate user "{impersonate_user}"'
+                )
+            user = c.UserInfo(name=impersonate_user, groups=())
 
         # priority & fairness: classify + fair-queue; in this synchronous
         # facade the request must come out of dispatch() before proceeding

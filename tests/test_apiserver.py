@@ -427,3 +427,30 @@ def test_hollow_kubelet_assigns_pod_ip_and_prunes_state():
     kubelet.tick()
     # no leak after deletion while Running: worker + runtime state pruned
     assert not kubelet.workers and not kubelet.runtime.containers
+
+
+def test_impersonation_requires_rbac_and_swaps_identity():
+    """DefaultBuildHandlerChain's impersonation filter: the authenticated
+    user needs `impersonate` on `users`; the request then runs (and audits)
+    as the impersonated identity."""
+    store = ClusterStore()
+    srv = APIServer(store)
+    srv.authn.add_token("admin", "admin", groups=("system:masters",))
+    srv.authn.add_token("eve", "eve")
+    # eve may NOT impersonate
+    with pytest.raises(Forbidden, match="impersonate"):
+        srv.handle("eve", "list", "Pod", namespace="default",
+                   impersonate_user="alice")
+    # grant alice pod access; admin impersonates alice (masters may do anything)
+    store.add_object("Role", c.Role(
+        name="reader", namespace="",
+        rules=(c.PolicyRule(verbs=("list",), resources=("pods",)),)))
+    bind_cluster_role(store, "alice-read", "reader", [("User", "alice")])
+    out = srv.handle("admin", "list", "Pod", namespace="default",
+                     impersonate_user="alice")
+    assert out == []
+    # the audit row carries the impersonated identity
+    assert srv.audit_log[-1].user == "alice"
+    # impersonated identity is NOT a master: unauthorized resources refused
+    with pytest.raises(Forbidden):
+        srv.handle("admin", "list", "Node", impersonate_user="alice")
