@@ -378,3 +378,27 @@ def test_health_server_zpages():
         assert st == 200
     finally:
         hs.stop()
+
+
+def test_session_node_change_forces_full_resync(server):
+    """A node-set change invalidates the session's cluster state: the client
+    transparently re-sends the FULL snapshot (nodes_fp conditioning) and
+    verdicts still match stateless."""
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    stateless = TPUScoreClient(f"127.0.0.1:{server.port}", session=False)
+    nodes = [mk_node(f"n{i}", cpu=4000) for i in range(3)]
+    client.schedule(Snapshot(nodes=nodes, pending_pods=_wave(3, "a")),
+                    deadline_ms=60_000)
+    client.schedule(Snapshot(nodes=nodes, pending_pods=_wave(3, "b")),
+                    deadline_ms=60_000)
+    assert client.stats["delta"] == 1
+    nodes2 = nodes + [mk_node("n-new", cpu=9000)]
+    snap = Snapshot(nodes=nodes2, pending_pods=_wave(3, "c", cpu=5000))
+    got = client.schedule(snap, deadline_ms=60_000)
+    want = stateless.schedule(snap, deadline_ms=60_000)
+    assert got == want
+    assert client.stats["full"] == 2  # the node change forced a full sync
+    # big pods only fit the new node — proves the new node reached the session
+    assert all(v == "n-new" for v in got.values() if v)
+    client.close()
+    stateless.close()
