@@ -182,3 +182,25 @@ def test_chunked_heterogeneous_with_hard_taints_matches_plain():
     plain = np.asarray(jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0])
     routed = np.asarray(schedule_batch(arr, cfg)[0])
     np.testing.assert_array_equal(routed, plain)
+
+
+def test_chunked_scan_tie_breaks_match_plain_on_identical_nodes():
+    """Identical nodes + identical pods = a score TIE at every step, with the
+    tying nodes alternating between touched (corrected) and untouched
+    (hoisted) entries — the worst case for the chunked argmax/tie-break."""
+    import jax
+
+    from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+    from kubernetes_tpu.ops.assign import _chunkable, schedule_scan
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    nodes = [mk_node(f"n{i}", cpu=8000, pods=200) for i in range(4)]
+    pods = [mk_pod(f"p{i}", cpu=100) for i in range(160)]
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg)
+    plain = np.asarray(jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0])
+    routed = np.asarray(schedule_batch(arr, cfg)[0])
+    np.testing.assert_array_equal(routed, plain)
+    assert_parity(snap)
