@@ -79,7 +79,7 @@ class TPUScoreClient:
 
     # --- request builders ---
     def _wave_msg(self, pods) -> pb.InternedWave:
-        """wave_to_proto through the client-resident interner: per-template
+        """The spec-interned wave message: per-template
         canonical keying AND pb.Pod serialization happen once, not per cycle
         (steady-state waves re-send only uids + spec indices)."""
         reps, inv, rep_keys = self._interner.group(pods)

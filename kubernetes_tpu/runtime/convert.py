@@ -109,21 +109,6 @@ def node_to_proto(n: t.Node) -> pb.Node:
     )
 
 
-def wave_to_proto(pods: List[t.Pod]) -> pb.InternedWave:
-    """Spec-interned pending wave: each unique spec serialized ONCE, the wave
-    itself as parallel (uid, spec-index) arrays.  Steady-state waves stamped
-    from a few workload templates collapse from O(P) Pod messages to O(specs)
-    messages + two flat arrays — the wire analog of api/snapshot.py —
-    group_by_spec."""
-    from ..api.snapshot import group_by_spec
-
-    reps, inv = group_by_spec(pods)
-    msg = pb.InternedWave(specs=[pod_to_proto(r) for r in reps])
-    msg.uids.extend(p.uid for p in pods)
-    msg.spec_idx.extend(inv.tolist())
-    return msg
-
-
 def wave_from_proto(msg: pb.InternedWave) -> List[t.Pod]:
     """Pod names are synthesized from uids (the session path keys verdicts by
     wave position, never by name).  copy.copy skips dataclass re-init — the
