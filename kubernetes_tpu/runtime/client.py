@@ -69,6 +69,7 @@ class TPUScoreClient:
         self._nodes_fp: Optional[Tuple] = None
         self._last_wave: Dict[str, t.Pod] = {}
         self._known_bound: Dict[str, t.Pod] = {}
+        self._fp_refs: Tuple = ()
         self.stats = {"full": 0, "delta": 0, "resync": 0, "not_ready": 0}
 
     def health(self, timeout_s: float = 2.0) -> pb.HealthResponse:
@@ -172,11 +173,22 @@ class TPUScoreClient:
         """-> pod uid -> node name (None = unschedulable).  Raises
         SidecarUnavailable on deadline/transport failure or a still-compiling
         sidecar (caller falls back)."""
+        from ..api.delta import _storage_fp
+        from ..api.volumes import resolve_snapshot
+
+        # fingerprint the RAW cluster (resolution rebuilds node objects per
+        # cycle whenever volume/DRA state exists — the same pre-resolution
+        # conditioning the delta encoder uses), then resolve for the wire
+        nodes_fp = (
+            tuple((nd.name, id(nd)) for nd in snap.nodes),
+            _storage_fp(snap),
+        )
+        raw_refs = (list(snap.nodes), list(snap.pvs), dict(snap.pvcs))
+        snap = resolve_snapshot(snap)
         if not self.session_id:
             return self._schedule_stateless(
                 snap, deadline_ms, gang, hard_pod_affinity_weight
             )
-        nodes_fp = tuple((nd.name, id(nd)) for nd in snap.nodes)
         self._epoch += 1
         if self._synced and nodes_fp == self._nodes_fp:
             req = self._delta_request(
@@ -208,6 +220,7 @@ class TPUScoreClient:
         # not_ready — record it so the next cycle's diff is correct
         self._synced = True
         self._nodes_fp = nodes_fp
+        self._fp_refs = raw_refs  # keep fingerprinted objects alive (id reuse)
         self._last_wave = {p.uid: p for p in snap.pending_pods}
         self._known_bound = {p.uid: p for p in snap.bound_pods}
         if resp.not_ready:

@@ -545,16 +545,15 @@ class Scheduler:
             # mandated CPU fallback (per-pod plugin path)
             from ..runtime import SidecarUnavailable, TPUScoreClient
 
-            from ..api.volumes import resolve_snapshot
-
             try:
                 if self._sidecar is None:
                     self._sidecar = TPUScoreClient(prof.tpu_score.sidecar_address)
-                # resolve BEFORE transmit: volume/DRA constraints fold into
-                # plain requests + affinity, which the wire format carries —
-                # the sidecar needs no PV/PVC/StorageClass/slice schema
+                # the RAW snapshot goes to the client: it fingerprints raw
+                # node identity + storage state for its session delta, THEN
+                # resolves volume/DRA constraints into plain requests +
+                # affinity for the wire (which carries no PV/PVC schema)
                 verdicts = self._sidecar.schedule(
-                    resolve_snapshot(snap),
+                    snap,
                     deadline_ms=prof.tpu_score.deadline_ms,
                     gang=gang,
                     hard_pod_affinity_weight=prof.hard_pod_affinity_weight,

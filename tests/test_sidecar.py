@@ -402,3 +402,37 @@ def test_session_node_change_forces_full_resync(server):
     assert all(v == "n-new" for v in got.values() if v)
     client.close()
     stateless.close()
+
+
+def test_session_deltas_survive_volume_state(server):
+    """Volume clusters must keep session deltas: the client fingerprints the
+    RAW node set + storage state (resolution rebuilds node objects per cycle),
+    so stable PVC state stays on the delta path; a PVC change resyncs."""
+    import dataclasses
+
+    pvc = t.PersistentVolumeClaim(name="claim", request=1,
+                                  wait_for_first_consumer=True)
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    import dataclasses as _dc
+
+    nodes = []
+    for i in range(4):
+        nd = mk_node(f"n{i}", cpu=4000)
+        nd.volume_attach_limit = 8
+        nodes.append(nd)
+    for cycle in range(3):
+        wave = _wave(3, f"v{cycle}")
+        wave.append(dataclasses.replace(
+            mk_pod(f"vol-{cycle}", cpu=100), pvcs=("claim",)))
+        snap = Snapshot(nodes=nodes, pending_pods=wave,
+                        pvcs={pvc.key: pvc})
+        v = client.schedule(snap, deadline_ms=60_000)
+        assert any(v.values())
+    assert client.stats["full"] == 1 and client.stats["delta"] == 2, client.stats
+    # PVC state change -> storage fingerprint mismatch -> full sync
+    pvc2 = dataclasses.replace(pvc, request=2)
+    snap = Snapshot(nodes=nodes, pending_pods=_wave(2, "after"),
+                    pvcs={pvc2.key: pvc2})
+    client.schedule(snap, deadline_ms=60_000)
+    assert client.stats["full"] == 2, client.stats
+    client.close()
