@@ -230,6 +230,23 @@ def _nodes_fp(nodes: Sequence[t.Node]) -> Tuple:
     return tuple((nd.name, id(nd)) for nd in nodes)
 
 
+def raw_fingerprints(snap) -> Tuple:
+    """(raw_nodes_fp, storage_fp) — the PRE-resolution cache conditioning,
+    shared by the encoder and the sidecar client so the two cannot drift."""
+    return (_nodes_fp(snap.nodes), _storage_fp(snap))
+
+
+def raw_keepalive_refs(snap) -> Tuple:
+    """Containers pinning every object the raw fingerprints id() — build
+    ONLY when (re)synchronizing, never on steady-state cycles (copying a
+    20k-node list per cycle is measurable host time)."""
+    return (
+        list(snap.nodes), list(snap.pvs), dict(snap.pvcs),
+        dict(snap.storage_classes), list(snap.resource_slices),
+        dict(snap.device_classes),
+    )
+
+
 def _storage_fp(snap) -> Tuple:
     """Identity fingerprint of every input volumes.resolve_snapshot reads
     beyond nodes/pods: PVs, PVCs, StorageClasses, ResourceSlices,
@@ -798,8 +815,7 @@ class DeltaEncoder:
         from .snapshot import _resource_axis, activeq_order
         from .volumes import resolve_snapshot
 
-        raw_nodes_fp = _nodes_fp(snap.nodes)
-        storage_fp = _storage_fp(snap)
+        raw_nodes_fp, storage_fp = raw_fingerprints(snap)
         raw_snap = snap  # rebuilds capture keep-alive refs from the raw snap
         snap = resolve_snapshot(snap)
         pending = snap.pending_pods
@@ -832,11 +848,7 @@ class DeltaEncoder:
             cs.storage_fp = storage_fp
             # keep-alive refs for every id() the fingerprints hold (built only
             # here — steady-state delta cycles must not copy 20k-element lists)
-            cs.raw_refs = (
-                list(raw_snap.nodes), list(raw_snap.pvs), dict(raw_snap.pvcs),
-                dict(raw_snap.storage_classes), list(raw_snap.resource_slices),
-                dict(raw_snap.device_classes),
-            )
+            cs.raw_refs = raw_keepalive_refs(raw_snap)
             cs.stats["rebuilds"] += 1
             self._cs = cs
             self.stats["full"] += 1

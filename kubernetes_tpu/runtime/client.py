@@ -173,22 +173,20 @@ class TPUScoreClient:
         """-> pod uid -> node name (None = unschedulable).  Raises
         SidecarUnavailable on deadline/transport failure or a still-compiling
         sidecar (caller falls back)."""
-        from ..api.delta import _storage_fp
+        from ..api.delta import raw_fingerprints, raw_keepalive_refs
         from ..api.volumes import resolve_snapshot
 
-        # fingerprint the RAW cluster (resolution rebuilds node objects per
-        # cycle whenever volume/DRA state exists — the same pre-resolution
-        # conditioning the delta encoder uses), then resolve for the wire
-        nodes_fp = (
-            tuple((nd.name, id(nd)) for nd in snap.nodes),
-            _storage_fp(snap),
-        )
-        raw_refs = (list(snap.nodes), list(snap.pvs), dict(snap.pvcs))
-        snap = resolve_snapshot(snap)
         if not self.session_id:
             return self._schedule_stateless(
-                snap, deadline_ms, gang, hard_pod_affinity_weight
+                resolve_snapshot(snap), deadline_ms, gang,
+                hard_pod_affinity_weight,
             )
+        # fingerprint the RAW cluster (resolution rebuilds node objects per
+        # cycle whenever volume/DRA state exists) with the SAME helpers the
+        # delta encoder conditions on, then resolve for the wire
+        nodes_fp = raw_fingerprints(snap)
+        raw_snap = snap
+        snap = resolve_snapshot(snap)
         self._epoch += 1
         if self._synced and nodes_fp == self._nodes_fp:
             req = self._delta_request(
@@ -219,8 +217,12 @@ class TPUScoreClient:
         # the server applied this request's state even when answering
         # not_ready — record it so the next cycle's diff is correct
         self._synced = True
-        self._nodes_fp = nodes_fp
-        self._fp_refs = raw_refs  # keep fingerprinted objects alive (id reuse)
+        if nodes_fp != self._nodes_fp:
+            # (re)synchronized against a new raw state: pin every object the
+            # fingerprints id() so address reuse can never alias them; on
+            # matching cycles the existing refs already pin the same objects
+            self._fp_refs = raw_keepalive_refs(raw_snap)
+            self._nodes_fp = nodes_fp
         self._last_wave = {p.uid: p for p in snap.pending_pods}
         self._known_bound = {p.uid: p for p in snap.bound_pods}
         if resp.not_ready:

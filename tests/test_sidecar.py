@@ -413,8 +413,6 @@ def test_session_deltas_survive_volume_state(server):
     pvc = t.PersistentVolumeClaim(name="claim", request=1,
                                   wait_for_first_consumer=True)
     client = TPUScoreClient(f"127.0.0.1:{server.port}")
-    import dataclasses as _dc
-
     nodes = []
     for i in range(4):
         nd = mk_node(f"n{i}", cpu=4000)
