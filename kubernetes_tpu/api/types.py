@@ -273,6 +273,8 @@ class Node:
 
     def __post_init__(self) -> None:
         self.labels.setdefault(LABEL_HOSTNAME, self.name)
+        if type(self.taints) is not tuple:  # boundary normalization
+            self.taints = tuple(self.taints)
 
 
 @dataclass(frozen=True)
@@ -347,6 +349,37 @@ class Pod:
     def __post_init__(self) -> None:
         if not self.uid:
             self.uid = f"{self.namespace}/{self.name}"
+        # Boundary normalization (the analog of apimachinery defaulting):
+        # callers naturally pass lists / a dict nodeSelector; the encoder's
+        # spec interner hashes these fields, so coerce them to the declared
+        # tuple forms here rather than failing deep inside encode_snapshot.
+        for f in (
+            "tolerations", "topology_spread",
+            "scheduling_gates", "images", "pvcs", "resource_claims",
+            "owner_references",
+        ):
+            v = getattr(self, f)
+            if type(v) is not tuple:
+                setattr(self, f, tuple(v))
+        # pair-valued fields coerce their inner pairs too (a list of
+        # ["TCP", 80] pairs must hash); a dict nodeSelector sorts for a
+        # canonical key
+        if isinstance(self.node_selector, dict):
+            self.node_selector = tuple(sorted(self.node_selector.items()))
+        elif type(self.node_selector) is not tuple or any(
+            type(kv) is not tuple for kv in self.node_selector
+        ):
+            self.node_selector = tuple(
+                kv if type(kv) is tuple else tuple(kv)
+                for kv in self.node_selector
+            )
+        if type(self.host_ports) is not tuple or any(
+            type(pp) is not tuple for pp in self.host_ports
+        ):
+            self.host_ports = tuple(
+                pp if type(pp) is tuple else tuple(pp)
+                for pp in self.host_ports
+            )
 
 
 @dataclass(frozen=True)

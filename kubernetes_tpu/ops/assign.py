@@ -248,6 +248,8 @@ def schedule_scan(
 
 
 _CHUNK = 128  # pods per chunk on the chunked path (buckets are multiples)
+_SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
+_SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 
 
 def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
@@ -266,18 +268,55 @@ def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
     )
 
 
-def schedule_scan_chunked(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
-    """Chunked sequential-commit scan, BIT-IDENTICAL to schedule_scan for
-    fit+balanced-only configs (tests/test_assign_parity.py — chunked case).
+def schedule_scan_chunked(
+    arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False
+):
+    """Chunked sequential-commit scan via PREFIX-COMMIT SPECULATION rounds,
+    BIT-IDENTICAL to schedule_scan for fit+balanced-only configs
+    (tests/test_assign_parity.py — chunked cases).
 
-    The per-pod scan pays ~10us/step of [N]-wide work at 20k nodes; here each
-    CHUNK of pods hoists its dense candidate scores [C, N] against the
-    chunk-start usage ONCE (MXU-friendly), and the inner commit scan touches
-    only [C]-sized slot state: a pod's true score differs from the hoisted
-    row exactly at nodes other chunk members committed to (at most C of
-    them), so each step rewrites those few entries and re-argmaxes.  Exact
-    because fit/least/balanced depend on per-node usage only — there are no
-    cross-node normalizations on this path."""
+    The per-pod scan's latency floor is the sequential step count: ~3us of
+    on-device loop overhead per `lax.scan` step x 50k pods =~ the whole
+    budget, regardless of per-step width (measured on v5e).  This path
+    replaces the per-pod loop with a small number of vectorized ROUNDS:
+
+      - each CHUNK of C pods hoists dense scores [C, N] against chunk-start
+        usage once (MXU/VPU-batched) and keeps the top K=C+1 candidates per
+        pod (`lax.top_k`: values desc, ties to the lower index — the same
+        tie-break as the deterministic selectHost mode);
+      - a `lax.while_loop` of rounds then (1) SPECULATES a choice for every
+        uncommitted pod, (2) REVALIDATES each choice exactly under the
+        cumulative intra-round usage of earlier pods' picks, and (3) commits
+        the longest prefix whose revalidated choice is unchanged.  The first
+        uncommitted pod is always exact, so every round commits >= 1 pod.
+
+    Speculation (pass 1) exploits the plateau structure of the score
+    landscape: one placement generically drops a node off its tied-score
+    plateau, so pods sharing a plateau head are seeded with SUCCESSIVE
+    usable list entries (rank within same-head group), then a few
+    fixed-point iterations advance pointers past cross-group collisions.
+    A wrong guess only shortens the committed prefix — validation (pass 2)
+    recomputes the true argmax from exactly-rescored candidates.
+
+    Validation candidates per pod i: (a) chunk-dirty nodes (committed in
+    previous rounds; <= C of them, tracked in `dlist` with their live usage
+    in `dsu`), rescored with the same float32 formulas as the hoist;
+    (b) nodes picked intra-round by pods j < i, rescored under round-start
+    usage plus an exclusive int32 prefix sum of earlier picks' requests
+    (same adds, same order as the sequential scan — exact); (c) the first
+    top-K entry that is neither dirty nor intra-round-picked — which
+    dominates every untouched node on both score and the lowest-index
+    tie-break, because top_k keeps the lowest-indexed ties and anything
+    outside the list scores <= the last list entry.  Fit is monotone (usage
+    only grows), so a -inf hoisted entry stays infeasible and static
+    feasibility can be read off total0.
+
+    The while-loop carry is deliberately O(C)-sized (slot usage, clean-list
+    flags) — carrying [N]-shaped state through a while_loop costs ~65us per
+    iteration on v5e regardless of the body.  Node usage [N, R] is updated
+    once per chunk from the committed choices.  Exact because fit/least/
+    balanced depend on per-node usage only — there are no cross-node
+    normalizations on this path."""
     local_n = arr.N
     my_nodes = jnp.arange(local_n, dtype=jnp.int32)
 
@@ -295,15 +334,36 @@ def schedule_scan_chunked(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Arr
     n_alloc = arr.node_alloc
     P, N, R = arr.P, arr.N, arr.R
     C = _CHUNK
+    K = min(C + 1, N)  # K == N: the list is exhaustive, guarded by .any()
+    Z = min(_SPECZ, K)  # usable entries precomputed for pass-1 speculation
     res = cfg.score_resources
     neg_inf = -jnp.inf
+    idxC = jnp.arange(C, dtype=jnp.int32)
+    jlt = idxC[None, :] < idxC[:, None]  # [i, j]: j < i
 
     reqs = arr.pod_req.reshape(P // C, C, R)
     sfs = sf.reshape(P // C, C, N)
     valids = arr.pod_valid.reshape(P // C, C)
 
-    def chunk(used0, xs):
+    def score_flat(requested, alloc):
+        """Same formulas as the dense hoist, on flattened [*, R] rows —
+        elementwise ops, so float32 results are bit-identical."""
+        return cfg.fit_weight * least_allocated(
+            requested, alloc, res
+        ) + cfg.balanced_weight * balanced_allocation(requested, alloc, res)
+
+    def best_and_cand(vals, nodes, vu, iu):
+        """Max score + lowest-node-index tie-break over per-pod candidate
+        rows [C, D] plus the clean list head (vu, iu) per pod."""
+        bd = vals.max(axis=1)
+        best = jnp.maximum(bd, vu)
+        cd = jnp.where(vals == best[:, None], nodes, _INT_MAX).min(axis=1)
+        cand = jnp.minimum(cd, jnp.where(vu == best, iu, _INT_MAX))
+        return best, cand
+
+    def chunk(used_in, xs):
         creq, csf, cvalid = xs
+        used0 = used_in
         # hoisted dense scores vs chunk-start usage (vmap = the per-step ops
         # batched, so float32 results are bit-identical to the plain scan)
         requested = used0[None, :, :] + creq[:, None, :]  # [C, N, R]
@@ -314,54 +374,194 @@ def schedule_scan_chunked(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Arr
             balanced_allocation, (0, None, None)
         )(requested, n_alloc, res)
         total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, N]
+        topv, topi = lax.top_k(total0, K)  # [C, K] each
+        # row-major transpose: [C, D] static-feasibility lookups below become
+        # contiguous row gathers instead of strided column gathers
+        total0_T = total0.T  # [N, C]
+        req_b = creq[:, None, :]  # [C(pod), 1, R]
 
-        def step(st, xs2):
-            tids, tused, talloc = st  # [C], [C, R], [C, R]
-            req_i, row0, sf_row, valid_i, slot_i = xs2
-            live = tids >= 0
-            # corrected score at touched nodes (same formulas on [C, R] rows)
-            requested_t = tused + req_i[None, :]
-            fit_t = jnp.all(
-                (req_i[None, :] == 0) | (req_i[None, :] <= talloc - tused), axis=1
+        def rescore(node_ids, node_usage):
+            """Exact scores of every pod [C] at nodes node_ids [D] under
+            node_usage [D, R]: (fit bool[C, D], value f32[C, D], static
+            feasibility bool[C, D])."""
+            da = n_alloc[node_ids]  # [D, R]
+            fit = jax.vmap(filters.fit_ok, (0, None, None))(
+                creq, node_usage, da
+            )  # [C, D]
+            reqd = node_usage[None] + req_b  # [C, D, R]
+            shape = reqd.shape
+            vals = score_flat(
+                reqd.reshape(-1, R),
+                jnp.broadcast_to(da[None], shape).reshape(-1, R),
+            ).reshape(shape[0], shape[1])
+            static = total0_T[node_ids].T > neg_inf  # [C, D]
+            return fit, vals, static
+
+        def round_body(st):
+            committed, out, cleank, dlist, dsu, nd, nrounds = st
+            unc = ~committed
+            # ---- pass 1: speculative choices vs live usage ----
+            dn = jnp.maximum(dlist, 0)
+            dvalid = dlist >= 0
+            dfit, dvals, dstat = rescore(dn, dsu)
+            M2 = jnp.where(dvalid[None] & dstat & dfit, dvals, neg_inf)
+            usablek = cleank & (topv > neg_inf)
+            ukey = jnp.where(usablek, K - jnp.arange(K, dtype=jnp.int32), 0)
+            _, upos = lax.top_k(ukey, Z)  # first Z usable positions
+            uok = jnp.take_along_axis(ukey, upos, 1) > 0  # [C, Z]
+            head = jnp.take_along_axis(topi, upos[:, :1], 1)[:, 0]  # [C]
+            have0 = uok[:, 0]
+            # seed: rank among earlier uncommitted pods with the same head
+            # (same-spec pods share whole lists; they take successive
+            # entries), then advance pointers past cross-group collisions
+            same_head = (
+                (head[:, None] == head[None, :]) & have0[None, :] & unc[None, :]
             )
-            sc_t = cfg.fit_weight * least_allocated(
-                requested_t, talloc, res
-            ) + cfg.balanced_weight * balanced_allocation(requested_t, talloc, res)
-            ok_t = live & fit_t & sf_row[jnp.maximum(tids, 0)]
-            val_t = jnp.where(ok_t, sc_t, neg_inf)
-            # overwrite the touched entries of the hoisted row (dead slots
-            # scatter out of bounds and are dropped)
-            row = row0.at[jnp.where(live, tids, N)].set(val_t, mode="drop")
-            best = row.max()
-            cand = jnp.where(row == best, my_nodes, _INT_MAX)
-            schedulable = (best > neg_inf) & valid_i
-            choice = jnp.where(schedulable, cand.min().astype(jnp.int32), -1)
-            # commit: add to the existing slot, or open THIS step's own slot
-            exists = live & (tids == choice)
-            placed = choice >= 0
-            tused = tused + (exists & placed)[:, None] * req_i[None, :]
-            new_here = placed & ~exists.any()
-            mine = (jnp.arange(C, dtype=jnp.int32) == slot_i) & new_here
-            cc = jnp.maximum(choice, 0)
-            tids = jnp.where(mine, choice, tids)
-            tused = jnp.where(mine[:, None], (used0[cc] + req_i)[None, :], tused)
-            talloc = jnp.where(mine[:, None], n_alloc[cc][None, :], talloc)
-            return (tids, tused, talloc), choice
+            ptr = jnp.minimum(
+                (same_head & jlt).sum(axis=1).astype(jnp.int32), Z - 1
+            )
+            # jump-to-first-unclaimed iterations: each pod claims its
+            # pointed entry; pods whose entry is claimed by an earlier pod
+            # jump to their first entry claimed by no earlier pod.  The
+            # rank seed already disperses same-head (same-spec) groups, so
+            # a couple of iterations settle cross-group collision chains.
+            nodes_z = jnp.take_along_axis(topi, upos, 1)  # [C, Z]
+            okr = jnp.take_along_axis(uok, ptr[:, None], 1)[:, 0] & unc
+            for _ in range(_SPEC_ITERS):
+                claim = jnp.where(
+                    okr,
+                    jnp.take_along_axis(nodes_z, ptr[:, None], 1)[:, 0],
+                    -1,
+                )
+                cb = (
+                    (nodes_z[:, :, None] == claim[None, None, :])
+                    & jlt[:, None, :]
+                ).any(axis=2)
+                free = uok & ~cb
+                has = free.any(axis=1)
+                ptr = jnp.where(has, jnp.argmax(free, axis=1), Z - 1)
+                okr = has & unc
+            sel = jnp.take_along_axis(upos, ptr[:, None], 1)[:, 0]
+            vu = jnp.where(
+                okr, jnp.take_along_axis(topv, sel[:, None], 1)[:, 0], neg_inf
+            )
+            iu = jnp.take_along_axis(topi, sel[:, None], 1)[:, 0]
+            best1, cand1 = best_and_cand(
+                M2, jnp.broadcast_to(dn[None], (C, C)), vu, iu
+            )
+            c = jnp.where(
+                (best1 > neg_inf) & unc & cvalid, cand1.astype(jnp.int32), -1
+            )
+            # ---- pass 2: revalidate under intra-round prefix commits ----
+            act = unc & (c >= 0)
+            cn = jnp.maximum(c, 0)
+            # cumulative usage each pod i sees at node c_j from pods k < i
+            # (exclusive int32 prefix sum == the adds the per-pod scan
+            # performs, in the same order — exact; log-depth associative
+            # scan, jnp.cumsum lowers to O(C^2) reduce_window on TPU)
+            E = (c[:, None] == c[None, :]) & act[:, None]  # [C(k), C(j)]
+            T = E[:, :, None] * creq[:, None, :]  # [C, C, R]
+            cum = lax.associative_scan(jnp.add, T, axis=0) - T
+            # round-start usage at c_j: dirty nodes live in dsu, clean nodes
+            # are untouched since chunk start
+            eqd = (c[:, None] == dlist[None, :]) & dvalid[None, :]  # [C, C]
+            hasslot = eqd.any(axis=1)
+            sl = jnp.argmax(eqd, axis=1)
+            cu = jnp.where(hasslot[:, None], dsu[sl], used0[cn])  # [C, R]
+            ca = n_alloc[cn]
+            cstat = total0_T[cn].T > neg_inf  # [C, C]
+            uij = cu[None] + cum  # [C, C, R]
+            # fit of pod i at node c_j under its intra-round usage uij[i, j]
+            fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
+            reqij = uij + req_b
+            shape = reqij.shape
+            vij = score_flat(
+                reqij.reshape(-1, R),
+                jnp.broadcast_to(ca[None], shape).reshape(-1, R),
+            ).reshape(C, C)
+            Mij = jnp.where(act[None, :] & jlt & cstat & fitij, vij, neg_inf)
+            # dirty nodes picked intra-round before i: superseded by Mij.
+            # prefix-any over j < i as a [C,C]x[C,C] bool matmul (MXU)
+            D2 = (dlist[None, :] == c[:, None]) & act[:, None] & dvalid[None, :]
+            excl2 = (
+                jlt.astype(jnp.float32) @ D2.astype(jnp.float32)
+            ) > 0.0  # [C(i), C(d)]
+            M2x = jnp.where(excl2, neg_inf, M2)
+            # list entries picked intra-round: one [C, K, C] compare, two
+            # masked reductions (also reused for the cleank carry update)
+            cmp = topi[:, :, None] == c[None, None, :]  # [C, K, C(j)]
+            chosen_before = (cmp & (jlt & act[None, :])[:, None, :]).any(2)
+            cleank2 = cleank & ~chosen_before
+            jf2 = jnp.argmax(cleank2, axis=1)
+            vu2 = jnp.where(
+                cleank2.any(axis=1),
+                jnp.take_along_axis(topv, jf2[:, None], 1)[:, 0],
+                neg_inf,
+            )
+            iu2 = jnp.take_along_axis(topi, jf2[:, None], 1)[:, 0]
+            vals_all = jnp.concatenate([M2x, Mij], axis=1)  # [C, 2C]
+            nodes_all = jnp.concatenate(
+                [
+                    jnp.broadcast_to(dn[None], (C, C)),
+                    jnp.broadcast_to(cn[None], (C, C)),
+                ],
+                axis=1,
+            )
+            best2, cand2 = best_and_cand(vals_all, nodes_all, vu2, iu2)
+            t = jnp.where(
+                (best2 > neg_inf) & unc & cvalid, cand2.astype(jnp.int32), -1
+            )
+            # ---- commit the longest exact prefix ----
+            bad = unc & (t != c)
+            firstbad = jnp.where(bad.any(), jnp.argmax(bad), C).astype(
+                jnp.int32
+            )
+            prefix = unc & (idxC < firstbad)
+            pact = prefix & (c >= 0)
+            out = jnp.where(prefix, c, out)
+            committed = committed | prefix
+            # stale list entries: nodes picked by the committed prefix
+            cleank = cleank & ~(cmp & pact[None, None, :]).any(2)
+            # per-node committed adds this round (sum over the prefix's
+            # pods; one add per node — int32, exact)
+            Epact = E & pact[:, None]  # [C(k), C(j)]
+            adds = (Epact[:, :, None] * creq[:, None, :]).sum(axis=0)  # [C,R]
+            minpos = jnp.where(Epact, idxC[:, None], C).min(axis=0)  # [C(j)]
+            owner = pact & (minpos == idxC)  # first chooser of its node
+            is_new = owner & ~hasslot
+            rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+            newpos = jnp.where(is_new, nd + rank, C)
+            dlist = dlist.at[newpos].set(c, mode="drop")
+            dsu = dsu.at[newpos].set(used0[cn] + adds, mode="drop")
+            dsu = dsu.at[jnp.where(owner & hasslot, sl, C)].add(
+                adds, mode="drop"
+            )
+            nd = nd + is_new.sum().astype(jnp.int32)
+            return committed, out, cleank, dlist, dsu, nd, nrounds + 1
 
         st0 = (
+            jnp.zeros(C, dtype=jnp.bool_),
+            jnp.full(C, -1, dtype=jnp.int32),
+            jnp.ones((C, K), dtype=jnp.bool_),
             jnp.full(C, -1, dtype=jnp.int32),
             jnp.zeros((C, R), dtype=used0.dtype),
-            jnp.ones((C, R), dtype=used0.dtype),
+            jnp.int32(0),
+            jnp.int32(0),
         )
-        xs2 = (creq, total0, csf, cvalid, jnp.arange(C, dtype=jnp.int32))
-        _, choices_c = lax.scan(step, st0, xs2)
-        placed = (choices_c >= 0)[:, None]
-        used0 = used0.at[jnp.maximum(choices_c, 0)].add(
-            placed * creq, mode="drop"
+        committed, out, _, _, _, _, nrounds = lax.while_loop(
+            lambda st: ~st[0].all(), round_body, st0
         )
-        return used0, choices_c
+        placed = (out >= 0)[:, None]
+        used_out = used0.at[jnp.where(out >= 0, out, N)].add(
+            jnp.where(placed, creq, 0), mode="drop"
+        )
+        return used_out, (out, nrounds)
 
-    used_final, choices = lax.scan(chunk, arr.node_used, (reqs, sfs, valids))
+    used_final, (choices, rounds) = lax.scan(
+        chunk, arr.node_used, (reqs, sfs, valids)
+    )
+    if with_rounds:
+        return choices.reshape(P), used_final, rounds
     return choices.reshape(P), used_final
 
 

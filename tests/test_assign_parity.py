@@ -204,3 +204,76 @@ def test_chunked_scan_tie_breaks_match_plain_on_identical_nodes():
     routed = np.asarray(schedule_batch(arr, cfg)[0])
     np.testing.assert_array_equal(routed, plain)
     assert_parity(snap)
+
+
+def test_chunked_scan_with_rounds_diagnostic():
+    """`with_rounds=True` (bound BEFORE jit, e.g. via functools.partial — it
+    selects the return arity at trace time) reports the per-chunk round count
+    of the prefix-commit speculation loop without changing decisions.  Every
+    chunk commits >= 1 pod per round, so rounds are in [1, C]."""
+    from functools import partial
+
+    import jax
+
+    from kubernetes_tpu.api.snapshot import encode_snapshot as _enc
+    from kubernetes_tpu.ops.assign import (
+        _CHUNK,
+        _chunkable,
+        schedule_scan_chunked,
+    )
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    rng = random.Random(11)
+    snap = random_cluster(rng, n_nodes=6, n_pods=256, with_taints=False,
+                          with_selectors=True, with_pairwise=False)
+    arr, meta = _enc(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg)
+    f = jax.jit(
+        partial(schedule_scan_chunked, with_rounds=True),
+        static_argnames=("cfg",),
+    )
+    choices, used, rounds = (np.asarray(x) for x in f(arr, cfg))
+    assert rounds.shape == (arr.P // _CHUNK,)
+    assert (rounds >= 1).all() and (rounds <= _CHUNK).all()
+    # decisions identical to the default (2-tuple) entry point
+    two = np.asarray(
+        jax.jit(schedule_scan_chunked, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    np.testing.assert_array_equal(choices, two)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_chunked_scan_parity_when_topk_not_exhaustive(seed):
+    """N > K = C+1: the top-K candidate list is a strict subset of the
+    nodes, so the clean-head domination argument and the cleank staleness
+    updates actually carry the result (with N <= K the list is trivially
+    exhaustive and those paths are untested).  Decisions must stay
+    bit-identical to the plain per-pod scan."""
+    import jax
+
+    from kubernetes_tpu.api.snapshot import encode_snapshot as _enc
+    from kubernetes_tpu.ops.assign import (
+        _CHUNK,
+        _chunkable,
+        schedule_scan,
+        schedule_scan_chunked,
+    )
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    rng = random.Random(3000 + seed)
+    snap = random_cluster(rng, n_nodes=150 + 20 * seed, n_pods=256,
+                          with_taints=False, with_selectors=True,
+                          with_pairwise=False)
+    arr, meta = _enc(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg)
+    assert arr.N > _CHUNK + 1, arr.N  # the regime under test
+    plain = np.asarray(
+        jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    chunked = np.asarray(
+        jax.jit(schedule_scan_chunked, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    np.testing.assert_array_equal(chunked, plain)
+    assert_parity(snap)
