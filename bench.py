@@ -8,11 +8,13 @@ semantics.
 
 Reported (stderr) and embedded in the JSON line:
   encode_s      cold full snapshot encode (host)
-  delta_s       warm-cluster re-encode of a fresh 50k wave through the
-                resident DeltaEncoder (the steady-state host cost)
+  delta_s       median warm-cycle re-encode through the resident
+                DeltaEncoder; every cycle absorbs ~50k binds + ~50k
+                completions (deletes), the sustainable steady state
   step_s        device step, steady state (best of 3)
-  end_to_end_s  delta_s + step_s — the north-star "<1 s wall-clock" metric
-                for a warm cluster absorbing a 50k-pod wave
+  end_to_end_s  median over 3 warm cycles of (delta + step) — the
+                north-star "<1 s wall-clock" metric; end_to_end_worst_s
+                and the per-cycle list expose the variance
 
 vs_baseline's denominator is THIS REPO'S OWN CPU MODE on the same workload
 shape (heterogeneous, measured at a 1,000-pod x 2,000-node sample:
@@ -84,33 +86,56 @@ def main() -> None:
         t_plain = min(t_plain, time.perf_counter() - t0)
     print(f"per-pod (unchunked) scan step: {t_plain*1e3:.1f}ms", file=sys.stderr)
 
-    # warm-cluster wave: the scheduled pods are now bound, a fresh 50k wave
-    # arrives — the resident encoder absorbs the bind delta + encodes the wave
-    bound = [
-        dataclasses.replace(p, node_name=meta.node_names[int(c)])
-        for p, c in zip(
-            (snap.pending_pods[i] for i in meta.pod_perm), choices[: meta.n_pods]
-        )
-        if int(c) >= 0
-    ]
-    wave = [dataclasses.replace(p, name=f"w2-{p.name}", uid="") for p in snap.pending_pods]
-    snap2 = Snapshot(nodes=snap.nodes, pending_pods=wave, bound_pods=bound)
-    t0 = time.perf_counter()
-    arr2, meta2 = enc.encode_device(snap2)
-    t_delta = time.perf_counter() - t0
-    assert enc.stats["delta"] >= 1, f"delta path did not engage: {enc.stats}"
-    t0 = time.perf_counter()
-    choices2 = np.asarray(schedule_batch(arr2, cfg)[0])
-    t_step2 = time.perf_counter() - t0
+    # warm-cluster steady state, THREE full cycles: each cycle the previous
+    # wave's pods are bound, the wave before THAT completes (its bound pods
+    # leave the cluster — sustainable forever, like real churn), and a fresh
+    # 50k wave arrives.  Every cycle therefore absorbs ~50k binds + ~50k
+    # deletes through the resident encoder and re-runs the device step —
+    # median over cycles is the honest steady-state number (the round-2
+    # verdict flagged the previous single-sample measurement).
+    def place(prev_snap, prev_meta, prev_choices):
+        return [
+            dataclasses.replace(p, node_name=prev_meta.node_names[int(c)])
+            for p, c in zip(
+                (prev_snap.pending_pods[i] for i in prev_meta.pod_perm),
+                prev_choices[: prev_meta.n_pods],
+            )
+            if int(c) >= 0
+        ]
+
+    cycles = []
+    prev = (snap, meta, choices)
+    for w in range(2, 5):
+        bound = place(*prev)  # previous wave bound; earlier waves completed
+        wave = [
+            dataclasses.replace(p, name=f"w{w}-{p.name}", uid="")
+            for p in snap.pending_pods
+        ]
+        snapw = Snapshot(nodes=snap.nodes, pending_pods=wave, bound_pods=bound)
+        t0 = time.perf_counter()
+        arrw, metaw = enc.encode_device(snapw)
+        t_delta = time.perf_counter() - t0
+        assert enc.stats["delta"] >= w - 1, f"delta path did not engage: {enc.stats}"
+        t0 = time.perf_counter()
+        choicesw = np.asarray(schedule_batch(arrw, cfg)[0])
+        t_stepw = time.perf_counter() - t0
+        cycles.append((t_delta, t_stepw))
+        prev = (snapw, metaw, choicesw)
 
     scheduled = int((choices[: meta.n_pods] >= 0).sum())
-    end_to_end = t_delta + t_step2
+    e2es = sorted(d + s for d, s in cycles)
+    end_to_end = e2es[len(e2es) // 2]  # median cycle
+    t_delta = sorted(d for d, _ in cycles)[len(cycles) // 2]
+    t_step2 = sorted(s for _, s in cycles)[len(cycles) // 2]
     pods_per_sec = meta.n_pods / t_step
-    e2e_pods_per_sec = meta2.n_pods / end_to_end
+    e2e_pods_per_sec = meta.n_pods / end_to_end
     print(
         f"step: {t_step*1e3:.1f}ms  scheduled {scheduled}/{meta.n_pods}\n"
-        f"warm wave: delta-encode {t_delta*1e3:.1f}ms + step {t_step2*1e3:.1f}ms "
-        f"= end-to-end {end_to_end*1e3:.1f}ms "
+        f"warm cycles (delta_s, step_s): "
+        + ", ".join(f"({d:.3f}, {s:.3f})" for d, s in cycles)
+        + f"\nsteady state (median): delta-encode {t_delta*1e3:.1f}ms + step "
+        f"{t_step2*1e3:.1f}ms; end-to-end median {end_to_end*1e3:.1f}ms, "
+        f"worst {e2es[-1]*1e3:.1f}ms "
         f"({'PASS' if end_to_end < 1.0 else 'FAIL'} <1s north star)",
         file=sys.stderr,
     )
@@ -131,6 +156,8 @@ def main() -> None:
                 "step_s": round(t_step, 4),
                 "step_unchunked_s": round(t_plain, 4),
                 "end_to_end_s": round(end_to_end, 3),
+                "end_to_end_worst_s": round(e2es[-1], 3),
+                "cycles": [[round(d, 3), round(s, 3)] for d, s in cycles],
                 "end_to_end_pods_per_sec": round(e2e_pods_per_sec, 1),
                 "scheduled": scheduled,
             }

@@ -323,13 +323,39 @@ class SpecInterner:
     share field objects), so steady-state group_by_spec costs O(P) dict hits
     instead of O(P) sorted() canonicalizations.  Used by the delta encoder
     and the sidecar client's wave interning.  Values keep the keyed pod alive
-    so recycled ids can never alias a live entry."""
+    so recycled ids can never alias a live entry.
+
+    The identity-profile pass runs in C when the native helper builds
+    (native/interner.c, ~0.5us/pod vs ~4us for the Python loop at 50k pods);
+    grouping is bit-identical on either path and
+    tests/test_snapshot.py::test_interner_native_matches_python pins that."""
 
     def __init__(self):
         self._keys: Dict[Tuple, Tuple] = {}
+        from ..native import pyintern
+
+        self._lib = pyintern.load()
+        if self._lib is not None:
+            self._h = self._lib.interner_new()
+            if not self._h:
+                self._lib = None
+        if self._lib is not None:
+            self._canon: Dict[Tuple, int] = {}  # spec key -> persistent kid
+            self._key_by_kid: List[Tuple] = []
+
+    def __del__(self):  # release the C table's pod pins
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            try:
+                lib.interner_free(self._h)
+            except Exception:
+                pass
+            self._h = None
 
     def group(self, pods: Sequence[t.Pod]):
         """-> (reps, inv, rep_keys) — same reps/inv as group_by_spec."""
+        if self._lib is not None:
+            return self._group_native(pods)
         if len(self._keys) > 2 * (len(pods) + 1024):
             self._keys.clear()
         cache = self._keys
@@ -352,6 +378,61 @@ class SpecInterner:
                 rep_keys.append(k)
             inv[i] = su
         return reps, inv, tuple(rep_keys)
+
+    def _group_native(self, pods: Sequence[t.Pod]):
+        lib = self._lib
+        if not isinstance(pods, list):
+            pods = list(pods)
+        n = len(pods)
+        # same bounded-memory policy as the Python path's _keys.clear():
+        # drop the profile table AND the spec-key registry together (C
+        # entries hold kid indices into _key_by_kid, so they must reset as
+        # one unit); kids restart from 0 afterwards
+        if int(lib.interner_count(self._h)) > 2 * (n + 1024) or len(
+            self._key_by_kid
+        ) > 2 * (n + 1024):
+            lib.interner_clear(self._h)
+            self._canon.clear()
+            self._key_by_kid.clear()
+        keyid = np.empty(n, dtype=np.int64)
+        miss = np.empty(n, dtype=np.int64)
+        # NOTE: PyDLL checks the Python error flag after each call and
+        # raises the pending exception itself, so no failure branches here
+        n_miss = int(
+            lib.interner_lookup(
+                self._h, pods, keyid.ctypes.data, miss.ctypes.data
+            )
+        )
+        if n_miss:
+            canon = self._canon
+            kids = np.empty(n_miss, dtype=np.int64)
+            for k in range(n_miss):
+                i = int(miss[k])
+                key = _pod_spec_key(pods[i])
+                kid = canon.get(key)
+                if kid is None:
+                    kid = len(self._key_by_kid)
+                    canon[key] = kid
+                    self._key_by_kid.append(key)
+                kids[k] = kid
+                keyid[i] = kid
+            lib.interner_insert(
+                self._h, pods, miss.ctypes.data, kids.ctypes.data, n_miss
+            )
+        percall = np.full(len(self._key_by_kid), -1, dtype=np.int64)
+        inv = np.empty(n, dtype=np.int64)
+        rep_idx = np.empty(n, dtype=np.int64)
+        n_reps = int(
+            lib.interner_canonicalize(
+                keyid.ctypes.data, n, percall.ctypes.data,
+                inv.ctypes.data, rep_idx.ctypes.data,
+            )
+        )
+        reps = [pods[int(j)] for j in rep_idx[:n_reps]]
+        rep_keys = tuple(
+            self._key_by_kid[int(keyid[int(j)])] for j in rep_idx[:n_reps]
+        )
+        return reps, inv, rep_keys
 
 
 def group_by_spec(pods: Sequence[t.Pod]) -> Tuple[List[t.Pod], np.ndarray]:

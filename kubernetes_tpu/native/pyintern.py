@@ -1,0 +1,81 @@
+"""ctypes loader for the C identity-profile interner (interner.c).
+
+Built on demand with the running interpreter's headers and loaded with
+ctypes.PyDLL — NOT CDLL: every exported function manipulates Python objects,
+so the GIL must stay held across calls.  Python symbols are left undefined in
+the .so and resolve against the process at dlopen time; if anything in the
+chain fails (no compiler, unresolved symbols), callers fall back to the
+pure-Python SpecInterner loop — behavior is identical either way, only the
+per-pod constant differs (~4us -> ~0.5us measured at 50k pods).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "interner.c")
+_SO = os.path.join(_DIR, "libinterner.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> None:
+    inc = sysconfig.get_paths()["include"]
+    # compile to a private temp path, then publish atomically: a concurrent
+    # loader must never dlopen a partially written .so (it would latch the
+    # slow path for its whole lifetime)
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "gcc", "-O2", "-fPIC", "-shared", f"-I{inc}", _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.rename(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load() -> Optional[ctypes.PyDLL]:
+    """The loaded library, building it first if needed; None on any failure."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            _build()
+        lib = ctypes.PyDLL(_SO)
+        lib.interner_new.restype = ctypes.c_void_p
+        lib.interner_new.argtypes = []
+        lib.interner_free.argtypes = [ctypes.c_void_p]
+        lib.interner_clear.argtypes = [ctypes.c_void_p]
+        lib.interner_count.restype = ctypes.c_int64
+        lib.interner_count.argtypes = [ctypes.c_void_p]
+        lib.interner_lookup.restype = ctypes.c_int64
+        lib.interner_lookup.argtypes = [
+            ctypes.c_void_p, ctypes.py_object,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.interner_insert.restype = ctypes.c_int
+        lib.interner_insert.argtypes = [
+            ctypes.c_void_p, ctypes.py_object,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.interner_canonicalize.restype = ctypes.c_int64
+        lib.interner_canonicalize.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
