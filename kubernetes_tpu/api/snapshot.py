@@ -384,6 +384,17 @@ class SpecInterner:
         if not isinstance(pods, list):
             pods = list(pods)
         n = len(pods)
+        if int(lib.interner_prov(self._h)) != 0:
+            # a prior batch left unresolved provisional entries — either its
+            # slow path raised, or a pod's profile fields are not
+            # identity-stable (property-backed attributes).  One occurrence
+            # triggers a crash-only table wipe inside interner_lookup; if it
+            # keeps happening the C fast path cannot help this workload, so
+            # hand the instance to the Python loop for good.
+            self._thrash = getattr(self, "_thrash", 0) + 1
+            if self._thrash >= 3:
+                self._lib = None
+                return self.group(pods)
         # same bounded-memory policy as the Python path's _keys.clear():
         # drop the profile table AND the spec-key registry together (C
         # entries hold kid indices into _key_by_kid, so they must reset as
@@ -404,6 +415,10 @@ class SpecInterner:
             )
         )
         if n_miss:
+            # miss holds only UNIQUE missing profiles (intra-batch
+            # duplicates were resolved to provisional markers by the C
+            # pass), so the sorted-canonicalization slow path runs once per
+            # distinct spec, not once per pod
             canon = self._canon
             kids = np.empty(n_miss, dtype=np.int64)
             for k in range(n_miss):
@@ -415,10 +430,12 @@ class SpecInterner:
                     canon[key] = kid
                     self._key_by_kid.append(key)
                 kids[k] = kid
-                keyid[i] = kid
             lib.interner_insert(
                 self._h, pods, miss.ctypes.data, kids.ctypes.data, n_miss
             )
+            # resolve provisional markers -(m)-2 -> kids[m]
+            neg = keyid < -1
+            keyid[neg] = kids[-keyid[neg] - 2]
         percall = np.full(len(self._key_by_kid), -1, dtype=np.int64)
         inv = np.empty(n, dtype=np.int64)
         rep_idx = np.empty(n, dtype=np.int64)

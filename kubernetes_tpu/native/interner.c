@@ -49,6 +49,7 @@ typedef struct {
     Entry *slots;
     size_t cap;      /* power of two */
     size_t count;
+    size_t n_prov;   /* unresolved provisional entries (see lookup) */
     PyObject *names[NFIELDS]; /* interned field-name strings */
 } Interner;
 
@@ -74,9 +75,9 @@ static int grow(Interner *in, size_t mincap) {
     for (size_t i = 0; i < ncap; i++) ns[i].keyid = -1;
     for (size_t i = 0; i < in->cap; i++) {
         Entry *e = &in->slots[i];
-        if (e->keyid < 0) continue;
+        if (e->keyid == -1) continue; /* provisional entries migrate too */
         size_t j = profile_hash(e->ptrs) & (ncap - 1);
-        while (ns[j].keyid >= 0) j = (j + 1) & (ncap - 1);
+        while (ns[j].keyid != -1) j = (j + 1) & (ncap - 1);
         ns[j] = *e;
     }
     free(in->slots);
@@ -129,10 +130,11 @@ void *interner_new(void) {
 void interner_clear(void *h) {
     Interner *in = (Interner *)h;
     for (size_t i = 0; i < in->cap; i++) {
-        if (in->slots[i].keyid >= 0) Py_CLEAR(in->slots[i].pin);
+        if (in->slots[i].keyid != -1) Py_CLEAR(in->slots[i].pin);
         in->slots[i].keyid = -1;
     }
     in->count = 0;
+    in->n_prov = 0;
 }
 
 void interner_free(void *h) {
@@ -145,12 +147,25 @@ void interner_free(void *h) {
 
 int64_t interner_count(void *h) { return (int64_t)((Interner *)h)->count; }
 
-/* Pass 1: out_keyid[i] = persistent key-id or -1 (miss); miss indices are
- * appended to miss_idx.  Returns n_miss, or -1 with a Python error set. */
+/* Pass 1: out_keyid[i] = persistent key-id (>= 0), or a PROVISIONAL marker
+ * -(m)-2 where m is the miss ordinal.  Each UNIQUE missing profile is
+ * appended to miss_idx once and inserted provisionally right away, so
+ * intra-batch duplicates (the common case: one spec, thousands of pods)
+ * resolve to the first occurrence's marker instead of each taking the
+ * Python slow path.  The first-occurrence pod is pinned immediately.
+ * Returns n_miss (unique misses), or -1 with a Python error set. */
 int64_t interner_lookup(void *h, PyObject *pods, int64_t *out_keyid,
                         int64_t *miss_idx) {
     Interner *in = (Interner *)h;
     Py_ssize_t n = PyList_GET_SIZE(pods);
+    if (in->n_prov) {
+        /* a previous batch died between lookup and insert (Python slow
+         * path raised); its markers would alias this batch's.  Crash-only:
+         * drop the table — every profile re-misses and re-resolves through
+         * the caller's persistent spec-key registry, so grouping is
+         * unaffected. */
+        interner_clear(in);
+    }
     if (in->cap < (size_t)(in->count + n) * 2 && grow(in, in->count + n) < 0) {
         PyErr_NoMemory();
         return -1;
@@ -158,24 +173,31 @@ int64_t interner_lookup(void *h, PyObject *pods, int64_t *out_keyid,
     int64_t n_miss = 0;
     void *ptrs[NFIELDS];
     for (Py_ssize_t i = 0; i < n; i++) {
-        if (read_profile(in, PyList_GET_ITEM(pods, i), ptrs) < 0) return -1;
+        PyObject *pod = PyList_GET_ITEM(pods, i);
+        if (read_profile(in, pod, ptrs) < 0) return -1;
         size_t j = profile_hash(ptrs) & (in->cap - 1);
-        int64_t kid = -1;
-        while (in->slots[j].keyid >= 0) {
-            if (profile_eq(&in->slots[j], ptrs)) {
-                kid = in->slots[j].keyid;
-                break;
-            }
+        while (in->slots[j].keyid != -1) {
+            if (profile_eq(&in->slots[j], ptrs)) break;
             j = (j + 1) & (in->cap - 1);
         }
-        out_keyid[i] = kid;
-        if (kid < 0) miss_idx[n_miss++] = i;
+        if (in->slots[j].keyid != -1) {
+            out_keyid[i] = in->slots[j].keyid; /* hit or earlier provisional */
+        } else {
+            memcpy(in->slots[j].ptrs, ptrs, sizeof(ptrs));
+            in->slots[j].keyid = -n_miss - 2; /* provisional marker */
+            Py_INCREF(pod);
+            in->slots[j].pin = pod;
+            in->count++;
+            in->n_prov++;
+            out_keyid[i] = -n_miss - 2;
+            miss_idx[n_miss++] = i;
+        }
     }
     return n_miss;
 }
 
-/* Insert resolved misses: pods[idx[k]] -> kid[k].  The pod is INCREF'd to
- * pin its field objects (see aliasing note above). */
+/* Resolve the provisional entries from this batch: pods[idx[k]] (the first
+ * occurrence of unique miss k) gets persistent key-id kid[k]. */
 int interner_insert(void *h, PyObject *pods, const int64_t *idx,
                     const int64_t *kid, int64_t n_ins) {
     Interner *in = (Interner *)h;
@@ -189,11 +211,21 @@ int interner_insert(void *h, PyObject *pods, const int64_t *idx,
         PyObject *pod = PyList_GET_ITEM(pods, idx[k]);
         if (read_profile(in, pod, ptrs) < 0) return -1;
         size_t j = profile_hash(ptrs) & (in->cap - 1);
-        while (in->slots[j].keyid >= 0) {
-            if (profile_eq(&in->slots[j], ptrs)) break; /* dup in batch */
+        while (in->slots[j].keyid != -1) {
+            if (profile_eq(&in->slots[j], ptrs)) {
+                if (in->slots[j].keyid < -1) in->n_prov--;
+                in->slots[j].keyid = kid[k];
+                break;
+            }
             j = (j + 1) & (in->cap - 1);
         }
-        if (in->slots[j].keyid < 0) {
+        if (in->slots[j].keyid == -1) {
+            /* identity-unstable profile (e.g. a property returning a fresh
+             * object per read): insert re-read different pointers than
+             * lookup stored.  Store a usable entry under the re-read
+             * pointers; the orphaned provisional marker keeps n_prov > 0,
+             * which the caller observes via interner_prov and uses to fall
+             * back to the Python path rather than thrash. */
             memcpy(in->slots[j].ptrs, ptrs, sizeof(ptrs));
             in->slots[j].keyid = kid[k];
             Py_INCREF(pod);
@@ -203,6 +235,8 @@ int interner_insert(void *h, PyObject *pods, const int64_t *idx,
     }
     return 0;
 }
+
+int64_t interner_prov(void *h) { return (int64_t)((Interner *)h)->n_prov; }
 
 /* Pass 2: per-call canonical ids in first-occurrence order.
  * keyid[i] >= 0 for all i.  percall must hold max_kid+1 slots, pre-filled

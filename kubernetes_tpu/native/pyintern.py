@@ -60,6 +60,8 @@ def load() -> Optional[ctypes.PyDLL]:
         lib.interner_clear.argtypes = [ctypes.c_void_p]
         lib.interner_count.restype = ctypes.c_int64
         lib.interner_count.argtypes = [ctypes.c_void_p]
+        lib.interner_prov.restype = ctypes.c_int64
+        lib.interner_prov.argtypes = [ctypes.c_void_p]
         lib.interner_lookup.restype = ctypes.c_int64
         lib.interner_lookup.argtypes = [
             ctypes.c_void_p, ctypes.py_object,

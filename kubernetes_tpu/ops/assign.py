@@ -268,6 +268,16 @@ def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
     )
 
 
+def _chunk_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
+    """Routing decision: chunked only off-CPU.  The rounds design trades
+    per-step count for wider vectorized round bodies, which wins on TPU
+    (scan loop overhead ~3us/step) but loses to the plain scan on the CPU
+    interpreter.  Decisions are bit-identical on both paths
+    (tests/test_assign_parity.py), so this is a pure performance choice
+    evaluated at trace time."""
+    return jax.default_backend() != "cpu" and _chunkable(arr, cfg)
+
+
 def schedule_scan_chunked(
     arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False
 ):
@@ -566,7 +576,7 @@ def schedule_scan_chunked(
 
 
 def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
-    if _chunkable(arr, cfg):
+    if _chunk_routed(arr, cfg):
         return schedule_scan_chunked(arr, cfg)
     return schedule_scan(arr, cfg, axis_name=None)
 

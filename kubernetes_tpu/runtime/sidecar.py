@@ -58,6 +58,10 @@ class _Session:
         self.nodes: List[t.Node] = []
         self.bound: Dict[str, t.Pod] = {}
         self.last_wave: Dict[str, t.Pod] = {}
+        # serialized-spec-bytes -> decoded rep Pod (convert.wave_from_proto):
+        # keeps rep OBJECTS stable across waves so the resident encoder's
+        # identity-level interning hits instead of re-canonicalizing
+        self.rep_cache: Dict[bytes, t.Pod] = {}
         self.pod_groups: Dict[str, t.PodGroup] = {}
         self.epoch = 0
         self.ready = False
@@ -117,7 +121,14 @@ class _Engine:
             if request.HasField("hard_pod_affinity_weight")
             else 1.0
         )
-        wave = wave_from_proto(request.wave)
+        with self._state_lock:
+            sess0 = self._sessions.get(request.session_id)
+            rep_cache = sess0.rep_cache if sess0 is not None else {}
+        # decode outside the lock; rep_cache is only ever touched by this
+        # session's requests (one client), so the dict is effectively
+        # single-writer.  The dict is carried into a full-sync's fresh
+        # session below so resyncs keep rep objects identity-stable.
+        wave = wave_from_proto(request.wave, rep_cache)
         with self._state_lock:
             sess = self._sessions.get(request.session_id)
             if sess is not None:
@@ -144,8 +155,11 @@ class _Engine:
                     sess.bound[p.uid] = p
             else:
                 # full sync (re)builds the session; LRU-evict beyond the cap
-                # (crash-only: an evicted client just resyncs)
+                # (crash-only: an evicted client just resyncs).  The decode
+                # rep cache survives the rebuild — resync must not cost the
+                # encoder its identity-level warmth.
                 sess = _Session(hpaw)
+                sess.rep_cache = rep_cache
                 self._sessions[request.session_id] = sess
                 while len(self._sessions) > self.MAX_SESSIONS:
                     oldest = next(iter(self._sessions))
