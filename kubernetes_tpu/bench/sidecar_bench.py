@@ -1,0 +1,96 @@
+"""Sidecar loopback benchmark — the deployed north-star architecture
+end-to-end: real gRPC server + client in one process, real device step,
+session/delta wire protocol.
+
+Measures, client-side (including proto build, wire, server decode, resident
+delta encode, device step, verdict decode):
+  - the cold path: first request -> not_ready + CPU-fallback contract while
+    the server warms in the background (encode + compile + one run)
+  - N warm waves against a warm cluster (each wave re-binds the previous
+    one's placements like bench.py's sustainable cycle)
+
+Usage: python -m kubernetes_tpu.bench.sidecar_bench [n_nodes] [n_pods] [waves]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+from ..api.snapshot import Snapshot
+from ..runtime.client import SidecarUnavailable, TPUScoreClient
+from ..runtime.sidecar import TPUScoreServer
+from .workloads import heterogeneous
+
+
+def main() -> None:
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+    n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 50_000
+    n_waves = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+
+    snap = heterogeneous(n_nodes, n_pods, seed=0)
+    server = TPUScoreServer()
+    port = server.start()
+    cli = TPUScoreClient(f"127.0.0.1:{port}")
+
+    t0 = time.perf_counter()
+    cold_fallback = None
+    try:
+        cli.schedule(snap, deadline_ms=600_000)
+    except SidecarUnavailable:
+        cold_fallback = time.perf_counter() - t0
+    # wait for background warmup (compile included)
+    t0 = time.perf_counter()
+    while not server.engine.ready:
+        time.sleep(0.25)
+        if time.perf_counter() - t0 > 600:
+            raise SystemExit("warmup never completed")
+    warmup_s = time.perf_counter() - t0
+
+    # first warm request gives the placements to bind for the cycle chain
+    r = cli.schedule(snap, deadline_ms=600_000)
+    waves = []
+    prev_assign = r
+    prev_pods = snap.pending_pods
+    for w in range(2, 2 + n_waves):
+        bound = [
+            dataclasses.replace(p, node_name=prev_assign[p.uid])
+            for p in prev_pods
+            if prev_assign.get(p.uid)
+        ]
+        wave = [
+            dataclasses.replace(p, name=f"w{w}-{p.name}", uid="")
+            for p in snap.pending_pods
+        ]
+        s2 = Snapshot(nodes=snap.nodes, pending_pods=wave, bound_pods=bound)
+        t0 = time.perf_counter()
+        prev_assign = cli.schedule(s2, deadline_ms=600_000)
+        waves.append(time.perf_counter() - t0)
+        prev_pods = wave
+    server.stop()
+    med = sorted(waves)[len(waves) // 2]
+    print(
+        json.dumps(
+            {
+                "metric": "sidecar_loopback_warm_wave",
+                "n_nodes": n_nodes,
+                "n_pods": n_pods,
+                "cold_fallback_s": round(cold_fallback, 3)
+                if cold_fallback is not None
+                else None,
+                "warmup_s": round(warmup_s, 1),
+                "warm_wave_s": [round(x, 3) for x in waves],
+                "warm_wave_median_s": round(med, 3),
+                "pass_1s": med < 1.0,
+                "client_stats": cli.stats,
+                "unit": "s",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
