@@ -37,13 +37,18 @@ CLAIM_PREFIX = "claim/"
 
 def _topology_term(allowed_topology) -> Optional[t.NodeSelectorTerm]:
     """Allowed-topology pairs (from a PV or a StorageClass) → one conjunction
-    term; pairs within one object AND together in this reduced model."""
+    term.  Pairs sharing a key merge into one In-expression (values OR
+    together — the reference's TopologySelectorTerm.matchLabelExpressions
+    carries values[] per key); DISTINCT keys AND together."""
     if not allowed_topology:
         return None
+    by_key: dict = {}
+    for k, v in allowed_topology:
+        by_key.setdefault(k, []).append(v)
     return t.NodeSelectorTerm(
         match_expressions=tuple(
-            t.NodeSelectorRequirement(key=k, operator=t.OP_IN, values=(v,))
-            for k, v in allowed_topology
+            t.NodeSelectorRequirement(key=k, operator=t.OP_IN, values=tuple(vs))
+            for k, vs in by_key.items()
         )
     )
 

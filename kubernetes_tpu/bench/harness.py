@@ -156,6 +156,9 @@ GENERATORS = {
     "heterogeneous": lambda **kw: workloads.heterogeneous(
         kw["nodes"], kw["pods"], kw.get("seed", 0)
     ),
+    "heterogeneous_storage": lambda **kw: workloads.heterogeneous_storage(
+        kw["nodes"], kw["pods"], kw.get("seed", 0)
+    ),
     "gang": lambda **kw: workloads.gang(
         kw["groups"], kw["group_size"], kw["nodes"], kw.get("seed", 0)
     ),
@@ -265,6 +268,11 @@ name: Config5_Gang
 ops:
   - {op: createCluster, generator: gang, groups: 1000, group_size: 64, nodes: 2000}
   - {op: measure}
+---
+name: Config4S_HeterogeneousStorage
+ops:
+  - {op: createCluster, generator: heterogeneous_storage, nodes: 20000, pods: 20000}
+  - {op: measure}
 """
 
 SMOKE_CONFIGS = """
@@ -291,6 +299,11 @@ ops:
 name: Config5_Gang
 ops:
   - {op: createCluster, generator: gang, groups: 20, group_size: 16, nodes: 100}
+  - {op: measure}
+---
+name: Config4S_HeterogeneousStorage
+ops:
+  - {op: createCluster, generator: heterogeneous_storage, nodes: 500, pods: 500}
   - {op: measure}
 """
 

@@ -168,3 +168,63 @@ def heterogeneous(n_nodes: int, n_pods: int, seed: int = 0) -> Snapshot:
             )
         )
     return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+def heterogeneous_storage(n_nodes: int, n_pods: int, seed: int = 0) -> Snapshot:
+    """Config 4 + storage: the heterogeneous shape where a slice of pods
+    claim volumes — some bound to zone-restricted static PVs, some unbound
+    through a WaitForFirstConsumer StorageClass with zone topology, plus
+    CSI attach limits on a slice of nodes.  Exercises volume resolution
+    (api/volumes.resolve_snapshot) and the delta encoder\'s storage-state
+    conditioning every cycle — the round-2 verdict\'s "config-4-plus-
+    storage" gap: storage-using clusters must keep the incremental encode
+    rather than silently rebuilding."""
+    from ..api.cluster import StorageClass
+
+    snap = heterogeneous(n_nodes, n_pods, seed=seed)
+    snap.storage_classes["fast-wffc"] = StorageClass(
+        name="fast-wffc",
+        provisioner="csi.example.com",
+        volume_binding_mode="WaitForFirstConsumer",
+        allowed_topology=((t.LABEL_ZONE, "zone-0"), (t.LABEL_ZONE, "zone-1")),
+    )
+    # static zone-pinned PVs, one per 40 nodes, pre-bound to claims
+    n_static = max(1, n_nodes // 40)
+    for v in range(n_static):
+        zone = f"zone-{v % 9}"
+        snap.pvs.append(
+            t.PersistentVolume(
+                name=f"pv-{v}",
+                capacity=100 * GI,
+                storage_class="static",
+                allowed_topology=((t.LABEL_ZONE, zone),),
+                claim_ref=f"default/claim-static-{v}",
+            )
+        )
+        snap.pvcs[f"default/claim-static-{v}"] = t.PersistentVolumeClaim(
+            name=f"claim-static-{v}",
+            request=50 * GI,
+            storage_class="static",
+            volume_name=f"pv-{v}",
+        )
+    # unbound WFFC claims for a slice of pods
+    n_wffc = max(1, n_pods // 50)
+    for c in range(n_wffc):
+        snap.pvcs[f"default/claim-wffc-{c}"] = t.PersistentVolumeClaim(
+            name=f"claim-wffc-{c}",
+            request=10 * GI,
+            storage_class="fast-wffc",
+            wait_for_first_consumer=True,
+        )
+    # attach limits on a slice of nodes
+    for i, nd in enumerate(snap.nodes):
+        if i % 7 == 0:
+            nd.volume_attach_limit = 16
+    # pods claiming volumes: every 50th pod a WFFC claim, every 97th a
+    # static claim (claims may be shared — ReadWriteMany semantics)
+    for i, p in enumerate(snap.pending_pods):
+        if i % 50 == 0:
+            p.pvcs = (f"claim-wffc-{(i // 50) % n_wffc}",)
+        elif i % 97 == 0:
+            p.pvcs = (f"claim-static-{(i // 97) % n_static}",)
+    return snap

@@ -75,3 +75,45 @@ def test_read_write_once_pod_parity_through_batch_path():
     sched.run_until_idle()
     bound = {p.name: bool(p.node_name) for p in store.pods.values()}
     assert bound == {"a": True, "b": False}, bound
+
+
+def test_allowed_topology_values_or_within_key():
+    """Repeated keys in allowed_topology OR their values (the reference's
+    TopologySelectorTerm.matchLabelExpressions carries values[] per key);
+    regression: they previously lowered to ANDed single-value expressions,
+    which is unsatisfiable and marked every claimer unschedulable."""
+    from kubernetes_tpu.api.cluster import StorageClass
+    from kubernetes_tpu.api.snapshot import Snapshot
+    from kubernetes_tpu.api.volumes import resolve_snapshot
+    from kubernetes_tpu.oracle import oracle_schedule
+
+    nodes = [
+        t.Node(name=f"n{z}", allocatable={t.CPU: 4000, t.PODS: 10},
+               labels={t.LABEL_ZONE: f"zone-{z}"})
+        for z in range(3)
+    ]
+    sc = StorageClass(
+        name="wffc",
+        provisioner="csi.example.com",
+        volume_binding_mode="WaitForFirstConsumer",
+        allowed_topology=(
+            (t.LABEL_ZONE, "zone-0"),
+            (t.LABEL_ZONE, "zone-1"),
+        ),
+    )
+    pod = t.Pod(name="p", requests={t.CPU: 100}, pvcs=("c",))
+    snap = Snapshot(
+        nodes=nodes,
+        pending_pods=[pod],
+        pvcs={"default/c": t.PersistentVolumeClaim(
+            name="c", request=1 << 30, storage_class="wffc",
+            wait_for_first_consumer=True)},
+        storage_classes={"wffc": sc},
+    )
+    rs = resolve_snapshot(snap)
+    (q,) = rs.pending_pods
+    (term,) = q.affinity.required_node_terms
+    (expr,) = term.match_expressions  # ONE expression, both values OR'd
+    assert set(expr.values) == {"zone-0", "zone-1"}
+    got = dict(oracle_schedule(snap))
+    assert got["p"] in ("n0", "n1")  # schedulable, zone-2 excluded
