@@ -301,3 +301,40 @@ def test_delta_survives_volume_state():
     g = np.asarray(schedule_batch(g2, DEFAULT_SCORE_CONFIG)[0])
     w = np.asarray(schedule_batch(w2, DEFAULT_SCORE_CONFIG)[0])
     np.testing.assert_array_equal(g[: gm2.n_pods], w[: wm2.n_pods])
+
+
+def test_wave_store_bounded_on_stable_backlog():
+    """The per-wave (pods, reps, inv) store must not accumulate across
+    cycles: a stable backlog re-pends the same uids every cycle (wave_ix
+    slots overwrite, never pop), and fully-bound waves must drain by
+    refcount.  Regression for the round-3 review finding: one store entry
+    leaked per encode cycle, unbounded over a long-running encoder."""
+    import dataclasses
+
+    from kubernetes_tpu.bench.workloads import basic
+
+    snap = basic(30, 120)
+    enc = DeltaEncoder()
+    for _ in range(30):  # stable backlog: same pods re-encoded every cycle
+        enc.encode_device(
+            Snapshot(nodes=snap.nodes, pending_pods=snap.pending_pods)
+        )
+    assert len(enc._cs.wave_store) <= 9, len(enc._cs.wave_store)
+    assert enc.stats["delta"] >= 25, enc.stats
+
+    enc2 = DeltaEncoder()
+    enc2.encode_device(snap)
+    prev = snap.pending_pods
+    for c in range(6):  # every wave fully binds: refcount drain
+        bound = [
+            dataclasses.replace(p, node_name=snap.nodes[0].name) for p in prev
+        ]
+        wave = [
+            dataclasses.replace(p, name=f"c{c}-{p.name}", uid="")
+            for p in snap.pending_pods
+        ]
+        enc2.encode_device(
+            Snapshot(nodes=snap.nodes, pending_pods=wave, bound_pods=bound)
+        )
+        prev = wave
+    assert len(enc2._cs.wave_store) <= 3, len(enc2._cs.wave_store)
