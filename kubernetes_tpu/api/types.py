@@ -311,6 +311,11 @@ class Pod:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     node_name: str = ""  # spec.nodeName: "" = pending; set = bound/running
+    # spec.schedulerName: selects the scheduling profile ("" = the default
+    # profile).  Pods naming a profile this scheduler does not serve are
+    # ignored entirely — another scheduler's responsibility
+    # (schedule_one.go — frameworkForPod)
+    scheduler_name: str = ""
     priority_class_name: str = ""  # resolved to `priority` by Priority admission
     pod_ip: str = ""  # status.podIP, assigned by the kubelet when Running
     # status.nominatedNodeName: set by preemption; the node this pod's victims

@@ -74,10 +74,11 @@ class SchedulerConfiguration:
                 return p
         return self.profiles[0]
 
-    def score_config(self) -> ScoreConfig:
-        """Lower profile plugin weights onto the kernel ScoreConfig."""
-        w = {s.name: s.weight for s in self.profile().plugins if s.enabled}
-        disabled = {s.name for s in self.profile().plugins if not s.enabled}
+    def score_config(self, profile_name: str = "default-scheduler") -> ScoreConfig:
+        """Lower a profile's plugin weights onto the kernel ScoreConfig."""
+        prof = self.profile(profile_name)
+        w = {s.name: s.weight for s in prof.plugins if s.enabled}
+        disabled = {s.name for s in prof.plugins if not s.enabled}
         cfg = ScoreConfig(
             fit_weight=w.get("NodeResourcesFit", 1.0),
             balanced_weight=w.get("NodeResourcesBalancedAllocation", 1.0),
@@ -85,7 +86,7 @@ class SchedulerConfiguration:
             node_affinity_weight=w.get("NodeAffinity", 2.0),
             spread_weight=w.get("PodTopologySpread", 2.0),
             interpod_weight=w.get("InterPodAffinity", 2.0),
-            hard_pod_affinity_weight=self.profile().hard_pod_affinity_weight,
+            hard_pod_affinity_weight=prof.hard_pod_affinity_weight,
         )
         for name in disabled:
             key = {

@@ -480,11 +480,17 @@ class DefaultPreemption(Plugin):
 
 
 def default_plugins(
-    store, filter_fn=None, nominated_fn=None, hard_pod_affinity_weight: float = 1.0
+    store, filter_fn=None, nominated_fn=None, hard_pod_affinity_weight: float = 1.0,
+    plugin_specs=(),
 ) -> List[PluginWeight]:
     """The default profile — plugin set and weights mirroring
     default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
-    TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2)."""
+    TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2).
+
+    `plugin_specs` (KubeSchedulerProfile.plugins) overrides per plugin name:
+    weight replacement, or removal when enabled=False — the same lowering
+    config.score_config applies for the batch kernels, so the two paths see
+    one profile semantics."""
     # Score-plugin order mirrors the kernels' float32 accumulation order
     # (ops/assign.py: fit, balanced, taint, nodeAffinity, spread, image) so the
     # CPU path's weighted sum is bit-identical to the TPU/native paths.
@@ -504,6 +510,19 @@ def default_plugins(
         pls.append(PluginWeight(DefaultPreemption(filter_fn, store, nominated_fn)))
     pls.append(PluginWeight(VolumeBinding(store)))
     pls.append(PluginWeight(DefaultBinder(store)))
+    by_name = {s.name: s for s in plugin_specs}
+    if by_name:
+        # enabled=False disables the SCORE point only (weight 0) — exactly
+        # what config.score_config does for the batch kernels, which always
+        # keep feasibility filters.  Filters stay active on both paths.
+        pls = [
+            PluginWeight(
+                pw.plugin,
+                (s.weight if s.enabled else 0.0) if s is not None else pw.weight,
+            )
+            for pw in pls
+            for s in (by_name.get(getattr(pw.plugin, "name", "")),)
+        ]
     return pls
 
 

@@ -102,15 +102,24 @@ class Scheduler:
         # watch callbacks fire on whichever thread mutates the store (e.g.
         # binding-pool threads) — the waiting map needs its own lock
         self._gang_lock = threading.Lock()
-        self.framework = Framework(
-            default_plugins(
-                store,
-                filter_fn=self._filter_one,
-                nominated_fn=lambda n: self.queue.nominated_pods_for_node(n),
-                hard_pod_affinity_weight=config.profile().hard_pod_affinity_weight,
+        # one Framework per profile (frameworkForPod — pods select theirs by
+        # spec.schedulerName); self.framework stays the default profile's
+        self.frameworks: Dict[str, Framework] = {
+            p.scheduler_name: Framework(
+                default_plugins(
+                    store,
+                    filter_fn=self._filter_one,
+                    nominated_fn=lambda n: self.queue.nominated_pods_for_node(n),
+                    hard_pod_affinity_weight=p.hard_pod_affinity_weight,
+                    plugin_specs=p.plugins,
+                )
             )
-        )
-        self._sidecar = None  # lazy TPUScoreClient when profile configures one
+            for p in config.profiles
+        }
+        self.default_profile_name = config.profiles[0].scheduler_name
+        self.framework = self.frameworks[self.default_profile_name]
+        self._sidecar = None  # most-recent client (kept for tests/introspection)
+        self._sidecars: Dict[str, object] = {}  # per-address lazy TPUScoreClients
         # batched-bind move coalescing: while a batch commit loop runs, watch
         # events' MoveAllToActiveOrBackoffQueue calls collapse into one move
         # per event kind at loop exit (the reference fires one move per
@@ -178,7 +187,10 @@ class Scheduler:
                 if pod.node_name and pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
                     self._move_all(EV_POD_DELETE, obj=pod)
             elif not pod.node_name:
-                st = self.framework.run_pre_enqueue(pod)
+                fw = self._fw(pod)
+                if fw is None:
+                    return  # another scheduler's pod: not queued, not failed
+                st = fw.run_pre_enqueue(pod)
                 if st.ok:
                     self.queue.add(pod)
                     self.metrics.inc("queue_incoming_pods_total")
@@ -195,8 +207,15 @@ class Scheduler:
                 old=getattr(ev, "old", None),
             )
 
+
+    def _fw(self, pod: t.Pod) -> Optional[Framework]:
+        """frameworkForPod (schedule_one.go): the profile the pod selects by
+        spec.schedulerName, or None when no profile here serves that name —
+        such pods are another scheduler's responsibility and are ignored."""
+        return self.frameworks.get(pod.scheduler_name or self.default_profile_name)
+
     def _filter_one(self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo) -> Status:
-        return self.framework.run_filters(state, snap, pod, info)
+        return self._fw(pod).run_filters(state, snap, pod, info)
 
     def _filter_with_nominated(
         self, state: CycleState, snap: Snapshot, pod: t.Pod, info: NodeInfo, i: int
@@ -213,24 +232,26 @@ class Scheduler:
             if q.uid != pod.uid and q.priority >= pod.priority
         ]
         if not nominated:
-            return self.framework.run_filters(state, snap, pod, info)
+            return self._fw(pod).run_filters(state, snap, pod, info)
         sc = state.data["scaled"]
         sim = NodeInfo(node=info.node, pods=list(info.pods) + list(nominated))
         sc.push_sim(i, sim)
         try:
-            st = self.framework.run_filters(state, snap, pod, sim)
+            st = self._fw(pod).run_filters(state, snap, pod, sim)
         finally:
             sc.pop_sim(i)
         if not st.ok:
             return st
-        return self.framework.run_filters(state, snap, pod, info)
+        return self._fw(pod).run_filters(state, snap, pod, info)
 
     # --- findNodesThatFitPod helpers (CPU path) ---
-    def _num_feasible_nodes_to_find(self, num_nodes: int) -> int:
+    def _num_feasible_nodes_to_find(self, num_nodes: int, profile_name: str = "") -> int:
         """schedule_one.go — numFeasibleNodesToFind: percentageOfNodesToScore
         (0 = adaptive max(5, 50 - nodes/125)%), floored at
         minFeasibleNodesToFind = 100."""
-        pct = self.config.profile().percentage_of_nodes_to_score
+        pct = self.config.profile(
+            profile_name or self.default_profile_name
+        ).percentage_of_nodes_to_score
         if pct == 0:
             pct = max(5, 50 - num_nodes // 125)
         if pct >= 100 or num_nodes <= 100:
@@ -242,7 +263,9 @@ class Scheduler:
         numFeasibleNodesToFind (the adaptive-sampling half of D3; the batch
         path always scores everything)."""
         n = len(infos)
-        want = self._num_feasible_nodes_to_find(n)
+        want = self._num_feasible_nodes_to_find(
+            n, pod.scheduler_name or self.default_profile_name
+        )
         feasible: List[int] = []
         statuses: Dict[str, Status] = {}
         processed = 0
@@ -315,7 +338,10 @@ class Scheduler:
         infos = self.cache.node_infos(snap)
         state = CycleState()
         state.data["scaled"] = ScaledState(snap, infos)
-        st = self.framework.run_pre_filter(state, snap, pod)
+        fw = self._fw(pod)
+        if fw is None:
+            return None  # another scheduler's pod (defensive; not enqueued)
+        st = fw.run_pre_filter(state, snap, pod)
         feasible: List[int] = []
         statuses: Dict[str, Status] = {}
         if st.ok:
@@ -337,7 +363,7 @@ class Scheduler:
                 self.metrics.inc("scheduling_attempts_error")
                 return None
         if not feasible:
-            nominated, pst = self.framework.run_post_filters(state, snap, pod, statuses)
+            nominated, pst = fw.run_post_filters(state, snap, pod, statuses)
             self.events.record(
                 "FailedScheduling", pod.uid,
                 message=f"0/{len(infos)} nodes available" + (f"; preemption nominated {nominated}" if pst.ok else ""),
@@ -360,8 +386,8 @@ class Scheduler:
             # snapshot: plain backoff, or its wake event is already gone
             failing = {s.plugin for s in statuses.values() if s.plugin}
             park = failing and not (pst.ok and nominated)
-            hint_events = self.framework.events_for_plugins(failing) if park else None
-            hints = self.framework.hints_for_plugins(failing) if park else None
+            hint_events = fw.events_for_plugins(failing) if park else None
+            hints = fw.hints_for_plugins(failing) if park else None
             # move_seq compared inside add_unschedulable, under the queue lock
             self.queue.add_unschedulable(
                 pod, hint_events, backoff=True, cycle_move_seq=cycle_move_seq,
@@ -370,15 +396,15 @@ class Scheduler:
             self.metrics.inc("scheduling_attempts_unschedulable")
             return None
         chosen = [infos[i] for i in feasible]
-        self.framework.run_pre_score(state, snap, pod, chosen)
-        scores = self.framework.run_scores(state, snap, pod, chosen)
+        fw.run_pre_score(state, snap, pod, chosen)
+        scores = fw.run_scores(state, snap, pod, chosen)
         scores = self._extender_prioritize(pod, chosen, scores)
         best = feasible[int(np.argmax(scores))]  # first max == lowest node index
         node_name = infos[best].node.name
         # assume: the cycle becomes pipelinable — the assumed pod occupies
         # capacity for the NEXT pod's cycle while this one's binding runs
         self.cache.assume(pod.uid, node_name)
-        st = self.framework.run_permit(state, snap, pod, node_name)
+        st = fw.run_permit(state, snap, pod, node_name)
         if not st.ok:
             self.cache.forget(pod.uid)
             self.queue.add_unschedulable(pod, backoff=True)
@@ -440,7 +466,8 @@ class Scheduler:
     def _binding_cycle(self, state, snap, pod, node_name, t0) -> Optional[str]:
         """PreBind -> Bind -> PostBind (+ extender binder precedence); failure
         forgets the assumption and requeues — schedule_one.go's bindingCycle."""
-        st = self.framework.run_pre_bind(state, snap, pod, node_name)
+        fw = self._fw(pod) or self.framework
+        st = fw.run_pre_bind(state, snap, pod, node_name)
         if st.ok:
             binder = next((e for e in self.extenders if e.cfg.bind_verb), None)
             if binder is not None:
@@ -454,12 +481,12 @@ class Scheduler:
                 else:
                     st = Status.unschedulable(f"extender bind: {err}")
             else:
-                st = self.framework.run_bind(state, snap, pod, node_name)
+                st = fw.run_bind(state, snap, pod, node_name)
         if not st.ok:
             self.cache.forget(pod.uid)
             self.queue.add_unschedulable(pod, backoff=True)
             return None
-        self.framework.run_post_bind(state, snap, pod, node_name)
+        fw.run_post_bind(state, snap, pod, node_name)
         self.queue.delete_nominated(pod.uid)
         self.events.record("Scheduled", pod.uid, node=node_name)
         dt = time.perf_counter() - t0
@@ -512,6 +539,19 @@ class Scheduler:
         batch: List[t.Pod] = self.queue.pop_all()
         if not batch:
             return {}
+        # one profile per batch cycle (the kernels take one static weight
+        # config): schedule the profile of the earliest-queued pod now and
+        # requeue the other profiles' pods untouched — run_until_idle picks
+        # them up next cycle.  Single-profile configs (the common case) never
+        # requeue anything.
+        lead = batch[0].scheduler_name or self.default_profile_name
+        if any((p.scheduler_name or self.default_profile_name) != lead for p in batch):
+            mine = [p for p in batch if (p.scheduler_name or self.default_profile_name) == lead]
+            for p in batch:
+                if (p.scheduler_name or self.default_profile_name) != lead:
+                    self.queue.add(p)
+            batch = mine
+        profile_name = lead
         snap = self.cache.update_snapshot()
         bound_uids = {p.uid for p in snap.bound_pods}
         batch_uids = {p.uid for p in batch}
@@ -538,16 +578,33 @@ class Scheduler:
             device_classes=snap.device_classes,
         )
         gang = self.features.enabled("GangScheduling")
-        prof = self.config.profile()
+        prof = self.config.profile(profile_name)
+        batch_fw = self.frameworks[profile_name]
         verdicts: Optional[Dict[str, Optional[str]]] = None  # uid -> node|None
-        if prof.tpu_score is not None and prof.tpu_score.sidecar_address != "local":
+        offload = prof.tpu_score is not None and prof.tpu_score.sidecar_address != "local"
+        if offload:
+            # the wire carries hardPodAffinityWeight but not arbitrary plugin
+            # weights: a profile with customized score weights schedules
+            # in-process (the kernels honor its ScoreConfig) rather than
+            # receiving default-weight verdicts from the sidecar
+            from dataclasses import replace as _dc_replace
+
+            want_cfg = self.config.score_config(profile_name)
+            if want_cfg != _dc_replace(
+                type(want_cfg)(),
+                hard_pod_affinity_weight=want_cfg.hard_pod_affinity_weight,
+            ):
+                offload = False
+        if offload:
             # offload to the gRPC sidecar; deadline/transport failure -> the
             # mandated CPU fallback (per-pod plugin path)
             from ..runtime import SidecarUnavailable, TPUScoreClient
 
             try:
-                if self._sidecar is None:
-                    self._sidecar = TPUScoreClient(prof.tpu_score.sidecar_address)
+                addr = prof.tpu_score.sidecar_address
+                if self._sidecars.get(addr) is None:
+                    self._sidecars[addr] = TPUScoreClient(addr)
+                self._sidecar = self._sidecars[addr]
                 # the RAW snapshot goes to the client: it fingerprints raw
                 # node identity + storage state for its session delta, THEN
                 # resolves volume/DRA constraints into plain requests +
@@ -576,7 +633,7 @@ class Scheduler:
                 return result
         arr = meta = None  # encoded cycle arrays (batched preemption reuses them)
         if verdicts is None:
-            base_cfg = self.config.score_config()
+            base_cfg = self.config.score_config(profile_name)
             if (
                 self._delta_enc is None
                 or self._delta_enc.hpaw != base_cfg.hard_pod_affinity_weight
@@ -677,7 +734,7 @@ class Scheduler:
                     else:
                         self._clear_nomination(pod)
                 else:
-                    nominated, pst = self.framework.run_post_filters(state, snap2, pod, {})
+                    nominated, pst = batch_fw.run_post_filters(state, snap2, pod, {})
                     if pst.ok and nominated:
                         self.events.record("Preempted", pod.uid, node=nominated)
                         self._nominate(pod, nominated)

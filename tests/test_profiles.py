@@ -1,0 +1,91 @@
+"""Multi-profile dispatch: pods select their scheduling profile by
+spec.schedulerName (schedule_one.go — frameworkForPod); pods naming a
+profile this scheduler does not serve are ignored entirely."""
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.config import PluginSpec, Profile
+from helpers import mk_node, mk_pod
+
+
+def _two_profile_cfg(mode):
+    """default-scheduler = stock weights; busy-packer disables
+    LeastAllocated+Balanced and prefers ALREADY-BUSY nodes via
+    MostAllocated-like behavior... kept simple: it zeroes both usage
+    scores, so among feasible nodes it picks the LOWEST INDEX regardless
+    of load, while the default profile spreads to the idle node."""
+    return SchedulerConfiguration(
+        mode=mode,
+        profiles=(
+            Profile(),
+            Profile(
+                scheduler_name="busy-packer",
+                plugins=(
+                    PluginSpec(name="NodeResourcesFit", enabled=False),
+                    PluginSpec(
+                        name="NodeResourcesBalancedAllocation", enabled=False
+                    ),
+                ),
+            ),
+        ),
+    )
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_pods_dispatch_to_their_profile(mode):
+    store = ClusterStore()
+    # n0 busy, n1 idle: default profile prefers n1 (least-allocated);
+    # busy-packer scores both equally -> lowest index n0
+    store.add_node(mk_node("n0", cpu=4000))
+    store.add_node(mk_node("n1", cpu=4000))
+    store.add_pod(mk_pod("filler", cpu=2000, node_name="n0"))
+    sched = Scheduler(store, _two_profile_cfg(mode))
+    store.add_pod(mk_pod("default-pod", cpu=500))
+    p = mk_pod("packer-pod", cpu=500)
+    p.scheduler_name = "busy-packer"
+    store.add_pod(p)
+    sched.run_until_idle()
+    pods = {q.name: q.node_name for q in store.pods.values()}
+    assert pods["default-pod"] == "n1"
+    assert pods["packer-pod"] == "n0"
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_unknown_scheduler_name_ignored(mode):
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=4000))
+    sched = Scheduler(store, SchedulerConfiguration(mode=mode))
+    store.add_pod(mk_pod("ours", cpu=500))
+    other = mk_pod("theirs", cpu=500)
+    other.scheduler_name = "some-other-scheduler"
+    store.add_pod(other)
+    sched.run_until_idle()
+    pods = {q.name: q for q in store.pods.values()}
+    assert pods["ours"].node_name == "n0"
+    # not scheduled, not failed — simply not ours
+    assert pods["theirs"].node_name == ""
+    assert not any(
+        e.pod == other.uid for e in sched.events.by_reason("FailedScheduling")
+    )
+
+
+@pytest.mark.parametrize("mode", ["cpu", "tpu"])
+def test_disabled_plugin_keeps_its_filter(mode):
+    """PluginSpec(enabled=False) disables the SCORE point only — exactly the
+    batch kernels' lowering (score weight 0, feasibility always enforced).
+    Regression: the CPU path once dropped the whole plugin, letting a pod
+    overcommit a node the kernels would reject."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=1000))
+    cfg = SchedulerConfiguration(
+        mode=mode,
+        profiles=(
+            Profile(plugins=(PluginSpec(name="NodeResourcesFit", enabled=False),)),
+        ),
+    )
+    sched = Scheduler(store, cfg)
+    store.add_pod(mk_pod("big", cpu=5000))
+    sched.run_until_idle()
+    assert store.pods[next(iter(store.pods))].node_name == ""  # stays pending
