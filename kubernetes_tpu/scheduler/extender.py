@@ -59,6 +59,9 @@ class ExtenderConfig:
     filter_verb: str = ""
     prioritize_verb: str = ""
     bind_verb: str = ""
+    # supportsPreemption: when set, DefaultPreemption offers this extender
+    # its candidate victim map (extender.go — ProcessPreemption)
+    preempt_verb: str = ""
     weight: float = 1.0
     ignorable: bool = False
     timeout_s: float = 5.0
@@ -110,6 +113,49 @@ class HTTPExtender:
             for h in out
             if isinstance(h, dict) and "host" in h
         }
+
+    # ---------------------------------------------------------- preemption
+    def process_preemption(
+        self, pod: t.Pod, node_to_victims: Dict[str, List[t.Pod]]
+    ) -> Dict[str, List[t.Pod]]:
+        """extender.go — ProcessPreemption: offer the candidate victim map;
+        the extender returns the surviving subset (it may drop whole nodes
+        or trim a node's victim list).  Wire shape is the reference's
+        ExtenderPreemptionArgs / nodeNameToMetaVictims (victims by uid).
+        Raises ExtenderError on transport failure (caller applies
+        `ignorable`)."""
+        if not self.cfg.preempt_verb:
+            return node_to_victims
+        try:
+            out = self._post(
+                self.cfg.preempt_verb,
+                {
+                    "pod": to_manifest(pod),
+                    "nodeNameToMetaVictims": {
+                        node: {"pods": [{"uid": q.uid} for q in victims]}
+                        for node, victims in node_to_victims.items()
+                    },
+                },
+            )
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise ExtenderError(f"{self.cfg.url_prefix}: {e}") from e
+        if out.get("error"):
+            raise ExtenderError(out["error"])
+        by_uid = {
+            q.uid: q for victims in node_to_victims.values() for q in victims
+        }
+        result: Dict[str, List[t.Pod]] = {}
+        for node, meta in (out.get("nodeNameToMetaVictims") or {}).items():
+            if node not in node_to_victims:
+                continue  # an extender cannot invent candidates
+            kept = [
+                by_uid[m["uid"]]
+                for m in (meta or {}).get("pods", [])
+                if m.get("uid") in by_uid
+            ]
+            if kept:
+                result[node] = kept
+        return result
 
     # ---------------------------------------------------------------- bind
     def bind(self, pod: t.Pod, node_name: str) -> Optional[str]:

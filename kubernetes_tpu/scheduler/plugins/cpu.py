@@ -28,6 +28,7 @@ from ..framework import (
     PluginWeight,
     Status,
 )
+from ..extender import ExtenderError
 from ..queue import EV_NODE_ADD, EV_NODE_UPDATE, EV_POD_ADD, EV_POD_DELETE
 
 f32 = np.float32
@@ -400,18 +401,24 @@ class DefaultPreemption(Plugin):
 
     name = "DefaultPreemption"
 
-    def __init__(self, filter_fn, store, nominated_fn=None):
+    def __init__(self, filter_fn, store, nominated_fn=None, extenders=()):
         self.filter_fn = filter_fn  # (state, snap, pod, NodeInfo) -> Status
         self.store = store
         # node_name -> [nominated pods] (the queue's nominator); preemption
         # must respect other preemptors' reservations (the reference's
         # SelectVictimsOnNode filters through RunFilterPluginsWithNominatedPods)
         self.nominated_fn = nominated_fn
+        # preemption-capable extenders get the candidate map before the node
+        # pick (extender.go — ProcessPreemption / SupportsPreemption)
+        self.extenders = [e for e in extenders if e.cfg.preempt_verb]
 
     def PostFilter(self, state, snap, pod, statuses) -> Tuple[Optional[str], Status]:
         sc = state.data["scaled"]
         pdbs = list(getattr(self.store, "pdbs", {}).values())
         best = None  # ((violations, max_prio, sum_prio, count, node_idx), victims, name)
+        # with preemption-capable extenders, ALL candidates are collected and
+        # offered before the pick; without them the best is tracked streaming
+        candidates: dict = {} if self.extenders else None
         for i, info in enumerate(sc.infos):
             lower = [q for q in info.pods if q.priority < pod.priority]
             if not lower:
@@ -436,6 +443,7 @@ class DefaultPreemption(Plugin):
                 # so the final victim set avoids PDB damage when possible
                 violating, non_violating = _split_pdb_violating(lower, pdbs)
                 victims: List[t.Pod] = []
+                viol_uids: set = set()
                 n_violations = 0
                 for group, counts in ((violating, True), (non_violating, False)):
                     for q in sorted(group, key=lambda q: (-q.priority, q.uid)):
@@ -448,6 +456,7 @@ class DefaultPreemption(Plugin):
                         victims.append(q)
                         if counts:
                             n_violations += 1
+                            viol_uids.add(q.uid)
                 if victims and nom:
                     # second pass of the two-pass nominated filter: feasibility
                     # must not DEPEND on a nominated pod that may never arrive
@@ -469,8 +478,53 @@ class DefaultPreemption(Plugin):
                 len(victims),
                 i,
             )
-            if best is None or key < best[0]:
-                best = (key, victims, info.node.name)
+            if candidates is not None:
+                # the pick happens after the extender round; each victim's
+                # PDB classification from the reprieve pass rides along so a
+                # trimmed set re-keys with the SAME semantics as streaming
+                candidates[info.node.name] = (key, victims, viol_uids)
+            else:
+                if best is None or key < best[0]:
+                    best = (key, victims, info.node.name)
+        if candidates is not None:
+            if not candidates:
+                return None, Status.unschedulable("preemption: no candidates")
+            node_map = {n: v for n, (_, v, _) in candidates.items()}
+            for ext in self.extenders:
+                try:
+                    node_map = ext.process_preemption(pod, node_map)
+                except ExtenderError as e:
+                    if ext.cfg.ignorable:
+                        continue
+                    return None, Status.unschedulable(
+                        f"preemption extender: {e}"
+                    )
+                if not node_map:
+                    return None, Status.unschedulable(
+                        "preemption: extenders rejected all candidates"
+                    )
+            best = None
+            for node, kept in node_map.items():
+                key0, orig, viol = candidates[node]
+                kept_uids = {q.uid for q in kept}
+                if kept_uids == {q.uid for q in orig}:
+                    # untouched candidate: the streaming key stands as-is
+                    key, chosen = key0, orig
+                else:
+                    # trimmed set: keep the ORIGINAL victim order and each
+                    # victim's reprieve-time PDB classification (the
+                    # reference echoes NumPDBViolations through the extender
+                    # round rather than re-deriving it)
+                    chosen = [q for q in orig if q.uid in kept_uids]
+                    key = (
+                        sum(1 for q in chosen if q.uid in viol),
+                        max(q.priority for q in chosen),
+                        sum(q.priority for q in chosen),
+                        len(chosen),
+                        key0[4],
+                    )
+                if best is None or key < best[0]:
+                    best = (key, chosen, node)
         if best is None:
             return None, Status.unschedulable("preemption: no candidates")
         _, victims, node_name = best
@@ -481,7 +535,7 @@ class DefaultPreemption(Plugin):
 
 def default_plugins(
     store, filter_fn=None, nominated_fn=None, hard_pod_affinity_weight: float = 1.0,
-    plugin_specs=(),
+    plugin_specs=(), extenders=(),
 ) -> List[PluginWeight]:
     """The default profile — plugin set and weights mirroring
     default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
@@ -507,7 +561,11 @@ def default_plugins(
         PluginWeight(ImageLocality(), 1.0),
     ]
     if filter_fn is not None:
-        pls.append(PluginWeight(DefaultPreemption(filter_fn, store, nominated_fn)))
+        pls.append(
+            PluginWeight(
+                DefaultPreemption(filter_fn, store, nominated_fn, extenders)
+            )
+        )
     pls.append(PluginWeight(VolumeBinding(store)))
     pls.append(PluginWeight(DefaultBinder(store)))
     by_name = {s.name: s for s in plugin_specs}
@@ -515,14 +573,13 @@ def default_plugins(
         # enabled=False disables the SCORE point only (weight 0) — exactly
         # what config.score_config does for the batch kernels, which always
         # keep feasibility filters.  Filters stay active on both paths.
-        pls = [
-            PluginWeight(
-                pw.plugin,
-                (s.weight if s.enabled else 0.0) if s is not None else pw.weight,
-            )
-            for pw in pls
-            for s in (by_name.get(getattr(pw.plugin, "name", "")),)
-        ]
+        def _weight(pw: PluginWeight) -> float:
+            s = by_name.get(getattr(pw.plugin, "name", ""))
+            if s is None:
+                return pw.weight
+            return s.weight if s.enabled else 0.0
+
+        pls = [PluginWeight(pw.plugin, _weight(pw)) for pw in pls]
     return pls
 
 

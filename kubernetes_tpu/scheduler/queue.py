@@ -138,6 +138,17 @@ class PriorityQueue:
         heapq.heappush(self._active, _Item(self._key(pod), pod))
         self._active_uids.add(pod.uid)
 
+    def forgive_attempt(self, pod_uid: str) -> None:
+        """Undo one attempt increment: a pod drained by pop_all but handed
+        back untouched (e.g. another profile's batch cycle) was never
+        actually attempted, and must not accrue exponential backoff."""
+        with self._lock:
+            n = self._attempts.get(pod_uid, 0)
+            if n > 1:
+                self._attempts[pod_uid] = n - 1
+            else:
+                self._attempts.pop(pod_uid, None)
+
     def _flush_backoff(self) -> None:
         now = self.clock.now()
         # flushUnschedulablePodsLeftover: event-parked pods retry eventually

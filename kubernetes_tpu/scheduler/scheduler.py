@@ -112,6 +112,7 @@ class Scheduler:
                     nominated_fn=lambda n: self.queue.nominated_pods_for_node(n),
                     hard_pod_affinity_weight=p.hard_pod_affinity_weight,
                     plugin_specs=p.plugins,
+                    extenders=self.extenders,
                 )
             )
             for p in config.profiles
@@ -550,6 +551,8 @@ class Scheduler:
             for p in batch:
                 if (p.scheduler_name or self.default_profile_name) != lead:
                     self.queue.add(p)
+                    # drained but never attempted: no backoff accrual
+                    self.queue.forgive_attempt(p.uid)
             batch = mine
         profile_name = lead
         snap = self.cache.update_snapshot()

@@ -210,3 +210,69 @@ def test_extender_outage_does_not_trigger_preemption():
     sched.run_until_idle(5)
     assert "default/victim" in store.pods  # not evicted
     assert store.pods["default/high"].node_name == ""
+
+
+class _PreemptHandler(BaseHTTPRequestHandler):
+    """A toy preemption-capable extender: rejects candidates on nodes whose
+    name ends with -protected (extender.go — ProcessPreemption)."""
+
+    calls = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        assert self.path.endswith("/preempt"), self.path
+        _PreemptHandler.calls.append(body)
+        kept = {
+            node: meta
+            for node, meta in body["nodeNameToMetaVictims"].items()
+            if not node.endswith("-protected")
+        }
+        out = {"nodeNameToMetaVictims": kept, "error": ""}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_extender_process_preemption_drops_protected_nodes():
+    """Preemption offers the candidate victim map to preempt-verb extenders
+    before picking a node; a node the extender rejects is never preempted
+    even when it is otherwise the lexicographic best."""
+    _PreemptHandler.calls = []
+    srv = HTTPServer(("127.0.0.1", 0), _PreemptHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}"
+        store = ClusterStore()
+        # n0-protected would be the preferred candidate (lower index/equal
+        # key); the extender forces n1
+        store.add_node(mk_node("n0-protected", cpu=1000, pods=4))
+        store.add_node(mk_node("n1", cpu=1000, pods=4))
+        store.add_pod(mk_pod("v0", cpu=900, priority=0, node_name="n0-protected"))
+        store.add_pod(mk_pod("v1", cpu=900, priority=0, node_name="n1"))
+        cfg = SchedulerConfiguration(
+            mode="cpu",
+            extenders=(ExtenderConfig(url_prefix=url, preempt_verb="preempt"),),
+        )
+        from kubernetes_tpu.scheduler.queue import FakeClock
+
+        clock = FakeClock()
+        sched = Scheduler(store, cfg, clock=clock)
+        store.add_pod(mk_pod("hi", cpu=900, priority=100))
+        sched.run_until_idle()
+        assert _PreemptHandler.calls, "extender was never offered candidates"
+        offered = set(_PreemptHandler.calls[0]["nodeNameToMetaVictims"])
+        assert offered == {"n0-protected", "n1"}
+        clock.step(2.0)  # the preemptor retries after its backoff
+        sched.run_until_idle()
+        pods = {q.name: q.node_name for q in store.pods.values()}
+        assert pods["hi"] == "n1"  # protected node never preempted
+        assert "v0" in pods and pods["v0"] == "n0-protected"  # v0 survived
+        assert "v1" not in pods  # v1 evicted
+    finally:
+        srv.shutdown()
