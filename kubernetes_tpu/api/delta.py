@@ -138,6 +138,23 @@ def wave_fingerprint(reps: Sequence[t.Pod], resources: Sequence[str]) -> WaveFin
 # --------------------------------------------------------------------------
 
 
+@dataclass
+class _WaveView:
+    """Snapshot-shaped view for the pregrouped (sidecar) encode path: the
+    wire carries no PV/PVC/class/slice schema (D10 — constraints arrive
+    pre-resolved), so the storage surfaces are permanently empty."""
+
+    nodes: list
+    pending_pods: tuple
+    bound_pods: list
+    pod_groups: dict
+    pvs: tuple = ()
+    pvcs: dict = field(default_factory=dict)
+    storage_classes: dict = field(default_factory=dict)
+    resource_slices: tuple = ()
+    device_classes: dict = field(default_factory=dict)
+
+
 class _Fallback(Exception):
     """A delta cannot be absorbed bit-exactly — rebuild the cluster side."""
 
@@ -672,7 +689,12 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
                 wid = packed >> 32
                 went = wave_store[wid]
                 i = packed & 0xFFFFFFFF
-                ent_wave = (went[0][i], went[1][went[2][i]])
+                rep_i = went[1][went[2][i]]
+                # pregrouped waves store no per-pod objects: the rep IS the
+                # wave-time object (bind copies clone from it server-side)
+                ent_wave = (
+                    went[0][i] if went[0] is not None else rep_i, rep_i
+                )
                 went[3] -= 1  # drained waves release their pod lists
                 if went[3] <= 0:
                     del wave_store[wid]
@@ -797,11 +819,24 @@ class DeltaEncoder:
         host array is IDENTICAL (by object) to the previous cycle's reuse the
         resident device buffer, so a warm cluster re-transfers only the wave's
         pod-side arrays and the delta-touched cluster state."""
+        return self._to_device(*self.encode(snap))
+
+    def encode_device_pregrouped(
+        self, nodes, bound_pods, pod_groups, uids, reps, inv
+    ):
+        """encode_pregrouped() + the same resident-device-buffer reuse as
+        encode_device()."""
+        return self._to_device(
+            *self.encode_pregrouped(
+                nodes, bound_pods, pod_groups, uids, reps, inv
+            )
+        )
+
+    def _to_device(self, arr, meta):
         import dataclasses as _dc
 
         import jax
 
-        arr, meta = self.encode(snap)
         out = {}
         for f in _dc.fields(type(arr)):
             a = getattr(arr, f.name)
@@ -842,6 +877,87 @@ class DeltaEncoder:
         sorted_pending = [pending[i] for i in perm]
         reps, inv, rep_keys = self._group_cached(sorted_pending)
         resources = _resource_axis(snap)
+        return self._encode_core(
+            snap, (raw_nodes_fp, storage_fp), raw_snap, reps, inv, perm,
+            rep_keys, resources,
+            wave_uids=[p.uid for p in sorted_pending],
+            wave_pods=sorted_pending,
+        )
+
+    def encode_pregrouped(
+        self, nodes, bound_pods, pod_groups, uids, reps, inv
+    ):
+        """The sidecar session path: the wire ships the wave already
+        INTERNED (spec reps + per-pod spec index + uids, convert.py —
+        wave_parts_from_proto) and volume/DRA constraints already resolved
+        client-side (D10), so the wave is encoded WITHOUT materializing one
+        pod object per pending pod — at 50k pods/wave the clone loop alone
+        was the largest host cost on the wire path.
+
+        `reps` SHOULD be identity-stable across waves (the sidecar's
+        per-session rep cache) so the rep-key memo and the pad cache hit;
+        fresh objects only cost re-canonicalization, never correctness."""
+        import numpy as np
+
+        from .snapshot import _resource_axis
+
+        inv = np.asarray(inv, dtype=np.int64)
+        # activeQ order from rep priorities (activeq_order on materialized
+        # pods reads the same field)
+        prio = (
+            np.array([r.priority for r in reps], dtype=np.int64)[inv]
+            if len(reps)
+            else np.zeros(len(uids), dtype=np.int64)
+        )
+        perm = np.argsort(-prio, kind="stable")
+        inv_sorted = inv[perm]
+        uids_sorted = [uids[i] for i in perm]
+        rep_keys = tuple(self._rep_key(r) for r in reps)
+        shim = _WaveView(
+            nodes=nodes, pending_pods=(), bound_pods=bound_pods,
+            pod_groups=pod_groups,
+        )
+        # resource axis via the one shared first-seen rule (snapshot.py —
+        # _resource_axis); reps stand in for the pending pods
+        resources = _resource_axis(
+            _WaveView(
+                nodes=nodes, pending_pods=tuple(reps),
+                bound_pods=bound_pods, pod_groups=pod_groups,
+            )
+        )
+        fps = raw_fingerprints(shim)
+        return self._encode_core(
+            shim, fps, shim, reps, inv_sorted, perm, rep_keys, resources,
+            wave_uids=uids_sorted, wave_pods=None,
+        )
+
+    def _rep_key(self, rep):
+        """Canonical spec key per rep, memoized by object identity (the
+        sidecar rep cache keeps reps alive and stable across waves)."""
+        memo = getattr(self, "_rep_key_memo", None)
+        if memo is None:
+            memo = self._rep_key_memo = {}
+        ent = memo.get(id(rep))
+        if ent is not None and ent[1] is rep:
+            return ent[0]
+        if len(memo) > 65536:
+            memo.clear()
+        from .snapshot import _pod_spec_key
+
+        key = _pod_spec_key(rep)
+        memo[id(rep)] = (key, rep)
+        return key
+
+    def _encode_core(
+        self, snap, fps, raw_snap, reps, inv, perm, rep_keys, resources,
+        wave_uids, wave_pods,
+    ):
+        """Shared tail of encode()/encode_pregrouped(): cluster-side reuse or
+        rebuild, bound-pod sync, wave bind-absorb bookkeeping, assembly.
+        `wave_pods` is None on the pregrouped path — bind-absorb then
+        revalidates bound copies against the REP (bind copies are cloned from
+        the rep server-side, so the field-identity checks still hold)."""
+        raw_nodes_fp, storage_fp = fps
         wfp = wave_fingerprint(reps, resources)
 
         cs = self._cs
@@ -874,17 +990,16 @@ class DeltaEncoder:
         # remember this wave's spec reps so the next cycle's bind-absorb is
         # O(1) per pod; size-capped so never-scheduled uids can't accumulate
         # unboundedly (evicted uids just re-take the per-pod slow path)
-        if len(cs.wave_ix) > 4 * (len(cs.records) + len(sorted_pending) + 1024):
+        if len(cs.wave_ix) > 4 * (len(cs.records) + len(wave_uids) + 1024):
             cs.wave_ix.clear()
             cs.wave_store.clear()
             cs.rep_bound_info.clear()
         wid = cs.wave_next
         cs.wave_next = wid + 1
-        cs.wave_store[wid] = [sorted_pending, reps, inv.tolist(),
-                              len(sorted_pending)]
+        cs.wave_store[wid] = [wave_pods, reps, inv.tolist(), len(wave_uids)]
         base = wid << 32
         cs.wave_ix.update(
-            zip((p.uid for p in sorted_pending), map(base.__or__, range(len(sorted_pending))))
+            zip(wave_uids, map(base.__or__, range(len(wave_uids))))
         )
         # waves drain by refcount as their pods bind (sync_bound), but a
         # STABLE backlog re-pends the same uids every cycle — wave_ix slots
@@ -895,7 +1010,10 @@ class DeltaEncoder:
             live = {v >> 32 for v in cs.wave_ix.values()}
             for w in [w for w in cs.wave_store if w not in live]:
                 del cs.wave_store[w]
-        return _assemble(cs, snap, reps, inv, perm, self.bucket, rep_keys)
+        return _assemble(
+            cs, snap, reps, inv, perm, self.bucket, rep_keys,
+            wave_names=wave_uids if wave_pods is None else None,
+        )
 
     @staticmethod
     def _verify_against_rebuild(cs: ClusterSide, snap, wfp: WaveFingerprint) -> None:
@@ -1184,6 +1302,7 @@ def _assemble(
     perm: np.ndarray,
     bucket: bool,
     rep_keys: Optional[Tuple] = None,
+    wave_names: Optional[List[str]] = None,
 ):
     """Build the wave (pod-side) arrays against the resident cluster side and
     assemble the full ClusterArrays + EncodingMeta.
@@ -1205,7 +1324,10 @@ def _assemble(
 
     nodes = cs.nodes
     pending = snap.pending_pods
-    n, p = len(nodes), len(pending)
+    n = len(nodes)
+    # pregrouped waves carry no per-pod objects: names/uids arrive directly
+    # (sorted order — the perm was already applied by the caller)
+    p = len(wave_names) if wave_names is not None else len(pending)
     N = _bucket(n) if bucket else max(1, n)
     P = _bucket(p) if bucket else max(1, p)
     resources = list(cs.wfp.resources)
@@ -1323,7 +1445,11 @@ def _assemble(
     )
     meta = EncodingMeta(
         node_names=[nd.name for nd in nodes],
-        pod_names=[pending[i].name for i in perm],
+        pod_names=(
+            list(wave_names)
+            if wave_names is not None
+            else [pending[i].name for i in perm]
+        ),
         pod_perm=perm,
         resources=resources,
         resource_scale=scale,

@@ -109,6 +109,45 @@ def node_to_proto(n: t.Node) -> pb.Node:
     )
 
 
+def clone_pod(rep: t.Pod, name: str, uid: str, node_name: str = "") -> t.Pod:
+    """__new__ + __dict__ copy — ~4x cheaper than copy.copy at wave rates;
+    field objects stay SHARED with the rep, which is what the encoder's
+    identity-level interning and bind-absorb `is`-checks key on."""
+    q = t.Pod.__new__(t.Pod)
+    d = rep.__dict__.copy()
+    d["name"] = name
+    d["uid"] = uid
+    d["node_name"] = node_name
+    q.__dict__ = d
+    return q
+
+
+def wave_parts_from_proto(
+    msg: pb.InternedWave, rep_cache: Optional[dict] = None
+) -> Tuple[List[str], List[t.Pod], "np.ndarray"]:
+    """-> (uids, reps, inv) WITHOUT materializing per-pod objects — the
+    encoder's pregrouped path (api/delta.py — encode_pregrouped) consumes
+    the interned form directly.  `rep_cache` memoizes decoded reps by
+    serialized spec bytes so successive waves reuse identical objects."""
+    import numpy as np
+
+    reps = []
+    for s in msg.specs:
+        if rep_cache is None:
+            reps.append(pod_from_proto(s))
+            continue
+        kb = s.SerializeToString()
+        rep = rep_cache.get(kb)
+        if rep is None:
+            if len(rep_cache) > 4096:
+                rep_cache.clear()
+            rep = pod_from_proto(s)
+            rep_cache[kb] = rep
+        reps.append(rep)
+    inv = np.asarray(msg.spec_idx, dtype=np.int64)
+    return list(msg.uids), reps, inv
+
+
 def wave_from_proto(
     msg: pb.InternedWave, rep_cache: Optional[dict] = None
 ) -> List[t.Pod]:

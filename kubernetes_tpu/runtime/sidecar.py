@@ -37,10 +37,12 @@ from ..api import types as t
 from ..api.snapshot import Snapshot
 from . import tpuscore_pb2 as pb
 from .convert import (
+    clone_pod,
     node_from_proto,
     pod_from_proto,
     snapshot_from_proto,
     wave_from_proto,
+    wave_parts_from_proto,
 )
 
 SERVICE = "tpuscore.TPUScore"
@@ -57,6 +59,8 @@ class _Session:
         self.hpaw = hpaw
         self.nodes: List[t.Node] = []
         self.bound: Dict[str, t.Pod] = {}
+        # uid -> the wave pod's spec REP (no per-pod objects exist on the
+        # session path; bind copies clone from the rep with clone_pod)
         self.last_wave: Dict[str, t.Pod] = {}
         # serialized-spec-bytes -> decoded rep Pod (convert.wave_from_proto):
         # keeps rep OBJECTS stable across waves so the resident encoder's
@@ -128,7 +132,9 @@ class _Engine:
         # session's requests (one client), so the dict is effectively
         # single-writer.  The dict is carried into a full-sync's fresh
         # session below so resyncs keep rep objects identity-stable.
-        wave = wave_from_proto(request.wave, rep_cache)
+        # No per-pod objects are materialized: the encoder consumes the
+        # interned (uids, reps, inv) form directly (encode_pregrouped).
+        wave = wave_parts_from_proto(request.wave, rep_cache)
         with self._state_lock:
             sess = self._sessions.get(request.session_id)
             if sess is not None:
@@ -139,15 +145,16 @@ class _Engine:
                 d = request.delta
                 if sess is None or sess.epoch != d.base_epoch or sess.hpaw != hpaw:
                     raise _ResyncRequired()
-                import copy
-
                 for b in d.binds:
-                    prev = sess.last_wave.get(b.pod_uid)
-                    if prev is None:
+                    rep = sess.last_wave.get(b.pod_uid)
+                    if rep is None:
                         raise _ResyncRequired()
-                    q = copy.copy(prev)  # spec fields verified client-side
-                    q.node_name = b.node
-                    sess.bound[b.pod_uid] = q
+                    # spec fields verified client-side; the bound copy shares
+                    # the rep's field objects, so the encoder's bind-absorb
+                    # `is`-checks hold
+                    sess.bound[b.pod_uid] = clone_pod(
+                        rep, b.pod_uid, b.pod_uid, b.node
+                    )
                 for uid in d.deleted_uids:
                     sess.bound.pop(uid, None)
                 for msg in d.added_bound:
@@ -173,34 +180,35 @@ class _Engine:
                 g.name: t.PodGroup(name=g.name, min_member=g.min_member)
                 for g in request.snapshot.pod_groups
             }
-            sess.last_wave = {p.uid: p for p in wave}
+            uids, reps, inv = wave
+            sess.last_wave = dict(zip(uids, (reps[i] for i in inv.tolist())))
             sess.epoch = request.epoch
-            return sess, wave
+            # capture the encode inputs UNDER the state lock: the warmup
+            # thread (and run_session) must never iterate sess.bound while a
+            # later RPC's delta mutates it
+            view = (list(sess.bound.values()), dict(sess.pod_groups))
+            return sess, wave, view
 
-    def session_snapshot(self, sess: _Session, wave: List[t.Pod]) -> Snapshot:
-        return Snapshot(
-            nodes=sess.nodes,
-            pending_pods=wave,
-            bound_pods=list(sess.bound.values()),
-            pod_groups=dict(sess.pod_groups),
-        )
-
-    def coarse_shape(self, snap: Snapshot, gang: bool):
+    def coarse_shape_parts(self, sess: _Session, wave, gang: bool):
         from ..api.snapshot import _bucket
 
-        return (
-            _bucket(len(snap.pending_pods)),
-            _bucket(len(snap.nodes)),
-            gang,
-        )
+        uids, _reps, _inv = wave
+        return (_bucket(len(uids)), _bucket(len(sess.nodes)), gang)
 
-    def run_session(self, sess: _Session, snap: Snapshot, gang: bool):
+    def run_session(self, sess: _Session, wave, gang: bool, view=None):
         from ..ops import schedule_batch
         from ..ops.gang import schedule_with_gangs
         from ..ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
 
+        uids, reps, inv = wave
+        if view is None:  # direct callers (tests) outside an RPC
+            with self._state_lock:
+                view = (list(sess.bound.values()), dict(sess.pod_groups))
+        bound, groups = view
         with self._lock:
-            arr, meta = sess.enc.encode(snap)
+            arr, meta = sess.enc.encode_device_pregrouped(
+                sess.nodes, bound, groups, uids, reps, inv,
+            )
             base = dataclasses.replace(
                 DEFAULT_SCORE_CONFIG, hard_pod_affinity_weight=sess.hpaw
             )
@@ -209,10 +217,10 @@ class _Engine:
                 choices, _ = schedule_with_gangs(arr, cfg)
             else:
                 choices = np.asarray(schedule_batch(arr, cfg)[0])
-            self._compiled.add(self.coarse_shape(snap, gang))
+            self._compiled.add(self.coarse_shape_parts(sess, wave, gang))
             return choices, meta
 
-    def warmup(self, sess: _Session, snap: Snapshot, gang: bool) -> None:
+    def warmup(self, sess: _Session, wave, gang: bool, view=None) -> None:
         """Background: encode + compile + run once, then mark ready.  The
         results are discarded — the client already took the CPU fallback for
         this cycle; what survives is the jit cache and the session's resident
@@ -220,7 +228,7 @@ class _Engine:
         client's next request resyncs instead of hitting a session that
         claims ready but cannot serve."""
         try:
-            self.run_session(sess, snap, gang)
+            self.run_session(sess, wave, gang, view)
         except Exception:  # noqa: BLE001 — crash-only containment
             with self._state_lock:
                 sess.warming = False
@@ -274,19 +282,17 @@ class TPUScoreServer:
         if not request.session_id:
             return self._schedule_stateless(request, t0)
         try:
-            sess, wave = self.engine.apply_request(request)
+            sess, wave, view = self.engine.apply_request(request)
         except _ResyncRequired:
             return pb.ScheduleResponse(resync_required=True)
-        snap = self.engine.session_snapshot(sess, wave)
         if not sess.ready:
             eng = self.engine
             small = (
-                len(snap.pending_pods) * max(1, len(snap.nodes))
-                < eng.warmup_threshold
+                len(wave[0]) * max(1, len(sess.nodes)) < eng.warmup_threshold
             )
             spawn = False
             with eng._state_lock:  # check-then-act atomic across the RPC pool
-                if small or eng.coarse_shape(snap, request.gang) in eng._compiled:
+                if small or eng.coarse_shape_parts(sess, wave, request.gang) in eng._compiled:
                     # compile affordable (or already paid): serve synchronously
                     sess.ready = True
                 elif not sess.warming:
@@ -294,11 +300,13 @@ class TPUScoreServer:
                     spawn = True
             if spawn:
                 threading.Thread(
-                    target=eng.warmup, args=(sess, snap, request.gang), daemon=True
+                    target=eng.warmup,
+                    args=(sess, wave, request.gang, view),
+                    daemon=True,
                 ).start()
             if not sess.ready:
                 return pb.ScheduleResponse(not_ready=True, epoch=sess.epoch)
-        choices, meta = self.engine.run_session(sess, snap, request.gang)
+        choices, meta = self.engine.run_session(sess, wave, request.gang, view)
         # aligned-array verdicts: node index per wave pod in REQUEST order
         # (meta.pod_perm maps device order -> request order; node indices are
         # the session's node-list order == the client's own node list)

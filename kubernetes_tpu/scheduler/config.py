@@ -169,6 +169,7 @@ def from_yaml(text: str) -> SchedulerConfiguration:
             filter_verb=e.get("filterVerb", ""),
             prioritize_verb=e.get("prioritizeVerb", ""),
             bind_verb=e.get("bindVerb", ""),
+            preempt_verb=e.get("preemptVerb", ""),
             weight=float(e.get("weight", 1.0)),
             ignorable=bool(e.get("ignorable", False)),
             timeout_s=float(e.get("httpTimeout", 5.0)),

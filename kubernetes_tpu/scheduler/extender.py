@@ -141,17 +141,19 @@ class HTTPExtender:
             raise ExtenderError(f"{self.cfg.url_prefix}: {e}") from e
         if out.get("error"):
             raise ExtenderError(out["error"])
-        by_uid = {
-            q.uid: q for victims in node_to_victims.values() for q in victims
-        }
         result: Dict[str, List[t.Pod]] = {}
         for node, meta in (out.get("nodeNameToMetaVictims") or {}).items():
-            if node not in node_to_victims:
-                continue  # an extender cannot invent candidates
+            orig = node_to_victims.get(node)
+            if orig is None:
+                continue  # an extender cannot invent candidate nodes
+            # ... nor move victims between nodes: only THIS node's own
+            # candidates resolve (the reference's convertToVictims rejects
+            # unknown uids the same way)
+            own = {q.uid: q for q in orig}
             kept = [
-                by_uid[m["uid"]]
+                own[m["uid"]]
                 for m in (meta or {}).get("pods", [])
-                if m.get("uid") in by_uid
+                if m.get("uid") in own
             ]
             if kept:
                 result[node] = kept

@@ -89,3 +89,27 @@ def test_disabled_plugin_keeps_its_filter(mode):
     store.add_pod(mk_pod("big", cpu=5000))
     sched.run_until_idle()
     assert store.pods[next(iter(store.pods))].node_name == ""  # stays pending
+
+
+def test_other_profile_requeue_accrues_no_backoff():
+    """A batch cycle drains the whole activeQ but schedules one profile per
+    cycle; the other profiles' pods are handed back untouched and must not
+    accrue exponential backoff for the phantom attempt (queue.pop_all bumps
+    the attempt counter; the requeue forgives it)."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=64000, pods=200))
+    cfg = _two_profile_cfg("tpu")
+    sched = Scheduler(store, cfg)
+    for i in range(6):
+        p = mk_pod(f"a{i}", cpu=100)
+        store.add_pod(p)
+        q = mk_pod(f"b{i}", cpu=100)
+        q.scheduler_name = "busy-packer"
+        store.add_pod(q)
+    sched.run_until_idle()
+    assert all(p.node_name == "n0" for p in store.pods.values())
+    # nobody failed scheduling, so nobody should carry attempt counts that
+    # inflate a FUTURE failure's backoff beyond the initial step
+    assert all(v <= 1 for v in sched.queue._attempts.values()), (
+        sched.queue._attempts
+    )
