@@ -113,3 +113,32 @@ def test_other_profile_requeue_accrues_no_backoff():
     assert all(v <= 1 for v in sched.queue._attempts.values()), (
         sched.queue._attempts
     )
+
+
+def test_custom_weight_profile_never_offloads_to_sidecar():
+    """The wire protocol carries hardPodAffinityWeight but not arbitrary
+    plugin weights, so a profile with customized score weights schedules
+    in-process (kernels honor its ScoreConfig) instead of receiving
+    default-weight verdicts from the sidecar.  With a dead sidecar address
+    this only works if the offload is skipped entirely — no fallback
+    metric, no connection attempt."""
+    from kubernetes_tpu.scheduler.config import TPUScoreArgs
+
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=4000))
+    cfg = SchedulerConfiguration(
+        mode="tpu",
+        profiles=(
+            Profile(
+                plugins=(PluginSpec(name="TaintToleration", weight=9.0),),
+                tpu_score=TPUScoreArgs(
+                    sidecar_address="127.0.0.1:1"  # nothing listens here
+                ),
+            ),
+        ),
+    )
+    sched = Scheduler(store, cfg)
+    store.add_pod(mk_pod("p", cpu=500))
+    sched.run_until_idle()
+    assert store.pods["default/p"].node_name == "n0"
+    assert sched.metrics.counters.get("tpuscore_fallback_total", 0) == 0
