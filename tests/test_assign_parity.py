@@ -277,3 +277,55 @@ def test_chunked_scan_parity_when_topk_not_exhaustive(seed):
     )
     np.testing.assert_array_equal(chunked, plain)
     assert_parity(snap)
+
+
+def test_chunked_scan_plateau_wider_than_candidate_list():
+    """More identical nodes than K = C+1: the tied-score plateau extends
+    past every pod's candidate list, so correctness leans on the
+    top_k lowest-index-ties ordering + the clean-head domination argument
+    at its boundary.  Identical pods make every step a plateau pick."""
+    import jax
+
+    from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+    from kubernetes_tpu.ops.assign import _CHUNK, _chunkable, schedule_scan, schedule_scan_chunked
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    n_nodes = _CHUNK + 60  # > K = C+1, all identical
+    nodes = [mk_node(f"n{i:04d}", cpu=4000, pods=300) for i in range(n_nodes)]
+    pods = [mk_pod(f"p{i:05d}", cpu=50) for i in range(2 * _CHUNK)]
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg) and arr.N > _CHUNK + 1
+    plain = np.asarray(jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0])
+    chunked = np.asarray(
+        jax.jit(schedule_scan_chunked, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    np.testing.assert_array_equal(chunked, plain)
+    assert_parity(snap)
+
+
+def test_chunked_scan_capacity_exhausts_mid_chunk():
+    """Capacity runs out partway through a chunk: later pods must go
+    unschedulable (-1) exactly where the per-pod scan says, exercising the
+    t == c == -1 validity path and fit monotonicity mid-round."""
+    import jax
+
+    from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+    from kubernetes_tpu.ops.assign import _chunkable, schedule_scan, schedule_scan_chunked
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    nodes = [mk_node(f"n{i}", cpu=1000, pods=500) for i in range(140)]
+    # 140 nodes x 1 pod of 900m each = exactly 140 fit; the rest starve
+    pods = [mk_pod(f"p{i:05d}", cpu=900) for i in range(256)]
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunkable(arr, cfg)
+    plain = np.asarray(jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0])
+    chunked = np.asarray(
+        jax.jit(schedule_scan_chunked, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    np.testing.assert_array_equal(chunked, plain)
+    assert (plain[: meta.n_pods] >= 0).sum() == 140
+    assert_parity(snap)
