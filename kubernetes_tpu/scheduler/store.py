@@ -308,8 +308,6 @@ class ClusterStore:
 
 
 def replace_pod_nodename(pod: t.Pod, node_name: str) -> t.Pod:
-    import copy
-
-    q = copy.copy(pod)
-    q.node_name = node_name
-    return q
+    """Shallow copy with node_name set (types.pod_clone — the one shared
+    clone idiom; field objects stay shared per copy-on-write)."""
+    return t.pod_clone(pod, node_name=node_name)
