@@ -119,6 +119,8 @@ class Scheduler:
         }
         self.default_profile_name = config.profiles[0].scheduler_name
         self.framework = self.frameworks[self.default_profile_name]
+        # batch-cycle lead rotation (anti-starvation across profiles)
+        self._last_profile_served: Optional[str] = None
         self._sidecar = None  # most-recent client (kept for tests/introspection)
         self._sidecars: Dict[str, object] = {}  # per-address lazy TPUScoreClients
         # batched-bind move coalescing: while a batch commit loop runs, watch
@@ -541,19 +543,26 @@ class Scheduler:
         if not batch:
             return {}
         # one profile per batch cycle (the kernels take one static weight
-        # config): schedule the profile of the earliest-queued pod now and
-        # requeue the other profiles' pods untouched — run_until_idle picks
-        # them up next cycle.  Single-profile configs (the common case) never
-        # requeue anything.
-        lead = batch[0].scheduler_name or self.default_profile_name
-        if any((p.scheduler_name or self.default_profile_name) != lead for p in batch):
-            mine = [p for p in batch if (p.scheduler_name or self.default_profile_name) == lead]
-            for p in batch:
-                if (p.scheduler_name or self.default_profile_name) != lead:
+        # config): serve one profile now and requeue the other profiles'
+        # pods untouched — run_until_idle picks them up next cycle.  The
+        # lead rotates round-robin over the profiles present so continuous
+        # arrivals on one profile cannot starve another; single-profile
+        # configs (the common case) never requeue anything.
+        names = [p.scheduler_name or self.default_profile_name for p in batch]
+        present = list(dict.fromkeys(names))  # first-appearance order
+        lead = present[0]
+        if len(present) > 1:
+            last = self._last_profile_served
+            if last in present:
+                lead = present[(present.index(last) + 1) % len(present)]
+            mine = [p for p, n in zip(batch, names) if n == lead]
+            for p, n in zip(batch, names):
+                if n != lead:
                     self.queue.add(p)
                     # drained but never attempted: no backoff accrual
                     self.queue.forgive_attempt(p.uid)
             batch = mine
+        self._last_profile_served = lead
         profile_name = lead
         snap = self.cache.update_snapshot()
         bound_uids = {p.uid for p in snap.bound_pods}

@@ -142,3 +142,30 @@ def test_custom_weight_profile_never_offloads_to_sidecar():
     sched.run_until_idle()
     assert store.pods["default/p"].node_name == "n0"
     assert sched.metrics.counters.get("tpuscore_fallback_total", 0) == 0
+
+
+def test_batch_lead_profile_round_robins():
+    """Continuous arrivals on one profile must not starve another: the
+    batch cycle rotates its lead profile over the profiles present."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=64000, pods=500))
+    sched = Scheduler(store, _two_profile_cfg("tpu"))
+    for i in range(4):
+        store.add_pod(mk_pod(f"a{i}", cpu=100))
+        q = mk_pod(f"b{i}", cpu=100)
+        q.scheduler_name = "busy-packer"
+        store.add_pod(q)
+    # first cycle serves one profile and requeues the other...
+    first = sched.schedule_batch()
+    served1 = {n for n, v in first.items() if v}
+    assert served1 and len({n[0] for n in served1}) == 1  # ONE profile/cycle
+    # ...the next cycle must serve the OTHER profile even though new pods
+    # keep arriving on the first one
+    lead1 = sched._last_profile_served
+    for i in range(4, 8):
+        p = mk_pod(f"a{i}", cpu=100)
+        store.add_pod(p)
+    sched.schedule_batch()
+    assert sched._last_profile_served != lead1
+    sched.run_until_idle()
+    assert all(p.node_name for p in store.pods.values())
