@@ -54,7 +54,23 @@ class _View(ctypes.Structure):
         ("enable_pairwise", ctypes.c_uint8), ("enable_ports", ctypes.c_uint8),
         ("enable_taint", ctypes.c_uint8), ("enable_na", ctypes.c_uint8),
         ("enable_img", ctypes.c_uint8), ("enable_ip", ctypes.c_uint8),
+        # NodeResourcesFit scoringStrategy (0 Least, 1 Most, 2 RTCR) + shape
+        ("fit_strategy", ctypes.c_int32), ("n_shape", ctypes.c_int32),
+        ("shape_x", ctypes.c_float * 8), ("shape_y", ctypes.c_float * 8),
     ]
+
+
+def _strategy_code(cfg) -> int:
+    codes = {"LeastAllocated": 0, "MostAllocated": 1,
+             "RequestedToCapacityRatio": 2}
+    code = codes.get(cfg.fit_strategy)
+    if code is None:
+        raise ValueError(f"unknown fit scoringStrategy {cfg.fit_strategy!r}")
+    if code == 2 and len(cfg.rtcr_shape) > 8:
+        # the View struct carries at most 8 points; silent truncation would
+        # diverge from the kernels, so refuse loudly
+        raise ValueError("rtcr shape supports at most 8 points")
+    return code
 
 
 def _build() -> str:
@@ -144,6 +160,10 @@ def schedule_batch_native(
         enable_taint=int(cfg.enable_taint_score), enable_na=int(cfg.enable_node_pref),
         enable_img=int(enable_img),
         enable_ip=int(cfg.enable_pairwise and cfg.enable_interpod_score),
+        fit_strategy=_strategy_code(cfg),
+        n_shape=len(cfg.rtcr_shape),
+        shape_x=(ctypes.c_float * 8)(*[p[0] for p in cfg.rtcr_shape]),
+        shape_y=(ctypes.c_float * 8)(*[p[1] for p in cfg.rtcr_shape]),
     )
     rc = lib.schedule_native(ctypes.byref(view), _ptr(choices))
     if rc != 0:

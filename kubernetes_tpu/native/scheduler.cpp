@@ -60,6 +60,11 @@ struct View {
   int32_t r0, r1;  // scored resource indices
   uint8_t enable_pairwise, enable_ports, enable_taint, enable_na, enable_img,
       enable_ip;
+  // NodeResourcesFit scoringStrategy: 0 LeastAllocated, 1 MostAllocated,
+  // 2 RequestedToCapacityRatio (shape points interpolated like np.interp)
+  int32_t fit_strategy;
+  int32_t n_shape;            // number of rtcr shape points (<= 8)
+  float shape_x[8], shape_y[8];
 };
 
 inline float least_alloc(const int32_t *alloc_row, const int64_t *req_tot,
@@ -74,6 +79,63 @@ inline float least_alloc(const int32_t *alloc_row, const int64_t *req_tot,
     v1 = a > 0.f ? std::fmax(0.0f, (a - r) * MAXS / a) : 0.0f;
   }
   return (v0 + v1) / 2.0f;  // mean over the two scored resources
+}
+
+inline float most_alloc(const int32_t *alloc_row, const int64_t *req_tot,
+                        int r0, int r1) {
+  // most_allocated.go: 0 when alloc == 0 OR requested > alloc (no clamp)
+  float v0, v1;
+  {
+    float a = (float)alloc_row[r0], r = (float)req_tot[r0];
+    v0 = (a > 0.f && r <= a) ? r * MAXS / a : 0.0f;
+  }
+  {
+    float a = (float)alloc_row[r1], r = (float)req_tot[r1];
+    v1 = (a > 0.f && r <= a) ? r * MAXS / a : 0.0f;
+  }
+  return (v0 + v1) / 2.0f;
+}
+
+inline float interp_shape(float util, const float *xs, const float *ys,
+                          int n) {
+  // np.interp semantics: clamp outside, linear inside
+  if (n <= 0) return 0.0f;
+  if (util <= xs[0]) return ys[0];
+  if (util >= xs[n - 1]) return ys[n - 1];
+  for (int i = 1; i < n; i++) {
+    if (util <= xs[i]) {
+      float t = (util - xs[i - 1]) / (xs[i] - xs[i - 1]);
+      return ys[i - 1] + t * (ys[i] - ys[i - 1]);
+    }
+  }
+  return ys[n - 1];
+}
+
+inline float rtcr(const int32_t *alloc_row, const int64_t *req_tot, int r0,
+                  int r1, const float *xs, const float *ys, int n_shape) {
+  float v0, v1;
+  {
+    float a = (float)alloc_row[r0], r = (float)req_tot[r0];
+    v0 = a > 0.f
+             ? interp_shape(r * 100.0f / a, xs, ys, n_shape) * (MAXS / 10.0f)
+             : 0.0f;
+  }
+  {
+    float a = (float)alloc_row[r1], r = (float)req_tot[r1];
+    v1 = a > 0.f
+             ? interp_shape(r * 100.0f / a, xs, ys, n_shape) * (MAXS / 10.0f)
+             : 0.0f;
+  }
+  return (v0 + v1) / 2.0f;
+}
+
+inline float fit_score_strategy(const View *v, const int32_t *alloc_row,
+                                const int64_t *req_tot) {
+  if (v->fit_strategy == 1) return most_alloc(alloc_row, req_tot, v->r0, v->r1);
+  if (v->fit_strategy == 2)
+    return rtcr(alloc_row, req_tot, v->r0, v->r1, v->shape_x, v->shape_y,
+                v->n_shape);
+  return least_alloc(alloc_row, req_tot, v->r0, v->r1);
 }
 
 inline float balanced(const int32_t *alloc_row, const int64_t *req_tot,
@@ -277,7 +339,7 @@ extern "C" int schedule_native(const View *v, int32_t *choices) {
       const int32_t *al = v->alloc + (size_t)n * R;
       const int32_t *us = v->used + (size_t)n * R;
       for (int r = 0; r < R; r++) req_tot[r] = (int64_t)us[r] + req[r];
-      float total = v->w_fit * least_alloc(al, req_tot.data(), v->r0, v->r1) +
+      float total = v->w_fit * fit_score_strategy(v, al, req_tot.data()) +
                     v->w_bal * balanced(al, req_tot.data(), v->r0, v->r1);
       if (v->enable_taint) {
         float c = v->pref[(size_t)p * N + n];

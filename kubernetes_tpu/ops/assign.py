@@ -44,7 +44,7 @@ from .scores import (
     MAX_NODE_SCORE,
     ScoreConfig,
     balanced_allocation,
-    least_allocated,
+    fit_score,
     taint_prefer_counts,
 )
 
@@ -157,9 +157,9 @@ def schedule_scan(
             )
         requested = used + req[None, :]
         # score accumulation order mirrors the oracle exactly (float32 parity):
-        # fit, balanced, taint, nodeAffinity, spread
-        total = cfg.fit_weight * least_allocated(
-            requested, n_alloc, cfg.score_resources
+        # fit(strategy), balanced, taint, nodeAffinity, spread
+        total = cfg.fit_weight * fit_score(
+            requested, n_alloc, cfg
         ) + cfg.balanced_weight * balanced_allocation(
             requested, n_alloc, cfg.score_resources
         )
@@ -358,8 +358,8 @@ def schedule_scan_chunked(
     def score_flat(requested, alloc):
         """Same formulas as the dense hoist, on flattened [*, R] rows —
         elementwise ops, so float32 results are bit-identical."""
-        return cfg.fit_weight * least_allocated(
-            requested, alloc, res
+        return cfg.fit_weight * fit_score(
+            requested, alloc, cfg
         ) + cfg.balanced_weight * balanced_allocation(requested, alloc, res)
 
     def best_and_cand(vals, nodes, vu, iu):
@@ -379,8 +379,8 @@ def schedule_scan_chunked(
         requested = used0[None, :, :] + creq[:, None, :]  # [C, N, R]
         fit0 = jax.vmap(filters.fit_ok, (0, None, None))(creq, used0, n_alloc)
         total0 = cfg.fit_weight * jax.vmap(
-            least_allocated, (0, None, None)
-        )(requested, n_alloc, res) + cfg.balanced_weight * jax.vmap(
+            lambda rq, al: fit_score(rq, al, cfg), (0, None)
+        )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
             balanced_allocation, (0, None, None)
         )(requested, n_alloc, res)
         total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, N]

@@ -34,7 +34,16 @@ class ScoreConfig:
     default_plugins.go — getDefaultPlugins multipoint weights) and the scored
     resource axis indices (cpu, memory — noderesources defaults)."""
 
-    fit_weight: float = 1.0  # NodeResourcesFit (LeastAllocated strategy)
+    fit_weight: float = 1.0  # NodeResourcesFit score weight
+    # NodeResourcesFitArgs.scoringStrategy (noderesources/fit.go):
+    # LeastAllocated (default) | MostAllocated | RequestedToCapacityRatio
+    fit_strategy: str = "LeastAllocated"
+    # RequestedToCapacityRatio shape points as (utilization%, score) pairs,
+    # linearly interpolated (requested_to_capacity_ratio.go —
+    # buildRequestedToCapacityRatioScorerFunction); must be sorted by
+    # utilization.  Scores are in [0, 10] in the reference's shape and are
+    # rescaled to MaxNodeScore by the scorer.
+    rtcr_shape: Tuple[Tuple[float, float], ...] = ((0.0, 0.0), (100.0, 10.0))
     balanced_weight: float = 1.0  # NodeResourcesBalancedAllocation
     taint_weight: float = 3.0  # TaintToleration
     node_affinity_weight: float = 2.0  # NodeAffinity (preferred terms)
@@ -112,6 +121,88 @@ def least_allocated(
     r = requested[:, idx].astype(jnp.float32)
     per_res = jnp.where(a > 0, jnp.maximum(0.0, (a - r) * MAX_NODE_SCORE / a), 0.0)
     return per_res.mean(axis=1)
+
+
+def most_allocated(
+    requested: jax.Array, alloc: jax.Array, res_idx: Tuple[int, ...]
+) -> jax.Array:
+    """f32[N]: NodeResourcesFit MostAllocated strategy (bin-packing).
+
+    reference: noderesources/most_allocated.go — mostResourceScorer:
+    score_r = requested * 100 / alloc; 0 when alloc == 0 OR requested
+    exceeds alloc (the reference returns 0 for over-capacity rather than
+    clamping); node score = mean over scored resources."""
+    idx = jnp.array(res_idx, dtype=jnp.int32)
+    a = alloc[:, idx].astype(jnp.float32)
+    r = requested[:, idx].astype(jnp.float32)
+    per_res = jnp.where(
+        (a > 0) & (r <= a),
+        r * MAX_NODE_SCORE / jnp.where(a > 0, a, 1.0),
+        0.0,
+    )
+    return per_res.mean(axis=1)
+
+
+def interp_shape_f32(util: jax.Array, shape) -> jax.Array:
+    """Piecewise-linear interpolation through the RTCR shape points with ONE
+    EXPLICIT float32 op order — y0 + t*(y1-y0), t = (u-x0)/(x1-x0) — mirrored
+    verbatim by the oracle (_rtcr) and the C++ engine (interp_shape), so all
+    three engines agree bit-for-bit (np.interp/jnp.interp would each use
+    their own internal precision/op order).  Clamps outside the shape."""
+    xs = [jnp.float32(p[0]) for p in shape]
+    ys = [jnp.float32(p[1]) for p in shape]
+    out = jnp.full_like(util, ys[-1])
+    # descending so the FIRST matching segment wins (strictly increasing xs
+    # are enforced by config validation)
+    for i in range(len(xs) - 1, 0, -1):
+        t = (util - xs[i - 1]) / (xs[i] - xs[i - 1])
+        seg = ys[i - 1] + t * (ys[i] - ys[i - 1])
+        out = jnp.where(util <= xs[i], seg, out)
+    return jnp.where(util <= xs[0], ys[0], out)
+
+
+def requested_to_capacity_ratio(
+    requested: jax.Array,
+    alloc: jax.Array,
+    res_idx: Tuple[int, ...],
+    shape: Tuple[Tuple[float, float], ...],
+) -> jax.Array:
+    """f32[N]: NodeResourcesFit RequestedToCapacityRatio strategy.
+
+    reference: noderesources/requested_to_capacity_ratio.go — the scorer
+    linearly interpolates the utilization%% (requested*100/alloc) through the
+    user's shape points (scores 0..10), then rescales to MaxNodeScore;
+    utilization outside the shape clamps to the end points."""
+    idx = jnp.array(res_idx, dtype=jnp.int32)
+    a = alloc[:, idx].astype(jnp.float32)
+    r = requested[:, idx].astype(jnp.float32)
+    util = jnp.where(a > 0, r * 100.0 / jnp.where(a > 0, a, 1.0), 0.0)
+    score10 = interp_shape_f32(util, shape)
+    per_res = jnp.where(a > 0, score10 * (MAX_NODE_SCORE / 10.0), 0.0)
+    return per_res.mean(axis=1)
+
+
+FIT_STRATEGIES = ("LeastAllocated", "MostAllocated", "RequestedToCapacityRatio")
+
+
+def fit_score(
+    requested: jax.Array,
+    alloc: jax.Array,
+    cfg: "ScoreConfig",
+) -> jax.Array:
+    """NodeResourcesFit's Score, dispatched on the profile's scoringStrategy
+    at trace time (cfg is static under jit).  Unknown strategies raise —
+    every engine fails the same way instead of silently scoring with the
+    default."""
+    if cfg.fit_strategy == "MostAllocated":
+        return most_allocated(requested, alloc, cfg.score_resources)
+    if cfg.fit_strategy == "RequestedToCapacityRatio":
+        return requested_to_capacity_ratio(
+            requested, alloc, cfg.score_resources, cfg.rtcr_shape
+        )
+    if cfg.fit_strategy != "LeastAllocated":
+        raise ValueError(f"unknown fit scoringStrategy {cfg.fit_strategy!r}")
+    return least_allocated(requested, alloc, cfg.score_resources)
 
 
 def balanced_allocation(

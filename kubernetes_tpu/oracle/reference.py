@@ -105,6 +105,62 @@ def _least_allocated(requested: np.ndarray, alloc: np.ndarray, idx) -> f32:
     return f32(np.mean(np.array(vals, dtype=f32)))
 
 
+def _most_allocated(requested: np.ndarray, alloc: np.ndarray, idx) -> f32:
+    # noderesources/most_allocated.go — mostResourceScorer: 0 when alloc == 0
+    # OR requested exceeds alloc (no clamp — f32 op-for-op mirror of
+    # ops/scores.most_allocated)
+    vals = []
+    for j in idx:
+        a, r = f32(alloc[j]), f32(requested[j])
+        if a > 0 and r <= a:
+            vals.append(f32(r * f32(MAX_NODE_SCORE) / a))
+        else:
+            vals.append(f32(0.0))
+    return f32(np.mean(np.array(vals, dtype=f32)))
+
+
+def _interp_shape_f32(util: f32, shape) -> f32:
+    # ONE explicit f32 op order — y0 + t*(y1-y0) — mirrored verbatim by
+    # ops/scores.interp_shape_f32 and the C++ interp_shape, so all engines
+    # agree bit-for-bit.  Clamps outside the shape.
+    xs = [f32(p[0]) for p in shape]
+    ys = [f32(p[1]) for p in shape]
+    if util <= xs[0]:
+        return ys[0]
+    for i in range(1, len(xs)):
+        if util <= xs[i]:
+            t = f32(f32(util - xs[i - 1]) / f32(xs[i] - xs[i - 1]))
+            return f32(ys[i - 1] + f32(t * f32(ys[i] - ys[i - 1])))
+    return ys[-1]
+
+
+def _rtcr(requested: np.ndarray, alloc: np.ndarray, idx, shape) -> f32:
+    # noderesources/requested_to_capacity_ratio.go (mirror of
+    # ops/scores.requested_to_capacity_ratio)
+    vals = []
+    for j in idx:
+        a, r = f32(alloc[j]), f32(requested[j])
+        if a > 0:
+            util = f32(r * f32(100.0) / a)
+            vals.append(
+                f32(_interp_shape_f32(util, shape) * f32(MAX_NODE_SCORE / 10.0))
+            )
+        else:
+            vals.append(f32(0.0))
+    return f32(np.mean(np.array(vals, dtype=f32)))
+
+
+def _fit_score(requested: np.ndarray, alloc: np.ndarray, idx, cfg) -> f32:
+    strategy = getattr(cfg, "fit_strategy", "LeastAllocated")
+    if strategy == "MostAllocated":
+        return _most_allocated(requested, alloc, idx)
+    if strategy == "RequestedToCapacityRatio":
+        return _rtcr(requested, alloc, idx, cfg.rtcr_shape)
+    if strategy != "LeastAllocated":
+        raise ValueError(f"unknown fit scoringStrategy {strategy!r}")
+    return _least_allocated(requested, alloc, idx)
+
+
 def _balanced(requested: np.ndarray, alloc: np.ndarray, idx) -> f32:
     fs, cnt = [], 0
     for j in idx:
@@ -405,7 +461,7 @@ def oracle_schedule(
                 else f32(MAX_NODE_SCORE)
             )
             s = (
-                f32(cfg.fit_weight) * _least_allocated(requested, alloc[i], idx)
+                f32(cfg.fit_weight) * _fit_score(requested, alloc[i], idx, cfg)
                 + f32(cfg.balanced_weight) * _balanced(requested, alloc[i], idx)
                 + f32(cfg.taint_weight) * taint_sc
                 + f32(cfg.node_affinity_weight) * na_sc

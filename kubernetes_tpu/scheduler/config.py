@@ -47,6 +47,11 @@ class Profile:
     tpu_score: Optional[TPUScoreArgs] = None
     # InterPodAffinityArgs.hardPodAffinityWeight (pluginConfig; default 1)
     hard_pod_affinity_weight: float = 1.0
+    # NodeResourcesFitArgs.scoringStrategy (pluginConfig):
+    # LeastAllocated | MostAllocated | RequestedToCapacityRatio
+    fit_strategy: str = "LeastAllocated"
+    # RequestedToCapacityRatio shape points (utilization%%, score 0..10)
+    rtcr_shape: Tuple[Tuple[float, float], ...] = ((0.0, 0.0), (100.0, 10.0))
 
 
 @dataclass(frozen=True)
@@ -87,6 +92,8 @@ class SchedulerConfiguration:
             spread_weight=w.get("PodTopologySpread", 2.0),
             interpod_weight=w.get("InterPodAffinity", 2.0),
             hard_pod_affinity_weight=prof.hard_pod_affinity_weight,
+            fit_strategy=prof.fit_strategy,
+            rtcr_shape=prof.rtcr_shape,
         )
         for name in disabled:
             key = {
@@ -111,6 +118,18 @@ def validate(cfg: SchedulerConfiguration) -> List[str]:
     if len(set(names)) != len(names):
         errs.append("duplicate profile schedulerName")
     for p in cfg.profiles:
+        if p.fit_strategy not in (
+            "LeastAllocated", "MostAllocated", "RequestedToCapacityRatio"
+        ):
+            errs.append(f"{p.scheduler_name}: unknown fit scoringStrategy "
+                        f"{p.fit_strategy!r}")
+        if p.fit_strategy == "RequestedToCapacityRatio":
+            xs = [q[0] for q in p.rtcr_shape]
+            if len(xs) < 2 or len(xs) > 8 or any(
+                b <= a for a, b in zip(xs, xs[1:])
+            ):
+                errs.append(f"{p.scheduler_name}: rtcr shape must be 2..8 "
+                            "points with strictly increasing utilization")
         if not 0 <= p.percentage_of_nodes_to_score <= 100:
             errs.append(f"{p.scheduler_name}: percentageOfNodesToScore out of [0,100]")
         for s in p.plugins:

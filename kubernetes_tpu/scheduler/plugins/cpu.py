@@ -140,9 +140,15 @@ class NodePorts(Plugin):
 class NodeResourcesFit(Plugin):
     """noderesources/fit.go — Filter (fitsRequest over the shared ScaledState,
     the analog of computePodResourceRequest's PreFilter output) + Score
-    (LeastAllocated strategy)."""
+    (LeastAllocated / MostAllocated / RequestedToCapacityRatio per the
+    profile's scoringStrategy pluginConfig)."""
 
     name = "NodeResourcesFit"
+
+    def __init__(self, fit_strategy: str = "LeastAllocated",
+                 rtcr_shape=((0.0, 0.0), (100.0, 10.0))):
+        self.fit_strategy = fit_strategy
+        self.rtcr_shape = tuple(rtcr_shape)
 
     _EVENTS = (EV_NODE_ADD, EV_NODE_UPDATE, EV_POD_DELETE)
 
@@ -172,8 +178,15 @@ class NodeResourcesFit(Plugin):
     def Score(self, state, snap, pod, info: NodeInfo) -> float:
         sc = state.data["scaled"]
         i = sc.index[info.node.name]
+        requested = sc.used[i] + sc.req_of(pod)
+        if self.fit_strategy == "MostAllocated":
+            return float(oref._most_allocated(requested, sc.alloc[i], sc.score_idx))
+        if self.fit_strategy == "RequestedToCapacityRatio":
+            return float(
+                oref._rtcr(requested, sc.alloc[i], sc.score_idx, self.rtcr_shape)
+            )
         return float(
-            oref._least_allocated(sc.used[i] + sc.req_of(pod), sc.alloc[i], sc.score_idx)
+            oref._least_allocated(requested, sc.alloc[i], sc.score_idx)
         )
 
 
@@ -536,6 +549,8 @@ class DefaultPreemption(Plugin):
 def default_plugins(
     store, filter_fn=None, nominated_fn=None, hard_pod_affinity_weight: float = 1.0,
     plugin_specs=(), extenders=(),
+    fit_strategy: str = "LeastAllocated",
+    rtcr_shape=((0.0, 0.0), (100.0, 10.0)),
 ) -> List[PluginWeight]:
     """The default profile — plugin set and weights mirroring
     default_plugins.go (NodeResourcesFit 1, BalancedAllocation 1,
@@ -552,7 +567,7 @@ def default_plugins(
         PluginWeight(SchedulingGates()),
         PluginWeight(NodeName()),
         PluginWeight(NodePorts()),
-        PluginWeight(NodeResourcesFit(), 1.0),
+        PluginWeight(NodeResourcesFit(fit_strategy, rtcr_shape), 1.0),
         PluginWeight(NodeResourcesBalancedAllocation(), 1.0),
         PluginWeight(TaintToleration(), 3.0),
         PluginWeight(NodeAffinity(), 2.0),

@@ -113,6 +113,8 @@ class Scheduler:
                     hard_pod_affinity_weight=p.hard_pod_affinity_weight,
                     plugin_specs=p.plugins,
                     extenders=self.extenders,
+                    fit_strategy=p.fit_strategy,
+                    rtcr_shape=p.rtcr_shape,
                 )
             )
             for p in config.profiles

@@ -1,0 +1,90 @@
+"""NodeResourcesFit scoring strategies (noderesources/least_allocated.go,
+most_allocated.go, requested_to_capacity_ratio.go): LeastAllocated (default),
+MostAllocated (bin-packing), RequestedToCapacityRatio (user shape) — decision-
+identical across the XLA kernels, the C++ engine, the CPU plugin path, and
+the oracle."""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
+from kubernetes_tpu.ops.scores import infer_score_config
+from kubernetes_tpu.oracle import oracle_schedule
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.config import Profile, validate
+from helpers import mk_node, mk_pod, random_cluster
+
+STRATEGIES = [
+    ("LeastAllocated", ((0.0, 0.0), (100.0, 10.0))),
+    ("MostAllocated", ((0.0, 0.0), (100.0, 10.0))),
+    ("RequestedToCapacityRatio", ((0.0, 10.0), (50.0, 2.0), (100.0, 0.0))),
+]
+
+
+def _cfg(strategy, shape):
+    return dataclasses.replace(
+        DEFAULT_SCORE_CONFIG, fit_strategy=strategy, rtcr_shape=shape
+    )
+
+
+@pytest.mark.parametrize("strategy,shape", STRATEGIES)
+def test_kernel_oracle_parity(strategy, shape):
+    rng = random.Random(hash(strategy) % 1000)
+    snap = random_cluster(rng, n_nodes=12, n_pods=40, with_taints=True,
+                          with_selectors=True)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, _cfg(strategy, shape))
+    choices = np.asarray(schedule_batch(arr, cfg)[0])
+    got = [(meta.pod_names[k],
+            meta.node_names[int(choices[k])] if int(choices[k]) >= 0 else None)
+           for k in range(meta.n_pods)]
+    assert got == oracle_schedule(snap, cfg)
+
+
+@pytest.mark.parametrize("strategy,shape", STRATEGIES)
+def test_native_parity(strategy, shape):
+    from kubernetes_tpu.native import schedule_batch_native
+
+    rng = random.Random(1 + hash(strategy) % 1000)
+    snap = random_cluster(rng, n_nodes=10, n_pods=30, with_taints=False,
+                          with_selectors=True)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, _cfg(strategy, shape))
+    kern = np.asarray(schedule_batch(arr, cfg)[0])[: meta.n_pods]
+    nat = np.asarray(schedule_batch_native(arr, cfg)[0])[: meta.n_pods]
+    np.testing.assert_array_equal(kern, nat)
+
+
+def test_most_allocated_packs_instead_of_spreading():
+    """The strategies must actually change placement: MostAllocated packs
+    onto the busy node the default strategy avoids."""
+    def run(strategy):
+        store = ClusterStore()
+        store.add_node(mk_node("busy", cpu=4000))
+        store.add_node(mk_node("idle", cpu=4000))
+        store.add_pod(mk_pod("filler", cpu=2000, node_name="busy"))
+        cfg = SchedulerConfiguration(
+            mode="tpu", profiles=(Profile(fit_strategy=strategy),)
+        )
+        assert not validate(cfg)
+        sched = Scheduler(store, cfg)
+        store.add_pod(mk_pod("p", cpu=500))
+        sched.run_until_idle()
+        return store.pods["default/p"].node_name
+
+    assert run("LeastAllocated") == "idle"
+    assert run("MostAllocated") == "busy"
+
+
+def test_rtcr_shape_validation():
+    bad = SchedulerConfiguration(
+        profiles=(Profile(fit_strategy="RequestedToCapacityRatio",
+                          rtcr_shape=((50.0, 1.0), (0.0, 0.0))),)
+    )
+    assert any("rtcr shape" in e for e in validate(bad))
+    worse = SchedulerConfiguration(profiles=(Profile(fit_strategy="Sideways"),))
+    assert any("scoringStrategy" in e for e in validate(worse))
