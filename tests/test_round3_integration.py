@@ -14,8 +14,12 @@ from kubernetes_tpu.scheduler.queue import FakeClock
 from helpers import mk_node, mk_pod
 
 
-def test_round3_churn_soak_invariants():
-    rng = random.Random(42)
+import pytest
+
+
+@pytest.mark.parametrize("seed", [42, 7])
+def test_round3_churn_soak_invariants(seed):
+    rng = random.Random(seed)
     clock = FakeClock()
     store = ClusterStore()
     for i in range(10):
