@@ -414,6 +414,16 @@ class SpecInterner:
                 self._h, pods, keyid.ctypes.data, miss.ctypes.data
             )
         )
+        if int(lib.interner_forced(self._h)) != 0:
+            # identity-unstable pods (property/slots-backed profile fields)
+            # bypass the pointer table entirely — forced misses resolve
+            # correctly through the value slow path below, but with no
+            # intra-batch dedup; if they keep appearing the C fast path
+            # cannot help this workload, so latch onto the Python loop
+            # (same counter as the provisional-thrash latch above)
+            self._thrash = getattr(self, "_thrash", 0) + 1
+            if self._thrash >= 3:
+                self._lib = None
         if n_miss:
             # miss holds only UNIQUE missing profiles (intra-batch
             # duplicates were resolved to provisional markers by the C

@@ -50,6 +50,11 @@ typedef struct {
     size_t cap;      /* power of two */
     size_t count;
     size_t n_prov;   /* unresolved provisional entries (see lookup) */
+    size_t n_forced; /* identity-unstable forced misses in the LAST lookup:
+                      * these bypass the pointer table entirely, so the
+                      * caller polls this to latch such workloads onto the
+                      * Python value-level path instead of paying the
+                      * per-pod slow path every batch */
     PyObject *names[NFIELDS]; /* interned field-name strings */
 } Interner;
 
@@ -86,7 +91,14 @@ static int grow(Interner *in, size_t mincap) {
     return 0;
 }
 
-/* read the profile pointers of one pod; returns 0 on success */
+/* Read the profile pointers of one pod.  Returns 0 on success, 1 when the
+ * profile is NOT identity-stable — a field had to be read through
+ * PyObject_GetAttr, whose result can be a per-read temporary (property /
+ * computed attribute): after Py_DECREF its address may be recycled by a
+ * DIFFERENT object in the same batch, so storing it as an identity key
+ * could falsely merge two pods with different specs.  Such pods are routed
+ * to the Python value-level slow path instead (the plain-dataclass fast
+ * path never takes this branch).  Returns -1 with a Python error set. */
 static int read_profile(Interner *in, PyObject *pod, void *ptrs[NFIELDS]) {
     PyObject **dictp = _PyObject_GetDictPtr(pod);
     if (dictp && *dictp) {
@@ -94,25 +106,22 @@ static int read_profile(Interner *in, PyObject *pod, void *ptrs[NFIELDS]) {
             PyObject *v = PyDict_GetItemWithError(*dictp, in->names[f]);
             if (!v) {
                 if (PyErr_Occurred()) return -1;
-                /* field missing from __dict__ (slots/odd subclass):
-                 * fall back to full attribute lookup */
+                /* field missing from __dict__ (slots/property/odd
+                 * subclass): confirm the attribute exists, then force the
+                 * Python slow path — a GetAttr temporary's address is not
+                 * an identity */
                 v = PyObject_GetAttr(pod, in->names[f]);
                 if (!v) return -1;
-                ptrs[f] = (void *)v;
-                Py_DECREF(v); /* pointer value only; pod keeps it alive */
-                continue;
+                Py_DECREF(v);
+                return 1;
             }
             ptrs[f] = (void *)v; /* borrowed */
         }
         return 0;
     }
-    for (int f = 0; f < NFIELDS; f++) {
-        PyObject *v = PyObject_GetAttr(pod, in->names[f]);
-        if (!v) return -1;
-        ptrs[f] = (void *)v;
-        Py_DECREF(v);
-    }
-    return 0;
+    /* no instance __dict__ at all (slots-backed type): every GetAttr result
+     * may be a temporary — same hazard, same slow-path routing */
+    return 1;
 }
 
 /* exported API (ctypes.PyDLL) ------------------------------------------- */
@@ -171,10 +180,21 @@ int64_t interner_lookup(void *h, PyObject *pods, int64_t *out_keyid,
         return -1;
     }
     int64_t n_miss = 0;
+    in->n_forced = 0;
     void *ptrs[NFIELDS];
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *pod = PyList_GET_ITEM(pods, i);
-        if (read_profile(in, pod, ptrs) < 0) return -1;
+        int rc = read_profile(in, pod, ptrs);
+        if (rc < 0) return -1;
+        if (rc == 1) {
+            /* identity-unstable profile: forced miss, NOT inserted into the
+             * pointer table (no provisional entry, no pin) — the Python
+             * slow path resolves it by canonical value key */
+            in->n_forced++;
+            out_keyid[i] = -n_miss - 2;
+            miss_idx[n_miss++] = i;
+            continue;
+        }
         size_t j = profile_hash(ptrs) & (in->cap - 1);
         while (in->slots[j].keyid != -1) {
             if (profile_eq(&in->slots[j], ptrs)) break;
@@ -209,7 +229,9 @@ int interner_insert(void *h, PyObject *pods, const int64_t *idx,
     void *ptrs[NFIELDS];
     for (int64_t k = 0; k < n_ins; k++) {
         PyObject *pod = PyList_GET_ITEM(pods, idx[k]);
-        if (read_profile(in, pod, ptrs) < 0) return -1;
+        int rc = read_profile(in, pod, ptrs);
+        if (rc < 0) return -1;
+        if (rc == 1) continue; /* forced miss: nothing provisional to resolve */
         size_t j = profile_hash(ptrs) & (in->cap - 1);
         while (in->slots[j].keyid != -1) {
             if (profile_eq(&in->slots[j], ptrs)) {
@@ -237,6 +259,8 @@ int interner_insert(void *h, PyObject *pods, const int64_t *idx,
 }
 
 int64_t interner_prov(void *h) { return (int64_t)((Interner *)h)->n_prov; }
+
+int64_t interner_forced(void *h) { return (int64_t)((Interner *)h)->n_forced; }
 
 /* Pass 2: per-call canonical ids in first-occurrence order.
  * keyid[i] >= 0 for all i.  percall must hold max_kid+1 slots, pre-filled

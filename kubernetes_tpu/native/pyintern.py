@@ -62,6 +62,8 @@ def load() -> Optional[ctypes.PyDLL]:
         lib.interner_count.argtypes = [ctypes.c_void_p]
         lib.interner_prov.restype = ctypes.c_int64
         lib.interner_prov.argtypes = [ctypes.c_void_p]
+        lib.interner_forced.restype = ctypes.c_int64
+        lib.interner_forced.argtypes = [ctypes.c_void_p]
         lib.interner_lookup.restype = ctypes.c_int64
         lib.interner_lookup.argtypes = [
             ctypes.c_void_p, ctypes.py_object,

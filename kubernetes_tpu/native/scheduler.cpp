@@ -98,10 +98,14 @@ inline float most_alloc(const int32_t *alloc_row, const int64_t *req_tot,
 
 inline float interp_shape(float util, const float *xs, const float *ys,
                           int n) {
-  // np.interp semantics: clamp outside, linear inside
+  // clamp outside, linear inside.  STRICT > on the upper clamp: at
+  // util == xs[n-1] the JAX kernel (interp_shape_f32) and the oracle fall
+  // through to the segment formula ys[n-2] + t*(ys[n-1]-ys[n-2]), which in
+  // float32 does not round-trip to ys[n-1] for many y-pairs — early-returning
+  // here would break three-engine bit-parity at exact-fit utilization.
   if (n <= 0) return 0.0f;
   if (util <= xs[0]) return ys[0];
-  if (util >= xs[n - 1]) return ys[n - 1];
+  if (util > xs[n - 1]) return ys[n - 1];
   for (int i = 1; i < n; i++) {
     if (util <= xs[i]) {
       float t = (util - xs[i - 1]) / (xs[i] - xs[i - 1]);
@@ -113,18 +117,22 @@ inline float interp_shape(float util, const float *xs, const float *ys,
 
 inline float rtcr(const int32_t *alloc_row, const int64_t *req_tot, int r0,
                   int r1, const float *xs, const float *ys, int n_shape) {
+  // capacity == 0: the reference's resourceScoringFunction returns
+  // rawScoringFunction(maxUtilization) — the shape score at 100% — not 0
+  // (requested_to_capacity_ratio.go); mirrored by all engines.  This runs
+  // once per node in the scoring hot loop, so utilization is folded to
+  // 100 for the zero-capacity case instead of branching to a precomputed
+  // constant.
   float v0, v1;
   {
     float a = (float)alloc_row[r0], r = (float)req_tot[r0];
-    v0 = a > 0.f
-             ? interp_shape(r * 100.0f / a, xs, ys, n_shape) * (MAXS / 10.0f)
-             : 0.0f;
+    float util = a > 0.f ? r * 100.0f / a : 100.0f;
+    v0 = interp_shape(util, xs, ys, n_shape) * (MAXS / 10.0f);
   }
   {
     float a = (float)alloc_row[r1], r = (float)req_tot[r1];
-    v1 = a > 0.f
-             ? interp_shape(r * 100.0f / a, xs, ys, n_shape) * (MAXS / 10.0f)
-             : 0.0f;
+    float util = a > 0.f ? r * 100.0f / a : 100.0f;
+    v1 = interp_shape(util, xs, ys, n_shape) * (MAXS / 10.0f);
   }
   return (v0 + v1) / 2.0f;
 }

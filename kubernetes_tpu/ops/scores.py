@@ -172,13 +172,16 @@ def requested_to_capacity_ratio(
     reference: noderesources/requested_to_capacity_ratio.go — the scorer
     linearly interpolates the utilization%% (requested*100/alloc) through the
     user's shape points (scores 0..10), then rescales to MaxNodeScore;
-    utilization outside the shape clamps to the end points."""
+    utilization outside the shape clamps to the end points.  capacity == 0
+    scores as 100%% utilization (resourceScoringFunction returns
+    rawScoringFunction(maxUtilization)), not 0 — mirrored by the oracle and
+    the C++ engine."""
     idx = jnp.array(res_idx, dtype=jnp.int32)
     a = alloc[:, idx].astype(jnp.float32)
     r = requested[:, idx].astype(jnp.float32)
-    util = jnp.where(a > 0, r * 100.0 / jnp.where(a > 0, a, 1.0), 0.0)
+    util = jnp.where(a > 0, r * 100.0 / jnp.where(a > 0, a, 1.0), 100.0)
     score10 = interp_shape_f32(util, shape)
-    per_res = jnp.where(a > 0, score10 * (MAX_NODE_SCORE / 10.0), 0.0)
+    per_res = score10 * (MAX_NODE_SCORE / 10.0)
     return per_res.mean(axis=1)
 
 

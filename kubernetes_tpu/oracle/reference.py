@@ -142,11 +142,14 @@ def _rtcr(requested: np.ndarray, alloc: np.ndarray, idx, shape) -> f32:
         a, r = f32(alloc[j]), f32(requested[j])
         if a > 0:
             util = f32(r * f32(100.0) / a)
-            vals.append(
-                f32(_interp_shape_f32(util, shape) * f32(MAX_NODE_SCORE / 10.0))
-            )
         else:
-            vals.append(f32(0.0))
+            # capacity == 0: the reference's resourceScoringFunction returns
+            # rawScoringFunction(maxUtilization) — the shape score at 100% —
+            # not 0 (requested_to_capacity_ratio.go)
+            util = f32(100.0)
+        vals.append(
+            f32(_interp_shape_f32(util, shape) * f32(MAX_NODE_SCORE / 10.0))
+        )
     return f32(np.mean(np.array(vals, dtype=f32)))
 
 

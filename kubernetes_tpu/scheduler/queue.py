@@ -9,8 +9,10 @@ Analog of pkg/scheduler/backend/queue/scheduling_queue.go — PriorityQueue:
     DefaultPodMaxBackoffDuration)
   - unschedulablePods: pods that failed with no backoff pending; moved back to
     activeQ/backoffQ when a cluster event that might make them schedulable
-    arrives (MoveAllToActiveOrBackoffQueue; QueueingHint machinery reduced to
-    event-kind matching)
+    arrives (MoveAllToActiveOrBackoffQueue), filtered through per-plugin
+    QueueingHint callbacks — each registered plugin's (event, obj, old, pod)
+    -> Queue/Skip hint, so irrelevant churn (e.g. a Node update that shrinks
+    allocatable) wakes nobody (isSchedulableAfterNodeChange analogs)
 
 A injectable clock makes backoff deterministic in tests (the reference uses
 k8s.io/utils/clock/testing the same way — SURVEY.md §4).
@@ -137,17 +139,6 @@ class PriorityQueue:
         self._no_flush.discard(pod.uid)
         heapq.heappush(self._active, _Item(self._key(pod), pod))
         self._active_uids.add(pod.uid)
-
-    def forgive_attempt(self, pod_uid: str) -> None:
-        """Undo one attempt increment: a pod drained by pop_all but handed
-        back untouched (e.g. another profile's batch cycle) was never
-        actually attempted, and must not accrue exponential backoff."""
-        with self._lock:
-            n = self._attempts.get(pod_uid, 0)
-            if n > 1:
-                self._attempts[pod_uid] = n - 1
-            else:
-                self._attempts.pop(pod_uid, None)
 
     def _flush_backoff(self) -> None:
         now = self.clock.now()

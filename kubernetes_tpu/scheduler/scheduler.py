@@ -537,35 +537,73 @@ class Scheduler:
 
     # --- the TPU batch cycle ---
     def schedule_batch(self) -> Dict[str, Optional[str]]:
-        """Drain the activeQ and schedule the whole batch in one device program."""
-        from ..ops.gang import schedule_with_gangs
+        """Drain the activeQ and schedule the whole batch in one cycle.
 
+        Multi-profile batches group by spec.schedulerName and the (few)
+        per-profile programs run back-to-back within THIS cycle (each kernel
+        takes one static weight config; round 3 served one profile per cycle
+        and requeued the rest, which serialized a mixed stream).  The
+        round-robin lead now only decides which profile sees free capacity
+        first; single-profile batches (the common case) take one program as
+        before.  Gang members always ride ONE program — the PodGroup's
+        first-seen member's profile — because a gang split across
+        per-profile programs could never reach quorum in any of them
+        (cross-profile gang livelock, round-3 advisor finding)."""
         t0 = time.perf_counter()
         batch: List[t.Pod] = self.queue.pop_all()
         if not batch:
             return {}
-        # one profile per batch cycle (the kernels take one static weight
-        # config): serve one profile now and requeue the other profiles'
-        # pods untouched — run_until_idle picks them up next cycle.  The
-        # lead rotates round-robin over the profiles present so continuous
-        # arrivals on one profile cannot starve another; single-profile
-        # configs (the common case) never requeue anything.
         names = [p.scheduler_name or self.default_profile_name for p in batch]
+        gang_profile: Dict[str, str] = {}
+        for p, n in zip(batch, names):
+            if p.pod_group and p.pod_group not in gang_profile:
+                gang_profile[p.pod_group] = n
+        for k, p in enumerate(batch):
+            if p.pod_group and names[k] != gang_profile[p.pod_group]:
+                coalesced = gang_profile[p.pod_group]
+                self.events.record(
+                    "GangProfileCoalesced", p.uid,
+                    message=(
+                        f"PodGroup {p.pod_group} members span schedulerNames; "
+                        f"scheduling gang under profile {coalesced!r}"
+                    ),
+                )
+                names[k] = coalesced
         present = list(dict.fromkeys(names))  # first-appearance order
-        lead = present[0]
-        if len(present) > 1:
-            last = self._last_profile_served
-            if last in present:
-                lead = present[(present.index(last) + 1) % len(present)]
-            mine = [p for p, n in zip(batch, names) if n == lead]
-            for p, n in zip(batch, names):
-                if n != lead:
-                    self.queue.add(p)
-                    # drained but never attempted: no backoff accrual
-                    self.queue.forgive_attempt(p.uid)
-            batch = mine
-        self._last_profile_served = lead
-        profile_name = lead
+        if len(present) > 1 and self._last_profile_served in present:
+            i = (present.index(self._last_profile_served) + 1) % len(present)
+            present = present[i:] + present[:i]
+        # the cycle's lead = the profile with first claim on capacity; the
+        # NEXT cycle's lead rotates past it
+        self._last_profile_served = present[0]
+        result: Dict[str, Optional[str]] = {}
+        n_failed = 0
+        for profile_name in present:
+            group = [p for p, n in zip(batch, names) if n == profile_name]
+            r, nf = self._schedule_profile_batch(profile_name, group)
+            result.update(r)
+            n_failed += nf
+        dt = time.perf_counter() - t0
+        self.log.V(2).info("Batch scheduled", batch=len(batch),
+                           profiles=len(present),
+                           scheduled=len(batch) - n_failed,
+                           unschedulable=n_failed,
+                           duration_ms=round(dt * 1e3, 1))
+        self.metrics.observe("batch_scheduling_duration_seconds", dt)
+        self.metrics.inc("scheduling_attempts_scheduled", len(batch) - n_failed)
+        self.metrics.inc("scheduling_attempts_unschedulable", n_failed)
+        self.metrics.set("pending_pods", self.queue.pending_total)
+        return result
+
+    def _schedule_profile_batch(
+        self, profile_name: str, batch: List[t.Pod]
+    ) -> Tuple[Dict[str, Optional[str]], int]:
+        """One profile's slice of the cycle: encode → kernel (or sidecar /
+        native engine) → bind → preempt-on-failure.  Returns (pod name ->
+        node | None, #unschedulable).  Bindings apply to the store/cache
+        synchronously, so the next profile's update_snapshot sees them."""
+        from ..ops.gang import schedule_with_gangs
+
         snap = self.cache.update_snapshot()
         bound_uids = {p.uid for p in snap.bound_pods}
         batch_uids = {p.uid for p in batch}
@@ -641,10 +679,12 @@ class Scheduler:
                 self.reject_incomplete_gangs()
                 # async binding cycles and gang waits resolve after the loop:
                 # report the SETTLED placements, not the optimistic returns
+                n_unbound = 0
                 for pod in snap.pending_pods:
                     cur = self.store.pods.get(pod.uid)
                     result[pod.name] = (cur.node_name or None) if cur else None
-                return result
+                    n_unbound += result[pod.name] is None
+                return result, n_unbound
         arr = meta = None  # encoded cycle arrays (batched preemption reuses them)
         if verdicts is None:
             base_cfg = self.config.score_config(profile_name)
@@ -758,16 +798,7 @@ class Scheduler:
                     else:
                         self._clear_nomination(pod)
                 self.queue.add_unschedulable(pod, backoff=True)
-        dt = time.perf_counter() - t0
-        self.log.V(2).info("Batch scheduled", batch=len(batch),
-                           scheduled=len(batch) - len(failed),
-                           unschedulable=len(failed),
-                           duration_ms=round(dt * 1e3, 1))
-        self.metrics.observe("batch_scheduling_duration_seconds", dt)
-        self.metrics.inc("scheduling_attempts_scheduled", len(batch) - len(failed))
-        self.metrics.inc("scheduling_attempts_unschedulable", len(failed))
-        self.metrics.set("pending_pods", self.queue.pending_total)
-        return result
+        return result, len(failed)
 
     def _nominate(self, pod: t.Pod, node_name: str) -> None:
         """Record the nomination (queue nominator) and publish it on the pod's

@@ -88,3 +88,60 @@ def test_rtcr_shape_validation():
     assert any("rtcr shape" in e for e in validate(bad))
     worse = SchedulerConfiguration(profiles=(Profile(fit_strategy="Sideways"),))
     assert any("scoringStrategy" in e for e in validate(worse))
+
+
+def test_rtcr_exact_fit_parity_non_round_tripping_shape():
+    """util == 100 exactly (pod request == allocatable) with a shape whose
+    segment formula ys[n-2] + 1.0*(ys[n-1]-ys[n-2]) does NOT round-trip to
+    ys[n-1] in float32 (y = 0.1/0.3): the C++ engine used to early-return
+    ys[n-1] at util >= xs[n-1] while the kernel and oracle fall through to
+    the segment formula, diverging at exact-fit utilization (round-3
+    advisor, medium).  All engines must agree bit-for-bit."""
+    from kubernetes_tpu.native import schedule_batch_native
+
+    shape = ((0.0, 0.1), (100.0, 0.3))
+    # two nodes scoring differently only through the RTCR shape; the pod
+    # fills node n0 EXACTLY (util == 100 on both scored resources)
+    snap = Snapshot(
+        nodes=[mk_node("n0", cpu=500, mem=512 * 1024**2),
+               mk_node("n1", cpu=4000, mem=8 * 1024**3)],
+        pending_pods=[mk_pod("exact", cpu=500, mem=512 * 1024**2)],
+    )
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, _cfg("RequestedToCapacityRatio", shape))
+    kern = np.asarray(schedule_batch(arr, cfg)[0])[: meta.n_pods]
+    nat = np.asarray(schedule_batch_native(arr, cfg)[0])[: meta.n_pods]
+    np.testing.assert_array_equal(kern, nat)
+    got = [(meta.pod_names[k],
+            meta.node_names[int(kern[k])] if int(kern[k]) >= 0 else None)
+           for k in range(meta.n_pods)]
+    assert got == oracle_schedule(snap, cfg)
+
+
+def test_rtcr_zero_capacity_scores_as_max_utilization():
+    """capacity == 0 scores as the shape value at 100% utilization — the
+    reference's resourceScoringFunction returns rawScoringFunction(
+    maxUtilization) for capacity 0, NOT 0 (round-3 advisor, low).  With a
+    decreasing shape (high score at low utilization) a zero-memory node
+    must therefore score LOW on that resource, steering the pod to the
+    provisioned node; all engines agree."""
+    from kubernetes_tpu.native import schedule_batch_native
+
+    shape = ((0.0, 10.0), (100.0, 0.0))
+    snap = Snapshot(
+        # n0 has NO memory capacity; the pod requests none, so n0 is
+        # feasible — but its memory axis scores at 100% utilization (0.0)
+        nodes=[mk_node("n0", cpu=4000, mem=0), mk_node("n1", cpu=4000)],
+        pending_pods=[mk_pod("memless", cpu=100, mem=0)],
+    )
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, _cfg("RequestedToCapacityRatio", shape))
+    kern = np.asarray(schedule_batch(arr, cfg)[0])[: meta.n_pods]
+    nat = np.asarray(schedule_batch_native(arr, cfg)[0])[: meta.n_pods]
+    np.testing.assert_array_equal(kern, nat)
+    got = [(meta.pod_names[k],
+            meta.node_names[int(kern[k])] if int(kern[k]) >= 0 else None)
+           for k in range(meta.n_pods)]
+    assert got == oracle_schedule(snap, cfg)
+    # the zero-capacity node must NOT win: its memory score is 0, n1's ~10
+    assert got[0][1] == "n1"

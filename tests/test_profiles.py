@@ -91,11 +91,10 @@ def test_disabled_plugin_keeps_its_filter(mode):
     assert store.pods[next(iter(store.pods))].node_name == ""  # stays pending
 
 
-def test_other_profile_requeue_accrues_no_backoff():
-    """A batch cycle drains the whole activeQ but schedules one profile per
-    cycle; the other profiles' pods are handed back untouched and must not
-    accrue exponential backoff for the phantom attempt (queue.pop_all bumps
-    the attempt counter; the requeue forgives it)."""
+def test_mixed_profile_batch_schedules_in_one_cycle():
+    """A mixed-schedulerName batch runs its per-profile programs
+    back-to-back within ONE cycle (round 3 requeued the non-lead profiles,
+    serializing the stream), and nobody accrues backoff attempts for it."""
     store = ClusterStore()
     store.add_node(mk_node("n0", cpu=64000, pods=200))
     cfg = _two_profile_cfg("tpu")
@@ -106,6 +105,9 @@ def test_other_profile_requeue_accrues_no_backoff():
         q = mk_pod(f"b{i}", cpu=100)
         q.scheduler_name = "busy-packer"
         store.add_pod(q)
+    first = sched.schedule_batch()
+    # every pod of BOTH profiles scheduled by the single cycle
+    assert len(first) == 12 and all(v == "n0" for v in first.values())
     sched.run_until_idle()
     assert all(p.node_name == "n0" for p in store.pods.values())
     # nobody failed scheduling, so nobody should carry attempt counts that
@@ -145,8 +147,9 @@ def test_custom_weight_profile_never_offloads_to_sidecar():
 
 
 def test_batch_lead_profile_round_robins():
-    """Continuous arrivals on one profile must not starve another: the
-    batch cycle rotates its lead profile over the profiles present."""
+    """The lead (the profile with FIRST claim on free capacity within the
+    cycle) rotates across cycles, so continuous arrivals on one profile
+    cannot always grab capacity first."""
     store = ClusterStore()
     store.add_node(mk_node("n0", cpu=64000, pods=500))
     sched = Scheduler(store, _two_profile_cfg("tpu"))
@@ -155,17 +158,40 @@ def test_batch_lead_profile_round_robins():
         q = mk_pod(f"b{i}", cpu=100)
         q.scheduler_name = "busy-packer"
         store.add_pod(q)
-    # first cycle serves one profile and requeues the other...
+    # one cycle serves BOTH profiles; the lead is recorded
     first = sched.schedule_batch()
-    served1 = {n for n, v in first.items() if v}
-    assert served1 and len({n[0] for n in served1}) == 1  # ONE profile/cycle
-    # ...the next cycle must serve the OTHER profile even though new pods
-    # keep arriving on the first one
+    assert len([v for v in first.values() if v]) == 8
     lead1 = sched._last_profile_served
+    # next mixed cycle leads with the OTHER profile
     for i in range(4, 8):
-        p = mk_pod(f"a{i}", cpu=100)
-        store.add_pod(p)
+        store.add_pod(mk_pod(f"a{i}", cpu=100))
+        q = mk_pod(f"b{i}", cpu=100)
+        q.scheduler_name = "busy-packer"
+        store.add_pod(q)
     sched.schedule_batch()
     assert sched._last_profile_served != lead1
     sched.run_until_idle()
     assert all(p.node_name for p in store.pods.values())
+
+
+def test_cross_profile_gang_coalesces_to_one_program():
+    """PodGroup members carrying different schedulerNames would deadlock if
+    split across per-profile programs (no program ever sees min_member);
+    the cycle coalesces the gang under its first-seen member's profile and
+    records an event (round-3 advisor finding)."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=64000, pods=200))
+    cfg = _two_profile_cfg("tpu")
+    sched = Scheduler(store, cfg)
+    sched.cache.pod_groups["job"] = t.PodGroup(name="job", min_member=4)
+    for i in range(4):
+        p = mk_pod(f"g{i}", cpu=100)
+        p.pod_group = "job"
+        p.labels = {"job": "job"}
+        if i % 2:
+            p.scheduler_name = "busy-packer"
+        store.add_pod(p)
+    res = sched.schedule_batch()
+    assert len([v for v in res.values() if v]) == 4, res
+    assert sched.events.by_reason("GangProfileCoalesced")
+    assert all(p.node_name == "n0" for p in store.pods.values())
