@@ -424,6 +424,12 @@ class SpecInterner:
             self._thrash = getattr(self, "_thrash", 0) + 1
             if self._thrash >= 3:
                 self._lib = None
+        else:
+            # a clean batch resets the streak: the latch is for workloads
+            # that are PERSISTENTLY identity-unstable, not for one odd pod
+            # ever — 3 isolated events weeks apart must not disable the
+            # fast path for the process lifetime
+            self._thrash = 0
         if n_miss:
             # miss holds only UNIQUE missing profiles (intra-batch
             # duplicates were resolved to provisional markers by the C

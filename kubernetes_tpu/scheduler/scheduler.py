@@ -555,11 +555,14 @@ class Scheduler:
             return {}
         names = [p.scheduler_name or self.default_profile_name for p in batch]
         gang_profile: Dict[str, str] = {}
-        for p, n in zip(batch, names):
-            if p.pod_group and p.pod_group not in gang_profile:
-                gang_profile[p.pod_group] = n
+        if self.features.enabled("GangScheduling"):
+            # with the gate off, pod_group is inert everywhere — a pod must
+            # keep its own profile, so no coalescing either
+            for p, n in zip(batch, names):
+                if p.pod_group and p.pod_group not in gang_profile:
+                    gang_profile[p.pod_group] = n
         for k, p in enumerate(batch):
-            if p.pod_group and names[k] != gang_profile[p.pod_group]:
+            if p.pod_group in gang_profile and names[k] != gang_profile[p.pod_group]:
                 coalesced = gang_profile[p.pod_group]
                 self.events.record(
                     "GangProfileCoalesced", p.uid,
