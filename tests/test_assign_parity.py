@@ -10,6 +10,7 @@ import pytest
 from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot
 from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, schedule_batch
 from kubernetes_tpu.oracle import oracle_schedule
+from kubernetes_tpu.api import types as t
 from helpers import mk_node, mk_pod, random_cluster
 
 
@@ -329,3 +330,235 @@ def test_chunked_scan_capacity_exhausts_mid_chunk():
     np.testing.assert_array_equal(chunked, plain)
     assert (plain[: meta.n_pods] >= 0).sum() == 140
     assert_parity(snap)
+
+
+# ---- schedule_scan_rounds: the generalized (pairwise/ports/taint/pref/
+# image) chunked path ----
+
+def _rounds_vs_plain(snap, cfg_base=DEFAULT_SCORE_CONFIG, check_oracle=True):
+    """Route-independent ground truth: the rounds kernel must be
+    bit-identical to the plain per-pod scan (choices AND final usage), and
+    — for the default config — to the sequential oracle."""
+    import jax
+
+    from kubernetes_tpu.ops.assign import (
+        _chunkable,
+        _rounds_capable,
+        schedule_scan,
+        schedule_scan_rounds,
+    )
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, cfg_base)
+    assert _rounds_capable(arr, cfg), arr.P
+    assert not _chunkable(arr, cfg), cfg  # the regime the rounds path exists for
+    plain_c, plain_u = (
+        np.asarray(x)
+        for x in jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)
+    )
+    rounds_c, rounds_u = (
+        np.asarray(x)
+        for x in jax.jit(schedule_scan_rounds, static_argnames=("cfg",))(arr, cfg)
+    )
+    np.testing.assert_array_equal(rounds_c, plain_c)
+    np.testing.assert_array_equal(rounds_u, plain_u)
+    if check_oracle and cfg_base is DEFAULT_SCORE_CONFIG:
+        got = [
+            (meta.pod_names[k],
+             meta.node_names[int(plain_c[k])] if int(plain_c[k]) >= 0 else None)
+            for k in range(meta.n_pods)
+        ]
+        assert got == oracle_schedule(snap)
+    return arr, cfg
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_rounds_scan_randomized_pairwise_parity(seed):
+    """Random spread + (anti-)affinity + host ports + PreferNoSchedule
+    taints (taint-score stage) + node selectors at >= 2 chunks."""
+    rng = random.Random(4000 + seed)
+    snap = random_cluster(rng, n_nodes=rng.randint(6, 40),
+                          n_pods=rng.choice([128, 256]),
+                          with_taints=True, with_selectors=True,
+                          with_pairwise=True)
+    _rounds_vs_plain(snap)
+
+
+def test_rounds_scan_same_app_spread_worst_case():
+    """EVERY pod shares one DoNotSchedule spread term (one app): maximal
+    term-sharing interference — prefixes shrink toward one pod per round,
+    the degenerate regime — while domain counts must stay exact across
+    chunks."""
+    nodes = [mk_node(f"n{i}", cpu=4000, pods=300,
+                     labels={"topology.kubernetes.io/zone": f"zone-{i % 3}"})
+             for i in range(9)]
+    spread = (t.TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        when_unsatisfiable=t.DO_NOT_SCHEDULE,
+        label_selector=t.LabelSelector.of(app="one")),)
+    pods = [mk_pod(f"p{i:04d}", cpu=50, labels={"app": "one"},
+                   topology_spread=spread) for i in range(128)]
+    _rounds_vs_plain(Snapshot(nodes=nodes, pending_pods=pods))
+
+
+def test_rounds_scan_anti_affinity_one_per_node():
+    """One-replica-per-node: every pod carries hostname-scoped required
+    anti-affinity against its own app — each commit excludes a node for
+    ALL later pods (anti_node writes ∩ every pod's match terms), the
+    self-exclusion chain the round-3 verdict called out."""
+    term = t.PodAffinityTerm(
+        topology_key="kubernetes.io/hostname",
+        label_selector=t.LabelSelector.of(app="solo"),
+    )
+    nodes = [mk_node(f"n{i:03d}", cpu=4000) for i in range(140)]
+    pods = [mk_pod(f"p{i:04d}", cpu=100, labels={"app": "solo"},
+                   affinity=t.Affinity(required_pod_anti_affinity=(term,)))
+            for i in range(128)]
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    _rounds_vs_plain(snap)
+    # semantic sanity: all 128 land on 128 DISTINCT nodes
+    arr, meta = encode_snapshot(snap)
+    from kubernetes_tpu.ops.scores import infer_score_config
+    import jax
+    from kubernetes_tpu.ops.assign import schedule_scan_rounds
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    ch = np.asarray(jax.jit(schedule_scan_rounds, static_argnames=("cfg",))(arr, cfg)[0])
+    placed = ch[: meta.n_pods]
+    assert (placed >= 0).all() and len(set(placed.tolist())) == 128
+
+
+def test_rounds_scan_skew_boundary_and_exhaustion():
+    """Tight maxSkew=1 over unbalanced zones + capacity that exhausts
+    mid-chunk: spread feasibility flips back and forth as domains fill
+    (min_match rises, RELAXING earlier-infeasible nodes) and late pods go
+    -1 exactly where the plain scan says."""
+    nodes = []
+    for i in range(10):
+        # zone-0 has 6 nodes, zone-1 has 3, zone-2 has 1 — skewed domains
+        z = 0 if i < 6 else (1 if i < 9 else 2)
+        nodes.append(mk_node(f"n{i}", cpu=1200, pods=8,
+                             labels={"topology.kubernetes.io/zone": f"zone-{z}"}))
+    spread = (t.TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        when_unsatisfiable=t.DO_NOT_SCHEDULE,
+        label_selector=t.LabelSelector.of(app="a")),)
+    pods = [mk_pod(f"p{i:04d}", cpu=300, labels={"app": "a"},
+                   topology_spread=spread) for i in range(128)]
+    _rounds_vs_plain(Snapshot(nodes=nodes, pending_pods=pods))
+
+
+def test_rounds_scan_all_stages_on():
+    """Every optional stage at once: spread + required AND preferred
+    (anti-)affinity (interpod score incl. hardPodAffinityWeight symmetric
+    half) + host ports + PreferNoSchedule taints + preferred node affinity
+    + ImageLocality — the full normalization-scalar surface the
+    interference conditions must cover."""
+    rng = random.Random(99)
+    nodes = []
+    for i in range(24):
+        taints = ()
+        if i % 4 == 0:
+            taints = (t.Taint(key="soft", value="x",
+                              effect=t.PREFER_NO_SCHEDULE),)
+        nd = mk_node(
+            f"n{i:02d}", cpu=8000, pods=64,
+            labels={"topology.kubernetes.io/zone": f"zone-{i % 3}",
+                    "tier": rng.choice(["a", "b"])},
+            taints=taints,
+        )
+        if i % 3 == 0:
+            nd.images = {"registry/app:v1": 500 * 1024**2}
+        nodes.append(nd)
+    apps = ["web", "db", "cache"]
+    pods = []
+    for i in range(256):
+        app = rng.choice(apps)
+        spread = ()
+        aff_kw = {}
+        if i % 3 == 0:
+            spread = (t.TopologySpreadConstraint(
+                max_skew=1, topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable=t.DO_NOT_SCHEDULE if i % 6 else t.SCHEDULE_ANYWAY,
+                label_selector=t.LabelSelector.of(app=app)),)
+        if i % 4 == 1:
+            aff_kw["required_pod_affinity"] = (t.PodAffinityTerm(
+                topology_key="topology.kubernetes.io/zone",
+                label_selector=t.LabelSelector.of(app=rng.choice(apps))),)
+        if i % 4 == 2:
+            aff_kw["preferred_pod_affinity"] = (t.WeightedPodAffinityTerm(
+                weight=rng.choice([10, 50]),
+                term=t.PodAffinityTerm(
+                    topology_key="topology.kubernetes.io/zone",
+                    label_selector=t.LabelSelector.of(app=rng.choice(apps)))),)
+        if i % 5 == 0:
+            aff_kw["preferred_pod_anti_affinity"] = (t.WeightedPodAffinityTerm(
+                weight=20,
+                term=t.PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector=t.LabelSelector.of(app=app))),)
+        if i % 7 == 0:
+            aff_kw["preferred_node_terms"] = (t.PreferredSchedulingTerm(
+                weight=rng.choice([1, 5]),
+                preference=t.NodeSelectorTerm(match_expressions=(
+                    t.NodeSelectorRequirement(key="tier", operator=t.OP_IN,
+                                              values=("a",)),))),)
+        pod = mk_pod(
+            f"p{i:04d}", cpu=rng.choice([100, 250]), labels={"app": app},
+            topology_spread=spread,
+            affinity=t.Affinity(**aff_kw) if aff_kw else None,
+            host_ports=(("TCP", 9100),) if i % 11 == 0 else (),
+        )
+        if i % 6 == 0:
+            pod.images = ("registry/app:v1",)
+        pods.append(pod)
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    arr, cfg = _rounds_vs_plain(snap)
+    # the test must actually be exercising every stage
+    assert cfg.enable_pairwise and cfg.enable_ports and cfg.enable_taint_score
+    assert cfg.enable_node_pref and cfg.enable_image and cfg.enable_interpod_score
+
+
+def test_rounds_diagnostic_and_forced_routing(monkeypatch):
+    """with_rounds reports per-chunk round counts in [1, C]; with
+    KTPU_FORCE_CHUNKED=1 the PRODUCTION entry point (schedule_batch_impl)
+    routes a pairwise config through the rounds kernel on the CPU sim
+    (round-3 verdict: the routing predicate must be testable off-TPU)."""
+    from functools import partial
+
+    import jax
+
+    from kubernetes_tpu.ops.assign import (
+        _CHUNK,
+        _rounds_routed,
+        schedule_batch_impl,
+        schedule_scan,
+        schedule_scan_rounds,
+    )
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    rng = random.Random(31)
+    snap = random_cluster(rng, n_nodes=11, n_pods=256, with_taints=True,
+                          with_selectors=True, with_pairwise=True)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    f = jax.jit(
+        partial(schedule_scan_rounds, with_rounds=True),
+        static_argnames=("cfg",),
+    )
+    choices, used, rounds = (np.asarray(x) for x in f(arr, cfg))
+    assert rounds.shape == (arr.P // _CHUNK,)
+    assert (rounds >= 1).all() and (rounds <= _CHUNK).all()
+
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    assert _rounds_routed(arr, cfg)
+    routed = np.asarray(
+        jax.jit(schedule_batch_impl, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    plain = np.asarray(
+        jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)[0]
+    )
+    np.testing.assert_array_equal(routed, plain)
+    np.testing.assert_array_equal(choices, plain)
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "0")
+    assert not _rounds_routed(arr, cfg)
