@@ -797,7 +797,7 @@ class DeltaEncoder:
         self.hpaw = hard_pod_affinity_weight
         self._cs: Optional[ClusterSide] = None
         self._dev: Dict[str, Tuple] = {}  # field -> (host array, device array)
-        self.stats = {"full": 0, "delta": 0}
+        self.stats = {"full": 0, "delta": 0, "verified": 0}
         # Cache validity is conditioned on OBJECT IDENTITY (_nodes_fp, record
         # `is` checks) under the repo-wide copy-on-write convention for
         # Node/Pod; an in-place mutation anywhere would silently serve stale
@@ -973,6 +973,7 @@ class DeltaEncoder:
                 self.stats["delta"] += 1
                 if self.debug_verify:
                     self._verify_against_rebuild(cs, snap, wfp)
+                    self.stats["verified"] += 1
             except _Fallback:
                 cs = None
         else:

@@ -43,17 +43,19 @@ class PerfData:
     unschedulable: int
     wall_s: float
     pods_per_sec: float
-    # quantiles over the recorded attempt/batch durations.  HONESTY NOTE: in
-    # batch (tpu/native) mode a config is usually ONE batch, so p50==p99==the
-    # wave wall time — they are per-WAVE latencies, not a per-pod attempt
-    # distribution; the per-pod number is amortized_ms_per_pod (wall/pod,
-    # the batch path's analog of scheduling_attempt_duration).  cpu mode
-    # records a real per-pod distribution.
+    # quantiles over PER-POD scheduling latency.  cpu mode records real
+    # per-attempt durations; batch (tpu/native) modes — gang fixpoints
+    # included — record per-pod ESTIMATES from each pod's commit ordinal
+    # (the sequential device sweep that decided it) scaled by the kernel
+    # wall (scheduler._observe_wave_latency).  latency_source says which;
+    # "batch" (per-wave durations, p50==p99) remains only for waves that
+    # produced no per-pod data (e.g. sidecar offload).
     p50_ms: float
     p90_ms: float
     p99_ms: float
-    batches: int = 1
+    batches: int = 1  # waves (batch-duration samples), NOT latency samples
     amortized_ms_per_pod: float = 0.0
+    latency_source: str = "batch"
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -94,10 +96,18 @@ def _setup_cluster(snap: Snapshot, mode: str):
 def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> PerfData:
     scheduled = len(sched.events.by_reason("Scheduled"))
     failed = len(sched.events.by_reason("FailedScheduling"))
-    hist = sched.metrics.hists.get("batch_scheduling_duration_seconds") or sched.metrics.hists.get(
-        "scheduling_attempt_duration_seconds"
-    )
+    source = "attempt"
+    hist = sched.metrics.hists.get("scheduling_attempt_duration_seconds")
+    if not (hist and hist.samples):
+        source = "per-pod-estimate"
+        hist = sched.metrics.hists.get(
+            "scheduling_attempt_duration_estimate_seconds"
+        )
+    if not (hist and hist.samples):
+        source = "batch"
+        hist = sched.metrics.hists.get("batch_scheduling_duration_seconds")
     q = (lambda p: hist.quantile(p) * 1e3) if hist else (lambda p: 0.0)
+    batch_hist = sched.metrics.hists.get("batch_scheduling_duration_seconds")
     return PerfData(
         name=name,
         n_nodes=len(snap.nodes),
@@ -109,8 +119,9 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
         p50_ms=round(q(0.50), 2),
         p90_ms=round(q(0.90), 2),
         p99_ms=round(q(0.99), 2),
-        batches=len(hist.samples) if hist else 0,
+        batches=len(batch_hist.samples) if batch_hist else 0,
         amortized_ms_per_pod=round(wall * 1e3 / scheduled, 3) if scheduled else 0.0,
+        latency_source=source,
     )
 
 

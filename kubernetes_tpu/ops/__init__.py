@@ -1,3 +1,3 @@
 from .filters import static_feasible, term_match  # noqa: F401
 from .scores import ScoreConfig, DEFAULT_SCORE_CONFIG, infer_score_config  # noqa: F401
-from .assign import schedule_batch  # noqa: F401
+from .assign import schedule_batch, schedule_batch_ordinals  # noqa: F401

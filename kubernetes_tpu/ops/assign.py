@@ -290,7 +290,8 @@ def _chunk_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 
 
 def schedule_scan_chunked(
-    arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False
+    arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False,
+    with_ordinals: bool = False,
 ):
     """Chunked sequential-commit scan via PREFIX-COMMIT SPECULATION rounds,
     BIT-IDENTICAL to schedule_scan for fit+balanced-only configs
@@ -419,7 +420,7 @@ def schedule_scan_chunked(
             return fit, vals, static
 
         def round_body(st):
-            committed, out, cleank, dlist, dsu, nd, nrounds = st
+            committed, out, ord_, cleank, dlist, dsu, nd, nrounds = st
             unc = ~committed
             # ---- pass 1: speculative choices vs live usage ----
             dn = jnp.maximum(dlist, 0)
@@ -540,6 +541,7 @@ def schedule_scan_chunked(
             prefix = unc & (idxC < firstbad)
             pact = prefix & (c >= 0)
             out = jnp.where(prefix, c, out)
+            ord_ = jnp.where(prefix, nrounds, ord_)  # commit-round ordinal
             committed = committed | prefix
             # stale list entries: nodes picked by the committed prefix
             cleank = cleank & ~(cmp & pact[None, None, :]).any(2)
@@ -558,29 +560,42 @@ def schedule_scan_chunked(
                 adds, mode="drop"
             )
             nd = nd + is_new.sum().astype(jnp.int32)
-            return committed, out, cleank, dlist, dsu, nd, nrounds + 1
+            return committed, out, ord_, cleank, dlist, dsu, nd, nrounds + 1
 
         st0 = (
             jnp.zeros(C, dtype=jnp.bool_),
             jnp.full(C, -1, dtype=jnp.int32),
+            jnp.zeros(C, dtype=jnp.int32),
             jnp.ones((C, K), dtype=jnp.bool_),
             jnp.full(C, -1, dtype=jnp.int32),
             jnp.zeros((C, R), dtype=used0.dtype),
             jnp.int32(0),
             jnp.int32(0),
         )
-        committed, out, _, _, _, _, nrounds = lax.while_loop(
+        committed, out, ord_, _, _, _, _, nrounds = lax.while_loop(
             lambda st: ~st[0].all(), round_body, st0
         )
         placed = (out >= 0)[:, None]
         used_out = used0.at[jnp.where(out >= 0, out, N)].add(
             jnp.where(placed, creq, 0), mode="drop"
         )
-        return used_out, (out, nrounds)
+        return used_out, (out, nrounds, ord_)
 
-    used_final, (choices, rounds) = lax.scan(
+    used_final, (choices, rounds, ords) = lax.scan(
         chunk, arr.node_used, (reqs, sfs, valids)
     )
+    if with_ordinals:
+        # global commit ordinal: rounds of all previous chunks + the pod's
+        # commit round within its chunk (pods committed in the same round
+        # share an ordinal — they were decided by the same device sweep);
+        # plus the TOTAL sweep count, the latency-estimate denominator
+        # (padding chunks sweep too, so the slice [:n_pods] alone would
+        # misattribute their wall share)
+        base = jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(rounds)[:-1]]
+        )
+        return (choices.reshape(P), used_final,
+                (base[:, None] + ords).reshape(P), rounds.sum())
     if with_rounds:
         return choices.reshape(P), used_final, rounds
     return choices.reshape(P), used_final
@@ -604,7 +619,8 @@ def _rounds_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 
 
 def schedule_scan_rounds(
-    arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False
+    arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False,
+    with_ordinals: bool = False,
 ):
     """Chunked sequential-commit scan for the FULL stage set — pairwise
     (PodTopologySpread + InterPodAffinity), NodePorts, TaintToleration
@@ -788,7 +804,7 @@ def schedule_scan_rounds(
         base0_init, fit0_init = base_at(used0)
 
         def round_body(st):
-            (committed, out, base0, fit0, used, cnt_node, anti_node,
+            (committed, out, ord_, base0, fit0, used, cnt_node, anti_node,
              pref_node, total_t, ports_used, nrounds) = st
             unc = ~committed
 
@@ -940,6 +956,7 @@ def schedule_scan_rounds(
             prefix = unc & (idxC < firstbad)
             pact = prefix & (c >= 0)
             out = jnp.where(prefix, c, out)
+            ord_ = jnp.where(prefix, nrounds, ord_)  # commit-round ordinal
             committed = committed | prefix
 
             # ---- absorb the prefix into the live state ----
@@ -1012,23 +1029,24 @@ def schedule_scan_rounds(
                         pref_node, _ = scatter_rows(
                             pref_node, cx["aff"], w_ha
                         )
-            return (committed, out, base0, fit0, used, cnt_node, anti_node,
-                    pref_node, total_t, ports_used, nrounds + 1)
+            return (committed, out, ord_, base0, fit0, used, cnt_node,
+                    anti_node, pref_node, total_t, ports_used, nrounds + 1)
 
         st0 = (
             jnp.zeros(C, dtype=jnp.bool_),
             jnp.full(C, -1, dtype=jnp.int32),
+            jnp.zeros(C, dtype=jnp.int32),
             base0_init,
             fit0_init,
             used0, cnt_node, anti_node, pref_node, total_t, ports_used,
             jnp.int32(0),
         )
         st = lax.while_loop(lambda s: ~s[0].all(), round_body, st0)
-        (_, out, _, _, used, cnt_node, anti_node, pref_node, total_t,
+        (_, out, ord_, _, _, used, cnt_node, anti_node, pref_node, total_t,
          ports_used, nrounds) = st
         return (
             (used, cnt_node, anti_node, pref_node, total_t, ports_used),
-            (out, nrounds),
+            (out, nrounds, ord_),
         )
 
     cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
@@ -1039,7 +1057,13 @@ def schedule_scan_rounds(
         arr.node_used, cnt_node0, anti_node0, pref_node0, total_t0,
         arr.node_ports0,
     )
-    (used_final, *_), (choices, rounds) = lax.scan(chunk, carry0, xs)
+    (used_final, *_), (choices, rounds, ords) = lax.scan(chunk, carry0, xs)
+    if with_ordinals:
+        base = jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(rounds)[:-1]]
+        )
+        return (choices.reshape(P), used_final,
+                (base[:, None] + ords).reshape(P), rounds.sum())
     if with_rounds:
         return choices.reshape(P), used_final, rounds
     return choices.reshape(P), used_final
@@ -1054,3 +1078,25 @@ def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array
 
 
 schedule_batch = partial(jax.jit, static_argnames=("cfg",))(schedule_batch_impl)
+
+
+def schedule_batch_ordinals_impl(arr: ClusterArrays, cfg: ScoreConfig):
+    """schedule_batch + (per-pod COMMIT ORDINAL i32[P], total sweeps i32):
+    the ordinal is the index of the sequential device sweep that decided
+    each pod (the scan step on the per-pod path; the global round on the
+    chunked paths); `sweeps` is the kernel's TOTAL sweep count including
+    pod-axis padding.  Together they turn a wave's single wall time into a
+    per-pod latency distribution — pod i's decision was available
+    ~(ordinal_i + 1) / sweeps of the way through the kernel step
+    (BASELINE.md p99 scheduling latency; round-3 verdict missing #5)."""
+    if _chunk_routed(arr, cfg):
+        return schedule_scan_chunked(arr, cfg, with_ordinals=True)
+    if _rounds_routed(arr, cfg):
+        return schedule_scan_rounds(arr, cfg, with_ordinals=True)
+    choices, used = schedule_scan(arr, cfg, axis_name=None)
+    return choices, used, jnp.arange(arr.P, dtype=jnp.int32), jnp.int32(arr.P)
+
+
+schedule_batch_ordinals = partial(jax.jit, static_argnames=("cfg",))(
+    schedule_batch_ordinals_impl
+)

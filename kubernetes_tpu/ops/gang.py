@@ -41,23 +41,39 @@ def failed_groups(choices: np.ndarray, pod_group: np.ndarray, group_min: np.ndar
 
 
 def schedule_with_gangs(
-    arr: ClusterArrays, cfg: ScoreConfig
-) -> Tuple[np.ndarray, np.ndarray]:
+    arr: ClusterArrays, cfg: ScoreConfig, with_ordinals: bool = False
+):
     """Schedule honoring all-or-nothing groups.
 
-    Returns (choices i32[P] with revoked gangs at -1, node_used i32[N, R])."""
+    Returns (choices i32[P] with revoked gangs at -1, node_used i32[N, R]);
+    with_ordinals appends (ordinals, sweeps): per-pod commit ordinals
+    positioned AFTER the earlier fixpoint iterations' sweeps (a pod's
+    decision is only available once the final program ran), with `sweeps`
+    the total across all iterations — see assign.schedule_batch_ordinals."""
+    from .assign import schedule_batch_ordinals
+
     pod_valid = np.asarray(arr.pod_valid).copy()
     revoked = np.zeros_like(pod_valid)
+    sweeps_prior = 0
     while True:
         import dataclasses
 
         arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
-        choices, used = schedule_batch(arr_i, cfg)
+        if with_ordinals:
+            choices, used, ords, sweeps = schedule_batch_ordinals(arr_i, cfg)
+        else:
+            choices, used = schedule_batch(arr_i, cfg)
         choices = np.asarray(choices)
         pod_group = np.asarray(arr.pod_group)
         bad = failed_groups(choices, pod_group, np.asarray(arr.group_min), active=pod_valid)
         if not bad.any():
+            if with_ordinals:
+                return (choices, np.asarray(used),
+                        np.asarray(ords) + sweeps_prior,
+                        sweeps_prior + int(sweeps))
             return choices, np.asarray(used)
+        if with_ordinals:
+            sweeps_prior += int(sweeps)
         # revoke the failed group appearing earliest in activeQ order
         in_bad = bad[np.maximum(pod_group, 0)] & (pod_group >= 0) & pod_valid
         first_g = pod_group[int(np.argmax(in_bad))]

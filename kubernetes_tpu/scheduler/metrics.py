@@ -93,3 +93,16 @@ class Metrics:
         p = self._prom.get(name)
         if p is not None:
             p.observe(v)
+
+    def observe_many(self, name: str, values) -> None:
+        """Bulk-append samples (a batch wave's per-pod latency estimates:
+        one observe() call per pod would serialize 50k lock round-trips)."""
+        values = list(values)
+        with self._lock:
+            h = self.hists[name]
+        with h._lock:
+            h.samples.extend(float(v) for v in values)
+        p = self._prom.get(name)
+        if p is not None:  # pragma: no cover - optional path
+            for v in values:
+                p.observe(v)

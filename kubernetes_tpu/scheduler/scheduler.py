@@ -702,17 +702,33 @@ class Scheduler:
                 )
             arr, meta = self._delta_enc.encode(snap)
             cfg = infer_score_config(arr, base_cfg)
+            ords = sweeps = None
+            t_k0 = time.perf_counter()
             if self.config.mode == "native":
                 from ..native import schedule_batch_native, schedule_with_gangs_native
 
                 fn = schedule_with_gangs_native if gang else schedule_batch_native
                 choices = fn(arr, cfg)[0]
+                if not gang:
+                    # the C++ engine commits strictly in pod order: the
+                    # ordinal IS the index, and every pod is one sweep
+                    ords = np.arange(meta.n_pods, dtype=np.int64)
+                    sweeps = meta.n_pods
             elif gang:
-                choices, _ = schedule_with_gangs(arr, cfg)
+                choices, _, ords, sweeps = schedule_with_gangs(
+                    arr, cfg, with_ordinals=True
+                )
             else:
-                from ..ops import schedule_batch as kernel
+                from ..ops import schedule_batch_ordinals as kernel
 
-                choices = np.asarray(kernel(arr, cfg)[0])
+                choices, _, ords, sweeps = kernel(arr, cfg)
+                choices = np.asarray(choices)
+            if ords is not None:
+                self._observe_wave_latency(
+                    np.asarray(ords)[: meta.n_pods],
+                    time.perf_counter() - t_k0,
+                    int(sweeps),
+                )
             uid_of = {p.name: p.uid for p in snap.pending_pods}
             verdicts = {
                 uid_of[meta.pod_names[k]]: (
@@ -802,6 +818,30 @@ class Scheduler:
                         self._clear_nomination(pod)
                 self.queue.add_unschedulable(pod, backoff=True)
         return result, len(failed)
+
+    def _observe_wave_latency(
+        self, ordinals: np.ndarray, t_kernel: float, sweeps: int
+    ) -> None:
+        """Per-pod estimated scheduling latency within one batch wave.
+
+        The kernels report each pod's COMMIT ORDINAL — the index of the
+        sequential device sweep (scan step / chunked round) that decided it
+        — and the TOTAL sweep count including pod-axis padding (the bucket
+        pad sweeps cost wall time too; normalizing by the max REAL ordinal
+        would misattribute their share to the tail and jump across bucket
+        boundaries).  Sweeps are near-uniform in cost, so pod i's decision
+        became available ~(ordinal+1)/sweeps of the way through the kernel
+        wall; that estimate is what turns batch mode's single wall time
+        into a real p50/p90/p99 distribution (BASELINE.md's per-pod
+        latency metric; the wave's encode/bind overheads are amortized
+        constants and excluded — this measures scheduling decision
+        latency)."""
+        if ordinals.size == 0 or sweeps <= 0:
+            return
+        est = (ordinals.astype(np.float64) + 1.0) * (t_kernel / float(sweeps))
+        self.metrics.observe_many(
+            "scheduling_attempt_duration_estimate_seconds", est
+        )
 
     def _nominate(self, pod: t.Pod, node_name: str) -> None:
         """Record the nomination (queue nominator) and publish it on the pod's

@@ -1,0 +1,111 @@
+"""Round-4 soak: the PRODUCTION batch route under forced-chunked routing
+(KTPU_FORCE_CHUNKED=1 — the rounds/chunked kernels on the CPU sim, the
+round-3 verdict's "production routing predicate is untestable off-TPU"),
+with the delta encoder's identity-convention cross-check enabled
+(KTPU_DELTA_VERIFY=1 — the round-3 verdict's "debug_verify never runs in
+CI").  Waves are sized so the bucketed pod axis reaches >= 128 and the
+chunked paths actually engage through Scheduler.schedule_batch, not via
+direct kernel calls."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.queue import FakeClock
+from helpers import mk_node, mk_pod
+
+
+@pytest.mark.parametrize("seed", [11])
+def test_round4_forced_chunked_soak_with_delta_verify(seed, monkeypatch):
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    monkeypatch.setenv("KTPU_DELTA_VERIFY", "1")
+    rng = random.Random(seed)
+    clock = FakeClock()
+    store = ClusterStore()
+    for i in range(21):
+        store.add_node(mk_node(f"n{i}", cpu=16000, pods=40,
+                               labels={t.LABEL_ZONE: f"z{i % 3}"}))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"), clock=clock)
+
+    serial = 0
+    for cycle in range(6):
+        # a big mixed wave: bucketed P >= 128 so the chunked routing engages
+        n_wave = rng.randint(70, 130)
+        for _ in range(n_wave):
+            kind = rng.random()
+            if kind < 0.5:
+                p = mk_pod(f"p{serial}", cpu=rng.choice([100, 300, 700]),
+                           labels={"app": rng.choice(["web", "db"])})
+            elif kind < 0.8:
+                p = mk_pod(
+                    f"s{serial}", cpu=200,
+                    labels={"app": "web"},
+                    topology_spread=(
+                        t.TopologySpreadConstraint(
+                            max_skew=2, topology_key=t.LABEL_ZONE,
+                            when_unsatisfiable=t.DO_NOT_SCHEDULE,
+                            label_selector=t.LabelSelector.of(app="web"),
+                        ),
+                    ),
+                )
+            else:
+                p = mk_pod(
+                    f"a{serial}", cpu=150, labels={"app": "db"},
+                    affinity=t.Affinity(required_pod_anti_affinity=(
+                        t.PodAffinityTerm(
+                            topology_key=t.LABEL_HOSTNAME,
+                            label_selector=t.LabelSelector.of(
+                                app=f"solo{serial % 5}"),
+                        ),)),
+                )
+            store.add_pod(p)
+            serial += 1
+        sched.run_until_idle()
+        # churn: complete/delete a slice of bound pods so the next cycle
+        # exercises the DELTA path (bind absorb + deletes), which is the
+        # path debug_verify cross-checks
+        bound = [p for p in store.pods.values() if p.node_name]
+        for p in rng.sample(bound, min(len(bound), 30)):
+            store.delete_pod(p.uid)
+        clock.step(2.0)
+
+        # capacity invariant under the chunked production route
+        for nd in store.nodes.values():
+            used = sum(
+                q.requests.get(t.CPU, 0)
+                for q in store.pods.values()
+                if q.node_name == nd.name
+                and q.phase not in (t.PHASE_SUCCEEDED, t.PHASE_FAILED)
+            )
+            assert used <= nd.allocatable[t.CPU], (nd.name, used)
+
+    # the forced routing must actually have been in force for the shapes
+    # this soak produced...
+    from kubernetes_tpu.ops.assign import _chunk_routed, _rounds_routed
+    from kubernetes_tpu.ops.scores import infer_score_config, DEFAULT_SCORE_CONFIG
+
+    assert sched._delta_enc is not None
+    snap = sched.cache.update_snapshot()
+    arr, _ = sched._delta_enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    assert _chunk_routed(arr, cfg) or _rounds_routed(arr, cfg) or arr.P < 128
+    # ...and the delta cross-check must have RUN (not just been enabled)
+    assert sched._delta_enc.debug_verify
+    assert sched._delta_enc.stats["delta"] > 0, sched._delta_enc.stats
+    assert sched._delta_enc.stats["verified"] > 0, sched._delta_enc.stats
+
+    # decisions through the resident (delta-synced, verified) encoder match
+    # a from-scratch encoder on the final state
+    from kubernetes_tpu.api.delta import DeltaEncoder
+    from kubernetes_tpu.ops import schedule_batch
+
+    if snap.pending_pods:
+        got_arr, gm = sched._delta_enc.encode(snap)
+        want_arr, wm = DeltaEncoder().encode(snap)
+        cfg = infer_score_config(want_arr, DEFAULT_SCORE_CONFIG)
+        g = np.asarray(schedule_batch(got_arr, cfg)[0])[: gm.n_pods]
+        w = np.asarray(schedule_batch(want_arr, cfg)[0])[: wm.n_pods]
+        np.testing.assert_array_equal(g, w)
