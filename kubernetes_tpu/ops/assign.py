@@ -82,6 +82,7 @@ def schedule_scan(
     axis_name is None, or this shard's node slice under shard_map.
 
     Returns (assignment i32[P] — GLOBAL node index or -1, node_used i32[N,R])."""
+    TRACE_COUNTS["plain"] += 1
     local_n = arr.N
     if axis_name:
         base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
@@ -252,6 +253,13 @@ _CHUNK = 128  # pods per chunk on the chunked path (buckets are multiples)
 _SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
 _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 
+# Trace-time counters, bumped when a kernel's Python body actually runs
+# under jit tracing (once per cache entry).  Tests use them to prove WHICH
+# kernel a routed call compiled — the routing env override is read at trace
+# time, so asserting on the predicate alone can be vacuous against a warm
+# jit cache.
+TRACE_COUNTS = {"plain": 0, "chunked": 0, "rounds": 0}
+
 
 def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
     """The chunked scan applies when the ONLY scan-carried state is node
@@ -339,6 +347,7 @@ def schedule_scan_chunked(
     once per chunk from the committed choices.  Exact because fit/least/
     balanced depend on per-node usage only — there are no cross-node
     normalizations on this path."""
+    TRACE_COUNTS["chunked"] += 1
     local_n = arr.N
     my_nodes = jnp.arange(local_n, dtype=jnp.int32)
 
@@ -688,6 +697,7 @@ def schedule_scan_rounds(
     inner while_loop additionally carries the patched base/fit hoists
     [C, N].  All count updates are integer-valued f32 / int32 scatter-adds
     — order-independent and exact below 2^24."""
+    TRACE_COUNTS["rounds"] += 1
     local_n = arr.N
     my_nodes = jnp.arange(local_n, dtype=jnp.int32)
     P, N, R = arr.P, arr.N, arr.R

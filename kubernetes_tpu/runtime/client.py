@@ -69,8 +69,12 @@ class TPUScoreClient:
         self._nodes_fp: Optional[Tuple] = None
         self._last_wave: Dict[str, t.Pod] = {}
         self._known_bound: Dict[str, t.Pod] = {}
+        self._last_assign: Dict[str, str] = {}  # server's previous assignment
         self._fp_refs: Tuple = ()
-        self.stats = {"full": 0, "delta": 0, "resync": 0, "not_ready": 0}
+        self.stats = {
+            "full": 0, "delta": 0, "resync": 0, "not_ready": 0,
+            "binds_compressed": 0, "binds_explicit": 0,
+        }
 
     def health(self, timeout_s: float = 2.0) -> pb.HealthResponse:
         try:
@@ -130,6 +134,7 @@ class TPUScoreClient:
         req.delta.SetInParent()  # presence even when the diff is empty
         d = req.delta
         d.base_epoch = self._epoch - 1
+        covered = set()
         for p in snap.bound_pods:
             known = self._known_bound.get(p.uid)
             if known is not None:
@@ -145,12 +150,30 @@ class TPUScoreClient:
                 continue
             prev = self._last_wave.get(p.uid)
             if prev is not None and _spec_fields_match(prev, p):
-                d.binds.add(pod_uid=p.uid, node=p.node_name)
+                # the common steady-state bind: if it lands exactly where
+                # the server's previous response assigned it, it rides the
+                # bind_prev_assignment compression instead of a Bind message
+                if self._last_assign.get(p.uid) == p.node_name:
+                    covered.add(p.uid)
+                else:
+                    d.binds.add(pod_uid=p.uid, node=p.node_name)
             else:
                 # never seen pending (external bind), or the bound copy
                 # drifted from the wave spec (e.g. label update raced the
                 # bind): ship the object itself
                 d.added_bound.append(pod_to_proto(p))
+        if covered:
+            exc = [uid for uid in self._last_assign if uid not in covered]
+            if len(exc) < len(covered):
+                d.bind_prev_assignment = True
+                d.bind_prev_except.extend(exc)
+                self.stats["binds_compressed"] += len(covered)
+            else:
+                # a mostly-unbound assignment: the exception list would
+                # outweigh the saved Bind messages — ship binds explicitly
+                for uid in covered:
+                    d.binds.add(pod_uid=uid, node=self._last_assign[uid])
+        self.stats["binds_explicit"] += len(d.binds)
         bound_now = {p.uid for p in snap.bound_pods}
         d.deleted_uids.extend(
             uid for uid in self._known_bound if uid not in bound_now
@@ -227,14 +250,17 @@ class TPUScoreClient:
         self._known_bound = {p.uid: p for p in snap.bound_pods}
         if resp.not_ready:
             self.stats["not_ready"] += 1
+            self._last_assign = {}  # no assignment to echo next cycle
             raise SidecarUnavailable("sidecar compiling (not ready)")
         # aligned-array verdicts: assignment[i] is a node index (our own node
         # list's order) for pending pod i in the order we sent the wave
         names = [nd.name for nd in snap.nodes]
-        return {
+        out = {
             p.uid: (names[c] if c >= 0 else None)
             for p, c in zip(snap.pending_pods, resp.assignment)
         }
+        self._last_assign = {u: n for u, n in out.items() if n is not None}
+        return out
 
     def _schedule_stateless(self, snap, deadline_ms, gang, hpaw):
         from .convert import snapshot_to_proto

@@ -62,6 +62,9 @@ class _Session:
         # uid -> the wave pod's spec REP (no per-pod objects exist on the
         # session path; bind copies clone from the rep with clone_pod)
         self.last_wave: Dict[str, t.Pod] = {}
+        # uid -> node NAME assigned by the previous response — the referent
+        # of the delta's bind_prev_assignment compression
+        self.last_assign: Dict[str, str] = {}
         # serialized-spec-bytes -> decoded rep Pod (convert.wave_from_proto):
         # keeps rep OBJECTS stable across waves so the resident encoder's
         # identity-level interning hits instead of re-canonicalizing
@@ -84,14 +87,26 @@ class _Engine:
     in the background instead of blowing the client's deadline; smaller
     problems compile inline (sub-second on any backend)."""
 
-    MAX_SESSIONS = 4  # LRU-evicted; each session pins cluster state + encoder
+    # LRU-evicted.  MEMORY NOTE: each session pins its full cluster (node/
+    # bound-pod objects), a resident DeltaEncoder, and that encoder's device
+    # buffers — at north-star scale roughly (20k Node objects + padded
+    # [P, N]-adjacent arrays) per session, so 4 sessions ≈ 4x the snapshot
+    # residency.  There is no byte accounting; the cap IS the bound, and
+    # sidecar_sessions_resident exposes the current count.
+    MAX_SESSIONS = 4
 
     def __init__(self, warmup_threshold: int = 4_000_000):
+        from ..scheduler.metrics import Metrics
+
         self._lock = threading.Lock()  # device owner
         self._state_lock = threading.Lock()  # session bookkeeping
         self._sessions: Dict[str, _Session] = {}  # insertion == LRU order
         self.warmup_threshold = warmup_threshold
         self._compiled: set = set()  # coarse (P_bucket, N_bucket, gang) shapes
+        # per-phase latency histograms (decode/encode/step/readback) — the
+        # round-3 loopback waves showed a 1.85->3.22 s spread with no way to
+        # attribute it; these are served over HealthServer /metrics
+        self.metrics = Metrics()
 
     # --- legacy stateless path ---
     def schedule(self, snap, gang: bool, hard_pod_affinity_weight: float = 1.0):
@@ -145,6 +160,18 @@ class _Engine:
                 d = request.delta
                 if sess is None or sess.epoch != d.base_epoch or sess.hpaw != hpaw:
                     raise _ResyncRequired()
+                if d.bind_prev_assignment:
+                    # the client echoes our own previous assignment: bind it
+                    # wholesale minus the exception list (no per-pod strings
+                    # crossed the wire)
+                    exc = set(d.bind_prev_except)
+                    for uid, node in sess.last_assign.items():
+                        if uid in exc:
+                            continue
+                        rep = sess.last_wave.get(uid)
+                        if rep is None:
+                            raise _ResyncRequired()
+                        sess.bound[uid] = clone_pod(rep, uid, uid, node)
                 for b in d.binds:
                     rep = sess.last_wave.get(b.pod_uid)
                     if rep is None:
@@ -196,6 +223,17 @@ class _Engine:
         return (_bucket(len(uids)), _bucket(len(sess.nodes)), gang)
 
     def run_session(self, sess: _Session, wave, gang: bool, view=None):
+        """One wave: encode -> dispatch -> readback, PIPELINED across
+        requests.  The device lock covers only host encode + kernel
+        DISPATCH (JAX queues device work asynchronously and in order); the
+        blocking readback happens OUTSIDE the lock, so while wave k's step
+        executes on the device, wave k+1's decode and host encode proceed
+        — the host/device overlap that separated the round-3 loopback
+        waves (~2 s serial) from the <1 s budget.  Per-session encoder
+        state stays single-writer: one client per session sends serially,
+        and cross-session encoders are distinct objects.  The gang
+        fixpoint iterates data-dependently (revoke -> re-run), so it
+        blocks under the lock as before."""
         from ..ops import schedule_batch
         from ..ops.gang import schedule_with_gangs
         from ..ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
@@ -206,6 +244,7 @@ class _Engine:
                 view = (list(sess.bound.values()), dict(sess.pod_groups))
         bound, groups = view
         with self._lock:
+            t0 = time.perf_counter()
             arr, meta = sess.enc.encode_device_pregrouped(
                 sess.nodes, bound, groups, uids, reps, inv,
             )
@@ -213,12 +252,24 @@ class _Engine:
                 DEFAULT_SCORE_CONFIG, hard_pod_affinity_weight=sess.hpaw
             )
             cfg = infer_score_config(arr, base)
+            t1 = time.perf_counter()
+            self.metrics.observe("sidecar_encode_seconds", t1 - t0)
             if gang:
                 choices, _ = schedule_with_gangs(arr, cfg)
-            else:
-                choices = np.asarray(schedule_batch(arr, cfg)[0])
+                self.metrics.observe(
+                    "sidecar_step_seconds", time.perf_counter() - t1
+                )
+                self._compiled.add(self.coarse_shape_parts(sess, wave, gang))
+                return choices, meta
+            choices_dev = schedule_batch(arr, cfg)[0]  # async dispatch
+            t2 = time.perf_counter()
+            self.metrics.observe("sidecar_dispatch_seconds", t2 - t1)
             self._compiled.add(self.coarse_shape_parts(sess, wave, gang))
-            return choices, meta
+        # blocking transfer outside the device lock: waits on the device
+        # stream while the next request encodes
+        choices = np.asarray(choices_dev)
+        self.metrics.observe("sidecar_step_seconds", time.perf_counter() - t2)
+        return choices, meta
 
     def warmup(self, sess: _Session, wave, gang: bool, view=None) -> None:
         """Background: encode + compile + run once, then mark ready.  The
@@ -285,6 +336,10 @@ class TPUScoreServer:
             sess, wave, view = self.engine.apply_request(request)
         except _ResyncRequired:
             return pb.ScheduleResponse(resync_required=True)
+        m = self.engine.metrics
+        m.observe("sidecar_decode_seconds", time.perf_counter() - t0)
+        with self.engine._state_lock:
+            m.set("sidecar_sessions_resident", len(self.engine._sessions))
         if not sess.ready:
             eng = self.engine
             small = (
@@ -314,7 +369,20 @@ class TPUScoreServer:
         out = np.full(meta.n_pods, -1, dtype=np.int64)
         out[meta.pod_perm] = np.asarray(choices[: meta.n_pods], dtype=np.int64)
         resp.assignment.extend(out.tolist())
+        # remember what we just assigned: the next delta may bind it by
+        # reference (bind_prev_assignment) instead of 50k Bind messages.
+        # Built OUTSIDE the state lock (50k-entry dict; control-plane
+        # answers must not wait on it) — only the reference store is locked.
+        node_names = [nd.name for nd in sess.nodes]
+        last_assign = {
+            uid: node_names[int(c)]
+            for uid, c in zip(wave[0], out.tolist())
+            if c >= 0
+        }
+        with self.engine._state_lock:
+            sess.last_assign = last_assign
         resp.elapsed_ms = (time.perf_counter() - t0) * 1e3
+        m.observe("sidecar_schedule_seconds", time.perf_counter() - t0)
         return resp
 
     def _schedule_stateless(self, request, t0) -> pb.ScheduleResponse:
@@ -459,6 +527,7 @@ def main() -> None:  # pragma: no cover - manual entry point
     port = srv.start()
     if args.health_port:
         hs = HealthServer(f"127.0.0.1:{args.health_port}",
+                          metrics=srv.engine.metrics,
                           ready_check=lambda: srv.engine.ready)
         print(f"health endpoints on port {hs.start()}")
     print(f"tpuscore sidecar listening on port {port}")

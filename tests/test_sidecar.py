@@ -434,3 +434,44 @@ def test_session_deltas_survive_volume_state(server):
     client.schedule(snap, deadline_ms=60_000)
     assert client.stats["full"] == 2, client.stats
     client.close()
+
+
+def test_session_bind_compression_engages_and_matches(server):
+    """Steady-state binds ride bind_prev_assignment — the server re-binds
+    its own previous assignment minus an exception list instead of decoding
+    N Bind messages — and the session must stay decision-identical to a
+    stateless client, including a pod the client did NOT bind (exception)
+    and a departed pod (delete after compressed bind)."""
+    import dataclasses
+
+    client = TPUScoreClient(f"127.0.0.1:{server.port}")
+    stateless = TPUScoreClient(f"127.0.0.1:{server.port}", session=False)
+    nodes = [mk_node(f"n{i}", cpu=4000) for i in range(6)]
+    bound = []
+    skipped_uid = None
+    for cycle in range(4):
+        wave = _wave(8, f"c{cycle}")
+        snap = Snapshot(nodes=nodes, pending_pods=wave, bound_pods=list(bound))
+        got = client.schedule(snap, deadline_ms=60_000)
+        want = stateless.schedule(snap, deadline_ms=60_000)
+        assert got == want, f"cycle {cycle}"
+        for k, p in enumerate(wave):
+            node = got[p.uid]
+            if node is None:
+                continue
+            if k == 3:
+                # the client declines one bind per wave (volume failure
+                # analog): must land on the exception list, not the server
+                skipped_uid = p.uid
+                continue
+            bound.append(dataclasses.replace(p, node_name=node))
+        if bound:
+            bound.pop(0)  # churn: a bound pod departs each cycle
+    assert client.stats["binds_compressed"] > 0, client.stats
+    # compression carried the steady state: almost no explicit Bind messages
+    assert client.stats["binds_explicit"] == 0, client.stats
+    # the server's session state does NOT contain the skipped pod
+    sess = next(iter(server.engine._sessions.values()))
+    assert skipped_uid is not None and skipped_uid not in sess.bound
+    client.close()
+    stateless.close()
