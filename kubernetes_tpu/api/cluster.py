@@ -550,3 +550,55 @@ class DeviceClass:
     @property
     def key(self) -> str:
         return self.name
+
+
+@dataclass
+class ResourceClaim:
+    """resource.k8s.io/v1 — type ResourceClaim, reduced to the DRA-lite
+    model (counted devices of one DeviceClass — the schedulable core behind
+    Pod.resource_claims).  Generated claims carry their owner pod's uid
+    (resourceclaim controller: created from pod claim templates, reserved
+    for the pod while it runs, released and deleted when it finishes —
+    pkg/controller/resourceclaim/controller.go)."""
+
+    name: str
+    namespace: str = "default"
+    device_class: str = ""
+    count: int = 1
+    owner_pod_uid: str = ""  # "" = user-created standalone claim
+    reserved_for: Tuple[str, ...] = ()  # status.reservedFor pod uids
+    allocated: bool = False  # status.allocation present
+    uid: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"claim/{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class CertificateSigningRequest:
+    """certificates.k8s.io/v1 — type CertificateSigningRequest: the kubelet
+    serving/client certificate flow (cluster-scoped).  status: Pending ->
+    Approved|Denied (approver policy) -> certificate issued (signer)."""
+
+    name: str
+    username: str = ""  # the requester (spec.username)
+    groups: Tuple[str, ...] = ()
+    signer_name: str = "kubernetes.io/kubelet-serving"
+    usages: Tuple[str, ...] = ("digital signature", "server auth")
+    status: str = "Pending"  # Pending | Approved | Denied
+    certificate: str = ""  # status.certificate (issued by the signer)
+    created_at: float = 0.0
+    uid: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"csr/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return self.name

@@ -58,6 +58,8 @@ KINDS: Dict[str, type] = {
     "StorageClass": c.StorageClass,
     "ResourceSlice": c.ResourceSlice,
     "DeviceClass": c.DeviceClass,
+    "ResourceClaim": c.ResourceClaim,
+    "CertificateSigningRequest": c.CertificateSigningRequest,
     "Event": c.ClusterEvent,
     "ServiceAccount": c.ServiceAccount,
 }

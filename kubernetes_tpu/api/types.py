@@ -270,6 +270,9 @@ class Node:
     images: Dict[str, int] = field(default_factory=dict)
     # CSI attachable-volume limit (NodeVolumeLimits/csi.go); 0 = unlimited
     volume_attach_limit: int = 0
+    # NodeStatus.VolumesAttached — PV names the attach/detach controller has
+    # attached here (controllers.py — AttachDetachController)
+    volumes_attached: Tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         self.labels.setdefault(LABEL_HOSTNAME, self.name)

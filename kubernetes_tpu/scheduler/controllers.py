@@ -765,6 +765,160 @@ class ServiceAccountController:
                 )
 
 
+class AttachDetachController:
+    """pkg/controller/volume/attachdetach — reconciler.go: converge the
+    actual attachment state (NodeStatus.VolumesAttached) onto the desired
+    state (every PV referenced through the PVCs of a non-finished pod bound
+    to the node).  Detach happens when the last using pod leaves; nodes
+    whose set is already correct are not touched (a node update would churn
+    the delta encoder's identity fingerprints for nothing)."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def tick(self) -> None:
+        # claimRef -> PV index built once per tick: the steady-state no-op
+        # pass must not pay O(pods x PVs) linear rescans
+        pv_by_claim = {
+            pv.claim_ref: pv.name
+            for pv in self.store.pvs.values()
+            if pv.claim_ref
+        }
+        desired: Dict[str, set] = {}
+        for pod in self.store.pods.values():
+            if not pod.node_name or _is_finished(pod):
+                continue
+            for claim in pod.pvcs:
+                key = f"{pod.namespace}/{claim}"
+                pvc = self.store.pvcs.get(key)
+                pv = (
+                    pvc.volume_name
+                    if pvc is not None and pvc.volume_name
+                    else pv_by_claim.get(key)
+                )
+                if pv is not None:
+                    desired.setdefault(pod.node_name, set()).add(pv)
+        for nd in list(self.store.nodes.values()):
+            want = tuple(sorted(desired.get(nd.name, ())))
+            if tuple(nd.volumes_attached) != want:
+                q = copy_module.copy(nd)
+                q.volumes_attached = want
+                self.store.update_node(q)
+
+
+class ResourceClaimController:
+    """pkg/controller/resourceclaim/controller.go reduced to the DRA-lite
+    model: materialize a generated ResourceClaim per (pod, claim-template
+    slot), reserve it for the pod once bound (status.reservedFor), and
+    release + delete generated claims when their owner pod finishes or
+    disappears (the ownerRef cascade)."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    @staticmethod
+    def _claim_name(pod: t.Pod, i: int) -> str:
+        return f"{pod.name}-claim-{i}"
+
+    def tick(self) -> None:
+        from ..api import cluster as c
+
+        live: Dict[str, t.Pod] = {
+            p.uid: p for p in self.store.pods.values() if not _is_finished(p)
+        }
+        wanted = set()
+        for pod in live.values():
+            for i, ref in enumerate(pod.resource_claims):
+                key = f"{pod.namespace}/{self._claim_name(pod, i)}"
+                wanted.add(key)
+                cur = self.store.get_object("ResourceClaim", key)
+                if cur is None:
+                    self.store.add_object(
+                        "ResourceClaim",
+                        c.ResourceClaim(
+                            name=self._claim_name(pod, i),
+                            namespace=pod.namespace,
+                            device_class=ref.device_class,
+                            count=ref.count,
+                            owner_pod_uid=pod.uid,
+                            reserved_for=(pod.uid,) if pod.node_name else (),
+                            allocated=bool(pod.node_name),
+                        ),
+                    )
+                elif bool(pod.node_name) != cur.allocated or (
+                    (pod.uid in cur.reserved_for) != bool(pod.node_name)
+                ):
+                    q = copy_module.copy(cur)
+                    q.allocated = bool(pod.node_name)
+                    q.reserved_for = (pod.uid,) if pod.node_name else ()
+                    self.store.update_object("ResourceClaim", q)
+        for claim in list(self.store.list_objects("ResourceClaim")):
+            if not claim.owner_pod_uid:
+                continue  # standalone user claim: not ours to manage
+            if claim.key not in wanted:
+                # owner gone or finished: release and GC the generated claim
+                self.store.delete_object("ResourceClaim", claim.key)
+
+
+class CertificatesController:
+    """pkg/controller/certificates — the approver (approver.go sarApprove
+    policy reduced to group membership) + signer (issue status.certificate
+    for approved CSRs) + cleaner (certificate_controller's GC of stale
+    CSRs after --csr-cleaner-interval; denied/expired requests age out)."""
+
+    AUTO_APPROVE_SIGNERS = (
+        "kubernetes.io/kubelet-serving",
+        "kubernetes.io/kube-apiserver-client-kubelet",
+    )
+    TTL_S = 3600.0  # cleaner horizon for denied/issued CSRs
+
+    def __init__(self, store: ClusterStore, clock=None):
+        from .queue import Clock
+
+        self.store = store
+        self.clock = clock or Clock()  # one clock domain with the siblings
+        self._seen: Dict[str, float] = {}  # csr uid -> first-observed time
+
+    def tick(self) -> None:
+        now = self.clock.now()
+        listed = list(self.store.list_objects("CertificateSigningRequest"))
+        # CSRs deleted by anyone else must not leak _seen entries forever
+        live = {csr.uid for csr in listed}
+        for uid in [u for u in self._seen if u not in live]:
+            del self._seen[uid]
+        for csr in listed:
+            # age runs from first observation; "unset" is tracked separately
+            # from the timestamp value (a FakeClock legitimately starts at 0)
+            if csr.uid not in self._seen:
+                self._seen[csr.uid] = now
+                if csr.created_at:
+                    self._seen[csr.uid] = csr.created_at
+            if csr.status == "Pending":
+                q = copy_module.copy(csr)
+                ok = csr.signer_name in self.AUTO_APPROVE_SIGNERS and (
+                    "system:nodes" in csr.groups
+                    or csr.username.startswith("system:node:")
+                )
+                q.status = "Approved" if ok else "Denied"
+                q.created_at = self._seen[csr.uid]
+                self.store.update_object("CertificateSigningRequest", q)
+                csr = q
+            if csr.status == "Approved" and not csr.certificate:
+                q = copy_module.copy(csr)
+                digest = hashlib.sha1(
+                    f"{csr.name}:{csr.username}:{csr.signer_name}".encode()
+                ).hexdigest()
+                q.certificate = f"-----BEGIN CERTIFICATE-----\n{digest}\n-----END CERTIFICATE-----"
+                self.store.update_object("CertificateSigningRequest", q)
+                csr = q
+            if csr.status in ("Denied", "Approved"):
+                if now - self._seen[csr.uid] > self.TTL_S:
+                    self.store.delete_object(
+                        "CertificateSigningRequest", csr.key
+                    )
+                    self._seen.pop(csr.uid, None)
+
+
 class ControllerManager:
     """cmd/kube-controller-manager — runs the controller set; tick() is one
     reconcile round across all of them (deployment before replicaset so a
@@ -789,6 +943,9 @@ class ControllerManager:
         self.namespaces = NamespaceController(store)
         self.podgc = PodGCController(store)
         self.ttl = TTLAfterFinishedController(store, clock=clock)
+        self.attachdetach = AttachDetachController(store)
+        self.resourceclaims = ResourceClaimController(store)
+        self.certificates = CertificatesController(store, clock=clock)
         self.gc = GarbageCollector(store)
 
     def tick(self) -> None:
@@ -805,6 +962,9 @@ class ControllerManager:
         self.namespaces.tick()
         self.podgc.tick()
         self.ttl.tick()
+        self.attachdetach.tick()
+        self.resourceclaims.tick()
+        self.certificates.tick()
         self.gc.tick()
 
     def tick_until_quiescent(self, max_rounds: int = 20) -> None:

@@ -64,6 +64,8 @@ BUILTIN_KINDS = (
     "StorageClass",
     "ResourceSlice",
     "DeviceClass",
+    "ResourceClaim",
+    "CertificateSigningRequest",
     "Event",
     "ServiceAccount",
 )

@@ -217,3 +217,100 @@ def test_unschedulable_pod_wakes_when_bound_pod_completes():
     clock.step(30.0)  # clear waiter's backoff
     sched.run_until_idle()
     assert store.pods["default/waiter"].node_name == "n0"
+
+
+def test_attach_detach_controller_reconciles_node_attachments():
+    """AttachDetach: PVs used by bound pods appear in the node's
+    volumes_attached; the last user leaving detaches; untouched nodes are
+    not rewritten (identity-stable for the delta encoder)."""
+    from kubernetes_tpu.scheduler.controllers import AttachDetachController
+
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    store.add_node(mk_node("n1"))
+    store.add_pv(t.PersistentVolume(name="pv-a", capacity=10,
+                                    storage_class="std",
+                                    claim_ref="default/claim-a"))
+    store.add_pvc(t.PersistentVolumeClaim(name="claim-a", request=5,
+                                          storage_class="std",
+                                          volume_name="pv-a"))
+    p = mk_pod("user", cpu=100, node_name="n0")
+    p.pvcs = ("claim-a",)
+    store.add_pod(p)
+    ctrl = AttachDetachController(store)
+    ctrl.tick()
+    assert store.nodes["n0"].volumes_attached == ("pv-a",)
+    assert store.nodes["n1"].volumes_attached == ()
+    n1_obj = store.nodes["n1"]
+    ctrl.tick()  # steady state: no node rewrites
+    assert store.nodes["n1"] is n1_obj
+    # the using pod finishes -> detach
+    q = store.pods[p.uid]
+    import copy
+    q2 = copy.copy(q)
+    q2.phase = t.PHASE_SUCCEEDED
+    store.update_pod_status(q2)
+    ctrl.tick()
+    assert store.nodes["n0"].volumes_attached == ()
+
+
+def test_resourceclaim_controller_lifecycle():
+    """ResourceClaim: generated claim per pod template slot, reserved and
+    allocated once bound, deleted when the owner finishes; standalone
+    claims untouched."""
+    from kubernetes_tpu.api import cluster as c
+    from kubernetes_tpu.scheduler.controllers import ResourceClaimController
+
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    store.add_object("ResourceClaim",
+                     c.ResourceClaim(name="standalone", device_class="gpu"))
+    p = mk_pod("dra", cpu=100)
+    p.resource_claims = (t.ResourceClaimRef(device_class="gpu", count=2),)
+    store.add_pod(p)
+    ctrl = ResourceClaimController(store)
+    ctrl.tick()
+    claim = store.get_object("ResourceClaim", "default/dra-claim-0")
+    assert claim is not None and claim.device_class == "gpu" and claim.count == 2
+    assert not claim.allocated and claim.reserved_for == ()
+    # pod binds -> reserved + allocated
+    store.bind(p.uid, "n0")
+    ctrl.tick()
+    claim = store.get_object("ResourceClaim", "default/dra-claim-0")
+    assert claim.allocated and claim.reserved_for == (p.uid,)
+    # pod finishes -> generated claim GCed, standalone claim stays
+    import copy
+    q = copy.copy(store.pods[p.uid])
+    q.phase = t.PHASE_SUCCEEDED
+    store.update_pod_status(q)
+    ctrl.tick()
+    assert store.get_object("ResourceClaim", "default/dra-claim-0") is None
+    assert store.get_object("ResourceClaim", "default/standalone") is not None
+
+
+def test_certificates_controller_approves_signs_and_cleans():
+    """Certificates: kubelet-serving CSRs from system:nodes auto-approve
+    and get a certificate; foreign signers are denied; both age out after
+    the cleaner TTL."""
+    from kubernetes_tpu.api import cluster as c
+    from kubernetes_tpu.scheduler.controllers import CertificatesController
+    from kubernetes_tpu.scheduler.queue import FakeClock
+
+    store = ClusterStore()
+    clock = FakeClock()
+    ctrl = CertificatesController(store, clock=clock)
+    store.add_object("CertificateSigningRequest", c.CertificateSigningRequest(
+        name="node-n0-serving", username="system:node:n0",
+        groups=("system:nodes",)))
+    store.add_object("CertificateSigningRequest", c.CertificateSigningRequest(
+        name="rogue", username="mallory",
+        signer_name="example.com/custom"))
+    ctrl.tick()
+    good = store.get_object("CertificateSigningRequest", "node-n0-serving")
+    bad = store.get_object("CertificateSigningRequest", "rogue")
+    assert good.status == "Approved" and "BEGIN CERTIFICATE" in good.certificate
+    assert bad.status == "Denied" and not bad.certificate
+    clock.step(CertificatesController.TTL_S + 1)
+    ctrl.tick()
+    assert store.get_object("CertificateSigningRequest", "node-n0-serving") is None
+    assert store.get_object("CertificateSigningRequest", "rogue") is None
