@@ -10,18 +10,27 @@ control structure (SURVEY.md §2.3 kubelet row; §3.4 call stack):
     channel; here a per-UID worker object whose update() entries apply in
     arrival order).  Workers own admission (device allocation), start,
     completion, crash/restart, and teardown.
-  - PLEG: the Pod Lifecycle Event Generator relists the (hollow) runtime's
-    container states and emits ContainerStarted/ContainerDied events that
-    drive workers, exactly the reference's generic PLEG relist
-    (pkg/kubelet/pleg/generic.go — func (g *GenericPLEG) Relist).  The hollow
-    "runtime" is clock-driven: containers run for run_seconds then exit 0, or
-    crash_after_seconds then exit non-zero (the kubemark trade: real kubelet
-    shape, fake CRI — pkg/kubemark/hollow_kubelet.go).
-  - restartPolicy: a died container restarts (restartCount++) under Always /
-    OnFailure-with-nonzero-exit, else the pod goes Succeeded/Failed
-    (kuberuntime_manager.go — computePodActions' ShouldContainerBeRestarted).
+  - CRI BOUNDARY: all container work goes through the RuntimeService/
+    ImageService protocols (scheduler/cri.py — the cri-api analog): pull
+    images, RunPodSandbox (the sandbox owns the pod IP, as the CNI result
+    the runtime reports), CreateContainer/StartContainer, and the
+    stop-container -> stop-sandbox -> remove teardown ordering
+    (kuberuntime_manager.go — SyncPod/killPodWithSyncResult).  The wired
+    implementation is FakeCRI — clock-driven containers, the kubemark
+    trade (pkg/kubemark/hollow_kubelet.go) — but the kubelet would run
+    unchanged against a remote runtime speaking the same protocols.
+  - PLEG: the Pod Lifecycle Event Generator relists CRI container states
+    and emits ContainerStarted/ContainerDied events that drive workers,
+    exactly the reference's generic PLEG relist (pkg/kubelet/pleg/
+    generic.go — func (g *GenericPLEG) Relist), keyed on (container id,
+    state) so a restarted container's crash is a fresh event.
+  - restartPolicy: a died container restarts (restartCount++, a NEW
+    container at attempt+1) under Always / OnFailure-with-nonzero-exit,
+    else the pod goes Succeeded/Failed (kuberuntime_manager.go —
+    computePodActions' ShouldContainerBeRestarted).
   - node Lease heartbeat per tick (pkg/kubelet/nodelease), consumed by the
-    NodeLifecycleController for failure detection.
+    NodeLifecycleController for failure detection; pulled images publish
+    to NodeStatus.Images (what ImageLocality scores against).
 
 Phase transitions publish through the pods/status subresource so the
 scheduler's queue never mistakes them for spec changes.
@@ -34,6 +43,14 @@ from typing import Dict, List, Optional, Tuple
 from weakref import WeakKeyDictionary
 
 from ..api import types as t
+from . import cri as cri_mod
+from .cri import (
+    CONTAINER_EXITED,
+    CONTAINER_RUNNING,
+    ContainerConfig,
+    FakeCRI,
+    PodSandboxConfig,
+)
 from .leases import LeaseStore
 from .queue import Clock
 from .store import ClusterStore, Event
@@ -52,22 +69,6 @@ def _cidr_index_for(store: ClusterStore, node_name: str) -> int:
     return table[node_name]
 
 
-# hollow container states (cri-api runtime states reduced)
-_WAITING, _RUNNING, _EXITED_OK, _EXITED_ERR = range(4)
-
-
-@dataclass
-class _Container:
-    """The hollow runtime's view of one pod's (single) container."""
-
-    state: int = _WAITING
-    started_at: float = 0.0
-    # restart increments this — the container-ID analog; PLEG keys its relist
-    # on (incarnation, state) so a crash of the RESTARTED container is a new
-    # event even when the previous relist also saw an exited state
-    incarnation: int = 0
-
-
 @dataclass
 class _PodWorker:
     """pod_workers.go — one serialized lifecycle machine per pod UID.  The
@@ -79,59 +80,33 @@ class _PodWorker:
     admitted: bool = False
     terminated: bool = False  # reached Succeeded/Failed
     restarts: int = 0
-
-
-class HollowRuntime:
-    """The fake CRI: containers 'run' by clock alone.  PLEG relists this."""
-
-    def __init__(self, clock: Clock):
-        self.clock = clock
-        self.containers: Dict[str, _Container] = {}
-
-    def start(self, uid: str) -> None:
-        prev = self.containers.get(uid)
-        inc = prev.incarnation + 1 if prev is not None else 0
-        self.containers[uid] = _Container(_RUNNING, self.clock.now(), inc)
-
-    def remove(self, uid: str) -> None:
-        self.containers.pop(uid, None)
-
-    def tick(self, pods: Dict[str, t.Pod]) -> None:
-        """Advance container states (what a real runtime does on its own)."""
-        now = self.clock.now()
-        for uid, c in self.containers.items():
-            if c.state != _RUNNING:
-                continue
-            pod = pods.get(uid)
-            if pod is None:
-                continue
-            crash = pod.crash_after_seconds
-            if crash > 0 and now - c.started_at >= crash:
-                c.state = _EXITED_ERR
-            elif pod.run_seconds > 0 and now - c.started_at >= pod.run_seconds:
-                c.state = _EXITED_OK
+    sandbox_id: str = ""  # CRI objects this worker owns
+    container_id: str = ""
 
 
 class PLEG:
-    """pleg/generic.go — Relist: diff the runtime's container states against
-    the previous relist and emit lifecycle events."""
+    """pleg/generic.go — Relist: diff CRI container states (through
+    RuntimeService.list_containers, nothing else) against the previous
+    relist and emit lifecycle events.  Keyed on (container id, state):
+    restarts create a NEW container, so a crash of the replacement is a
+    fresh event even when the previous relist also saw an exited state."""
 
-    def __init__(self, runtime: HollowRuntime):
+    def __init__(self, runtime: "cri_mod.RuntimeService"):
         self.runtime = runtime
-        self._last: Dict[str, Tuple[int, int]] = {}
+        self._last: Dict[str, Tuple[str, str]] = {}
 
     def relist(self) -> List[Tuple[str, str]]:
         events: List[Tuple[str, str]] = []
-        cur = {
-            uid: (c.incarnation, c.state)
-            for uid, c in self.runtime.containers.items()
-        }
-        for uid, (inc, state) in cur.items():
-            old = self._last.get(uid)
-            if old != (inc, state):
-                if state == _RUNNING:
+        cur: Dict[str, Tuple[str, str]] = {}
+        for cs in self.runtime.list_containers():
+            prev = cur.get(cs.pod_uid)
+            if prev is None or cs.id > prev[0]:
+                cur[cs.pod_uid] = (cs.id, cs.state)  # newest attempt wins
+        for uid, (cid, state) in cur.items():
+            if self._last.get(uid) != (cid, state):
+                if state == CONTAINER_RUNNING:
                     events.append((uid, "ContainerStarted"))
-                elif state in (_EXITED_OK, _EXITED_ERR):
+                elif state == CONTAINER_EXITED:
                     events.append((uid, "ContainerDied"))
         for uid in self._last:
             if uid not in cur:
@@ -158,7 +133,12 @@ class HollowKubelet:
         self.node_name = node_name
         self.clock = clock or leases.clock
         self.workers: Dict[str, _PodWorker] = {}  # pod_workers.go map
-        self.runtime = HollowRuntime(self.clock)
+        # the CRI boundary: everything container-shaped goes through these
+        # two protocol objects (FakeCRI implements both — the kubemark
+        # runtime; the sandbox IP callback is the CNI-result analog)
+        self.cri = FakeCRI(self.clock, ip_alloc=lambda uid: self._alloc_ip())
+        self.runtime: "cri_mod.RuntimeService" = self.cri
+        self.images: "cri_mod.ImageService" = self.cri
         self.pleg = PLEG(self.runtime)
         # cm/devicemanager analog: concrete device IDs per admitted pod,
         # checkpointed when a directory is given (restart-safe allocations)
@@ -190,13 +170,29 @@ class HollowKubelet:
         elif getattr(pod, "node_name", "") == self.node_name:
             self._dispatch(pod, removed=False)
 
+    def _teardown(self, w: _PodWorker) -> None:
+        """killPodWithSyncResult's ordering: stop container -> remove
+        container -> stop sandbox -> remove sandbox, then release devices."""
+        from .cri import CRIError
+
+        try:
+            if w.container_id:
+                self.runtime.stop_container(w.container_id)
+                self.runtime.remove_container(w.container_id)
+            if w.sandbox_id:
+                self.runtime.stop_pod_sandbox(w.sandbox_id)
+                self.runtime.remove_pod_sandbox(w.sandbox_id)
+        except CRIError:
+            pass  # already gone (crash-only: teardown is idempotent)
+        w.container_id = w.sandbox_id = ""
+        self.devices.free(w.pod.uid)
+
     def _dispatch(self, pod: t.Pod, removed: bool) -> None:
         """UpdatePod (pod_workers.go): create/feed the pod's worker."""
         if removed:
             w = self.workers.pop(pod.uid, None)
             if w is not None:
-                self.runtime.remove(pod.uid)
-                self.devices.free(pod.uid)
+                self._teardown(w)
             return
         w = self.workers.get(pod.uid)
         if w is None:
@@ -205,8 +201,7 @@ class HollowKubelet:
             w.pod = pod
         if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
             w.terminated = True
-            self.runtime.remove(pod.uid)
-            self.devices.free(pod.uid)
+            self._teardown(w)
 
     # --- the sync loop ---
     def tick(self) -> None:
@@ -214,8 +209,7 @@ class HollowKubelet:
         sequenced): heartbeat, runtime advance, PLEG relist -> worker syncs,
         then housekeeping."""
         self.leases.renew_node_heartbeat(self.node_name)
-        pods = {uid: w.pod for uid, w in self.workers.items()}
-        self.runtime.tick(pods)
+        self.cri.tick()  # the fake runtime's own event loop
         # PLEG events drive workers (syncLoopIteration's plegCh case)
         for uid, what in self.pleg.relist():
             w = self.workers.get(uid)
@@ -245,7 +239,21 @@ class HollowKubelet:
         node must stop consuming events — and being retained — forever)."""
         self.store.unwatch(self._on_event)
 
-    # --- worker syncs (kubelet.go — SyncPod reduced to the hollow trade) ---
+    # --- worker syncs (kuberuntime_manager.go — SyncPod over the CRI) ---
+    def _start_container(self, w: _PodWorker) -> None:
+        """CreateContainer + StartContainer inside the worker's sandbox."""
+        pod = w.pod
+        w.container_id = self.runtime.create_container(
+            w.sandbox_id,
+            ContainerConfig(
+                name="main",
+                image=pod.images[0] if pod.images else "",
+                run_seconds=pod.run_seconds,
+                crash_after_seconds=pod.crash_after_seconds,
+            ),
+        )
+        self.runtime.start_container(w.container_id)
+
     def _sync_start(self, w: _PodWorker) -> None:
         pod = w.pod
         if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
@@ -264,28 +272,74 @@ class HollowKubelet:
                 self._set_phase(pod, t.PHASE_FAILED)
                 return
         w.admitted = True
-        self.runtime.start(pod.uid)  # CreateSandbox + StartContainer
-        self._set_phase(pod, t.PHASE_RUNNING)
+        # SyncPod: EnsureImagesExist -> RunPodSandbox -> containers
+        for img in pod.images:
+            self.images.pull_image(img)
+        if pod.images:
+            self._publish_images()
+        w.sandbox_id = self.runtime.run_pod_sandbox(
+            PodSandboxConfig(
+                pod_uid=pod.uid, pod_name=pod.name, namespace=pod.namespace
+            )
+        )
+        self._start_container(w)
+        # the sandbox owns the pod IP (the CNI result the runtime reports)
+        ip = next(
+            (
+                s.ip
+                for s in self.runtime.list_pod_sandboxes()
+                if s.id == w.sandbox_id
+            ),
+            "",
+        )
+        self._set_phase(pod, t.PHASE_RUNNING, pod_ip=ip)
 
     def _sync_died(self, w: _PodWorker) -> None:
         """computePodActions — ShouldContainerBeRestarted: a CRASHED container
-        restarts under Always/OnFailure (restartCount++), else the pod goes
-        Failed; a clean exit is the hollow Job contract (run_seconds elapsed:
-        the workload is DONE) and terminates Succeeded."""
-        c = self.runtime.containers.get(w.pod.uid)
-        failed = c is not None and c.state == _EXITED_ERR
+        restarts under Always/OnFailure (restartCount++, a NEW container at
+        the next attempt), else the pod goes Failed; a clean exit is the
+        hollow Job contract (run_seconds elapsed: the workload is DONE) and
+        terminates Succeeded."""
+        status = next(
+            (
+                cs
+                for cs in self.runtime.list_containers()
+                if cs.id == w.container_id
+            ),
+            None,
+        )
+        failed = status is not None and status.exit_code != 0
         policy = w.pod.restart_policy or "Always"
         if failed and policy in ("Always", "OnFailure"):
             w.restarts += 1
-            self.runtime.start(w.pod.uid)
+            # remove the dead container, then create+start the replacement
+            # (kuberuntime prunes dead attempts as it restarts)
+            self.runtime.remove_container(w.container_id)
+            self._start_container(w)
             q = self._status_copy(w.pod)
             q.restart_count = w.restarts
             self.store.update_pod_status(q)
             return
         w.terminated = True
-        self.runtime.remove(w.pod.uid)
-        self.devices.free(w.pod.uid)
+        self._teardown(w)
         self._set_phase(w.pod, t.PHASE_FAILED if failed else t.PHASE_SUCCEEDED)
+
+    def _publish_images(self) -> None:
+        """NodeStatus.Images from the runtime's image list (what
+        ImageLocality scores against) — only when something new landed, so
+        steady state never rewrites the Node object (identity fingerprints
+        in the delta encoder stay warm)."""
+        import copy
+
+        node = self.store.nodes.get(self.node_name)
+        if node is None:
+            return
+        have = self.images.list_images()
+        merged = {**node.images, **have}
+        if merged != node.images:
+            q = copy.copy(node)
+            q.images = merged
+            self.store.update_node(q)
 
     # --- status publication ---
     def _status_copy(self, pod: t.Pod) -> t.Pod:
@@ -294,15 +348,16 @@ class HollowKubelet:
         cur = self.store.pods.get(pod.uid, pod)
         return copy.copy(cur)
 
-    def _set_phase(self, pod: t.Pod, phase: str) -> None:
+    def _set_phase(self, pod: t.Pod, phase: str, pod_ip: str = "") -> None:
         q = self._status_copy(pod)
         q.phase = phase
         if phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
             q.finished_at = self.clock.now()
         if phase == t.PHASE_RUNNING and not q.pod_ip:
-            # status.podIP from the node's pod CIDR (nodeipam's per-node
-            # 10.244.x.0/24 shape; the sandbox IP the CRI would report)
-            q.pod_ip = self._alloc_ip()
+            # status.podIP = the sandbox IP the runtime reported (CNI
+            # result through RunPodSandbox); allocator fallback for direct
+            # callers outside a sandbox
+            q.pod_ip = pod_ip or self._alloc_ip()
         self.store.update_pod_status(q)
 
     def _alloc_ip(self) -> str:

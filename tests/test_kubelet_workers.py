@@ -43,7 +43,15 @@ def test_pleg_emits_started_and_died():
     clock.step(6.0)
     kubelet.tick()  # runtime exits 0 -> PLEG ContainerDied -> Succeeded
     assert store.pods["default/job"].phase == t.PHASE_SUCCEEDED
-    assert "default/job" not in kubelet.runtime.containers
+    # teardown removed the container AND its sandbox through the CRI
+    assert not [
+        c for c in kubelet.runtime.list_containers()
+        if c.pod_uid == "default/job"
+    ]
+    assert not [
+        s for s in kubelet.runtime.list_pod_sandboxes()
+        if s.pod_uid == "default/job"
+    ]
 
 
 def test_crash_restart_policy_always_bumps_restart_count():
@@ -85,3 +93,52 @@ def test_on_failure_restarts_crashes_but_not_completions():
     clock.step(4.0)
     kubelet.tick()
     assert store.pods["default/flaky-job"].restart_count == 2
+
+
+def test_cri_boundary_sandbox_container_lifecycle():
+    """The kubelet speaks only the CRI: a running pod owns one READY
+    sandbox (which carries the pod IP — the CNI result) and one RUNNING
+    container; restarts create a NEW container id at the next attempt in
+    the SAME sandbox; teardown is ordered and leaves nothing behind."""
+    from kubernetes_tpu.scheduler import cri
+
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod("svc", node_name="n0", crash_after_seconds=2.0))
+    kubelet.tick()
+    sbs = kubelet.runtime.list_pod_sandboxes()
+    ctrs = kubelet.runtime.list_containers()
+    assert len(sbs) == 1 and sbs[0].state == cri.SANDBOX_READY
+    assert sbs[0].ip and store.pods["default/svc"].pod_ip == sbs[0].ip
+    assert len(ctrs) == 1 and ctrs[0].state == cri.CONTAINER_RUNNING
+    assert ctrs[0].attempt == 0 and ctrs[0].sandbox_id == sbs[0].id
+    first_id = ctrs[0].id
+    clock.step(3.0)
+    kubelet.tick()  # crash -> restart: NEW container, same sandbox
+    ctrs = kubelet.runtime.list_containers()
+    assert len(ctrs) == 1 and ctrs[0].id != first_id
+    assert ctrs[0].attempt == 1 and ctrs[0].sandbox_id == sbs[0].id
+    # delete the pod: full CRI teardown
+    store.delete_pod("default/svc")
+    assert kubelet.runtime.list_containers() == []
+    assert kubelet.runtime.list_pod_sandboxes() == []
+
+
+def test_cri_image_pulls_publish_to_node_status():
+    """EnsureImagesExist pulls through the ImageService and the kubelet
+    publishes NodeStatus.Images — the matrix ImageLocality scores against
+    — without rewriting the Node when nothing new landed."""
+    clock, store, kubelet = _rig()
+    p = mk_pod("imgpod", node_name="n0")
+    p.images = ("registry/app:v2",)
+    store.add_pod(p)
+    kubelet.tick()
+    node = store.nodes["n0"]
+    assert "registry/app:v2" in node.images
+    assert kubelet.images.list_images()["registry/app:v2"] == node.images["registry/app:v2"]
+    # steady state: same images -> node object untouched
+    q = mk_pod("imgpod2", node_name="n0")
+    q.images = ("registry/app:v2",)
+    store.add_pod(q)
+    node_obj = store.nodes["n0"]
+    kubelet.tick()
+    assert store.nodes["n0"] is node_obj
