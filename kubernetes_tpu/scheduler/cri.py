@@ -24,6 +24,7 @@ runtime); images are pulled by name and listed with sizes.
 from __future__ import annotations
 
 import itertools
+import zlib
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Protocol
 
@@ -92,6 +93,8 @@ class RuntimeService(Protocol):
     def remove_container(self, container_id: str) -> None: ...
     def list_pod_sandboxes(self) -> List[SandboxStatus]: ...
     def list_containers(self) -> List[ContainerStatus]: ...
+    def pod_sandbox_status(self, sandbox_id: str) -> SandboxStatus: ...
+    def container_status(self, container_id: str) -> ContainerStatus: ...
 
 
 class ImageService(Protocol):
@@ -202,11 +205,27 @@ class FakeCRI:
     def list_containers(self) -> List[ContainerStatus]:
         return [c.status for c in self.containers.values()]
 
+    def pod_sandbox_status(self, sandbox_id: str) -> SandboxStatus:
+        sb = self.sandboxes.get(sandbox_id)
+        if sb is None:
+            raise CRIError(f"sandbox {sandbox_id} not found")
+        return sb.status
+
+    def container_status(self, container_id: str) -> ContainerStatus:
+        c = self.containers.get(container_id)
+        if c is None:
+            raise CRIError(f"container {container_id} not found")
+        return c.status
+
     # --- ImageService ---
     def pull_image(self, name: str) -> str:
         if name not in self.images:
-            # deterministic nominal size (the hollow registry)
-            self.images[name] = self.DEFAULT_IMAGE_BYTES + (hash(name) & 0xFFFF)
+            # deterministic nominal size (the hollow registry) — crc32, not
+            # hash(): Python string hashing is randomized per process and
+            # would make NodeStatus.Images non-reproducible across runs
+            self.images[name] = self.DEFAULT_IMAGE_BYTES + (
+                zlib.crc32(name.encode()) & 0xFFFF
+            )
         return name
 
     def list_images(self) -> Dict[str, int]:

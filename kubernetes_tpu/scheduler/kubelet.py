@@ -48,6 +48,7 @@ from .cri import (
     CONTAINER_EXITED,
     CONTAINER_RUNNING,
     ContainerConfig,
+    CRIError,
     FakeCRI,
     PodSandboxConfig,
 )
@@ -98,10 +99,13 @@ class PLEG:
     def relist(self) -> List[Tuple[str, str]]:
         events: List[Tuple[str, str]] = []
         cur: Dict[str, Tuple[str, str]] = {}
+        attempts: Dict[str, int] = {}
         for cs in self.runtime.list_containers():
-            prev = cur.get(cs.pod_uid)
-            if prev is None or cs.id > prev[0]:
-                cur[cs.pod_uid] = (cs.id, cs.state)  # newest attempt wins
+            # newest ATTEMPT wins (ids are runtime-assigned and carry no
+            # ordering contract — a remote runtime's are hashes)
+            if cs.pod_uid not in cur or cs.attempt > attempts[cs.pod_uid]:
+                cur[cs.pod_uid] = (cs.id, cs.state)
+                attempts[cs.pod_uid] = cs.attempt
         for uid, (cid, state) in cur.items():
             if self._last.get(uid) != (cid, state):
                 if state == CONTAINER_RUNNING:
@@ -173,8 +177,6 @@ class HollowKubelet:
     def _teardown(self, w: _PodWorker) -> None:
         """killPodWithSyncResult's ordering: stop container -> remove
         container -> stop sandbox -> remove sandbox, then release devices."""
-        from .cri import CRIError
-
         try:
             if w.container_id:
                 self.runtime.stop_container(w.container_id)
@@ -284,14 +286,7 @@ class HollowKubelet:
         )
         self._start_container(w)
         # the sandbox owns the pod IP (the CNI result the runtime reports)
-        ip = next(
-            (
-                s.ip
-                for s in self.runtime.list_pod_sandboxes()
-                if s.id == w.sandbox_id
-            ),
-            "",
-        )
+        ip = self.runtime.pod_sandbox_status(w.sandbox_id).ip
         self._set_phase(pod, t.PHASE_RUNNING, pod_ip=ip)
 
     def _sync_died(self, w: _PodWorker) -> None:
@@ -300,14 +295,10 @@ class HollowKubelet:
         the next attempt), else the pod goes Failed; a clean exit is the
         hollow Job contract (run_seconds elapsed: the workload is DONE) and
         terminates Succeeded."""
-        status = next(
-            (
-                cs
-                for cs in self.runtime.list_containers()
-                if cs.id == w.container_id
-            ),
-            None,
-        )
+        try:
+            status = self.runtime.container_status(w.container_id)
+        except CRIError:
+            status = None
         failed = status is not None and status.exit_code != 0
         policy = w.pod.restart_policy or "Always"
         if failed and policy in ("Always", "OnFailure"):
