@@ -651,9 +651,28 @@ def schedule_scan_rounds(
     hoist is amortized: computed once per chunk and patched only at
     columns whose usage changed (committed nodes).
 
-    A round then commits the longest prefix of pods provably unaffected by
-    the round's earlier commits.  Pod j < i (committed this round, active)
-    INTERFERES with pod i iff any of:
+    A round then commits pods in three moves:
+
+    1. DISPERSAL SPECULATION: pod i's tentative pick c_i is its rank-th
+       best feasible node, rank = earlier uncommitted pods sharing its
+       argmax.  Same-spec pods share whole rows and top-k's
+       lowest-index-tie order matches the sequential tie-break, so ranks
+       walk a tied plateau exactly like the sequential scan does (without
+       this, every duplicate argmax truncated the prefix — measured 1.9
+       pods/round on BASELINE config 3; 7.5 with it).
+    2. EXACT REPAIR: t_i = pod i's TRUE sequential argmax given that
+       pods j < i commit c_j — max of (a) the best round-start score over
+       nodes NOT picked by the prefix (valid: nothing else changed) and
+       (b) the picked nodes rescored under the EXACT prefix usage (an
+       int32 associative prefix sum — the same adds in the same order as
+       the sequential scan) with round-start raws and scalars; ties break
+       to the lowest node index across both sides.
+    3. COMMIT: the longest prefix with t == c (speculation confirmed),
+       plus the FIRST divergence-only pod committing its exact t.
+
+    The repair itself is valid only while pod i's unpicked scores and
+    normalization scalars are round-start-stable, which two HARD
+    interference conditions guard (they truncate the prefix instead):
 
       - share(i, j): j's state writes touch terms i reads.  Writes:
         cnt/total at j's matched terms, anti at j's own anti terms,
@@ -663,34 +682,26 @@ def schedule_scan_rounds(
         score half), i's preferred-affinity terms (cnt).  Precomputed per
         chunk as [C, T] incidence matmuls.  Any shared term can move i's
         raws or masks ANYWHERE (domain columns, min_match, the waiver), so
-        this is the coarse gate.
-      - share_ports(i, j): overlapping host ports (j's commit flips i's
-        port mask at c_j, which also perturbs i's normalization sets).
-      - c_j == c_i: i's chosen node absorbed j's request.
+        this is the coarse gate.  Overlapping host ports gate the same
+        way (j's commit flips i's port mask at c_j).
       - a normalization-scalar hazard: c_j was feasible for i, j's commit
         makes it fit-infeasible, AND c_j attains one of i's normalization
         extremes (spread/taint max with max > 0, node-affinity max > 0,
         inter-pod max/min with max > min) — dropping a non-extreme node
-        cannot move any scalar, and scalars are the only cross-node
-        coupling.
-      - beats: i's score at c_j under the EXACT prefix usage (round-start
-        usage + an int32 associative prefix sum of earlier picks' requests
-        — the same adds in the same order as the sequential scan) exceeds
-        i's round-start best, or ties it with c_j < c_i.  Scores at c_j
-        reuse i's round-start raws and scalars, valid because the
-        share/ports/extreme conditions above did not fire.  Conversely a
-        score DROP at a picked node only matters if that node was i's
-        choice (covered by c_j == c_i).
+        cannot move any scalar, and scalars are the only remaining
+        cross-node coupling (same-node picks and score-beats, the old
+        truncation conditions, are now handled EXACTLY by the repair).
 
-    Interference only ever SHORTENS the committed prefix (decisions are
-    re-derived next round from freshly committed state), so conservatism
-    costs rounds, never correctness; the first uncommitted pod has no
-    active predecessor and always commits, bounding the loop at C rounds.
+    A wrong speculation or hard interference only SHORTENS the committed
+    prefix (decisions re-derive next round from freshly committed state),
+    so conservatism costs rounds, never correctness; the first uncommitted
+    pod has no active predecessor — its repair is trivially its argmax —
+    so every round commits >= 1 pod, bounding the loop at C rounds.
     Worst case (every pod sharing one term) degrades toward per-pod
     stepping; the expected prefix on mixed workloads is set by the
-    birthday structure of term collisions within a chunk (theory ~25 at
-    200 apps over C=128; see tests/test_assign_parity.py — rounds
-    diagnostic for the measured distribution).
+    birthday structure of term collisions within a chunk (measured on
+    BASELINE config 3 at 10k pods x 5k nodes: 17.2 rounds/chunk mean, 32
+    max; see tests/test_assign_parity.py — rounds diagnostic).
 
     State layout: the outer chunk scan carries the live cluster state
     (used[N,R], cnt/anti/pref_node[T,N], total_t[T], ports[N,PT]); the
@@ -884,11 +895,37 @@ def schedule_scan_rounds(
             cand = jnp.where(
                 (total == best[:, None]) & feasible, my_nodes[None, :], _INT_MAX
             ).min(axis=1)
-            c = jnp.where(
+            c0 = jnp.where(
                 (best > neg_inf) & cvalid, cand.astype(jnp.int32), -1
             )
+            # ---- dispersal speculation: same-choice pods would otherwise
+            # truncate the prefix at every duplicate (measured 1.9 pods/
+            # round on BASELINE config 3 without it).  Pod i speculates its
+            # rank-th best feasible node, rank = earlier uncommitted pods
+            # sharing its argmax — same-spec pods share whole rows (and
+            # top-k's lowest-index-tie order matches the sequential
+            # tie-break), so ranks walk the plateau exactly like the
+            # sequential scan does.  A wrong guess is caught by the exact
+            # repair below and only shortens the prefix. ----
+            same0 = (
+                (c0[:, None] == c0[None, :])
+                & (c0[None, :] >= 0)
+                & unc[None, :]
+            )
+            rank = (same0 & jlt).sum(axis=1).astype(jnp.int32)
+            Zr = min(32, N)
+            topv, topi = lax.top_k(total, Zr)
+            sel = jnp.minimum(rank, Zr - 1)[:, None]
+            v_sel = jnp.take_along_axis(topv, sel, 1)[:, 0]
+            c_sp = jnp.take_along_axis(topi, sel, 1)[:, 0].astype(jnp.int32)
+            c = jnp.where(
+                unc & (c0 >= 0) & (rank > 0) & (rank < Zr)
+                & (v_sel > neg_inf),
+                c_sp,
+                c0,
+            )
 
-            # ---- interference against the intra-round prefix ----
+            # ---- exact repair under the intra-round prefix ----
             act = unc & (c >= 0)
             cn = jnp.maximum(c, 0)
             E = (c[:, None] == c[None, :]) & act[:, None]  # [k, j] same node
@@ -946,38 +983,65 @@ def schedule_scan_rounds(
                     cx["img"], cn[None, :], axis=1
                 )
             newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
-            beats = (newtot > best[:, None]) | (
-                (newtot == best[:, None]) & (cn[None, :] < c[:, None])
-            )
             dropped = feas0_at & ~fitij
-            unsafe_pair = (
-                share
-                | ((c[:, None] >= 0) & (c[:, None] == cn[None, :]))
-                | (dropped & extreme_at)
-                | beats
+            # HARD interference — conditions that invalidate the repair
+            # itself: term-sharing moves raws/masks anywhere; an extreme-
+            # attaining feasibility drop moves a normalization scalar
+            hard = (
+                (share | (dropped & extreme_at)) & jlt & act[None, :]
+            ).any(axis=1)
+            # the exact sequential argmax t_i given the prefix's picks:
+            # unpicked nodes keep their round-start scores (no share, no
+            # scalar change), picked nodes take the rescored newtot
+            O = ((c[:, None] == my_nodes[None, :]) & act[:, None]).astype(
+                jnp.float32
+            )  # [C(j), N] pick indicator
+            picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, N]
+            av = jnp.max(jnp.where(picked_before, neg_inf, total), axis=1)
+            a_n = jnp.where(
+                (total == av[:, None]) & ~picked_before, my_nodes[None, :],
+                _INT_MAX,
+            ).min(axis=1)
+            Mj = jnp.where(act[None, :] & jlt, newtot, neg_inf)
+            vb = jnp.max(Mj, axis=1)
+            b_n = jnp.where(Mj == vb[:, None], cn[None, :], _INT_MAX).min(
+                axis=1
             )
-            unsafe = (unsafe_pair & jlt & act[None, :]).any(axis=1)
+            t_val = jnp.maximum(av, vb)
+            t_n = jnp.where(
+                vb > av, b_n, jnp.where(av > vb, a_n, jnp.minimum(a_n, b_n))
+            )
+            t = jnp.where(
+                (t_val > neg_inf) & cvalid, t_n.astype(jnp.int32), -1
+            )
 
-            # ---- commit the longest safe prefix ----
-            bad = unc & unsafe
+            # ---- commit: the longest prefix whose speculation matched the
+            # exact repair, plus the FIRST divergence-only pod committing
+            # its exact t (hard interference voids t, so not that one) ----
+            div = t != c
+            bad = unc & (hard | div)
             firstbad = jnp.where(bad.any(), jnp.argmax(bad), C).astype(
                 jnp.int32
             )
+            fb_commit = (idxC == firstbad) & unc & ~hard
+            c_final = jnp.where(fb_commit, t, c)
             prefix = unc & (idxC < firstbad)
-            pact = prefix & (c >= 0)
-            out = jnp.where(prefix, c, out)
-            ord_ = jnp.where(prefix, nrounds, ord_)  # commit-round ordinal
-            committed = committed | prefix
+            commit_set = prefix | fb_commit
+            pact = commit_set & (c_final >= 0)
+            cn_final = jnp.maximum(c_final, 0)
+            out = jnp.where(commit_set, c_final, out)
+            ord_ = jnp.where(commit_set, nrounds, ord_)  # commit ordinal
+            committed = committed | commit_set
 
-            # ---- absorb the prefix into the live state ----
-            ucols = jnp.where(pact, c, N)  # N = drop sentinel
+            # ---- absorb the committed picks into the live state ----
+            ucols = jnp.where(pact, c_final, N)  # N = drop sentinel
             adds = jnp.zeros((N, R), dtype=used.dtype).at[ucols].add(
                 jnp.where(pact[:, None], creq, 0), mode="drop"
             )
             used = used + adds
             # patch base/fit at the dirtied columns against the NEW usage
-            col_used = used[cn]  # [C, R] (committed cols; others dropped)
-            col_alloc = n_alloc[cn]
+            col_used = used[cn_final]  # [C, R] (committed cols; others dropped)
+            col_alloc = n_alloc[cn_final]
             col_req = col_used[None, :, :] + creq[:, None, :]  # [C, C, R]
             col_fit = jax.vmap(
                 lambda rq: filters.fit_ok(rq, col_used, col_alloc)
@@ -1000,7 +1064,7 @@ def schedule_scan_rounds(
                     domain), rows = the (pod, slot) flattening."""
                     tids = jnp.maximum(ids, 0).reshape(-1)  # [C*S]
                     nodes = jnp.broadcast_to(
-                        cn[:, None], ids.shape
+                        cn_final[:, None], ids.shape
                     ).reshape(-1)
                     wf = w.reshape(-1)
                     dcol = dom_by_term[tids, nodes]  # [C*S]
