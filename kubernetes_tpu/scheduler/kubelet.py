@@ -155,6 +155,27 @@ class HollowKubelet:
             if pod_cidr_index is not None
             else _cidr_index_for(store, node_name)
         )
+        # TLS bootstrap analog (pkg/kubelet/certificate — the serving-cert
+        # manager): file a CertificateSigningRequest on startup; the
+        # Certificates controller approves+signs it and serving_certificate
+        # returns the issued cert once available
+        self._csr_name = f"{node_name}-serving"
+        try:
+            from ..api import cluster as c
+
+            if store.get_object(
+                "CertificateSigningRequest", self._csr_name
+            ) is None:
+                store.add_object(
+                    "CertificateSigningRequest",
+                    c.CertificateSigningRequest(
+                        name=self._csr_name,
+                        username=f"system:node:{node_name}",
+                        groups=("system:nodes",),
+                    ),
+                )
+        except KeyError:
+            pass  # stores without the kind registered (reduced harnesses)
         # config source: route my pods' watch events to workers — the
         # kubelet's syncLoop 'config updates' channel.  Seed from a LIST
         # (informer semantics), then stay event-driven.
@@ -211,6 +232,27 @@ class HollowKubelet:
         sequenced): heartbeat, runtime advance, PLEG relist -> worker syncs,
         then housekeeping."""
         self.leases.renew_node_heartbeat(self.node_name)
+        if not getattr(self, "_serving_cert", ""):
+            # cache the issued serving cert EAGERLY (the CSR cleaner GCs
+            # the request after its TTL); if the CSR vanished unissued,
+            # re-file it (certificate manager rotation loop)
+            if not self.serving_certificate():
+                try:
+                    from ..api import cluster as c
+
+                    if self.store.get_object(
+                        "CertificateSigningRequest", self._csr_name
+                    ) is None:
+                        self.store.add_object(
+                            "CertificateSigningRequest",
+                            c.CertificateSigningRequest(
+                                name=self._csr_name,
+                                username=f"system:node:{self.node_name}",
+                                groups=("system:nodes",),
+                            ),
+                        )
+                except KeyError:
+                    pass
         self.cri.tick()  # the fake runtime's own event loop
         # PLEG events drive workers (syncLoopIteration's plegCh case)
         for uid, what in self.pleg.relist():
@@ -235,6 +277,23 @@ class HollowKubelet:
             cur = self.store.pods.get(uid)
             if cur is None or cur.node_name != self.node_name:
                 self.devices.free(uid)
+
+    def serving_certificate(self) -> str:
+        """The issued serving certificate, "" until the Certificates
+        controller has approved and signed this kubelet's bootstrap CSR.
+        Cached once observed: the CSR cleaner GCs issued requests after its
+        TTL (certificate_controller's cleaner), but the cert itself lives
+        with the kubelet."""
+        if getattr(self, "_serving_cert", ""):
+            return self._serving_cert
+        try:
+            csr = self.store.get_object(
+                "CertificateSigningRequest", self._csr_name
+            )
+        except KeyError:
+            return ""
+        self._serving_cert = csr.certificate if csr is not None else ""
+        return self._serving_cert
 
     def close(self) -> None:
         """Detach from the store's watch fan-out (a removed/restarted hollow

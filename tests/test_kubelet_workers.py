@@ -142,3 +142,25 @@ def test_cri_image_pulls_publish_to_node_status():
     node_obj = store.nodes["n0"]
     kubelet.tick()
     assert store.nodes["n0"] is node_obj
+
+
+def test_kubelet_tls_bootstrap_csr_flow():
+    """The kubelet files a serving CSR on startup (pkg/kubelet/certificate
+    bootstrap analog); the Certificates controller approves and signs it;
+    serving_certificate() returns the issued cert and caches it across the
+    CSR cleaner's GC."""
+    from kubernetes_tpu.scheduler.controllers import CertificatesController
+
+    clock, store, kubelet = _rig()
+    csr = store.get_object("CertificateSigningRequest", "n0-serving")
+    assert csr is not None and csr.username == "system:node:n0"
+    assert kubelet.serving_certificate() == ""
+    ctrl = CertificatesController(store, clock=clock)
+    ctrl.tick()
+    cert = kubelet.serving_certificate()
+    assert "BEGIN CERTIFICATE" in cert
+    # the cleaner GCs the issued CSR; the kubelet keeps its cert
+    clock.step(CertificatesController.TTL_S + 1)
+    ctrl.tick()
+    assert store.get_object("CertificateSigningRequest", "n0-serving") is None
+    assert kubelet.serving_certificate() == cert
