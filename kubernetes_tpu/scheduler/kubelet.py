@@ -150,6 +150,15 @@ class HollowKubelet:
             node_name,
             CheckpointManager(checkpoint_dir) if checkpoint_dir else None,
         )
+        # cm/cpumanager static policy + the eviction manager (scheduler/cm.py)
+        from .cm import CPUManagerStatic, EvictionManager
+
+        node = store.nodes.get(node_name)
+        n_cpus = (
+            node.allocatable.get(t.CPU, 0) // 1000 if node is not None else 0
+        )
+        self.cpumanager = CPUManagerStatic(n_cpus)
+        self.eviction = EvictionManager(store, node_name)
         self._cidr_index = (
             pod_cidr_index
             if pod_cidr_index is not None
@@ -209,6 +218,7 @@ class HollowKubelet:
             pass  # already gone (crash-only: teardown is idempotent)
         w.container_id = w.sandbox_id = ""
         self.devices.free(w.pod.uid)
+        self.cpumanager.free(w.pod.uid)
 
     def _dispatch(self, pod: t.Pod, removed: bool) -> None:
         """UpdatePod (pod_workers.go): create/feed the pod's worker."""
@@ -254,6 +264,10 @@ class HollowKubelet:
                 except KeyError:
                     pass
         self.cri.tick()  # the fake runtime's own event loop
+        # node-pressure eviction BEFORE new syncs (the reference's eviction
+        # manager runs on its own loop; per-tick ordering here keeps an
+        # overcommitted node from starting even more work)
+        self.eviction.synchronize()
         # PLEG events drive workers (syncLoopIteration's plegCh case)
         for uid, what in self.pleg.relist():
             w = self.workers.get(uid)
@@ -332,6 +346,18 @@ class HollowKubelet:
                 w.terminated = True
                 self._set_phase(pod, t.PHASE_FAILED)
                 return
+        from .cm import CPUAllocationError
+
+        try:
+            # exclusive cores for integer-CPU pods (cpumanager static
+            # policy); fragmentation -> the same UnexpectedAdmissionError
+            # path as devices
+            self.cpumanager.allocate(pod)
+        except CPUAllocationError:
+            w.terminated = True
+            self.devices.free(pod.uid)
+            self._set_phase(pod, t.PHASE_FAILED)
+            return
         w.admitted = True
         # SyncPod: EnsureImagesExist -> RunPodSandbox -> containers
         for img in pod.images:

@@ -1,0 +1,101 @@
+"""cpumanager static policy + eviction manager (scheduler/cm.py) — the
+kubelet's cm/ subsystems: exclusive-core pinning with fragmentation-driven
+admission failure, and node-pressure eviction with the memory-pressure
+taint surfaced to the scheduler."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.scheduler import ClusterStore
+from kubernetes_tpu.scheduler.cm import (
+    MEMORY_PRESSURE_TAINT_KEY,
+    CPUManagerStatic,
+    EvictionManager,
+    pod_qos,
+)
+from kubernetes_tpu.scheduler.kubelet import HollowKubelet
+from kubernetes_tpu.scheduler.leases import LeaseStore
+from kubernetes_tpu.scheduler.queue import FakeClock
+from helpers import mk_node, mk_pod
+
+GI = 1024**3
+
+
+def _rig(cpu=4000, mem=8 * GI, pods=20):
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=cpu, mem=mem, pods=pods))
+    kubelet = HollowKubelet(store, LeaseStore(clock=clock), "n0", clock=clock)
+    return clock, store, kubelet
+
+
+def test_cpumanager_pins_integer_requests_exclusively():
+    clock, store, kubelet = _rig(cpu=4000)
+    store.add_pod(mk_pod("g1", cpu=2000, node_name="n0"))  # integer: pinned
+    store.add_pod(mk_pod("b1", cpu=500, node_name="n0"))  # fractional: shared
+    kubelet.tick()
+    assert kubelet.cpumanager.assignments["default/g1"] == (0, 1)
+    assert "default/b1" not in kubelet.cpumanager.assignments
+    # a second integer pod gets the NEXT free cores
+    store.add_pod(mk_pod("g2", cpu=1000, node_name="n0"))
+    kubelet.tick()
+    assert kubelet.cpumanager.assignments["default/g2"] == (2,)
+
+
+def test_cpumanager_fragmentation_fails_admission():
+    """4-core node with 3 cores pinned: a 2-core pod cannot be admitted
+    even though 1000m+shared capacity remains — UnexpectedAdmissionError,
+    pod Failed (the reference kubelet's admission contract)."""
+    clock, store, kubelet = _rig(cpu=4000)
+    store.add_pod(mk_pod("g1", cpu=3000, node_name="n0"))
+    kubelet.tick()
+    store.add_pod(mk_pod("g2", cpu=2000, node_name="n0"))
+    kubelet.tick()
+    assert store.pods["default/g2"].phase == t.PHASE_FAILED
+    # ...and its cores were never leaked
+    assert "default/g2" not in kubelet.cpumanager.assignments
+    # cores free once the pinned pod terminates
+    store.delete_pod("default/g1")
+    assert "default/g1" not in kubelet.cpumanager.assignments
+
+
+def test_eviction_reclaims_overcommit_and_taints_node():
+    """Direct binds bypassing the scheduler overcommit memory: the eviction
+    manager evicts BestEffort first, then lowest-priority largest-request,
+    until under the threshold, and the memory-pressure NoSchedule taint
+    tracks the pressure state."""
+    clock, store, kubelet = _rig(mem=8 * GI, pods=20)
+    store.add_pod(mk_pod("be", cpu=0, mem=0, node_name="n0"))  # BestEffort
+    assert pod_qos(store.pods["default/be"]) == "BestEffort"
+    store.add_pod(mk_pod("low", cpu=100, mem=4 * GI, node_name="n0", priority=0))
+    store.add_pod(mk_pod("hi", cpu=100, mem=3 * GI, node_name="n0", priority=100))
+    kubelet.tick()
+    assert not any(
+        tn.key == MEMORY_PRESSURE_TAINT_KEY
+        for tn in store.nodes["n0"].taints
+    )
+    # overcommit: another 4Gi lands directly (7+4 > 0.95 * 8)
+    store.add_pod(mk_pod("ext", cpu=100, mem=4 * GI, node_name="n0", priority=50))
+    evicted = kubelet.eviction.synchronize()
+    # BestEffort evicts first but frees 0 bytes; then priority-0 "low"
+    # (4Gi) brings usage to 7Gi <= 7.6Gi
+    assert "default/be" in evicted and "default/low" in evicted
+    assert store.pods["default/low"].phase == t.PHASE_FAILED
+    assert store.pods["default/hi"].phase != t.PHASE_FAILED
+    assert not any(
+        tn.key == MEMORY_PRESSURE_TAINT_KEY
+        for tn in store.nodes["n0"].taints
+    )
+
+
+def test_eviction_taints_while_pressure_persists():
+    """A single unevictable-helpful... rather: when eviction cannot bring
+    the node under threshold (one giant pod), the taint stays until it
+    can."""
+    clock, store, kubelet = _rig(mem=8 * GI)
+    store.add_pod(mk_pod("giant", cpu=100, mem=9 * GI, node_name="n0"))
+    evicted = kubelet.eviction.synchronize()
+    # the giant itself is evicted (only candidate)
+    assert evicted == ["default/giant"]
+    assert not any(
+        tn.key == MEMORY_PRESSURE_TAINT_KEY
+        for tn in store.nodes["n0"].taints
+    )
