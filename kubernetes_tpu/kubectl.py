@@ -72,6 +72,9 @@ _register_words("PVC", "persistentvolumeclaim", "persistentvolumeclaims", "pvc")
 _register_words("StorageClass", "storageclass", "storageclasses", "sc")
 _register_words("ResourceSlice", "resourceslice", "resourceslices")
 _register_words("DeviceClass", "deviceclass", "deviceclasses")
+_register_words("ResourceClaim", "resourceclaim", "resourceclaims")
+_register_words("CertificateSigningRequest", "certificatesigningrequest",
+                "certificatesigningrequests", "csr", "csrs")
 _register_words("Event", "event", "events", "ev")
 _register_words("FlowSchema", "flowschema", "flowschemas")
 _register_words("PriorityLevelConfiguration", "prioritylevelconfiguration",
@@ -86,7 +89,8 @@ _STORE_KIND = {
 # kinds with no namespace column
 _CLUSTER_SCOPED = {"Node", "Namespace", "PriorityClass", "PV", "StorageClass",
                    "ResourceSlice", "DeviceClass", "FlowSchema",
-                   "PriorityLevelConfiguration", "CustomResourceDefinition"}
+                   "PriorityLevelConfiguration", "CustomResourceDefinition",
+                   "CertificateSigningRequest"}
 
 
 def _singular(resource: str) -> str:

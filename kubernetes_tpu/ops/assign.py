@@ -253,6 +253,14 @@ _CHUNK = 128  # pods per chunk on the chunked path (buckets are multiples)
 _SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
 _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 
+# speculate->repair iterations per round (rounds kernel).  Measured on
+# BASELINE config 3 at 10k x 5k (40 apps): 1 iter -> 17.2 rounds/chunk,
+# 3 iters -> 15.4 — the floor there is the term-sharing (hard) bound
+# ~15, so extra iterations buy little at that app density; they matter
+# when divergence truncation dominates (sparser sharing, e.g. the
+# north-star 200-app shape).  2 keeps one re-speculation at modest cost.
+_REPAIR_ITERS = 2
+
 # Trace-time counters, bumped when a kernel's Python body actually runs
 # under jit tracing (once per cache entry).  Tests use them to prove WHICH
 # kernel a routed call compiled — the routing env override is read at trace
@@ -926,94 +934,115 @@ def schedule_scan_rounds(
             )
 
             # ---- exact repair under the intra-round prefix ----
-            act = unc & (c >= 0)
-            cn = jnp.maximum(c, 0)
-            E = (c[:, None] == c[None, :]) & act[:, None]  # [k, j] same node
-            T3 = E[:, :, None] * creq[:, None, :]
-            cum = lax.associative_scan(jnp.add, T3, axis=0) - T3
-            ca = n_alloc[cn]  # [C, R]
-            uij = used[cn][None, :, :] + cum  # [C(i), C(j), R]
-            fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
-            reqij = uij + creq[:, None, :]
-            shape3 = reqij.shape
-            baseij = score_flat(
-                reqij.reshape(-1, R),
-                jnp.broadcast_to(ca[None], shape3).reshape(-1, R),
-            ).reshape(C, C)
-            feas0_at = jnp.take_along_axis(feasible, cn[None, :], axis=1)
-            newtot = baseij
-            extreme_at = jnp.zeros((C, C), dtype=jnp.bool_)
-            if cfg.enable_taint_score:
-                r_at = jnp.take_along_axis(cx["traw"], cn[None, :], axis=1)
-                newtot = newtot + cfg.taint_weight * jnp.where(
-                    (t_mx > 0)[:, None],
-                    MAXS - MAXS * r_at / t_mx[:, None],
-                    MAXS,
+            def repair(c):
+                """(t, hard) for speculation c: t_i = pod i's TRUE
+                sequential argmax given pods j < i commit c_j; hard_i =
+                the repair's premises are void for i (term-sharing or an
+                extreme-attaining feasibility drop among its prefix)."""
+                act = unc & (c >= 0)
+                cn = jnp.maximum(c, 0)
+                E = (c[:, None] == c[None, :]) & act[:, None]
+                T3 = E[:, :, None] * creq[:, None, :]
+                cum = lax.associative_scan(jnp.add, T3, axis=0) - T3
+                ca = n_alloc[cn]  # [C, R]
+                uij = used[cn][None, :, :] + cum  # [C(i), C(j), R]
+                fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
+                reqij = uij + creq[:, None, :]
+                shape3 = reqij.shape
+                baseij = score_flat(
+                    reqij.reshape(-1, R),
+                    jnp.broadcast_to(ca[None], shape3).reshape(-1, R),
+                ).reshape(C, C)
+                feas0_at = jnp.take_along_axis(feasible, cn[None, :], axis=1)
+                newtot = baseij
+                extreme_at = jnp.zeros((C, C), dtype=jnp.bool_)
+                if cfg.enable_taint_score:
+                    r_at = jnp.take_along_axis(cx["traw"], cn[None, :], axis=1)
+                    newtot = newtot + cfg.taint_weight * jnp.where(
+                        (t_mx > 0)[:, None],
+                        MAXS - MAXS * r_at / t_mx[:, None],
+                        MAXS,
+                    )
+                    extreme_at |= (t_mx > 0)[:, None] & (r_at == t_mx[:, None])
+                if cfg.enable_node_pref:
+                    r_at = jnp.take_along_axis(
+                        cx["naraw"], cn[None, :], axis=1
+                    )
+                    newtot = newtot + cfg.node_affinity_weight * jnp.where(
+                        (na_mx > 0)[:, None],
+                        r_at * MAXS / na_mx[:, None],
+                        0.0,
+                    )
+                    extreme_at |= (na_mx > 0)[:, None] & (
+                        r_at == na_mx[:, None]
+                    )
+                if pw:
+                    r_at = jnp.take_along_axis(
+                        spread_raw, cn[None, :], axis=1
+                    )
+                    newtot = newtot + cfg.spread_weight * jnp.where(
+                        (s_mx > 0)[:, None],
+                        MAXS - MAXS * r_at / s_mx[:, None],
+                        MAXS,
+                    )
+                    extreme_at |= (s_mx > 0)[:, None] & (r_at == s_mx[:, None])
+                if ips:
+                    r_at = jnp.take_along_axis(ip_raw, cn[None, :], axis=1)
+                    newtot = newtot + cfg.interpod_weight * jnp.where(
+                        (ip_mx > ip_mn)[:, None],
+                        MAXS * (r_at - ip_mn[:, None])
+                        / (ip_mx[:, None] - ip_mn[:, None]),
+                        0.0,
+                    )
+                    extreme_at |= (ip_mx > ip_mn)[:, None] & (
+                        (r_at == ip_mx[:, None]) | (r_at == ip_mn[:, None])
+                    )
+                if "img" in cx:
+                    newtot = newtot + cfg.image_weight * jnp.take_along_axis(
+                        cx["img"], cn[None, :], axis=1
+                    )
+                newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
+                dropped = feas0_at & ~fitij
+                hard = (
+                    (share | (dropped & extreme_at)) & jlt & act[None, :]
+                ).any(axis=1)
+                # unpicked nodes keep round-start scores; picked nodes take
+                # the rescored newtot
+                O = ((c[:, None] == my_nodes[None, :]) & act[:, None]).astype(
+                    jnp.float32
+                )  # [C(j), N] pick indicator
+                picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, N]
+                av = jnp.max(jnp.where(picked_before, neg_inf, total), axis=1)
+                a_n = jnp.where(
+                    (total == av[:, None]) & ~picked_before,
+                    my_nodes[None, :],
+                    _INT_MAX,
+                ).min(axis=1)
+                Mj = jnp.where(act[None, :] & jlt, newtot, neg_inf)
+                vb = jnp.max(Mj, axis=1)
+                b_n = jnp.where(Mj == vb[:, None], cn[None, :], _INT_MAX).min(
+                    axis=1
                 )
-                extreme_at |= (t_mx > 0)[:, None] & (r_at == t_mx[:, None])
-            if cfg.enable_node_pref:
-                r_at = jnp.take_along_axis(cx["naraw"], cn[None, :], axis=1)
-                newtot = newtot + cfg.node_affinity_weight * jnp.where(
-                    (na_mx > 0)[:, None],
-                    r_at * MAXS / na_mx[:, None],
-                    0.0,
+                t_val = jnp.maximum(av, vb)
+                t_n = jnp.where(
+                    vb > av, b_n,
+                    jnp.where(av > vb, a_n, jnp.minimum(a_n, b_n)),
                 )
-                extreme_at |= (na_mx > 0)[:, None] & (r_at == na_mx[:, None])
-            if pw:
-                r_at = jnp.take_along_axis(spread_raw, cn[None, :], axis=1)
-                newtot = newtot + cfg.spread_weight * jnp.where(
-                    (s_mx > 0)[:, None],
-                    MAXS - MAXS * r_at / s_mx[:, None],
-                    MAXS,
+                t = jnp.where(
+                    (t_val > neg_inf) & cvalid, t_n.astype(jnp.int32), -1
                 )
-                extreme_at |= (s_mx > 0)[:, None] & (r_at == s_mx[:, None])
-            if ips:
-                r_at = jnp.take_along_axis(ip_raw, cn[None, :], axis=1)
-                newtot = newtot + cfg.interpod_weight * jnp.where(
-                    (ip_mx > ip_mn)[:, None],
-                    MAXS * (r_at - ip_mn[:, None])
-                    / (ip_mx[:, None] - ip_mn[:, None]),
-                    0.0,
-                )
-                extreme_at |= (ip_mx > ip_mn)[:, None] & (
-                    (r_at == ip_mx[:, None]) | (r_at == ip_mn[:, None])
-                )
-            if "img" in cx:
-                newtot = newtot + cfg.image_weight * jnp.take_along_axis(
-                    cx["img"], cn[None, :], axis=1
-                )
-            newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
-            dropped = feas0_at & ~fitij
-            # HARD interference — conditions that invalidate the repair
-            # itself: term-sharing moves raws/masks anywhere; an extreme-
-            # attaining feasibility drop moves a normalization scalar
-            hard = (
-                (share | (dropped & extreme_at)) & jlt & act[None, :]
-            ).any(axis=1)
-            # the exact sequential argmax t_i given the prefix's picks:
-            # unpicked nodes keep their round-start scores (no share, no
-            # scalar change), picked nodes take the rescored newtot
-            O = ((c[:, None] == my_nodes[None, :]) & act[:, None]).astype(
-                jnp.float32
-            )  # [C(j), N] pick indicator
-            picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, N]
-            av = jnp.max(jnp.where(picked_before, neg_inf, total), axis=1)
-            a_n = jnp.where(
-                (total == av[:, None]) & ~picked_before, my_nodes[None, :],
-                _INT_MAX,
-            ).min(axis=1)
-            Mj = jnp.where(act[None, :] & jlt, newtot, neg_inf)
-            vb = jnp.max(Mj, axis=1)
-            b_n = jnp.where(Mj == vb[:, None], cn[None, :], _INT_MAX).min(
-                axis=1
-            )
-            t_val = jnp.maximum(av, vb)
-            t_n = jnp.where(
-                vb > av, b_n, jnp.where(av > vb, a_n, jnp.minimum(a_n, b_n))
-            )
-            t = jnp.where(
-                (t_val > neg_inf) & cvalid, t_n.astype(jnp.int32), -1
-            )
+                return t, hard
+
+            # iterate speculate -> repair: a wrong guess at pod k corrupts
+            # only guesses AFTER k, and its own repair is exact, so feeding
+            # t back as the next speculation converges the prefix toward
+            # the hard-interference bound instead of stopping at the first
+            # divergence (the commit rule below revalidates the FINAL c, so
+            # iterations only improve throughput, never correctness)
+            for _ in range(_REPAIR_ITERS - 1):
+                t, hard = repair(c)
+                c = jnp.where(unc, t, c)
+            t, hard = repair(c)
 
             # ---- commit: the longest prefix whose speculation matched the
             # exact repair, plus the FIRST divergence-only pod committing

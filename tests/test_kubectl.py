@@ -242,3 +242,27 @@ def test_get_selector_existence_and_bad_rollout_usage(kc):
     assert "No resources found" in kc.run("get pods -l nope")
     with pytest.raises(KubectlError, match="usage"):
         kc.run("rollout status deployment")
+
+
+def test_get_resourceclaims_and_csrs():
+    """Round-4 kinds ride the same verb machinery: resourceclaims are
+    namespaced, certificatesigningrequests cluster-scoped with the csr
+    shortname."""
+    from kubernetes_tpu.kubectl import make_admin_kubectl
+
+    store = ClusterStore()
+    store.add_object(
+        "ResourceClaim", c.ResourceClaim(name="claim-a", device_class="gpu")
+    )
+    store.add_object(
+        "CertificateSigningRequest",
+        c.CertificateSigningRequest(name="n0-serving",
+                                    username="system:node:n0"),
+    )
+    k = make_admin_kubectl(store)
+    out = k.run(["get", "resourceclaims"])
+    assert "claim-a" in out and "NAMESPACE" in out
+    out = k.run(["get", "csr"])
+    assert "n0-serving" in out and "NAMESPACE" not in out
+    y = k.run(["get", "resourceclaim", "claim-a", "-o", "yaml"])
+    assert "device_class: gpu" in y or "gpu" in y
