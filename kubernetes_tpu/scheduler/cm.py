@@ -55,11 +55,31 @@ class CPUAllocationError(Exception):
 
 
 class CPUManagerStatic:
-    """Exclusive-core accounting for one node (cpumanager static policy)."""
+    """Exclusive-core accounting for one node (cpumanager static policy).
 
-    def __init__(self, n_cpus: int):
+    With a CheckpointManager the assignments survive kubelet restart, the
+    same cm/cpumanager/state checkpoint contract the devicemanager analog
+    follows (a restarted kubelet must not double-assign cores that running
+    containers still hold)."""
+
+    def __init__(self, n_cpus: int, checkpoints=None, node_name: str = ""):
         self.n_cpus = n_cpus
         self.assignments: Dict[str, Tuple[int, ...]] = {}  # pod uid -> cores
+        self._ckpt = checkpoints
+        self._ckpt_name = f"cpumanager-{node_name or 'node'}"
+        if checkpoints is not None:
+            saved = checkpoints.load(self._ckpt_name)
+            if saved:
+                self.assignments = {
+                    uid: tuple(cores) for uid, cores in saved.items()
+                }
+
+    def _persist(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.save(
+                self._ckpt_name,
+                {uid: list(c) for uid, c in self.assignments.items()},
+            )
 
     def _free(self) -> List[int]:
         used: Set[int] = set()
@@ -91,10 +111,12 @@ class CPUManagerStatic:
             )
         cores = tuple(free[:n])  # lowest-numbered free cores
         self.assignments[pod.uid] = cores
+        self._persist()
         return cores
 
     def free(self, pod_uid: str) -> None:
-        self.assignments.pop(pod_uid, None)
+        if self.assignments.pop(pod_uid, None) is not None:
+            self._persist()
 
 
 class EvictionManager:

@@ -157,7 +157,11 @@ class HollowKubelet:
         n_cpus = (
             node.allocatable.get(t.CPU, 0) // 1000 if node is not None else 0
         )
-        self.cpumanager = CPUManagerStatic(n_cpus)
+        self.cpumanager = CPUManagerStatic(
+            n_cpus,
+            CheckpointManager(checkpoint_dir) if checkpoint_dir else None,
+            node_name,
+        )
         self.eviction = EvictionManager(store, node_name)
         self._cidr_index = (
             pod_cidr_index
@@ -291,6 +295,10 @@ class HollowKubelet:
             cur = self.store.pods.get(uid)
             if cur is None or cur.node_name != self.node_name:
                 self.devices.free(uid)
+        for uid in list(self.cpumanager.assignments):
+            cur = self.store.pods.get(uid)
+            if cur is None or cur.node_name != self.node_name:
+                self.cpumanager.free(uid)
 
     def serving_certificate(self) -> str:
         """The issued serving certificate, "" until the Certificates

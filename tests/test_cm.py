@@ -99,3 +99,28 @@ def test_eviction_taints_while_pressure_persists():
         tn.key == MEMORY_PRESSURE_TAINT_KEY
         for tn in store.nodes["n0"].taints
     )
+
+
+def test_cpumanager_checkpoint_survives_kubelet_restart(tmp_path):
+    """cm/cpumanager/state: a restarted kubelet reloads core assignments
+    from the checksummed checkpoint, so a still-running pod's cores are
+    not double-assigned, and a pod that vanished while the kubelet was
+    down frees its cores through housekeeping."""
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=4000))
+    k1 = HollowKubelet(store, LeaseStore(clock=clock), "n0", clock=clock,
+                       checkpoint_dir=str(tmp_path))
+    store.add_pod(mk_pod("g1", cpu=2000, node_name="n0"))
+    k1.tick()
+    assert k1.cpumanager.assignments["default/g1"] == (0, 1)
+    k1.close()
+    # restart: the new kubelet sees the same assignment without re-allocating
+    k2 = HollowKubelet(store, LeaseStore(clock=clock), "n0", clock=clock,
+                       checkpoint_dir=str(tmp_path))
+    assert k2.cpumanager.assignments["default/g1"] == (0, 1)
+    # a new integer pod takes the NEXT cores (no double assignment)
+    store.add_pod(mk_pod("g2", cpu=1000, node_name="n0"))
+    k2.tick()
+    assert k2.cpumanager.assignments["default/g2"] == (2,)
+    k2.close()
