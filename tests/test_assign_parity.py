@@ -562,3 +562,106 @@ def test_rounds_diagnostic_and_forced_routing(monkeypatch):
     np.testing.assert_array_equal(choices, plain)
     monkeypatch.setenv("KTPU_FORCE_CHUNKED", "0")
     assert not _rounds_routed(arr, cfg)
+
+
+@pytest.mark.parametrize("strategy,shape", [
+    ("MostAllocated", ((0.0, 0.0), (100.0, 10.0))),
+    ("RequestedToCapacityRatio", ((0.0, 10.0), (50.0, 2.0), (100.0, 0.0))),
+])
+def test_rounds_scan_fit_strategies_parity(strategy, shape):
+    """The rounds kernel's base hoist + column patches + point rescores all
+    dispatch on the profile's scoringStrategy; MostAllocated inverts the
+    usage preference (picked nodes IMPROVE for later pods — the repair's
+    rescored-beats case), RTCR interpolates a custom shape."""
+    import dataclasses
+
+    import jax
+
+    from kubernetes_tpu.ops.assign import schedule_scan, schedule_scan_rounds
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    rng = random.Random(hash(strategy) % 997)
+    snap = random_cluster(rng, n_nodes=10, n_pods=128, with_taints=True,
+                          with_selectors=True, with_pairwise=True)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, dataclasses.replace(
+        DEFAULT_SCORE_CONFIG, fit_strategy=strategy, rtcr_shape=shape))
+    plain_c, plain_u = (
+        np.asarray(x)
+        for x in jax.jit(schedule_scan, static_argnames=("cfg",))(arr, cfg)
+    )
+    rc, ru = (
+        np.asarray(x)
+        for x in jax.jit(schedule_scan_rounds, static_argnames=("cfg",))(arr, cfg)
+    )
+    np.testing.assert_array_equal(rc, plain_c)
+    np.testing.assert_array_equal(ru, plain_u)
+
+
+def test_rounds_scan_in_gang_fixpoint_matches_plain(monkeypatch):
+    """Gang revocation re-runs the kernel with pod_valid masks; the rounds
+    path must produce the same fixpoint as the plain scan (pairwise gangs:
+    spread-constrained groups contending for skew headroom)."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.gang import schedule_with_gangs
+    from kubernetes_tpu.ops.scores import infer_score_config
+
+    nodes = [mk_node(f"n{i}", cpu=2000, pods=6,
+                     labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
+             for i in range(9)]
+    spread = (t.TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        when_unsatisfiable=t.DO_NOT_SCHEDULE,
+        label_selector=t.LabelSelector.of(app="gang")),)
+    pods, groups = [], {}
+    for g in range(16):
+        name = f"job{g}"
+        groups[name] = t.PodGroup(name=name, min_member=8)
+        for m in range(8):
+            pods.append(mk_pod(f"{name}-{m}", cpu=600, labels={"app": "gang"},
+                               topology_spread=spread, pod_group=name))
+    snap = Snapshot(nodes=nodes, pending_pods=pods, pod_groups=groups)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    forced, _ = schedule_with_gangs(arr, cfg)
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "0")
+    plain, _ = schedule_with_gangs(arr, cfg)
+    np.testing.assert_array_equal(forced, plain)
+    # all-or-nothing held: bound members per group are 0 or >= 8
+    pg = np.asarray(arr.pod_group)
+    for g in range(16):
+        n = int(((pg == g) & (forced >= 0)).sum())
+        assert n == 0 or n >= 8, (g, n)
+
+
+def test_rounds_scan_with_pinned_and_gated_pods():
+    """spec.nodeName pins and scheduling-gated (pod_valid=False) pods
+    interleave a chunk: pins restrict static feasibility to one node
+    (forced same-node collisions for the repair), gates must stay -1."""
+    nodes = [mk_node(f"n{i}", cpu=6000, pods=30,
+                     labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
+             for i in range(6)]
+    pods = []
+    for i in range(128):
+        p = mk_pod(f"p{i:03d}", cpu=100, labels={"app": "w"},
+                   topology_spread=(t.TopologySpreadConstraint(
+                       max_skew=2,
+                       topology_key="topology.kubernetes.io/zone",
+                       when_unsatisfiable=t.SCHEDULE_ANYWAY,
+                       label_selector=t.LabelSelector.of(app="w")),)
+                   if i % 3 == 0 else ())
+        if i % 7 == 0:
+            # spec.nodeName pin on a PENDING pod: static feasibility
+            # narrows to one node (forced same-node collisions)
+            p.node_name = f"n{i % 6}"
+        if i % 11 == 0:
+            p.scheduling_gates = ("hold",)
+        pods.append(p)
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    arr, cfg = _rounds_vs_plain(snap, check_oracle=False)
+    # gated pods stayed unscheduled on both paths (checked via the plain
+    # equality inside _rounds_vs_plain); sanity: at least one gate existed
+    assert (np.asarray(arr.pod_valid) == False).any()  # noqa: E712
