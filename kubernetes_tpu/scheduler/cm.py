@@ -127,15 +127,26 @@ class EvictionManager:
     #: expressed as a fraction of capacity)
     MEMORY_HARD_FRACTION = 0.95
 
-    def __init__(self, store: ClusterStore, node_name: str):
+    def __init__(self, store: ClusterStore, node_name: str,
+                 pod_uids=None):
+        """pod_uids: optional callable yielding this node's pod uids (the
+        kubelet passes its watch-fed worker map, keeping the per-tick cost
+        O(node pods) — the kubelet's no-cluster-scans contract); without
+        it, falls back to scanning the store (standalone use)."""
         self.store = store
         self.node_name = node_name
+        self._pod_uids = pod_uids
 
     def _running_pods(self) -> List[t.Pod]:
+        if self._pod_uids is not None:
+            pods = (self.store.pods.get(uid) for uid in self._pod_uids())
+        else:
+            pods = self.store.pods.values()
         return [
             p
-            for p in self.store.pods.values()
-            if p.node_name == self.node_name
+            for p in pods
+            if p is not None
+            and p.node_name == self.node_name
             and p.phase not in (t.PHASE_SUCCEEDED, t.PHASE_FAILED)
         ]
 

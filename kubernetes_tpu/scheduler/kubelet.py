@@ -162,7 +162,9 @@ class HollowKubelet:
             CheckpointManager(checkpoint_dir) if checkpoint_dir else None,
             node_name,
         )
-        self.eviction = EvictionManager(store, node_name)
+        self.eviction = EvictionManager(
+            store, node_name, pod_uids=lambda: list(self.workers)
+        )
         self._cidr_index = (
             pod_cidr_index
             if pod_cidr_index is not None
