@@ -18,7 +18,7 @@ from kubernetes_tpu.scheduler.queue import FakeClock
 from helpers import mk_node, mk_pod
 
 
-@pytest.mark.parametrize("seed", [11])
+@pytest.mark.parametrize("seed", [11, 23])
 def test_round4_forced_chunked_soak_with_delta_verify(seed, monkeypatch):
     from kubernetes_tpu.ops.assign import TRACE_COUNTS
     from kubernetes_tpu.scheduler.config import Profile
@@ -37,7 +37,10 @@ def test_round4_forced_chunked_soak_with_delta_verify(seed, monkeypatch):
     # be satisfied by a plain-scan trace some earlier test cached for the
     # same bucketed shapes (the env override is read at trace time only)
     cfg = SchedulerConfiguration(
-        mode="tpu", profiles=(Profile(hard_pod_affinity_weight=1.0000001),)
+        mode="tpu",
+        # unique per SEED too: a second seed reusing the first's bucketed
+        # shapes would otherwise hit its jit cache and trace nothing
+        profiles=(Profile(hard_pod_affinity_weight=1.0 + seed * 1e-6),),
     )
     sched = Scheduler(store, cfg, clock=clock)
 
