@@ -70,6 +70,13 @@ def main() -> None:
         prev_assign = cli.schedule(s2, deadline_ms=600_000)
         waves.append(time.perf_counter() - t0)
         prev_pods = wave
+    # per-phase attribution (decode/encode/dispatch/step) from the engine's
+    # histograms — the round-3 warm-wave variance had no attribution
+    _, _, hists = server.engine.metrics.snapshot()
+    phases = {
+        name: {"p50_s": round(p50, 4), "p99_s": round(p99, 4), "n": n}
+        for name, (p50, p99, n) in sorted(hists.items())
+    }
     server.stop()
     med = sorted(waves)[len(waves) // 2]
     print(
@@ -86,6 +93,7 @@ def main() -> None:
                 "warm_wave_median_s": round(med, 3),
                 "pass_1s": med < 1.0,
                 "client_stats": cli.stats,
+                "server_phases": phases,
                 "unit": "s",
             }
         )
