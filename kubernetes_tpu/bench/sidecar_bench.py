@@ -52,6 +52,10 @@ def main() -> None:
 
     # first warm request gives the placements to bind for the cycle chain
     r = cli.schedule(snap, deadline_ms=600_000)
+    # drop the warmup/cold-path phase samples (compile-dominated) so the
+    # per-phase report attributes ONLY the timed warm waves below
+    with server.engine.metrics._lock:
+        server.engine.metrics.hists.clear()
     waves = []
     prev_assign = r
     prev_pods = snap.pending_pods
