@@ -390,11 +390,21 @@ class SpecInterner:
             # identity-stable (property-backed attributes).  One occurrence
             # triggers a crash-only table wipe inside interner_lookup; if it
             # keeps happening the C fast path cannot help this workload, so
-            # hand the instance to the Python loop for good.
-            self._thrash = getattr(self, "_thrash", 0) + 1
-            if self._thrash >= 3:
+            # hand the instance to the Python loop for good.  Counted
+            # SEPARATELY from the forced-miss latch below: a clean-batch
+            # reset of the forced counter must not erase provisional
+            # strikes (provisional leftovers typically coincide with zero
+            # forced misses, so a shared counter could never latch).
+            self._thrash_prov = getattr(self, "_thrash_prov", 0) + 1
+            if self._thrash_prov >= 3:
                 self._lib = None
                 return self.group(pods)
+        else:
+            # same isolated-events rule as the forced latch: a batch with no
+            # provisional leftovers resets the provisional streak, so three
+            # transient slow-path failures weeks apart never permanently
+            # disable the fast path — only PERSISTENT thrash latches
+            self._thrash_prov = 0
         # same bounded-memory policy as the Python path's _keys.clear():
         # drop the profile table AND the spec-key registry together (C
         # entries hold kid indices into _key_by_kid, so they must reset as
@@ -420,16 +430,17 @@ class SpecInterner:
             # correctly through the value slow path below, but with no
             # intra-batch dedup; if they keep appearing the C fast path
             # cannot help this workload, so latch onto the Python loop
-            # (same counter as the provisional-thrash latch above)
-            self._thrash = getattr(self, "_thrash", 0) + 1
-            if self._thrash >= 3:
+            # (own counter — see the provisional latch above)
+            self._thrash_forced = getattr(self, "_thrash_forced", 0) + 1
+            if self._thrash_forced >= 3:
                 self._lib = None
         else:
-            # a clean batch resets the streak: the latch is for workloads
-            # that are PERSISTENTLY identity-unstable, not for one odd pod
-            # ever — 3 isolated events weeks apart must not disable the
-            # fast path for the process lifetime
-            self._thrash = 0
+            # a clean batch resets the FORCED streak only: the latch is for
+            # workloads that are PERSISTENTLY identity-unstable, not for one
+            # odd pod ever — 3 isolated events weeks apart must not disable
+            # the fast path for the process lifetime.  Provisional strikes
+            # stay: their batches report zero forced misses by nature.
+            self._thrash_forced = 0
         if n_miss:
             # miss holds only UNIQUE missing profiles (intra-batch
             # duplicates were resolved to provisional markers by the C

@@ -298,6 +298,22 @@ PHASE_SUCCEEDED = "Succeeded"
 PHASE_FAILED = "Failed"
 
 
+@dataclass(frozen=True)
+class Probe:
+    """core/v1/types.go — type Probe (timing/threshold shape), reduced to
+    what drives the hollow kubelet's prober: the reference's handler
+    (httpGet/exec/tcpSocket) is replaced by a clock contract —
+    `fail_after_seconds` > 0 means the probe starts FAILING once the
+    container has been running that long (0 = always succeeds).  The
+    kubemark trade, same as FakeCRI's run/crash knobs."""
+
+    period_seconds: float = 10.0
+    failure_threshold: int = 3
+    success_threshold: int = 1  # readiness only (liveness must be 1 upstream)
+    initial_delay_seconds: float = 0.0
+    fail_after_seconds: float = 0.0  # hollow outcome knob
+
+
 @dataclass
 class Pod:
     """Scheduling view of a pod (pending or running).
@@ -352,6 +368,15 @@ class Pod:
     crash_after_seconds: float = 0.0
     # status.containerStatuses[0].restartCount, stamped by the kubelet
     restart_count: int = 0
+    # spec.containers[0].{liveness,readiness}Probe — run by the kubelet's
+    # prober (pkg/kubelet/prober); liveness failure restarts the container,
+    # readiness gates the pod's Ready condition (and so EndpointSlices)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
+    # status.conditions[Ready] — True when no readiness probe is configured
+    # (the reference defaults readiness true absent a probe); stamped False
+    # by the kubelet until the probe passes success_threshold times
+    ready: bool = True
     uid: str = ""
 
     def __post_init__(self) -> None:

@@ -259,7 +259,10 @@ _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 # ~15, so extra iterations buy little at that app density; they matter
 # when divergence truncation dominates (sparser sharing, e.g. the
 # north-star 200-app shape).  2 keeps one re-speculation at modest cost.
-_REPAIR_ITERS = 2
+# KTPU_REPAIR_ITERS overrides for tuning sweeps (read at import; the value
+# is baked into each jit trace, so sweep points must run in fresh
+# processes — bench/rounds_proof.py does).
+_REPAIR_ITERS = int(os.environ.get("KTPU_REPAIR_ITERS", "2"))
 
 # Trace-time counters, bumped when a kernel's Python body actually runs
 # under jit tracing (once per cache entry).  Tests use them to prove WHICH

@@ -33,8 +33,15 @@ def _is_finished(pod: t.Pod) -> bool:
 
 
 def _is_ready(pod: t.Pod) -> bool:
-    """Bound and running ("" phase = harness objects without lifecycle)."""
-    return bool(pod.node_name) and pod.phase in ("", t.PHASE_RUNNING)
+    """Bound, running ("" phase = harness objects without lifecycle), and
+    passing its readiness probe — the Ready CONDITION, which gates ordered
+    StatefulSet rollout and RS/DS ready counts in the reference, not just
+    the phase."""
+    return (
+        bool(pod.node_name)
+        and pod.phase in ("", t.PHASE_RUNNING)
+        and pod.ready
+    )
 
 
 def _controller_of(pod: t.Pod) -> Optional[t.OwnerReference]:

@@ -119,6 +119,99 @@ class PLEG:
         return events
 
 
+@dataclass
+class _ProbeState:
+    """One prober worker's result state (prober/worker.go — type worker:
+    onHold/resultRun counters), keyed to a CONTAINER: a restarted container
+    gets fresh counters (the reference spawns a new worker per container)."""
+
+    container_id: str
+    last_probe: float = float("-inf")
+    fails: int = 0
+    successes: int = 0
+    result: Optional[bool] = None  # None = no result yet (probing not begun)
+
+
+class ProbeManager:
+    """prober/prober_manager.go — liveness/readiness probes, clock-driven.
+
+    The reference runs one prober worker goroutine per (pod, container,
+    probe kind), each ticking on its probe's period and feeding a results
+    manager the kubelet's sync loop consults.  Here sync() is called from
+    the kubelet's tick for each running worker: it runs whichever probes
+    are DUE (period elapsed, initial delay passed) and returns the two
+    consumable signals — "liveness says kill" and the pod's readiness.
+
+    Probe outcomes come from the hollow contract on api.types.Probe
+    (fail_after_seconds), the same clock trade FakeCRI makes for container
+    lifecycles; thresholds and periods behave as the reference's
+    (worker.go — doProbe: failure_threshold consecutive failures flip the
+    result, success_threshold consecutive successes flip it back)."""
+
+    def __init__(self, runtime: "cri_mod.RuntimeService", clock: Clock):
+        self.runtime = runtime
+        self.clock = clock
+        self._state: Dict[Tuple[str, str], _ProbeState] = {}
+
+    def remove(self, pod_uid: str) -> None:
+        for kind in ("liveness", "readiness"):
+            self._state.pop((pod_uid, kind), None)
+
+    def _probe_one(self, w: "_PodWorker", kind: str,
+                   probe: t.Probe, started_at: float) -> Optional[bool]:
+        key = (w.pod.uid, kind)
+        st = self._state.get(key)
+        if st is None or st.container_id != w.container_id:
+            st = self._state[key] = _ProbeState(container_id=w.container_id)
+        now = self.clock.now()
+        if now - started_at < probe.initial_delay_seconds:
+            return st.result
+        if now - st.last_probe >= probe.period_seconds:
+            st.last_probe = now
+            ok = not (
+                probe.fail_after_seconds > 0
+                and now - started_at >= probe.fail_after_seconds
+            )
+            if ok:
+                st.successes += 1
+                st.fails = 0
+                if st.successes >= probe.success_threshold:
+                    st.result = True
+            else:
+                st.fails += 1
+                st.successes = 0
+                if st.fails >= probe.failure_threshold:
+                    st.result = False
+        return st.result
+
+    def sync(self, w: "_PodWorker") -> Tuple[bool, bool]:
+        """Run due probes for this worker's current container.  Returns
+        (liveness_kill, pod_ready).  No readiness probe -> always ready;
+        a readiness probe holds the pod NOT ready until it has passed
+        success_threshold times (the reference's initial readiness is
+        Failure until proven)."""
+        pod = w.pod
+        if pod.liveness_probe is None and pod.readiness_probe is None:
+            return False, True
+        try:
+            status = self.runtime.container_status(w.container_id)
+        except CRIError:
+            return False, pod.readiness_probe is None
+        if status.state != CONTAINER_RUNNING:
+            return False, pod.readiness_probe is None
+        kill = False
+        if pod.liveness_probe is not None:
+            res = self._probe_one(w, "liveness", pod.liveness_probe,
+                                  status.started_at)
+            kill = res is False
+        ready = True
+        if pod.readiness_probe is not None:
+            ready = self._probe_one(
+                w, "readiness", pod.readiness_probe, status.started_at
+            ) is True
+        return kill, ready
+
+
 class HollowKubelet:
     def __init__(
         self,
@@ -144,6 +237,7 @@ class HollowKubelet:
         self.runtime: "cri_mod.RuntimeService" = self.cri
         self.images: "cri_mod.ImageService" = self.cri
         self.pleg = PLEG(self.runtime)
+        self.prober = ProbeManager(self.runtime, self.clock)
         # cm/devicemanager analog: concrete device IDs per admitted pod,
         # checkpointed when a directory is given (restart-safe allocations)
         self.devices = DeviceManager(
@@ -212,19 +306,26 @@ class HollowKubelet:
 
     def _teardown(self, w: _PodWorker) -> None:
         """killPodWithSyncResult's ordering: stop container -> remove
-        container -> stop sandbox -> remove sandbox, then release devices."""
+        container -> stop sandbox -> remove sandbox, then release devices.
+        Container and sandbox steps swallow CRIError INDEPENDENTLY: a
+        container already gone must not orphan its sandbox (which would
+        stay in list_pod_sandboxes, IP held, forever)."""
         try:
             if w.container_id:
                 self.runtime.stop_container(w.container_id)
                 self.runtime.remove_container(w.container_id)
+        except CRIError:
+            pass  # already gone (crash-only: teardown is idempotent)
+        try:
             if w.sandbox_id:
                 self.runtime.stop_pod_sandbox(w.sandbox_id)
                 self.runtime.remove_pod_sandbox(w.sandbox_id)
         except CRIError:
-            pass  # already gone (crash-only: teardown is idempotent)
+            pass
         w.container_id = w.sandbox_id = ""
         self.devices.free(w.pod.uid)
         self.cpumanager.free(w.pod.uid)
+        self.prober.remove(w.pod.uid)
 
     def _dispatch(self, pod: t.Pod, removed: bool) -> None:
         """UpdatePod (pod_workers.go): create/feed the pod's worker."""
@@ -286,6 +387,27 @@ class HollowKubelet:
             if w.terminated or w.admitted:
                 continue
             self._sync_start(w)
+        # prober (prober_manager): due probes for every running container.
+        # Liveness failure kills the container and routes through the SAME
+        # died path as a crash (computePodActions sees an exited container;
+        # restartPolicy decides restart vs pod failure); readiness feeds
+        # the pod's Ready condition, which EndpointSlice consumes.
+        for uid, w in list(self.workers.items()):
+            if w.terminated or not w.admitted or not w.container_id:
+                continue
+            kill, ready = self.prober.sync(w)
+            if kill:
+                try:
+                    self.runtime.stop_container(w.container_id)
+                except CRIError:
+                    pass
+                self._sync_died(w)
+                continue
+            cur = self.store.pods.get(uid)
+            if cur is not None and cur.ready != ready:
+                q = self._status_copy(w.pod)
+                q.ready = ready
+                self.store.update_pod_status(q)
         # housekeeping (housekeepingCh): drop terminated workers whose pod
         # left the store (deletion events already handled; belt & braces),
         # and reclaim checkpoint-restored device allocations whose pod
@@ -444,6 +566,10 @@ class HollowKubelet:
             # result through RunPodSandbox); allocator fallback for direct
             # callers outside a sandbox
             q.pod_ip = pod_ip or self._alloc_ip()
+        if phase == t.PHASE_RUNNING:
+            # Ready starts False under a readiness probe (initial readiness
+            # is Failure until the probe passes success_threshold times)
+            q.ready = pod.readiness_probe is None
         self.store.update_pod_status(q)
 
     def _alloc_ip(self) -> str:

@@ -40,7 +40,10 @@ class EndpointSliceController:
                 continue
             if not pod.node_name:
                 continue  # unscheduled pods are never endpoints
-            ready = pod.phase in ("", t.PHASE_RUNNING)
+            # serving readiness = Running AND the Ready condition the
+            # kubelet's prober maintains (False while a readiness probe has
+            # not yet passed, or after failure_threshold failures)
+            ready = pod.phase in ("", t.PHASE_RUNNING) and pod.ready
             if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
                 continue
             address = pod.pod_ip or f"?:{pod.uid}"  # IP pending -> not ready

@@ -164,3 +164,138 @@ def test_kubelet_tls_bootstrap_csr_flow():
     ctrl.tick()
     assert store.get_object("CertificateSigningRequest", "n0-serving") is None
     assert kubelet.serving_certificate() == cert
+
+
+def test_liveness_probe_failure_restarts_container():
+    """prober_manager: liveness failure_threshold consecutive failures kill
+    the container; the replacement goes through the standard restart path
+    (restartCount++, a NEW container at attempt+1)."""
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod(
+        "webapp", node_name="n0",
+        liveness_probe=t.Probe(period_seconds=1.0, failure_threshold=3,
+                               fail_after_seconds=5.0),
+    ))
+    kubelet.tick()
+    w = kubelet.workers["default/webapp"]
+    first = w.container_id
+    for _ in range(4):  # healthy while runtime < fail_after
+        clock.step(1.0)
+        kubelet.tick()
+    assert w.container_id == first and w.restarts == 0
+    # probe now fails; 3 consecutive failures (period 1s) trigger the kill
+    for _ in range(3):
+        clock.step(1.0)
+        kubelet.tick()
+    assert w.restarts == 1
+    assert w.container_id != first
+    st = kubelet.runtime.container_status(w.container_id)
+    assert st.attempt == 1
+    assert store.pods["default/webapp"].restart_count == 1
+    # ...and the cycle repeats on the replacement (fresh probe counters:
+    # no kill until ITS runtime passes fail_after + 3 failed periods)
+    clock.step(4.0)
+    kubelet.tick()
+    assert w.restarts == 1
+
+
+def test_liveness_probe_respects_restart_policy_never():
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod(
+        "once", node_name="n0", restart_policy="Never",
+        liveness_probe=t.Probe(period_seconds=1.0, failure_threshold=1,
+                               fail_after_seconds=2.0),
+    ))
+    kubelet.tick()
+    clock.step(3.0)
+    kubelet.tick()
+    assert store.pods["default/once"].phase == t.PHASE_FAILED
+    assert kubelet.workers["default/once"].terminated
+
+
+def test_readiness_probe_gates_pod_ready_and_endpoints():
+    """Readiness: the pod publishes Ready=False until the probe passes
+    success_threshold times; EndpointSlice serves only ready pods; a
+    failing probe flips Ready back off without restarting anything."""
+    from kubernetes_tpu.api import cluster as c
+    from kubernetes_tpu.scheduler.network import EndpointSliceController
+
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod(
+        "backend", node_name="n0", labels={"app": "web"},
+        readiness_probe=t.Probe(period_seconds=1.0, success_threshold=2,
+                                failure_threshold=2,
+                                fail_after_seconds=10.0),
+    ))
+    svc = c.Service(name="web", selector=(("app", "web"),),
+                    ports=(c.ServicePort(80, target_port=8080),))
+    store.add_object("Service", svc)
+    eps = EndpointSliceController(store)
+    kubelet.tick()  # Running, but NOT ready (probe not passed yet)
+    pod = store.pods["default/backend"]
+    assert pod.phase == t.PHASE_RUNNING and pod.ready is False
+    eps.sync_service(svc)
+    slices = store.list_objects("EndpointSlice")
+    assert all(not e.ready for s in slices for e in s.endpoints)
+    clock.step(1.0)
+    kubelet.tick()  # second consecutive success -> Ready
+    assert store.pods["default/backend"].ready is True
+    eps.sync_service(svc)
+    slices = store.list_objects("EndpointSlice")
+    assert [e.ready for s in slices for e in s.endpoints] == [True]
+    # probe starts failing at t>=10s: 2 consecutive failures -> not ready,
+    # container keeps running (readiness never restarts)
+    w = kubelet.workers["default/backend"]
+    cid = w.container_id
+    clock.step(10.0)
+    kubelet.tick()
+    clock.step(1.0)
+    kubelet.tick()
+    assert store.pods["default/backend"].ready is False
+    assert w.container_id == cid and w.restarts == 0
+
+
+def test_pods_without_probes_are_ready_when_running():
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod("plain", node_name="n0"))
+    kubelet.tick()
+    pod = store.pods["default/plain"]
+    assert pod.phase == t.PHASE_RUNNING and pod.ready is True
+
+
+def test_teardown_missing_container_still_removes_sandbox():
+    """A container already gone from the runtime must not orphan its
+    sandbox (per-step CRIError handling in _teardown)."""
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod("gone", node_name="n0"))
+    kubelet.tick()
+    w = kubelet.workers["default/gone"]
+    # the runtime loses the container out from under the kubelet (crash-only
+    # world: a restarted runtime daemon with partial state)
+    kubelet.runtime.stop_container(w.container_id)
+    kubelet.runtime.remove_container(w.container_id)
+    store.delete_pod("default/gone")
+    assert not kubelet.runtime.list_pod_sandboxes()
+
+
+def test_probe_thresholds_count_periods_not_ticks():
+    """failure_threshold counts PROBE EXECUTIONS (period-spaced), not sync
+    ticks: a period-10s liveness probe with threshold 3 on an
+    always-failing target kills ~30s in, even when the kubelet ticks
+    every second."""
+    clock, store, kubelet = _rig()
+    store.add_pod(mk_pod(
+        "slowprobe", node_name="n0",
+        liveness_probe=t.Probe(period_seconds=10.0, failure_threshold=3,
+                               fail_after_seconds=0.5),
+    ))
+    kubelet.tick()  # starts the container; probe #1 at t=0 succeeds
+    w = kubelet.workers["default/slowprobe"]
+    first = w.container_id
+    for _ in range(35):  # failures land at the period marks t=10, 20, 30
+        clock.step(1.0)
+        kubelet.tick()
+        if w.restarts:
+            break
+    assert clock.now() >= 30.0, f"killed too early at t={clock.now()}"
+    assert w.restarts == 1 and w.container_id != first
