@@ -1,0 +1,27 @@
+"""The one place that defeats sitecustomize's platform override.
+
+This machine's sitecustomize force-registers the TPU PJRT plugin and
+overwrites jax.config.jax_platforms at interpreter start, so the
+JAX_PLATFORMS env var ALONE is ignored — and with the tunnel down, first
+backend use hangs indefinitely instead of raising.  Every bench CLI calls
+this before its first jit; keeping the convention single-sourced means the
+next sitecustomize change is a one-file fix instead of a hunt for silently
+hanging benches.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_from_env(always: bool = False) -> bool:
+    """Re-assert the CPU platform IN-PROCESS when JAX_PLATFORMS=cpu is set
+    (or unconditionally with always=True).  Returns True if forced.  Must
+    run before first backend use; mutates os.environ and jax config."""
+    if not always and os.environ.get("JAX_PLATFORMS") != "cpu":
+        return False
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True

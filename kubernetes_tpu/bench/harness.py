@@ -78,11 +78,24 @@ def run_snapshot_workload(
 
 
 def _setup_cluster(snap: Snapshot, mode: str):
-    """Store + scheduler seeded from a snapshot (pod groups and pre-bound
-    pods included) — shared by the measure and churn ops."""
+    """Store + scheduler seeded from a snapshot (pod groups, pre-bound pods,
+    AND storage/DRA objects) — shared by the measure and churn ops.  The
+    storage seeding matters: without it Config4S's claimant pods resolve
+    their PVCs as missing (unsatisfiable) and the measured wall is
+    unschedulable-retry churn, not storage-path cost."""
     store = ClusterStore()
     for nd in snap.nodes:
         store.add_node(nd)
+    for sc in snap.storage_classes.values():
+        store.add_object("StorageClass", sc)
+    for pv in snap.pvs:
+        store.add_pv(pv)
+    for pvc in snap.pvcs.values():
+        store.add_pvc(pvc)
+    for sl in snap.resource_slices:
+        store.add_object("ResourceSlice", sl)
+    for dc in snap.device_classes.values():
+        store.add_object("DeviceClass", dc)
     sched = Scheduler(store, SchedulerConfiguration(mode=mode))
     for g, pg in snap.pod_groups.items():
         sched.cache.pod_groups[g] = pg

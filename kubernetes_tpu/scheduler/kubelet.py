@@ -526,6 +526,10 @@ class HollowKubelet:
             self._start_container(w)
             q = self._status_copy(w.pod)
             q.restart_count = w.restarts
+            # the replacement container has not passed its readiness probe:
+            # Ready drops NOW (the reference drops the condition on restart),
+            # not one tick later when the prober next runs
+            q.ready = w.pod.readiness_probe is None
             self.store.update_pod_status(q)
             return
         w.terminated = True

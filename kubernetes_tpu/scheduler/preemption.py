@@ -19,6 +19,7 @@ fingerprinted per call and invalidate the per-priority victim tables.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -79,6 +80,18 @@ class BatchedPreemption:
             for q in snap.bound_pods
         )
         self._level_cache: Dict[Tuple, Tuple] = {}
+        # wave state (evaluate-many batching): device stats for up to _WAVE
+        # same-priority preemptors computed against one state snapshot, plus
+        # the monotone log of node indices dirtied since — the sequential
+        # commit pass repairs exactly those nodes on host (phases A-C for a
+        # single node are O(V) numpy work)
+        self._pending: List[str] = []  # uids awaiting wave membership
+        self._pending_pods: Dict[str, t.Pod] = {}
+        self._waves: Dict[int, dict] = {}  # priority -> live wave
+        self._dirty_log: List[int] = []
+        self.wave_hits = 0  # evaluations served from a wave (tests/bench)
+        self.single_hits = 0
+        self._alloc_np: Optional[np.ndarray] = None
 
     # --- gate ---
     def applicable(self, pod: t.Pod) -> bool:
@@ -145,8 +158,240 @@ class BatchedPreemption:
             self._level_cache[key] = ent
         return ent
 
+    # --- evaluate-many batching (the preemptor axis) ---
+    # preemptors per device program ([K, N] stats ~ a few MB); 0 disables
+    # waves entirely (every evaluation single — the A/B baseline)
+    _WAVE = int(os.environ.get("KTPU_PREEMPT_WAVE", "64"))
+
+    def prefetch(self, pods: List[t.Pod]) -> None:
+        """Register the failure loop's upcoming preemptors so evaluate()
+        can serve them from batched waves.  Pods outside the gate, or
+        currently nominated (their self-exclusion from the nominated
+        reservation is per-preemptor — not wave-shareable), stay on the
+        single-pod path."""
+        if self._WAVE <= 0:
+            return  # waves disabled (A/B baseline)
+        for q in pods:
+            if self.applicable(q) and q.uid not in self.queue.nominated:
+                self._pending.append(q.uid)
+                self._pending_pods[q.uid] = q
+
+    def _nominated_raw(
+        self, priority: int, N: int, R: int, exclude_uid: Optional[str] = None
+    ):
+        """RAW (unscaled) nominated reservations per node for a preemptor of
+        this priority.  The ONE accumulation convention: sum raw int64
+        requests, then ceil-scale the SUM once — every consumer (wave
+        build, single eval, dirty-node repair) must scale identically or
+        wave-served and single-served decisions drift at scaled-unit
+        boundaries."""
+        nom_raw = np.zeros((N, R), dtype=np.int64)
+        has_nom = np.zeros(N, dtype=bool)
+        for uid, (q, node) in self.queue.nominated.items():
+            if uid == exclude_uid or q.priority < priority:
+                continue
+            i = self.node_idx.get(node)
+            if i is not None:
+                nom_raw[i] += np.array(
+                    pod_effective_requests(q, self.resources), dtype=np.int64
+                )
+                has_nom[i] = True
+        return nom_raw, has_nom
+
+    def _nominated_arrays(self, priority: int, N: int, R: int):
+        """Scaled nominated reservations for a wave of this priority (no
+        per-preemptor exclusion: nominated pods never join waves)."""
+        nom_raw, has_nom = self._nominated_raw(priority, N, R)
+        return (-(-nom_raw // self.scale)).astype(np.int32), has_nom
+
+    def _build_wave(self, first: t.Pod) -> None:
+        """One device program for the next _WAVE pending preemptors sharing
+        `first`'s priority, against the CURRENT state snapshot.  Keyed by
+        priority: interleaved priorities in the failure loop each keep
+        their own live wave instead of evicting each other's."""
+        from ..ops.preempt import preempt_eval_wave
+
+        prio = first.priority
+        members: List[t.Pod] = []
+        rest: List[str] = []
+        for uid in self._pending:
+            q = self._pending_pods.get(uid)
+            if q is None:
+                continue
+            if q.priority == prio and len(members) < self._WAVE:
+                members.append(q)
+            else:
+                rest.append(uid)
+        self._pending = rest
+        for q in members:
+            self._pending_pods.pop(q.uid, None)
+        fp, _ = self._pdb_fp()
+        ordered, vict_req, vict_prio, vict_viol, vict_valid = self._tables(
+            prio
+        )
+        N = self.arr.N
+        R = len(self.resources)
+        used_s = np.zeros((N, R), dtype=np.int32)
+        n = len(self.node_pods)
+        used_s[:n] = -(-self.used_raw // self.scale)
+        nom_s, has_nom = self._nominated_arrays(prio, N, R)
+        # pow2-bucket K (pad with row 0 repeats; padded outputs unread) so
+        # varying member counts reuse one jit trace per bucket instead of
+        # compiling a fresh [K, N] program per count — same convention as
+        # the snapshot encoder's shape buckets
+        K = len(members)
+        Kp = 1 << max(0, (K - 1).bit_length())
+        rows = [self.pod_row[q.name] for q in members]
+        idxs = np.array(rows + [rows[0]] * (Kp - K), dtype=np.int32)
+        out = preempt_eval_wave(
+            self.arr, idxs, used_s, nom_s, has_nom,
+            vict_req, vict_prio, vict_viol, vict_valid,
+        )
+        cand, nvio, vmax, vsum, vcnt, is_victim, static = (
+            np.asarray(x) for x in out
+        )
+        self._waves[prio] = {
+            "uid_to_i": {q.uid: i for i, q in enumerate(members)},
+            "fp": fp,
+            "mark": len(self._dirty_log),  # dirt before this = already seen
+            "cand": cand, "nvio": nvio, "vmax": vmax, "vsum": vsum,
+            "vcnt": vcnt, "is_victim": is_victim, "static": static,
+        }
+        if self._alloc_np is None:
+            self._alloc_np = np.asarray(self.arr.node_alloc)
+
+    def _host_node_stats(self, pod: t.Pod, static_ok: bool, n: int):
+        """Phases A-C for ONE node on host, against CURRENT state — the
+        exact repair for nodes dirtied after a wave's device snapshot.
+        Mirrors ops/preempt.py per slot: same reprieve order (the live
+        table row), same fit form (req <= alloc - used, zero-request
+        resources never block), same ok2 nominated re-check."""
+        ordered, *_ = self._tables(pod.priority)
+        row = ordered[n]
+        alloc = self._alloc_np[n].astype(np.int64)
+        used = -(-self.used_raw[n] // self.scale)
+        req = np.array(
+            pod_effective_requests(pod, self.resources), dtype=np.int64
+        )
+        req_s = -(-req // self.scale)
+        # same raw-sum-then-ceil convention as _nominated_arrays /
+        # _evaluate_single — per-pod ceils would over-reserve by up to one
+        # scaled unit per nominated pod and flip boundary decisions
+        nom_raw_row = np.zeros_like(used)
+        has_nom = False
+        for uid, (q, node) in self.queue.nominated.items():
+            if uid == pod.uid or q.priority < pod.priority:
+                continue
+            if self.node_idx.get(node) == n:
+                nom_raw_row += np.array(
+                    pod_effective_requests(q, self.resources), dtype=np.int64
+                )
+                has_nom = True
+        nom = -(-nom_raw_row // self.scale)
+
+        def fit(u):
+            return bool(np.all((req_s == 0) | (req_s <= alloc - u)))
+
+        vreqs = [
+            -(
+                -np.array(
+                    pod_effective_requests(q, self.resources), dtype=np.int64
+                )
+                // self.scale
+            )
+            for q, _ in row
+        ]
+        base = used + nom - (
+            np.sum(vreqs, axis=0) if vreqs else np.zeros_like(used)
+        )
+        okA = bool(static_ok) and fit(base)
+        used_cur = base
+        victims: List[Tuple[t.Pod, bool]] = []
+        for (q, viol), vr in zip(row, vreqs):
+            trial = used_cur + vr
+            if okA and fit(trial):
+                used_cur = trial  # reprieved
+            elif okA:
+                victims.append((q, viol))
+        vcnt = len(victims)
+        ok2 = fit(used_cur - nom) if (has_nom and vcnt > 0) else True
+        nvio = sum(1 for _, viol in victims if viol)
+        vmax = max(
+            (q.priority for q, _ in victims),
+            default=np.iinfo(np.int32).min,
+        )
+        vsum = sum(q.priority for q, _ in victims)
+        cand = okA and ok2 and vcnt > 0
+        return cand, nvio, vmax, vsum, vcnt, [q for q, _ in victims]
+
+    def note_nomination_cleared(self, pod: t.Pod) -> None:
+        """The failure loop is about to clear this pod's nomination: the
+        freed reservation changes later preemptors' view of that node."""
+        ent = self.queue.nominated.get(pod.uid)
+        if ent is not None:
+            i = self.node_idx.get(ent[1])
+            if i is not None:
+                self._dirty_log.append(i)
+
+    def _wave_decide(self, pod: t.Pod) -> Optional[Tuple[str, List[t.Pod]]]:
+        w = self._waves[pod.priority]
+        i = w["uid_to_i"][pod.uid]
+        dirty = sorted(set(self._dirty_log[w["mark"]:]))
+        over = {
+            n: self._host_node_stats(pod, w["static"][i, n], n)
+            for n in dirty
+        }
+        cand = w["cand"][i]
+        nvio, vmax, vsum, vcnt = (
+            w["nvio"][i], w["vmax"][i], w["vsum"][i], w["vcnt"][i]
+        )
+        if over:
+            cand, nvio, vmax, vsum, vcnt = (
+                a.copy() for a in (cand, nvio, vmax, vsum, vcnt)
+            )
+            for n, (c, nv, vm, vs, vc, _) in over.items():
+                cand[n], nvio[n], vmax[n], vsum[n], vcnt[n] = (
+                    c, nv, vm, vs, vc
+                )
+        if not cand.any():
+            return None
+        idx = np.flatnonzero(cand)
+        order = np.lexsort((idx, vcnt[idx], vsum[idx], vmax[idx], nvio[idx]))
+        best = int(idx[order[0]])
+        if best in over:
+            victims = over[best][5]
+        else:
+            ordered, *_ = self._tables(pod.priority)
+            victims = [
+                ordered[best][j][0]
+                for j in np.flatnonzero(w["is_victim"][i, best])
+            ]
+        return self.meta.node_names[best], victims
+
     # --- the evaluation (one failed pod) ---
     def evaluate(self, pod: t.Pod) -> Optional[Tuple[str, List[t.Pod]]]:
+        """Wave-served when the pod was prefetched (one device program per
+        _WAVE same-priority preemptors + exact host repair of dirtied
+        nodes); single device program otherwise.  Decisions identical
+        either way (tests/test_preemption_batched.py — wave cases)."""
+        w = self._waves.get(pod.priority)
+        if w is not None and self._pdb_fp()[0] != w["fp"]:
+            del self._waves[pod.priority]  # PDB moved: snapshot stale
+            w = None
+        if (
+            w is None or pod.uid not in w["uid_to_i"]
+        ) and pod.uid in self._pending_pods:
+            self._build_wave(pod)
+            w = self._waves.get(pod.priority)
+        if w is not None and pod.uid in w["uid_to_i"]:
+            self.wave_hits += 1
+            return self._wave_decide(pod)
+        self.single_hits += 1
+        return self._evaluate_single(pod)
+
+    def _evaluate_single(
+        self, pod: t.Pod
+    ) -> Optional[Tuple[str, List[t.Pod]]]:
         from ..ops.preempt import preempt_eval
 
         ordered, vict_req, vict_prio, vict_viol, vict_valid = self._tables(
@@ -157,17 +402,9 @@ class BatchedPreemption:
         used_s = np.zeros((N, R), dtype=np.int32)
         n = len(self.node_pods)
         used_s[:n] = -(-self.used_raw // self.scale)
-        nom_raw = np.zeros((N, R), dtype=np.int64)
-        has_nom = np.zeros(N, dtype=bool)
-        for uid, (q, node) in self.queue.nominated.items():
-            if uid == pod.uid or q.priority < pod.priority:
-                continue
-            i = self.node_idx.get(node)
-            if i is not None:
-                nom_raw[i] += np.array(
-                    pod_effective_requests(q, self.resources), dtype=np.int64
-                )
-                has_nom[i] = True
+        nom_raw, has_nom = self._nominated_raw(
+            pod.priority, N, R, exclude_uid=pod.uid
+        )
         nom_s = (-(-nom_raw // self.scale)).astype(np.int32)
         cand, nvio, vmax, vsum, vcnt, is_victim = (
             np.asarray(x)
@@ -196,6 +433,10 @@ class BatchedPreemption:
     # --- incremental state update after an eviction ---
     def apply_eviction(self, node_name: str, victims: List[t.Pod]) -> None:
         i = self.node_idx[node_name]
+        # waves built before this eviction repair this node from the log
+        # (the nomination that follows a successful preemption lands on the
+        # SAME node, so one entry covers both state changes)
+        self._dirty_log.append(i)
         gone = {q.uid for q in victims}
         self.node_pods[i] = [q for q in self.node_pods[i] if q.uid not in gone]
         for q in victims:

@@ -759,9 +759,21 @@ class Scheduler:
                     failed.append(pod)
                     result[pod.name] = None
             # failure path: preemption through the CPU PostFilter, then requeue.
-            # The what-if state is built once per batch (not per pod) and only
-            # rebuilt after an actual eviction; pods that cannot possibly preempt
-            # (no bound pod anywhere with lower priority) skip PostFilter outright.
+            # Three lazily-maintained pieces, each invalidated only by what
+            # actually stales it:
+            #   snap2          fresh resolved snapshot + bound-priority counts
+            #                  (None = rebuild); batched evictions update the
+            #                  counts INCREMENTALLY instead of re-resolving
+            #   state          the CPU PostFilter's what-if ScaledState — built
+            #                  only when a pod actually takes the CPU branch
+            #                  (node_infos + ScaledState are full-cluster scans:
+            #                  ~40 ms/rebuild at 2k nodes, and the batched path
+            #                  never reads them)
+            #   batched        ops/preempt.py evaluator with its own
+            #                  incremental ledger; dropped only when a CPU-path
+            #                  eviction happens outside that ledger
+            from collections import Counter
+
             state = None
             snap2 = None
             batched = None  # ops/preempt.py evaluator, shared across the loop
@@ -771,16 +783,18 @@ class Scheduler:
                 and self.features.enabled("DefaultPreemption")
             )
             min_bound_prio: Optional[int] = None
-            for pod in failed:
-                if state is None:
+            bound_prios: Counter = Counter()
+            for pod_i, pod in enumerate(failed):
+                if snap2 is None:
                     from ..api.volumes import resolve_snapshot
 
                     snap2 = resolve_snapshot(self.cache.update_snapshot())
-                    infos = self.cache.node_infos(snap2)
-                    state = CycleState()
-                    state.data["scaled"] = ScaledState(snap2, infos)
-                    min_bound_prio = min(
-                        (q.priority for q in snap2.bound_pods), default=None
+                    state = None  # what-if state pinned to the old snapshot
+                    bound_prios = Counter(
+                        q.priority for q in snap2.bound_pods
+                    )
+                    min_bound_prio = (
+                        min(bound_prios) if bound_prios else None
                     )
                     if use_batched and batched is None:
                         from .preemption import BatchedPreemption
@@ -788,8 +802,22 @@ class Scheduler:
                         batched = BatchedPreemption(
                             arr, meta, snap2, self.store, self.queue
                         )
+                        # evaluate-many: the rest of this failure loop is
+                        # known now — batch the gate-passing preemptors
+                        # into [K, N] device waves instead of one program
+                        # per pod (preemption.py — prefetch/evaluate).
+                        # Only the unprocessed suffix: a mid-loop rebuild
+                        # (after a CPU-path eviction) must not refill wave
+                        # slots with already-evaluated pods.
+                        if min_bound_prio is not None:
+                            batched.prefetch([
+                                q for q in failed[pod_i:]
+                                if q.priority > min_bound_prio
+                            ])
                 self.events.record("FailedScheduling", pod.uid)
                 if min_bound_prio is None or pod.priority <= min_bound_prio:
+                    if batched is not None:
+                        batched.note_nomination_cleared(pod)
                     self._clear_nomination(pod)
                 elif batched is not None and batched.applicable(pod):
                     # device-vectorized victim search (decision-identical to
@@ -799,22 +827,47 @@ class Scheduler:
                         node_name, victims = res
                         for q in victims:
                             self.store.delete_pod(q.uid)
+                            bound_prios[q.priority] -= 1
+                            if bound_prios[q.priority] <= 0:
+                                del bound_prios[q.priority]
+                        min_bound_prio = (
+                            min(bound_prios) if bound_prios else None
+                        )
                         self.metrics.inc("preemption_victims", len(victims))
                         batched.apply_eviction(node_name, victims)
                         self.events.record("Preempted", pod.uid, node=node_name)
+                        # a nomination carried from a prior cycle moves OFF
+                        # its old node here — that node's reservation changes
+                        # for later wave members too
+                        batched.note_nomination_cleared(pod)
                         self._nominate(pod, node_name)
-                        state = None  # CPU what-if state is stale now
+                        state = None  # CPU what-if (if built) is stale now
                     else:
+                        batched.note_nomination_cleared(pod)
                         self._clear_nomination(pod)
                 else:
+                    if state is None:
+                        # lazy CPU what-if: only pods outside the batched
+                        # gate pay for it.  Note snap2 may postdate batched
+                        # evictions only through the store (the cache saw
+                        # the deletions), so re-resolve for exactness.
+                        from ..api.volumes import resolve_snapshot
+
+                        snap2 = resolve_snapshot(self.cache.update_snapshot())
+                        infos = self.cache.node_infos(snap2)
+                        state = CycleState()
+                        state.data["scaled"] = ScaledState(snap2, infos)
                     nominated, pst = batch_fw.run_post_filters(state, snap2, pod, {})
                     if pst.ok and nominated:
                         self.events.record("Preempted", pod.uid, node=nominated)
                         self._nominate(pod, nominated)
                         state = None  # evictions changed the cluster: rebuild lazily
+                        snap2 = None
                         if batched is not None:
                             batched = None  # CPU path evicted outside our ledger
                     else:
+                        if batched is not None:
+                            batched.note_nomination_cleared(pod)
                         self._clear_nomination(pod)
                 self.queue.add_unschedulable(pod, backoff=True)
         return result, len(failed)

@@ -31,7 +31,14 @@ def _node_topology(node: t.Node) -> tuple:
 
 
 def _matches_node(topology: tuple, node: t.Node) -> bool:
-    return all(node.labels.get(k) == v for k, v in topology)
+    """Same semantics as volumes._topology_term's lowering: pairs sharing a
+    key OR their values (TopologySelectorTerm.matchLabelExpressions carries
+    values[] per key), distinct keys AND — a class allowing zone-0 OR
+    zone-1 must provision in either, not in the empty zone-0∧zone-1."""
+    by_key: dict = {}
+    for k, v in topology:
+        by_key.setdefault(k, set()).add(v)
+    return all(node.labels.get(k) in vs for k, vs in by_key.items())
 
 
 def bind_pod_volumes(store: ClusterStore, pod: t.Pod, node_name: str) -> Optional[str]:

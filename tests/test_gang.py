@@ -104,3 +104,36 @@ def test_gang_fixpoint_on_chunked_scan_matches_plain():
         first_g = pg[int(np.argmax(in_bad))]
         pod_valid = pod_valid & ~((pg == first_g) & pod_valid)
     np.testing.assert_array_equal(chunked, choices)
+
+
+def test_device_fixpoint_matches_host_loop():
+    """gang_fixpoint_device (the lax.while_loop fixpoint, one async
+    dispatch) must be bit-identical to the host revoke-one loop on
+    randomized gang workloads — the sidecar's config-5 overlap rests on
+    this parity."""
+    from kubernetes_tpu.ops.gang import gang_fixpoint_device
+
+    for seed in range(8):
+        rng = random.Random(seed)
+        nodes = [
+            mk_node(f"n{i}", cpu=rng.choice([1000, 2000, 4000]))
+            for i in range(rng.randint(2, 5))
+        ]
+        pods = []
+        for g in range(rng.randint(1, 4)):
+            size = rng.randint(2, 5)
+            for i in range(size):
+                pods.append(mk_pod(
+                    f"g{g}-{i}", cpu=rng.choice([300, 600, 900]),
+                    pod_group=f"grp{g}",
+                ))
+        for i in range(rng.randint(0, 3)):
+            pods.append(mk_pod(f"solo{i}", cpu=rng.choice([200, 500])))
+        snap = Snapshot(nodes=nodes, pending_pods=pods)
+        arr, meta = encode_snapshot(snap)
+        host_c, host_u = schedule_with_gangs(arr, DEFAULT_SCORE_CONFIG)
+        dev_c, dev_u = (np.asarray(x) for x in gang_fixpoint_device(
+            arr, DEFAULT_SCORE_CONFIG
+        ))
+        np.testing.assert_array_equal(host_c, dev_c, err_msg=f"seed {seed}")
+        np.testing.assert_array_equal(host_u, dev_u, err_msg=f"seed {seed}")

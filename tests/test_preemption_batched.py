@@ -124,3 +124,46 @@ def test_batched_preemption_actually_engages():
     arr, meta = sched._delta_enc.encode(snap2)
     bp = BatchedPreemption(arr, meta, snap2, store, sched.queue)
     assert bp.applicable(probe)
+
+
+def test_wave_path_serves_multiple_preemptors_and_repairs_dirty_nodes():
+    """evaluate-many: several same-priority preemptors are served from ONE
+    device wave; later members' decisions must account for earlier members'
+    evictions + nominations (host repair of dirtied nodes), giving exactly
+    the sequential single-eval decisions."""
+    from kubernetes_tpu.scheduler import preemption as pre_mod
+
+    instances = []
+    orig_init = pre_mod.BatchedPreemption.__init__
+
+    def spy_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        instances.append(self)
+
+    pre_mod.BatchedPreemption.__init__ = spy_init
+    try:
+        store = ClusterStore()
+        # 2 nodes, each full with one evictable low pod; 4 preemptors of
+        # one priority -> two preempt (one per node), the other two find
+        # nothing ONLY IF they see the earlier nominations (dirty repair)
+        for i in range(2):
+            store.add_node(mk_node(f"n{i}", cpu=2000, pods=8))
+            store.add_pod(mk_pod(f"low{i}", cpu=1800, node_name=f"n{i}"))
+        sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+        for k in range(4):
+            store.add_pod(mk_pod(f"hi{k}", cpu=1800, priority=50))
+        sched.run_until_idle()
+    finally:
+        pre_mod.BatchedPreemption.__init__ = orig_init
+    preempted = sorted(e.pod for e in sched.events.by_reason("Preempted"))
+    nominated = sorted(
+        p.uid for p in store.pods.values() if p.nominated_node_name
+    )
+    survivors = sorted(u for u in store.pods if u.startswith("default/low"))
+    assert len(preempted) == 2 and len(nominated) == 2
+    assert survivors == []
+    # the wave path really served every evaluation that ran (hi2/hi3
+    # short-circuit before evaluate(): after both evictions no bound pod
+    # outranks them, the loop's min_bound_prio gate); no silent fallback
+    assert sum(b.wave_hits for b in instances) >= 2
+    assert sum(b.single_hits for b in instances) == 0

@@ -5,6 +5,8 @@ restriction), resolved identically by every engine via api/volumes."""
 from kubernetes_tpu.api import types as t
 from helpers import mk_node, mk_pod
 
+GI = 1024 ** 3
+
 
 
 # ------------------------------------------------- ReadWriteOncePod (round 3)
@@ -117,3 +119,33 @@ def test_allowed_topology_values_or_within_key():
     assert set(expr.values) == {"zone-0", "zone-1"}
     got = dict(oracle_schedule(snap))
     assert got["p"] in ("n0", "n1")  # schedulable, zone-2 excluded
+
+
+def test_wffc_class_with_multiple_allowed_zones_provisions_in_any():
+    """AllowedTopologies pairs sharing a key OR their values (the
+    reference's TopologySelectorTerm.matchLabelExpressions.values[]): a
+    class allowing zone-0 OR zone-1 must provision on a node in either
+    zone.  Regression: _matches_node used to AND every pair, making any
+    multi-zone class unprovisionable anywhere."""
+    from kubernetes_tpu.api.cluster import StorageClass
+    from kubernetes_tpu.scheduler.store import ClusterStore
+    from kubernetes_tpu.scheduler.volumebinder import bind_pod_volumes
+
+    store = ClusterStore()
+    store.add_node(t.Node(name="n0", allocatable={t.CPU: 1000},
+                          labels={t.LABEL_ZONE: "zone-1"}))
+    store.add_object("StorageClass", StorageClass(
+        name="wffc", provisioner="csi.example.com",
+        volume_binding_mode="WaitForFirstConsumer",
+        allowed_topology=((t.LABEL_ZONE, "zone-0"), (t.LABEL_ZONE, "zone-1")),
+    ))
+    store.add_pvc(t.PersistentVolumeClaim(
+        name="data", request=GI, storage_class="wffc",
+        wait_for_first_consumer=True,
+    ))
+    pod = t.Pod(name="p", pvcs=("data",))
+    store.add_pod(pod)
+    err = bind_pod_volumes(store, pod, "n0")
+    assert err is None, err
+    pvc = store.pvcs["default/data"]
+    assert pvc.volume_name, "claim bound to a provisioned volume"
