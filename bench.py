@@ -35,8 +35,10 @@ import sys
 import time
 import traceback
 
-N_NODES = 20_000
-N_PODS = 50_000
+# KTPU_BENCH_* override the scale for smoke runs only — the driver always
+# runs the defaults (the artifact embeds the actual N/P via meta)
+N_NODES = int(os.environ.get("KTPU_BENCH_NODES", 20_000))
+N_PODS = int(os.environ.get("KTPU_BENCH_PODS", 50_000))
 # this repo's own CPU-mode throughput on the heterogeneous shape (see above)
 BASELINE_PODS_PER_SEC = 3.8
 REFERENCE_FOLKLORE_PODS_PER_SEC = 300.0
@@ -81,16 +83,20 @@ def _probe_backend(timeout_s: float = 45.0, retries: int = 3,
 def main() -> None:
     backend = _probe_backend()
     if not backend:
-        # labeled CPU-sim fallback: same workload, same JSON schema — the
-        # sitecustomize override requires BOTH the env var and the config
-        # update before first backend use
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        # labeled CPU-sim fallback (the one shared sitecustomize-defeating
+        # helper — bench/_cpu.py).  Also force the CHUNKED routing so the
+        # fallback validates the PRODUCTION TPU route (compile + decisions
+        # at full scale), not the plain scan that would never run on TPU
+        # (round-4 verdict weak #3); read at trace time, so setting it
+        # before the first jit call suffices.
+        from kubernetes_tpu.bench._cpu import force_cpu_from_env
+
+        force_cpu_from_env(always=True)
+        os.environ.setdefault("KTPU_FORCE_CHUNKED", "1")
         platform = "cpu-sim-fallback"
     import jax
 
-    if not backend:
-        jax.config.update("jax_platforms", "cpu")
-    else:
+    if backend:
         platform = backend
 
     from kubernetes_tpu.api.delta import DeltaEncoder
@@ -201,6 +207,8 @@ def main() -> None:
                 "metric": "north_star_50kpods_20knodes_throughput",
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
+                "n_nodes": N_NODES,
+                "n_pods": N_PODS,
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
                 "platform": platform,
                 "baseline_pods_per_sec": BASELINE_PODS_PER_SEC,
@@ -219,9 +227,18 @@ def main() -> None:
                 "cycles": [[round(d, 3), round(s, 3)] for d, s in cycles],
                 "end_to_end_pods_per_sec": round(e2e_pods_per_sec, 1),
                 "scheduled": scheduled,
+                # which kernel the routed call actually compiled (trace-time
+                # proof; the fallback must exercise the production route)
+                "route_trace_counts": dict(_trace_counts()),
             }
         )
     )
+
+
+def _trace_counts():
+    from kubernetes_tpu.ops.assign import TRACE_COUNTS
+
+    return TRACE_COUNTS
 
 
 if __name__ == "__main__":

@@ -56,6 +56,11 @@ class PerfData:
     batches: int = 1  # waves (batch-duration samples), NOT latency samples
     amortized_ms_per_pod: float = 0.0
     latency_source: str = "batch"
+    # error bar on per-pod-estimate latencies: the uniform-sweep assumption
+    # was calibrated against true cumulative wall at chunk-prefix
+    # boundaries (bench/latency_calibration.py, round 5: max |measured -
+    # estimated| wall fraction = 0.055 over 4 probes at config-3 scale)
+    latency_estimate_error: Optional[str] = None
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -135,6 +140,11 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
         batches=len(batch_hist.samples) if batch_hist else 0,
         amortized_ms_per_pod=round(wall * 1e3 / scheduled, 3) if scheduled else 0.0,
         latency_source=source,
+        latency_estimate_error=(
+            "±5.5% wall fraction (cpu-sim, config-3 scale, r05; re-measure"
+            " per backend/shape: bench/latency_calibration.py)"
+            if source == "per-pod-estimate" else None
+        ),
     )
 
 

@@ -1,0 +1,114 @@
+"""Calibrate the per-pod latency estimate against measured sweep boundaries.
+
+The harness derives per-pod scheduling latency from kernel COMMIT ORDINALS
+under a uniform-sweep assumption: pod i's decision became available
+~(ordinal_i + 1) / sweeps of the way through the kernel wall
+(ops/assign.py — schedule_batch_ordinals).  Rounds have unequal real costs
+(re-hoist vs commit-only), so the round-4 verdict (weak #6) asked for a
+device-timed spot check before quoting the estimated p99 against
+BASELINE.md.
+
+Method — zero kernel changes: chunk c's work depends only on pods before
+it (the outer lax.scan carries state forward), so running the SAME
+workload truncated to its first P' pods measures the true cumulative wall
+at that chunk boundary.  For a set of prefix fractions we compare:
+
+  measured fraction   warm wall(prefix) / warm wall(full)
+  estimated fraction  sweeps consumed by the prefix / total sweeps
+                      (from the full run's per-chunk rounds diagnostic)
+
+The max |measured - estimated| over the probes is the error bar to quote
+next to `latency_source: per-pod-estimate`.  Prefixes are chosen on
+bucket boundaries so padding adds no phantom chunks.
+
+Usage: python -m kubernetes_tpu.bench.latency_calibration [nodes] [pods]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+from ._cpu import force_cpu_from_env
+
+
+def _warm_wall(snap, kernel_fn, n_runs: int = 2):
+    """-> (best warm wall seconds, last run's outputs as numpy)."""
+    import numpy as np
+
+    from ..api.delta import DeltaEncoder
+    from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
+
+    arr, meta = DeltaEncoder().encode_device(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    out = kernel_fn(arr, cfg)
+    res = [np.asarray(x) for x in out]  # compile + first run
+    best = float("inf")
+    for _ in range(n_runs):
+        t0 = time.perf_counter()
+        res = [np.asarray(x) for x in kernel_fn(arr, cfg)]
+        best = min(best, time.perf_counter() - t0)
+    return best, res
+
+
+def main() -> None:
+    force_cpu_from_env()
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ..ops import assign
+    from .workloads import spread_affinity
+
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 5_000
+    n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 10_240
+
+    kernel = jax.jit(
+        partial(assign.schedule_scan_rounds, with_rounds=True),
+        static_argnames=("cfg",),
+    )
+    snap = spread_affinity(n_nodes, n_pods, seed=0)
+    full_wall, full_res = _warm_wall(snap, kernel)
+    rounds = np.asarray(full_res[2])  # per-chunk round counts
+    total_sweeps = int(rounds.sum())
+    C = assign._CHUNK
+
+    # prefix fractions on 2048-pod bucket boundaries (api/snapshot._bucket)
+    probes = []
+    for frac in (0.2, 0.4, 0.6, 0.8):
+        p_pref = max(2048, int(round(n_pods * frac / 2048)) * 2048)
+        if p_pref >= n_pods:
+            continue
+        pref_snap = dataclasses.replace(
+            snap, pending_pods=snap.pending_pods[:p_pref]
+        )
+        wall, _ = _warm_wall(pref_snap, kernel)
+        est = float(rounds[: p_pref // C].sum()) / total_sweeps
+        probes.append({
+            "prefix_pods": p_pref,
+            "measured_wall_s": round(wall, 2),
+            "measured_fraction": round(wall / full_wall, 4),
+            "estimated_fraction": round(est, 4),
+            "abs_error": round(abs(wall / full_wall - est), 4),
+        })
+
+    err = max((p["abs_error"] for p in probes), default=None)
+    print(json.dumps({
+        "metric": "latency_estimate_calibration",
+        "workload": f"spread_affinity {n_pods}x{n_nodes} (rounds kernel)",
+        "full_wall_s": round(full_wall, 2),
+        "total_sweeps": total_sweeps,
+        "probes": probes,
+        "max_abs_fraction_error": err,
+        "note": "uniform-sweep per-pod latency estimate vs true cumulative "
+                "wall at chunk-prefix boundaries; quote max_abs_fraction_"
+                "error as the error bar on per-pod-estimate latencies",
+    }))
+
+
+if __name__ == "__main__":
+    main()

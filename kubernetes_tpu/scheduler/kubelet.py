@@ -212,6 +212,65 @@ class ProbeManager:
         return kill, ready
 
 
+class VolumeManager:
+    """pkg/kubelet/volumemanager — the kubelet-side half of volume
+    lifecycle: desired state (the volumes of admitted pods) reconciled
+    against actual state (what is attached and mounted on THIS node).
+
+    The control-plane half is the AttachDetachController, which converges
+    NodeStatus.VolumesAttached; this manager's WaitForAttachAndMount
+    (volumemanager/volume_manager.go) blocks a pod's containers until
+    every PV its PVCs resolve to appears in that set, then records the
+    mount.  Unmount happens at pod teardown; detach is again the
+    controller's job once the last using pod leaves.  Hollow trade: mounts
+    are bookkeeping (no filesystem), matching FakeCRI's container trade."""
+
+    def __init__(self, store: ClusterStore, node_name: str):
+        self.store = store
+        self.node_name = node_name
+        self.mounted: Dict[str, Tuple[str, ...]] = {}  # pod uid -> PV names
+
+    def _resolve_pvs(self, pod: t.Pod) -> Optional[Tuple[str, ...]]:
+        """PV names behind the pod's PVCs, or None while any claim is
+        unbound (the volume binder / provisioner has not landed yet)."""
+        pvs = []
+        pv_by_claim = None
+        for claim in pod.pvcs:
+            key = f"{pod.namespace}/{claim}"
+            pvc = self.store.pvcs.get(key)
+            name = pvc.volume_name if pvc is not None else ""
+            if not name:
+                if pv_by_claim is None:
+                    pv_by_claim = {
+                        pv.claim_ref: pv.name
+                        for pv in self.store.pvs.values()
+                        if pv.claim_ref
+                    }
+                name = pv_by_claim.get(key, "")
+            if not name:
+                return None
+            pvs.append(name)
+        return tuple(pvs)
+
+    def wait_for_attach_and_mount(self, pod: t.Pod) -> bool:
+        """True once every volume is attached here AND recorded mounted —
+        the SyncPod gate (kubelet.go calls this before containers)."""
+        if not pod.pvcs:
+            return True
+        pvs = self._resolve_pvs(pod)
+        if pvs is None:
+            return False
+        node = self.store.nodes.get(self.node_name)
+        attached = set(node.volumes_attached) if node is not None else set()
+        if not all(pv in attached for pv in pvs):
+            return False
+        self.mounted[pod.uid] = pvs
+        return True
+
+    def unmount(self, pod_uid: str) -> None:
+        self.mounted.pop(pod_uid, None)
+
+
 class HollowKubelet:
     def __init__(
         self,
@@ -238,6 +297,7 @@ class HollowKubelet:
         self.images: "cri_mod.ImageService" = self.cri
         self.pleg = PLEG(self.runtime)
         self.prober = ProbeManager(self.runtime, self.clock)
+        self.volumemanager = VolumeManager(store, node_name)
         # cm/devicemanager analog: concrete device IDs per admitted pod,
         # checkpointed when a directory is given (restart-safe allocations)
         self.devices = DeviceManager(
@@ -326,6 +386,7 @@ class HollowKubelet:
         self.devices.free(w.pod.uid)
         self.cpumanager.free(w.pod.uid)
         self.prober.remove(w.pod.uid)
+        self.volumemanager.unmount(w.pod.uid)
 
     def _dispatch(self, pod: t.Pod, removed: bool) -> None:
         """UpdatePod (pod_workers.go): create/feed the pod's worker."""
@@ -465,6 +526,12 @@ class HollowKubelet:
         pod = w.pod
         if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
             w.terminated = True
+            return
+        # WaitForAttachAndMount gates SyncPod: containers must not start
+        # until the AttachDetach controller has attached every volume here
+        # (checked BEFORE device/cpu allocation so nothing is held while
+        # waiting; un-admitted workers retry next tick)
+        if not self.volumemanager.wait_for_attach_and_mount(pod):
             return
         if pod.resource_claims:
             from .devicemanager import AllocationError

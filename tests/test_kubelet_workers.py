@@ -299,3 +299,34 @@ def test_probe_thresholds_count_periods_not_ticks():
             break
     assert clock.now() >= 30.0, f"killed too early at t={clock.now()}"
     assert w.restarts == 1 and w.container_id != first
+
+
+def test_volume_manager_gates_start_on_attach():
+    """volumemanager WaitForAttachAndMount: a pod with a bound PVC does
+    not start containers until the AttachDetach controller attaches the
+    PV to this node; teardown unmounts and the controller then detaches."""
+    from kubernetes_tpu.scheduler.controllers import AttachDetachController
+
+    clock, store, kubelet = _rig()
+    store.add_pv(t.PersistentVolume(name="pv-1", capacity=1024**3,
+                                    storage_class="static",
+                                    claim_ref="default/data"))
+    store.add_pvc(t.PersistentVolumeClaim(name="data", request=1024**3,
+                                          storage_class="static",
+                                          volume_name="pv-1"))
+    p = mk_pod("dbpod", node_name="n0")
+    p.pvcs = ("data",)
+    store.add_pod(p)
+    ad = AttachDetachController(store)
+    kubelet.tick()  # volume not attached yet -> no containers
+    assert store.pods["default/dbpod"].phase != t.PHASE_RUNNING
+    assert not kubelet.runtime.list_containers()
+    ad.tick()  # controller attaches pv-1 to n0
+    assert "pv-1" in store.nodes["n0"].volumes_attached
+    kubelet.tick()  # gate passes: sandbox + container start, mount recorded
+    assert store.pods["default/dbpod"].phase == t.PHASE_RUNNING
+    assert kubelet.volumemanager.mounted["default/dbpod"] == ("pv-1",)
+    store.delete_pod("default/dbpod")
+    assert "default/dbpod" not in kubelet.volumemanager.mounted  # unmounted
+    ad.tick()  # last user gone -> detach
+    assert "pv-1" not in store.nodes["n0"].volumes_attached
