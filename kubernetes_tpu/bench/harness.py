@@ -343,6 +343,9 @@ ops:
 
 
 def main(argv=None) -> None:
+    from ._cpu import force_cpu_from_env
+
+    force_cpu_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", help="workload YAML file")
     ap.add_argument("--out", help="perfdata JSON output path")

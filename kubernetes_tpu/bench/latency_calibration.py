@@ -77,12 +77,16 @@ def main() -> None:
     total_sweeps = int(rounds.sum())
     C = assign._CHUNK
 
-    # prefix fractions on 2048-pod bucket boundaries (api/snapshot._bucket)
+    # prefix fractions on 2048-pod bucket boundaries (api/snapshot._bucket);
+    # dedup: at small n_pods several fractions round to the same boundary,
+    # and each probe costs a full compile + warm runs
     probes = []
+    seen = set()
     for frac in (0.2, 0.4, 0.6, 0.8):
         p_pref = max(2048, int(round(n_pods * frac / 2048)) * 2048)
-        if p_pref >= n_pods:
+        if p_pref >= n_pods or p_pref in seen:
             continue
+        seen.add(p_pref)
         pref_snap = dataclasses.replace(
             snap, pending_pods=snap.pending_pods[:p_pref]
         )

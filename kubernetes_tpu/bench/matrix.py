@@ -14,10 +14,13 @@ a downed tunnel hangs, it doesn't raise), then runs the full battery:
   preemption        preempt_bench 1k preemptors x 20k nodes
   sidecar_loopback  sidecar_bench warm waves (wire + session deltas)
 
-On the CPU fallback every scale is reduced and the artifact says so
-(platform: cpu-sim-fallback, scales embedded) — a labeled small number
-beats an empty file.  Writes ONE json file (default BENCH_MATRIX_rNN.json
-style path given by --out).
+On the CPU fallback the harness configs run at smoke scale and the
+pairwise rounds row at 10k x 5k, while the north-star, preemption,
+sidecar, and calibration rows run at FULL scale (round 5 made them
+affordable there); the artifact labels all of it (platform:
+cpu-sim-fallback, scales embedded) — a labeled number beats an empty
+file.  Writes ONE json file (default BENCH_MATRIX_rNN.json style path
+given by --out).
 
 Usage: python -m kubernetes_tpu.bench.matrix --out BENCH_MATRIX_r04.json
 """
@@ -118,6 +121,10 @@ def main() -> None:
     backend = bench_mod._probe_backend()
     platform = backend or "cpu-sim-fallback"
     env = dict(os.environ)
+    # a stray smoke-run scale override must not silently shrink a
+    # "full"-labeled artifact's north-star row
+    env.pop("KTPU_BENCH_NODES", None)
+    env.pop("KTPU_BENCH_PODS", None)
     if not backend:
         env["JAX_PLATFORMS"] = "cpu"
     tpu = bool(backend)
@@ -126,7 +133,13 @@ def main() -> None:
         "artifact": "builder-recorded benchmark matrix",
         "platform": platform,
         "recorded_unix": time.time(),
-        "scales": "full" if tpu else "reduced (cpu sim)",
+        # per-row truth on the cpu fallback: preemption/sidecar/calibration
+        # run at FULL scale there too (round 5); only the harness configs
+        # (smoke) and the pairwise rounds row stay reduced
+        "scales": "full" if tpu else (
+            "mixed (cpu sim): harness smoke + pairwise reduced; "
+            "north-star/preemption/sidecar/calibration full"
+        ),
     }
 
     here = os.getcwd()
@@ -193,11 +206,12 @@ def main() -> None:
     )
     result["latency_calibration"] = row or {"error": err}
 
-    # 5. sidecar loopback (wire + session deltas + bind compression)
+    # 5. sidecar loopback (wire + session deltas + bind compression) —
+    # FULL north-star scale on both backends (round 5 measured the cpu-sim
+    # 50k wave at ~60 s; 3 waves + warmup fit the timeout comfortably)
     if not args.skip_sidecar:
-        sn, sp = ("20000", "50000") if tpu else ("2000", "5000")
         row, dt, err = _run_json(
-            cli("kubernetes_tpu.bench.sidecar_bench", sn, sp, "3"),
+            cli("kubernetes_tpu.bench.sidecar_bench", "20000", "50000", "3"),
             timeout_s=2400, env=env,
         )
         result["sidecar_loopback"] = row or {"error": err}
