@@ -75,7 +75,7 @@ def main() -> None:
     full_wall, full_res = _warm_wall(snap, kernel)
     rounds = np.asarray(full_res[2])  # per-chunk round counts
     total_sweeps = int(rounds.sum())
-    C = assign._CHUNK
+    C = assign._RCHUNK  # the ROUNDS kernel's chunk size
 
     # prefix fractions on 2048-pod bucket boundaries (api/snapshot._bucket);
     # dedup: at small n_pods several fractions round to the same boundary,

@@ -120,11 +120,15 @@ def main() -> None:
 
     backend = bench_mod._probe_backend()
     platform = backend or "cpu-sim-fallback"
+    # stray sweep/smoke overrides must not silently change the scale or
+    # kernel shape of a "full"-labeled artifact — sanitize BOTH the
+    # subprocess env and this process's own (the in-process pairwise row
+    # and import-time kernel constants read os.environ directly)
+    for var in ("KTPU_BENCH_NODES", "KTPU_BENCH_PODS", "KTPU_CHUNK",
+                "KTPU_RCHUNK", "KTPU_REPAIR_ITERS", "KTPU_FORCE_CHUNKED",
+                "KTPU_PREEMPT_WAVE"):
+        os.environ.pop(var, None)
     env = dict(os.environ)
-    # a stray smoke-run scale override must not silently shrink a
-    # "full"-labeled artifact's north-star row
-    env.pop("KTPU_BENCH_NODES", None)
-    env.pop("KTPU_BENCH_PODS", None)
     if not backend:
         env["JAX_PLATFORMS"] = "cpu"
     tpu = bool(backend)

@@ -249,7 +249,23 @@ def schedule_scan(
     return choices, used_final
 
 
-_CHUNK = 128  # pods per chunk on the chunked path (buckets are multiples)
+# pods per chunk, PER KERNEL — the two chunked designs scale oppositely
+# with C (round-5 sweep, BENCH_ROUNDS_PROOF_r05.json chunk_sweep):
+#
+#   rounds kernel: total rounds barely grow as C shrinks (config-3:
+#   1400@128 -> 1710@16) while per-round re-hoist bytes scale ∝ C, so
+#   SMALL chunks win big — 55.2 s @128 vs 8.6 s @16 at config-3 scale,
+#   611 s vs 145 s at full north-star scale on the CPU sim, decisions
+#   bit-identical throughout.  16 ships.
+#   chunked (top-K) kernel: the hoist+top_k is amortized per chunk and
+#   the O(C) while-carry is already tiny, so smaller chunks just add
+#   outer scan steps — 22.1 s @128 vs 28.5 s @16 at north-star scale.
+#   128 stays.
+#
+# KTPU_CHUNK / KTPU_RCHUNK override for sweeps (import-time, like
+# KTPU_REPAIR_ITERS: fresh process per point).
+_CHUNK = int(os.environ.get("KTPU_CHUNK", "128"))
+_RCHUNK = int(os.environ.get("KTPU_RCHUNK", "16"))
 _SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
 _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 
@@ -630,7 +646,7 @@ def _rounds_capable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
     combination the per-pod scan does — it exists for the configs
     `_chunkable` excludes (pairwise/ports/taint-score/node-pref/image), so
     routing tries the cheaper fit-only chunked path first."""
-    return arr.P >= _CHUNK and arr.P % _CHUNK == 0
+    return arr.P >= _RCHUNK and arr.P % _RCHUNK == 0
 
 
 def _rounds_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
@@ -727,7 +743,7 @@ def schedule_scan_rounds(
     local_n = arr.N
     my_nodes = jnp.arange(local_n, dtype=jnp.int32)
     P, N, R = arr.P, arr.N, arr.R
-    C = _CHUNK
+    C = _RCHUNK
     res = cfg.score_resources
     neg_inf = -jnp.inf
     MAXS = MAX_NODE_SCORE

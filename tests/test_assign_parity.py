@@ -529,7 +529,7 @@ def test_rounds_diagnostic_and_forced_routing(monkeypatch):
     import jax
 
     from kubernetes_tpu.ops.assign import (
-        _CHUNK,
+        _RCHUNK,
         _rounds_routed,
         schedule_batch_impl,
         schedule_scan,
@@ -547,8 +547,8 @@ def test_rounds_diagnostic_and_forced_routing(monkeypatch):
         static_argnames=("cfg",),
     )
     choices, used, rounds = (np.asarray(x) for x in f(arr, cfg))
-    assert rounds.shape == (arr.P // _CHUNK,)
-    assert (rounds >= 1).all() and (rounds <= _CHUNK).all()
+    assert rounds.shape == (arr.P // _RCHUNK,)
+    assert (rounds >= 1).all() and (rounds <= _RCHUNK).all()
 
     monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
     assert _rounds_routed(arr, cfg)
