@@ -69,6 +69,7 @@ def _rounds_kernel_row(n_nodes, n_pods):
 
     from ..api.delta import DeltaEncoder
     from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
+    from ..ops import assign
     from ..ops.assign import schedule_scan, schedule_scan_rounds
     from .workloads import spread_affinity
 
@@ -106,6 +107,10 @@ def _rounds_kernel_row(n_nodes, n_pods):
         "rounds_per_chunk_max": int(rounds.max()),
         "decisions_bit_identical_to_plain_scan": True,
         "scheduled": int((ch[: meta.n_pods] >= 0).sum()),
+        "note": (
+            f"shipping kernel config: _RCHUNK={assign._RCHUNK}, "
+            f"_REPAIR_ITERS={assign._REPAIR_ITERS}"
+        ),
     }
 
 
@@ -167,14 +172,16 @@ def main() -> None:
     hcmd = cli("kubernetes_tpu.bench.harness", "--out", out_path)
     if tpu:
         hcmd.append("--full")
+    # the harness reports through --out (its stdout carries progress, not
+    # a final JSON line) — judge success by the file, not by stdout
     _, dt, err = _run_json(hcmd, timeout_s=3600, env=env)
-    if err:
-        result["baseline_configs"] = {"error": err}
-    else:
-        try:
-            result["baseline_configs"] = json.load(open(out_path))["perfdata"]
-        except Exception as e:  # noqa: BLE001
-            result["baseline_configs"] = {"error": repr(e)}
+    try:
+        result["baseline_configs"] = json.load(open(out_path))["perfdata"]
+    except Exception as e:  # noqa: BLE001
+        # the FILE is the contract: its read error is the informative one
+        # (err only says the harness's stdout carried no JSON line, which
+        # is true even on success)
+        result["baseline_configs"] = {"error": repr(e), "subprocess": err}
 
     # 3. pairwise at scale through the rounds kernel (in-process: needs the
     # decisions cross-check, not just a wall time)
@@ -218,6 +225,12 @@ def main() -> None:
             cli("kubernetes_tpu.bench.sidecar_bench", "20000", "50000", "3"),
             timeout_s=2400, env=env,
         )
+        if row:
+            row["note"] = (
+                "full north-star scale; host phases (decode/encode/"
+                "dispatch) exclude the device step — the <1 s TPU wave "
+                "rests on the step once their sum is under ~0.4 s"
+            )
         result["sidecar_loopback"] = row or {"error": err}
 
     with open(args.out, "w") as f:
