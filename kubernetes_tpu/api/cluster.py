@@ -473,6 +473,9 @@ class StorageClass:
     volume_binding_mode: str = "Immediate"  # or "WaitForFirstConsumer"
     # zone restriction applied to dynamically provisioned PVs
     allowed_topology: Tuple[Tuple[str, str], ...] = ()
+    # allowVolumeExpansion: bound claims may grow their request; the
+    # expand controller resizes the backing PV (pkg/controller/volume/expand)
+    allow_volume_expansion: bool = False
     uid: str = ""
 
     def __post_init__(self) -> None:

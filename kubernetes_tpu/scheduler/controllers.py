@@ -298,6 +298,37 @@ class JobController:
             self.sync(job)
 
 
+class ExpandController:
+    """pkg/controller/volume/expand — expand_controller.go: a BOUND claim
+    whose request grew past its volume's capacity is resized, provided its
+    StorageClass allows expansion (allowVolumeExpansion).  The reference
+    calls the CSI driver and leaves filesystem resize to the kubelet; the
+    hollow trade collapses both into the PV capacity update (copy-on-write
+    so watchers and the delta encoder see a fresh object).  Shrinking is
+    never performed — the reference rejects it at validation."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def tick(self) -> None:
+        classes = {
+            sc.name: sc
+            for sc in self.store.objects.get("StorageClass", {}).values()
+        }
+        for pvc in list(self.store.pvcs.values()):
+            if not pvc.volume_name:
+                continue
+            pv = self.store.pvs.get(pvc.volume_name)
+            if pv is None or pv.claim_ref != pvc.key:
+                continue  # not actually BOUND to this claim (phase gate)
+            if pvc.request <= pv.capacity:
+                continue
+            sc = classes.get(pvc.storage_class)
+            if sc is None or not sc.allow_volume_expansion:
+                continue
+            self.store.update_pv(replace(pv, capacity=pvc.request))
+
+
 class GarbageCollector:
     """garbagecollector/ — the dependency graph reduced to one cascading rule:
     an object whose controller ownerReference names a vanished uid is deleted.
@@ -951,6 +982,7 @@ class ControllerManager:
         self.podgc = PodGCController(store)
         self.ttl = TTLAfterFinishedController(store, clock=clock)
         self.attachdetach = AttachDetachController(store)
+        self.expand = ExpandController(store)
         self.resourceclaims = ResourceClaimController(store)
         self.certificates = CertificatesController(store, clock=clock)
         self.gc = GarbageCollector(store)
@@ -970,6 +1002,7 @@ class ControllerManager:
         self.podgc.tick()
         self.ttl.tick()
         self.attachdetach.tick()
+        self.expand.tick()
         self.resourceclaims.tick()
         self.certificates.tick()
         self.gc.tick()

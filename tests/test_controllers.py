@@ -314,3 +314,37 @@ def test_certificates_controller_approves_signs_and_cleans():
     ctrl.tick()
     assert store.get_object("CertificateSigningRequest", "node-n0-serving") is None
     assert store.get_object("CertificateSigningRequest", "rogue") is None
+
+
+def test_expand_controller_resizes_bound_claims():
+    """expand_controller.go: a bound claim whose request grew past its PV's
+    capacity is resized iff the class allows expansion; shrink never."""
+    from dataclasses import replace as dc_replace
+
+    from kubernetes_tpu.api import cluster as c
+    from kubernetes_tpu.scheduler.controllers import ExpandController
+
+    store = ClusterStore()
+    store.add_object("StorageClass", c.StorageClass(
+        name="fast", provisioner="csi.x", allow_volume_expansion=True))
+    store.add_object("StorageClass", c.StorageClass(
+        name="rigid", provisioner="csi.x"))
+    store.add_pv(t.PersistentVolume(name="pv-a", capacity=10,
+                                    storage_class="fast",
+                                    claim_ref="default/grow"))
+    store.add_pv(t.PersistentVolume(name="pv-b", capacity=10,
+                                    storage_class="rigid",
+                                    claim_ref="default/stuck"))
+    store.add_pvc(t.PersistentVolumeClaim(
+        name="grow", request=25, storage_class="fast", volume_name="pv-a"))
+    store.add_pvc(t.PersistentVolumeClaim(
+        name="stuck", request=25, storage_class="rigid", volume_name="pv-b"))
+    ctrl = ExpandController(store)
+    ctrl.tick()
+    assert store.pvs["pv-a"].capacity == 25  # expanded
+    assert store.pvs["pv-b"].capacity == 10  # class forbids expansion
+    # shrink request: never shrinks the volume
+    store.update_pvc(dc_replace(
+        store.pvcs["default/grow"], request=5))
+    ctrl.tick()
+    assert store.pvs["pv-a"].capacity == 25
