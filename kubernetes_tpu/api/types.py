@@ -309,7 +309,9 @@ class Probe:
 
     period_seconds: float = 10.0
     failure_threshold: int = 3
-    success_threshold: int = 1  # readiness only (liveness must be 1 upstream)
+    # readiness only: a liveness probe with success_threshold != 1 is
+    # rejected by Pod.__post_init__ (reference API validation)
+    success_threshold: int = 1
     initial_delay_seconds: float = 0.0
     fail_after_seconds: float = 0.0  # hollow outcome knob
 
@@ -374,14 +376,38 @@ class Pod:
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
     # status.conditions[Ready] — True when no readiness probe is configured
-    # (the reference defaults readiness true absent a probe); stamped False
-    # by the kubelet until the probe passes success_threshold times
+    # (the reference defaults readiness true absent a probe).  A pending pod
+    # WITH a readiness probe is forced False in __post_init__ (initial
+    # readiness is Failure) and stays False until the kubelet's prober has
+    # seen success_threshold consecutive passes
     ready: bool = True
     uid: str = ""
 
     def __post_init__(self) -> None:
         if not self.uid:
             self.uid = f"{self.namespace}/{self.name}"
+        # reference API validation (core/validation — validateLivenessProbe):
+        # a liveness probe's successThreshold must be 1; anything else is
+        # rejected at admission, so reject it at construction here
+        if (
+            self.liveness_probe is not None
+            and self.liveness_probe.success_threshold != 1
+        ):
+            raise ValueError(
+                "liveness probe success_threshold must be 1 "
+                f"(got {self.liveness_probe.success_threshold})"
+            )
+        # initial readiness is Failure under a readiness probe (the reference
+        # holds the Ready condition false from creation until the probe has
+        # passed success_threshold times) — without this a probed pod counts
+        # Ready between bind and its first kubelet sync.  Only stamped on
+        # still-pending pods: bound/running fixtures keep what they pass.
+        if (
+            self.readiness_probe is not None
+            and not self.node_name
+            and self.phase in ("", PHASE_PENDING)
+        ):
+            self.ready = False
         # Boundary normalization (the analog of apimachinery defaulting):
         # callers naturally pass lists / a dict nodeSelector; the encoder's
         # spec interner hashes these fields, so coerce them to the declared

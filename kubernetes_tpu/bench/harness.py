@@ -17,7 +17,9 @@ Generators live in bench/workloads.py (the performance-config.yaml analog).
 
 Usage: python -m kubernetes_tpu.bench.harness [--config FILE] [--out FILE]
        (no --config: runs the five BASELINE.md configs at reduced scale
-        unless --full is given).
+        unless --full is given).  --trace captures a span trace per round
+       and writes Perfetto-loadable JSON next to --out (--trace-device DIR
+       additionally records the jax.profiler device trace).
 """
 
 from __future__ import annotations
@@ -31,6 +33,7 @@ from typing import Dict, List, Optional
 
 from ..api.snapshot import Snapshot
 from ..scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from ..scheduler.tracing import TraceCollector, device_trace
 from . import workloads
 
 
@@ -67,22 +70,36 @@ class PerfData:
 
 
 def run_snapshot_workload(
-    name: str, snap: Snapshot, mode: str = "tpu", warmup: bool = True
+    name: str, snap: Snapshot, mode: str = "tpu", warmup: bool = True,
+    collector=None, device_trace_dir: Optional[str] = None,
 ) -> PerfData:
     """Measure one workload.  warmup=True first runs an identical throwaway
     scheduler so the timed run hits the XLA compile cache — scheduler_perf
-    likewise measures a long-lived scheduler, not binary start-up."""
+    likewise measures a long-lived scheduler, not binary start-up.
+
+    collector: a TraceCollector capturing the measured run's span trace
+    (the warmup run never traces); device_trace_dir additionally wraps the
+    run in the jax.profiler device trace (scheduler/tracing.py —
+    device_trace), pairing host spans with the XLA timeline."""
+    import contextlib
+
     if warmup and mode == "tpu":
         run_snapshot_workload(name, snap, mode, warmup=False)
-    sched = _setup_cluster(snap, mode)
+    sched = _setup_cluster(snap, mode, collector=collector)
 
+    cm = (
+        device_trace(device_trace_dir)
+        if device_trace_dir
+        else contextlib.nullcontext()
+    )
     t0 = time.perf_counter()
-    sched.run_until_idle()
+    with cm:
+        sched.run_until_idle()
     wall = time.perf_counter() - t0
     return _perfdata(name, snap, sched, len(snap.pending_pods), wall)
 
 
-def _setup_cluster(snap: Snapshot, mode: str):
+def _setup_cluster(snap: Snapshot, mode: str, collector=None):
     """Store + scheduler seeded from a snapshot (pod groups, pre-bound pods,
     AND storage/DRA objects) — shared by the measure and churn ops.  The
     storage seeding matters: without it Config4S's claimant pods resolve
@@ -101,7 +118,12 @@ def _setup_cluster(snap: Snapshot, mode: str):
         store.add_object("ResourceSlice", sl)
     for dc in snap.device_classes.values():
         store.add_object("DeviceClass", dc)
-    sched = Scheduler(store, SchedulerConfiguration(mode=mode))
+    # default: a disabled collector, so an untraced bench run pays zero span
+    # allocation (and never routes through the shared process collector)
+    if collector is None:
+        collector = TraceCollector(enabled=False)
+    sched = Scheduler(store, SchedulerConfiguration(mode=mode),
+                      collector=collector)
     for g, pg in snap.pod_groups.items():
         sched.cache.pod_groups[g] = pg
     for p in snap.pending_pods:
@@ -241,7 +263,11 @@ def run_churn_workload(
     return _perfdata(name, snap, sched, scheduled, wall)
 
 
-def run_yaml(text: str, mode: str = "tpu") -> List[PerfData]:
+def run_yaml(text: str, mode: str = "tpu", trace_base: Optional[str] = None,
+             device_trace_dir: Optional[str] = None) -> List[PerfData]:
+    """trace_base != None captures one span trace per measured round and
+    writes Perfetto-loadable JSON next to the perfdata artifact
+    (<trace_base>.<round name>.trace.json)."""
     import yaml
 
     results = []
@@ -256,11 +282,24 @@ def run_yaml(text: str, mode: str = "tpu") -> List[PerfData]:
                 snap = gen(**{k: v for k, v in op.items() if k not in ("op", "generator")})
             elif kind == "measure":
                 assert snap is not None, "createCluster must precede measure"
+                name = doc.get("name", "unnamed")
+                collector = TraceCollector() if trace_base else None
                 results.append(
                     run_snapshot_workload(
-                        doc.get("name", "unnamed"), snap, mode, warmup=op.get("warmup", True)
+                        name, snap, mode, warmup=op.get("warmup", True),
+                        collector=collector,
+                        device_trace_dir=(
+                            f"{device_trace_dir}/{name}" if device_trace_dir else None
+                        ),
                     )
                 )
+                if collector is not None:
+                    path = collector.export_chrome_trace(
+                        f"{trace_base}.{name}.trace.json"
+                    )
+                    print(f"trace: {path} "
+                          f"({len(collector.spans())} spans; open in Perfetto)",
+                          file=sys.stderr)
             elif kind == "churn":
                 assert snap is not None, "createCluster must precede churn"
                 results.append(
@@ -353,7 +392,16 @@ def main(argv=None) -> None:
     ap.add_argument("--full", action="store_true", help="run BASELINE configs at full scale")
     ap.add_argument("--stream", type=int, metavar="WAVES",
                     help="run the host<->device pipelining benchmark instead")
+    ap.add_argument("--trace", action="store_true",
+                    help="capture a span trace per bench round and write "
+                         "Perfetto JSON next to the --out artifact")
+    ap.add_argument("--trace-device", metavar="DIR",
+                    help="with --trace: also capture a jax.profiler device "
+                         "trace per round under DIR (TensorBoard format)")
     args = ap.parse_args(argv)
+    if args.trace_device and not args.trace:
+        ap.error("--trace-device requires --trace (the device trace pairs "
+                 "with the host-span trace)")
     if args.stream:
         waves = [
             workloads.heterogeneous(2000, 5000, seed=s) for s in range(args.stream)
@@ -365,7 +413,12 @@ def main(argv=None) -> None:
         text = open(args.config).read()
     else:
         text = BASELINE_CONFIGS if args.full else SMOKE_CONFIGS
-    results = run_yaml(text, args.mode)
+    # trace artifacts land NEXT TO the perfdata artifact (same stem)
+    trace_base = None
+    if args.trace:
+        trace_base = (args.out.rsplit(".json", 1)[0] if args.out else "BENCH")
+    results = run_yaml(text, args.mode, trace_base=trace_base,
+                       device_trace_dir=args.trace_device)
     data = [r.to_json() for r in results]
     for r in data:
         print(json.dumps(r), file=sys.stderr)

@@ -115,6 +115,20 @@ def _rounds_kernel_row(n_nodes, n_pods):
 
 
 def main() -> None:
+    # stray sweep/smoke overrides must not silently change the scale or
+    # kernel shape of a "full"-labeled artifact — sanitize FIRST, before ANY
+    # project import: kernel constants (ops/assign.py _RCHUNK etc.) read
+    # os.environ at import time, so a project import landing above this loop
+    # would bake the stray values in for the in-process pairwise row
+    for var in ("KTPU_BENCH_NODES", "KTPU_BENCH_PODS", "KTPU_CHUNK",
+                "KTPU_RCHUNK", "KTPU_REPAIR_ITERS", "KTPU_FORCE_CHUNKED",
+                "KTPU_PREEMPT_WAVE", "KTPU_PREEMPT_WAVE_BYTES"):
+        os.environ.pop(var, None)
+    assert "kubernetes_tpu.ops.assign" not in sys.modules, (
+        "kubernetes_tpu.ops.assign imported before env sanitation: its "
+        "import-time kernel constants may carry stray KTPU_* overrides"
+    )
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_MATRIX_r04.json")
     ap.add_argument("--skip-sidecar", action="store_true")
@@ -125,14 +139,6 @@ def main() -> None:
 
     backend = bench_mod._probe_backend()
     platform = backend or "cpu-sim-fallback"
-    # stray sweep/smoke overrides must not silently change the scale or
-    # kernel shape of a "full"-labeled artifact — sanitize BOTH the
-    # subprocess env and this process's own (the in-process pairwise row
-    # and import-time kernel constants read os.environ directly)
-    for var in ("KTPU_BENCH_NODES", "KTPU_BENCH_PODS", "KTPU_CHUNK",
-                "KTPU_RCHUNK", "KTPU_REPAIR_ITERS", "KTPU_FORCE_CHUNKED",
-                "KTPU_PREEMPT_WAVE"):
-        os.environ.pop(var, None)
     env = dict(os.environ)
     if not backend:
         env["JAX_PLATFORMS"] = "cpu"

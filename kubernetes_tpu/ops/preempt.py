@@ -123,7 +123,11 @@ def preempt_eval_wave(
     snapshot, in one device program: vmap over the preemptor axis only (the
     victim tables and usage are priority-shared, so everything else
     broadcasts and the per-node work batches [K, N] wide instead of looping
-    K host round-trips).  Returns [K, ...]-leading stats PLUS each
+    K host round-trips).  K is the CALLER'S responsibility to bound: the
+    program materializes ~K·N·V bytes of is_victim/slot-flag intermediates,
+    so the host caps K to a byte budget instead of a fixed count
+    (scheduler/preemption.py — _wave_cap, KTPU_PREEMPT_WAVE_BYTES).
+    Returns [K, ...]-leading stats PLUS each
     preemptor's static feasibility row — the host's sequential commit pass
     re-derives exact per-node stats for nodes dirtied by earlier commits
     (scheduler/preemption.py — _host_node_stats), and the static row is the

@@ -116,9 +116,17 @@ class APIServer:
         webhooks: tuple = (),
         total_concurrency: int = 600,
         queue_wait_s: float = 5.0,
+        tracer=None,
     ):
+        from .tracing import Tracer
+
         self.store = store
         self.queue_wait_s = queue_wait_s
+        # each handle() call is one traced request (the reference wraps the
+        # handler chain in an otelhttp span the same way); a created Pod
+        # inherits the request span as its trace root, so the pod's queue /
+        # scheduling / kubelet spans all join one tree
+        self.tracer = tracer or Tracer(component="apiserver")
         self.authn = authenticator or TokenAuthenticator()
         self.authz = RBACAuthorizer(store)
         self.apf = APFController(store, total_concurrency=total_concurrency)
@@ -143,6 +151,33 @@ class APIServer:
     ):
         """One request through the full chain.  Returns the stored object for
         writes / the object (list) for reads."""
+        if not self.tracer.enabled:
+            return self._handle(token, verb, kind, obj, namespace, name,
+                                impersonate_user)
+        with self.tracer.span(
+            "apiserver.request", parent=None, verb=verb, kind=kind
+        ) as sp:
+            out = self._handle(token, verb, kind, obj, namespace, name,
+                               impersonate_user)
+            if verb == "create" and kind == "Pod" and sp is not None:
+                uid = getattr(out, "uid", "")
+                if uid:
+                    # the request span becomes the pod's trace root: queue,
+                    # scheduler and kubelet spans chain under it
+                    sp.attributes["pod"] = uid
+                    self.tracer.collector.attach_pod(uid, sp.context)
+            return out
+
+    def _handle(
+        self,
+        token: Optional[str],
+        verb: str,
+        kind: str,
+        obj: object = None,
+        namespace: str = "",
+        name: str = "",
+        impersonate_user: Optional[str] = None,
+    ):
         resource = resource_of(kind)
         ns = namespace or getattr(obj, "namespace", "") or ""
         nm = name or getattr(obj, "name", "") or ""

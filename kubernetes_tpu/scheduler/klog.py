@@ -10,6 +10,12 @@ key-value pairs, never format strings — and the backend renders text
 Verbosity: entries at V(n) emit only when n <= the configured verbosity
 (klog's -v flag).  The default sink appends to an in-memory ring (tests,
 parity debugging); `to_stderr()`/`to_json_stderr()` stream instead.
+
+Trace-log correlation: an entry emitted inside an active tracing span
+(scheduler/tracing.py — the contextvar current span) carries that span's
+trace_id/span_id as trailing key-value pairs, the way the reference's
+otelhttp-instrumented handlers stamp log lines — so one pod's log entries
+join up with its span tree.
 """
 
 from __future__ import annotations
@@ -21,6 +27,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, Optional, Tuple
+
+from .tracing import current_span
 
 
 @dataclass(frozen=True)
@@ -82,7 +90,11 @@ class Logger:
         root = self._root
         if level > root.verbosity:
             return
-        e = Entry(time.time(), level, severity, msg, self._ctx + tuple(kv.items()))
+        pairs = self._ctx + tuple(kv.items())
+        sp = current_span()
+        if sp is not None:
+            pairs += (("trace_id", sp.trace_id), ("span_id", sp.span_id))
+        e = Entry(time.time(), level, severity, msg, pairs)
         with root._lock:
             root.ring.append(e)
             if root._sink is not None:

@@ -280,14 +280,19 @@ class HollowKubelet:
         clock: Optional[Clock] = None,
         pod_cidr_index: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
+        tracer=None,
     ):
         from .checkpoint import CheckpointManager
         from .devicemanager import DeviceManager
+        from .tracing import Tracer
 
         self.store = store
         self.leases = leases
         self.node_name = node_name
         self.clock = clock or leases.clock
+        # pod syncs join the pod's trace via the collector's pod-context
+        # table (component-base/tracing: the kubelet's syncPod spans)
+        self.tracer = tracer or Tracer(component="kubelet")
         self.workers: Dict[str, _PodWorker] = {}  # pod_workers.go map
         # the CRI boundary: everything container-shaped goes through these
         # two protocol objects (FakeCRI implements both — the kubemark
@@ -523,6 +528,18 @@ class HollowKubelet:
         self.runtime.start_container(w.container_id)
 
     def _sync_start(self, w: _PodWorker) -> None:
+        """Traced SyncPod entry: admission + volumes + sandbox + containers
+        under one kubelet.sync span chained onto the pod's trace."""
+        if not self.tracer.enabled:
+            return self._sync_start_inner(w)
+        with self.tracer.span_for_pod(
+            w.pod.uid, "kubelet.sync", pod=w.pod.uid, node=self.node_name
+        ) as sp:
+            self._sync_start_inner(w)
+            if sp is not None:
+                sp.attributes["admitted"] = w.admitted
+
+    def _sync_start_inner(self, w: _PodWorker) -> None:
         pod = w.pod
         if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
             w.terminated = True
@@ -579,6 +596,15 @@ class HollowKubelet:
         the next attempt), else the pod goes Failed; a clean exit is the
         hollow Job contract (run_seconds elapsed: the workload is DONE) and
         terminates Succeeded."""
+        if self.tracer.enabled:
+            with self.tracer.span_for_pod(
+                w.pod.uid, "kubelet.sync_died", pod=w.pod.uid,
+                node=self.node_name,
+            ):
+                return self._sync_died_inner(w)
+        return self._sync_died_inner(w)
+
+    def _sync_died_inner(self, w: _PodWorker) -> None:
         try:
             status = self.runtime.container_status(w.container_id)
         except CRIError:

@@ -37,9 +37,12 @@ class _Hist:
             return s[min(len(s) - 1, int(q * len(s)))]
 
 
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
 class Metrics:
-    """One instance per scheduler; label-free simple registry + optional
-    Prometheus mirroring."""
+    """One instance per scheduler; simple registry (plus labeled-histogram
+    series) + optional Prometheus mirroring."""
 
     def __init__(self, prometheus: bool = False):
         # counters/gauges are bumped from binding-cycle worker threads too
@@ -47,6 +50,10 @@ class Metrics:
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = defaultdict(float)
         self.hists: Dict[str, _Hist] = defaultdict(_Hist)
+        # labeled histogram series: name -> {sorted (k, v) label pairs -> _Hist}
+        # (framework_extension_point_duration_seconds{extension_point, plugin}
+        # — metrics.go declares it with exactly these labels)
+        self.labeled_hists: Dict[str, Dict[LabelKey, _Hist]] = {}
         self._prom = {}
         if prometheus and _PROM:  # pragma: no cover - optional path
             self._prom = {
@@ -73,17 +80,49 @@ class Metrics:
         if p is not None:
             p.set(v)
 
+    def labeled_hist(self, name: str, **labels: str) -> _Hist:
+        """The histogram for one label combination, created on first use —
+        callers on hot paths cache the returned _Hist so repeat observations
+        skip the registry lock entirely."""
+        key: LabelKey = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            series = self.labeled_hists.setdefault(name, {})
+            h = series.get(key)
+            if h is None:
+                h = series[key] = _Hist()
+            return h
+
+    def observe_labeled(self, name: str, v: float, **labels: str) -> None:
+        self.labeled_hist(name, **labels).observe(v)
+
+    @staticmethod
+    def render_labels(key: LabelKey) -> str:
+        """Prometheus exposition form for a label key:
+        {extension_point="Filter",plugin="NodeResourcesFit"}."""
+        return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
     def snapshot(self):
         """Consistent copies for scrapers: (counters, gauges,
-        {hist: (p50, p99, count)})."""
+        {hist: (p50, p99, count)}).  Labeled series appear in the hist dict
+        under their Prometheus-rendered name —
+        name{label="value",...} — one entry per label combination."""
         with self._lock:
             counters = dict(self.counters)
             gauges = dict(self.gauges)
             hists = dict(self.hists)
-        return counters, gauges, {
+            labeled = {
+                name: dict(series) for name, series in self.labeled_hists.items()
+            }
+        out_hists = {
             name: (h.quantile(0.5), h.quantile(0.99), len(h.samples))
             for name, h in hists.items()
         }
+        for name, series in labeled.items():
+            for key, h in series.items():
+                out_hists[name + self.render_labels(key)] = (
+                    h.quantile(0.5), h.quantile(0.99), len(h.samples)
+                )
+        return counters, gauges, out_hists
 
     def observe(self, name: str, v: float) -> None:
         # called from binding-cycle worker threads: the defaultdict __missing__

@@ -159,9 +159,24 @@ class BatchedPreemption:
         return ent
 
     # --- evaluate-many batching (the preemptor axis) ---
-    # preemptors per device program ([K, N] stats ~ a few MB); 0 disables
-    # waves entirely (every evaluation single — the A/B baseline)
+    # max preemptors per device program; 0 disables waves entirely (every
+    # evaluation single — the A/B baseline).  The EFFECTIVE K additionally
+    # scales down with the victim-table size so the wave's intermediates
+    # stay under _WAVE_BYTES (see _wave_cap) — a fixed 64 at 20k nodes with
+    # dense victim tables would materialize hundreds of MB per program
     _WAVE = int(os.environ.get("KTPU_PREEMPT_WAVE", "64"))
+    # byte budget for one wave's [K, N, V]-shaped intermediates (is_victim
+    # + the scan's per-slot flags dominate; stats rows are [K, N] noise)
+    _WAVE_BYTES = int(
+        os.environ.get("KTPU_PREEMPT_WAVE_BYTES", str(256 * 1024 * 1024))
+    )
+
+    def _wave_cap(self, V: int) -> int:
+        """Preemptors per wave so K·N·V stays under the byte budget:
+        ~2 bytes per (K, N, V) cell (bool is_victim + scan slot flags) plus
+        the [K, N] int32 stat rows."""
+        per_k = 2 * self.arr.N * max(1, V) + 32 * self.arr.N
+        return max(1, min(self._WAVE, self._WAVE_BYTES // per_k))
 
     def prefetch(self, pods: List[t.Pod]) -> None:
         """Register the failure loop's upcoming preemptors so evaluate()
@@ -212,23 +227,24 @@ class BatchedPreemption:
         from ..ops.preempt import preempt_eval_wave
 
         prio = first.priority
+        fp, _ = self._pdb_fp()
+        ordered, vict_req, vict_prio, vict_viol, vict_valid = self._tables(
+            prio
+        )
+        k_cap = self._wave_cap(vict_valid.shape[1])
         members: List[t.Pod] = []
         rest: List[str] = []
         for uid in self._pending:
             q = self._pending_pods.get(uid)
             if q is None:
                 continue
-            if q.priority == prio and len(members) < self._WAVE:
+            if q.priority == prio and len(members) < k_cap:
                 members.append(q)
             else:
                 rest.append(uid)
         self._pending = rest
         for q in members:
             self._pending_pods.pop(q.uid, None)
-        fp, _ = self._pdb_fp()
-        ordered, vict_req, vict_prio, vict_viol, vict_valid = self._tables(
-            prio
-        )
         N = self.arr.N
         R = len(self.resources)
         used_s = np.zeros((N, R), dtype=np.int32)

@@ -80,9 +80,15 @@ class PriorityQueue:
     the scheduling thread's pop (the reference's queue takes its own lock —
     scheduling_queue.go guards activeQ/backoffQ with sync.Cond)."""
 
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(self, clock: Optional[Clock] = None, tracer=None):
         self._lock = threading.RLock()
         self.clock = clock or Clock()
+        # queue-wait spans (enqueue -> pop) per pod, joining the pod's trace
+        # (scheduler/tracing.py); timestamps are real perf_counter values —
+        # span time is wall attribution, independent of the injectable
+        # backoff clock
+        self._tracer = tracer
+        self._enq_at: Dict[str, float] = {}
         self._seq = itertools.count()
         self._active: List[_Item] = []  # heap
         self._active_uids: Set[str] = set()
@@ -139,6 +145,10 @@ class PriorityQueue:
         self._no_flush.discard(pod.uid)
         heapq.heappush(self._active, _Item(self._key(pod), pod))
         self._active_uids.add(pod.uid)
+        if self._tracer is not None and self._tracer.enabled:
+            # first activation wins: a superseding re-add keeps the original
+            # enqueue instant (the wait the pod actually experienced)
+            self._enq_at.setdefault(pod.uid, _time.perf_counter())
 
     def _flush_backoff(self) -> None:
         now = self.clock.now()
@@ -179,6 +189,17 @@ class PriorityQueue:
             if item.pod.uid in self._active_uids:
                 self._active_uids.discard(item.pod.uid)
                 self._attempts[item.pod.uid] = self._attempts.get(item.pod.uid, 0) + 1
+                tr = self._tracer
+                if tr is not None and tr.enabled:
+                    t0 = self._enq_at.pop(item.pod.uid, None)
+                    if t0 is not None:
+                        # enqueue -> pop as a finished span on the pod's
+                        # trace chain (attempt = retry ordinal)
+                        tr.record_span(
+                            "queue.wait", start=t0, pod_uid=item.pod.uid,
+                            pod=item.pod.uid,
+                            attempt=self._attempts[item.pod.uid],
+                        )
                 return item.pod
         return None
 
@@ -268,6 +289,7 @@ class PriorityQueue:
     @_locked
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)
+        self._enq_at.pop(pod_uid, None)
         self._unschedulable.pop(pod_uid, None)
         self._parked_at.pop(pod_uid, None)
         self._no_flush.discard(pod_uid)

@@ -63,12 +63,24 @@ class Scheduler:
         config: SchedulerConfiguration = SchedulerConfiguration(),
         clock: Optional[Clock] = None,
         logger=None,
+        collector=None,
     ):
+        from .tracing import TraceCollector, Tracer, default_collector
+
         self.store = store
         self.config = config
         self.features = FeatureGates(config.feature_gates)
         self.cache = SchedulerCache(store)
-        self.queue = PriorityQueue(clock)
+        # span tracing: callers may inject a TraceCollector (bench rounds use
+        # a fresh one per run; pass TraceCollector(enabled=False) to opt out
+        # of all span allocation); default = the process-wide collector
+        self.collector: TraceCollector = (
+            collector if collector is not None else default_collector()
+        )
+        self.tracer = Tracer(self.collector, component="scheduler")
+        self.queue = PriorityQueue(
+            clock, tracer=Tracer(self.collector, component="queue")
+        )
         self.metrics = Metrics()
         self.events = EventRecorder(store=store)
         from .klog import Logger
@@ -115,7 +127,9 @@ class Scheduler:
                     extenders=self.extenders,
                     fit_strategy=p.fit_strategy,
                     rtcr_shape=p.rtcr_shape,
-                )
+                ),
+                tracer=self.tracer,
+                metrics=self.metrics,
             )
             for p in config.profiles
         }
@@ -169,6 +183,10 @@ class Scheduler:
             pod = ev.obj
             if ev.kind == "Deleted":
                 self.queue.delete(pod.uid)
+                # a recreated pod reuses the namespace/name-derived uid: drop
+                # the trace context so its spans start a FRESH trace instead
+                # of chaining into the dead predecessor's
+                self.collector.detach_pod(pod.uid)
                 # a gang member deleted while Permit-waiting must release its
                 # assumption and stop counting toward quorum
                 if pod.pod_group:
@@ -266,7 +284,12 @@ class Scheduler:
     def _find_feasible(self, state, snap, pod, infos):
         """Rotating-cursor filter fan-out with early stop at
         numFeasibleNodesToFind (the adaptive-sampling half of D3; the batch
-        path always scores everything)."""
+        path always scores everything).  Traced runs flush ONE aggregate
+        Filter/<plugin> span per cycle from the accumulator run_filters
+        fills (per-(node, plugin) spans would flood the ring; see
+        framework.run_filters)."""
+        tracing = self.tracer.enabled
+        t_f0 = time.perf_counter() if tracing else 0.0
         n = len(infos)
         want = self._num_feasible_nodes_to_find(
             n, pod.scheduler_name or self.default_profile_name
@@ -288,6 +311,19 @@ class Scheduler:
         if n:
             self._next_start_node_index = (start + processed) % n
         feasible.sort()  # deterministic tie-break stays index-ordered
+        if tracing:
+            # aggregate spans tile sequentially from the fan-out start: the
+            # sum of per-plugin filter time, one span per plugin per cycle
+            agg = state.data.pop("_filter_trace", None)
+            if agg:
+                off = t_f0
+                for plugin_name, (dt, calls) in agg.items():
+                    self.tracer.record_span(
+                        f"Filter/{plugin_name}", start=off, end=off + dt,
+                        extension_point="Filter", plugin=plugin_name,
+                        nodes=calls,
+                    )
+                    off += dt
         return feasible, statuses
 
     def _extender_filter(self, pod, infos, feasible, statuses):
@@ -333,6 +369,20 @@ class Scheduler:
 
     # --- the CPU scheduling cycle (ScheduleOne) ---
     def schedule_one(self, pod: t.Pod) -> Optional[str]:
+        """One pod through the plugin framework, wrapped in a
+        scheduling.cycle span chained onto the pod's trace (queue-wait span
+        -> this -> binding.cycle -> kubelet sync)."""
+        if not self.tracer.enabled:
+            return self._schedule_one_cycle(pod)
+        with self.tracer.span_for_pod(
+            pod.uid, "scheduling.cycle", pod=pod.uid
+        ) as sp:
+            node = self._schedule_one_cycle(pod)
+            if sp is not None:
+                sp.attributes["node"] = node or ""
+            return node
+
+    def _schedule_one_cycle(self, pod: t.Pod) -> Optional[str]:
         from ..api.volumes import resolve_snapshot
 
         t0 = time.perf_counter()
@@ -470,7 +520,18 @@ class Scheduler:
 
     def _binding_cycle(self, state, snap, pod, node_name, t0) -> Optional[str]:
         """PreBind -> Bind -> PostBind (+ extender binder precedence); failure
-        forgets the assumption and requeues — schedule_one.go's bindingCycle."""
+        forgets the assumption and requeues — schedule_one.go's bindingCycle.
+        Traced as binding.cycle under the pod's context — the explicit
+        pod-attached parent, NOT the contextvar, because this often runs on a
+        binding-pool worker thread where no scheduling span is active."""
+        if not self.tracer.enabled:
+            return self._binding_cycle_inner(state, snap, pod, node_name, t0)
+        with self.tracer.span_for_pod(
+            pod.uid, "binding.cycle", pod=pod.uid, node=node_name
+        ):
+            return self._binding_cycle_inner(state, snap, pod, node_name, t0)
+
+    def _binding_cycle_inner(self, state, snap, pod, node_name, t0) -> Optional[str]:
         fw = self._fw(pod) or self.framework
         st = fw.run_pre_bind(state, snap, pod, node_name)
         if st.ok:
@@ -553,6 +614,12 @@ class Scheduler:
         batch: List[t.Pod] = self.queue.pop_all()
         if not batch:
             return {}
+        with self.tracer.span("batch.cycle", pods=len(batch)):
+            return self._schedule_batch_traced(batch, t0)
+
+    def _schedule_batch_traced(
+        self, batch: List[t.Pod], t0: float
+    ) -> Dict[str, Optional[str]]:
         names = [p.scheduler_name or self.default_profile_name for p in batch]
         gang_profile: Dict[str, str] = {}
         if self.features.enabled("GangScheduling"):
@@ -700,29 +767,33 @@ class Scheduler:
                 self._delta_enc = DeltaEncoder(
                     hard_pod_affinity_weight=base_cfg.hard_pod_affinity_weight
                 )
-            arr, meta = self._delta_enc.encode(snap)
+            with self.tracer.span("batch.encode", profile=profile_name):
+                arr, meta = self._delta_enc.encode(snap)
             cfg = infer_score_config(arr, base_cfg)
             ords = sweeps = None
-            t_k0 = time.perf_counter()
-            if self.config.mode == "native":
-                from ..native import schedule_batch_native, schedule_with_gangs_native
+            with self.tracer.span(
+                "batch.kernel", profile=profile_name, mode=self.config.mode
+            ):
+                t_k0 = time.perf_counter()
+                if self.config.mode == "native":
+                    from ..native import schedule_batch_native, schedule_with_gangs_native
 
-                fn = schedule_with_gangs_native if gang else schedule_batch_native
-                choices = fn(arr, cfg)[0]
-                if not gang:
-                    # the C++ engine commits strictly in pod order: the
-                    # ordinal IS the index, and every pod is one sweep
-                    ords = np.arange(meta.n_pods, dtype=np.int64)
-                    sweeps = meta.n_pods
-            elif gang:
-                choices, _, ords, sweeps = schedule_with_gangs(
-                    arr, cfg, with_ordinals=True
-                )
-            else:
-                from ..ops import schedule_batch_ordinals as kernel
+                    fn = schedule_with_gangs_native if gang else schedule_batch_native
+                    choices = fn(arr, cfg)[0]
+                    if not gang:
+                        # the C++ engine commits strictly in pod order: the
+                        # ordinal IS the index, and every pod is one sweep
+                        ords = np.arange(meta.n_pods, dtype=np.int64)
+                        sweeps = meta.n_pods
+                elif gang:
+                    choices, _, ords, sweeps = schedule_with_gangs(
+                        arr, cfg, with_ordinals=True
+                    )
+                else:
+                    from ..ops import schedule_batch_ordinals as kernel
 
-                choices, _, ords, sweeps = kernel(arr, cfg)
-                choices = np.asarray(choices)
+                    choices, _, ords, sweeps = kernel(arr, cfg)
+                    choices = np.asarray(choices)
             if ords is not None:
                 self._observe_wave_latency(
                     np.asarray(ords)[: meta.n_pods],
@@ -738,7 +809,9 @@ class Scheduler:
             }
         result: Dict[str, Optional[str]] = {}
         failed: List[t.Pod] = []
-        with self._coalesced_moves():
+        # bind fan-out + the preemption failure loop = the cycle's commit step
+        with self.tracer.span("batch.commit", profile=profile_name), \
+                self._coalesced_moves():
             for pod in snap.pending_pods:
                 node_name = verdicts.get(pod.uid)
                 if node_name and pod.pvcs:
@@ -751,7 +824,15 @@ class Scheduler:
                         node_name = None
                 if node_name:
                     self.cache.assume(pod.uid, node_name)
+                    t_b0 = time.perf_counter()
                     self.store.bind(pod.uid, node_name)
+                    if self.tracer.enabled:
+                        # instant per-pod bind mark on the pod's own trace
+                        # chain (the batch verdict crossing back to ONE pod)
+                        self.tracer.record_span(
+                            "bind", start=t_b0, pod_uid=pod.uid,
+                            pod=pod.uid, node=node_name,
+                        )
                     self.queue.delete_nominated(pod.uid)
                     self.events.record("Scheduled", pod.uid, node=node_name)
                     result[pod.name] = node_name
