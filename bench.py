@@ -102,14 +102,25 @@ def main() -> None:
     from kubernetes_tpu.api.delta import DeltaEncoder
     from kubernetes_tpu.api.snapshot import Snapshot
     from kubernetes_tpu.bench.workloads import heterogeneous
-    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config, schedule_batch
+    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+    from kubernetes_tpu.ops.aot import maybe_enable_compile_cache
+    from kubernetes_tpu.ops.assign import (
+        donation_supported,
+        schedule_batch_routed,
+    )
 
+    # persistent XLA compile cache (KTPU_COMPILE_CACHE_DIR): the first
+    # process pays the cold compile; every later one loads the executable
+    cache_dir = maybe_enable_compile_cache()
+    don = donation_supported()
     print(f"platform: {platform}  devices: {jax.devices()}", file=sys.stderr)
+    if cache_dir:
+        print(f"compile cache: {cache_dir}", file=sys.stderr)
     snap = heterogeneous(N_NODES, N_PODS, seed=0)
     enc = DeltaEncoder()
 
     t0 = time.perf_counter()
-    arr, meta = enc.encode_device(snap)
+    arr, meta = enc.encode(snap)
     t_encode = time.perf_counter() - t0
     cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
     print(f"encode (cold full): {t_encode:.3f}s  N={arr.N} P={arr.P} R={arr.R}",
@@ -117,17 +128,21 @@ def main() -> None:
 
     import numpy as np
 
-    # warmup / compile.  NOTE: block_until_ready can return early through the
+    # warmup / compile through the ROUTED kernel (donating where the backend
+    # honors it — the same variant the pipelined loop runs, so only one
+    # executable compiles in-process).  Inputs stay host numpy: the jit call
+    # transfers fresh device buffers per step, which is what makes donation
+    # safe here.  NOTE: block_until_ready can return early through the
     # axon TPU tunnel, so timing forces a (tiny) host transfer of the choices
     # vector — which is also what a real sidecar client would consume.
     t0 = time.perf_counter()
-    choices = np.asarray(schedule_batch(arr, cfg)[0])
+    choices = np.asarray(schedule_batch_routed(arr, cfg, donate=don)[0])
     print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     t_step = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        choices = np.asarray(schedule_batch(arr, cfg)[0])
+        choices = np.asarray(schedule_batch_routed(arr, cfg, donate=don)[0])
         t_step = min(t_step, time.perf_counter() - t0)
 
     # the pre-chunking per-pod scan, for the delta the chunked path buys
@@ -148,56 +163,90 @@ def main() -> None:
         print(f"per-pod (unchunked) scan step: {t_plain*1e3:.1f}ms",
               file=sys.stderr)
 
-    # warm-cluster steady state, THREE full cycles: each cycle the previous
-    # wave's pods are bound, the wave before THAT completes (its bound pods
-    # leave the cluster — sustainable forever, like real churn), and a fresh
-    # 50k wave arrives.  Every cycle therefore absorbs ~50k binds + ~50k
-    # deletes through the resident encoder and re-runs the device step —
-    # median over cycles is the honest steady-state number (the round-2
-    # verdict flagged the previous single-sample measurement).
-    def place(prev_snap, prev_meta, prev_choices):
-        return [
-            dataclasses.replace(p, node_name=prev_meta.node_names[int(c)])
-            for p, c in zip(
-                (prev_snap.pending_pods[i] for i in prev_meta.pod_perm),
-                prev_choices[: prev_meta.n_pods],
-            )
-            if int(c) >= 0
-        ]
+    # warm-cluster steady state, PIPELINED (parallel/pipeline.py —
+    # PipelinedBatchLoop): each cycle the previous fetched wave's pods are
+    # bound, the wave before THAT completes (its bound pods leave the
+    # cluster — sustainable forever, like real churn), and a fresh 50k wave
+    # arrives.  Every cycle absorbs ~50k binds + ~50k deletes through the
+    # resident encoder, and the delta-encode of wave w+1 runs WHILE wave
+    # w's device step executes — the measured cycle wall is the step alone
+    # once the pipeline fills.  The feedback runs with the pipeline's
+    # one-wave lag (wave w binds wave w-2's placements); KTPU_PIPELINE=0
+    # (the same switch the scheduler and harness --no-pipeline honor)
+    # replays the IDENTICAL dataflow serially (depth 0) for comparison, so
+    # decisions are bit-identical between the two (the parity tests pin
+    # this at smoke scale).
+    from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop
 
-    cycles = []
-    prev = (snap, meta, choices)
-    for w in range(2, 5):
-        bound = place(*prev)  # previous wave bound; earlier waves completed
-        wave = [
+    pipeline = os.environ.get("KTPU_PIPELINE") != "0"
+    loop = PipelinedBatchLoop(
+        encoder=enc, donate=don, depth=1 if pipeline else 0
+    )
+
+    def mk_wave(w):
+        return [
             dataclasses.replace(p, name=f"w{w}-{p.name}", uid="")
             for p in snap.pending_pods
         ]
-        snapw = Snapshot(nodes=snap.nodes, pending_pods=wave, bound_pods=bound)
-        t0 = time.perf_counter()
-        arrw, metaw = enc.encode_device(snapw)
-        t_delta = time.perf_counter() - t0
-        assert enc.stats["delta"] >= w - 1, f"delta path did not engage: {enc.stats}"
-        t0 = time.perf_counter()
-        choicesw = np.asarray(schedule_batch(arrw, cfg)[0])
-        t_stepw = time.perf_counter() - t0
-        cycles.append((t_delta, t_stepw))
-        prev = (snapw, metaw, choicesw)
+
+    def place(pods, verdicts):
+        return [
+            dataclasses.replace(p, node_name=verdicts[p.name])
+            for p in pods
+            if verdicts.get(p.name)
+        ]
+
+    wave_pods = {1: snap.pending_pods}
+    fetched = {
+        1: {
+            meta.pod_names[k]: (
+                meta.node_names[int(choices[k])]
+                if int(choices[k]) >= 0 else None
+            )
+            for k in range(meta.n_pods)
+        }
+    }
+    walls = []
+    last_w = 7
+    t_mark = time.perf_counter()
+    for w in range(2, last_w + 1):
+        src = w - 2 if w - 2 in fetched else max(fetched)
+        snapw = Snapshot(
+            nodes=snap.nodes,
+            pending_pods=mk_wave(w),
+            bound_pods=place(wave_pods[src], fetched[src]),
+        )
+        wave_pods[w] = snapw.pending_pods
+        v = loop.submit(snapw)
+        # full cycle wall, mark to mark: the submit (encode + fetch of the
+        # previous step) PLUS the caller-side feedback work — nothing is
+        # excluded, so the median is an honest end-to-end number
+        now = time.perf_counter()
+        walls.append(now - t_mark)
+        t_mark = now
+        if v is not None:
+            fetched[w - 1] = v
+    fetched[last_w] = loop.drain()
+    assert enc.stats["delta"] >= 3, f"delta path did not engage: {enc.stats}"
 
     scheduled = int((choices[: meta.n_pods] >= 0).sum())
-    e2es = sorted(d + s for d, s in cycles)
+    # steady-state cycles: submit walls once the pipeline is full (each
+    # spans one device step + any UNHIDDEN host work)
+    steady = walls[2:]
+    e2es = sorted(steady)
     end_to_end = e2es[len(e2es) // 2]  # median cycle
-    t_delta = sorted(d for d, _ in cycles)[len(cycles) // 2]
-    t_step2 = sorted(s for _, s in cycles)[len(cycles) // 2]
+    overlap_fraction = loop.overlap_fraction()
+    t_delta = loop.host_seconds["encode"][0] / max(1, len(walls))
     pods_per_sec = meta.n_pods / t_step
     e2e_pods_per_sec = meta.n_pods / end_to_end
     print(
         f"step: {t_step*1e3:.1f}ms  scheduled {scheduled}/{meta.n_pods}\n"
-        f"warm cycles (delta_s, step_s): "
-        + ", ".join(f"({d:.3f}, {s:.3f})" for d, s in cycles)
-        + f"\nsteady state (median): delta-encode {t_delta*1e3:.1f}ms + step "
-        f"{t_step2*1e3:.1f}ms; end-to-end median {end_to_end*1e3:.1f}ms, "
-        f"worst {e2es[-1]*1e3:.1f}ms "
+        f"warm cycle walls: "
+        + ", ".join(f"{s:.3f}" for s in walls)
+        + f"\nsteady state ({'pipelined' if pipeline else 'serial'}): "
+        f"mean host encode+dispatch {t_delta*1e3:.1f}ms "
+        f"(overlap fraction {overlap_fraction:.2f}); end-to-end median "
+        f"{end_to_end*1e3:.1f}ms, worst {e2es[-1]*1e3:.1f}ms "
         f"({'PASS' if end_to_end < 1.0 else 'FAIL'} <1s north star)",
         file=sys.stderr,
     )
@@ -224,9 +273,15 @@ def main() -> None:
                 ),
                 "end_to_end_s": round(end_to_end, 3),
                 "end_to_end_worst_s": round(e2es[-1], 3),
-                "cycles": [[round(d, 3), round(s, 3)] for d, s in cycles],
+                "cycles": [round(s, 3) for s in walls],
                 "end_to_end_pods_per_sec": round(e2e_pods_per_sec, 1),
                 "scheduled": scheduled,
+                # the pipelined loop's self-report: fraction of host
+                # encode/commit/decode hidden under in-flight device steps
+                "pipeline": pipeline,
+                "overlap_fraction": round(overlap_fraction, 3),
+                "donated_waves": int(loop.stats["donated"]),
+                "compile_cache_dir": cache_dir,
                 # which kernel the routed call actually compiled (trace-time
                 # proof; the fallback must exercise the production route)
                 "route_trace_counts": dict(_trace_counts()),

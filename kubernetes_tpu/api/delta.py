@@ -814,12 +814,21 @@ class DeltaEncoder:
 
         self._interner = SpecInterner()
 
-    def encode_device(self, snap):
+    def encode_device(self, snap, fresh: bool = False):
         """encode(), with the ClusterArrays placed on device — fields whose
         host array is IDENTICAL (by object) to the previous cycle's reuse the
         resident device buffer, so a warm cluster re-transfers only the wave's
-        pod-side arrays and the delta-touched cluster state."""
-        return self._to_device(*self.encode(snap))
+        pod-side arrays and the delta-touched cluster state.
+
+        fresh=True transfers EVERY field anew and records nothing in the
+        resident-buffer table — the donation-safe mode: a donated call
+        invalidates its input buffers, so a resident buffer handed to a
+        donating kernel would poison every later cycle that reuses it
+        (ops/assign.py — schedule_batch_donated).  Fresh transfers are what
+        makes the pipeline's two in-flight generations true double
+        buffering: slot i's (donated) arrays live on device while slot i+1
+        uploads."""
+        return self._to_device(*self.encode(snap), fresh=fresh)
 
     def encode_device_pregrouped(
         self, nodes, bound_pods, pod_groups, uids, reps, inv
@@ -832,7 +841,21 @@ class DeltaEncoder:
             )
         )
 
-    def _to_device(self, arr, meta):
+    def to_device(self, arr, meta, fresh: bool = False):
+        """Public device placement for callers that need the HOST arrays
+        first (e.g. infer_score_config inspects concrete numpy before the
+        transfer): encode() -> inspect -> to_device().  Same resident-buffer
+        reuse as encode_device(); fresh=True is the donation-safe mode."""
+        return self._to_device(arr, meta, fresh=fresh)
+
+    def drop_device_buffers(self) -> None:
+        """Forget every resident device buffer (next encode re-transfers).
+        Callers that mix donated and non-donated cycles MUST call this after
+        a donated call that consumed resident buffers; the pipeline loop
+        avoids the problem entirely with encode_device(fresh=True)."""
+        self._dev.clear()
+
+    def _to_device(self, arr, meta, fresh: bool = False):
         import dataclasses as _dc
 
         import jax
@@ -840,6 +863,9 @@ class DeltaEncoder:
         out = {}
         for f in _dc.fields(type(arr)):
             a = getattr(arr, f.name)
+            if fresh:
+                out[f.name] = jax.device_put(a)
+                continue
             ent = self._dev.get(f.name)
             if ent is not None and (
                 ent[0] is a

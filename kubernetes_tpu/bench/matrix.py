@@ -144,6 +144,27 @@ def main() -> None:
         env["JAX_PLATFORMS"] = "cpu"
     tpu = bool(backend)
 
+    # ONE persistent XLA compile cache shared by every row's subprocess
+    # (KTPU_COMPILE_CACHE_DIR; ops/aot.py): the rows repeat the same
+    # kernel shapes, so only the first process pays each cold compile —
+    # the rest load the executable from disk.  Per-user path: a shared
+    # /tmp dir owned by another user would silently fail every cache
+    # write (JAX downgrades those to warnings) and recompile each row.
+    import getpass
+    import tempfile
+
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):  # unmapped uid in a container: no passwd entry
+        user = str(os.getuid())
+    env.setdefault(
+        "KTPU_COMPILE_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), f"ktpu-xla-cache-{user}"),
+    )
+    os.environ.setdefault(
+        "KTPU_COMPILE_CACHE_DIR", env["KTPU_COMPILE_CACHE_DIR"]
+    )
+
     result = {
         "artifact": "builder-recorded benchmark matrix",
         "platform": platform,
@@ -199,6 +220,10 @@ def main() -> None:
         pw_nodes, pw_pods = 5_000, 10_240
     else:
         pw_nodes, pw_pods = 20_000, 50_000
+    # the in-process pairwise row shares the subprocess rows' disk cache
+    from ..ops.aot import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     try:
         result["pairwise_north_star_scale"] = _rounds_kernel_row(
             pw_nodes, pw_pods

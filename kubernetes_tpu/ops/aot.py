@@ -1,0 +1,125 @@
+"""Persistent compilation cache + AOT warmup — killing the cold compile.
+
+BENCH_r05 pays a 26.5 s XLA compile in EVERY process that touches the
+north-star shape, because the jit cache dies with the process.  Two fixes
+compose here:
+
+  * `maybe_enable_compile_cache()` turns on JAX's persistent compilation
+    cache (`jax.config.jax_compilation_cache_dir`) when
+    ``KTPU_COMPILE_CACHE_DIR`` is set (or a path is passed explicitly).
+    The first process to compile a (shape, config) writes the serialized
+    executable; every later process — bench rounds, sidecar restarts,
+    scheduler processes — loads it in seconds instead of recompiling.
+    Thresholds are zeroed so the CPU sim caches too (the default config
+    skips sub-second compiles, which would silently exclude smoke shapes
+    from tests).
+  * `warm_kernels()` is the explicit AOT path: ``kernel.lower(arr,
+    cfg).compile()`` for the shapes a process is about to serve.  With the
+    persistent cache enabled the compile both lands on disk and seeds this
+    process's XLA cache, so the first REAL wave pays tracing only — warmup
+    no longer needs a throwaway full run.
+
+Both are wired into bench/harness.py, bench/matrix.py, bench.py and
+scheduler/scheduler.py (mode="tpu").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_enabled_dir: Optional[str] = None
+
+
+def maybe_enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Enable the persistent compilation cache at `path` (default: the
+    ``KTPU_COMPILE_CACHE_DIR`` env var).  Returns the active cache dir, or
+    None when no path is configured.  Idempotent; safe to call from every
+    entry point — first caller wins, later conflicting paths raise (two
+    halves of one process silently writing different caches would make
+    "second process hits the cache" unfalsifiable)."""
+    global _enabled_dir
+    path = path or os.environ.get("KTPU_COMPILE_CACHE_DIR")
+    if not path:
+        return _enabled_dir
+    if _enabled_dir is not None:
+        if os.path.abspath(path) != _enabled_dir:
+            raise ValueError(
+                f"compile cache already enabled at {_enabled_dir!r}; "
+                f"refusing to rebind to {path!r}"
+            )
+        return _enabled_dir
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache EVERYTHING: the defaults skip fast/small compiles, which would
+    # exclude the smoke shapes tests assert on (and the CPU sim's smaller
+    # programs) — the north-star entry is minutes either way
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled_dir = path
+    return path
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The active persistent-cache dir, or None."""
+    return _enabled_dir
+
+
+def warm_kernels(
+    arr, cfg, *, gang: bool = False, ordinals: bool = True, batch: bool = True
+) -> int:
+    """AOT-compile the batch kernels for `arr`'s exact shape via
+    ``lower().compile()`` — the explicit warmup path.  Returns the number
+    of kernels compiled.  With the persistent cache enabled the
+    executables land on disk, so a later process's first real call is a
+    cache-hit load, not a recompile.
+
+    Warms the VARIANTS the runtime actually routes — the donated kernels
+    where the backend honors donation (the cache key includes the aliasing
+    config, so warming the wrong variant saves nothing): the pipelined
+    loop's schedule_batch (`batch`; pass False for callers that only drive
+    the scheduler cycle — on TPU this kernel's compile is the minutes-class
+    cost, so never pay it for an executable that won't run), the scheduler
+    cycle's schedule_batch_ordinals (`ordinals`), and with `gang` the
+    non-donating ordinals kernel the gang fixpoint re-invokes per iteration
+    (ops/gang.py — schedule_with_gangs; donation is unsound there, the
+    fixpoint re-reads its inputs)."""
+    from .assign import (
+        donation_supported,
+        schedule_batch,
+        schedule_batch_donated,
+        schedule_batch_ordinals,
+        schedule_batch_ordinals_donated,
+    )
+
+    import warnings
+
+    donate = donation_supported()
+    n = 0
+    with warnings.catch_warnings():
+        # expected on the donated variants: most inputs cannot alias the
+        # two outputs (they still free early) — same policy as the routed
+        # call wrappers in ops/assign.py
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        if batch:
+            (schedule_batch_donated if donate else schedule_batch).lower(
+                arr, cfg
+            ).compile()
+            n += 1
+        if ordinals:
+            (
+                schedule_batch_ordinals_donated if donate
+                else schedule_batch_ordinals
+            ).lower(arr, cfg).compile()
+            n += 1
+        if gang and (donate or not ordinals):
+            # not already covered above: the gang fixpoint always takes the
+            # non-donating ordinals kernel
+            schedule_batch_ordinals.lower(arr, cfg).compile()
+            n += 1
+    return n

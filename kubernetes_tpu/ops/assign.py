@@ -1205,6 +1205,75 @@ def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array
 
 schedule_batch = partial(jax.jit, static_argnames=("cfg",))(schedule_batch_impl)
 
+# Donating variants: the step's input device buffers are handed to XLA, so
+# node_used [N, R] aliases used_final in place and the [P, N]-scale inputs
+# free as soon as the program's last read of them retires — the step's
+# intermediates stop DOUBLING peak device memory.  The contract is strict:
+# a donated buffer must never be re-read by host code afterwards (the
+# encoder's resident-buffer reuse is fundamentally incompatible — callers
+# must pair donation with fresh per-wave transfers; api/delta.py —
+# encode_device(fresh=True), asserted by tests/test_pipeline_parity.py).
+schedule_batch_donated = partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(0,)
+)(schedule_batch_impl)
+
+
+def donation_supported() -> bool:
+    """Whether the donating kernels should route on this backend.
+
+    KTPU_DONATE=1 forces donation, =0 disables it; default = donate on
+    accelerator backends that actually honor it (probed ONCE by donating a
+    scratch buffer and checking it was invalidated — backends that merely
+    warn and keep the buffer gain nothing, and backends that raise are
+    caught the same way, so both take the non-donating fallback).  The CPU
+    sim is excluded by default even though its runtime honors donation:
+    with no separate device memory there is nothing to save, and the
+    donation-induced fresh transfers measurably slow the 2-core fallback —
+    KTPU_DONATE=1 still forces it there (the parity/safety tests do)."""
+    ov = os.environ.get("KTPU_DONATE", "")
+    if ov == "1":
+        return True
+    if ov == "0":
+        return False
+    if jax.default_backend() == "cpu":
+        return False
+    global _DONATION_PROBED
+    if _DONATION_PROBED is None:
+        try:
+            import warnings
+
+            x = jax.device_put(jnp.zeros((2, 2), dtype=jnp.int32))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                jax.jit(lambda a: a + 1, donate_argnums=(0,))(
+                    x
+                ).block_until_ready()
+            _DONATION_PROBED = bool(x.is_deleted())
+        except Exception:  # noqa: BLE001 — a rejecting backend = fallback
+            _DONATION_PROBED = False
+    return _DONATION_PROBED
+
+
+_DONATION_PROBED: Optional[bool] = None
+
+
+def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool):
+    """schedule_batch with donation routed per call.  `donate` is the
+    caller's RESOLVED decision (resolve defaults with donation_supported();
+    an explicit True forces the donating kernel — tests do, even on the CPU
+    sim).  The "donated buffers were not usable" warning is expected noise
+    on this kernel (most inputs cannot alias the two outputs; donation
+    still frees them early) and is suppressed here only."""
+    if donate:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return schedule_batch_donated(arr, cfg)
+    return schedule_batch(arr, cfg)
+
 
 def schedule_batch_ordinals_impl(arr: ClusterArrays, cfg: ScoreConfig):
     """schedule_batch + (per-pod COMMIT ORDINAL i32[P], total sweeps i32):
@@ -1226,3 +1295,22 @@ def schedule_batch_ordinals_impl(arr: ClusterArrays, cfg: ScoreConfig):
 schedule_batch_ordinals = partial(jax.jit, static_argnames=("cfg",))(
     schedule_batch_ordinals_impl
 )
+
+schedule_batch_ordinals_donated = partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(0,)
+)(schedule_batch_ordinals_impl)
+
+
+def schedule_batch_ordinals_routed(arr, cfg: ScoreConfig, donate: bool):
+    """schedule_batch_ordinals with the same donation routing + warning
+    policy as schedule_batch_routed (`donate` = the caller's resolved
+    decision)."""
+    if donate:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return schedule_batch_ordinals_donated(arr, cfg)
+    return schedule_batch_ordinals(arr, cfg)

@@ -1,3 +1,3 @@
 from .mesh import make_mesh  # noqa: F401
-from .pipeline import PipelinedRunner, run_serial  # noqa: F401
+from .pipeline import PipelinedBatchLoop, PipelinedRunner, run_serial  # noqa: F401
 from .sharded import sharded_schedule_batch  # noqa: F401

@@ -1,48 +1,296 @@
-"""Host↔device pipelining — the pipeline-parallelism analog.
+"""Pipelined batch-scheduling cycles — host↔device overlap, the PP analog.
 
 SURVEY.md §2.4: the reference has no PP; its counterpart here is overlapping
-host work (snapshot encode + H2D transfer of batch k+1) with device compute
-(the filter/score/commit program still running on batch k), exactly how the
-reference's binding goroutine overlaps the next pod's scheduling cycle
-(schedule_one.go: bindingCycle runs async under the next schedulingCycle).
+host work (snapshot delta-encode + H2D transfer of wave k+1, plus the
+bind/commit fan-out of wave k−1) with device compute (the filter/score/commit
+program still running on wave k), exactly how the reference's binding
+goroutine overlaps the next pod's scheduling cycle (schedule_one.go:
+bindingCycle runs async under the next schedulingCycle).
 
 JAX dispatch is asynchronous: `schedule_batch` returns device futures
-immediately, so the pipeline is expressed with ordinary control flow — encode
-batch k+1 while batch k's program runs, then block on k's (tiny) choices
-vector.  Two device programs are never enqueued back-to-back for the same
-buffer, so this is classic double-buffering with depth 1.
+immediately, so the pipeline is expressed with ordinary control flow.  The
+core is `PipelinedBatchLoop`, a depth-1 double-buffered submit/collect loop:
 
-Use `PipelinedRunner` for streams of INDEPENDENT snapshots (separate virtual
-clusters, sidecar request streams, replayed scheduler_perf waves).  When wave
-k+1's pending set depends on wave k's placements (the sequential-commit
-semantics across waves), the dependency forbids overlap — the scheduler's
-in-wave `lax.scan` already covers that case on-device.
+    loop = PipelinedBatchLoop()
+    prev = loop.submit(wave_1)          # None (nothing in flight yet)
+    prev = loop.submit(wave_2)          # wave_1's verdicts; wave_2 runs
+    ...                                 # ... while the caller consumes them
+    last = loop.drain()                 # final wave's verdicts
+
+`submit(wave_i)` delta-encodes wave_i into a fresh `ClusterArrays` slot and
+dispatches its device step WHILE step i−1 still runs, then blocks only on
+step i−1's (tiny) choices vector.  The returned verdicts are committed by
+the caller (or the loop's `commit` callback) while step i runs on device —
+so the steady-state wall is the device step alone and the ~0.5 s of host
+encode plus the commit fan-out disappear into device time.  Buffer donation
+(ops/assign.py — schedule_batch_donated) rides the same structure: each
+wave's input buffers are freshly transferred (true double buffering — two
+generations in flight) and handed to XLA, so the [P, N]-scale intermediates
+stop doubling peak device memory; the loop never re-reads a dispatched
+wave's device arrays.
+
+DEPENDENT wave streams (the scheduler's steady state, bench.py's warm
+cycles) feed verdicts back with a one-wave lag: wave i+1's bound set
+absorbs the placements of wave i−1 (the newest FETCHED wave), because wave
+i is still deciding on device.  The sequential-commit semantics of a wave
+live entirely inside the kernel, so the pipeline can never reorder commits
+WITHIN a wave; across waves the dataflow (which bound set each wave saw) is
+fixed by the lag, and `depth=0` runs the IDENTICAL dataflow serially —
+decisions are bit-identical between the two (tests/test_pipeline_parity.py
+asserts it; that equality is what proves overlap and donation change
+nothing but wall time).
+
+Every host phase is trace-attributed: `encode_overlap` / `commit_overlap`
+(+ `decode_overlap`) spans tagged with whether a device step was in flight,
+and `overlap_fraction()` reports the fraction of host pipeline work that
+executed under a running device step — the "delta-encode fully hidden"
+claim as a measured number (>0.8 steady-state, 0.0 at depth=0).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Iterator, Optional, Tuple
+import time
+from typing import Callable, Dict, Iterable, Iterator, Optional, Tuple
 
-import jax
 import numpy as np
 
-from ..api.snapshot import Snapshot, encode_snapshot
-from ..ops import DEFAULT_SCORE_CONFIG
-from ..ops.scores import ScoreConfig, infer_score_config
+from ..api.delta import DeltaEncoder
+from ..api.snapshot import Snapshot
+from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from ..ops.scores import ScoreConfig
+
+Verdicts = Dict[str, Optional[str]]
 
 
-def _decode(choices, meta) -> Dict[str, Optional[str]]:
-    ch = np.asarray(choices)  # blocks until the device program finishes
-    return {
-        meta.pod_names[k]: (
-            meta.node_names[int(ch[k])] if int(ch[k]) >= 0 else None
+class PipelinedBatchLoop:
+    """Depth-1 double-buffered encode→dispatch→commit loop over waves.
+
+    donate=None probes the backend (ops/assign.py — donation_supported);
+    depth=0 is the serial oracle: the same dataflow with the previous step
+    fetched BEFORE the next encode, so nothing ever overlaps.  `commit`
+    (optional) is invoked with each wave's verdicts as soon as they are
+    decoded — inside the overlap window of the step just dispatched.
+    Gang waves are out of scope here (the gang fixpoint re-reads its input
+    arrays, which donation forbids); the scheduler's gang path stays on
+    its own cycle."""
+
+    def __init__(
+        self,
+        encoder: Optional[DeltaEncoder] = None,
+        base_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
+        hard_pod_affinity_weight: float = 1.0,
+        donate: Optional[bool] = None,
+        depth: int = 1,
+        commit: Optional[Callable[[Verdicts], None]] = None,
+        tracer=None,
+        metrics=None,
+    ):
+        from ..ops.assign import donation_supported
+
+        self.enc = encoder or DeltaEncoder(
+            hard_pod_affinity_weight=hard_pod_affinity_weight
         )
-        for k in range(meta.n_pods)
-    }
+        self.base_config = base_config
+        self.donate = donation_supported() if donate is None else donate
+        self.depth = depth
+        self.commit = commit
+        self.tracer = tracer
+        self.metrics = metrics
+        self._inflight: Optional[Tuple[object, object, float]] = None
+        self._wave = 0
+        # per-kind host seconds: [total, overlapped-with-an-in-flight-step]
+        self.host_seconds: Dict[str, list] = {
+            "encode": [0.0, 0.0],
+            "commit": [0.0, 0.0],
+            "decode": [0.0, 0.0],
+        }
+        self.stats: Dict[str, float] = {"waves": 0, "donated": 0}
+        # probes onto the newest donated wave's aliasable input buffers
+        # (i32[N,R] / i32[P] leaves — XLA aliases the outputs greedily onto
+        # whichever matches first): one of them reading is_deleted() after
+        # the step proves donation actually consumed the inputs (tests);
+        # host code must never read their VALUES, which the safety test
+        # asserts by construction (fresh transfers, empty reuse table)
+        self.last_donated_probe = None
+
+    # -- accounting helpers --
+    def _span(self, name: str, start: float, end: float, **attrs):
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.record_span(name, start=start, end=end, **attrs)
+
+    @staticmethod
+    def _step_running(probe) -> Optional[bool]:
+        """Whether the in-flight step's result is still being computed;
+        None when unobservable (no probe / non-jax array)."""
+        if probe is None:
+            return None
+        try:
+            return not probe.is_ready()
+        except AttributeError:  # numpy choices (native path)
+            return None
+
+    def _overlap_credit(self, probe, running_at_start) -> float:
+        """Fraction of a host phase credited as hidden under the in-flight
+        step, bounded by what is OBSERVABLE: still running at phase end ->
+        the whole phase was concurrent (1.0, exact); already finished at
+        phase start -> nothing was (0.0, exact); finished mid-phase -> the
+        true share is unknowable without a completion timestamp, so credit
+        half (error bounded by dt/2).  Unobservable probes keep the old
+        in-flight-at-start accounting."""
+        if running_at_start is None:
+            return 1.0 if probe is not None else 0.0
+        if not running_at_start:
+            return 0.0
+        running_end = self._step_running(probe)
+        return 1.0 if running_end else 0.5
+
+    def _host_phase(self, kind: str, dt: float, credit: float) -> None:
+        tot = self.host_seconds[kind]
+        tot[0] += dt
+        tot[1] += dt * credit
+
+    def overlap_fraction(self) -> float:
+        """Fraction of host pipeline work (encode + commit + decode) that
+        ran while a dispatched device step was still running — credited
+        conservatively per phase (see _overlap_credit)."""
+        total = sum(v[0] for v in self.host_seconds.values())
+        hidden = sum(v[1] for v in self.host_seconds.values())
+        return (hidden / total) if total > 0 else 0.0
+
+    # -- the pipeline --
+    def _dispatch(self, snap: Snapshot):
+        from ..ops.assign import schedule_batch_routed
+
+        probe = self._inflight[0] if self._inflight is not None else None
+        running0 = self._step_running(probe)
+        t0 = time.perf_counter()
+        donating = self.donate
+        # host arrays first (infer_score_config inspects concrete numpy);
+        # donation requires fresh per-wave transfers — a resident buffer
+        # handed to a donating kernel would poison later reusing cycles
+        arr, meta = self.enc.encode(snap)
+        cfg = infer_score_config(arr, self.base_config)
+        arr, meta = self.enc.to_device(arr, meta, fresh=donating)
+        if donating:
+            self.last_donated_probe = (
+                arr.node_alloc, arr.node_used, arr.pod_prio, arr.pod_nodename,
+            )
+            self.stats["donated"] += 1
+        choices = schedule_batch_routed(arr, cfg, donate=donating)[0]
+        t1 = time.perf_counter()
+        credit = self._overlap_credit(probe, running0)
+        self._host_phase("encode", t1 - t0, credit)
+        self._span(
+            "encode_overlap", t0, t1, component="pipeline",
+            wave=self._wave, overlapped=credit > 0, overlap_credit=credit,
+        )
+        return choices, meta
+
+    def _collect(self) -> Optional[Verdicts]:
+        if self._inflight is None:
+            return None
+        choices, meta, t_dispatch = self._inflight
+        self._inflight = None
+        t0 = time.perf_counter()
+        ch = np.asarray(choices)  # the sync point: wait on the device step
+        t1 = time.perf_counter()
+        self._span(
+            "device.step", t_dispatch, t1, component="pipeline",
+            wave=self._wave - 1,
+        )
+        # decode happens after the blocking fetch, so it overlaps only the
+        # NEXT step — dispatched before this collect when pipelining
+        probe = self._pending_choices
+        d_run0 = self._step_running(probe)
+        verdicts = {
+            meta.pod_names[k]: (
+                meta.node_names[int(ch[k])] if int(ch[k]) >= 0 else None
+            )
+            for k in range(meta.n_pods)
+        }
+        t2 = time.perf_counter()
+        credit = self._overlap_credit(probe, d_run0)
+        self._host_phase("decode", t2 - t1, credit)
+        self._span(
+            "decode_overlap", t1, t2, component="pipeline",
+            wave=self._wave - 1, overlapped=credit > 0, overlap_credit=credit,
+        )
+        if self.commit is not None:
+            c_run0 = self._step_running(probe)
+            t3 = time.perf_counter()
+            self.commit(verdicts)
+            t4 = time.perf_counter()
+            ccredit = self._overlap_credit(probe, c_run0)
+            self._host_phase("commit", t4 - t3, ccredit)
+            self._span(
+                "commit_overlap", t3, t4, component="pipeline",
+                wave=self._wave - 1, overlapped=ccredit > 0,
+                overlap_credit=ccredit, pods=len(verdicts),
+            )
+        self.stats["waves"] += 1
+        if self.metrics is not None:
+            self.metrics.observe("pipeline_cycle_seconds", t2 - t_dispatch)
+        return verdicts
+
+    # the step dispatched after the one being collected (None outside that
+    # window): the overlap probe for decode/commit phases
+    _pending_choices = None
+
+    def submit(self, snap: Snapshot) -> Optional[Verdicts]:
+        """Encode + dispatch `snap`; return the PREVIOUS wave's verdicts
+        (None on the first call).  depth=0 collects BEFORE encoding — the
+        serial oracle with identical dataflow."""
+        if self.depth == 0:
+            prev = self._collect()
+            nxt = self._dispatch(snap)
+            t_dispatch = time.perf_counter()
+            # strict serial oracle: the step finishes INSIDE submit, so not
+            # even caller-side work between submits overlaps the device —
+            # the pre-pipeline wall, reproducible for --no-pipeline runs
+            try:
+                nxt[0].block_until_ready()
+            except AttributeError:  # numpy choices (native path)
+                pass
+            self._inflight = (*nxt, t_dispatch)
+            self._wave += 1
+            return prev
+        nxt = self._dispatch(snap)
+        t_dispatch = time.perf_counter()
+        self._pending_choices = nxt[0]
+        try:
+            prev = self._collect()
+        finally:
+            self._pending_choices = None
+        self._inflight = (*nxt, t_dispatch)
+        self._wave += 1
+        return prev
+
+    def drain(self) -> Optional[Verdicts]:
+        """Fetch the final in-flight wave's verdicts (None if none)."""
+        out = self._collect()
+        if self.metrics is not None:
+            self.metrics.observe(
+                "pipeline_overlap_fraction", self.overlap_fraction()
+            )
+        return out
+
+    def run(self, snapshots: Iterable[Snapshot]) -> Iterator[Verdicts]:
+        """Yield one verdict dict per snapshot, in order — the streaming
+        form for INDEPENDENT waves (replayed scheduler_perf streams,
+        sidecar request replays).  Wave k+1's encode and wave k−1's commit
+        overlap wave k's device step."""
+        for snap in snapshots:
+            v = self.submit(snap)
+            if v is not None:
+                yield v
+        v = self.drain()
+        if v is not None:
+            yield v
 
 
 class PipelinedRunner:
-    """Double-buffered snapshot stream executor.
+    """Back-compat façade over PipelinedBatchLoop for independent snapshot
+    streams (the original double-buffered runner's interface).
 
     >>> runner = PipelinedRunner()
     >>> for verdicts in runner.run(snapshots):
@@ -53,41 +301,46 @@ class PipelinedRunner:
         self,
         base_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
         hard_pod_affinity_weight: float = 1.0,
+        donate: Optional[bool] = None,
+        tracer=None,
+        metrics=None,
     ):
         self.base_config = base_config
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.donate = donate
+        self.tracer = tracer
+        self.metrics = metrics
+        self.last_loop: Optional[PipelinedBatchLoop] = None
 
-    def _dispatch(self, snap: Snapshot) -> Tuple[jax.Array, object]:
-        from ..ops import schedule_batch
-
-        arr, meta = encode_snapshot(
-            snap, hard_pod_affinity_weight=self.hard_pod_affinity_weight
+    def _loop(self, depth: int) -> PipelinedBatchLoop:
+        loop = PipelinedBatchLoop(
+            base_config=self.base_config,
+            hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+            donate=self.donate,
+            depth=depth,
+            tracer=self.tracer,
+            metrics=self.metrics,
         )
-        cfg = infer_score_config(arr, self.base_config)
-        arr = jax.device_put(arr)  # async H2D
-        choices, _used = schedule_batch(arr, cfg)  # async dispatch
-        return choices, meta
+        self.last_loop = loop
+        return loop
 
-    def run(self, snapshots: Iterable[Snapshot]) -> Iterator[Dict[str, Optional[str]]]:
-        """Yields one verdict dict per snapshot, in order.  Encode/transfer of
-        snapshot k+1 overlaps the device program of snapshot k."""
-        prev: Optional[Tuple[jax.Array, object]] = None
-        for snap in snapshots:
-            nxt = self._dispatch(snap)  # host encodes while prev computes
-            if prev is not None:
-                yield _decode(*prev)
-            prev = nxt
-        if prev is not None:
-            yield _decode(*prev)
+    def run(self, snapshots: Iterable[Snapshot]) -> Iterator[Verdicts]:
+        return self._loop(depth=1).run(snapshots)
 
 
 def run_serial(
     snapshots: Iterable[Snapshot],
     base_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
     hard_pod_affinity_weight: float = 1.0,
-) -> Iterator[Dict[str, Optional[str]]]:
+    donate: Optional[bool] = None,
+) -> Iterator[Verdicts]:
     """The unpipelined oracle for the same stream: encode -> run -> block,
-    one snapshot at a time (used by tests and the overlap benchmark)."""
-    runner = PipelinedRunner(base_config, hard_pod_affinity_weight)
-    for snap in snapshots:
-        yield _decode(*runner._dispatch(snap))
+    one snapshot at a time (identical dataflow at depth=0 — used by tests
+    and the overlap benchmark; the harness's --no-pipeline escape hatch)."""
+    loop = PipelinedBatchLoop(
+        base_config=base_config,
+        hard_pod_affinity_weight=hard_pod_affinity_weight,
+        donate=donate,
+        depth=0,
+    )
+    return loop.run(snapshots)

@@ -82,6 +82,24 @@ class TPUScoreClient:
         except grpc.RpcError as e:
             raise SidecarUnavailable(str(e.code())) from e
 
+    @staticmethod
+    def _trace_metadata():
+        """The W3C-traceparent analog for the sidecar hop: stamp the ACTIVE
+        span's trace_id/span_id (the scheduler's batch.cycle is current when
+        schedule() runs) into gRPC metadata.  The server rebuilds the span
+        context from it (sidecar.py — _parent_ctx), so a sidecar-routed
+        wave renders as ONE connected Perfetto tree instead of an orphan
+        root per RPC — the ROADMAP open item."""
+        from ..scheduler.tracing import current_span
+
+        sp = current_span()
+        if sp is None:
+            return None
+        return (
+            ("ktpu-trace-id", sp.trace_id),
+            ("ktpu-span-id", sp.span_id),
+        )
+
     # --- request builders ---
     def _wave_msg(self, pods) -> pb.InternedWave:
         """The spec-interned wave message: per-template
@@ -219,8 +237,9 @@ class TPUScoreClient:
             req = self._full_request(
                 snap, deadline_ms, gang, hard_pod_affinity_weight
             )
+        md = self._trace_metadata()
         try:
-            resp = self._schedule(req, timeout=deadline_ms / 1e3)
+            resp = self._schedule(req, timeout=deadline_ms / 1e3, metadata=md)
             if resp.resync_required:
                 # server lost the session (restart / eviction): reconnect by
                 # re-sending the full snapshot once, same call
@@ -229,7 +248,9 @@ class TPUScoreClient:
                 req = self._full_request(
                     snap, deadline_ms, gang, hard_pod_affinity_weight
                 )
-                resp = self._schedule(req, timeout=deadline_ms / 1e3)
+                resp = self._schedule(
+                    req, timeout=deadline_ms / 1e3, metadata=md
+                )
                 if resp.resync_required:
                     raise SidecarUnavailable("resync loop")
         except grpc.RpcError as e:
@@ -272,7 +293,10 @@ class TPUScoreClient:
             hard_pod_affinity_weight=hpaw,
         )
         try:
-            resp = self._schedule(req, timeout=deadline_ms / 1e3)
+            resp = self._schedule(
+                req, timeout=deadline_ms / 1e3,
+                metadata=self._trace_metadata(),
+            )
         except grpc.RpcError as e:
             raise SidecarUnavailable(str(e.code())) from e
         return {v.pod_uid: (v.node if v.scheduled else None) for v in resp.verdicts}

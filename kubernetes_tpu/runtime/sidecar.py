@@ -329,12 +329,36 @@ class _Engine:
             return all(s.ready for s in self._sessions.values())
 
 
+def _parent_ctx(context):
+    """Rebuild the client-stamped span context from gRPC metadata
+    (runtime/client.py — _trace_metadata).  Returns a SpanContext or None;
+    with it, the server-side schedule span joins the scheduler's trace tree
+    — one connected Perfetto render across the wire hop."""
+    from ..scheduler.tracing import SpanContext
+
+    try:
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+    except Exception:  # noqa: BLE001 — tests pass bare mocks
+        return None
+    tid, sid = md.get("ktpu-trace-id"), md.get("ktpu-span-id")
+    if tid and sid:
+        return SpanContext(tid, sid)
+    return None
+
+
 class TPUScoreServer:
     # full snapshots at north-star scale exceed gRPC's 4 MB default
     MAX_MSG = 256 * 1024 * 1024
 
-    def __init__(self, address: str = "127.0.0.1:0", engine: Optional[_Engine] = None):
+    def __init__(self, address: str = "127.0.0.1:0", engine: Optional[_Engine] = None,
+                 collector=None):
+        from ..scheduler.tracing import Tracer
+
         self.engine = engine or _Engine()
+        # span tracing: the default process collector unless injected (the
+        # in-process loopback tests share one collector with the scheduler,
+        # which is what makes the cross-hop tree assertable)
+        self.tracer = Tracer(collector, component="sidecar")
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=4),
             options=[
@@ -361,6 +385,23 @@ class TPUScoreServer:
 
     # --- RPCs ---
     def _schedule(self, request: pb.ScheduleRequest, context) -> pb.ScheduleResponse:
+        """Schedule RPC entry, traced under the CLIENT's span context when
+        the request metadata carries one (trace_id/span_id stamped by
+        runtime/client.py): the sidecar's work renders inside the
+        scheduler's batch.cycle tree instead of as a disconnected root."""
+        if not self.tracer.enabled:
+            return self._schedule_inner(request, context)
+        with self.tracer.span(
+            "sidecar.schedule",
+            parent=_parent_ctx(context),
+            session=request.session_id or "stateless",
+            pods=len(request.wave.uids) or len(request.snapshot.pending_pods),
+        ):
+            return self._schedule_inner(request, context)
+
+    def _schedule_inner(
+        self, request: pb.ScheduleRequest, context
+    ) -> pb.ScheduleResponse:
         t0 = time.perf_counter()
         if not request.session_id:
             return self._schedule_stateless(request, t0)

@@ -72,6 +72,11 @@ class SchedulerConfiguration:
     # "tpu" (batched XLA kernels) | "native" (batched C++ engine — the fast
     # CPU fallback) | "cpu" (per-pod plugin path — the reference's exact shape)
     mode: str = "tpu"
+    # pipelined batch commits: defer the bind/events fan-out of cycle i−1
+    # into cycle i's device-step window when provably serial-equivalent
+    # (capacity reserves synchronously via cache.assume regardless; see
+    # scheduler.py — _flush_deferred_binds).  KTPU_PIPELINE=0 also disables.
+    pipeline_commit: bool = True
 
     def profile(self, name: str = "default-scheduler") -> Profile:
         for p in self.profiles:

@@ -4,6 +4,16 @@ Prometheus when prometheus_client is importable, else a minimal in-process
 registry with the same API — either way the same metric names as the
 reference: scheduling_attempt_duration_seconds, pending_pods,
 queue_incoming_pods_total, preemption_victims, framework_extension_point_duration_seconds.
+
+Pipelined-cycle series (parallel/pipeline.py + scheduler.py deferred
+commits; no reference analog — the reference never overlaps cycles):
+
+  pipeline_cycle_seconds              per-wave dispatch→result wall
+  pipeline_overlap_fraction           fraction of host encode/commit/decode
+                                      hidden under in-flight device steps
+  pipeline_deferred_commit_seconds    deferred bind fan-out flush (usually
+                                      inside the next cycle's device-step
+                                      window; at a drain point otherwise)
 """
 
 from __future__ import annotations

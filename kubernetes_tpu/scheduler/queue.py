@@ -129,6 +129,17 @@ class PriorityQueue:
     def pending_total(self) -> int:
         return len(self._active) + len(self._backoff) + len(self._unschedulable)
 
+    @property
+    @_locked
+    def parked_total(self) -> int:
+        """Pods waiting OUTSIDE the activeQ (backoff + unschedulable) — the
+        set a cluster-event move could wake.  The batch cycle's deferred
+        commit fan-out (scheduler.py — _flush_deferred_binds) is exactly
+        serial-equivalent only when this is 0: with nobody parked, the
+        deferred binds' AssignedPodAdd moves are no-ops, so delaying them
+        into the next device step's window cannot change any queue state."""
+        return len(self._backoff) + len(self._unschedulable)
+
     def _key(self, pod: t.Pod) -> Tuple:
         # PrioritySort.Less: higher priority first, then FIFO by first arrival
         arr = self._arrival.setdefault(pod.uid, next(self._seq))

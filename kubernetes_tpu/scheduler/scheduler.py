@@ -149,6 +149,29 @@ class Scheduler:
         # state persists across cycles, absorbing bind/delete deltas
         # (api/delta.py — the watch-cache analog)
         self._delta_enc = None
+        # pipelined batch commits: the bind/events/queue fan-out of cycle
+        # i−1 is deferred into cycle i's device-step window (dispatch is
+        # async) whenever that is provably serial-equivalent — capacity is
+        # reserved synchronously through cache.assume either way, so every
+        # encode sees identical bound state.  KTPU_PIPELINE=0 (or the
+        # config knob) restores the fully synchronous commit.
+        import os
+
+        self._pipeline_commit = (
+            config.pipeline_commit and os.environ.get("KTPU_PIPELINE") != "0"
+        )
+        self._deferred_binds: List[Tuple[t.Pod, str]] = []
+        # deferral engages only under run_until_idle's cycle stream: a
+        # directly-called schedule_batch() keeps its contract that binds
+        # are store-visible on return
+        self._cycle_streaming = False
+        # persistent XLA compilation cache (KTPU_COMPILE_CACHE_DIR): a second
+        # scheduler process loads the north-star executable from disk
+        # instead of re-paying the cold compile (ops/aot.py)
+        if config.mode in ("tpu", "native"):
+            from ..ops.aot import maybe_enable_compile_cache
+
+            maybe_enable_compile_cache()
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -584,7 +607,9 @@ class Scheduler:
 
     def wait_for_bindings(self) -> None:
         """Drain in-flight binding cycles (the reference's graceful shutdown
-        waits on the binding goroutines the same way)."""
+        waits on the binding goroutines the same way).  Also a drain point
+        for the batch path's deferred commit fan-out."""
+        self._flush_deferred_binds()
         if self._bind_pool is None:
             return
         while True:
@@ -718,6 +743,10 @@ class Scheduler:
             ):
                 offload = False
         if offload:
+            # sidecar cycles have no async-dispatch window: publish the
+            # previous cycle's deferred fan-out BEFORE any of this cycle's
+            # commit work, preserving the serial loop's store/event order
+            self._flush_deferred_binds()
             # offload to the gRPC sidecar; deadline/transport failure -> the
             # mandated CPU fallback (per-pod plugin path)
             from ..runtime import SidecarUnavailable, TPUScoreClient
@@ -756,6 +785,12 @@ class Scheduler:
                     n_unbound += result[pod.name] is None
                 return result, n_unbound
         arr = meta = None  # encoded cycle arrays (batched preemption reuses them)
+        # does this cycle's kernel path dispatch asynchronously?  Deferring
+        # the fan-out is only worth anything when the next cycle (same
+        # stream, usually the same branch) will have a device window to
+        # hide it under — sidecar/native/gang cycles flush synchronously,
+        # so deferring there just delays publication for zero overlap
+        async_window = False
         if verdicts is None:
             base_cfg = self.config.score_config(profile_name)
             if (
@@ -778,6 +813,9 @@ class Scheduler:
                 if self.config.mode == "native":
                     from ..native import schedule_batch_native, schedule_with_gangs_native
 
+                    # synchronous C++ engine: no async window — commit the
+                    # previous cycle's deferred fan-out before it runs
+                    self._flush_deferred_binds()
                     fn = schedule_with_gangs_native if gang else schedule_batch_native
                     choices = fn(arr, cfg)[0]
                     if not gang:
@@ -786,14 +824,36 @@ class Scheduler:
                         ords = np.arange(meta.n_pods, dtype=np.int64)
                         sweeps = meta.n_pods
                 elif gang:
+                    # the gang fixpoint re-reads its input arrays across
+                    # iterations, so it neither donates nor exposes a clean
+                    # single-dispatch window — flush first
+                    self._flush_deferred_binds()
                     choices, _, ords, sweeps = schedule_with_gangs(
                         arr, cfg, with_ordinals=True
                     )
                 else:
-                    from ..ops import schedule_batch_ordinals as kernel
+                    from ..ops.assign import (
+                        donation_supported,
+                        schedule_batch_ordinals_routed,
+                    )
 
-                    choices, _, ords, sweeps = kernel(arr, cfg)
+                    # async dispatch; `arr` is host numpy, so the jit call
+                    # transfers fresh per-cycle device buffers — donation
+                    # (where the backend honors it) hands those to XLA and
+                    # can never poison a resident buffer (the host copy,
+                    # which batched preemption reuses, stays valid)
+                    choices, _, ords, sweeps = schedule_batch_ordinals_routed(
+                        arr, cfg, donate=donation_supported()
+                    )
+                    # step i runs on device: the deferred bind/events
+                    # fan-out of step i−1 executes NOW, inside the device
+                    # window — the commit_overlap half of the pipeline
+                    self._flush_deferred_binds()
                     choices = np.asarray(choices)
+                    # only this branch has the async window the NEXT
+                    # cycle's deferred fan-out would hide under; a
+                    # same-profile stream keeps taking it
+                    async_window = True
             if ords is not None:
                 self._observe_wave_latency(
                     np.asarray(ords)[: meta.n_pods],
@@ -809,6 +869,20 @@ class Scheduler:
             }
         result: Dict[str, Optional[str]] = {}
         failed: List[t.Pod] = []
+        # Deferred-commit gate: capacity is reserved through cache.assume
+        # synchronously either way (update_snapshot treats assumed pods as
+        # bound), so the store/events/queue fan-out may lag into the NEXT
+        # cycle's device window without changing any encode — PROVIDED the
+        # fan-out's move events could wake nobody (no parked pods) and the
+        # pod needs no volume commitment (bind_pod_volumes mutates storage
+        # state the next encode fingerprints).  Anything else commits
+        # synchronously, bit-for-bit the old loop.
+        defer_ok = (
+            self._pipeline_commit
+            and self._cycle_streaming
+            and async_window
+            and self.queue.parked_total == 0
+        )
         # bind fan-out + the preemption failure loop = the cycle's commit step
         with self.tracer.span("batch.commit", profile=profile_name), \
                 self._coalesced_moves():
@@ -824,21 +898,20 @@ class Scheduler:
                         node_name = None
                 if node_name:
                     self.cache.assume(pod.uid, node_name)
-                    t_b0 = time.perf_counter()
-                    self.store.bind(pod.uid, node_name)
-                    if self.tracer.enabled:
-                        # instant per-pod bind mark on the pod's own trace
-                        # chain (the batch verdict crossing back to ONE pod)
-                        self.tracer.record_span(
-                            "bind", start=t_b0, pod_uid=pod.uid,
-                            pod=pod.uid, node=node_name,
-                        )
-                    self.queue.delete_nominated(pod.uid)
-                    self.events.record("Scheduled", pod.uid, node=node_name)
+                    if defer_ok and not pod.pvcs:
+                        self._deferred_binds.append((pod, node_name))
+                        result[pod.name] = node_name
+                        continue
+                    self._publish_bind(pod.uid, node_name)
                     result[pod.name] = node_name
                 else:
                     failed.append(pod)
                     result[pod.name] = None
+            if failed:
+                # the preemption loop below reads AND mutates the store
+                # (victim evictions); its view must match the serial loop's,
+                # so the deferred fan-out lands first
+                self._flush_deferred_binds()
             # failure path: preemption through the CPU PostFilter, then requeue.
             # Three lazily-maintained pieces, each invalidated only by what
             # actually stales it:
@@ -857,6 +930,14 @@ class Scheduler:
 
             state = None
             snap2 = None
+            # snap2 freshness: True while snap2 exactly reflects the store
+            # (no eviction since it was resolved).  Batched evictions flow
+            # through the store only, so they DIRTY snap2 rather than
+            # rebuilding it eagerly; the CPU what-if branch then re-resolves
+            # only when actually stale instead of on every entry (ADVICE
+            # r5: the unconditional re-resolve was a full-cluster scan per
+            # entry with zero intervening evictions)
+            snap2_fresh = False
             batched = None  # ops/preempt.py evaluator, shared across the loop
             use_batched = (
                 arr is not None
@@ -870,6 +951,7 @@ class Scheduler:
                     from ..api.volumes import resolve_snapshot
 
                     snap2 = resolve_snapshot(self.cache.update_snapshot())
+                    snap2_fresh = True
                     state = None  # what-if state pinned to the old snapshot
                     bound_prios = Counter(
                         q.priority for q in snap2.bound_pods
@@ -923,18 +1005,25 @@ class Scheduler:
                         batched.note_nomination_cleared(pod)
                         self._nominate(pod, node_name)
                         state = None  # CPU what-if (if built) is stale now
+                        snap2_fresh = False  # eviction went through the store
                     else:
                         batched.note_nomination_cleared(pod)
                         self._clear_nomination(pod)
                 else:
                     if state is None:
                         # lazy CPU what-if: only pods outside the batched
-                        # gate pay for it.  Note snap2 may postdate batched
-                        # evictions only through the store (the cache saw
-                        # the deletions), so re-resolve for exactness.
-                        from ..api.volumes import resolve_snapshot
+                        # gate pay for it (resolve + node_infos + ScaledState
+                        # are full-cluster scans).  snap2 is reused VERBATIM
+                        # while fresh; only an eviction since it was resolved
+                        # (batched evictions reach it through the store
+                        # alone) forces the re-resolve.
+                        if not snap2_fresh:
+                            from ..api.volumes import resolve_snapshot
 
-                        snap2 = resolve_snapshot(self.cache.update_snapshot())
+                            snap2 = resolve_snapshot(
+                                self.cache.update_snapshot()
+                            )
+                            snap2_fresh = True
                         infos = self.cache.node_infos(snap2)
                         state = CycleState()
                         state.data["scaled"] = ScaledState(snap2, infos)
@@ -952,6 +1041,55 @@ class Scheduler:
                         self._clear_nomination(pod)
                 self.queue.add_unschedulable(pod, backoff=True)
         return result, len(failed)
+
+    def _flush_deferred_binds(self) -> None:
+        """Commit the deferred bind/events/queue fan-out of the previous
+        batch cycle.  Runs inside the NEXT cycle's device-step window (the
+        commit_overlap of the pipelined loop) or at a drain point — always
+        before anything that reads bind-visible state the serial loop would
+        have seen (preemption, run_until_idle exit, CPU fallback).
+
+        Serial equivalence: every deferred pod was cache.assume()d at
+        verdict time, so snapshots/encodes already counted it as bound; the
+        deferral only moves the store publication, its watch fan-out (a
+        no-op move — the gate required zero parked pods) and the Scheduled
+        event later in wall time, never across an observable read."""
+        if not self._deferred_binds:
+            return
+        binds, self._deferred_binds = self._deferred_binds, []
+        t0 = time.perf_counter()
+        with self._coalesced_moves():
+            for pod, node_name in binds:
+                if pod.uid not in self.store.pods:
+                    # deleted (or preempted) while deferred: the capacity
+                    # reservation died with the Deleted event; never
+                    # resurrect the pod as bound
+                    self.cache.forget(pod.uid)
+                    continue
+                self._publish_bind(pod.uid, node_name)
+        dt = time.perf_counter() - t0
+        self.metrics.observe("pipeline_deferred_commit_seconds", dt)
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "commit_overlap", start=t0, end=t0 + dt, pods=len(binds),
+            )
+
+    def _publish_bind(self, pod_uid: str, node_name: str) -> None:
+        """The bind publication fan-out, shared VERBATIM by the synchronous
+        commit loop and the deferred flush (the two must never diverge):
+        store bind + per-pod bind span + nomination cleanup + Scheduled
+        event."""
+        t_b0 = time.perf_counter()
+        self.store.bind(pod_uid, node_name)
+        if self.tracer.enabled:
+            # instant per-pod bind mark on the pod's own trace chain (the
+            # batch verdict crossing back to ONE pod)
+            self.tracer.record_span(
+                "bind", start=t_b0, pod_uid=pod_uid,
+                pod=pod_uid, node=node_name,
+            )
+        self.queue.delete_nominated(pod_uid)
+        self.events.record("Scheduled", pod_uid, node=node_name)
 
     def _observe_wave_latency(
         self, ordinals: np.ndarray, t_kernel: float, sweeps: int
@@ -1013,6 +1151,14 @@ class Scheduler:
         — it never truncates silently.  An explicit max_cycles bounds the
         work and returns possibly-non-idle (soak tests drive incremental
         cycles this way on purpose)."""
+        self._cycle_streaming = True  # deferred commits may span cycles here
+        try:
+            self._run_until_idle_loop(max_cycles, stall_limit)
+        finally:
+            self._cycle_streaming = False
+            self._flush_deferred_binds()
+
+    def _run_until_idle_loop(self, max_cycles, stall_limit) -> None:
         cycles = 0
         stall = 0
         while max_cycles is None or cycles < max_cycles:
