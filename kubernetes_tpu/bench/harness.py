@@ -464,6 +464,12 @@ def main(argv=None) -> None:
     ap.add_argument("--trace-device", metavar="DIR",
                     help="with --trace: also capture a jax.profiler device "
                          "trace per round under DIR (TensorBoard format)")
+    ap.add_argument("--chaos", type=int, metavar="SEED",
+                    help="arm the fault injector with FaultPlan.from_seed "
+                         "(also via KTPU_CHAOS_SEED / KTPU_FAULT_PLAN): the "
+                         "run must survive the storm and the artifact "
+                         "reports injected/recovered counts so recovery "
+                         "overhead is priced")
     args = ap.parse_args(argv)
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
@@ -478,6 +484,22 @@ def main(argv=None) -> None:
         # the scheduler reads this at construction: batch commits stay
         # fully synchronous, exactly the pre-pipeline loop
         os.environ["KTPU_PIPELINE"] = "0"
+    from .. import chaos as chaos_mod
+
+    if args.chaos is not None:
+        inj = chaos_mod.install(chaos_mod.FaultPlan.from_seed(args.chaos))
+    else:
+        inj = chaos_mod.maybe_install_from_env()
+    if inj is not None:
+        print(f"chaos plan: {inj.plan.describe()}", file=sys.stderr)
+
+    def _chaos_report():
+        if inj is None:
+            return None
+        rep = inj.report()
+        rep["seed"] = inj.plan.seed
+        return rep
+
     if args.stream:
         waves = [
             workloads.heterogeneous(2000, 5000, seed=s) for s in range(args.stream)
@@ -486,6 +508,8 @@ def main(argv=None) -> None:
             f"stream-{args.stream}x5000", waves,
             pipeline=not args.no_pipeline,
         )
+        if inj is not None:
+            out["chaos"] = _chaos_report()
         print(json.dumps(out))
         return
     if args.config:
@@ -501,7 +525,10 @@ def main(argv=None) -> None:
     data = [r.to_json() for r in results]
     for r in data:
         print(json.dumps(r), file=sys.stderr)
-    out = json.dumps({"perfdata": data}, indent=2)
+    doc = {"perfdata": data}
+    if inj is not None:
+        doc["chaos"] = _chaos_report()
+    out = json.dumps(doc, indent=2)
     if args.out:
         open(args.out, "w").write(out)
     else:

@@ -1,0 +1,21 @@
+"""Deterministic fault-injection + crash-safe recovery plumbing (see plan.py)."""
+
+from .plan import (  # noqa: F401
+    ALWAYS,
+    ChaosInjector,
+    Fault,
+    FaultInjected,
+    FaultPlan,
+    PoisonedWave,
+    SITE_ACTIONS,
+    active,
+    chaos_plan,
+    enabled,
+    install,
+    maybe_install_from_env,
+    poison,
+    poisoned_verdicts,
+    poke,
+    record_recovery,
+    uninstall,
+)

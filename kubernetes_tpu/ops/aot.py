@@ -28,7 +28,44 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .. import chaos
+
 _enabled_dir: Optional[str] = None
+
+# a crash mid-write truncates an entry to 0 or a few bytes — below any
+# compressed executable's compression header, let alone its payload
+_MIN_ENTRY_BYTES = 8
+
+
+def scrub_compile_cache(path: Optional[str] = None, aggressive: bool = False) -> int:
+    """Remove unreadably-corrupt entries from the persistent compile cache;
+    returns how many files were dropped.  The cheap pass drops empty and
+    sub-magic-sized files (a crash mid-write truncates to 0 or a few
+    bytes); aggressive=True (the post-compile-failure path, where SOME
+    entry provably poisoned the load but XLA does not say which) drops
+    every cache entry — the fresh compiles that follow rewrite them.
+    Either way the contract holds: a corrupt entry costs a recompile,
+    never a crash out of warmup."""
+    path = path or _enabled_dir
+    if not path or not os.path.isdir(path):
+        return 0
+    dropped = 0
+    for name in os.listdir(path):
+        fp = os.path.join(path, name)
+        if not os.path.isfile(fp):
+            continue
+        try:
+            if aggressive:
+                os.remove(fp)
+                dropped += 1
+                continue
+            size = os.path.getsize(fp)
+            if size < _MIN_ENTRY_BYTES:
+                os.remove(fp)
+                dropped += 1
+        except OSError:
+            continue  # raced with a concurrent writer: its entry is fresh
+    return dropped
 
 
 def maybe_enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
@@ -51,6 +88,10 @@ def maybe_enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
         return _enabled_dir
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
+    # drop obviously-truncated entries BEFORE jax ever reads the dir (a
+    # previous process crashing mid-write must cost a recompile, not an
+    # exception out of the first warmup)
+    scrub_compile_cache(path)
     import jax
 
     jax.config.update("jax_compilation_cache_dir", path)
@@ -97,6 +138,11 @@ def warm_kernels(
 
     import warnings
 
+    if chaos.enabled():
+        fault = chaos.poke("compile.cache")
+        if fault is not None and fault.action == "corrupt":
+            _corrupt_one_cache_entry()
+
     donate = donation_supported()
     n = 0
     with warnings.catch_warnings():
@@ -107,19 +153,66 @@ def warm_kernels(
             "ignore", message="Some donated buffers were not usable"
         )
         if batch:
-            (schedule_batch_donated if donate else schedule_batch).lower(
-                arr, cfg
-            ).compile()
+            _compile_with_cache_recovery(
+                schedule_batch_donated if donate else schedule_batch, arr, cfg
+            )
             n += 1
         if ordinals:
-            (
+            _compile_with_cache_recovery(
                 schedule_batch_ordinals_donated if donate
-                else schedule_batch_ordinals
-            ).lower(arr, cfg).compile()
+                else schedule_batch_ordinals,
+                arr, cfg,
+            )
             n += 1
         if gang and (donate or not ordinals):
             # not already covered above: the gang fixpoint always takes the
             # non-donating ordinals kernel
-            schedule_batch_ordinals.lower(arr, cfg).compile()
+            _compile_with_cache_recovery(schedule_batch_ordinals, arr, cfg)
             n += 1
     return n
+
+
+def _compile_with_cache_recovery(kernel, arr, cfg) -> None:
+    """lower().compile() that survives a corrupt persistent-cache entry.
+
+    Classification by experiment, not guesswork: on failure with the cache
+    enabled, retry ONCE with the persistent cache disabled.  If that also
+    fails, the error is a genuine compile error — re-raise with the shared
+    cache dir UNTOUCHED (wiping valid entries other processes depend on
+    would fix nothing).  If it succeeds, the on-disk entry is what poisoned
+    the load: scrub the dir aggressively and compile again with the cache
+    re-enabled so the fresh write IS the repair.  Either way warmup never
+    dies to a truncated file on disk."""
+    try:
+        kernel.lower(arr, cfg).compile()
+        return
+    except Exception:  # noqa: BLE001 — classify below, re-raise when real
+        if _enabled_dir is None:
+            raise
+    import time
+
+    import jax
+
+    t0 = time.perf_counter()
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        kernel.lower(arr, cfg).compile()  # genuine error still raises here
+    finally:
+        jax.config.update("jax_compilation_cache_dir", _enabled_dir)
+    scrub_compile_cache(_enabled_dir, aggressive=True)
+    kernel.lower(arr, cfg).compile()  # cache-enabled: rewrites fresh entries
+    chaos.record_recovery("compile.cache", "recompile", start=t0)
+
+
+def _corrupt_one_cache_entry() -> None:
+    """The compile.cache chaos action: truncate the first cache entry to
+    garbage — exactly the artifact of a process killed mid-write."""
+    d = _enabled_dir
+    if not d or not os.path.isdir(d):
+        return
+    for name in sorted(os.listdir(d)):
+        fp = os.path.join(d, name)
+        if os.path.isfile(fp):
+            with open(fp, "wb") as f:
+                f.write(b"\x00bad")
+            return

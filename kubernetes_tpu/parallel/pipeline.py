@@ -58,6 +58,7 @@ from ..api.delta import DeltaEncoder
 from ..api.snapshot import Snapshot
 from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
 from ..ops.scores import ScoreConfig
+from .. import chaos
 
 Verdicts = Dict[str, Optional[str]]
 
@@ -104,7 +105,7 @@ class PipelinedBatchLoop:
             "commit": [0.0, 0.0],
             "decode": [0.0, 0.0],
         }
-        self.stats: Dict[str, float] = {"waves": 0, "donated": 0}
+        self.stats: Dict[str, float] = {"waves": 0, "donated": 0, "recovered": 0}
         # probes onto the newest donated wave's aliasable input buffers
         # (i32[N,R] / i32[P] leaves — XLA aliases the outputs greedily onto
         # whichever matches first): one of them reading is_deleted() after
@@ -163,6 +164,10 @@ class PipelinedBatchLoop:
 
         probe = self._inflight[0] if self._inflight is not None else None
         running0 = self._step_running(probe)
+        if chaos.enabled():
+            # slow-host stall: encode-path latency only — decisions and the
+            # drain contract must hold regardless (chaos parity asserts it)
+            chaos.poke("host.stall", tracer=self.tracer, metrics=self.metrics)
         t0 = time.perf_counter()
         donating = self.donate
         # host arrays first (infer_score_config inspects concrete numpy);
@@ -186,13 +191,54 @@ class PipelinedBatchLoop:
         )
         return choices, meta
 
+    def _recover_wave(self, snap: Snapshot, err: BaseException, t0: float):
+        """Serial-oracle replay of a wave that died mid-flight (device-step
+        exception, poisoned verdicts): re-encode from host state — the
+        NON-donated source of truth; any donated device buffers of the dead
+        wave are unreadable by contract — and re-run the same kernel
+        synchronously without donation.  The encoder is deterministic, so
+        the replay's verdicts are bit-identical to what the fault-free wave
+        would have produced (the chaos parity invariant)."""
+        from ..ops.assign import schedule_batch_routed
+
+        arr, meta = self.enc.encode(snap)
+        cfg = infer_score_config(arr, self.base_config)
+        # fresh=True: never touch (or populate) the resident-reuse table —
+        # the replay must not alias buffers a donating successor wave hands
+        # to XLA
+        arr, meta = self.enc.to_device(arr, meta, fresh=True)
+        ch = np.asarray(schedule_batch_routed(arr, cfg, donate=False)[0])
+        if chaos.poisoned_verdicts(ch, len(meta.node_names)):
+            raise chaos.PoisonedWave(
+                f"wave {self._wave - 1}: serial replay still poisoned"
+            ) from err
+        self.stats["recovered"] += 1
+        chaos.record_recovery(
+            "pipeline.step", "serial_replay", tracer=self.tracer,
+            metrics=self.metrics, start=t0, wave=self._wave - 1,
+            error=type(err).__name__,
+        )
+        return ch, meta
+
     def _collect(self) -> Optional[Verdicts]:
         if self._inflight is None:
             return None
-        choices, meta, t_dispatch = self._inflight
+        choices, meta, t_dispatch, snap = self._inflight
         self._inflight = None
         t0 = time.perf_counter()
-        ch = np.asarray(choices)  # the sync point: wait on the device step
+        try:
+            fault = (
+                chaos.poke("pipeline.step", tracer=self.tracer,
+                           metrics=self.metrics)
+                if chaos.enabled() else None
+            )
+            ch = np.asarray(choices)  # the sync point: wait on the device step
+            if fault is not None and fault.action == "nan":
+                ch = chaos.poison(ch)
+            if chaos.poisoned_verdicts(ch, len(meta.node_names)):
+                raise chaos.PoisonedWave(f"wave {self._wave - 1}")
+        except Exception as e:  # noqa: BLE001 — any mid-wave death recovers
+            ch, meta = self._recover_wave(snap, e, t0)
         t1 = time.perf_counter()
         self._span(
             "device.step", t_dispatch, t1, component="pipeline",
@@ -251,7 +297,7 @@ class PipelinedBatchLoop:
                 nxt[0].block_until_ready()
             except AttributeError:  # numpy choices (native path)
                 pass
-            self._inflight = (*nxt, t_dispatch)
+            self._inflight = (*nxt, t_dispatch, snap)
             self._wave += 1
             return prev
         nxt = self._dispatch(snap)
@@ -260,9 +306,13 @@ class PipelinedBatchLoop:
         try:
             prev = self._collect()
         finally:
+            # the in-flight wave is tracked even when the collect (commit
+            # callback included) raises mid-wave: a later drain() still
+            # flushes its verdicts instead of leaking the dispatched step
+            # (and whatever capacity the caller's commit path reserved)
             self._pending_choices = None
-        self._inflight = (*nxt, t_dispatch)
-        self._wave += 1
+            self._inflight = (*nxt, t_dispatch, snap)
+            self._wave += 1
         return prev
 
     def drain(self) -> Optional[Verdicts]:
@@ -279,13 +329,27 @@ class PipelinedBatchLoop:
         form for INDEPENDENT waves (replayed scheduler_perf streams,
         sidecar request replays).  Wave k+1's encode and wave k−1's commit
         overlap wave k's device step."""
-        for snap in snapshots:
-            v = self.submit(snap)
+        try:
+            for snap in snapshots:
+                v = self.submit(snap)
+                if v is not None:
+                    yield v
+            v = self.drain()
             if v is not None:
                 yield v
-        v = self.drain()
-        if v is not None:
-            yield v
+        finally:
+            if self._inflight is not None:
+                # abandoned mid-stream (caller exception / generator close):
+                # best-effort drain so the final wave's commit callback runs
+                # and nothing stays reserved-but-unpublished
+                try:
+                    self.drain()
+                    chaos.record_recovery(
+                        "pipeline.step", "abort_drain", tracer=self.tracer,
+                        metrics=self.metrics,
+                    )
+                except Exception:  # noqa: BLE001 — teardown must not mask
+                    pass
 
 
 class PipelinedRunner:

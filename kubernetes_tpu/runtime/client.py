@@ -15,6 +15,8 @@ retry inside the same call, which is the crash-only reconnect contract."""
 from __future__ import annotations
 
 import dataclasses
+import random
+import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
@@ -22,13 +24,23 @@ import grpc
 
 from ..api import types as t
 from ..api.snapshot import Snapshot
+from .. import chaos
 from . import tpuscore_pb2 as pb
 from .convert import node_to_proto, pod_to_proto
 from .sidecar import SERVICE
 
 
 class SidecarUnavailable(Exception):
-    pass
+    """The caller must fall back to the in-process CPU branch.
+
+    retryable distinguishes transport-shaped failures (a drop, a deadline,
+    a partial response — a fresh attempt may land) from structural ones (a
+    still-compiling sidecar, a resync loop, an exhausted failure budget —
+    retrying inside the same cycle cannot help)."""
+
+    def __init__(self, msg: str, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
 
 
 # one shared field list + comparator with the encoder's bind-absorb
@@ -37,10 +49,34 @@ from ..api.delta import bound_spec_fields_match as _spec_fields_match
 
 
 class TPUScoreClient:
-    def __init__(self, address: str, session: bool = True):
+    """retry/degrade contract (the Borg/Omega failure-is-common posture):
+    each schedule() retries transport failures up to max_attempts with
+    capped exponential backoff + jitter (seeded — reproducible waits), then
+    raises for the per-cycle CPU fallback.  failure_budget CONSECUTIVE
+    exhausted calls trip the circuit: the channel is marked degraded and
+    schedule() raises immediately (no dial, no deadline wait) until
+    degraded_cooldown_s elapses, after which one half-open probe attempt is
+    allowed; any success fully resets the budget."""
+
+    def __init__(self, address: str, session: bool = True, metrics=None,
+                 max_attempts: int = 3, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0, failure_budget: int = 3,
+                 degraded_cooldown_s: float = 30.0, sleep_fn=time.sleep):
+        from ..scheduler.metrics import Metrics
         from .sidecar import TPUScoreServer
 
         self.address = address
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.max_attempts = max(1, max_attempts)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.failure_budget = max(1, failure_budget)
+        self.degraded_cooldown_s = degraded_cooldown_s
+        self._sleep = sleep_fn
+        self._retry_rng = random.Random(0xC4A05)  # jitter only; never a decision
+        self.degraded = False
+        self._degraded_until = 0.0
+        self._consecutive_failures = 0
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -73,14 +109,98 @@ class TPUScoreClient:
         self._fp_refs: Tuple = ()
         self.stats = {
             "full": 0, "delta": 0, "resync": 0, "not_ready": 0,
-            "binds_compressed": 0, "binds_explicit": 0,
+            "binds_compressed": 0, "binds_explicit": 0, "retries": 0,
         }
 
     def health(self, timeout_s: float = 2.0) -> pb.HealthResponse:
+        """Health RPC.  A transport failure is never swallowed silently: it
+        increments sidecar_health_failures_total, counts toward the failure
+        budget (marking the channel degraded when exhausted), and forces a
+        full session resync on the next schedule() — the server may have
+        restarted and lost the session (the reconnect-after-health-failure
+        contract; tests/test_chaos.py asserts it)."""
         try:
-            return self._health(pb.HealthRequest(), timeout=timeout_s)
-        except grpc.RpcError as e:
-            raise SidecarUnavailable(str(e.code())) from e
+            if chaos.enabled():
+                chaos.poke("sidecar.health", metrics=self.metrics)
+            resp = self._health(pb.HealthRequest(), timeout=timeout_s)
+        except (grpc.RpcError, chaos.FaultInjected) as e:
+            self.metrics.inc("sidecar_health_failures_total")
+            self._synced = False
+            self._note_failure()
+            code = str(e.code()) if isinstance(e, grpc.RpcError) else "INJECTED"
+            raise SidecarUnavailable(code, retryable=True) from e
+        self._note_success()
+        return resp
+
+    # --- failure budget / circuit state ---
+    def _note_failure(self) -> None:
+        self._consecutive_failures += 1
+        if not self.degraded and self._consecutive_failures >= self.failure_budget:
+            self.degraded = True
+            self._degraded_until = time.monotonic() + self.degraded_cooldown_s
+            self.metrics.inc("sidecar_degraded_total")
+            chaos.record_recovery(
+                "sidecar.rpc", "degrade", metrics=self.metrics,
+                failures=self._consecutive_failures,
+            )
+
+    def _note_success(self) -> None:
+        self._consecutive_failures = 0
+        if self.degraded:
+            self.degraded = False
+            self.metrics.inc("sidecar_degraded_recovered_total")
+            chaos.record_recovery("sidecar.rpc", "reconnect", metrics=self.metrics)
+
+    def _check_degraded(self) -> bool:
+        """While degraded, fail fast (no dial, no deadline wait) so every
+        cycle takes the in-process CPU branch immediately; after the
+        cooldown one half-open probe call is let through — its success
+        resets the budget, its failure re-arms the cooldown.  Returns True
+        when THIS call is the half-open probe: the caller restricts it to a
+        single attempt (probing a still-dead sidecar must not pay the full
+        retry ladder inside one scheduling cycle)."""
+        if not self.degraded:
+            return False
+        now = time.monotonic()
+        if now < self._degraded_until:
+            self.metrics.inc("sidecar_degraded_skips_total")
+            raise SidecarUnavailable(
+                "degraded (failure budget exhausted)", retryable=False
+            )
+        self._degraded_until = now + self.degraded_cooldown_s  # re-arm
+        return True
+
+    def _backoff_sleep(self, attempt: int) -> None:
+        """Capped exponential backoff with multiplicative jitter between
+        retry attempts (seeded RNG: reproducible waits, never a decision
+        input)."""
+        d = min(self.backoff_cap_s, self.backoff_base_s * (2 ** attempt))
+        self._sleep(d * (1.0 + self._retry_rng.random()))
+
+    def _retrying(self, attempt_fn, max_attempts: Optional[int] = None):
+        attempts = max_attempts if max_attempts is not None else self.max_attempts
+        for attempt in range(attempts):
+            try:
+                out = attempt_fn()
+            except SidecarUnavailable as e:
+                if not e.retryable:
+                    # structural (still compiling / resync loop): the
+                    # transport is fine — neither retry nor budget
+                    raise
+                self.metrics.inc("sidecar_rpc_failures_total")
+                if attempt + 1 < attempts:
+                    self.stats["retries"] = self.stats.get("retries", 0) + 1
+                    self._backoff_sleep(attempt)
+                    continue
+                self._note_failure()
+                raise
+            if attempt > 0:
+                chaos.record_recovery(
+                    "sidecar.rpc", "retry", metrics=self.metrics,
+                    attempts=attempt + 1,
+                )
+            self._note_success()
+            return out
 
     @staticmethod
     def _trace_metadata():
@@ -211,17 +331,39 @@ class TPUScoreClient:
         gang: bool = True,
         hard_pod_affinity_weight: float = 1.0,
     ) -> Dict[str, Optional[str]]:
-        """-> pod uid -> node name (None = unschedulable).  Raises
-        SidecarUnavailable on deadline/transport failure or a still-compiling
-        sidecar (caller falls back)."""
-        from ..api.delta import raw_fingerprints, raw_keepalive_refs
+        """-> pod uid -> node name (None = unschedulable).  Transport-shaped
+        failures retry in-call with capped backoff + jitter; raises
+        SidecarUnavailable once retries exhaust, the failure budget trips
+        (degraded channel — fails fast until the cooldown), or the sidecar
+        is still compiling (caller falls back to the CPU branch)."""
         from ..api.volumes import resolve_snapshot
 
+        probing = self._check_degraded()
+        attempts = 1 if probing else None  # half-open: exactly one attempt
         if not self.session_id:
-            return self._schedule_stateless(
-                resolve_snapshot(snap), deadline_ms, gang,
-                hard_pod_affinity_weight,
+            rsnap = resolve_snapshot(snap)
+            return self._retrying(
+                lambda: self._schedule_stateless(
+                    rsnap, deadline_ms, gang, hard_pod_affinity_weight
+                ),
+                max_attempts=attempts,
             )
+        return self._retrying(
+            lambda: self._schedule_session_once(
+                snap, deadline_ms, gang, hard_pod_affinity_weight
+            ),
+            max_attempts=attempts,
+        )
+
+    def _schedule_session_once(
+        self,
+        snap: Snapshot,
+        deadline_ms: float,
+        gang: bool,
+        hard_pod_affinity_weight: float,
+    ) -> Dict[str, Optional[str]]:
+        from ..api.delta import raw_fingerprints, raw_keepalive_refs
+        from ..api.volumes import resolve_snapshot
         # fingerprint the RAW cluster (resolution rebuilds node objects per
         # cycle whenever volume/DRA state exists) with the SAME helpers the
         # delta encoder conditions on, then resolve for the wire
@@ -239,6 +381,10 @@ class TPUScoreClient:
             )
         md = self._trace_metadata()
         try:
+            fault = (
+                chaos.poke("sidecar.rpc", metrics=self.metrics)
+                if chaos.enabled() else None
+            )
             resp = self._schedule(req, timeout=deadline_ms / 1e3, metadata=md)
             if resp.resync_required:
                 # server lost the session (restart / eviction): reconnect by
@@ -253,11 +399,16 @@ class TPUScoreClient:
                 )
                 if resp.resync_required:
                     raise SidecarUnavailable("resync loop")
-        except grpc.RpcError as e:
+            if fault is not None and fault.action == "partial":
+                # truncated response (a connection cut mid-stream): the
+                # validation below must catch it, never decode it
+                del resp.assignment[len(resp.assignment) // 2:]
+        except (grpc.RpcError, chaos.FaultInjected) as e:
             # transport/deadline failure: the server may or may not have
             # applied this epoch — force a full resync next cycle
             self._synced = False
-            raise SidecarUnavailable(str(e.code())) from e
+            code = str(e.code()) if isinstance(e, grpc.RpcError) else "INJECTED"
+            raise SidecarUnavailable(code, retryable=True) from e
         # the server applied this request's state even when answering
         # not_ready — record it so the next cycle's diff is correct
         self._synced = True
@@ -273,6 +424,17 @@ class TPUScoreClient:
             self.stats["not_ready"] += 1
             self._last_assign = {}  # no assignment to echo next cycle
             raise SidecarUnavailable("sidecar compiling (not ready)")
+        if len(resp.assignment) != len(snap.pending_pods):
+            # a partial/truncated response: zip() below would silently drop
+            # the tail's verdicts (pods would vanish into the preemption
+            # path on a healthy cluster) — treat it as the transport
+            # failure it is and resync
+            self._synced = False
+            self.metrics.inc("sidecar_partial_responses_total")
+            raise SidecarUnavailable(
+                f"partial response ({len(resp.assignment)} verdicts for "
+                f"{len(snap.pending_pods)} pods)", retryable=True,
+            )
         # aligned-array verdicts: assignment[i] is a node index (our own node
         # list's order) for pending pod i in the order we sent the wave
         names = [nd.name for nd in snap.nodes]
@@ -293,12 +455,25 @@ class TPUScoreClient:
             hard_pod_affinity_weight=hpaw,
         )
         try:
+            fault = (
+                chaos.poke("sidecar.rpc", metrics=self.metrics)
+                if chaos.enabled() else None
+            )
             resp = self._schedule(
                 req, timeout=deadline_ms / 1e3,
                 metadata=self._trace_metadata(),
             )
-        except grpc.RpcError as e:
-            raise SidecarUnavailable(str(e.code())) from e
+            if fault is not None and fault.action == "partial":
+                del resp.verdicts[len(resp.verdicts) // 2:]
+        except (grpc.RpcError, chaos.FaultInjected) as e:
+            code = str(e.code()) if isinstance(e, grpc.RpcError) else "INJECTED"
+            raise SidecarUnavailable(code, retryable=True) from e
+        if len(resp.verdicts) != len(snap.pending_pods):
+            self.metrics.inc("sidecar_partial_responses_total")
+            raise SidecarUnavailable(
+                f"partial response ({len(resp.verdicts)} verdicts for "
+                f"{len(snap.pending_pods)} pods)", retryable=True,
+            )
         return {v.pod_uid: (v.node if v.scheduled else None) for v in resp.verdicts}
 
     def close(self) -> None:

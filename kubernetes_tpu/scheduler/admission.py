@@ -166,7 +166,7 @@ class ResourceQuotaAdmission(AdmissionPlugin):
 
     def _usage(self, namespace: str) -> Dict[str, int]:
         used: Dict[str, int] = {"pods": 0}
-        for pod in self.store.pods.values():
+        for pod in self.store.list_pods():
             if pod.namespace != namespace or pod.phase in (
                 t.PHASE_SUCCEEDED,
                 t.PHASE_FAILED,

@@ -293,7 +293,7 @@ class APIServer:
         p = self.store.pods.get(f"{ns}/{name}")
         if p is not None and p.namespace == ns and p.name == name:
             return p
-        for p in self.store.pods.values():
+        for p in self.store.list_pods():
             if p.namespace == ns and p.name == name:
                 return p
         return None
@@ -337,17 +337,17 @@ class APIServer:
 
     def _list(self, kind: str, ns: Optional[str]):
         if kind == "Pod":
-            return [p for p in self.store.pods.values()
+            return [p for p in self.store.list_pods()
                     if ns is None or p.namespace == ns]
         if kind == "Node":
-            return list(self.store.nodes.values())
+            return self.store.list_nodes()
         if kind == "PDB":
-            return [p for p in self.store.pdbs.values()
+            return [p for p in self.store.list_pdbs()
                     if ns is None or p.namespace == ns]
         if kind == "PV":
-            return list(self.store.pvs.values())
+            return self.store.list_pvs()
         if kind == "PVC":
-            return [p for p in self.store.pvcs.values()
+            return [p for p in self.store.list_pvcs()
                     if ns is None or p.namespace == ns]
         return self.store.list_objects(kind, ns)
 

@@ -141,7 +141,7 @@ class EvictionManager:
         if self._pod_uids is not None:
             pods = (self.store.pods.get(uid) for uid in self._pod_uids())
         else:
-            pods = self.store.pods.values()
+            pods = self.store.list_pods()
         return [
             p
             for p in pods

@@ -66,8 +66,15 @@ class SchedulerConfiguration:
     # many worker threads, overlapping the next pod's scheduling cycle — the
     # reference's async bindingCycle goroutine.  0 = synchronous binding.
     binding_workers: int = 0
+    # unschedulable-retry backoff: initial step, the CAP (a fixed uncapped
+    # doubling would park pods for minutes after a long outage), and a
+    # multiplicative jitter fraction (each push matures at duration *
+    # (1 + U[0, jitter))) — a sidecar outage parks whole waves at once, and
+    # without jitter they all retry in one synchronized storm.  All three
+    # are wired into the scheduler's PriorityQueue.
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
+    pod_backoff_jitter: float = 0.1
     feature_gates: Tuple[Tuple[str, bool], ...] = ()
     # "tpu" (batched XLA kernels) | "native" (batched C++ engine — the fast
     # CPU fallback) | "cpu" (per-pod plugin path — the reference's exact shape)
@@ -151,6 +158,12 @@ def validate(cfg: SchedulerConfiguration) -> List[str]:
         errs.append("parallelism must be positive")
     if cfg.binding_workers < 0:
         errs.append("bindingWorkers must be >= 0")
+    if cfg.pod_initial_backoff_seconds <= 0:
+        errs.append("podInitialBackoffSeconds must be positive")
+    if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
+        errs.append("podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
+    if cfg.pod_backoff_jitter < 0:
+        errs.append("podBackoffJitter must be >= 0")
     return errs
 
 
@@ -206,6 +219,7 @@ def from_yaml(text: str) -> SchedulerConfiguration:
         parallelism=int(doc.get("parallelism", 16)),
         pod_initial_backoff_seconds=float(doc.get("podInitialBackoffSeconds", 1.0)),
         pod_max_backoff_seconds=float(doc.get("podMaxBackoffSeconds", 10.0)),
+        pod_backoff_jitter=float(doc.get("podBackoffJitter", 0.1)),
         feature_gates=tuple((k, bool(v)) for k, v in (doc.get("featureGates") or {}).items()),
         mode=doc.get("mode", "tpu"),
     )

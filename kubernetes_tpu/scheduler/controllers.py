@@ -76,7 +76,7 @@ class ReplicaSetController:
 
     def _owned(self, rs: t.ReplicaSet) -> List[t.Pod]:
         out = []
-        for pod in self.store.pods.values():
+        for pod in self.store.list_pods():
             if pod.namespace != rs.namespace:
                 continue
             ctrl = _controller_of(pod)
@@ -252,7 +252,7 @@ class JobController:
             return
         owned = [
             p
-            for p in self.store.pods.values()
+            for p in self.store.list_pods()
             if p.namespace == job.namespace
             and any(r.uid == job.uid for r in p.owner_references)
         ]
@@ -313,9 +313,9 @@ class ExpandController:
     def tick(self) -> None:
         classes = {
             sc.name: sc
-            for sc in self.store.objects.get("StorageClass", {}).values()
+            for sc in (self.store.list_objects("StorageClass") if "StorageClass" in self.store.objects else ())
         }
-        for pvc in list(self.store.pvcs.values()):
+        for pvc in self.store.list_pvcs():
             if not pvc.volume_name:
                 continue
             pv = self.store.pvs.get(pvc.volume_name)
@@ -340,17 +340,21 @@ class GarbageCollector:
 
     def _live_uids(self) -> set:
         live = set()
-        for table in self.store.objects.values():
-            for obj in table.values():
-                uid = getattr(obj, "uid", "")
-                if uid:
-                    live.add(uid)
-        # pods and nodes can own objects too (EndpointSlice<-Service is the
-        # common case, but Pod- and Node-owned objects exist in the reference)
-        for pod in self.store.pods.values():
-            live.add(pod.uid)
-        for name in self.store.nodes:
-            live.add(f"node/{name}")
+        # one lock-consistent pass: the object tables, pods and nodes all
+        # mutate in place under the store lock while other components run
+        with self.store.transaction():
+            for table in self.store.objects.values():
+                for obj in table.values():
+                    uid = getattr(obj, "uid", "")
+                    if uid:
+                        live.add(uid)
+            # pods and nodes can own objects too (EndpointSlice<-Service is
+            # the common case, but Pod- and Node-owned objects exist in the
+            # reference)
+            for pod in self.store.pods.values():
+                live.add(pod.uid)
+            for name in self.store.nodes:
+                live.add(f"node/{name}")
         return live
 
     def tick(self) -> int:
@@ -361,15 +365,20 @@ class GarbageCollector:
         deletable resource)."""
         deleted = 0
         live = self._live_uids()
-        for kind in list(self.store.objects):
-            for obj in list(self.store.objects[kind].values()):
+        with self.store.transaction():
+            tables = {
+                kind: list(table.values())
+                for kind, table in self.store.objects.items()
+            }
+        for kind, objs in tables.items():
+            for obj in objs:
                 refs = getattr(obj, "owner_references", ())
                 ctrl = next((r for r in refs if r.controller), None)
                 if ctrl is not None and ctrl.uid not in live:
                     self.store.delete_object(kind, _key_of(obj))
                     deleted += 1
         live = self._live_uids()
-        for pod in list(self.store.pods.values()):
+        for pod in self.store.list_pods():
             ctrl = _controller_of(pod)
             if ctrl is not None and ctrl.uid not in live:
                 self.store.delete_pod(pod.uid)
@@ -392,7 +401,7 @@ class StatefulSetController:
     def sync(self, sts) -> None:
         owner = t.OwnerReference(kind="StatefulSet", name=sts.name, uid=sts.uid)
         by_ordinal: Dict[int, t.Pod] = {}
-        for pod in self.store.pods.values():
+        for pod in self.store.list_pods():
             if pod.namespace == sts.namespace and any(
                 r.uid == sts.uid for r in pod.owner_references
             ):
@@ -429,7 +438,7 @@ class StatefulSetController:
             self.store.update_object("StatefulSet", replace(sts, ready_replicas=ready))
 
     def tick(self) -> None:
-        for sts in list(self.store.objects["StatefulSet"].values()):
+        for sts in self.store.list_objects("StatefulSet"):
             self.sync(sts)
 
 
@@ -463,7 +472,7 @@ class DaemonSetController:
     def sync(self, ds) -> None:
         owner = t.OwnerReference(kind="DaemonSet", name=ds.name, uid=ds.uid)
         have: Dict[str, t.Pod] = {}
-        for pod in list(self.store.pods.values()):
+        for pod in self.store.list_pods():
             if pod.namespace == ds.namespace and any(
                 r.uid == ds.uid for r in pod.owner_references
             ):
@@ -506,7 +515,7 @@ class DaemonSetController:
             )
 
     def tick(self) -> None:
-        for ds in list(self.store.objects["DaemonSet"].values()):
+        for ds in self.store.list_objects("DaemonSet"):
             self.sync(ds)
 
 
@@ -572,7 +581,7 @@ class CronJobController:
         self.store.update_object("CronJob", replace(cj, last_schedule_time=now))
 
     def tick(self) -> None:
-        for cj in list(self.store.objects["CronJob"].values()):
+        for cj in self.store.list_objects("CronJob"):
             self.sync(cj)
 
 
@@ -595,7 +604,7 @@ class HPAController:
             return
         pods = [
             p
-            for p in self.store.pods.values()
+            for p in self.store.list_pods()
             if p.namespace == hpa.namespace
             and d.selector is not None
             and d.selector.matches(p.labels)
@@ -624,7 +633,7 @@ class HPAController:
             )
 
     def tick(self) -> None:
-        for hpa in list(self.store.objects["HorizontalPodAutoscaler"].values()):
+        for hpa in self.store.list_objects("HorizontalPodAutoscaler"):
             self.sync(hpa)
 
 
@@ -637,26 +646,31 @@ class NamespaceController:
         self.store = store
 
     def tick(self) -> None:
-        for ns in list(self.store.objects["Namespace"].values()):
+        for ns in self.store.list_objects("Namespace"):
             if ns.phase != "Terminating":
                 continue
             remaining = 0
-            for pod in list(self.store.pods.values()):
+            for pod in self.store.list_pods():
                 if pod.namespace == ns.name:
                     self.store.delete_pod(pod.uid)
                     remaining += 1
-            for pdb in list(self.store.pdbs.values()):
+            for pdb in self.store.list_pdbs():
                 if pdb.namespace == ns.name:
                     self.store.delete_pdb(pdb.key)
                     remaining += 1
-            for pvc in list(self.store.pvcs.values()):
+            for pvc in self.store.list_pvcs():
                 if pvc.namespace == ns.name:
                     self.store.delete_pvc(pvc.key)
                     remaining += 1
-            for kind in list(self.store.objects):
+            with self.store.transaction():
+                tables = {
+                    kind: list(table.values())
+                    for kind, table in self.store.objects.items()
+                }
+            for kind, objs in tables.items():
                 if kind == "Namespace":
                     continue
-                for obj in list(self.store.objects[kind].values()):
+                for obj in objs:
                     if getattr(obj, "namespace", None) == ns.name:
                         self.store.delete_object(kind, _key_of(obj))
                         remaining += 1
@@ -675,12 +689,12 @@ class PodGCController:
 
     def tick(self) -> int:
         deleted = 0
-        for pod in list(self.store.pods.values()):
+        for pod in self.store.list_pods():
             if pod.node_name and pod.node_name not in self.store.nodes:
                 self.store.delete_pod(pod.uid)
                 deleted += 1
         finished = sorted(
-            (p for p in self.store.pods.values() if _is_finished(p)),
+            (p for p in self.store.list_pods() if _is_finished(p)),
             # oldest first by finish time (stamped by the kubelet; untimed
             # pods sort first = oldest), uid as the deterministic tie-break
             key=lambda p: (p.finished_at, p.uid),
@@ -723,14 +737,14 @@ class NodeIPAMController:
 
     def tick(self) -> None:
         used = set()
-        for nd in self.store.nodes.values():
+        for nd in self.store.list_nodes():
             if nd.pod_cidr.startswith(self.cluster_prefix + "."):
                 try:
                     used.add(int(nd.pod_cidr.split(".")[2]))
                 except (IndexError, ValueError):
                     pass
         free = (i for i in range(256) if i not in used)
-        for nd in sorted(self.store.nodes.values(), key=lambda n: n.name):
+        for nd in sorted(self.store.list_nodes(), key=lambda n: n.name):
             if nd.pod_cidr:
                 continue
             idx = next(free, None)
@@ -819,11 +833,11 @@ class AttachDetachController:
         # pass must not pay O(pods x PVs) linear rescans
         pv_by_claim = {
             pv.claim_ref: pv.name
-            for pv in self.store.pvs.values()
+            for pv in self.store.list_pvs()
             if pv.claim_ref
         }
         desired: Dict[str, set] = {}
-        for pod in self.store.pods.values():
+        for pod in self.store.list_pods():
             if not pod.node_name or _is_finished(pod):
                 continue
             for claim in pod.pvcs:
@@ -836,7 +850,7 @@ class AttachDetachController:
                 )
                 if pv is not None:
                     desired.setdefault(pod.node_name, set()).add(pv)
-        for nd in list(self.store.nodes.values()):
+        for nd in self.store.list_nodes():
             want = tuple(sorted(desired.get(nd.name, ())))
             if tuple(nd.volumes_attached) != want:
                 q = copy_module.copy(nd)
@@ -862,7 +876,7 @@ class ResourceClaimController:
         from ..api import cluster as c
 
         live: Dict[str, t.Pod] = {
-            p.uid: p for p in self.store.pods.values() if not _is_finished(p)
+            p.uid: p for p in self.store.list_pods() if not _is_finished(p)
         }
         wanted = set()
         for pod in live.values():

@@ -36,8 +36,8 @@ class DisruptionController:
     def tick(self) -> List[t.PodDisruptionBudget]:
         """Reconcile every PDB's status; returns the updated objects."""
         out: List[t.PodDisruptionBudget] = []
-        for key, pdb in list(self.store.pdbs.items()):
-            matching = [p for p in self.store.pods.values() if pdb.matches(p)]
+        for pdb in self.store.list_pdbs():
+            matching = [p for p in self.store.list_pods() if pdb.matches(p)]
             expected = len(matching)
             healthy = sum(1 for p in matching if _is_healthy(p))
             if pdb.min_available is not None:

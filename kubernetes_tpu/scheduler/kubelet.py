@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 from weakref import WeakKeyDictionary
 
+from .. import chaos
 from ..api import types as t
 from . import cri as cri_mod
 from .cri import (
@@ -243,7 +244,7 @@ class VolumeManager:
                 if pv_by_claim is None:
                     pv_by_claim = {
                         pv.claim_ref: pv.name
-                        for pv in self.store.pvs.values()
+                        for pv in self.store.list_pvs()
                         if pv.claim_ref
                     }
                 name = pv_by_claim.get(key, "")
@@ -302,6 +303,7 @@ class HollowKubelet:
         self.images: "cri_mod.ImageService" = self.cri
         self.pleg = PLEG(self.runtime)
         self.prober = ProbeManager(self.runtime, self.clock)
+        self.sync_failures = 0  # syncs contained by the tick loop's catch
         self.volumemanager = VolumeManager(store, node_name)
         # cm/devicemanager analog: concrete device IDs per admitted pod,
         # checkpointed when a directory is given (restart-safe allocations)
@@ -353,7 +355,7 @@ class HollowKubelet:
         # config source: route my pods' watch events to workers — the
         # kubelet's syncLoop 'config updates' channel.  Seed from a LIST
         # (informer semantics), then stay event-driven.
-        for pod in store.pods.values():
+        for pod in store.list_pods():
             if pod.node_name == self.node_name:
                 self._dispatch(pod, removed=False)
         store.watch(self._on_event, replay=False)  # seeded above: my pods only
@@ -448,11 +450,30 @@ class HollowKubelet:
                 continue
             if what == "ContainerDied":
                 self._sync_died(w)
-        # config-driven syncs: admit + start pods whose worker is fresh
+        # config-driven syncs: admit + start pods whose worker is fresh.
+        # Crash-consistent: one worker's sync dying (a CRI hiccup, an
+        # injected kubelet.sync crash) must neither kill the tick loop nor
+        # strand the pod — partial admission rolls back (devices/cpu freed,
+        # admitted reset) and the un-admitted worker retries next tick.
         for uid, w in list(self.workers.items()):
             if w.terminated or w.admitted:
                 continue
-            self._sync_start(w)
+            try:
+                self._sync_start(w)
+            except Exception as e:  # noqa: BLE001 — per-pod containment
+                self.sync_failures += 1
+                if w.admitted:
+                    # roll back the partial admission COMPLETELY through the
+                    # CRI teardown path: an already-created sandbox (and its
+                    # pod IP) must not orphan in the runtime while the retry
+                    # creates a second one — teardown also frees devices,
+                    # exclusive CPUs, probe state and mounts, idempotently
+                    self._teardown(w)
+                    w.admitted = False
+                chaos.record_recovery(
+                    "kubelet.sync", "retry_next_tick", tracer=self.tracer,
+                    pod=uid, node=self.node_name, error=type(e).__name__,
+                )
         # prober (prober_manager): due probes for every running container.
         # Liveness failure kills the container and routes through the SAME
         # died path as a crash (computePodActions sees an exited container;
@@ -544,6 +565,10 @@ class HollowKubelet:
         if pod.phase in (t.PHASE_SUCCEEDED, t.PHASE_FAILED):
             w.terminated = True
             return
+        if chaos.enabled():
+            # injected sync crash: contained by tick()'s per-worker catch
+            chaos.poke("kubelet.sync", tracer=self.tracer,
+                       pod=pod.uid, node=self.node_name)
         # WaitForAttachAndMount gates SyncPod: containers must not start
         # until the AttachDetach controller has attached every volume here
         # (checked BEFORE device/cpu allocation so nothing is held while
@@ -685,7 +710,7 @@ class HollowKubelet:
             prefix = f"10.{192 + (n >> 8 & 0x3F)}.{n & 0xFF}"
         in_use = {
             int(p.pod_ip.rsplit(".", 1)[1])
-            for p in self.store.pods.values()
+            for p in self.store.list_pods()
             if p.node_name == self.node_name and p.pod_ip.startswith(prefix + ".")
         }
         host = next(h for h in range(1, 255) if h not in in_use)
@@ -702,11 +727,13 @@ class HollowCluster:
         self.kubelets: Dict[str, HollowKubelet] = {}
 
     def tick(self) -> None:
-        for name in self.store.nodes:
+        names = self.store.list_node_names()  # lock-consistent snapshot
+        for name in names:
             if name not in self.kubelets:
                 self.kubelets[name] = HollowKubelet(self.store, self.leases, name)
+        names = set(names)
         for name in list(self.kubelets):
-            if name not in self.store.nodes:
+            if name not in names:
                 self.kubelets.pop(name).close()
                 continue
             self.kubelets[name].tick()

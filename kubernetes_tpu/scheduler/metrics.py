@@ -64,6 +64,9 @@ class Metrics:
         # (framework_extension_point_duration_seconds{extension_point, plugin}
         # — metrics.go declares it with exactly these labels)
         self.labeled_hists: Dict[str, Dict[LabelKey, _Hist]] = {}
+        # labeled counter series, same keying
+        # (framework_fault_recovery_total{site, action} — chaos/plan.py)
+        self.labeled_counters: Dict[str, Dict[LabelKey, float]] = {}
         self._prom = {}
         if prometheus and _PROM:  # pragma: no cover - optional path
             self._prom = {
@@ -105,6 +108,20 @@ class Metrics:
     def observe_labeled(self, name: str, v: float, **labels: str) -> None:
         self.labeled_hist(name, **labels).observe(v)
 
+    def inc_labeled(self, name: str, v: float = 1.0, **labels: str) -> None:
+        """Labeled counter bump (framework_fault_recovery_total{site,action}
+        and friends) — appears in snapshot() under the Prometheus-rendered
+        name, one entry per label combination."""
+        key: LabelKey = tuple(sorted((k, str(val)) for k, val in labels.items()))
+        with self._lock:
+            series = self.labeled_counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + v
+
+    def labeled_counter_total(self, name: str) -> float:
+        """Sum across all label combinations of one labeled counter."""
+        with self._lock:
+            return sum(self.labeled_counters.get(name, {}).values())
+
     @staticmethod
     def render_labels(key: LabelKey) -> str:
         """Prometheus exposition form for a label key:
@@ -123,6 +140,9 @@ class Metrics:
             labeled = {
                 name: dict(series) for name, series in self.labeled_hists.items()
             }
+            for name, series in self.labeled_counters.items():
+                for key, v in series.items():
+                    counters[name + self.render_labels(key)] = v
         out_hists = {
             name: (h.quantile(0.5), h.quantile(0.99), len(h.samples))
             for name, h in hists.items()

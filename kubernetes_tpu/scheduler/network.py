@@ -35,7 +35,7 @@ class EndpointSliceController:
 
     def _endpoints_for(self, svc: c.Service) -> List[c.Endpoint]:
         eps = []
-        for pod in self.store.pods.values():
+        for pod in self.store.list_pods():
             if not svc.selects(pod):
                 continue
             if not pod.node_name:
@@ -94,7 +94,7 @@ class EndpointSliceController:
         for svc in services:
             self.sync_service(svc)
         # slices for deleted services (when GC hasn't collected them yet)
-        for s in list(self.store.objects["EndpointSlice"].values()):
+        for s in self.store.list_objects("EndpointSlice"):
             if s.service_name and (s.namespace, s.service_name) not in names:
                 self.store.delete_object("EndpointSlice", s.key)
 
@@ -127,7 +127,7 @@ class Proxier:
         """One syncProxyRules pass."""
         rules: Dict[Tuple[str, int], Rule] = {}
         slices_by_svc: Dict[Tuple[str, str], List[c.EndpointSlice]] = {}
-        for s in self.store.objects["EndpointSlice"].values():
+        for s in self.store.list_objects("EndpointSlice"):
             slices_by_svc.setdefault((s.namespace, s.service_name), []).append(s)
         for svc in self.store.list_objects("Service"):
             if not svc.cluster_ip:

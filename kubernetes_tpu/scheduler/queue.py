@@ -23,6 +23,7 @@ from __future__ import annotations
 import functools
 import heapq
 import itertools
+import random
 import threading
 import time as _time
 from dataclasses import dataclass, field
@@ -80,9 +81,25 @@ class PriorityQueue:
     the scheduling thread's pop (the reference's queue takes its own lock —
     scheduling_queue.go guards activeQ/backoffQ with sync.Cond)."""
 
-    def __init__(self, clock: Optional[Clock] = None, tracer=None):
+    def __init__(self, clock: Optional[Clock] = None, tracer=None,
+                 initial_backoff_s: float = INITIAL_BACKOFF_S,
+                 max_backoff_s: float = MAX_BACKOFF_S,
+                 backoff_jitter: float = 0.0, jitter_seed: int = 0):
         self._lock = threading.RLock()
         self.clock = clock or Clock()
+        # exponential backoff base/cap (podInitialBackoffSeconds /
+        # podMaxBackoffSeconds — wired from SchedulerConfiguration), plus a
+        # multiplicative jitter fraction: each push matures at
+        # duration * (1 + U[0, jitter)).  A FIXED backoff synchronizes the
+        # retry storm after a correlated failure (e.g. a sidecar outage
+        # parks a whole wave at once, and 1 s later the whole wave retries
+        # in one thundering cycle); jitter de-correlates the retries.  The
+        # RNG is seeded so runs are reproducible — backoff_duration() stays
+        # the pure base for tests/introspection, jitter applies at push.
+        self.initial_backoff_s = initial_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.backoff_jitter = backoff_jitter
+        self._jitter_rng = random.Random(jitter_seed)
         # queue-wait spans (enqueue -> pop) per pod, joining the pod's trace
         # (scheduler/tracing.py); timestamps are real perf_counter values —
         # span time is wall attribution, independent of the injectable
@@ -173,9 +190,7 @@ class PriorityQueue:
             elif now - since >= self.max_unschedulable_s:
                 pod = self._unschedulable.pop(uid)[0]
                 del self._parked_at[uid]
-                ready = now + self.backoff_duration(uid)
-                heapq.heappush(self._backoff, (ready, next(self._seq), pod))
-                self._in_backoff[uid] = self._in_backoff.get(uid, 0) + 1
+                self._push_backoff(pod)
         while self._backoff and self._backoff[0][0] <= now:
             _, _, pod = heapq.heappop(self._backoff)
             left = self._in_backoff.get(pod.uid, 1) - 1
@@ -237,7 +252,18 @@ class PriorityQueue:
     @_locked
     def backoff_duration(self, pod_uid: str) -> float:
         n = max(0, self._attempts.get(pod_uid, 1) - 1)
-        return min(MAX_BACKOFF_S, INITIAL_BACKOFF_S * (2**n))
+        return min(self.max_backoff_s, self.initial_backoff_s * (2**n))
+
+    def _push_backoff(self, pod: t.Pod) -> None:
+        """Enter the backoffQ (caller holds the lock): jittered maturity —
+        duration * (1 + U[0, jitter)), base already capped at
+        max_backoff_s — so correlated failures fan their retries out
+        instead of re-arriving as one storm."""
+        d = self.backoff_duration(pod.uid)
+        if self.backoff_jitter > 0.0:
+            d *= 1.0 + self._jitter_rng.random() * self.backoff_jitter
+        heapq.heappush(self._backoff, (self.clock.now() + d, next(self._seq), pod))
+        self._in_backoff[pod.uid] = self._in_backoff.get(pod.uid, 0) + 1
 
     @_locked
     def add_unschedulable(self, pod: t.Pod, events: Optional[Set[str]] = None,
@@ -261,9 +287,7 @@ class PriorityQueue:
             self._unschedulable[pod.uid] = (pod, set(events), hints or {})
             self._parked_at[pod.uid] = self.clock.now()
         elif backoff:
-            ready = self.clock.now() + self.backoff_duration(pod.uid)
-            heapq.heappush(self._backoff, (ready, next(self._seq), pod))
-            self._in_backoff[pod.uid] = self._in_backoff.get(pod.uid, 0) + 1
+            self._push_backoff(pod)
         else:
             self._unschedulable[pod.uid] = (pod, events or {EV_ALL}, hints or {})
             self._parked_at[pod.uid] = self.clock.now()
@@ -292,9 +316,7 @@ class PriorityQueue:
                 del self._unschedulable[uid]
                 self._parked_at.pop(uid, None)
                 self._no_flush.discard(uid)
-                ready = self.clock.now() + self.backoff_duration(uid)
-                heapq.heappush(self._backoff, (ready, next(self._seq), pod))
-                self._in_backoff[uid] = self._in_backoff.get(uid, 0) + 1
+                self._push_backoff(pod)
         return len(moved)
 
     @_locked

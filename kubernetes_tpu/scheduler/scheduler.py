@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import chaos
 from ..api import types as t
 from ..api.snapshot import Snapshot, encode_snapshot
 from ..ops.scores import infer_score_config
@@ -78,8 +79,14 @@ class Scheduler:
             collector if collector is not None else default_collector()
         )
         self.tracer = Tracer(self.collector, component="scheduler")
+        # KTPU_CHAOS_SEED / KTPU_FAULT_PLAN arm the fault injector for any
+        # scheduler-driven process (idempotent; no-op when unset)
+        chaos.maybe_install_from_env()
         self.queue = PriorityQueue(
-            clock, tracer=Tracer(self.collector, component="queue")
+            clock, tracer=Tracer(self.collector, component="queue"),
+            initial_backoff_s=config.pod_initial_backoff_seconds,
+            max_backoff_s=config.pod_max_backoff_seconds,
+            backoff_jitter=config.pod_backoff_jitter,
         )
         self.metrics = Metrics()
         self.events = EventRecorder(store=store)
@@ -754,7 +761,11 @@ class Scheduler:
             try:
                 addr = prof.tpu_score.sidecar_address
                 if self._sidecars.get(addr) is None:
-                    self._sidecars[addr] = TPUScoreClient(addr)
+                    # shares the scheduler's Metrics so the client's retry/
+                    # degrade/health counters land in one scrape
+                    self._sidecars[addr] = TPUScoreClient(
+                        addr, metrics=self.metrics
+                    )
                 self._sidecar = self._sidecars[addr]
                 # the RAW snapshot goes to the client: it fingerprints raw
                 # node identity + storage state for its session delta, THEN
@@ -804,6 +815,11 @@ class Scheduler:
                 )
             with self.tracer.span("batch.encode", profile=profile_name):
                 arr, meta = self._delta_enc.encode(snap)
+            if chaos.enabled():
+                # slow-host stall inside the encode window: latency only,
+                # decisions and commit order must be unaffected
+                chaos.poke("host.stall", tracer=self.tracer,
+                           metrics=self.metrics)
             cfg = infer_score_config(arr, base_cfg)
             ords = sweeps = None
             with self.tracer.span(
@@ -828,9 +844,26 @@ class Scheduler:
                     # iterations, so it neither donates nor exposes a clean
                     # single-dispatch window — flush first
                     self._flush_deferred_binds()
-                    choices, _, ords, sweeps = schedule_with_gangs(
-                        arr, cfg, with_ordinals=True
-                    )
+                    try:
+                        fault = (
+                            chaos.poke("scheduler.step", tracer=self.tracer,
+                                       metrics=self.metrics)
+                            if chaos.enabled() else None
+                        )
+                        choices, _, ords, sweeps = schedule_with_gangs(
+                            arr, cfg, with_ordinals=True
+                        )
+                        choices = np.asarray(choices)
+                        if fault is not None and fault.action == "nan":
+                            choices = chaos.poison(choices)
+                        if chaos.poisoned_verdicts(
+                            choices, len(meta.node_names)
+                        ):
+                            raise chaos.PoisonedWave(profile_name)
+                    except Exception as e:  # noqa: BLE001 — wave recovery
+                        choices, ords, sweeps = self._recover_batch_step(
+                            arr, cfg, meta, e, gang=True
+                        )
                 else:
                     from ..ops.assign import (
                         donation_supported,
@@ -842,14 +875,32 @@ class Scheduler:
                     # (where the backend honors it) hands those to XLA and
                     # can never poison a resident buffer (the host copy,
                     # which batched preemption reuses, stays valid)
-                    choices, _, ords, sweeps = schedule_batch_ordinals_routed(
-                        arr, cfg, donate=donation_supported()
-                    )
-                    # step i runs on device: the deferred bind/events
-                    # fan-out of step i−1 executes NOW, inside the device
-                    # window — the commit_overlap half of the pipeline
-                    self._flush_deferred_binds()
-                    choices = np.asarray(choices)
+                    try:
+                        fault = (
+                            chaos.poke("scheduler.step", tracer=self.tracer,
+                                       metrics=self.metrics)
+                            if chaos.enabled() else None
+                        )
+                        choices, _, ords, sweeps = (
+                            schedule_batch_ordinals_routed(
+                                arr, cfg, donate=donation_supported()
+                            )
+                        )
+                        # step i runs on device: the deferred bind/events
+                        # fan-out of step i−1 executes NOW, inside the device
+                        # window — the commit_overlap half of the pipeline
+                        self._flush_deferred_binds()
+                        choices = np.asarray(choices)
+                        if fault is not None and fault.action == "nan":
+                            choices = chaos.poison(choices)
+                        if chaos.poisoned_verdicts(
+                            choices, len(meta.node_names)
+                        ):
+                            raise chaos.PoisonedWave(profile_name)
+                    except Exception as e:  # noqa: BLE001 — wave recovery
+                        choices, ords, sweeps = self._recover_batch_step(
+                            arr, cfg, meta, e
+                        )
                     # only this branch has the async window the NEXT
                     # cycle's deferred fan-out would hide under; a
                     # same-profile stream keeps taking it
@@ -883,7 +934,26 @@ class Scheduler:
             and async_window
             and self.queue.parked_total == 0
         )
-        # bind fan-out + the preemption failure loop = the cycle's commit step
+        # bind fan-out + the preemption failure loop = the cycle's commit
+        # step.  assumed_now tracks this cycle's reservations so a crash
+        # mid-commit releases them (crash-only containment: a leaked assume
+        # is phantom capacity every later encode would subtract forever)
+        assumed_now: List[str] = []
+        done: set = set()  # pod names whose commit disposition fully landed
+        try:
+            self._commit_profile_batch(
+                profile_name, snap, verdicts, result, failed, defer_ok,
+                assumed_now, done, arr, meta, batch_fw,
+            )
+        except Exception:
+            self._release_crashed_commit(snap, done, assumed_now)
+            raise
+        return result, len(failed)
+
+    def _commit_profile_batch(
+        self, profile_name, snap, verdicts, result, failed, defer_ok,
+        assumed_now, done, arr, meta, batch_fw,
+    ) -> None:
         with self.tracer.span("batch.commit", profile=profile_name), \
                 self._coalesced_moves():
             for pod in snap.pending_pods:
@@ -898,12 +968,15 @@ class Scheduler:
                         node_name = None
                 if node_name:
                     self.cache.assume(pod.uid, node_name)
+                    assumed_now.append(pod.uid)
                     if defer_ok and not pod.pvcs:
                         self._deferred_binds.append((pod, node_name))
                         result[pod.name] = node_name
+                        done.add(pod.name)
                         continue
                     self._publish_bind(pod.uid, node_name)
                     result[pod.name] = node_name
+                    done.add(pod.name)
                 else:
                     failed.append(pod)
                     result[pod.name] = None
@@ -1040,7 +1113,88 @@ class Scheduler:
                             batched.note_nomination_cleared(pod)
                         self._clear_nomination(pod)
                 self.queue.add_unschedulable(pod, backoff=True)
-        return result, len(failed)
+                done.add(pod.name)
+
+    def _release_crashed_commit(
+        self, snap, done: set, assumed_now: List[str]
+    ) -> None:
+        """A crash mid-commit must not leak: publish the already-deferred
+        binds (they were assumed AND recorded — the committed prefix stays
+        serial-equivalent), release every other assumption this cycle made
+        (no phantom capacity), and requeue the pods whose disposition never
+        landed (`done` = bound, deferred, or parked-with-backoff — anything
+        else was left mid-air by the crash) so a surviving caller retries
+        them.  The exception itself re-raises — crash-only containment,
+        not swallowing."""
+        t0 = time.perf_counter()
+        try:
+            self._flush_deferred_binds()
+        except Exception:  # noqa: BLE001 — flush keeps its tail deferred
+            pass  # the retained binds hold their assumes; a later drain retries
+        deferred_uids = {p.uid for p, _ in self._deferred_binds}
+        released = 0
+        for uid in assumed_now:
+            if uid in deferred_uids:
+                continue  # still slated to bind: the reservation must hold
+            cur = self.store.pods.get(uid)
+            if cur is None or not cur.node_name:
+                self.cache.forget(uid)
+                released += 1
+        requeued = 0
+        for pod in snap.pending_pods:
+            if pod.name in done or pod.uid in deferred_uids:
+                continue
+            cur = self.store.pods.get(pod.uid)
+            if cur is not None and not cur.node_name:
+                self.queue.add(pod)
+                requeued += 1
+        self.metrics.inc("scheduling_attempts_error")
+        self.log.V(1).info("Batch commit crashed; released assumptions",
+                           released=released, requeued=requeued)
+        chaos.record_recovery(
+            "scheduler.commit", "assume_release", tracer=self.tracer,
+            metrics=self.metrics, start=t0, released=released,
+            requeued=requeued,
+        )
+
+    def _recover_batch_step(self, arr, cfg, meta, err: BaseException,
+                            gang: bool = False):
+        """Serial-oracle replay of a batch wave that died on device (XLA
+        runtime error or poisoned readback).  `arr` is host numpy — the
+        non-donated source of truth; any donated per-call device buffers
+        died with the wave.  The deferred fan-out of the PREVIOUS cycle
+        flushes first (its store/event order must match the serial loop),
+        then the same kernel re-runs synchronously without donation: the
+        encoder and kernel are deterministic, so the replay's verdicts are
+        bit-identical to the wave the fault killed — the chaos parity
+        invariant (tests/test_chaos.py)."""
+        t0 = time.perf_counter()
+        self._flush_deferred_binds()
+        if gang:
+            from ..ops.gang import schedule_with_gangs
+
+            choices, _, ords, sweeps = schedule_with_gangs(
+                arr, cfg, with_ordinals=True
+            )
+        else:
+            from ..ops.assign import schedule_batch_ordinals_routed
+
+            choices, _, ords, sweeps = schedule_batch_ordinals_routed(
+                arr, cfg, donate=False
+            )
+        choices = np.asarray(choices)
+        if chaos.poisoned_verdicts(choices, len(meta.node_names)):
+            raise chaos.PoisonedWave(
+                "serial replay still poisoned — not a transient fault"
+            ) from err
+        self.metrics.inc("scheduling_wave_recoveries_total")
+        self.log.V(1).info("Batch wave recovered by serial replay",
+                           error=type(err).__name__)
+        chaos.record_recovery(
+            "scheduler.step", "serial_replay", tracer=self.tracer,
+            metrics=self.metrics, start=t0, error=type(err).__name__,
+        )
+        return choices, ords, sweeps
 
     def _flush_deferred_binds(self) -> None:
         """Commit the deferred bind/events/queue fan-out of the previous
@@ -1058,15 +1212,27 @@ class Scheduler:
             return
         binds, self._deferred_binds = self._deferred_binds, []
         t0 = time.perf_counter()
-        with self._coalesced_moves():
-            for pod, node_name in binds:
-                if pod.uid not in self.store.pods:
-                    # deleted (or preempted) while deferred: the capacity
-                    # reservation died with the Deleted event; never
-                    # resurrect the pod as bound
-                    self.cache.forget(pod.uid)
-                    continue
-                self._publish_bind(pod.uid, node_name)
+        k = 0
+        try:
+            with self._coalesced_moves():
+                for k, (pod, node_name) in enumerate(binds):
+                    cur = self.store.pods.get(pod.uid)
+                    if cur is None:
+                        # deleted (or preempted) while deferred: the capacity
+                        # reservation died with the Deleted event; never
+                        # resurrect the pod as bound
+                        self.cache.forget(pod.uid)
+                        continue
+                    if cur.node_name == node_name:
+                        continue  # already published (a crashed flush retried)
+                    self._publish_bind(pod.uid, node_name)
+        except Exception:
+            # publish crashed mid-fan-out: keep the failed bind and the
+            # unprocessed tail deferred (their assumes stay held) so a later
+            # flush or drain retries them — dropping them here would leak
+            # the assumed capacity forever and lose the binds
+            self._deferred_binds = binds[k:] + self._deferred_binds
+            raise
         dt = time.perf_counter() - t0
         self.metrics.observe("pipeline_deferred_commit_seconds", dt)
         if self.tracer.enabled:

@@ -221,6 +221,37 @@ class ClusterStore:
         with self._lock:
             return self._table(kind).get(key)
 
+    # --- snapshot LISTs (lock-consistent reads for concurrent components) ---
+    # The dedicated tables (pods/nodes/pvs/pvcs) mutate IN PLACE under the
+    # store lock; a component thread iterating .values() directly races the
+    # writers ("dictionary changed size during iteration" under the soak).
+    # Controllers, the proxier, kubelets and the apiserver take these
+    # snapshots instead — the informer-cache LIST, one lock hold per pass.
+    # Point reads (d.get(key)) stay lock-free: atomic under CPython.
+    def list_pods(self) -> List[t.Pod]:
+        with self._lock:
+            return list(self.pods.values())
+
+    def list_nodes(self) -> List[t.Node]:
+        with self._lock:
+            return list(self.nodes.values())
+
+    def list_pvs(self) -> List[t.PersistentVolume]:
+        with self._lock:
+            return list(self.pvs.values())
+
+    def list_pvcs(self) -> List[t.PersistentVolumeClaim]:
+        with self._lock:
+            return list(self.pvcs.values())
+
+    def list_pdbs(self) -> List[t.PodDisruptionBudget]:
+        with self._lock:
+            return list(self.pdbs.values())
+
+    def list_node_names(self) -> List[str]:
+        with self._lock:
+            return list(self.nodes)
+
     def list_objects(self, kind: str, namespace: Optional[str] = None) -> list:
         with self._lock:
             out = list(self._table(kind).values())

@@ -24,3 +24,11 @@ assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
     "CPU sim platform not active — jax backend was initialized before "
     f"conftest ran (platform={jax.devices()[0].platform}, n={len(jax.devices())})"
 )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos storms / full-scale runs (tier-1 runs "
+        "-m 'not slow')",
+    )

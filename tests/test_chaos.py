@@ -1,0 +1,672 @@
+"""Chaos parity: under any seeded fault plan, final placements are
+bit-identical to the fault-free serial oracle, every recovery emits a
+`recovery` span plus `framework_fault_recovery_total{site,action}`, and
+every injected fault a `fault_injected` span (ISSUE 3 acceptance).
+
+Tier-1 covers the three acceptance plans (sidecar drop, mid-wave device
+exception, corrupt compile cache) at smoke scale across {pipeline on/off,
+donation on/off}; the full seeded storms are marked `slow`."""
+
+import copy
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop, run_serial
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.config import Profile, TPUScoreArgs
+from kubernetes_tpu.scheduler.metrics import Metrics
+from kubernetes_tpu.scheduler.tracing import TraceCollector, Tracer
+
+from helpers import mk_node, mk_pod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _wave(seed: int, n_nodes: int = 8, n_pods: int = 16) -> Snapshot:
+    rng = np.random.default_rng(seed)
+    nodes = [
+        mk_node(f"w{seed}-n{i}", cpu=int(rng.integers(2000, 8000)))
+        for i in range(n_nodes)
+    ]
+    pods = [
+        mk_pod(f"w{seed}-p{j}", cpu=int(rng.integers(100, 1500)))
+        for j in range(n_pods)
+    ]
+    return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+# --- plan mechanics ---
+def test_fault_plan_is_deterministic_and_parses():
+    a = chaos.FaultPlan.from_seed(11)
+    b = chaos.FaultPlan.from_seed(11)
+    assert a.describe() == b.describe()
+    assert a.describe() != chaos.FaultPlan.from_seed(12).describe()
+    p = chaos.FaultPlan.parse("scheduler.step:error@1;sidecar.rpc:hang@0:0.02")
+    assert p.match("scheduler.step", 1).action == "error"
+    assert p.match("scheduler.step", 0) is None
+    assert p.match("sidecar.rpc", 0).param == 0.02
+    star = chaos.FaultPlan.parse("pipeline.step:nan@*")
+    assert star.match("pipeline.step", 999).action == "nan"
+    with pytest.raises(ValueError):
+        chaos.FaultPlan.parse("no.such.site:error@0")
+    with pytest.raises(ValueError):
+        chaos.FaultPlan.parse("kubelet.sync:nan@0")  # unsupported action
+
+
+def test_poisoned_verdict_detection():
+    assert not chaos.poisoned_verdicts(np.array([0, 3, -1], dtype=np.int32), 4)
+    assert chaos.poisoned_verdicts(np.array([0.0, np.nan]), 4)
+    assert chaos.poisoned_verdicts(np.array([0, 4], dtype=np.int64), 4)
+    assert chaos.poisoned_verdicts(np.array([-7, 1], dtype=np.int64), 4)
+    assert chaos.poisoned_verdicts(chaos.poison(np.array([1, 2, 3])), 4)
+
+
+# --- pipelined loop: mid-wave death -> serial-oracle replay ---
+@pytest.mark.parametrize("action", ["error", "nan"])
+@pytest.mark.parametrize("donate", [False, True])
+def test_pipeline_wave_death_recovers_to_serial_parity(action, donate):
+    waves = [_wave(s) for s in range(4)]
+    oracle = list(run_serial(waves, donate=donate))
+    col = TraceCollector()
+    metrics = Metrics()
+    with chaos.chaos_plan(chaos.FaultPlan.single("pipeline.step", action, at=1)):
+        loop = PipelinedBatchLoop(
+            donate=donate, depth=1,
+            tracer=Tracer(col, component="pipeline"), metrics=metrics,
+        )
+        got = list(loop.run(waves))
+    assert got == oracle  # bit-identical placements, fault or no fault
+    assert loop.stats["recovered"] == 1
+    assert col.spans(name="fault_injected") and col.spans(name="recovery")
+    assert metrics.labeled_counter_total("framework_fault_recovery_total") >= 1
+
+
+def test_pipeline_host_stall_changes_nothing_but_wall():
+    waves = [_wave(s) for s in range(3)]
+    oracle = list(run_serial(waves))
+    with chaos.chaos_plan(
+        chaos.FaultPlan.single("host.stall", "stall", at=0, count=2, param=0.01)
+    ):
+        got = list(PipelinedBatchLoop(depth=1).run(waves))
+    assert got == oracle
+
+
+def test_pipeline_commit_exception_still_drains_inflight_wave():
+    """An exception thrown by the caller's commit callback mid-wave must
+    not leak the dispatched wave: drain() still fetches and commits it."""
+    waves = [_wave(s) for s in range(2)]
+    oracle = list(run_serial(waves))
+    committed = []
+    state = {"boomed": False}
+
+    def commit(v):
+        if not state["boomed"]:
+            state["boomed"] = True
+            raise RuntimeError("commit crash")
+        committed.append(v)
+
+    loop = PipelinedBatchLoop(depth=1, commit=commit)
+    loop.submit(waves[0])
+    with pytest.raises(RuntimeError):
+        loop.submit(waves[1])  # wave 0's commit crashes mid-wave
+    v = loop.drain()  # wave 1 was still tracked in-flight: flushes here
+    assert v == oracle[1] and committed == [oracle[1]]
+
+
+# --- scheduler batch path: acceptance plans x {pipeline, donation} ---
+def _churn_run(pipeline: bool, plan=None, collector=None, donate_env=None):
+    os.environ["KTPU_PIPELINE"] = "1" if pipeline else "0"
+    if donate_env is not None:
+        os.environ["KTPU_DONATE"] = donate_env
+    try:
+        ctx = (
+            chaos.chaos_plan(plan) if plan is not None
+            else __import__("contextlib").nullcontext()
+        )
+        with ctx:
+            store = ClusterStore()
+            for i in range(5):
+                store.add_node(mk_node(f"n{i}", cpu=3000, pods=16))
+            sched = Scheduler(
+                store, SchedulerConfiguration(mode="tpu"), collector=collector
+            )
+            for i in range(20):
+                store.add_pod(mk_pod(f"p{i}", cpu=250))
+            sched.run_until_idle()
+            rng = random.Random(5)
+            for r in range(2):
+                bound = sorted(
+                    (p for p in store.pods.values() if p.node_name),
+                    key=lambda p: p.uid,
+                )
+                for v in rng.sample(bound, 6):
+                    store.delete_pod(v.uid)
+                    q = copy.copy(v)
+                    q.name = f"{v.name}-r{r}"
+                    q.uid = ""
+                    q.node_name = ""
+                    q.__post_init__()
+                    store.add_pod(q)
+                sched.run_until_idle()
+            placements = {p.name: p.node_name for p in store.pods.values()}
+            return placements, sched
+    finally:
+        os.environ.pop("KTPU_PIPELINE", None)
+        if donate_env is not None:
+            os.environ.pop("KTPU_DONATE", None)
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+@pytest.mark.parametrize(
+    "spec",
+    ["scheduler.step:error@1", "scheduler.step:nan@0",
+     "host.stall:stall@0+3:0.005"],
+)
+def test_scheduler_chaos_parity_on_churn(pipeline, spec):
+    """Mid-wave device exception / NaN verdicts / slow-host stalls across
+    {pipeline on, off}: placements bit-identical to the fault-free serial
+    oracle, with recovery metrics + spans wherever a wave actually died."""
+    oracle, _ = _churn_run(pipeline=False)
+    col = TraceCollector()
+    got, sched = _churn_run(
+        pipeline=pipeline, plan=chaos.FaultPlan.parse(spec), collector=col
+    )
+    assert got == oracle
+    assert all(v for v in got.values())
+    if "stall" not in spec:
+        assert (
+            sched.metrics.labeled_counter_total(
+                "framework_fault_recovery_total"
+            ) > 0
+        )
+        assert col.spans(name="fault_injected") and col.spans(name="recovery")
+
+
+def test_scheduler_chaos_parity_with_donation_disabled():
+    oracle, _ = _churn_run(pipeline=False)
+    got, sched = _churn_run(
+        pipeline=True, plan=chaos.FaultPlan.parse("scheduler.step:error@0"),
+        donate_env="0",
+    )
+    assert got == oracle
+    assert sched.metrics.counters["scheduling_wave_recoveries_total"] >= 1
+
+
+def test_commit_crash_releases_assumed_capacity():
+    """A crash mid-commit (apiserver down during the bind fan-out) must
+    release this cycle's assumptions and requeue the stranded pods — no
+    phantom capacity, and a surviving caller's retry completes."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=8000, pods=64))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for i in range(6):
+        store.add_pod(mk_pod(f"p{i}", cpu=100))
+    orig_bind = store.bind
+    calls = {"n": 0}
+
+    def bad_bind(uid, node):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("apiserver down")
+        return orig_bind(uid, node)
+
+    store.bind = bad_bind
+    with pytest.raises(RuntimeError):
+        sched.schedule_batch()
+    assert sched.cache.assumed == {}  # no leaked reservation
+    assert sched._deferred_binds == []
+    assert (
+        sched.metrics.labeled_counter_total("framework_fault_recovery_total")
+        >= 1
+    )
+    sched.run_until_idle()  # requeued pods retry and land
+    assert all(p.node_name == "n0" for p in store.pods.values())
+
+
+def test_commit_crash_requeues_unprocessed_and_keeps_committed_prefix():
+    """A bind crash PART WAY through the fan-out: the already-published
+    prefix stays bound, the failed pod and the unprocessed tail are
+    requeued (not dropped, not double-parked), and no assume leaks."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=8000, pods=64))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for i in range(5):
+        store.add_pod(mk_pod(f"p{i}", cpu=100))
+    orig_bind = store.bind
+    calls = {"n": 0}
+
+    def bad_bind(uid, node):
+        calls["n"] += 1
+        if calls["n"] == 3:  # third publish dies; two pods already bound
+            raise RuntimeError("apiserver down")
+        return orig_bind(uid, node)
+
+    store.bind = bad_bind
+    with pytest.raises(RuntimeError):
+        sched.schedule_batch()
+    bound = [p for p in store.pods.values() if p.node_name]
+    assert len(bound) == 2  # the committed prefix survived
+    assert sched.cache.assumed == {}
+    # the failed pod + unprocessed tail are back in the activeQ, once each
+    assert len(sched.queue) == 3
+    store.bind = orig_bind
+    sched.run_until_idle()
+    assert all(p.node_name == "n0" for p in store.pods.values())
+    assert len(sched.events.by_reason("Scheduled")) == 5
+
+
+def test_deferred_flush_crash_keeps_tail_for_retry():
+    """A store.bind exception mid-flush must keep the failed bind and the
+    unprocessed tail in _deferred_binds (assumes held) so a later flush
+    publishes them — not silently drop them as phantom capacity."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=8000, pods=64))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    pods = [mk_pod(f"d{i}", cpu=100) for i in range(3)]
+    for p in pods:
+        store.add_pod(p)
+        sched.cache.assume(p.uid, "n0")
+        sched._deferred_binds.append((p, "n0"))
+    orig_bind = store.bind
+    calls = {"n": 0}
+
+    def bad_bind(uid, node):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("apiserver down")
+        return orig_bind(uid, node)
+
+    store.bind = bad_bind
+    with pytest.raises(RuntimeError):
+        sched._flush_deferred_binds()
+    # bind 1 published; binds 2+3 retained for retry, reservations held
+    assert [p.name for p, _ in sched._deferred_binds] == ["d1", "d2"]
+    assert set(sched.cache.assumed) == {pods[1].uid, pods[2].uid}
+    store.bind = orig_bind
+    sched._flush_deferred_binds()
+    assert sched._deferred_binds == []
+    assert all(p.node_name == "n0" for p in store.pods.values())
+
+
+# --- sidecar: drop / hang / partial / budget ---
+def _sidecar_rig(n_nodes=4, n_pods=8):
+    from kubernetes_tpu.runtime import TPUScoreServer
+
+    srv = TPUScoreServer()
+    srv.start()
+    snap = Snapshot(
+        nodes=[mk_node(f"n{i}", cpu=4000) for i in range(n_nodes)],
+        pending_pods=[mk_pod(f"p{j}", cpu=300) for j in range(n_pods)],
+    )
+    return srv, snap
+
+
+@pytest.mark.parametrize("spec", [
+    "sidecar.rpc:error@0",            # dropped connection on the first try
+    "sidecar.rpc:hang@0:0.01",        # hang then drop
+    "sidecar.rpc:partial@0",          # truncated response (must be DETECTED)
+])
+def test_sidecar_fault_retries_to_identical_verdicts(spec):
+    from kubernetes_tpu.runtime import TPUScoreClient
+
+    srv, snap = _sidecar_rig()
+    try:
+        clean = TPUScoreClient(f"127.0.0.1:{srv.port}")
+        want = clean.schedule(snap, deadline_ms=60_000)
+        clean.close()
+        client = TPUScoreClient(
+            f"127.0.0.1:{srv.port}", backoff_base_s=0.001
+        )
+        with chaos.chaos_plan(chaos.FaultPlan.parse(spec)):
+            got = client.schedule(snap, deadline_ms=60_000)
+        assert got == want
+        assert client.stats["retries"] >= 1
+        assert (
+            client.metrics.labeled_counter_total(
+                "framework_fault_recovery_total"
+            ) >= 1
+        )
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_sidecar_failure_budget_degrades_then_recovers():
+    """failure_budget consecutive exhausted calls trip the circuit: the
+    channel fails fast (no dial) until the cooldown, then one half-open
+    probe restores it on success."""
+    from kubernetes_tpu.runtime import SidecarUnavailable, TPUScoreClient
+
+    srv, snap = _sidecar_rig()
+    try:
+        client = TPUScoreClient(
+            f"127.0.0.1:{srv.port}", max_attempts=1, backoff_base_s=0.001,
+            failure_budget=2, degraded_cooldown_s=0.05,
+        )
+        with chaos.chaos_plan(chaos.FaultPlan.parse("sidecar.rpc:error@0+2")):
+            for _ in range(2):
+                with pytest.raises(SidecarUnavailable):
+                    client.schedule(snap, deadline_ms=60_000)
+            assert client.degraded
+            assert client.metrics.counters["sidecar_degraded_total"] == 1
+            # fail-fast while degraded: no RPC attempted, so the chaos
+            # site counter must not advance
+            before = chaos.active().counts.get("sidecar.rpc", 0)
+            with pytest.raises(SidecarUnavailable):
+                client.schedule(snap, deadline_ms=60_000)
+            assert chaos.active().counts.get("sidecar.rpc", 0) == before
+            time.sleep(0.06)  # cooldown: half-open probe allowed (no fault now)
+            got = client.schedule(snap, deadline_ms=60_000)
+        assert not client.degraded
+        assert client.metrics.counters["sidecar_degraded_recovered_total"] == 1
+        assert sorted(got) == sorted(p.uid for p in snap.pending_pods)
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_half_open_probe_is_a_single_attempt():
+    """After the degraded cooldown the probe call makes exactly ONE
+    transport attempt — never the full retry ladder inside one cycle."""
+    from kubernetes_tpu.runtime import SidecarUnavailable, TPUScoreClient
+
+    srv, snap = _sidecar_rig()
+    try:
+        client = TPUScoreClient(
+            f"127.0.0.1:{srv.port}", max_attempts=3, backoff_base_s=0.001,
+            failure_budget=1, degraded_cooldown_s=0.01,
+        )
+        with chaos.chaos_plan(chaos.FaultPlan.parse("sidecar.rpc:error@*")):
+            with pytest.raises(SidecarUnavailable):
+                client.schedule(snap, deadline_ms=60_000)  # trips the budget
+            assert client.degraded
+            time.sleep(0.02)  # cooldown elapsed: next call is the probe
+            before = chaos.active().counts.get("sidecar.rpc", 0)
+            with pytest.raises(SidecarUnavailable):
+                client.schedule(snap, deadline_ms=60_000)
+            assert chaos.active().counts["sidecar.rpc"] == before + 1
+        assert client.degraded  # failed probe re-armed the cooldown
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_scheduler_parity_through_sidecar_drop():
+    """Acceptance plan 1: a sidecar-routed scheduler wave whose first RPC
+    drops retries in-call and lands the SAME placements as the fault-free
+    run."""
+    from kubernetes_tpu.runtime import TPUScoreServer
+
+    def run(plan):
+        srv = TPUScoreServer()
+        srv.start()
+        try:
+            store = ClusterStore()
+            for i in range(4):
+                store.add_node(mk_node(f"n{i}", cpu=4000))
+            prof = Profile(tpu_score=TPUScoreArgs(
+                sidecar_address=f"127.0.0.1:{srv.port}", deadline_ms=60_000,
+            ))
+            sched = Scheduler(
+                store, SchedulerConfiguration(profiles=(prof,), mode="tpu")
+            )
+            sched._sidecars[f"127.0.0.1:{srv.port}"] = None
+            for j in range(10):
+                store.add_pod(mk_pod(f"p{j}", cpu=300))
+            ctx = (
+                chaos.chaos_plan(plan) if plan is not None
+                else __import__("contextlib").nullcontext()
+            )
+            with ctx:
+                sched.run_until_idle()
+            return {p.name: p.node_name for p in store.pods.values()}, sched
+        finally:
+            srv.stop()
+
+    want, _ = run(None)
+    got, sched = run(chaos.FaultPlan.parse("sidecar.rpc:error@0"))
+    assert got == want and all(v for v in got.values())
+    assert sched.metrics.counters.get("sidecar_rpc_failures_total", 0) >= 1
+
+
+def test_health_failure_marks_degraded_track_and_resyncs(monkeypatch):
+    """Satellite regression: health() must never swallow a transport error
+    silently — it increments sidecar_health_failures_total, counts toward
+    the budget, and forces the next schedule() to full-resync (the
+    reconnect-after-health-failure path)."""
+    from kubernetes_tpu.runtime import SidecarUnavailable, TPUScoreClient
+
+    srv, snap = _sidecar_rig()
+    try:
+        client = TPUScoreClient(f"127.0.0.1:{srv.port}")
+        client.schedule(snap, deadline_ms=60_000)
+        assert client.stats["full"] == 1 and client._synced
+        with chaos.chaos_plan(chaos.FaultPlan.parse("sidecar.health:error@0")):
+            with pytest.raises(SidecarUnavailable):
+                client.health()
+        assert client.metrics.counters["sidecar_health_failures_total"] == 1
+        assert client._consecutive_failures == 1
+        assert not client._synced  # the server may have restarted
+        # reconnect: the next schedule re-sends the FULL snapshot
+        got = client.schedule(snap, deadline_ms=60_000)
+        assert client.stats["full"] == 2 and client.stats["delta"] == 0
+        assert sorted(got) == sorted(p.uid for p in snap.pending_pods)
+        assert client._consecutive_failures == 0  # success reset the budget
+        client.close()
+    finally:
+        srv.stop()
+
+
+# --- compile cache corruption ---
+def test_scrub_compile_cache_drops_truncated_entries(tmp_path):
+    from kubernetes_tpu.ops.aot import scrub_compile_cache
+
+    (tmp_path / "a-cache").write_bytes(b"")          # zero-length
+    (tmp_path / "b-cache").write_bytes(b"\x00ba")    # truncated-at-3
+    (tmp_path / "c-cache").write_bytes(b"x" * 64)    # plausible entry
+    assert scrub_compile_cache(str(tmp_path)) == 2
+    assert sorted(f.name for f in tmp_path.iterdir()) == ["c-cache"]
+    assert scrub_compile_cache(str(tmp_path), aggressive=True) == 1
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_corrupt_compile_cache_entry_recompiles_not_raises(tmp_path):
+    """Acceptance plan 3 (satellite 1): a truncated/corrupt entry in
+    KTPU_COMPILE_CACHE_DIR falls back to a fresh compile that overwrites
+    the bad entry — warmup never raises.  Subprocesses: the persistent
+    cache only writes on a real in-process-cache miss."""
+    import subprocess
+    import sys
+
+    cache = str(tmp_path / "cc")
+    prog = (
+        "from kubernetes_tpu.bench._cpu import force_cpu_from_env\n"
+        "force_cpu_from_env()\n"
+        "from kubernetes_tpu.ops import aot\n"
+        "aot.maybe_enable_compile_cache()\n"
+        "from kubernetes_tpu.api.snapshot import Snapshot, encode_snapshot\n"
+        "from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config\n"
+        "from helpers import mk_node, mk_pod\n"
+        "snap = Snapshot(nodes=[mk_node('n%d' % i) for i in range(3)],\n"
+        "                pending_pods=[mk_pod('p%d' % j) for j in range(4)])\n"
+        "arr, _ = encode_snapshot(snap)\n"
+        "cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)\n"
+        "assert aot.warm_kernels(arr, cfg, batch=False) >= 1\n"
+    )
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KTPU_COMPILE_CACHE_DIR=cache, PYTHONPATH=tests_dir)
+    kw = dict(env=env, capture_output=True, text=True, timeout=300,
+              cwd=os.path.dirname(tests_dir))
+    r = subprocess.run([sys.executable, "-c", prog], **kw)
+    assert r.returncode == 0, r.stderr[-2000:]
+    entries = [f for f in os.listdir(cache)]
+    assert entries, "warmup wrote no cache entries"
+    # corrupt every entry the way a crash mid-write does
+    for name in entries:
+        with open(os.path.join(cache, name), "wb") as f:
+            f.write(b"\x00bad")
+    r = subprocess.run([sys.executable, "-c", prog], **kw)
+    assert r.returncode == 0, (
+        "warmup raised on a corrupt cache entry:\n" + r.stderr[-2000:]
+    )
+    # the bad 4-byte entries were dropped/overwritten by fresh compiles
+    assert all(
+        os.path.getsize(os.path.join(cache, f)) > 4 for f in os.listdir(cache)
+    )
+
+
+def test_genuine_compile_error_does_not_wipe_cache(tmp_path, monkeypatch):
+    """A real compile error (not corruption) must escape with the shared
+    cache dir untouched — other processes depend on its valid entries."""
+    from kubernetes_tpu.ops import aot
+
+    (tmp_path / "valid-entry-cache").write_bytes(b"x" * 64)
+    monkeypatch.setattr(aot, "_enabled_dir", str(tmp_path))
+    seen = []
+    monkeypatch.setattr(
+        "jax.config.update", lambda k, v: seen.append((k, v))
+    )
+
+    class BadKernel:
+        def lower(self, arr, cfg):
+            raise RuntimeError("genuine lowering bug")
+
+    with pytest.raises(RuntimeError, match="genuine"):
+        aot._compile_with_cache_recovery(BadKernel(), None, None)
+    # the valid entry survived, and the cache was re-enabled on the way out
+    assert (tmp_path / "valid-entry-cache").read_bytes() == b"x" * 64
+    assert seen[-1] == ("jax_compilation_cache_dir", str(tmp_path))
+
+
+def test_kubelet_sync_crash_rollback_leaves_no_orphan_sandbox():
+    """A crash AFTER the sandbox was created rolls the admission back
+    through the CRI teardown: no orphaned sandbox (or leaked pod IP), and
+    the retry ends with exactly one sandbox."""
+    from kubernetes_tpu.scheduler.kubelet import HollowKubelet
+    from kubernetes_tpu.scheduler.leases import LeaseStore
+    from kubernetes_tpu.scheduler.queue import FakeClock
+    from kubernetes_tpu.api import types as t
+
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    kubelet = HollowKubelet(store, LeaseStore(clock=clock), "n0", clock=clock)
+    orig_create = kubelet.runtime.create_container
+    calls = {"n": 0}
+
+    def bad_create(sandbox_id, config):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("runtime hiccup after sandbox creation")
+        return orig_create(sandbox_id, config)
+
+    kubelet.runtime.create_container = bad_create
+    store.add_pod(mk_pod("sandboxed", node_name="n0"))
+    kubelet.tick()  # crash mid-sync, after run_pod_sandbox
+    assert kubelet.sync_failures == 1
+    assert kubelet.runtime.list_pod_sandboxes() == []  # rolled back via CRI
+    kubelet.tick()  # retry succeeds
+    assert store.pods["default/sandboxed"].phase == t.PHASE_RUNNING
+    assert len(kubelet.runtime.list_pod_sandboxes()) == 1
+
+
+# --- kubelet sync crash ---
+def test_kubelet_sync_crash_is_contained_and_retried():
+    from kubernetes_tpu.scheduler.kubelet import HollowKubelet
+    from kubernetes_tpu.scheduler.leases import LeaseStore
+    from kubernetes_tpu.scheduler.queue import FakeClock
+    from kubernetes_tpu.api import types as t
+
+    clock = FakeClock()
+    store = ClusterStore()
+    store.add_node(mk_node("n0"))
+    kubelet = HollowKubelet(store, LeaseStore(clock=clock), "n0", clock=clock)
+    store.add_pod(mk_pod("crashy", node_name="n0"))
+    with chaos.chaos_plan(chaos.FaultPlan.parse("kubelet.sync:crash@0")):
+        kubelet.tick()  # injected crash: contained, nothing started
+        assert kubelet.sync_failures == 1
+        assert store.pods["default/crashy"].phase == ""
+        assert not kubelet.workers["default/crashy"].admitted
+        kubelet.tick()  # fault exhausted: the retry admits and starts
+    assert store.pods["default/crashy"].phase == t.PHASE_RUNNING
+    assert kubelet.sync_failures == 1
+
+
+# --- queue backoff jitter (satellite) ---
+def test_backoff_jitter_is_bounded_capped_and_seeded():
+    from kubernetes_tpu.scheduler.queue import FakeClock, PriorityQueue
+
+    def maturities(seed):
+        clock = FakeClock()
+        q = PriorityQueue(clock, backoff_jitter=0.25, jitter_seed=seed,
+                          initial_backoff_s=1.0, max_backoff_s=10.0)
+        out = []
+        for i in range(32):
+            p = mk_pod(f"j{i}")
+            q._attempts[p.uid] = 6  # deep retry: base hits the 10 s cap
+            with q._lock:
+                q._push_backoff(p)
+            out.append(q._backoff[-1][0])
+        return out
+
+    a, b, c = maturities(1), maturities(1), maturities(2)
+    assert a == b  # seeded: reproducible
+    assert a != c
+    assert all(10.0 <= m < 10.0 * 1.25 for m in a)  # capped base + bounded jitter
+    assert len(set(a)) > 16  # actually de-correlated, not one synchronized storm
+
+
+def test_backoff_cap_and_jitter_flow_from_config():
+    store = ClusterStore()
+    cfg = SchedulerConfiguration(
+        mode="tpu", pod_initial_backoff_seconds=0.5,
+        pod_max_backoff_seconds=4.0, pod_backoff_jitter=0.2,
+    )
+    sched = Scheduler(store, cfg)
+    q = sched.queue
+    assert (q.initial_backoff_s, q.max_backoff_s, q.backoff_jitter) == (0.5, 4.0, 0.2)
+    q._attempts["default/x"] = 10
+    assert q.backoff_duration("default/x") == 4.0  # capped by config
+    from kubernetes_tpu.scheduler.config import validate
+
+    assert validate(SchedulerConfiguration(pod_backoff_jitter=-1.0))
+    assert validate(SchedulerConfiguration(pod_max_backoff_seconds=0.1))
+
+
+# --- seeded storms (full matrix is slow; tier-1 gets one smoke seed) ---
+def _storm_run(seed):
+    col = TraceCollector()
+    got, sched = _churn_run(
+        pipeline=True,
+        plan=chaos.FaultPlan.from_seed(
+            seed, sites=("scheduler.step", "host.stall"), n_faults=4
+        ),
+        collector=col,
+    )
+    return got, sched, col
+
+
+def test_chaos_storm_smoke_seed0():
+    oracle, _ = _churn_run(pipeline=False)
+    got, sched, col = _storm_run(0)
+    assert got == oracle
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_chaos_storm_full(seed):
+    oracle, _ = _churn_run(pipeline=False)
+    got, _, _ = _storm_run(seed)
+    assert got == oracle

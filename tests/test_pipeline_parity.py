@@ -293,14 +293,17 @@ def test_sidecar_without_active_span_starts_fresh_trace():
 
 
 def test_pipeline_smoke_overlap_and_route():
-    """CI smoke (satellite): a tiny streaming workload through the
+    """CI smoke (satellite): a small streaming workload through the
     pipelined loop reports the kernel route taken and a NONZERO overlap
-    fraction; --no-pipeline reports exactly zero."""
+    fraction; --no-pipeline reports exactly zero.  Wave size is chosen so
+    the device step is long enough to OBSERVE running on a loaded 2-core
+    box — at 6x10 the step can finish before any host phase samples it and
+    the fraction legitimately reads 0 (flaked under full-suite load)."""
     from kubernetes_tpu.bench.harness import run_streaming_workload
 
-    waves = [_wave(s, n_nodes=6, n_pods=10) for s in range(4)]
+    waves = [_wave(s, n_nodes=48, n_pods=96) for s in range(4)]
     out = run_streaming_workload("smoke", waves, warmup=True)
-    assert out["waves"] == 4 and out["n_pods"] == 40
+    assert out["waves"] == 4 and out["n_pods"] == 384
     assert out["overlap_fraction"] > 0.0
     assert sum(out["route_trace_counts"].values()) > 0
     off = run_streaming_workload("smoke-off", waves, warmup=False,
