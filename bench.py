@@ -91,6 +91,21 @@ def main() -> None:
         # before the first jit call suffices.
         from kubernetes_tpu.bench._cpu import force_cpu_from_env
 
+        # KTPU_MESH on the CPU fallback needs that many VIRTUAL host
+        # devices, and the flag must precede first backend use
+        try:
+            mesh_req = int(os.environ.get("KTPU_MESH", "1") or 1)
+        except ValueError:
+            mesh_req = 1
+        if mesh_req > 1:
+            parts = [
+                f for f in os.environ.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f
+            ]
+            parts.append(
+                f"--xla_force_host_platform_device_count={mesh_req}"
+            )
+            os.environ["XLA_FLAGS"] = " ".join(parts)
         force_cpu_from_env(always=True)
         os.environ.setdefault("KTPU_FORCE_CHUNKED", "1")
         platform = "cpu-sim-fallback"
@@ -113,11 +128,20 @@ def main() -> None:
     # process pays the cold compile; every later one loads the executable
     cache_dir = maybe_enable_compile_cache()
     don = donation_supported()
+    # KTPU_MESH=<n>: run the routed north-star step node-axis sharded over
+    # n chips (parallel/sharded.py); the encoder places resident buffers
+    # shard-wise so warm deltas update shards in place
+    from kubernetes_tpu.parallel.mesh import mesh_from_env, shard_hbm_estimate
+
+    mesh = mesh_from_env()
+    n_shards = int(mesh.size) if mesh is not None else 1
     print(f"platform: {platform}  devices: {jax.devices()}", file=sys.stderr)
+    if mesh is not None:
+        print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
     if cache_dir:
         print(f"compile cache: {cache_dir}", file=sys.stderr)
     snap = heterogeneous(N_NODES, N_PODS, seed=0)
-    enc = DeltaEncoder()
+    enc = DeltaEncoder(mesh=mesh)
 
     t0 = time.perf_counter()
     arr, meta = enc.encode(snap)
@@ -136,13 +160,17 @@ def main() -> None:
     # axon TPU tunnel, so timing forces a (tiny) host transfer of the choices
     # vector — which is also what a real sidecar client would consume.
     t0 = time.perf_counter()
-    choices = np.asarray(schedule_batch_routed(arr, cfg, donate=don)[0])
+    choices = np.asarray(
+        schedule_batch_routed(arr, cfg, donate=don, mesh=mesh)[0]
+    )
     print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     t_step = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        choices = np.asarray(schedule_batch_routed(arr, cfg, donate=don)[0])
+        choices = np.asarray(
+            schedule_batch_routed(arr, cfg, donate=don, mesh=mesh)[0]
+        )
         t_step = min(t_step, time.perf_counter() - t0)
 
     # the pre-chunking per-pod scan, for the delta the chunked path buys
@@ -180,7 +208,7 @@ def main() -> None:
 
     pipeline = os.environ.get("KTPU_PIPELINE") != "0"
     loop = PipelinedBatchLoop(
-        encoder=enc, donate=don, depth=1 if pipeline else 0
+        encoder=enc, donate=don, depth=1 if pipeline else 0, mesh=mesh
     )
 
     def mk_wave(w):
@@ -282,6 +310,13 @@ def main() -> None:
                 "overlap_fraction": round(overlap_fraction, 3),
                 "donated_waves": int(loop.stats["donated"]),
                 "compile_cache_dir": cache_dir,
+                # mesh scale-out: shard count + the per-shard HBM estimate
+                # of the kernel's dominant blocks at this shape
+                "n_shards": n_shards,
+                "per_shard_hbm_bytes": shard_hbm_estimate(
+                    arr.P, arr.N, n_shards, arr.R,
+                    n_terms=arr.term_counts0.shape[0],
+                )["total"],
                 # which kernel the routed call actually compiled (trace-time
                 # proof; the fallback must exercise the production route)
                 "route_trace_counts": dict(_trace_counts()),

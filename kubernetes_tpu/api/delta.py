@@ -792,12 +792,20 @@ class DeltaEncoder:
         bucket: bool = True,
         hard_pod_affinity_weight: float = 1.0,
         debug_verify: bool = False,
+        mesh=None,
     ):
         self.bucket = bucket
         self.hpaw = hard_pod_affinity_weight
         self._cs: Optional[ClusterSide] = None
         self._dev: Dict[str, Tuple] = {}  # field -> (host array, device array)
         self.stats = {"full": 0, "delta": 0, "verified": 0}
+        # device mesh for resident-buffer placement (set_mesh): node-axis
+        # arrays are placed with NamedSharding so the sharded step reads
+        # them in place — warm deltas re-place only changed fields' shards
+        self._mesh = None
+        self._pad_memo: Dict[str, Tuple] = {}
+        if mesh is not None:
+            self.set_mesh(mesh)
         # Cache validity is conditioned on OBJECT IDENTITY (_nodes_fp, record
         # `is` checks) under the repo-wide copy-on-write convention for
         # Node/Pod; an in-place mutation anywhere would silently serve stale
@@ -855,16 +863,64 @@ class DeltaEncoder:
         avoids the problem entirely with encode_device(fresh=True)."""
         self._dev.clear()
 
+    def set_mesh(self, mesh) -> None:
+        """Place all subsequent device buffers over `mesh`: node-axis arrays
+        sharded per parallel/sharded.py's spec table (NamedSharding), the
+        rest replicated — so a mesh-routed step (ops/assign.py —
+        schedule_batch_routed(mesh=)) reads the RESIDENT shards in place and
+        a warm-cycle delta re-places only the changed fields, never
+        gathering or re-scattering the cluster side.  Node counts not
+        divisible by the mesh are padded with permanently invalid nodes at
+        placement time (parallel/mesh.py padding semantics), memoized by
+        host-array identity so the resident-reuse table still hits.
+        Changing the mesh drops the resident buffers (old placement)."""
+        if mesh is not self._mesh:
+            self._mesh = mesh
+            self._dev.clear()
+            self._pad_memo.clear()
+
+    def _pad_for_mesh(self, name: str, a, pad: int, d_sentinel: int, n: int):
+        """Per-field node-axis padding (the one shared rule set —
+        parallel/mesh.py pad_field), memoized by input-array identity so
+        unchanged fields keep one stable padded object across cycles (the
+        resident-buffer identity check depends on it)."""
+        from ..parallel.mesh import pad_field
+
+        memo = self._pad_memo.get(name)
+        if memo is not None and memo[0] is a:
+            return memo[1]
+        p = pad_field(name, a, pad, d_sentinel, n)
+        if p is a:
+            return a
+        self._pad_memo[name] = (a, p)
+        return p
+
     def _to_device(self, arr, meta, fresh: bool = False):
         import dataclasses as _dc
 
         import jax
 
+        mesh = self._mesh
+        if mesh is not None:
+            from ..parallel.mesh import NODE_AXIS
+            from ..parallel.sharded import field_shardings
+
+            n_shards = int(mesh.shape[NODE_AXIS])
+            pad = (-arr.N) % n_shards
+            d_sentinel = arr.term_counts0.shape[1] - 1
+            sh = field_shardings(mesh, arr.image_score.shape[1] == arr.N)
+            n = arr.N
         out = {}
         for f in _dc.fields(type(arr)):
             a = getattr(arr, f.name)
+            if mesh is not None:
+                if pad:
+                    a = self._pad_for_mesh(f.name, a, pad, d_sentinel, n)
+                put = lambda x, _s=sh[f.name]: jax.device_put(x, _s)  # noqa: E731
+            else:
+                put = jax.device_put
             if fresh:
-                out[f.name] = jax.device_put(a)
+                out[f.name] = put(a)
                 continue
             ent = self._dev.get(f.name)
             if ent is not None and (
@@ -880,7 +936,7 @@ class DeltaEncoder:
             ):
                 out[f.name] = ent[1]
             else:
-                d = jax.device_put(a)
+                d = put(a)
                 self._dev[f.name] = (a, d)
                 out[f.name] = d
         return type(arr)(**out), meta

@@ -217,14 +217,16 @@ def run_streaming_workload(
     pipeline=False (the --no-pipeline escape hatch) runs ONLY the serial
     loop, so pre-pipeline numbers remain reproducible bit-for-bit."""
     from ..ops.assign import TRACE_COUNTS
+    from ..parallel.mesh import mesh_from_env
     from ..parallel.pipeline import PipelinedBatchLoop, run_serial
     from ..scheduler.tracing import Tracer
 
+    mesh = mesh_from_env()  # KTPU_MESH: sharded routed step under the loop
     if warmup:  # hit the XLA cache so the timed runs measure steady state
-        for _ in PipelinedBatchLoop(donate=donate).run(waves[:1]):
+        for _ in PipelinedBatchLoop(donate=donate, mesh=mesh).run(waves[:1]):
             pass
     t0 = time.perf_counter()
-    serial = list(run_serial(waves, donate=donate))
+    serial = list(run_serial(waves, donate=donate, mesh=mesh))
     t_serial = time.perf_counter() - t0
     out = {
         "name": name,
@@ -232,6 +234,7 @@ def run_streaming_workload(
         "n_pods": sum(len(w.pending_pods) for w in waves),
         "serial_s": round(t_serial, 3),
         "pipeline": pipeline,
+        "n_shards": int(mesh.size) if mesh is not None else 1,
         "route_trace_counts": dict(TRACE_COUNTS),
     }
     pods = out["n_pods"]
@@ -242,7 +245,7 @@ def run_streaming_workload(
         )
         return out
     tracer = Tracer(collector, component="pipeline") if collector else None
-    runner = PipelinedBatchLoop(donate=donate, tracer=tracer)
+    runner = PipelinedBatchLoop(donate=donate, tracer=tracer, mesh=mesh)
     t0 = time.perf_counter()
     pipelined = list(runner.run(waves))
     t_pipe = time.perf_counter() - t0

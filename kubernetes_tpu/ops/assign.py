@@ -63,6 +63,53 @@ def _rmin(x, axis_name):
     return lax.pmin(m, axis_name) if axis_name else m
 
 
+def _gather_cols(x, cols, axis_name, base, local_n):
+    """x[:, cols] for a node-axis-sharded x [C, local_n] and GLOBAL column ids
+    cols [D] -> [D]-column matrix [C, D], replicated: the owner shard
+    contributes its columns, psum broadcasts.  Zero-fill is exact — exactly
+    one shard owns each id, and v + 0 == v for every finite v and ±inf."""
+    if not axis_name:
+        return jnp.take_along_axis(x, cols[None, :], axis=1)
+    isbool = x.dtype == jnp.bool_
+    xv = x.astype(jnp.int32) if isbool else x
+    mine = (cols >= base) & (cols < base + local_n)
+    lc = jnp.where(mine, cols - base, 0)
+    v = jnp.take_along_axis(xv, lc[None, :], axis=1)
+    v = jnp.where(mine[None, :], v, 0)
+    out = lax.psum(v, axis_name)
+    return out > 0 if isbool else out
+
+
+def _gather_at_nodes(x, rows, nodes, axis_name, base, local_n):
+    """x[rows, nodes] for a node-axis-sharded x [T, local_n] and GLOBAL node
+    ids — the owner-shard psum broadcast (same pattern as schedule_scan's
+    committed-domain column)."""
+    if not axis_name:
+        return x[rows, nodes]
+    mine = (nodes >= base) & (nodes < base + local_n)
+    v = jnp.where(mine, x[rows, jnp.where(mine, nodes - base, 0)], 0)
+    return lax.psum(v, axis_name)
+
+
+def _global_top_k(vals, k, axis_name, base):
+    """lax.top_k over the GLOBAL node axis of a node-axis-sharded [C, local_n]
+    array -> (values [C, k], GLOBAL ids [C, k]), bit-identical — values, ids,
+    order, lowest-index ties — to single-device top_k on the concatenation:
+    an entry outside its shard's local top-k has >= k better-or-equal-ranked
+    entries in that shard alone, so it cannot rank globally; shard-local
+    lists keep equal values in ascending local-index order and the all_gather
+    concatenates in shard order (= ascending global index), so the merge's
+    lowest-position tie-break IS the lowest-global-index tie-break."""
+    if not axis_name:
+        return lax.top_k(vals, k)
+    kl = min(k, vals.shape[-1])
+    lv, li = lax.top_k(vals, kl)
+    av = lax.all_gather(lv, axis_name, axis=1, tiled=True)  # [C, S*kl]
+    ai = lax.all_gather(li + base, axis_name, axis=1, tiled=True)
+    mv, mp = lax.top_k(av, k)
+    return mv, jnp.take_along_axis(ai, mp, axis=1)
+
+
 def _preferred_node_affinity_raw(arr: ClusterArrays, term_matches: jax.Array) -> jax.Array:
     """f32[P, N]: summed weights of matching preferred node-affinity terms
     (nodeaffinity/node_affinity.go — Score).  One [P, S] @ [S, N] matmul."""
@@ -75,14 +122,28 @@ def _preferred_node_affinity_raw(arr: ClusterArrays, term_matches: jax.Array) ->
     return W @ term_matches.astype(jnp.float32)
 
 
+def _image_on(arr: ClusterArrays, cfg: ScoreConfig, image_sharded) -> bool:
+    """Whether the ImageLocality stage has a real [P, N] matrix.  Under
+    shard_map the local-shape heuristic (shape[1] == arr.N) is ambiguous when
+    the local node count collapses to the replicated matrix's width of 1, so
+    sharded callers resolve the check at GLOBAL shape and pass the verdict in
+    as `image_sharded`."""
+    if not cfg.enable_image:
+        return False
+    if image_sharded is not None:
+        return bool(image_sharded)
+    return arr.image_score.shape[1] == arr.N
+
+
 def schedule_scan(
-    arr: ClusterArrays, cfg: ScoreConfig, axis_name: Optional[str] = None
+    arr: ClusterArrays, cfg: ScoreConfig, axis_name: Optional[str] = None,
+    image_sharded: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """The full scheduling step.  `arr` holds the whole cluster when
     axis_name is None, or this shard's node slice under shard_map.
 
     Returns (assignment i32[P] — GLOBAL node index or -1, node_used i32[N,R])."""
-    TRACE_COUNTS["plain"] += 1
+    TRACE_COUNTS["sharded_plain" if axis_name else "plain"] += 1
     local_n = arr.N
     if axis_name:
         base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
@@ -134,7 +195,7 @@ def schedule_scan(
             xs["pref_w"] = arr.pod_pref_aff_w
     if cfg.enable_ports:
         xs["ports"] = arr.pod_ports
-    if cfg.enable_image and arr.image_score.shape[1] == arr.N:
+    if _image_on(arr, cfg, image_sharded):
         xs["img"] = arr.image_score
 
     def norm_reverse(counts, feasible):
@@ -289,7 +350,13 @@ _REPAIR_ITERS = int(os.environ.get("KTPU_REPAIR_ITERS", "1"))
 # kernel a routed call compiled — the routing env override is read at trace
 # time, so asserting on the predicate alone can be vacuous against a warm
 # jit cache.
-TRACE_COUNTS = {"plain": 0, "chunked": 0, "rounds": 0}
+TRACE_COUNTS = {
+    "plain": 0, "chunked": 0, "rounds": 0,
+    # mesh-sharded variants (parallel/sharded.py): bumped when the kernel
+    # traces under shard_map, so tests/benches can prove a routed call
+    # actually compiled the sharded program for its route
+    "sharded_plain": 0, "sharded_chunked": 0, "sharded_rounds": 0,
+}
 
 
 def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
@@ -330,7 +397,8 @@ def _chunk_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 
 def schedule_scan_chunked(
     arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False,
-    with_ordinals: bool = False,
+    with_ordinals: bool = False, axis_name: Optional[str] = None,
+    axis_size: int = 1, image_sharded: Optional[bool] = None,
 ):
     """Chunked sequential-commit scan via PREFIX-COMMIT SPECULATION rounds,
     BIT-IDENTICAL to schedule_scan for fit+balanced-only configs
@@ -377,10 +445,37 @@ def schedule_scan_chunked(
     iteration on v5e regardless of the body.  Node usage [N, R] is updated
     once per chunk from the committed choices.  Exact because fit/least/
     balanced depend on per-node usage only — there are no cross-node
-    normalizations on this path."""
-    TRACE_COUNTS["chunked"] += 1
+    normalizations on this path.
+
+    SHARDED EXECUTION (axis_name set, parallel/sharded.py): the node axis of
+    every [*, N] input is a shard_map slice.  The expensive parts — the
+    [P, Nl] static-feasibility masks and the per-chunk [C, Nl, R] hoist —
+    stay shard-local; ONE all_gather per chunk stitches the masked [C, N]
+    score matrix (elementwise math on a node slice is bit-identical to the
+    same columns of the dense hoist, so the gathered matrix IS the
+    single-device total0), and the prefix-commit round loop then runs
+    REPLICATED on it: literally the single-device code on identical inputs,
+    so decisions are bit-identical by construction.  The [N, R] usage/alloc
+    arrays are all-gathered once and carried replicated (they are ~1000x
+    smaller than the masks; the candidate-column alternative would gather
+    [C, C*K] ≈ the same bytes as [C, N] with far more collectives).  The
+    loop's per-round cost is O(C^2), independent of N — only the hoist
+    scales with the node axis, and the hoist is what shards."""
+    TRACE_COUNTS["sharded_chunked" if axis_name else "chunked"] += 1
     local_n = arr.N
-    my_nodes = jnp.arange(local_n, dtype=jnp.int32)
+    if axis_name:
+        base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
+        N = local_n * axis_size
+        n_alloc_full = lax.all_gather(
+            arr.node_alloc, axis_name, axis=0, tiled=True
+        )
+        used_init = lax.all_gather(arr.node_used, axis_name, axis=0, tiled=True)
+    else:
+        base = jnp.int32(0)
+        N = local_n
+        n_alloc_full = arr.node_alloc
+        used_init = arr.node_used
+    my_nodes = base + jnp.arange(local_n, dtype=jnp.int32)
 
     tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
     nodesel = filters.node_selection_ok_from(tm, arr)
@@ -393,8 +488,8 @@ def schedule_scan_chunked(
         & nodesel
         & nodename_ok
     )
-    n_alloc = arr.node_alloc
-    P, N, R = arr.P, arr.N, arr.R
+    n_alloc = arr.node_alloc  # LOCAL node slice — hoist-side only
+    P, R = arr.P, arr.R
     C = _CHUNK
     K = min(C + 1, N)  # K == N: the list is exhaustive, guarded by .any()
     Z = min(_SPECZ, K)  # usable entries precomputed for pass-1 speculation
@@ -404,7 +499,7 @@ def schedule_scan_chunked(
     jlt = idxC[None, :] < idxC[:, None]  # [i, j]: j < i
 
     reqs = arr.pod_req.reshape(P // C, C, R)
-    sfs = sf.reshape(P // C, C, N)
+    sfs = sf.reshape(P // C, C, local_n)
     valids = arr.pod_valid.reshape(P // C, C)
 
     def score_flat(requested, alloc):
@@ -425,17 +520,26 @@ def schedule_scan_chunked(
 
     def chunk(used_in, xs):
         creq, csf, cvalid = xs
-        used0 = used_in
+        used0 = used_in  # FULL [N, R] usage (replicated under sharding)
+        if axis_name:
+            used0_l = lax.dynamic_slice_in_dim(used0, base, local_n, axis=0)
+        else:
+            used0_l = used0
         # hoisted dense scores vs chunk-start usage (vmap = the per-step ops
-        # batched, so float32 results are bit-identical to the plain scan)
-        requested = used0[None, :, :] + creq[:, None, :]  # [C, N, R]
-        fit0 = jax.vmap(filters.fit_ok, (0, None, None))(creq, used0, n_alloc)
+        # batched, so float32 results are bit-identical to the plain scan);
+        # shard-local: [C, Nl, R] intermediates, this kernel's biggest block
+        requested = used0_l[None, :, :] + creq[:, None, :]  # [C, Nl, R]
+        fit0 = jax.vmap(filters.fit_ok, (0, None, None))(creq, used0_l, n_alloc)
         total0 = cfg.fit_weight * jax.vmap(
             lambda rq, al: fit_score(rq, al, cfg), (0, None)
         )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
             balanced_allocation, (0, None, None)
         )(requested, n_alloc, res)
-        total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, N]
+        total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, Nl]
+        if axis_name:
+            # stitch the shard-local hoists into the full masked score
+            # matrix; from here the round loop is replicated verbatim
+            total0 = lax.all_gather(total0, axis_name, axis=1, tiled=True)
         topv, topi = lax.top_k(total0, K)  # [C, K] each
         # row-major transpose: [C, D] static-feasibility lookups below become
         # contiguous row gathers instead of strided column gathers
@@ -446,7 +550,7 @@ def schedule_scan_chunked(
             """Exact scores of every pod [C] at nodes node_ids [D] under
             node_usage [D, R]: (fit bool[C, D], value f32[C, D], static
             feasibility bool[C, D])."""
-            da = n_alloc[node_ids]  # [D, R]
+            da = n_alloc_full[node_ids]  # [D, R]
             fit = jax.vmap(filters.fit_ok, (0, None, None))(
                 creq, node_usage, da
             )  # [C, D]
@@ -530,7 +634,7 @@ def schedule_scan_chunked(
             hasslot = eqd.any(axis=1)
             sl = jnp.argmax(eqd, axis=1)
             cu = jnp.where(hasslot[:, None], dsu[sl], used0[cn])  # [C, R]
-            ca = n_alloc[cn]
+            ca = n_alloc_full[cn]
             cstat = total0_T[cn].T > neg_inf  # [C, C]
             uij = cu[None] + cum  # [C, C, R]
             # fit of pod i at node c_j under its intra-round usage uij[i, j]
@@ -622,7 +726,7 @@ def schedule_scan_chunked(
         return used_out, (out, nrounds, ord_)
 
     used_final, (choices, rounds, ords) = lax.scan(
-        chunk, arr.node_used, (reqs, sfs, valids)
+        chunk, used_init, (reqs, sfs, valids)
     )
     if with_ordinals:
         # global commit ordinal: rounds of all previous chunks + the pod's
@@ -660,7 +764,8 @@ def _rounds_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 
 def schedule_scan_rounds(
     arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False,
-    with_ordinals: bool = False,
+    with_ordinals: bool = False, axis_name: Optional[str] = None,
+    axis_size: int = 1, image_sharded: Optional[bool] = None,
 ):
     """Chunked sequential-commit scan for the FULL stage set — pairwise
     (PodTopologySpread + InterPodAffinity), NodePorts, TaintToleration
@@ -738,11 +843,46 @@ def schedule_scan_rounds(
     (used[N,R], cnt/anti/pref_node[T,N], total_t[T], ports[N,PT]); the
     inner while_loop additionally carries the patched base/fit hoists
     [C, N].  All count updates are integer-valued f32 / int32 scatter-adds
-    — order-independent and exact below 2^24."""
-    TRACE_COUNTS["rounds"] += 1
+    — order-independent and exact below 2^24.
+
+    SHARDED EXECUTION (axis_name set, parallel/sharded.py): unlike the
+    chunked kernel (whose per-chunk hoist gathers once), the rounds kernel
+    re-hoists INSIDE the round loop, so the stitching happens per round and
+    stays exactly schedule_scan-shaped — per-node score math never crosses
+    shards:
+
+      - the [C, Nl] re-hoist (spread/interpod vmaps, base patch) and the
+        [T, Nl] count state are shard-local;
+      - per-pod NormalizeScore scalars stitch with pmax (same _rmax the
+        per-pod scan uses), the argmax/lowest-index tie-break with
+        pmax + pmin over global node ids;
+      - dispersal speculation merges shard-local top-Zr lists into the
+        global top-Zr (_global_top_k — provably identical values/ids/ties);
+      - the exact repair reads only CANDIDATE columns ([C, C]-sized), each
+        gathered from its owner shard via psum (_gather_cols);
+      - commits broadcast the chosen node's per-term domain column from the
+        owner shard via psum (_gather_at_nodes — the schedule_scan pattern)
+        and each shard scatter-adds its own [T, Nl] columns.
+
+    The [N, R] usage array is all-gathered once per step and carried
+    replicated (tiny next to the [T, N]/[P, N] state, and the repair needs
+    arbitrary candidate rows of it every round)."""
+    TRACE_COUNTS["sharded_rounds" if axis_name else "rounds"] += 1
     local_n = arr.N
-    my_nodes = jnp.arange(local_n, dtype=jnp.int32)
-    P, N, R = arr.P, arr.N, arr.R
+    if axis_name:
+        base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
+        N = local_n * axis_size
+        n_alloc_full = lax.all_gather(
+            arr.node_alloc, axis_name, axis=0, tiled=True
+        )
+        used_init = lax.all_gather(arr.node_used, axis_name, axis=0, tiled=True)
+    else:
+        base = jnp.int32(0)
+        N = local_n
+        n_alloc_full = arr.node_alloc
+        used_init = arr.node_used
+    my_nodes = base + jnp.arange(local_n, dtype=jnp.int32)
+    P, R = arr.P, arr.R
     C = _RCHUNK
     res = cfg.score_resources
     neg_inf = -jnp.inf
@@ -786,7 +926,7 @@ def schedule_scan_rounds(
         xs["traw"] = seg(taint_prefer_counts(arr))
     if cfg.enable_node_pref:
         xs["naraw"] = seg(_preferred_node_affinity_raw(arr, tm))
-    if cfg.enable_image and arr.image_score.shape[1] == arr.N:
+    if _image_on(arr, cfg, image_sharded):
         xs["img"] = seg(arr.image_score)
     if pw:
         xs.update(
@@ -844,8 +984,15 @@ def schedule_scan_rounds(
 
         # --- chunk-start base hoist (patched per round at dirty columns) ---
         def base_at(used):
-            requested = used[None, :, :] + creq[:, None, :]
-            fit = jax.vmap(filters.fit_ok, (0, None, None))(creq, used, n_alloc)
+            # `used` is the FULL [N, R] array; the hoist reads this shard's
+            # node slice only — [C, Nl] blocks, elementwise, bit-identical
+            # to the same columns of the dense hoist
+            if axis_name:
+                used_l = lax.dynamic_slice_in_dim(used, base, local_n, axis=0)
+            else:
+                used_l = used
+            requested = used_l[None, :, :] + creq[:, None, :]
+            fit = jax.vmap(filters.fit_ok, (0, None, None))(creq, used_l, n_alloc)
             b = cfg.fit_weight * jax.vmap(
                 lambda rq, al: fit_score(rq, al, cfg), (0, None)
             )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
@@ -868,9 +1015,10 @@ def schedule_scan_rounds(
                 )
             if pw:
                 spread_ok, spread_raw = jax.vmap(
-                    pairwise.spread_step, (None, None, 0, 0, 0, 0, None)
+                    partial(pairwise.spread_step, axis_name=axis_name),
+                    (None, None, 0, 0, 0, 0),
                 )(cnt_node, has_key_all, cx["spread_t"], cx["skew"],
-                  cx["hard"], cx["elig"], None)
+                  cx["hard"], cx["elig"])
                 interpod_ok = jax.vmap(
                     pairwise.interpod_required_ok,
                     (None, None, None, None, 0, 0, 0, 0, 0),
@@ -879,23 +1027,24 @@ def schedule_scan_rounds(
                 feasible &= spread_ok & interpod_ok
             total = base0
             # per-pod NormalizeScore scalars over the CURRENT feasible set,
-            # accumulated in the plain scan's stage order (float parity)
+            # accumulated in the plain scan's stage order (float parity);
+            # under sharding the scalars stitch with pmax, like the scan
             if cfg.enable_taint_score:
-                t_mx = jnp.max(jnp.where(feasible, cx["traw"], 0.0), axis=1)
+                t_mx = _rmax(jnp.where(feasible, cx["traw"], 0.0), axis_name)
                 total = total + cfg.taint_weight * jnp.where(
                     (t_mx > 0)[:, None],
                     MAXS - MAXS * cx["traw"] / t_mx[:, None],
                     MAXS,
                 )
             if cfg.enable_node_pref:
-                na_mx = jnp.max(jnp.where(feasible, cx["naraw"], 0.0), axis=1)
+                na_mx = _rmax(jnp.where(feasible, cx["naraw"], 0.0), axis_name)
                 total = total + cfg.node_affinity_weight * jnp.where(
                     (na_mx > 0)[:, None],
                     cx["naraw"] * MAXS / na_mx[:, None],
                     0.0,
                 )
             if pw:
-                s_mx = jnp.max(jnp.where(feasible, spread_raw, 0.0), axis=1)
+                s_mx = _rmax(jnp.where(feasible, spread_raw, 0.0), axis_name)
                 total = total + cfg.spread_weight * jnp.where(
                     (s_mx > 0)[:, None],
                     MAXS - MAXS * spread_raw / s_mx[:, None],
@@ -907,11 +1056,11 @@ def schedule_scan_rounds(
                     (None, None, None, 0, 0, 0, 0),
                 )(cnt_node, pref_node, has_key_all, cx["pref_t"],
                   cx["pref_w"], cx["mt"], cx["mv"])
-                ip_mx = jnp.max(
-                    jnp.where(feasible, ip_raw, neg_inf), axis=1
+                ip_mx = _rmax(
+                    jnp.where(feasible, ip_raw, neg_inf), axis_name
                 )
-                ip_mn = -jnp.max(
-                    jnp.where(feasible, -ip_raw, neg_inf), axis=1
+                ip_mn = -_rmax(
+                    jnp.where(feasible, -ip_raw, neg_inf), axis_name
                 )
                 total = total + cfg.interpod_weight * jnp.where(
                     (ip_mx > ip_mn)[:, None],
@@ -922,10 +1071,14 @@ def schedule_scan_rounds(
             if "img" in cx:
                 total = total + cfg.image_weight * cx["img"]
             total = jnp.where(feasible, total, neg_inf)
-            best = jnp.max(total, axis=1)
-            cand = jnp.where(
-                (total == best[:, None]) & feasible, my_nodes[None, :], _INT_MAX
-            ).min(axis=1)
+            best = _rmax(total, axis_name)
+            cand = _rmin(
+                jnp.where(
+                    (total == best[:, None]) & feasible,
+                    my_nodes[None, :], _INT_MAX,
+                ),
+                axis_name,
+            )
             c0 = jnp.where(
                 (best > neg_inf) & cvalid, cand.astype(jnp.int32), -1
             )
@@ -945,7 +1098,7 @@ def schedule_scan_rounds(
             )
             rank = (same0 & jlt).sum(axis=1).astype(jnp.int32)
             Zr = min(32, N)
-            topv, topi = lax.top_k(total, Zr)
+            topv, topi = _global_top_k(total, Zr, axis_name, base)
             sel = jnp.minimum(rank, Zr - 1)[:, None]
             v_sel = jnp.take_along_axis(topv, sel, 1)[:, 0]
             c_sp = jnp.take_along_axis(topi, sel, 1)[:, 0].astype(jnp.int32)
@@ -967,7 +1120,7 @@ def schedule_scan_rounds(
                 E = (c[:, None] == c[None, :]) & act[:, None]
                 T3 = E[:, :, None] * creq[:, None, :]
                 cum = lax.associative_scan(jnp.add, T3, axis=0) - T3
-                ca = n_alloc[cn]  # [C, R]
+                ca = n_alloc_full[cn]  # [C, R]
                 uij = used[cn][None, :, :] + cum  # [C(i), C(j), R]
                 fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
                 reqij = uij + creq[:, None, :]
@@ -976,11 +1129,14 @@ def schedule_scan_rounds(
                     reqij.reshape(-1, R),
                     jnp.broadcast_to(ca[None], shape3).reshape(-1, R),
                 ).reshape(C, C)
-                feas0_at = jnp.take_along_axis(feasible, cn[None, :], axis=1)
+                # round-start raws at the candidate nodes: each [C, C] block
+                # gathered from its owner shard (shard-local values, psum
+                # broadcast — no full-matrix traffic)
+                feas0_at = _gather_cols(feasible, cn, axis_name, base, local_n)
                 newtot = baseij
                 extreme_at = jnp.zeros((C, C), dtype=jnp.bool_)
                 if cfg.enable_taint_score:
-                    r_at = jnp.take_along_axis(cx["traw"], cn[None, :], axis=1)
+                    r_at = _gather_cols(cx["traw"], cn, axis_name, base, local_n)
                     newtot = newtot + cfg.taint_weight * jnp.where(
                         (t_mx > 0)[:, None],
                         MAXS - MAXS * r_at / t_mx[:, None],
@@ -988,8 +1144,8 @@ def schedule_scan_rounds(
                     )
                     extreme_at |= (t_mx > 0)[:, None] & (r_at == t_mx[:, None])
                 if cfg.enable_node_pref:
-                    r_at = jnp.take_along_axis(
-                        cx["naraw"], cn[None, :], axis=1
+                    r_at = _gather_cols(
+                        cx["naraw"], cn, axis_name, base, local_n
                     )
                     newtot = newtot + cfg.node_affinity_weight * jnp.where(
                         (na_mx > 0)[:, None],
@@ -1000,8 +1156,8 @@ def schedule_scan_rounds(
                         r_at == na_mx[:, None]
                     )
                 if pw:
-                    r_at = jnp.take_along_axis(
-                        spread_raw, cn[None, :], axis=1
+                    r_at = _gather_cols(
+                        spread_raw, cn, axis_name, base, local_n
                     )
                     newtot = newtot + cfg.spread_weight * jnp.where(
                         (s_mx > 0)[:, None],
@@ -1010,7 +1166,7 @@ def schedule_scan_rounds(
                     )
                     extreme_at |= (s_mx > 0)[:, None] & (r_at == s_mx[:, None])
                 if ips:
-                    r_at = jnp.take_along_axis(ip_raw, cn[None, :], axis=1)
+                    r_at = _gather_cols(ip_raw, cn, axis_name, base, local_n)
                     newtot = newtot + cfg.interpod_weight * jnp.where(
                         (ip_mx > ip_mn)[:, None],
                         MAXS * (r_at - ip_mn[:, None])
@@ -1021,8 +1177,8 @@ def schedule_scan_rounds(
                         (r_at == ip_mx[:, None]) | (r_at == ip_mn[:, None])
                     )
                 if "img" in cx:
-                    newtot = newtot + cfg.image_weight * jnp.take_along_axis(
-                        cx["img"], cn[None, :], axis=1
+                    newtot = newtot + cfg.image_weight * _gather_cols(
+                        cx["img"], cn, axis_name, base, local_n
                     )
                 newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
                 dropped = feas0_at & ~fitij
@@ -1034,13 +1190,16 @@ def schedule_scan_rounds(
                 O = ((c[:, None] == my_nodes[None, :]) & act[:, None]).astype(
                     jnp.float32
                 )  # [C(j), N] pick indicator
-                picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, N]
-                av = jnp.max(jnp.where(picked_before, neg_inf, total), axis=1)
-                a_n = jnp.where(
-                    (total == av[:, None]) & ~picked_before,
-                    my_nodes[None, :],
-                    _INT_MAX,
-                ).min(axis=1)
+                picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, Nl]
+                av = _rmax(jnp.where(picked_before, neg_inf, total), axis_name)
+                a_n = _rmin(
+                    jnp.where(
+                        (total == av[:, None]) & ~picked_before,
+                        my_nodes[None, :],
+                        _INT_MAX,
+                    ),
+                    axis_name,
+                )
                 Mj = jnp.where(act[None, :] & jlt, newtot, neg_inf)
                 vb = jnp.max(Mj, axis=1)
                 b_n = jnp.where(Mj == vb[:, None], cn[None, :], _INT_MAX).min(
@@ -1086,14 +1245,14 @@ def schedule_scan_rounds(
             committed = committed | commit_set
 
             # ---- absorb the committed picks into the live state ----
-            ucols = jnp.where(pact, c_final, N)  # N = drop sentinel
+            ucols = jnp.where(pact, c_final, N)  # N = drop sentinel (GLOBAL)
             adds = jnp.zeros((N, R), dtype=used.dtype).at[ucols].add(
                 jnp.where(pact[:, None], creq, 0), mode="drop"
             )
             used = used + adds
             # patch base/fit at the dirtied columns against the NEW usage
             col_used = used[cn_final]  # [C, R] (committed cols; others dropped)
-            col_alloc = n_alloc[cn_final]
+            col_alloc = n_alloc_full[cn_final]
             col_req = col_used[None, :, :] + creq[:, None, :]  # [C, C, R]
             col_fit = jax.vmap(
                 lambda rq: filters.fit_ok(rq, col_used, col_alloc)
@@ -1104,23 +1263,39 @@ def schedule_scan_rounds(
                     -1, R
                 ),
             ).reshape(C, C)
-            base0 = base0.at[:, ucols].set(col_base, mode="drop")
-            fit0 = fit0.at[:, ucols].set(col_fit, mode="drop")
+            if axis_name:
+                # each shard patches only the columns it owns; foreign and
+                # sentinel ids map to local_n and drop (duplicate committed
+                # columns write identical values — same node, same usage)
+                lucols = jnp.where(
+                    (ucols >= base) & (ucols < base + local_n),
+                    ucols - base, local_n,
+                )
+            else:
+                lucols = ucols
+            base0 = base0.at[:, lucols].set(col_base, mode="drop")
+            fit0 = fit0.at[:, lucols].set(col_fit, mode="drop")
             if cfg.enable_ports:
-                ports_used = ports_used.at[ucols].max(
+                ports_used = ports_used.at[lucols].max(
                     cx["ports"] & pact[:, None], mode="drop"
                 )
             if pw:
                 def scatter_rows(state, ids, w):
                     """state[T, N] += w * (dom matches the pod's chosen
-                    domain), rows = the (pod, slot) flattening."""
+                    domain), rows = the (pod, slot) flattening.  Under
+                    sharding the chosen node's domain per term comes from
+                    the owner shard (psum broadcast — the schedule_scan
+                    commit pattern) and each shard adds to its own
+                    [*, Nl] columns."""
                     tids = jnp.maximum(ids, 0).reshape(-1)  # [C*S]
                     nodes = jnp.broadcast_to(
                         cn_final[:, None], ids.shape
                     ).reshape(-1)
                     wf = w.reshape(-1)
-                    dcol = dom_by_term[tids, nodes]  # [C*S]
-                    same = dom_by_term[tids] == dcol[:, None]  # [C*S, N]
+                    dcol = _gather_at_nodes(
+                        dom_by_term, tids, nodes, axis_name, base, local_n
+                    )  # [C*S]
+                    same = dom_by_term[tids] == dcol[:, None]  # [C*S, Nl]
                     return state.at[tids].add(wf[:, None] * same), (
                         tids, dcol, wf
                     )
@@ -1180,7 +1355,7 @@ def schedule_scan_rounds(
     pref_node0 = jnp.take_along_axis(arr.pref_own0, dom_by_term, axis=1)
     total_t0 = arr.term_counts0[:, :D].sum(axis=1)
     carry0 = (
-        arr.node_used, cnt_node0, anti_node0, pref_node0, total_t0,
+        used_init, cnt_node0, anti_node0, pref_node0, total_t0,
         arr.node_ports0,
     )
     (used_final, *_), (choices, rounds, ords) = lax.scan(chunk, carry0, xs)
@@ -1257,13 +1432,23 @@ def donation_supported() -> bool:
 _DONATION_PROBED: Optional[bool] = None
 
 
-def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool):
+def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool, mesh=None):
     """schedule_batch with donation routed per call.  `donate` is the
     caller's RESOLVED decision (resolve defaults with donation_supported();
     an explicit True forces the donating kernel — tests do, even on the CPU
     sim).  The "donated buffers were not usable" warning is expected noise
     on this kernel (most inputs cannot alias the two outputs; donation
-    still frees them early) and is suppressed here only."""
+    still frees them early) and is suppressed here only.
+
+    `mesh` (jax.sharding.Mesh with >1 device) runs the SAME route — chunked
+    / rounds / per-pod scan — node-axis sharded under shard_map
+    (parallel/sharded.py — sharded_schedule_batch_routed), bit-identical
+    decisions; node counts not divisible by the mesh pad with permanently
+    invalid nodes (parallel/mesh.py — pad_nodes)."""
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from ..parallel.sharded import sharded_schedule_batch_routed
+
+        return sharded_schedule_batch_routed(arr, cfg, mesh, donate=donate)
     if donate:
         import warnings
 
@@ -1301,10 +1486,17 @@ schedule_batch_ordinals_donated = partial(
 )(schedule_batch_ordinals_impl)
 
 
-def schedule_batch_ordinals_routed(arr, cfg: ScoreConfig, donate: bool):
+def schedule_batch_ordinals_routed(arr, cfg: ScoreConfig, donate: bool,
+                                   mesh=None):
     """schedule_batch_ordinals with the same donation routing + warning
     policy as schedule_batch_routed (`donate` = the caller's resolved
-    decision)."""
+    decision), and the same `mesh=` scale-out path."""
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from ..parallel.sharded import sharded_schedule_batch_routed
+
+        return sharded_schedule_batch_routed(
+            arr, cfg, mesh, donate=donate, with_ordinals=True
+        )
     if donate:
         import warnings
 

@@ -27,7 +27,6 @@ import numpy as np
 from jax import lax
 
 from ..api.snapshot import ClusterArrays
-from .assign import schedule_batch
 from .scores import ScoreConfig
 
 
@@ -46,7 +45,8 @@ def failed_groups(choices: np.ndarray, pod_group: np.ndarray, group_min: np.ndar
 
 
 def schedule_with_gangs(
-    arr: ClusterArrays, cfg: ScoreConfig, with_ordinals: bool = False
+    arr: ClusterArrays, cfg: ScoreConfig, with_ordinals: bool = False,
+    mesh=None,
 ):
     """Schedule honoring all-or-nothing groups.
 
@@ -54,8 +54,16 @@ def schedule_with_gangs(
     with_ordinals appends (ordinals, sweeps): per-pod commit ordinals
     positioned AFTER the earlier fixpoint iterations' sweeps (a pod's
     decision is only available once the final program ran), with `sweeps`
-    the total across all iterations — see assign.schedule_batch_ordinals."""
-    from .assign import schedule_batch_ordinals
+    the total across all iterations — see assign.schedule_batch_ordinals.
+
+    `mesh` runs each fixpoint iteration's batch step node-axis SHARDED
+    (parallel/sharded.py) — safe here because the host fixpoint never
+    donates (it re-reads `arr` across iterations), and decision-identical
+    since each iteration is an ordinary routed batch call."""
+    from .assign import (
+        schedule_batch_ordinals_routed,
+        schedule_batch_routed,
+    )
 
     pod_valid = np.asarray(arr.pod_valid).copy()
     revoked = np.zeros_like(pod_valid)
@@ -63,9 +71,13 @@ def schedule_with_gangs(
     while True:
         arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
         if with_ordinals:
-            choices, used, ords, sweeps = schedule_batch_ordinals(arr_i, cfg)
+            choices, used, ords, sweeps = schedule_batch_ordinals_routed(
+                arr_i, cfg, donate=False, mesh=mesh
+            )
         else:
-            choices, used = schedule_batch(arr_i, cfg)
+            choices, used = schedule_batch_routed(
+                arr_i, cfg, donate=False, mesh=mesh
+            )
         choices = np.asarray(choices)
         pod_group = np.asarray(arr.pod_group)
         bad = failed_groups(choices, pod_group, np.asarray(arr.group_min), active=pod_valid)

@@ -1,3 +1,7 @@
-from .mesh import make_mesh  # noqa: F401
+from .mesh import make_mesh, mesh_from_env, pad_nodes, shard_hbm_estimate  # noqa: F401
 from .pipeline import PipelinedBatchLoop, PipelinedRunner, run_serial  # noqa: F401
-from .sharded import sharded_schedule_batch  # noqa: F401
+from .sharded import (  # noqa: F401
+    field_shardings,
+    sharded_schedule_batch,
+    sharded_schedule_batch_routed,
+)

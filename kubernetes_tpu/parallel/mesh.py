@@ -9,12 +9,42 @@ axis "nodes" for now; the pods axis joins when ring/all-to-all stages land.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import os
+import warnings
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh
 
 NODE_AXIS = "nodes"
+
+# jax moved shard_map out of experimental around 0.5; alias whichever this
+# runtime has so the sharded paths work on both (the seed's bare
+# jax.shard_map raised AttributeError on 0.4.x and failed tier-1's
+# test_sharded/test_ring).
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+# ClusterArrays fields carrying the node axis, with (axis, pad fill).  The
+# fill values replicate the encoder's own bucketing padding (api/delta.py —
+# _assemble: node_valid False is the master gate, so padded nodes are
+# statically infeasible for every pod and can never attain a normalization
+# extreme or win an argmax; node_dom's fill is resolved per-array to the
+# "key absent" sentinel D).  image_score pads on axis 1 only when it is a
+# real [P, N] matrix.
+NODE_AXIS_FIELDS: Dict[str, Tuple[int, object]] = {
+    "node_valid": (0, False),
+    "node_alloc": (0, 0),
+    "node_used": (0, 0),
+    "node_unsched": (0, False),
+    "node_labels": (0, 0),
+    "node_taint_ns": (0, False),
+    "node_taint_pref": (0, False),
+    "node_dom": (1, None),  # None -> D sentinel, resolved per array set
+    "node_ports0": (0, False),
+}
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -24,6 +54,112 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
     import numpy as np
 
     return Mesh(np.array(devs), (NODE_AXIS,))
+
+
+def mesh_from_env(raw: Optional[str] = None, source: str = "KTPU_MESH") -> Optional[Mesh]:
+    """KTPU_MESH=<n>: build the node-axis mesh over the first n local
+    devices.  Unset / 1 / 0 -> None (the single-device path).  Invalid
+    values raise a clear ValueError instead of silently running
+    single-device; a request beyond the available device count CLAMPS with
+    a warning, so one deployment config serves hosts of different sizes.
+    The one validated entry for EVERY mesh-count request — config-sourced
+    counts (TPUScoreArgs.meshDevices) resolve through it too, with `source`
+    naming the knob in errors/warnings."""
+    if raw is None:
+        raw = os.environ.get("KTPU_MESH", "")
+    raw = raw.strip()
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{source}={raw!r}: expected an integer device count "
+            f"(e.g. {source}=8 for a v5e-8)"
+        ) from None
+    if n < 0:
+        raise ValueError(f"{source}={n}: device count must be >= 0")
+    if n <= 1:
+        return None
+    avail = len(jax.devices())
+    if n > avail:
+        warnings.warn(
+            f"{source}={n} exceeds the {avail} available device(s); "
+            f"clamping to {avail}",
+            stacklevel=2,
+        )
+        n = avail
+    if n <= 1:
+        return None
+    return make_mesh(n)
+
+
+def pad_field(name: str, a, pad: int, d_sentinel: int, n: int):
+    """Pad ONE ClusterArrays field's node axis by `pad` entries, or return
+    it untouched when it carries no node axis.  The single source of the
+    fill/axis rules (NODE_AXIS_FIELDS + the [P, N] image_score case) shared
+    by pad_nodes below and the resident encoder's placement-time padding
+    (api/delta.py — DeltaEncoder._pad_for_mesh)."""
+    import numpy as np
+
+    ent = NODE_AXIS_FIELDS.get(name)
+    if ent is None:
+        if name == "image_score" and a.shape[1] == n:
+            ent = (1, 0)
+        else:
+            return a
+    axis, fill = ent
+    if fill is None:
+        fill = d_sentinel
+    a = np.asarray(a)
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return np.pad(a, widths, constant_values=fill)
+
+
+def pad_nodes(arr, n_shards: int):
+    """Pad the node axis of a ClusterArrays to a multiple of `n_shards` with
+    permanently invalid nodes — the exact padding the encoder's bucketing
+    already applies (zero capacity, valid=False, sentinel domains), so
+    decisions are unchanged: padded columns are masked -inf before every
+    argmax / top-k / normalization.  Returns (arr, original_N); the input is
+    returned untouched when already divisible.  Host-side (numpy): callers
+    on the device hot path pad BEFORE placement (api/delta.py —
+    DeltaEncoder with a mesh)."""
+    n = arr.N
+    pad = (-n) % n_shards
+    if pad == 0:
+        return arr, n
+    import dataclasses
+
+    d_sentinel = arr.term_counts0.shape[1] - 1
+    repl = {
+        name: pad_field(name, getattr(arr, name), pad, d_sentinel, n)
+        for name in (*NODE_AXIS_FIELDS, "image_score")
+    }
+    return dataclasses.replace(arr, **repl), n
+
+
+def shard_hbm_estimate(
+    n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
+    n_terms: int = 1, chunk: int = 128,
+) -> Dict[str, int]:
+    """Per-shard device-memory estimate (bytes) for the routed kernels'
+    dominant blocks at [P, N] scale (PARITY.md HBM budget, sharded): the
+    two [P, Nl] bool masks (static feasibility + node-selection) shard
+    column-wise; the per-chunk hoist and [T, Nl] count state shard with
+    them; the chunked kernel's gathered [C, N] score matrix (plus its
+    transpose) and the [N, R] usage/alloc arrays are replicated per shard."""
+    nl = -(-n_nodes // n_shards)
+    b = {
+        "pn_masks": 2 * n_pods * nl,                 # sf + nodesel, bool
+        "chunk_hoist": 2 * chunk * nl * n_res * 4,   # requested + scores f32
+        "count_state": 4 * max(1, n_terms) * nl * 4, # cnt/anti/pref/dom
+        "gathered_scores": 2 * chunk * n_nodes * 4,  # [C, N] total0 + .T
+        "node_side_replicated": 2 * n_nodes * n_res * 4,  # alloc + used
+    }
+    b["total"] = sum(b.values())
+    return b
 
 
 def init_distributed(

@@ -85,12 +85,21 @@ class PipelinedBatchLoop:
         commit: Optional[Callable[[Verdicts], None]] = None,
         tracer=None,
         metrics=None,
+        mesh=None,
     ):
         from ..ops.assign import donation_supported
 
         self.enc = encoder or DeltaEncoder(
             hard_pod_affinity_weight=hard_pod_affinity_weight
         )
+        # device mesh for the sharded routed step (parallel/sharded.py):
+        # the resident encoder places node-axis buffers shard-wise
+        # (NamedSharding) so warm-cycle deltas update shards in place and
+        # the double-buffered loop overlaps encode/commit against a
+        # SHARDED device step
+        self.mesh = mesh
+        if mesh is not None:
+            self.enc.set_mesh(mesh)
         self.base_config = base_config
         self.donate = donation_supported() if donate is None else donate
         self.depth = depth
@@ -181,7 +190,9 @@ class PipelinedBatchLoop:
                 arr.node_alloc, arr.node_used, arr.pod_prio, arr.pod_nodename,
             )
             self.stats["donated"] += 1
-        choices = schedule_batch_routed(arr, cfg, donate=donating)[0]
+        choices = schedule_batch_routed(
+            arr, cfg, donate=donating, mesh=self.mesh
+        )[0]
         t1 = time.perf_counter()
         credit = self._overlap_credit(probe, running0)
         self._host_phase("encode", t1 - t0, credit)
@@ -207,7 +218,9 @@ class PipelinedBatchLoop:
         # the replay must not alias buffers a donating successor wave hands
         # to XLA
         arr, meta = self.enc.to_device(arr, meta, fresh=True)
-        ch = np.asarray(schedule_batch_routed(arr, cfg, donate=False)[0])
+        ch = np.asarray(
+            schedule_batch_routed(arr, cfg, donate=False, mesh=self.mesh)[0]
+        )
         if chaos.poisoned_verdicts(ch, len(meta.node_names)):
             raise chaos.PoisonedWave(
                 f"wave {self._wave - 1}: serial replay still poisoned"
@@ -240,9 +253,11 @@ class PipelinedBatchLoop:
         except Exception as e:  # noqa: BLE001 — any mid-wave death recovers
             ch, meta = self._recover_wave(snap, e, t0)
         t1 = time.perf_counter()
+        from ..scheduler.tracing import mesh_attrs
+
         self._span(
             "device.step", t_dispatch, t1, component="pipeline",
-            wave=self._wave - 1,
+            wave=self._wave - 1, **mesh_attrs(self.mesh),
         )
         # decode happens after the blocking fetch, so it overlaps only the
         # NEXT step — dispatched before this collect when pipelining
@@ -368,12 +383,14 @@ class PipelinedRunner:
         donate: Optional[bool] = None,
         tracer=None,
         metrics=None,
+        mesh=None,
     ):
         self.base_config = base_config
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.donate = donate
         self.tracer = tracer
         self.metrics = metrics
+        self.mesh = mesh
         self.last_loop: Optional[PipelinedBatchLoop] = None
 
     def _loop(self, depth: int) -> PipelinedBatchLoop:
@@ -384,6 +401,7 @@ class PipelinedRunner:
             depth=depth,
             tracer=self.tracer,
             metrics=self.metrics,
+            mesh=self.mesh,
         )
         self.last_loop = loop
         return loop
@@ -397,6 +415,7 @@ def run_serial(
     base_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
     hard_pod_affinity_weight: float = 1.0,
     donate: Optional[bool] = None,
+    mesh=None,
 ) -> Iterator[Verdicts]:
     """The unpipelined oracle for the same stream: encode -> run -> block,
     one snapshot at a time (identical dataflow at depth=0 — used by tests
@@ -406,5 +425,6 @@ def run_serial(
         hard_pod_affinity_weight=hard_pod_affinity_weight,
         donate=donate,
         depth=0,
+        mesh=mesh,
     )
     return loop.run(snapshots)

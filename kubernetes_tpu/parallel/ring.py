@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, shard_map
 
 PODS_AXIS = NODE_AXIS  # one mesh axis; it shards whichever array axis a stage needs
 
@@ -70,12 +70,16 @@ def ring_match(sel_mask: jax.Array, sel_kind: jax.Array, labels: jax.Array, mesh
         zeros = jnp.zeros((sel_m.shape[0], P_total), dtype=jnp.bool_)
         if hasattr(lax, "pcast"):
             out0 = lax.pcast(zeros, (PODS_AXIS,), to="varying")
-        else:  # older jax
+        elif hasattr(lax, "pvary"):
             out0 = lax.pvary(zeros, (PODS_AXIS,))
+        else:
+            # jax 0.4.x: no replication-type casts (and no check_rep need
+            # for them) — the constant is device-varying implicitly
+            out0 = zeros
         _, out = lax.fori_loop(0, d, body, (lab, out0))
         return out
 
-    fn = jax.shard_map(
+    fn = shard_map(
         f,
         mesh=mesh,
         in_specs=(P(PODS_AXIS, None, None), P(PODS_AXIS, None), P(PODS_AXIS, None)),
@@ -95,6 +99,6 @@ def all_to_all_pods_to_nodes(x: jax.Array, mesh: Mesh):
         # split the node axis into d chunks, exchange, concat on the pod axis
         return lax.all_to_all(blk, PODS_AXIS, split_axis=1, concat_axis=0, tiled=True)
 
-    fn = jax.shard_map(f, mesh=mesh, in_specs=(P(PODS_AXIS, None),),
+    fn = shard_map(f, mesh=mesh, in_specs=(P(PODS_AXIS, None),),
                        out_specs=P(None, PODS_AXIS))
     return jax.jit(fn)(x)

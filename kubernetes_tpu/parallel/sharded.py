@@ -19,7 +19,7 @@ unsharded execution produce identical float32 values.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Tuple
 
 import jax
@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..api.snapshot import ClusterArrays
 from ..ops.assign import schedule_scan
 from ..ops.scores import ScoreConfig
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, shard_map
 
 
 def _node_sharding_specs(image_sharded: bool) -> ClusterArrays:
@@ -88,10 +88,125 @@ def sharded_schedule_batch(
     n_shards = mesh.shape[NODE_AXIS]
     if arr.N % n_shards:
         raise ValueError(f"node axis {arr.N} not divisible by mesh size {n_shards}")
-    fn = jax.shard_map(
-        partial(schedule_scan, cfg=cfg, axis_name=NODE_AXIS),
+    img = arr.image_score.shape[1] == arr.N
+    fn = shard_map(
+        partial(
+            schedule_scan, cfg=cfg, axis_name=NODE_AXIS, image_sharded=img
+        ),
         mesh=mesh,
-        in_specs=(_node_sharding_specs(arr.image_score.shape[1] == arr.N),),
+        in_specs=(_node_sharding_specs(img),),
         out_specs=(P(), P(NODE_AXIS, None)),
     )
     return jax.jit(fn)(arr)
+
+
+def field_shardings(mesh: Mesh, image_sharded: bool):
+    """field name -> NamedSharding matching the sharded kernels' in_specs,
+    so a ClusterArrays placed with these (api/delta.py — DeltaEncoder with a
+    mesh) enters the sharded step with zero resharding: resident node-axis
+    buffers live shard-wise on their owning devices and warm-cycle deltas
+    re-place only the changed fields' shards — no per-cycle gather/scatter.
+    Memoized per (mesh, image_sharded): the dict is rebuilt-free on the
+    warm-cycle encode hot path."""
+    return _field_shardings_cached(mesh, image_sharded)
+
+
+@lru_cache(maxsize=None)
+def _field_shardings_cached(mesh: Mesh, image_sharded: bool):
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    specs = _node_sharding_specs(image_sharded)
+    return {
+        f.name: NamedSharding(mesh, getattr(specs, f.name))
+        for f in dataclasses.fields(type(specs))
+    }
+
+
+# jit cache for the sharded routed kernels, keyed on everything trace-
+# relevant.  cfg is a frozen (hashable) dataclass; Mesh is hashable; the
+# shapes key themselves through jit as usual.
+@lru_cache(maxsize=None)
+def _sharded_routed_fn(
+    mesh: Mesh, image_sharded: bool, kind: str, cfg: ScoreConfig,
+    with_ordinals: bool, donate: bool,
+):
+    import jax.numpy as jnp
+
+    from ..ops import assign as A
+
+    n_shards = int(mesh.shape[NODE_AXIS])
+    in_specs = (_node_sharding_specs(image_sharded),)
+    if kind == "scan":
+        def body(a):
+            c, u = A.schedule_scan(
+                a, cfg=cfg, axis_name=NODE_AXIS, image_sharded=image_sharded
+            )
+            if with_ordinals:
+                return c, u, jnp.arange(a.P, dtype=jnp.int32), jnp.int32(a.P)
+            return c, u
+
+        used_spec = P(NODE_AXIS, None)  # the scan's used stays node-sharded
+    else:
+        kernel = (
+            A.schedule_scan_chunked if kind == "chunked"
+            else A.schedule_scan_rounds
+        )
+
+        def body(a):
+            return kernel(
+                a, cfg=cfg, with_ordinals=with_ordinals, axis_name=NODE_AXIS,
+                axis_size=n_shards, image_sharded=image_sharded,
+            )
+
+        used_spec = P()  # chunked/rounds carry usage replicated
+    out_specs = (P(), used_spec) + ((P(), P()) if with_ordinals else ())
+    fn = shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+    if donate:
+        return jax.jit(fn, donate_argnums=(0,))
+    return jax.jit(fn)
+
+
+def sharded_schedule_batch_routed(
+    arr: ClusterArrays, cfg: ScoreConfig, mesh: Mesh, donate: bool = False,
+    with_ordinals: bool = False,
+):
+    """The PRODUCTION routed step — chunked / rounds / per-pod scan, the same
+    trace-time routing as ops.assign.schedule_batch_routed — node-axis
+    sharded over `mesh`, decisions bit-identical to the single-device route
+    (tests/test_sharded_routed.py).  Node counts not divisible by the mesh
+    are padded with permanently invalid nodes (parallel/mesh.py —
+    pad_nodes); the returned node_used covers the padded axis (slice to the
+    caller's N — padded rows are always zero).
+
+    donate=True hands the (freshly transferred, per-wave) input shards to
+    XLA, same contract as schedule_batch_donated: per-shard [P, Nl]-scale
+    intermediates stop doubling peak HBM."""
+    from ..ops import assign as A
+    from .mesh import pad_nodes
+
+    n_shards = int(mesh.shape[NODE_AXIS])
+    arr, _n_orig = pad_nodes(arr, n_shards)
+    if A._chunk_routed(arr, cfg):
+        kind = "chunked"
+    elif A._rounds_routed(arr, cfg):
+        kind = "rounds"
+    else:
+        kind = "scan"
+    fn = _sharded_routed_fn(
+        mesh, arr.image_score.shape[1] == arr.N, kind, cfg,
+        with_ordinals, donate,
+    )
+    if donate:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return fn(arr)
+    return fn(arr)

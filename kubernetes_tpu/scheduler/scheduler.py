@@ -179,6 +179,29 @@ class Scheduler:
             from ..ops.aot import maybe_enable_compile_cache
 
             maybe_enable_compile_cache()
+        # device mesh for the batch path's sharded routed step: KTPU_MESH
+        # wins (validated/clamped — parallel/mesh.py), else the largest
+        # profile meshDevices from TPUScoreArgs.  None = single-device, the
+        # unchanged default.  Both batch branches thread it — the plain
+        # routed call AND the gang host fixpoint (which never donates, so
+        # sharding is safe there too); native/sidecar cycles stay
+        # unsharded (the C++ engine is host-side; the sidecar runs its own
+        # scheduler process).
+        self.mesh = None
+        if config.mode == "tpu":
+            from ..parallel.mesh import mesh_from_env
+
+            self.mesh = mesh_from_env()
+            if self.mesh is None:
+                md = max(
+                    (p.tpu_score.mesh_devices for p in config.profiles
+                     if p.tpu_score is not None),
+                    default=1,
+                )
+                if md > 1:
+                    # same validated clamp-with-warning (or None) semantics
+                    # as the env knob — one resolution path for both
+                    self.mesh = mesh_from_env(str(md), source="meshDevices")
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -813,6 +836,10 @@ class Scheduler:
                 self._delta_enc = DeltaEncoder(
                     hard_pod_affinity_weight=base_cfg.hard_pod_affinity_weight
                 )
+            # (arr stays host numpy below — with a mesh, the routed jit
+            # transfers each cycle's fresh buffers directly into the
+            # shard-wise layout per the kernel's in_specs; the resident
+            # DEVICE placement path is the pipeline loop's encoder)
             with self.tracer.span("batch.encode", profile=profile_name):
                 arr, meta = self._delta_enc.encode(snap)
             if chaos.enabled():
@@ -822,8 +849,11 @@ class Scheduler:
                            metrics=self.metrics)
             cfg = infer_score_config(arr, base_cfg)
             ords = sweeps = None
+            from .tracing import mesh_attrs
+
             with self.tracer.span(
-                "batch.kernel", profile=profile_name, mode=self.config.mode
+                "batch.kernel", profile=profile_name, mode=self.config.mode,
+                **mesh_attrs(self.mesh),
             ):
                 t_k0 = time.perf_counter()
                 if self.config.mode == "native":
@@ -851,7 +881,7 @@ class Scheduler:
                             if chaos.enabled() else None
                         )
                         choices, _, ords, sweeps = schedule_with_gangs(
-                            arr, cfg, with_ordinals=True
+                            arr, cfg, with_ordinals=True, mesh=self.mesh
                         )
                         choices = np.asarray(choices)
                         if fault is not None and fault.action == "nan":
@@ -883,7 +913,8 @@ class Scheduler:
                         )
                         choices, _, ords, sweeps = (
                             schedule_batch_ordinals_routed(
-                                arr, cfg, donate=donation_supported()
+                                arr, cfg, donate=donation_supported(),
+                                mesh=self.mesh,
                             )
                         )
                         # step i runs on device: the deferred bind/events
@@ -1174,13 +1205,13 @@ class Scheduler:
             from ..ops.gang import schedule_with_gangs
 
             choices, _, ords, sweeps = schedule_with_gangs(
-                arr, cfg, with_ordinals=True
+                arr, cfg, with_ordinals=True, mesh=self.mesh
             )
         else:
             from ..ops.assign import schedule_batch_ordinals_routed
 
             choices, _, ords, sweeps = schedule_batch_ordinals_routed(
-                arr, cfg, donate=False
+                arr, cfg, donate=False, mesh=self.mesh
             )
         choices = np.asarray(choices)
         if chaos.poisoned_verdicts(choices, len(meta.node_names)):

@@ -407,3 +407,12 @@ def device_trace(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def mesh_attrs(mesh) -> dict:
+    """Span attributes identifying the device mesh a kernel step ran on, so
+    traces attribute time per route+mesh (stamped onto the pipeline's
+    `device.step` and the scheduler's `batch.kernel` spans, and mirrored
+    into the bench JSON as `n_shards`).  mesh=None -> the single-device
+    path (n_shards 1)."""
+    return {"n_shards": int(mesh.size) if mesh is not None else 1}

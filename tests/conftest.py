@@ -32,3 +32,17 @@ def pytest_configure(config):
         "slow: long-running chaos storms / full-scale runs (tier-1 runs "
         "-m 'not slow')",
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """The 8-device node-axis mesh over the forced CPU platform above — the
+    tier-1-safe multichip fixture: every sharded parity test (test_sharded,
+    test_sharded_routed) runs on ordinary CPU CI, no TPU required."""
+    from kubernetes_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest forces 8 virtual CPU devices"
+    return make_mesh(8)

@@ -86,8 +86,12 @@ def test_two_process_distributed_step_matches_dense():
     if any(p.returncode != 0 for p in procs):
         lowered = joined.lower()
         if (
-            "distributed" in lowered
-            and ("unimplemented" in lowered or "not supported" in lowered)
+            "multiprocess computations aren't implemented" in lowered
+            # older jaxlibs word the same capability gap differently
+            or (
+                "distributed" in lowered
+                and ("unimplemented" in lowered or "not supported" in lowered)
+            )
         ):
             pytest.skip(f"multiprocess CPU collectives unavailable: {joined[-500:]}")
         pytest.fail(joined[-4000:])

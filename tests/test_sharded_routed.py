@@ -1,0 +1,241 @@
+"""Sharded PRODUCTION kernels (chunked / rounds under shard_map) vs their
+single-device counterparts — the routed north-star step across all chips.
+
+Parity matrix per ISSUE 4: {chunked, rounds} x {donation on/off} x
+{divisible, padded node count}, decisions (and node usage, up to the padded
+tail) bit-identical.  Runs tier-1-safe on the conftest-forced 8-device CPU
+platform (mesh8 fixture); the full-scale variant is @slow.  A seeded chaos
+storm drives the whole Scheduler batch path with KTPU_MESH=8 armed — a
+sharded trick that cannot survive the storm is not landable (ROADMAP).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.snapshot import encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.ops.assign import (
+    TRACE_COUNTS,
+    schedule_batch_ordinals_routed,
+    schedule_batch_routed,
+)
+from helpers import random_cluster
+
+
+@pytest.fixture(autouse=True)
+def _force_production_route(monkeypatch):
+    """Route the chunked/rounds kernels on the CPU sim (read per call), so
+    both the sharded run and its single-device comparator take the SAME
+    production route the TPU backend would."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+
+
+def _chunked_snap(divisible: bool):
+    # fit-only workload (infer_score_config strips every other stage) with
+    # P a multiple of the chunk size -> the chunked top-K kernel routes.
+    rng = random.Random(42 + divisible)
+    if divisible:
+        # bucketed: N=32 (divides 8), P=128
+        return random_cluster(rng, n_nodes=24, n_pods=120), True
+    # unbucketed: N=27 pads to 32 inside the sharded wrapper, P=128 exact
+    return random_cluster(rng, n_nodes=27, n_pods=128), False
+
+
+def _rounds_snap(divisible: bool):
+    # full stage set (taints/selectors/pairwise) -> the rounds kernel routes
+    rng = random.Random(9 + divisible)
+    if divisible:
+        return random_cluster(
+            rng, n_nodes=24, n_pods=50,
+            with_taints=True, with_selectors=True, with_pairwise=True,
+        ), True
+    return random_cluster(
+        rng, n_nodes=27, n_pods=48,
+        with_taints=True, with_selectors=True, with_pairwise=True,
+    ), False
+
+
+def _assert_parity(mesh, snap, bucket, cfg=None, donate=False, route=None):
+    arr, meta = encode_snapshot(snap, bucket=bucket)
+    cfg = cfg if cfg is not None else infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    n = arr.N
+    if route is not None:
+        # force a fresh trace so the route proof below is STRICT — a warm
+        # jit cache would otherwise make the counter check vacuous (the
+        # TRACE_COUNTS caveat in ops/assign.py)
+        import jax
+
+        jax.clear_caches()
+    before = dict(TRACE_COUNTS)
+    want, want_used = schedule_batch_routed(arr, cfg, donate=False)
+    got, got_used = schedule_batch_routed(arr, cfg, donate=donate, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # padded runs return the padded node axis; the tail rows are always zero
+    gu = np.asarray(got_used)
+    np.testing.assert_array_equal(gu[:n], np.asarray(want_used))
+    assert not gu[n:].any()
+    if route is not None:
+        # the sharded program really compiled for this route
+        assert TRACE_COUNTS[route] > before[route], (before, TRACE_COUNTS)
+    return arr, cfg
+
+
+@pytest.mark.parametrize("donate", [False, True])
+@pytest.mark.parametrize("divisible", [True, False])
+def test_sharded_chunked_parity(mesh8, donate, divisible, monkeypatch):
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap, bucket = _chunked_snap(divisible)
+    arr, cfg = _assert_parity(
+        mesh8, snap, bucket, donate=donate, route="sharded_chunked"
+    )
+    # prove the route: the config really is chunk-eligible
+    from kubernetes_tpu.ops.assign import _chunkable
+
+    assert _chunkable(arr, cfg)
+
+
+@pytest.mark.parametrize("donate", [False, True])
+@pytest.mark.parametrize("divisible", [True, False])
+def test_sharded_rounds_parity(mesh8, donate, divisible, monkeypatch):
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap, bucket = _rounds_snap(divisible)
+    _assert_parity(
+        mesh8, snap, bucket, cfg=DEFAULT_SCORE_CONFIG, donate=donate,
+        route="sharded_rounds",
+    )
+
+
+def test_sharded_ordinals_parity(mesh8):
+    """The ordinal-reporting variant (the scheduler batch path's call) is
+    sharded too: choices, per-pod commit ordinals and total sweeps all match
+    the single-device kernel."""
+    snap, bucket = _rounds_snap(True)
+    arr, _ = encode_snapshot(snap, bucket=bucket)
+    want_c, _, want_o, want_s = schedule_batch_ordinals_routed(
+        arr, DEFAULT_SCORE_CONFIG, donate=False
+    )
+    got_c, _, got_o, got_s = schedule_batch_ordinals_routed(
+        arr, DEFAULT_SCORE_CONFIG, donate=False, mesh=mesh8
+    )
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+    np.testing.assert_array_equal(np.asarray(got_o), np.asarray(want_o))
+    assert int(got_s) == int(want_s)
+
+
+def test_pipelined_loop_with_mesh_matches_serial(mesh8):
+    """The double-buffered loop against a SHARDED device step: verdicts
+    bit-identical to the unsharded serial oracle, resident buffers placed
+    with NamedSharding (no per-cycle re-transfer of unchanged fields)."""
+    from kubernetes_tpu.api.snapshot import Snapshot
+    from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop, run_serial
+    from helpers import mk_node, mk_pod
+
+    def wave(seed):
+        rng = np.random.default_rng(seed)
+        return Snapshot(
+            nodes=[mk_node(f"w{seed}-n{i}", cpu=int(rng.integers(2000, 8000)))
+                   for i in range(10)],
+            pending_pods=[mk_pod(f"w{seed}-p{j}", cpu=int(rng.integers(100, 1500)))
+                          for j in range(16)],
+        )
+
+    waves = [wave(s) for s in range(4)]
+    oracle = list(run_serial(waves))  # single-device, serial
+    loop = PipelinedBatchLoop(depth=1, mesh=mesh8)
+    got = list(loop.run(waves))
+    assert got == oracle
+    assert loop.enc._dev, "resident device buffers should exist"
+    from jax.sharding import NamedSharding
+
+    shardings = {
+        name: ent[1].sharding for name, ent in loop.enc._dev.items()
+    }
+    assert all(isinstance(s, NamedSharding) for s in shardings.values())
+    # node-axis fields really live sharded (not fully replicated)
+    assert not shardings["node_labels"].is_fully_replicated
+
+
+def test_mesh_from_env_validates_and_clamps(monkeypatch):
+    from kubernetes_tpu.parallel.mesh import mesh_from_env
+
+    monkeypatch.delenv("KTPU_MESH", raising=False)
+    assert mesh_from_env() is None
+    monkeypatch.setenv("KTPU_MESH", "1")
+    assert mesh_from_env() is None
+    monkeypatch.setenv("KTPU_MESH", "banana")
+    with pytest.raises(ValueError, match="KTPU_MESH"):
+        mesh_from_env()
+    monkeypatch.setenv("KTPU_MESH", "-3")
+    with pytest.raises(ValueError, match="KTPU_MESH"):
+        mesh_from_env()
+    monkeypatch.setenv("KTPU_MESH", "4096")  # beyond available: clamps
+    with pytest.warns(UserWarning, match="clamping"):
+        mesh = mesh_from_env()
+    assert mesh is not None and int(mesh.size) >= 8
+
+
+def test_pad_nodes_semantics():
+    """Padding adds permanently invalid nodes: valid False, zero capacity,
+    sentinel domains — and is a no-op when already divisible."""
+    from kubernetes_tpu.parallel.mesh import pad_nodes
+
+    snap, _ = _rounds_snap(False)
+    arr, _ = encode_snapshot(snap, bucket=False)
+    assert arr.N == 27
+    same, n0 = pad_nodes(arr, 1)
+    assert same is arr and n0 == 27
+    padded, n0 = pad_nodes(arr, 8)
+    assert n0 == 27 and padded.N == 32
+    assert not padded.node_valid[27:].any()
+    assert not padded.node_alloc[27:].any()
+    d_sentinel = arr.term_counts0.shape[1] - 1
+    assert (padded.node_dom[:, 27:] == d_sentinel).all()
+    np.testing.assert_array_equal(padded.node_labels[:27], arr.node_labels)
+
+
+def test_chaos_storm_with_mesh(monkeypatch):
+    """Seeded chaos storm through the Scheduler batch path with the mesh
+    armed (KTPU_MESH=8): placements bit-identical to the fault-free,
+    UNSHARDED serial oracle — the chaos parity invariant extended to the
+    sharded production route."""
+    from test_chaos import _churn_run
+    from kubernetes_tpu import chaos
+
+    monkeypatch.delenv("KTPU_MESH", raising=False)
+    monkeypatch.delenv("KTPU_FORCE_CHUNKED", raising=False)
+    oracle, _ = _churn_run(pipeline=False)
+    monkeypatch.setenv("KTPU_MESH", "8")
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    import jax
+
+    jax.clear_caches()  # strict route proof: the storm must RE-compile
+    before = dict(TRACE_COUNTS)
+    got, sched = _churn_run(
+        pipeline=True,
+        plan=chaos.FaultPlan.from_seed(
+            0, sites=("scheduler.step", "host.stall"), n_faults=4
+        ),
+    )
+    assert got == oracle
+    assert sched.mesh is not None and int(sched.mesh.size) == 8
+    assert TRACE_COUNTS["sharded_rounds"] > before["sharded_rounds"]
+
+
+@pytest.mark.slow
+def test_sharded_chunked_full_scale_parity(mesh8, monkeypatch):
+    """North-star-shaped (heterogeneous, chunk-routed) parity at a scale
+    where multiple chunks and non-trivial shards are exercised."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    from kubernetes_tpu.bench.workloads import heterogeneous
+
+    snap = heterogeneous(1000, 2560, seed=0)
+    arr, _ = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want, want_used = schedule_batch_routed(arr, cfg, donate=False)
+    got, got_used = schedule_batch_routed(arr, cfg, donate=False, mesh=mesh8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got_used), np.asarray(want_used))
