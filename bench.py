@@ -121,8 +121,13 @@ def main() -> None:
     from kubernetes_tpu.ops.aot import maybe_enable_compile_cache
     from kubernetes_tpu.ops.assign import (
         donation_supported,
+        reset_trace_counts,
         schedule_batch_routed,
     )
+
+    # per-run counters (ops/assign.py): route_trace_counts must describe
+    # THIS run even when bench runs back-to-back in one process
+    reset_trace_counts()
 
     # persistent XLA compile cache (KTPU_COMPILE_CACHE_DIR): the first
     # process pays the cold compile; every later one loads the executable
@@ -165,13 +170,43 @@ def main() -> None:
     )
     print(f"compile+first run: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
-    t_step = float("inf")
+    t_step_dense = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
         choices = np.asarray(
             schedule_batch_routed(arr, cfg, donate=don, mesh=mesh)[0]
         )
-        t_step = min(t_step, time.perf_counter() - t0)
+        t_step_dense = min(t_step_dense, time.perf_counter() - t0)
+
+    # the INCREMENTAL step — the production warm-cycle route (ops/
+    # incremental.py; KTPU_INCREMENTAL=0 skips it and step_s reports the
+    # dense kernel).  Same-box dense-vs-inc A/B lands in one artifact.
+    from kubernetes_tpu.ops.incremental import HoistCache
+
+    t_step = t_step_dense
+    hoist_probe = HoistCache(mesh=mesh)
+    inc = hoist_probe.ensure(arr, meta, cfg)
+    if inc is not None:
+        t0 = time.perf_counter()
+        choices = np.asarray(
+            schedule_batch_routed(arr, cfg, donate=don, mesh=mesh, inc=inc)[0]
+        )
+        print(f"inc compile+first run: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        t_step = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            choices = np.asarray(
+                schedule_batch_routed(
+                    arr, cfg, donate=don, mesh=mesh, inc=inc
+                )[0]
+            )
+            t_step = min(t_step, time.perf_counter() - t0)
+        print(
+            f"step dense {t_step_dense:.2f}s -> incremental {t_step:.2f}s "
+            f"({t_step_dense / max(t_step, 1e-9):.2f}x)",
+            file=sys.stderr,
+        )
 
     # the pre-chunking per-pod scan, for the delta the chunked path buys
     # (ops/assign.py — schedule_scan_chunked vs schedule_scan).  Skipped on
@@ -296,6 +331,9 @@ def main() -> None:
                 "encode_s": round(t_encode, 3),
                 "delta_s": round(t_delta, 3),
                 "step_s": round(t_step, 4),
+                # the dense (pre-PR-5) kernel on the same box, same run —
+                # the incremental speedup's denominator
+                "step_dense_s": round(t_step_dense, 4),
                 "step_unchunked_s": (
                     round(t_plain, 4) if t_plain is not None else None
                 ),
@@ -320,6 +358,13 @@ def main() -> None:
                 # which kernel the routed call actually compiled (trace-time
                 # proof; the fallback must exercise the production route)
                 "route_trace_counts": dict(_trace_counts()),
+                # incremental warm-cycle attribution (ops/incremental.py —
+                # BENCH_r06): unique equivalence classes this wave, the
+                # median dirty-node fraction the warm patches covered, and
+                # resident-cache hit/full counts.  KTPU_INCREMENTAL=0 runs
+                # the dense pre-PR-5 path for A/B comparison.
+                "incremental": os.environ.get("KTPU_INCREMENTAL", "") != "0",
+                **loop.hoist.summary(),
             }
         )
     )

@@ -169,6 +169,9 @@ class _Fallback(Exception):
 _BoundRec = tuple
 _NI, _REQ_U, _SPEC_U, _PORT_IDS, _ANTI_IDS, _PREF, _OBJ = range(7)
 
+# shared "nothing changed" dirty set (sync_bound / EncodingMeta.dirty_nodes)
+_EMPTY_DIRTY = np.empty(0, dtype=np.int64)
+
 
 @dataclass
 class ClusterSide:
@@ -249,6 +252,11 @@ class ClusterSide:
     raw_nodes_fp: Tuple = ()
     storage_fp: Tuple = ()
     raw_refs: Tuple = ()
+    # node rows whose bound-pod contributions (usage / counts / ports)
+    # changed in the LAST sync_bound — the O(changes) dirty-node set the
+    # incremental device hoist reports (ops/incremental.py).  None right
+    # after a rebuild ("unknown: everything").
+    last_dirty_nodes: Optional[np.ndarray] = None
 
 
 def _nodes_fp(nodes: Sequence[t.Node]) -> Tuple:
@@ -626,11 +634,14 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
         if uid not in cs.records:
             new.append(q)
     if not gone and not new:
+        cs.last_dirty_nodes = _EMPTY_DIRTY
         return
     cs.stats["deltas"] += 1
     cs.mut_version += 1
+    dirty_ni: List[int] = []
     if gone:
         recs = [cs.records.pop(uid) for uid in gone]
+        dirty_ni.extend(r[_NI] for r in recs)
         _apply_bound_batch(
             cs,
             np.array([r[_NI] for r in recs], dtype=np.int64),
@@ -748,6 +759,7 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
                 np.zeros(max(1, len(cs.terms_list)), dtype=np.float32)
                 for _ in fresh_specs
             )
+        dirty_ni.extend(r[_NI] for r in add_recs)
         _apply_bound_batch(
             cs,
             np.array([r[_NI] for r in add_recs], dtype=np.int64),
@@ -756,6 +768,9 @@ def sync_bound(cs: ClusterSide, bound: Sequence[t.Pod]) -> None:
             add_recs,
             sign=1,
         )
+    # the O(changes) dirty-node set: every row this sync's scatter updates
+    # touched (binds + deletes + replaced objects' re-absorbs)
+    cs.last_dirty_nodes = np.unique(np.array(dirty_ni, dtype=np.int64))
 
 
 # --------------------------------------------------------------------------
@@ -1506,6 +1521,28 @@ def _assemble(
         lambda: _pad2(cs.node_port_count > 0, bool),
     )
 
+    # --- equivalence classes (ops/incremental.py): per-pod class index +
+    # first-occurrence row per class.  inv IS the class map (one class per
+    # unique spec rep); bucketing padding gets one extra all-padding class.
+    # Cached so the arrays are identity-stable across steady-state waves —
+    # the HoistCache's invalidation fingerprint depends on it. ---
+    def _class_index():
+        pc = np.full(P, U, dtype=np.int32)
+        if p:
+            pc[:p] = inv
+        padded = P > p
+        first = np.zeros(U + (1 if padded else 0), dtype=np.int64)
+        if U:
+            uu, fi = np.unique(inv, return_index=True)
+            first[uu] = fi  # every rep occurs in inv by construction
+        if padded:
+            first[U] = p  # any padded row — all are identical
+        return pc, first
+
+    pod_class, class_first = _cached(
+        cs, "class_index", (P, U, p, inv.tobytes()), _class_index
+    )
+
     arrays = ClusterArrays(
         node_valid=node_valid,
         node_alloc=node_alloc,
@@ -1541,5 +1578,9 @@ def _assemble(
         pairwise_vocab=cs.voc,
         n_nodes=n,
         n_pods=p,
+        pod_class=pod_class,
+        class_first_pod=class_first,
+        n_classes=int(class_first.shape[0]),
+        dirty_nodes=cs.last_dirty_nodes,
     )
     return arrays, meta

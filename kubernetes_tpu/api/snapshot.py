@@ -107,6 +107,19 @@ class EncodingMeta:
     pairwise_vocab: object  # api/pairwise.py — PairwiseVocab
     n_nodes: int
     n_pods: int
+    # equivalence classes (the historical equivalence-cache analog, consumed
+    # by ops/incremental.py — HoistCache): per-pod class index i32[P]
+    # (class U = the bucketing padding class), the first pod row of each
+    # class i64[U1], and the class count.  None on paths that do not build
+    # them (the incremental device hoist then simply does not engage).
+    pod_class: Optional[np.ndarray] = None
+    class_first_pod: Optional[np.ndarray] = None
+    n_classes: int = 0
+    # node rows whose bound-pod contributions changed in THIS encode's sync
+    # (api/delta.py — sync_bound); None = unknown (fresh rebuild).  The
+    # HoistCache's authoritative dirty set is its own node_used row diff —
+    # this is the encoder-side O(changes) report (spans, bench artifacts).
+    dirty_nodes: Optional[np.ndarray] = None
 
 
 @jax.tree_util.register_dataclass

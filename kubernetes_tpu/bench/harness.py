@@ -216,11 +216,14 @@ def run_streaming_workload(
 
     pipeline=False (the --no-pipeline escape hatch) runs ONLY the serial
     loop, so pre-pipeline numbers remain reproducible bit-for-bit."""
-    from ..ops.assign import TRACE_COUNTS
+    from ..ops.assign import TRACE_COUNTS, reset_trace_counts
     from ..parallel.mesh import mesh_from_env
     from ..parallel.pipeline import PipelinedBatchLoop, run_serial
     from ..scheduler.tracing import Tracer
 
+    # per-run counters: back-to-back harness invocations in one process
+    # previously reported cumulative route_trace_counts
+    reset_trace_counts()
     mesh = mesh_from_env()  # KTPU_MESH: sharded routed step under the loop
     if warmup:  # hit the XLA cache so the timed runs measure steady state
         for _ in PipelinedBatchLoop(donate=donate, mesh=mesh).run(waves[:1]):
@@ -257,6 +260,8 @@ def run_streaming_workload(
         donated_waves=int(runner.stats["donated"]),
         pods_per_sec=round(pods / t_pipe, 1) if t_pipe > 0 else 0.0,
         route_trace_counts=dict(TRACE_COUNTS),
+        # incremental warm-cycle attribution (ops/incremental.py)
+        **runner.hoist.summary(),
     )
     return out
 
@@ -477,6 +482,11 @@ def main(argv=None) -> None:
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
                  "with the host-span trace)")
+    # counters are per-run: back-to-back harness invocations in one process
+    # must not report each other's kernel routes
+    from ..ops.assign import reset_trace_counts
+
+    reset_trace_counts()
     if args.compile_cache:
         # publish to the env too: Scheduler.__init__ re-resolves from
         # KTPU_COMPILE_CACHE_DIR, and a conflicting stale env value would

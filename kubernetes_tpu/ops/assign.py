@@ -327,6 +327,16 @@ def schedule_scan(
 # KTPU_REPAIR_ITERS: fresh process per point).
 _CHUNK = int(os.environ.get("KTPU_CHUNK", "128"))
 _RCHUNK = int(os.environ.get("KTPU_RCHUNK", "16"))
+# chunk size for the INCREMENTAL chunked path (ops/incremental.py).  The
+# dense kernel wants big chunks because the [C, N, R] hoist and the [C, N]
+# top-k amortize per chunk; the incremental path hoists per CYCLE and
+# top-ks the [U, N] class matrix (independent of C), so only the
+# O(C^2·K)-per-round loop costs scale with C and SMALL chunks win —
+# measured on the CPU sim at 12.8k x 5k: dense@128 12.4 s, inc@128 8.4 s,
+# inc@32 1.2 s, same decisions throughout (chunk size never changes
+# decisions, only commit ordinals).  P (bucketed, pow2 >= _CHUNK) is
+# always divisible by it.
+_INC_CHUNK = int(os.environ.get("KTPU_INC_CHUNK", "32"))
 _SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
 _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
 
@@ -356,7 +366,19 @@ TRACE_COUNTS = {
     # traces under shard_map, so tests/benches can prove a routed call
     # actually compiled the sharded program for its route
     "sharded_plain": 0, "sharded_chunked": 0, "sharded_rounds": 0,
+    # incremental (equivalence-class / dirty-node, ops/incremental.py)
+    # variants of the production kernels
+    "chunked_inc": 0, "rounds_inc": 0,
+    "sharded_chunked_inc": 0, "sharded_rounds_inc": 0,
 }
+
+
+def reset_trace_counts() -> None:
+    """Zero TRACE_COUNTS — called at harness/bench run start so counters
+    never bleed across runs in one process (back-to-back bench.harness
+    invocations previously reported cumulative route_trace_counts)."""
+    for k in TRACE_COUNTS:
+        TRACE_COUNTS[k] = 0
 
 
 def _chunkable(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
@@ -398,11 +420,26 @@ def _chunk_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 def schedule_scan_chunked(
     arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False,
     with_ordinals: bool = False, axis_name: Optional[str] = None,
-    axis_size: int = 1, image_sharded: Optional[bool] = None,
+    axis_size: int = 1, image_sharded: Optional[bool] = None, inc=None,
 ):
     """Chunked sequential-commit scan via PREFIX-COMMIT SPECULATION rounds,
     BIT-IDENTICAL to schedule_scan for fit+balanced-only configs
     (tests/test_assign_parity.py — chunked cases).
+
+    INCREMENTAL MODE (`inc` = ops/incremental.py — IncState): the [C, Nl]
+    per-chunk dense hoist is replaced by a CLASS hoist [U1, N] (U1 = unique
+    specs + padding class, U1 ≪ P for template-stamped waves) that arrives
+    precomputed vs cycle-start usage (resident across warm cycles,
+    dirty-column patched by the HoistCache), is carried through the chunk
+    scan, and is PATCHED at committed node columns against the new usage —
+    the same O(C)-column patching discipline schedule_scan_rounds applies,
+    lifted to the chunk level.  Per-pod score rows are gathers of their
+    class row (rows within a class are bit-identical by construction,
+    api/delta.py — _pod_side), and lax.top_k over identical rows is
+    deterministic, so decisions are bit-identical to the dense path
+    (tests/test_incremental.py).  Per-chunk hoist FLOPs drop from
+    O(C·N·R) to O(U1·C·R) patching; the only O(N) per-chunk work left is
+    the class top-k ([U1, N] when U1 <= C, else the gathered [C, N]).
 
     The per-pod scan's latency floor is the sequential step count: ~3us of
     on-device loop overhead per `lax.scan` step x 50k pods =~ the whole
@@ -461,7 +498,11 @@ def schedule_scan_chunked(
     [C, C*K] ≈ the same bytes as [C, N] with far more collectives).  The
     loop's per-round cost is O(C^2), independent of N — only the hoist
     scales with the node axis, and the hoist is what shards."""
-    TRACE_COUNTS["sharded_chunked" if axis_name else "chunked"] += 1
+    use_inc = inc is not None
+    TRACE_COUNTS[
+        ("sharded_chunked" if axis_name else "chunked")
+        + ("_inc" if use_inc else "")
+    ] += 1
     local_n = arr.N
     if axis_name:
         base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
@@ -477,20 +518,8 @@ def schedule_scan_chunked(
         used_init = arr.node_used
     my_nodes = base + jnp.arange(local_n, dtype=jnp.int32)
 
-    tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
-    nodesel = filters.node_selection_ok_from(tm, arr)
-    pin = arr.pod_nodename[:, None]
-    nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-    sf = (
-        arr.node_valid[None, :]
-        & arr.pod_valid[:, None]
-        & filters.taints_ok(arr)
-        & nodesel
-        & nodename_ok
-    )
-    n_alloc = arr.node_alloc  # LOCAL node slice — hoist-side only
     P, R = arr.P, arr.R
-    C = _CHUNK
+    C = _INC_CHUNK if use_inc else _CHUNK
     K = min(C + 1, N)  # K == N: the list is exhaustive, guarded by .any()
     Z = min(_SPECZ, K)  # usable entries precomputed for pass-1 speculation
     res = cfg.score_resources
@@ -499,8 +528,41 @@ def schedule_scan_chunked(
     jlt = idxC[None, :] < idxC[:, None]  # [i, j]: j < i
 
     reqs = arr.pod_req.reshape(P // C, C, R)
-    sfs = sf.reshape(P // C, C, local_n)
     valids = arr.pod_valid.reshape(P // C, C)
+    if use_inc:
+        # the static-feasibility and base-score hoists arrive precomputed
+        # per CLASS (resident across cycles); the [P, Nl] sf prelude and
+        # per-chunk dense hoist below never trace
+        U1 = inc.req_u.shape[0]
+        req_u = inc.req_u
+        t0u_init = jnp.where(inc.stat_u & inc.fit_u, inc.base_u, neg_inf)
+        if axis_name:
+            # stitch the shard-local class hoists once per cycle; the chunk
+            # scan then carries the full [U1, N] matrix replicated (the
+            # non-inc path gathers [C, N] per chunk — this is strictly less
+            # collective traffic whenever U1 < C * n_chunks)
+            t0u_init = lax.all_gather(t0u_init, axis_name, axis=1, tiled=True)
+            stat_full = lax.all_gather(
+                inc.stat_u, axis_name, axis=1, tiled=True
+            )
+        else:
+            stat_full = inc.stat_u
+        clss = inc.cls.reshape(P // C, C)
+        sfs = None
+    else:
+        tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
+        nodesel = filters.node_selection_ok_from(tm, arr)
+        pin = arr.pod_nodename[:, None]
+        nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+        sf = (
+            arr.node_valid[None, :]
+            & arr.pod_valid[:, None]
+            & filters.taints_ok(arr)
+            & nodesel
+            & nodename_ok
+        )
+        n_alloc = arr.node_alloc  # LOCAL node slice — hoist-side only
+        sfs = sf.reshape(P // C, C, local_n)
 
     def score_flat(requested, alloc):
         """Same formulas as the dense hoist, on flattened [*, R] rows —
@@ -518,32 +580,66 @@ def schedule_scan_chunked(
         cand = jnp.minimum(cd, jnp.where(vu == best, iu, _INT_MAX))
         return best, cand
 
-    def chunk(used_in, xs):
-        creq, csf, cvalid = xs
-        used0 = used_in  # FULL [N, R] usage (replicated under sharding)
-        if axis_name:
-            used0_l = lax.dynamic_slice_in_dim(used0, base, local_n, axis=0)
+    def chunk(carry, xs):
+        if use_inc:
+            used0, t0u = carry  # t0u: masked class scores vs current used0
+            creq, ccls, cvalid = xs
+            # per-pod scores are gathers of the pod's CLASS row — identical
+            # rows, and lax.top_k on identical rows is deterministic, so
+            # topv/topi match the dense path bit-for-bit.  Trace-time
+            # choice: top-k the [U1, N] class matrix and gather [C, K]
+            # lists when that is the smaller problem, else gather the
+            # [C, N] rows first (a memory move, no score FLOPs either way)
+            if U1 <= C:
+                tv_u, ti_u = lax.top_k(t0u, K)
+                topv, topi = tv_u[ccls], ti_u[ccls]
+            else:
+                topv, topi = lax.top_k(t0u[ccls], K)
+            # per-pod validity (stat_u deliberately excludes pod_valid so
+            # the resident state survives gang revocations): an invalid
+            # pod's list empties exactly as the dense path's all--inf row
+            # would, and every choice below is additionally cvalid-gated
+            topv = jnp.where(cvalid[:, None], topv, neg_inf)
+            t0u_T = t0u.T  # [N, U1] — contiguous row gathers below
+
+            def stat_at(node_ids):
+                # hoisted-entry feasibility at candidate columns, per pod:
+                # class rows gathered through ccls (== total0_T[ids].T)
+                return (t0u_T[node_ids] > neg_inf)[:, ccls].T  # [C, D]
         else:
-            used0_l = used0
-        # hoisted dense scores vs chunk-start usage (vmap = the per-step ops
-        # batched, so float32 results are bit-identical to the plain scan);
-        # shard-local: [C, Nl, R] intermediates, this kernel's biggest block
-        requested = used0_l[None, :, :] + creq[:, None, :]  # [C, Nl, R]
-        fit0 = jax.vmap(filters.fit_ok, (0, None, None))(creq, used0_l, n_alloc)
-        total0 = cfg.fit_weight * jax.vmap(
-            lambda rq, al: fit_score(rq, al, cfg), (0, None)
-        )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
-            balanced_allocation, (0, None, None)
-        )(requested, n_alloc, res)
-        total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, Nl]
-        if axis_name:
-            # stitch the shard-local hoists into the full masked score
-            # matrix; from here the round loop is replicated verbatim
-            total0 = lax.all_gather(total0, axis_name, axis=1, tiled=True)
-        topv, topi = lax.top_k(total0, K)  # [C, K] each
-        # row-major transpose: [C, D] static-feasibility lookups below become
-        # contiguous row gathers instead of strided column gathers
-        total0_T = total0.T  # [N, C]
+            used0 = carry  # FULL [N, R] usage (replicated under sharding)
+            creq, csf, cvalid = xs
+            if axis_name:
+                used0_l = lax.dynamic_slice_in_dim(
+                    used0, base, local_n, axis=0
+                )
+            else:
+                used0_l = used0
+            # hoisted dense scores vs chunk-start usage (vmap = the per-step
+            # ops batched, so float32 results are bit-identical to the plain
+            # scan); shard-local: [C, Nl, R] intermediates, this kernel's
+            # biggest block
+            requested = used0_l[None, :, :] + creq[:, None, :]  # [C, Nl, R]
+            fit0 = jax.vmap(filters.fit_ok, (0, None, None))(
+                creq, used0_l, n_alloc
+            )
+            total0 = cfg.fit_weight * jax.vmap(
+                lambda rq, al: fit_score(rq, al, cfg), (0, None)
+            )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
+                balanced_allocation, (0, None, None)
+            )(requested, n_alloc, res)
+            total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, Nl]
+            if axis_name:
+                # stitch the shard-local hoists into the full masked score
+                # matrix; from here the round loop is replicated verbatim
+                total0 = lax.all_gather(total0, axis_name, axis=1, tiled=True)
+            topv, topi = lax.top_k(total0, K)  # [C, K] each
+            # row-major transpose: [C, D] static-feasibility lookups below
+            # become contiguous row gathers instead of strided column gathers
+            total0_T = total0.T  # [N, C]
+
+            def stat_at(node_ids):
+                return total0_T[node_ids].T > neg_inf  # [C, D]
         req_b = creq[:, None, :]  # [C(pod), 1, R]
 
         def rescore(node_ids, node_usage):
@@ -560,7 +656,7 @@ def schedule_scan_chunked(
                 reqd.reshape(-1, R),
                 jnp.broadcast_to(da[None], shape).reshape(-1, R),
             ).reshape(shape[0], shape[1])
-            static = total0_T[node_ids].T > neg_inf  # [C, D]
+            static = stat_at(node_ids)  # [C, D]
             return fit, vals, static
 
         def round_body(st):
@@ -635,7 +731,7 @@ def schedule_scan_chunked(
             sl = jnp.argmax(eqd, axis=1)
             cu = jnp.where(hasslot[:, None], dsu[sl], used0[cn])  # [C, R]
             ca = n_alloc_full[cn]
-            cstat = total0_T[cn].T > neg_inf  # [C, C]
+            cstat = stat_at(cn)  # [C, C]
             uij = cu[None] + cum  # [C, C, R]
             # fit of pod i at node c_j under its intra-round usage uij[i, j]
             fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
@@ -720,14 +816,43 @@ def schedule_scan_chunked(
             lambda st: ~st[0].all(), round_body, st0
         )
         placed = (out >= 0)[:, None]
-        used_out = used0.at[jnp.where(out >= 0, out, N)].add(
+        ucols = jnp.where(out >= 0, out, N)
+        used_out = used0.at[ucols].add(
             jnp.where(placed, creq, 0), mode="drop"
         )
-        return used_out, (out, nrounds, ord_)
+        if not use_inc:
+            return used_out, (out, nrounds, ord_)
+        # patch the carried class hoist at the committed node columns
+        # against the NEW usage — exactly what a fresh hoist of the next
+        # chunk would compute there (fit/base read per-node usage only);
+        # untouched columns keep values computed against unchanged usage,
+        # so the carried matrix stays bit-identical to a per-chunk dense
+        # re-hoist throughout the scan.  Duplicate committed columns write
+        # identical values (same node, same final usage).
+        cn_out = jnp.maximum(out, 0)
+        col_used = used_out[cn_out]  # [C, R]
+        col_alloc = n_alloc_full[cn_out]
+        col_fit = jax.vmap(filters.fit_ok, (0, None, None))(
+            req_u, col_used, col_alloc
+        )  # [U1, C]
+        reqd_u = col_used[None, :, :] + req_u[:, None, :]  # [U1, C, R]
+        col_base = score_flat(
+            reqd_u.reshape(-1, R),
+            jnp.broadcast_to(col_alloc[None], reqd_u.shape).reshape(-1, R),
+        ).reshape(U1, C)
+        col_stat = stat_full[:, cn_out]  # [U1, C]
+        newv = jnp.where(col_stat & col_fit, col_base, neg_inf)
+        t0u = t0u.at[:, ucols].set(newv, mode="drop")
+        return (used_out, t0u), (out, nrounds, ord_)
 
-    used_final, (choices, rounds, ords) = lax.scan(
-        chunk, used_init, (reqs, sfs, valids)
-    )
+    if use_inc:
+        (used_final, _), (choices, rounds, ords) = lax.scan(
+            chunk, (used_init, t0u_init), (reqs, clss, valids)
+        )
+    else:
+        used_final, (choices, rounds, ords) = lax.scan(
+            chunk, used_init, (reqs, sfs, valids)
+        )
     if with_ordinals:
         # global commit ordinal: rounds of all previous chunks + the pod's
         # commit round within its chunk (pods committed in the same round
@@ -765,7 +890,7 @@ def _rounds_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 def schedule_scan_rounds(
     arr: ClusterArrays, cfg: ScoreConfig, with_rounds: bool = False,
     with_ordinals: bool = False, axis_name: Optional[str] = None,
-    axis_size: int = 1, image_sharded: Optional[bool] = None,
+    axis_size: int = 1, image_sharded: Optional[bool] = None, inc=None,
 ):
     """Chunked sequential-commit scan for the FULL stage set — pairwise
     (PodTopologySpread + InterPodAffinity), NodePorts, TaintToleration
@@ -866,8 +991,23 @@ def schedule_scan_rounds(
 
     The [N, R] usage array is all-gathered once per step and carried
     replicated (tiny next to the [T, N]/[P, N] state, and the repair needs
-    arbitrary candidate rows of it every round)."""
-    TRACE_COUNTS["sharded_rounds" if axis_name else "rounds"] += 1
+    arbitrary candidate rows of it every round).
+
+    INCREMENTAL MODE (`inc` = ops/incremental.py — IncState): the per-pod
+    usage-independent hoists (static feasibility, eligibility, taint /
+    node-affinity / image raws) arrive precomputed per CLASS (resident
+    across warm cycles) and are gathered [C, Nl] per chunk through the
+    class index; the fit+balanced base hoist [U1, Nl] arrives vs
+    cycle-start usage, is carried across chunks in the OUTER scan, and is
+    patched at committed columns per round at class level (O(U1·C·R)
+    instead of the per-chunk O(C·Nl·R) base_at re-hoist).  Per-pod rows
+    are class-row gathers — bit-identical by construction, so decisions
+    match the dense path exactly (tests/test_incremental.py)."""
+    use_inc = inc is not None
+    TRACE_COUNTS[
+        ("sharded_rounds" if axis_name else "rounds")
+        + ("_inc" if use_inc else "")
+    ] += 1
     local_n = arr.N
     if axis_name:
         base = lax.axis_index(axis_name).astype(jnp.int32) * local_n
@@ -890,24 +1030,33 @@ def schedule_scan_rounds(
     idxC = jnp.arange(C, dtype=jnp.int32)
     jlt = idxC[None, :] < idxC[:, None]  # [i, j]: j < i
 
-    tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
-    nodesel = filters.node_selection_ok_from(tm, arr)
-    pin = arr.pod_nodename[:, None]
-    nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-    sf = (
-        arr.node_valid[None, :]
-        & arr.pod_valid[:, None]
-        & filters.taints_ok(arr)
-        & nodesel
-        & nodename_ok
-    )
-    n_alloc = arr.node_alloc
     pw = cfg.enable_pairwise
     ips = pw and cfg.enable_interpod_score
     T = arr.term_counts0.shape[0]
     D = arr.term_counts0.shape[1] - 1
     dom_by_term = arr.node_dom[arr.term_key]  # i32[T, N]
     has_key_all = dom_by_term < D
+    if use_inc:
+        # usage-independent hoists (sf / elig / taint / node-affinity /
+        # image raws) arrive precomputed per CLASS and resident across
+        # cycles — the [P, Nl] preludes below never trace
+        U1 = inc.req_u.shape[0]
+        req_u = inc.req_u
+        img_on = inc.img_u is not None
+    else:
+        img_on = _image_on(arr, cfg, image_sharded)
+        tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
+        nodesel = filters.node_selection_ok_from(tm, arr)
+        pin = arr.pod_nodename[:, None]
+        nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+        sf = (
+            arr.node_valid[None, :]
+            & arr.pod_valid[:, None]
+            & filters.taints_ok(arr)
+            & nodesel
+            & nodename_ok
+        )
+    n_alloc = arr.node_alloc
 
     def score_flat(requested, alloc):
         return cfg.fit_weight * fit_score(
@@ -919,18 +1068,22 @@ def schedule_scan_rounds(
 
     xs = {
         "req": seg(arr.pod_req),
-        "sf": seg(sf),
         "valid": seg(arr.pod_valid),
     }
-    if cfg.enable_taint_score:
-        xs["traw"] = seg(taint_prefer_counts(arr))
-    if cfg.enable_node_pref:
-        xs["naraw"] = seg(_preferred_node_affinity_raw(arr, tm))
-    if _image_on(arr, cfg, image_sharded):
-        xs["img"] = seg(arr.image_score)
+    if use_inc:
+        xs["cls"] = seg(inc.cls)
+    else:
+        xs["sf"] = seg(sf)
+        if cfg.enable_taint_score:
+            xs["traw"] = seg(taint_prefer_counts(arr))
+        if cfg.enable_node_pref:
+            xs["naraw"] = seg(_preferred_node_affinity_raw(arr, tm))
+        if img_on:
+            xs["img"] = seg(arr.image_score)
+        if pw:
+            xs["elig"] = seg(nodesel & arr.node_valid[None, :])
     if pw:
         xs.update(
-            elig=seg(nodesel & arr.node_valid[None, :]),
             spread_t=seg(arr.pod_spread_terms),
             skew=seg(arr.pod_spread_maxskew),
             hard=seg(arr.pod_spread_hard),
@@ -956,8 +1109,29 @@ def schedule_scan_rounds(
         )
 
     def chunk(carry, cx):
-        used0, cnt_node, anti_node, pref_node, total_t, ports_used = carry
-        creq, csf, cvalid = cx["req"], cx["sf"], cx["valid"]
+        if use_inc:
+            (used0, cnt_node, anti_node, pref_node, total_t, ports_used,
+             base0_c, fit0_c) = carry
+        else:
+            used0, cnt_node, anti_node, pref_node, total_t, ports_used = carry
+        creq, cvalid = cx["req"], cx["valid"]
+        if use_inc:
+            # per-pod rows of the resident class hoists (identical rows by
+            # construction — api/delta.py _pod_side scatters per spec);
+            # pod_valid folds back in per pod (stat_u excludes it so the
+            # resident state survives the gang fixpoint's revocations)
+            ccls = cx["cls"]
+            csf = inc.stat_u[ccls] & cvalid[:, None]
+            celig = inc.elig_u[ccls] if pw else None
+            ctraw = inc.traw_u[ccls] if cfg.enable_taint_score else None
+            cnaraw = inc.naraw_u[ccls] if cfg.enable_node_pref else None
+            cimg = inc.img_u[ccls] if img_on else None
+        else:
+            csf = cx["sf"]
+            celig = cx["elig"] if pw else None
+            ctraw = cx["traw"] if cfg.enable_taint_score else None
+            cnaraw = cx["naraw"] if cfg.enable_node_pref else None
+            cimg = cx["img"] if img_on else None
 
         # --- per-chunk static: interference incidence [C, C] ---
         if pw:
@@ -1000,7 +1174,13 @@ def schedule_scan_rounds(
             )(requested, n_alloc, res)
             return b, fit
 
-        base0_init, fit0_init = base_at(used0)
+        if not use_inc:
+            base0_init, fit0_init = base_at(used0)
+        else:
+            # the class base hoist rides the OUTER carry — computed once
+            # per cycle (ops/incremental.py) and patched at committed
+            # columns below, never re-hoisted per chunk
+            base0_init, fit0_init = base0_c, fit0_c
 
         def round_body(st):
             (committed, out, ord_, base0, fit0, used, cnt_node, anti_node,
@@ -1008,7 +1188,13 @@ def schedule_scan_rounds(
             unc = ~committed
 
             # ---- exact re-hoist vs round-start state ----
-            feasible = csf & fit0
+            if use_inc:
+                # per-pod rows of the patched class matrices [U1, Nl]
+                fit0_p = fit0[ccls]
+                base0_p = base0[ccls]
+            else:
+                fit0_p, base0_p = fit0, base0
+            feasible = csf & fit0_p
             if cfg.enable_ports:
                 feasible &= jax.vmap(pairwise.ports_ok, (None, 0))(
                     ports_used, cx["ports"]
@@ -1018,29 +1204,29 @@ def schedule_scan_rounds(
                     partial(pairwise.spread_step, axis_name=axis_name),
                     (None, None, 0, 0, 0, 0),
                 )(cnt_node, has_key_all, cx["spread_t"], cx["skew"],
-                  cx["hard"], cx["elig"])
+                  cx["hard"], celig)
                 interpod_ok = jax.vmap(
                     pairwise.interpod_required_ok,
                     (None, None, None, None, 0, 0, 0, 0, 0),
                 )(cnt_node, anti_node, total_t, has_key_all, cx["aff"],
                   cx["anti"], cx["mt"], cx["mv"], cx["aself"])
                 feasible &= spread_ok & interpod_ok
-            total = base0
+            total = base0_p
             # per-pod NormalizeScore scalars over the CURRENT feasible set,
             # accumulated in the plain scan's stage order (float parity);
             # under sharding the scalars stitch with pmax, like the scan
             if cfg.enable_taint_score:
-                t_mx = _rmax(jnp.where(feasible, cx["traw"], 0.0), axis_name)
+                t_mx = _rmax(jnp.where(feasible, ctraw, 0.0), axis_name)
                 total = total + cfg.taint_weight * jnp.where(
                     (t_mx > 0)[:, None],
-                    MAXS - MAXS * cx["traw"] / t_mx[:, None],
+                    MAXS - MAXS * ctraw / t_mx[:, None],
                     MAXS,
                 )
             if cfg.enable_node_pref:
-                na_mx = _rmax(jnp.where(feasible, cx["naraw"], 0.0), axis_name)
+                na_mx = _rmax(jnp.where(feasible, cnaraw, 0.0), axis_name)
                 total = total + cfg.node_affinity_weight * jnp.where(
                     (na_mx > 0)[:, None],
-                    cx["naraw"] * MAXS / na_mx[:, None],
+                    cnaraw * MAXS / na_mx[:, None],
                     0.0,
                 )
             if pw:
@@ -1068,8 +1254,8 @@ def schedule_scan_rounds(
                     / (ip_mx[:, None] - ip_mn[:, None]),
                     0.0,
                 )
-            if "img" in cx:
-                total = total + cfg.image_weight * cx["img"]
+            if img_on:
+                total = total + cfg.image_weight * cimg
             total = jnp.where(feasible, total, neg_inf)
             best = _rmax(total, axis_name)
             cand = _rmin(
@@ -1136,7 +1322,7 @@ def schedule_scan_rounds(
                 newtot = baseij
                 extreme_at = jnp.zeros((C, C), dtype=jnp.bool_)
                 if cfg.enable_taint_score:
-                    r_at = _gather_cols(cx["traw"], cn, axis_name, base, local_n)
+                    r_at = _gather_cols(ctraw, cn, axis_name, base, local_n)
                     newtot = newtot + cfg.taint_weight * jnp.where(
                         (t_mx > 0)[:, None],
                         MAXS - MAXS * r_at / t_mx[:, None],
@@ -1145,7 +1331,7 @@ def schedule_scan_rounds(
                     extreme_at |= (t_mx > 0)[:, None] & (r_at == t_mx[:, None])
                 if cfg.enable_node_pref:
                     r_at = _gather_cols(
-                        cx["naraw"], cn, axis_name, base, local_n
+                        cnaraw, cn, axis_name, base, local_n
                     )
                     newtot = newtot + cfg.node_affinity_weight * jnp.where(
                         (na_mx > 0)[:, None],
@@ -1176,9 +1362,9 @@ def schedule_scan_rounds(
                     extreme_at |= (ip_mx > ip_mn)[:, None] & (
                         (r_at == ip_mx[:, None]) | (r_at == ip_mn[:, None])
                     )
-                if "img" in cx:
+                if img_on:
                     newtot = newtot + cfg.image_weight * _gather_cols(
-                        cx["img"], cn, axis_name, base, local_n
+                        cimg, cn, axis_name, base, local_n
                     )
                 newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
                 dropped = feas0_at & ~fitij
@@ -1253,16 +1439,31 @@ def schedule_scan_rounds(
             # patch base/fit at the dirtied columns against the NEW usage
             col_used = used[cn_final]  # [C, R] (committed cols; others dropped)
             col_alloc = n_alloc_full[cn_final]
-            col_req = col_used[None, :, :] + creq[:, None, :]  # [C, C, R]
-            col_fit = jax.vmap(
-                lambda rq: filters.fit_ok(rq, col_used, col_alloc)
-            )(creq)
-            col_base = score_flat(
-                col_req.reshape(-1, R),
-                jnp.broadcast_to(col_alloc[None], col_req.shape).reshape(
-                    -1, R
-                ),
-            ).reshape(C, C)
+            if use_inc:
+                # class-level column recompute: one [U1, C] block replaces
+                # the per-pod [C, C] one (per-pod rows are class-row
+                # gathers, so the scattered values are identical)
+                col_req = col_used[None, :, :] + req_u[:, None, :]  # [U1,C,R]
+                col_fit = jax.vmap(
+                    lambda rq: filters.fit_ok(rq, col_used, col_alloc)
+                )(req_u)
+                col_base = score_flat(
+                    col_req.reshape(-1, R),
+                    jnp.broadcast_to(
+                        col_alloc[None], col_req.shape
+                    ).reshape(-1, R),
+                ).reshape(U1, C)
+            else:
+                col_req = col_used[None, :, :] + creq[:, None, :]  # [C, C, R]
+                col_fit = jax.vmap(
+                    lambda rq: filters.fit_ok(rq, col_used, col_alloc)
+                )(creq)
+                col_base = score_flat(
+                    col_req.reshape(-1, R),
+                    jnp.broadcast_to(col_alloc[None], col_req.shape).reshape(
+                        -1, R
+                    ),
+                ).reshape(C, C)
             if axis_name:
                 # each shard patches only the columns it owns; foreign and
                 # sentinel ids map to local_n and drop (duplicate committed
@@ -1343,12 +1544,16 @@ def schedule_scan_rounds(
             jnp.int32(0),
         )
         st = lax.while_loop(lambda s: ~s[0].all(), round_body, st0)
-        (_, out, ord_, _, _, used, cnt_node, anti_node, pref_node, total_t,
-         ports_used, nrounds) = st
-        return (
-            (used, cnt_node, anti_node, pref_node, total_t, ports_used),
-            (out, nrounds, ord_),
-        )
+        (_, out, ord_, base0_f, fit0_f, used, cnt_node, anti_node, pref_node,
+         total_t, ports_used, nrounds) = st
+        carry_out = (used, cnt_node, anti_node, pref_node, total_t, ports_used)
+        if use_inc:
+            # the patched class hoist flows to the next chunk: committed
+            # columns are exact vs the new usage, untouched columns kept
+            # values whose inputs did not change — bit-identical to the
+            # per-chunk base_at re-hoist
+            carry_out = carry_out + (base0_f, fit0_f)
+        return carry_out, (out, nrounds, ord_)
 
     cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
     anti_node0 = jnp.take_along_axis(arr.anti_counts0, dom_by_term, axis=1)
@@ -1358,6 +1563,8 @@ def schedule_scan_rounds(
         used_init, cnt_node0, anti_node0, pref_node0, total_t0,
         arr.node_ports0,
     )
+    if use_inc:
+        carry0 = carry0 + (inc.base_u, inc.fit_u)
     (used_final, *_), (choices, rounds, ords) = lax.scan(chunk, carry0, xs)
     if with_ordinals:
         base = jnp.concatenate(
@@ -1370,11 +1577,46 @@ def schedule_scan_rounds(
     return choices.reshape(P), used_final
 
 
-def schedule_batch_impl(arr: ClusterArrays, cfg: ScoreConfig) -> Tuple[jax.Array, jax.Array]:
+def inc_route_applies(arr, cfg: ScoreConfig) -> bool:
+    """Whether this (arr, cfg) routes a kernel that consumes the
+    incremental class state at all — callers gate HoistCache.ensure() on
+    it so waves that route the plain per-pod scan never pay the [U, N]
+    class hoist for nothing."""
+    return _chunk_routed(arr, cfg) or _rounds_routed(arr, cfg)
+
+
+def inc_applicable(arr, cfg: ScoreConfig, inc):
+    """Shape/config gate for the incremental class state (ops/incremental.py
+    — IncState): None unless the state matches this call's arrays and the
+    dedup is non-degenerate (U1 < P; the all-pods-unique wave routes the
+    plain dense kernels, making the dedup path a provable no-op).  Pure
+    host-side — it decides the jit call's pytree structure."""
+    if inc is None:
+        return None
+    if inc.req_u.shape[0] >= arr.P or inc.cls.shape[0] != arr.P:
+        return None
+    if inc.stat_u.shape[-1] != arr.N or inc.req_u.shape[1] != arr.R:
+        return None
+    if arr.P % _INC_CHUNK:  # a hand-set KTPU_INC_CHUNK must divide P
+        return None
+    image_on = cfg.enable_image and arr.image_score.shape[1] == arr.N
+    if (
+        (cfg.enable_pairwise and inc.elig_u is None)
+        or (cfg.enable_taint_score and inc.traw_u is None)
+        or (cfg.enable_node_pref and inc.naraw_u is None)
+        or (image_on != (inc.img_u is not None))
+    ):
+        return None
+    return inc
+
+
+def schedule_batch_impl(
+    arr: ClusterArrays, cfg: ScoreConfig, inc=None
+) -> Tuple[jax.Array, jax.Array]:
     if _chunk_routed(arr, cfg):
-        return schedule_scan_chunked(arr, cfg)
+        return schedule_scan_chunked(arr, cfg, inc=inc)
     if _rounds_routed(arr, cfg):
-        return schedule_scan_rounds(arr, cfg)
+        return schedule_scan_rounds(arr, cfg, inc=inc)
     return schedule_scan(arr, cfg, axis_name=None)
 
 
@@ -1432,7 +1674,8 @@ def donation_supported() -> bool:
 _DONATION_PROBED: Optional[bool] = None
 
 
-def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool, mesh=None):
+def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool, mesh=None,
+                          inc=None):
     """schedule_batch with donation routed per call.  `donate` is the
     caller's RESOLVED decision (resolve defaults with donation_supported();
     an explicit True forces the donating kernel — tests do, even on the CPU
@@ -1444,11 +1687,20 @@ def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool, mesh=None):
     / rounds / per-pod scan — node-axis sharded under shard_map
     (parallel/sharded.py — sharded_schedule_batch_routed), bit-identical
     decisions; node counts not divisible by the mesh pad with permanently
-    invalid nodes (parallel/mesh.py — pad_nodes)."""
+    invalid nodes (parallel/mesh.py — pad_nodes).
+
+    `inc` (ops/incremental.py — HoistCache.ensure) is the resident
+    equivalence-class hoist state.  It enters the jit as a SEPARATE,
+    never-donated argument — only the per-wave ClusterArrays transfers are
+    donated, so a donated step can never consume the resident cache (the
+    donation-aliasing rule, PARITY.md)."""
     if mesh is not None and getattr(mesh, "size", 1) > 1:
         from ..parallel.sharded import sharded_schedule_batch_routed
 
-        return sharded_schedule_batch_routed(arr, cfg, mesh, donate=donate)
+        return sharded_schedule_batch_routed(
+            arr, cfg, mesh, donate=donate, inc=inc
+        )
+    inc = inc_applicable(arr, cfg, inc)
     if donate:
         import warnings
 
@@ -1456,11 +1708,12 @@ def schedule_batch_routed(arr, cfg: ScoreConfig, donate: bool, mesh=None):
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            return schedule_batch_donated(arr, cfg)
-    return schedule_batch(arr, cfg)
+            return schedule_batch_donated(arr, cfg, inc)
+    return schedule_batch(arr, cfg, inc)
 
 
-def schedule_batch_ordinals_impl(arr: ClusterArrays, cfg: ScoreConfig):
+def schedule_batch_ordinals_impl(arr: ClusterArrays, cfg: ScoreConfig,
+                                 inc=None):
     """schedule_batch + (per-pod COMMIT ORDINAL i32[P], total sweeps i32):
     the ordinal is the index of the sequential device sweep that decided
     each pod (the scan step on the per-pod path; the global round on the
@@ -1470,9 +1723,9 @@ def schedule_batch_ordinals_impl(arr: ClusterArrays, cfg: ScoreConfig):
     ~(ordinal_i + 1) / sweeps of the way through the kernel step
     (BASELINE.md p99 scheduling latency; round-3 verdict missing #5)."""
     if _chunk_routed(arr, cfg):
-        return schedule_scan_chunked(arr, cfg, with_ordinals=True)
+        return schedule_scan_chunked(arr, cfg, with_ordinals=True, inc=inc)
     if _rounds_routed(arr, cfg):
-        return schedule_scan_rounds(arr, cfg, with_ordinals=True)
+        return schedule_scan_rounds(arr, cfg, with_ordinals=True, inc=inc)
     choices, used = schedule_scan(arr, cfg, axis_name=None)
     return choices, used, jnp.arange(arr.P, dtype=jnp.int32), jnp.int32(arr.P)
 
@@ -1487,16 +1740,18 @@ schedule_batch_ordinals_donated = partial(
 
 
 def schedule_batch_ordinals_routed(arr, cfg: ScoreConfig, donate: bool,
-                                   mesh=None):
+                                   mesh=None, inc=None):
     """schedule_batch_ordinals with the same donation routing + warning
     policy as schedule_batch_routed (`donate` = the caller's resolved
-    decision), and the same `mesh=` scale-out path."""
+    decision), the same `mesh=` scale-out path, and the same never-donated
+    `inc=` incremental-hoist argument."""
     if mesh is not None and getattr(mesh, "size", 1) > 1:
         from ..parallel.sharded import sharded_schedule_batch_routed
 
         return sharded_schedule_batch_routed(
-            arr, cfg, mesh, donate=donate, with_ordinals=True
+            arr, cfg, mesh, donate=donate, with_ordinals=True, inc=inc
         )
+    inc = inc_applicable(arr, cfg, inc)
     if donate:
         import warnings
 
@@ -1504,5 +1759,5 @@ def schedule_batch_ordinals_routed(arr, cfg: ScoreConfig, donate: bool,
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            return schedule_batch_ordinals_donated(arr, cfg)
-    return schedule_batch_ordinals(arr, cfg)
+            return schedule_batch_ordinals_donated(arr, cfg, inc)
+    return schedule_batch_ordinals(arr, cfg, inc)

@@ -46,7 +46,7 @@ def failed_groups(choices: np.ndarray, pod_group: np.ndarray, group_min: np.ndar
 
 def schedule_with_gangs(
     arr: ClusterArrays, cfg: ScoreConfig, with_ordinals: bool = False,
-    mesh=None,
+    mesh=None, inc=None,
 ):
     """Schedule honoring all-or-nothing groups.
 
@@ -59,7 +59,13 @@ def schedule_with_gangs(
     `mesh` runs each fixpoint iteration's batch step node-axis SHARDED
     (parallel/sharded.py) — safe here because the host fixpoint never
     donates (it re-reads `arr` across iterations), and decision-identical
-    since each iteration is an ordinary routed batch call."""
+    since each iteration is an ordinary routed batch call.
+
+    `inc` (ops/incremental.py) is safe to reuse across fixpoint iterations:
+    the only per-iteration change is pod_valid, which the resident class
+    state deliberately excludes (the kernels fold validity per pod), and a
+    revocation masks whole equivalence classes — pod_group is part of the
+    spec key — so class-row consistency holds at every iteration."""
     from .assign import (
         schedule_batch_ordinals_routed,
         schedule_batch_routed,
@@ -72,11 +78,11 @@ def schedule_with_gangs(
         arr_i = dataclasses.replace(arr, pod_valid=pod_valid)
         if with_ordinals:
             choices, used, ords, sweeps = schedule_batch_ordinals_routed(
-                arr_i, cfg, donate=False, mesh=mesh
+                arr_i, cfg, donate=False, mesh=mesh, inc=inc
             )
         else:
             choices, used = schedule_batch_routed(
-                arr_i, cfg, donate=False, mesh=mesh
+                arr_i, cfg, donate=False, mesh=mesh, inc=inc
             )
         choices = np.asarray(choices)
         pod_group = np.asarray(arr.pod_group)

@@ -106,7 +106,18 @@ class PipelinedBatchLoop:
         self.commit = commit
         self.tracer = tracer
         self.metrics = metrics
-        self._inflight: Optional[Tuple[object, object, float]] = None
+        # incremental warm-cycle hoist (ops/incremental.py): equivalence-
+        # class deduped scores resident on device across cycles, dirty-node
+        # patched per warm delta.  Passed to the routed step as a separate,
+        # NEVER-donated argument, so the donating waves' fresh transfers can
+        # never alias the cache.  KTPU_INCREMENTAL=0 disables it per cycle.
+        from ..ops.incremental import HoistCache
+
+        self.hoist = HoistCache(mesh=mesh, tracer=tracer)
+        # (choices, meta, inc_attrs, t_dispatch, snap) of the dispatched wave
+        self._inflight: Optional[
+            Tuple[object, object, dict, float, Snapshot]
+        ] = None
         self._wave = 0
         # per-kind host seconds: [total, overlapped-with-an-in-flight-step]
         self.host_seconds: Dict[str, list] = {
@@ -184,6 +195,16 @@ class PipelinedBatchLoop:
         # handed to a donating kernel would poison later reusing cycles
         arr, meta = self.enc.encode(snap)
         cfg = infer_score_config(arr, self.base_config)
+        # resident class-hoist state from the HOST arrays (identity
+        # fingerprints + node_used row diff), before device placement —
+        # skipped when the wave routes the plain per-pod scan (which takes
+        # no inc), so those cycles never pay the class hoist
+        from ..ops.assign import inc_route_applies
+
+        inc = (
+            self.hoist.ensure(arr, meta, cfg)
+            if inc_route_applies(arr, cfg) else None
+        )
         arr, meta = self.enc.to_device(arr, meta, fresh=donating)
         if donating:
             self.last_donated_probe = (
@@ -191,7 +212,7 @@ class PipelinedBatchLoop:
             )
             self.stats["donated"] += 1
         choices = schedule_batch_routed(
-            arr, cfg, donate=donating, mesh=self.mesh
+            arr, cfg, donate=donating, mesh=self.mesh, inc=inc
         )[0]
         t1 = time.perf_counter()
         credit = self._overlap_credit(probe, running0)
@@ -200,7 +221,9 @@ class PipelinedBatchLoop:
             "encode_overlap", t0, t1, component="pipeline",
             wave=self._wave, overlapped=credit > 0, overlap_credit=credit,
         )
-        return choices, meta
+        from ..scheduler.tracing import incremental_attrs
+
+        return choices, meta, incremental_attrs(self.hoist)
 
     def _recover_wave(self, snap: Snapshot, err: BaseException, t0: float):
         """Serial-oracle replay of a wave that died mid-flight (device-step
@@ -236,7 +259,7 @@ class PipelinedBatchLoop:
     def _collect(self) -> Optional[Verdicts]:
         if self._inflight is None:
             return None
-        choices, meta, t_dispatch, snap = self._inflight
+        choices, meta, inc_attrs, t_dispatch, snap = self._inflight
         self._inflight = None
         t0 = time.perf_counter()
         try:
@@ -257,7 +280,7 @@ class PipelinedBatchLoop:
 
         self._span(
             "device.step", t_dispatch, t1, component="pipeline",
-            wave=self._wave - 1, **mesh_attrs(self.mesh),
+            wave=self._wave - 1, **mesh_attrs(self.mesh), **inc_attrs,
         )
         # decode happens after the blocking fetch, so it overlaps only the
         # NEXT step — dispatched before this collect when pipelining

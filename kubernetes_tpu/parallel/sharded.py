@@ -126,18 +126,19 @@ def _field_shardings_cached(mesh: Mesh, image_sharded: bool):
 
 # jit cache for the sharded routed kernels, keyed on everything trace-
 # relevant.  cfg is a frozen (hashable) dataclass; Mesh is hashable; the
-# shapes key themselves through jit as usual.
+# shapes key themselves through jit as usual.  inc_sig = None (dense) or
+# the tuple of which optional IncState fields are populated — it fixes the
+# second argument's pytree/spec structure (ops/incremental.py).
 @lru_cache(maxsize=None)
 def _sharded_routed_fn(
     mesh: Mesh, image_sharded: bool, kind: str, cfg: ScoreConfig,
-    with_ordinals: bool, donate: bool,
+    with_ordinals: bool, donate: bool, inc_sig=None,
 ):
     import jax.numpy as jnp
 
     from ..ops import assign as A
 
     n_shards = int(mesh.shape[NODE_AXIS])
-    in_specs = (_node_sharding_specs(image_sharded),)
     if kind == "scan":
         def body(a):
             c, u = A.schedule_scan(
@@ -153,27 +154,49 @@ def _sharded_routed_fn(
             A.schedule_scan_chunked if kind == "chunked"
             else A.schedule_scan_rounds
         )
-
-        def body(a):
-            return kernel(
-                a, cfg=cfg, with_ordinals=with_ordinals, axis_name=NODE_AXIS,
-                axis_size=n_shards, image_sharded=image_sharded,
-            )
+        if inc_sig is not None:
+            def body(a, inc):
+                return kernel(
+                    a, cfg=cfg, with_ordinals=with_ordinals,
+                    axis_name=NODE_AXIS, axis_size=n_shards,
+                    image_sharded=image_sharded, inc=inc,
+                )
+        else:
+            def body(a):
+                return kernel(
+                    a, cfg=cfg, with_ordinals=with_ordinals,
+                    axis_name=NODE_AXIS, axis_size=n_shards,
+                    image_sharded=image_sharded,
+                )
 
         used_spec = P()  # chunked/rounds carry usage replicated
+    in_specs = (_node_sharding_specs(image_sharded),)
+    if kind != "scan" and inc_sig is not None:
+        from ..ops.incremental import IncState
+
+        ns = P(None, NODE_AXIS)
+        elig, traw, naraw, img = inc_sig
+        in_specs = in_specs + (IncState(
+            cls=P(), req_u=P(None, None), stat_u=ns, base_u=ns, fit_u=ns,
+            elig_u=ns if elig else None, traw_u=ns if traw else None,
+            naraw_u=ns if naraw else None, img_u=ns if img else None,
+        ),)
     out_specs = (P(), used_spec) + ((P(), P()) if with_ordinals else ())
     fn = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_rep=False,
     )
     if donate:
+        # only the per-wave ClusterArrays donates — the IncState argument is
+        # the RESIDENT cache and must never be consumed (PARITY.md
+        # donation-aliasing rule)
         return jax.jit(fn, donate_argnums=(0,))
     return jax.jit(fn)
 
 
 def sharded_schedule_batch_routed(
     arr: ClusterArrays, cfg: ScoreConfig, mesh: Mesh, donate: bool = False,
-    with_ordinals: bool = False,
+    with_ordinals: bool = False, inc=None,
 ):
     """The PRODUCTION routed step — chunked / rounds / per-pod scan, the same
     trace-time routing as ops.assign.schedule_batch_routed — node-axis
@@ -197,10 +220,21 @@ def sharded_schedule_batch_routed(
         kind = "rounds"
     else:
         kind = "scan"
+    # the incremental class state applies only to the chunked/rounds routes
+    # and must match the PADDED node axis (the HoistCache pads with the same
+    # parallel/mesh.py rule set)
+    inc = A.inc_applicable(arr, cfg, inc) if kind != "scan" else None
+    inc_sig = None
+    if inc is not None:
+        inc_sig = (
+            inc.elig_u is not None, inc.traw_u is not None,
+            inc.naraw_u is not None, inc.img_u is not None,
+        )
     fn = _sharded_routed_fn(
         mesh, arr.image_score.shape[1] == arr.N, kind, cfg,
-        with_ordinals, donate,
+        with_ordinals, donate, inc_sig,
     )
+    args = (arr,) if inc is None else (arr, inc)
     if donate:
         import warnings
 
@@ -208,5 +242,5 @@ def sharded_schedule_batch_routed(
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            return fn(arr)
-    return fn(arr)
+            return fn(*args)
+    return fn(*args)

@@ -156,6 +156,10 @@ class Scheduler:
         # state persists across cycles, absorbing bind/delete deltas
         # (api/delta.py — the watch-cache analog)
         self._delta_enc = None
+        # resident incremental device hoist for the non-gang batch kernel:
+        # equivalence-class scores cached on device across cycles, dirty-node
+        # patched per warm delta (ops/incremental.py; KTPU_INCREMENTAL=0 off)
+        self._hoist_cache = None
         # pipelined batch commits: the bind/events/queue fan-out of cycle
         # i−1 is deferred into cycle i's device-step window (dispatch is
         # async) whenever that is provably serial-equivalent — capacity is
@@ -848,12 +852,31 @@ class Scheduler:
                 chaos.poke("host.stall", tracer=self.tracer,
                            metrics=self.metrics)
             cfg = infer_score_config(arr, base_cfg)
+            # resident incremental class-hoist state (ops/incremental.py;
+            # never donated).  Serves the gang fixpoint too — revocations
+            # only mask pod_valid, which the resident state excludes.  The
+            # native engine stays dense; recovery replays stay dense by
+            # design (cache-independent serial oracle).
+            inc = None
+            if self.config.mode != "native":
+                from ..ops.assign import inc_route_applies
+
+                if inc_route_applies(arr, cfg):
+                    if self._hoist_cache is None:
+                        from ..ops.incremental import HoistCache
+
+                        self._hoist_cache = HoistCache(
+                            mesh=self.mesh, tracer=self.tracer
+                        )
+                    inc = self._hoist_cache.ensure(arr, meta, cfg)
             ords = sweeps = None
-            from .tracing import mesh_attrs
+            from .tracing import incremental_attrs, mesh_attrs
 
             with self.tracer.span(
                 "batch.kernel", profile=profile_name, mode=self.config.mode,
                 **mesh_attrs(self.mesh),
+                **(incremental_attrs(self._hoist_cache) if inc is not None
+                   else {}),
             ):
                 t_k0 = time.perf_counter()
                 if self.config.mode == "native":
@@ -881,7 +904,8 @@ class Scheduler:
                             if chaos.enabled() else None
                         )
                         choices, _, ords, sweeps = schedule_with_gangs(
-                            arr, cfg, with_ordinals=True, mesh=self.mesh
+                            arr, cfg, with_ordinals=True, mesh=self.mesh,
+                            inc=inc,
                         )
                         choices = np.asarray(choices)
                         if fault is not None and fault.action == "nan":
@@ -914,7 +938,7 @@ class Scheduler:
                         choices, _, ords, sweeps = (
                             schedule_batch_ordinals_routed(
                                 arr, cfg, donate=donation_supported(),
-                                mesh=self.mesh,
+                                mesh=self.mesh, inc=inc,
                             )
                         )
                         # step i runs on device: the deferred bind/events

@@ -409,6 +409,26 @@ def device_trace(log_dir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+def incremental_attrs(hoist_cache) -> dict:
+    """Span attributes attributing a kernel step's incremental warm-cycle
+    state (ops/incremental.py — HoistCache): whether the resident class
+    hoist was hit/patched/rebuilt, the wave's unique-class count, and the
+    dirty-node fraction the patch covered — stamped onto the pipeline's
+    `device.step` and the scheduler's `batch.kernel` spans so BENCH_r06 can
+    attribute the warm-cycle win.  None / unarmed cache -> {}."""
+    if hoist_cache is None:
+        return {}
+    last = getattr(hoist_cache, "last", None)
+    if not last or last.get("action") in (None, "none"):
+        return {}
+    return {
+        "hoist_cache": last["action"],
+        "unique_classes": last["unique_classes"],
+        "dirty_node_fraction": last["dirty_node_fraction"],
+        "hoist_cols": last["patched_cols"],
+    }
+
+
 def mesh_attrs(mesh) -> dict:
     """Span attributes identifying the device mesh a kernel step ran on, so
     traces attribute time per route+mesh (stamped onto the pipeline's

@@ -1,0 +1,319 @@
+"""Incremental warm-cycle kernels (ISSUE 5): equivalence-class deduped
+hoists + dirty-node rescoring (ops/incremental.py) must be BIT-IDENTICAL to
+the dense kernels and the serial oracle across {chunked, rounds} x
+{donate on/off} x {mesh8, single-device}, survive a seeded chaos storm with
+the cache armed, fall back to the dense route on the degenerate
+all-pods-unique wave (U == P — dedup is a provable no-op), and actually
+patch O(changes) columns on warm cycles (the tier-1 trace-span regression
+guarding against a silent full re-hoist)."""
+
+import copy
+import dataclasses
+import os
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.api.delta import DeltaEncoder
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.ops.assign import (
+    TRACE_COUNTS,
+    schedule_batch_ordinals_routed,
+    schedule_batch_routed,
+)
+from kubernetes_tpu.ops.incremental import HoistCache, incremental_enabled
+from kubernetes_tpu.oracle import oracle_schedule
+from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+from kubernetes_tpu.scheduler.tracing import TraceCollector, Tracer
+
+from helpers import mk_node, mk_pod, random_cluster
+
+
+@pytest.fixture(autouse=True)
+def _force_production_route(monkeypatch):
+    """Route the chunked/rounds kernels on the CPU sim (read per call) so
+    every case exercises the SAME production route a TPU backend would."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+
+
+def _snap_for(kernel: str, seed: int = 42):
+    rng = random.Random(seed)
+    if kernel == "chunked":
+        # fit-only (infer_score_config strips the rest), P % 128 == 0
+        return random_cluster(rng, n_nodes=24, n_pods=120)
+    return random_cluster(
+        rng, n_nodes=24, n_pods=48,
+        with_taints=True, with_selectors=True, with_pairwise=True,
+    )
+
+
+def _decode(choices, meta):
+    ch = np.asarray(choices)
+    return [
+        (meta.pod_names[k],
+         meta.node_names[int(ch[k])] if int(ch[k]) >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+
+
+def _bind_some(snap, verdicts, k=4):
+    """k placed pods become bound (spec objects shared — template stamping),
+    the rest re-pend under fresh names: a small warm delta."""
+    by_name = {p.name: p for p in snap.pending_pods}
+    bound = []
+    for nm, node in verdicts:
+        if node is not None and len(bound) < k:
+            bound.append(dataclasses.replace(by_name[nm], node_name=node))
+    pend = [
+        dataclasses.replace(p, name=f"w-{p.name}", uid="")
+        for p in snap.pending_pods
+    ]
+    return Snapshot(nodes=snap.nodes, pending_pods=pend, bound_pods=bound)
+
+
+@pytest.mark.parametrize("kernel", ["chunked", "rounds"])
+@pytest.mark.parametrize("donate", [False, True])
+def test_incremental_parity_single_device(kernel, donate, monkeypatch):
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap = _snap_for(kernel)
+    enc = DeltaEncoder()
+    cache = HoistCache()
+    route = f"{kernel}_inc"
+    for cycle in range(3):
+        arr, meta = enc.encode(snap)
+        cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+        inc = cache.ensure(arr, meta, cfg)
+        assert inc is not None and inc.req_u.shape[0] < arr.P
+        before = dict(TRACE_COUNTS)
+        want_c, want_u = schedule_batch_routed(arr, cfg, donate=False)
+        got_c, got_u = schedule_batch_routed(
+            arr, cfg, donate=donate, inc=inc
+        )
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+        np.testing.assert_array_equal(np.asarray(got_u), np.asarray(want_u))
+        assert TRACE_COUNTS[route] >= before[route]  # warm jit cache ok
+        got = _decode(got_c, meta)
+        if cycle == 0:
+            # decisions match the serial oracle, not just the dense kernel
+            assert got == oracle_schedule(snap, cfg)
+        # donation must never consume the resident cache (the aliasing rule)
+        for buf in (inc.stat_u, inc.base_u, inc.fit_u, inc.cls, inc.req_u):
+            assert not buf.is_deleted()
+        snap = _bind_some(snap, got)
+    # warm cycles really rode the resident cache (patched, not rebuilt)
+    assert cache.stats["patched"] >= 1, cache.stats
+    assert enc.stats["delta"] >= 1
+
+
+@pytest.mark.parametrize("kernel", ["chunked", "rounds"])
+@pytest.mark.parametrize("donate", [False, True])
+def test_incremental_parity_mesh8(mesh8, kernel, donate, monkeypatch):
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap = _snap_for(kernel, seed=7)
+    enc = DeltaEncoder()
+    cache = HoistCache(mesh=mesh8)
+    for cycle in range(2):
+        arr, meta = enc.encode(snap)
+        cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+        inc = cache.ensure(arr, meta, cfg)
+        assert inc is not None
+        want_c, want_u = schedule_batch_routed(arr, cfg, donate=False)
+        got_c, got_u = schedule_batch_routed(
+            arr, cfg, donate=donate, mesh=mesh8, inc=inc
+        )
+        n = arr.N
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+        gu = np.asarray(got_u)
+        np.testing.assert_array_equal(gu[:n], np.asarray(want_u))
+        assert not gu[n:].any()
+        snap = _bind_some(snap, _decode(got_c, meta))
+    assert cache.stats["patched"] >= 1, cache.stats
+
+
+def test_incremental_ordinals_parity():
+    snap = _snap_for("rounds", seed=3)
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = HoistCache().ensure(arr, meta, cfg)
+    want = schedule_batch_ordinals_routed(arr, cfg, donate=False)
+    got = schedule_batch_ordinals_routed(arr, cfg, donate=False, inc=inc)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[2]), np.asarray(want[2]))
+    assert int(got[3]) == int(want[3])
+
+
+def test_degenerate_all_unique_routes_dense():
+    """U == P (every pod a distinct spec, no padding): the dedup is a
+    provable no-op — ensure() refuses, the routed call takes the DENSE
+    kernel, and decisions are unchanged."""
+    nodes = [mk_node(f"n{i}", cpu=16_000, pods=256) for i in range(16)]
+    pods = [mk_pod(f"p{i}", cpu=100 + i) for i in range(128)]  # P == p == 128
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    assert arr.P == 128 and meta.n_classes == 128  # no padding class
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    cache = HoistCache()
+    inc = cache.ensure(arr, meta, cfg)
+    assert inc is None and cache.last["action"] == "skipped_degenerate"
+    before = dict(TRACE_COUNTS)
+    got_c, _ = schedule_batch_routed(arr, cfg, donate=False, inc=inc)
+    assert TRACE_COUNTS["chunked_inc"] == before["chunked_inc"]
+    assert _decode(got_c, meta) == oracle_schedule(snap, cfg)
+
+
+def test_chunked_many_classes_branch():
+    """U1 > C exercises the gather-then-topk trace branch of the chunked
+    kernel (U1 <= C tops the class matrix instead)."""
+    nodes = [mk_node(f"n{i}", cpu=64_000, pods=512) for i in range(16)]
+    # 200 unique specs + 56 repeats of the first: U1 = 201 > C = 128 < P
+    pods = [mk_pod(f"p{i}", cpu=100 + (i % 200)) for i in range(256)]
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = HoistCache().ensure(arr, meta, cfg)
+    assert inc is not None and inc.req_u.shape[0] > 128
+    want_c, _ = schedule_batch_routed(arr, cfg, donate=False)
+    got_c, _ = schedule_batch_routed(arr, cfg, donate=False, inc=inc)
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+
+def test_kill_switch_disables_incremental(monkeypatch):
+    monkeypatch.setenv("KTPU_INCREMENTAL", "0")
+    assert not incremental_enabled()
+    snap = _snap_for("chunked")
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    cache = HoistCache()
+    assert cache.ensure(arr, meta, cfg) is None
+    assert cache.stats["disabled"] == 1
+
+
+# --- the tier-1 warm-cycle regression: a 1-node delta must patch ~1
+# column, NOT silently fall back to a full re-hoist ---
+def test_warm_cycle_patches_few_columns_trace_guard():
+    n_nodes = 32
+    nodes = [mk_node(f"n{i}", cpu=32_000, pods=256) for i in range(n_nodes)]
+    # 4 templates stamped 64x: U ≪ P, the steady production shape
+    tmpl = [mk_pod(f"t{j}", cpu=200 + 100 * j) for j in range(4)]
+    pods = [
+        dataclasses.replace(tmpl[j % 4], name=f"c1-p{j}", uid="")
+        for j in range(256)
+    ]
+    col = TraceCollector()
+    tracer = Tracer(col, component="pipeline")
+    loop = PipelinedBatchLoop(donate=False, depth=1, tracer=tracer)
+    v1 = loop.submit(Snapshot(nodes=nodes, pending_pods=pods))
+    assert v1 is None
+    # cycle 2: the SAME wave template, one pod bound to one node — a
+    # 1-node warm delta
+    bound = [dataclasses.replace(tmpl[0], name="b0", uid="", node_name="n0")]
+    pods2 = [
+        dataclasses.replace(tmpl[j % 4], name=f"c2-p{j}", uid="")
+        for j in range(256)
+    ]
+    loop.submit(Snapshot(nodes=nodes, pending_pods=pods2, bound_pods=bound))
+    v2 = loop.drain()
+    spans = col.spans("hoist.update")
+    assert len(spans) == 2, [s.attributes for s in spans]
+    first, second = (s.attributes for s in spans)
+    assert first["action"] in ("static_rebuild", "full")
+    # the regression guard: the warm cycle patched ≪ N columns
+    assert second["action"] == "patch", second
+    assert second["n_cols"] == 1 and second["n_cols"] < n_nodes // 4
+    assert second["unique_classes"] <= 5
+    assert 0 < second["dirty_node_fraction"] <= 1 / 16
+    # and the patched decisions equal a fresh dense encode of cycle 2
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(
+        Snapshot(nodes=nodes, pending_pods=pods2, bound_pods=bound)
+    )
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want_c, _ = schedule_batch_routed(arr, cfg, donate=False)
+    want = {
+        meta.pod_names[k]: (
+            meta.node_names[int(np.asarray(want_c)[k])]
+            if int(np.asarray(want_c)[k]) >= 0 else None
+        )
+        for k in range(meta.n_pods)
+    }
+    assert v2 == want
+
+
+# --- chaos storm with the cache armed: placements must stay bit-identical
+# to the fault-free serial oracle (the PR-3 landability bar) ---
+def _churn(pipeline: bool, plan=None, incremental: bool = True):
+    os.environ["KTPU_PIPELINE"] = "1" if pipeline else "0"
+    os.environ["KTPU_INCREMENTAL"] = "" if incremental else "0"
+    try:
+        ctx = (
+            chaos.chaos_plan(plan) if plan is not None
+            else __import__("contextlib").nullcontext()
+        )
+        with ctx:
+            store = ClusterStore()
+            for i in range(5):
+                store.add_node(mk_node(f"n{i}", cpu=3000, pods=16))
+            sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+            for i in range(20):
+                store.add_pod(mk_pod(f"p{i}", cpu=250))
+            sched.run_until_idle()
+            rng = random.Random(5)
+            for r in range(2):
+                bound = sorted(
+                    (p for p in store.pods.values() if p.node_name),
+                    key=lambda p: p.uid,
+                )
+                for v in rng.sample(bound, 6):
+                    store.delete_pod(v.uid)
+                    q = copy.copy(v)
+                    q.name = f"{v.name}-r{r}"
+                    q.uid = ""
+                    q.node_name = ""
+                    q.__post_init__()
+                    store.add_pod(q)
+                sched.run_until_idle()
+            placements = {p.name: p.node_name for p in store.pods.values()}
+            return placements, sched
+    finally:
+        os.environ.pop("KTPU_PIPELINE", None)
+        os.environ.pop("KTPU_INCREMENTAL", None)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def test_chaos_storm_with_cache_armed():
+    oracle, _ = _churn(pipeline=False, incremental=False)  # dense serial
+    plan = chaos.FaultPlan.from_seed(
+        0, sites=("scheduler.step", "host.stall"), n_faults=4
+    )
+    got, sched = _churn(pipeline=True, plan=plan, incremental=True)
+    assert got == oracle
+    # the storm really ran with the incremental cache engaged
+    assert sched._hoist_cache is not None
+    assert (
+        sched._hoist_cache.stats["hits"] + sched._hoist_cache.stats["full"]
+        + sched._hoist_cache.stats["static_rebuilds"] > 0
+    ), sched._hoist_cache.stats
+
+
+def test_scheduler_incremental_matches_dense_churn():
+    """The scheduler batch path with the cache armed is placement-identical
+    to the same churn with KTPU_INCREMENTAL=0 (dense kernels)."""
+    dense, _ = _churn(pipeline=True, incremental=False)
+    inc, sched = _churn(pipeline=True, incremental=True)
+    assert inc == dense

@@ -100,9 +100,12 @@ def test_round4_forced_chunked_soak_with_delta_verify(seed, monkeypatch):
     # through the production route — the trace counters prove a fresh
     # chunked/rounds compilation happened in this process, which the env
     # predicate alone cannot (a warm jit cache would make it vacuous)
-    assert (
-        TRACE_COUNTS["chunked"] > traced_before["chunked"]
-        or TRACE_COUNTS["rounds"] > traced_before["rounds"]
+    # (either the dense or the incremental variant of the production
+    # kernels satisfies the proof — the scheduler routes the _inc form
+    # when the equivalence-class cache applies, ops/incremental.py)
+    assert any(
+        TRACE_COUNTS[k] > traced_before[k]
+        for k in ("chunked", "rounds", "chunked_inc", "rounds_inc")
     ), (traced_before, TRACE_COUNTS)
     from kubernetes_tpu.ops.scores import infer_score_config, DEFAULT_SCORE_CONFIG
 

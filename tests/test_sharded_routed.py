@@ -222,7 +222,12 @@ def test_chaos_storm_with_mesh(monkeypatch):
     )
     assert got == oracle
     assert sched.mesh is not None and int(sched.mesh.size) == 8
-    assert TRACE_COUNTS["sharded_rounds"] > before["sharded_rounds"]
+    # dense or incremental variant both prove the sharded production route
+    # (the scheduler routes sharded_rounds_inc when the class cache applies)
+    assert (
+        TRACE_COUNTS["sharded_rounds"] > before["sharded_rounds"]
+        or TRACE_COUNTS["sharded_rounds_inc"] > before["sharded_rounds_inc"]
+    ), (before, TRACE_COUNTS)
 
 
 @pytest.mark.slow
