@@ -121,13 +121,28 @@ def main() -> None:
     from kubernetes_tpu.ops.aot import maybe_enable_compile_cache
     from kubernetes_tpu.ops.assign import (
         donation_supported,
-        reset_trace_counts,
         schedule_batch_routed,
     )
+    from kubernetes_tpu.scheduler.metrics import Metrics, reset_run_state
+    from kubernetes_tpu.scheduler.tracing import TraceCollector, Tracer
 
-    # per-run counters (ops/assign.py): route_trace_counts must describe
-    # THIS run even when bench runs back-to-back in one process
-    reset_trace_counts()
+    # the run-start reset hook (route counters + metrics + collector in one
+    # call): the artifact must describe THIS run even when bench runs
+    # back-to-back in one process
+    metrics = Metrics()
+    collector = TraceCollector()
+    reset_run_state(metrics=metrics, collector=collector)
+    if os.environ.get("KTPU_METRICS"):
+        # serve this run's registry for the duration (scheduler/apiserver.py)
+        from kubernetes_tpu.scheduler.apiserver import MetricsServer
+
+        try:
+            _mport = int(os.environ["KTPU_METRICS"])
+        except ValueError:
+            _mport = 0
+        srv = MetricsServer(metrics.expose_text, port=_mport)
+        print(f"metrics: http://127.0.0.1:{srv.start()}/metrics",
+              file=sys.stderr)
 
     # persistent XLA compile cache (KTPU_COMPILE_CACHE_DIR): the first
     # process pays the cold compile; every later one loads the executable
@@ -242,8 +257,13 @@ def main() -> None:
     from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop
 
     pipeline = os.environ.get("KTPU_PIPELINE") != "0"
+    # traced + metered warm loop: the captured spans feed the cycle
+    # attribution report (scheduler/attribution.py) and the loop's SLI
+    # series gives the headline arrival -> bind p50/p99 — span cost is
+    # a handful per cycle, invisible next to the device step
     loop = PipelinedBatchLoop(
-        encoder=enc, donate=don, depth=1 if pipeline else 0, mesh=mesh
+        encoder=enc, donate=don, depth=1 if pipeline else 0, mesh=mesh,
+        tracer=Tracer(collector, component="pipeline"), metrics=metrics,
     )
 
     def mk_wave(w):
@@ -291,6 +311,21 @@ def main() -> None:
             fetched[w - 1] = v
     fetched[last_w] = loop.drain()
     assert enc.stats["delta"] >= 3, f"delta path did not engage: {enc.stats}"
+
+    # cycle attribution over the warm loop's spans: where the cycle wall
+    # went, phase fractions summing to 1.0 (ROADMAP standing rule 1 —
+    # attribute before optimizing; the report names the device kernel /
+    # round loop as the dominant warm-cycle cost)
+    from kubernetes_tpu.scheduler.attribution import (
+        attribute_spans,
+        render_attribution,
+    )
+
+    attribution = attribute_spans(collector)
+    print(render_attribution(attribution), file=sys.stderr)
+    from kubernetes_tpu.bench.harness import sli_fields
+
+    sli = sli_fields(metrics)
 
     scheduled = int((choices[: meta.n_pods] >= 0).sum())
     # steady-state cycles: submit walls once the pipeline is full (each
@@ -364,6 +399,12 @@ def main() -> None:
                 # resident-cache hit/full counts.  KTPU_INCREMENTAL=0 runs
                 # the dense pre-PR-5 path for A/B comparison.
                 "incremental": os.environ.get("KTPU_INCREMENTAL", "") != "0",
+                # the headline SLI next to throughput: per-pod arrival ->
+                # bind over the warm waves (streaming histogram p50/p99)
+                **sli,
+                # per-phase cycle attribution (machine-readable; the table
+                # went to stderr above)
+                "attribution": attribution,
                 **loop.hoist.summary(),
             }
         )

@@ -64,6 +64,15 @@ class PerfData:
     # boundaries (bench/latency_calibration.py, round 5: max |measured -
     # estimated| wall fraction = 0.055 over 4 probes at config-3 scale)
     latency_estimate_error: Optional[str] = None
+    # the headline SLI: TRUE per-pod arrival -> bind latency
+    # (pod_scheduling_sli_duration_seconds — stamped at queue admission,
+    # observed at bind publication; deferred commits included)
+    sli_p50_ms: float = 0.0
+    sli_p99_ms: float = 0.0
+    sli_count: int = 0
+    # cycle attribution report (scheduler/attribution.py) when the round
+    # captured a span trace with --attribution
+    attribution: Optional[Dict] = None
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -128,6 +137,38 @@ def run_snapshot_workload(
     return _perfdata(name, snap, sched, len(snap.pending_pods), wall)
 
 
+# the registry KTPU_METRICS scrapes: whichever run is currently measuring
+# publishes its Metrics here (one harness process measures one run at a
+# time; the /metrics route always reflects the live run)
+_CURRENT_METRICS: Dict[str, Optional[object]] = {"m": None}
+
+
+def sli_fields(metrics) -> Dict:
+    """The headline-SLI artifact triple — sli_p50_ms/sli_p99_ms/sli_count —
+    read atomically from the registry (one definition shared by every
+    emitter: both streaming branches, PerfData, bench.py)."""
+    h = metrics.hists.get("pod_scheduling_sli_duration_seconds")
+    p50, p99, count = h.stats() if h is not None else (0.0, 0.0, 0)
+    return {
+        "sli_p50_ms": round(p50 * 1e3, 2),
+        "sli_p99_ms": round(p99 * 1e3, 2),
+        "sli_count": count,
+    }
+
+
+def _export_trace(collector, path: str) -> None:
+    """Write the Perfetto export and print the one-line trace summary —
+    flagging an INCOMPLETE trace (ring wrapped, spans dropped) so
+    downstream attribution is never silently under-counted."""
+    out_path = collector.export_chrome_trace(path)
+    dropped = (
+        f", {collector.spans_dropped} dropped — INCOMPLETE"
+        if collector.spans_dropped else ""
+    )
+    print(f"trace: {out_path} ({len(collector.spans())} spans{dropped}; "
+          "open in Perfetto)", file=sys.stderr)
+
+
 def _setup_cluster(snap: Snapshot, mode: str, collector=None):
     """Store + scheduler seeded from a snapshot (pod groups, pre-bound pods,
     AND storage/DRA objects) — shared by the measure and churn ops.  The
@@ -153,6 +194,7 @@ def _setup_cluster(snap: Snapshot, mode: str, collector=None):
         collector = TraceCollector(enabled=False)
     sched = Scheduler(store, SchedulerConfiguration(mode=mode),
                       collector=collector)
+    _CURRENT_METRICS["m"] = sched.metrics  # the KTPU_METRICS scrape target
     for g, pg in snap.pod_groups.items():
         sched.cache.pod_groups[g] = pg
     for p in snap.pending_pods:
@@ -167,16 +209,17 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
     failed = len(sched.events.by_reason("FailedScheduling"))
     source = "attempt"
     hist = sched.metrics.hists.get("scheduling_attempt_duration_seconds")
-    if not (hist and hist.samples):
+    if not (hist and hist.count):
         source = "per-pod-estimate"
         hist = sched.metrics.hists.get(
             "scheduling_attempt_duration_estimate_seconds"
         )
-    if not (hist and hist.samples):
+    if not (hist and hist.count):
         source = "batch"
         hist = sched.metrics.hists.get("batch_scheduling_duration_seconds")
     q = (lambda p: hist.quantile(p) * 1e3) if hist else (lambda p: 0.0)
     batch_hist = sched.metrics.hists.get("batch_scheduling_duration_seconds")
+    sli = sli_fields(sched.metrics)
     return PerfData(
         name=name,
         n_nodes=len(snap.nodes),
@@ -188,7 +231,7 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
         p50_ms=round(q(0.50), 2),
         p90_ms=round(q(0.90), 2),
         p99_ms=round(q(0.99), 2),
-        batches=len(batch_hist.samples) if batch_hist else 0,
+        batches=batch_hist.count if batch_hist else 0,
         amortized_ms_per_pod=round(wall * 1e3 / scheduled, 3) if scheduled else 0.0,
         latency_source=source,
         latency_estimate_error=(
@@ -196,6 +239,7 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
             " per backend/shape: bench/latency_calibration.py)"
             if source == "per-pod-estimate" else None
         ),
+        **sli,
     )
 
 
@@ -216,20 +260,33 @@ def run_streaming_workload(
 
     pipeline=False (the --no-pipeline escape hatch) runs ONLY the serial
     loop, so pre-pipeline numbers remain reproducible bit-for-bit."""
-    from ..ops.assign import TRACE_COUNTS, reset_trace_counts
+    from ..ops.assign import TRACE_COUNTS
     from ..parallel.mesh import mesh_from_env
     from ..parallel.pipeline import PipelinedBatchLoop, run_serial
+    from ..scheduler.metrics import Metrics, reset_run_state
     from ..scheduler.tracing import Tracer
 
-    # per-run counters: back-to-back harness invocations in one process
-    # previously reported cumulative route_trace_counts
-    reset_trace_counts()
+    # THE run-start reset hook: route counters + metrics + collector all
+    # clear together, so back-to-back invocations in one process never
+    # report each other's counters, SLI samples or spans
+    metrics = Metrics()
+    reset_run_state(metrics=metrics, collector=collector)
+    _CURRENT_METRICS["m"] = metrics  # the KTPU_METRICS scrape target
     mesh = mesh_from_env()  # KTPU_MESH: sharded routed step under the loop
     if warmup:  # hit the XLA cache so the timed runs measure steady state
         for _ in PipelinedBatchLoop(donate=donate, mesh=mesh).run(waves[:1]):
             pass
+    tracer = Tracer(collector, component="pipeline") if collector else None
     t0 = time.perf_counter()
-    serial = list(run_serial(waves, donate=donate, mesh=mesh))
+    # --no-pipeline runs have no later pipelined pass, so the serial loop
+    # itself is the traced+metered run (attribution + SLI still emit);
+    # when pipelining, the serial pass stays untraced/unmetered — its
+    # spans and SLI samples would pollute the pipelined run's report
+    serial = list(run_serial(
+        waves, donate=donate, mesh=mesh,
+        tracer=None if pipeline else tracer,
+        metrics=None if pipeline else metrics,
+    ))
     t_serial = time.perf_counter() - t0
     out = {
         "name": name,
@@ -245,10 +302,15 @@ def run_streaming_workload(
         out.update(
             pipelined_s=None, overlap_gain=None, overlap_fraction=0.0,
             pods_per_sec=round(pods / t_serial, 1) if t_serial > 0 else 0.0,
+            **sli_fields(metrics),
         )
+        if collector is not None:
+            from ..scheduler.attribution import attribute_spans
+
+            out["attribution"] = attribute_spans(collector)
         return out
-    tracer = Tracer(collector, component="pipeline") if collector else None
-    runner = PipelinedBatchLoop(donate=donate, tracer=tracer, mesh=mesh)
+    runner = PipelinedBatchLoop(donate=donate, tracer=tracer, mesh=mesh,
+                                metrics=metrics)
     t0 = time.perf_counter()
     pipelined = list(runner.run(waves))
     t_pipe = time.perf_counter() - t0
@@ -260,9 +322,17 @@ def run_streaming_workload(
         donated_waves=int(runner.stats["donated"]),
         pods_per_sec=round(pods / t_pipe, 1) if t_pipe > 0 else 0.0,
         route_trace_counts=dict(TRACE_COUNTS),
+        # the headline SLI next to throughput: per-pod arrival -> bind
+        **sli_fields(metrics),
         # incremental warm-cycle attribution (ops/incremental.py)
         **runner.hoist.summary(),
     )
+    if collector is not None:
+        # cycle attribution from the captured spans, embedded next to
+        # route_trace_counts (scheduler/attribution.py)
+        from ..scheduler.attribution import attribute_spans
+
+        out["attribution"] = attribute_spans(collector)
     return out
 
 
@@ -326,10 +396,14 @@ def run_churn_workload(
 
 
 def run_yaml(text: str, mode: str = "tpu", trace_base: Optional[str] = None,
-             device_trace_dir: Optional[str] = None) -> List[PerfData]:
+             device_trace_dir: Optional[str] = None,
+             attribution: bool = False) -> List[PerfData]:
     """trace_base != None captures one span trace per measured round and
     writes Perfetto-loadable JSON next to the perfdata artifact
-    (<trace_base>.<round name>.trace.json)."""
+    (<trace_base>.<round name>.trace.json).  attribution=True additionally
+    runs the cycle attribution engine over each round's spans and embeds
+    the report in the round's PerfData (a collector is captured per round
+    even without --trace)."""
     import yaml
 
     results = []
@@ -345,7 +419,9 @@ def run_yaml(text: str, mode: str = "tpu", trace_base: Optional[str] = None,
             elif kind == "measure":
                 assert snap is not None, "createCluster must precede measure"
                 name = doc.get("name", "unnamed")
-                collector = TraceCollector() if trace_base else None
+                collector = (
+                    TraceCollector() if (trace_base or attribution) else None
+                )
                 results.append(
                     run_snapshot_workload(
                         name, snap, mode, warmup=op.get("warmup", True),
@@ -355,13 +431,18 @@ def run_yaml(text: str, mode: str = "tpu", trace_base: Optional[str] = None,
                         ),
                     )
                 )
-                if collector is not None:
-                    path = collector.export_chrome_trace(
-                        f"{trace_base}.{name}.trace.json"
+                if collector is not None and attribution:
+                    from ..scheduler.attribution import (
+                        attribute_spans,
+                        render_attribution,
                     )
-                    print(f"trace: {path} "
-                          f"({len(collector.spans())} spans; open in Perfetto)",
-                          file=sys.stderr)
+
+                    report = attribute_spans(collector)
+                    results[-1].attribution = report
+                    print(render_attribution(report), file=sys.stderr)
+                if collector is not None and trace_base:
+                    _export_trace(collector,
+                                  f"{trace_base}.{name}.trace.json")
             elif kind == "churn":
                 assert snap is not None, "createCluster must precede churn"
                 results.append(
@@ -469,6 +550,11 @@ def main(argv=None) -> None:
     ap.add_argument("--trace", action="store_true",
                     help="capture a span trace per bench round and write "
                          "Perfetto JSON next to the --out artifact")
+    ap.add_argument("--attribution", action="store_true",
+                    help="run the cycle attribution engine over each "
+                         "round's span trace (scheduler/attribution.py) "
+                         "and embed the per-phase breakdown in the "
+                         "artifact next to route_trace_counts")
     ap.add_argument("--trace-device", metavar="DIR",
                     help="with --trace: also capture a jax.profiler device "
                          "trace per round under DIR (TensorBoard format)")
@@ -482,11 +568,30 @@ def main(argv=None) -> None:
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
                  "with the host-span trace)")
-    # counters are per-run: back-to-back harness invocations in one process
-    # must not report each other's kernel routes
-    from ..ops.assign import reset_trace_counts
+    # run-start reset (scheduler/metrics.py — reset_run_state): route
+    # counters are per-run; back-to-back harness invocations in one
+    # process must not report each other's kernel routes, metrics or spans
+    from ..scheduler.metrics import reset_run_state
 
-    reset_trace_counts()
+    reset_run_state()
+    # KTPU_METRICS=<port>: serve the run's metrics registries in Prometheus
+    # text format for the duration of the run (scheduler/apiserver.py —
+    # MetricsServer; port 0 picks an ephemeral one, printed to stderr)
+    metrics_srv = None
+    if os.environ.get("KTPU_METRICS"):
+        from ..scheduler.apiserver import MetricsServer
+
+        try:
+            port = int(os.environ["KTPU_METRICS"])
+        except ValueError:
+            port = 0
+        metrics_srv = MetricsServer(
+            lambda: (_CURRENT_METRICS["m"].expose_text()
+                     if _CURRENT_METRICS["m"] is not None else "\n"),
+            port=port,
+        )
+        print(f"metrics: http://127.0.0.1:{metrics_srv.start()}/metrics",
+              file=sys.stderr)
     if args.compile_cache:
         # publish to the env too: Scheduler.__init__ re-resolves from
         # KTPU_COMPILE_CACHE_DIR, and a conflicting stale env value would
@@ -517,10 +622,21 @@ def main(argv=None) -> None:
         waves = [
             workloads.heterogeneous(2000, 5000, seed=s) for s in range(args.stream)
         ]
+        collector = (
+            TraceCollector() if (args.trace or args.attribution) else None
+        )
         out = run_streaming_workload(
             f"stream-{args.stream}x5000", waves,
             pipeline=not args.no_pipeline,
+            collector=collector,
         )
+        if args.attribution and "attribution" in out:
+            from ..scheduler.attribution import render_attribution
+
+            print(render_attribution(out["attribution"]), file=sys.stderr)
+        if args.trace and collector is not None:
+            base = args.out.rsplit(".json", 1)[0] if args.out else "BENCH"
+            _export_trace(collector, f"{base}.stream.trace.json")
         if inj is not None:
             out["chaos"] = _chaos_report()
         print(json.dumps(out))
@@ -534,7 +650,8 @@ def main(argv=None) -> None:
     if args.trace:
         trace_base = (args.out.rsplit(".json", 1)[0] if args.out else "BENCH")
     results = run_yaml(text, args.mode, trace_base=trace_base,
-                       device_trace_dir=args.trace_device)
+                       device_trace_dir=args.trace_device,
+                       attribution=args.attribution)
     data = [r.to_json() for r in results]
     for r in data:
         print(json.dumps(r), file=sys.stderr)

@@ -1,0 +1,205 @@
+"""Bench regression gate over the BENCH_* trajectory.
+
+Loads the repo's BENCH_r*.json artifacts (both shapes: the driver wrapper
+{"n":…, "parsed": {…}} and the bare bench.py JSON line), normalizes
+per-box — runs are only comparable WITHIN one platform (a real TPU v5 run
+and the cpu-sim fallback differ by 20-40×, so cross-box deltas are noise,
+not regressions) — and exits nonzero when the newest run regressed more
+than --threshold against the BEST prior same-box run.
+
+Exit codes:
+  0  pass (improved, within threshold, or no comparable prior run)
+  1  regression beyond threshold
+  2  the current run is unusable (missing metric/file, no records)
+
+Usage:
+  python -m kubernetes_tpu.bench.regression [--dir .]
+      [--glob 'BENCH_r[0-9]*.json'] [--metric step_s] [--higher-is-better]
+      [--threshold 0.1] [--current FILE]
+
+Default metric is step_s (lower is better — the warm device step the
+BENCH_r01–r06 trajectory tracks); --metric value --higher-is-better gates
+on throughput instead.  Prior runs missing the metric or on another box
+are skipped with a note (the r01/r02 real-TPU artifacts predate step_s),
+never failed on — only the CURRENT run's record is load-bearing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _natural_key(name: str):
+    """Digit-aware sort key: BENCH_r99 sorts before BENCH_r100 (plain
+    lexicographic order would misplace three-digit rounds, making the gate
+    pick the wrong 'newest' run)."""
+    return [int(tok) if tok.isdigit() else tok
+            for tok in re.split(r"(\d+)", name)]
+
+
+def load_record(path: str) -> Tuple[Optional[Dict], Optional[str]]:
+    """One BENCH artifact -> (record, error).  Unwraps the driver's
+    {"parsed": …} envelope; a bare bench.py JSON line loads as-is."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return None, f"{path}: unreadable ({e})"
+    if not isinstance(doc, dict):
+        return None, f"{path}: not a JSON object"
+    rec = doc.get("parsed", doc)
+    if not isinstance(rec, dict):
+        return None, f"{path}: 'parsed' is not an object"
+    return rec, None
+
+
+def load_trajectory(dir_: str, pattern: str) -> List[Tuple[str, Dict]]:
+    """(name, record) pairs in trajectory order (digit-aware file-name
+    sort — BENCH_r01 < … < BENCH_r99 < BENCH_r100)."""
+    out: List[Tuple[str, Dict]] = []
+    for path in sorted(_glob.glob(os.path.join(dir_, pattern)),
+                       key=lambda p: _natural_key(os.path.basename(p))):
+        rec, err = load_record(path)
+        if rec is None:
+            print(f"regression: skipping {err}", file=sys.stderr)
+            continue
+        out.append((os.path.basename(path), rec))
+    return out
+
+
+def _metric(rec: Dict, name: str) -> Optional[float]:
+    v = rec.get(name)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def check_regression(
+    trajectory: List[Tuple[str, Dict]],
+    current: Tuple[str, Dict],
+    metric: str = "step_s",
+    higher_is_better: bool = False,
+    threshold: float = 0.1,
+) -> Dict:
+    """The gate: compare `current` against the best PRIOR same-platform run
+    on `metric`.  Returns a machine-readable verdict dict with `status` in
+    {"pass", "regression", "error"}."""
+    cur_name, cur = current
+    cur_v = _metric(cur, metric)
+    if cur_v is None:
+        return {
+            "status": "error",
+            "reason": f"current run {cur_name} has no numeric {metric!r}",
+            "current": cur_name,
+        }
+    platform = cur.get("platform", "unknown")
+    prior: List[Tuple[str, float]] = []
+    skipped: List[str] = []
+    for name, rec in trajectory:
+        if name == cur_name:
+            continue
+        if rec.get("platform", "unknown") != platform:
+            skipped.append(f"{name} (platform {rec.get('platform', 'unknown')!r})")
+            continue
+        v = _metric(rec, metric)
+        if v is None:
+            skipped.append(f"{name} (no {metric})")
+            continue
+        prior.append((name, v))
+    if not prior:
+        return {
+            "status": "pass",
+            "reason": f"no comparable prior {platform!r} run with {metric!r}",
+            "current": cur_name, "platform": platform,
+            "current_value": cur_v, "skipped": skipped,
+        }
+    best_name, best_v = (
+        max(prior, key=lambda t: t[1]) if higher_is_better
+        else min(prior, key=lambda t: t[1])
+    )
+    if higher_is_better:
+        # regression = current fell below best by more than threshold
+        ratio = (best_v - cur_v) / best_v if best_v > 0 else 0.0
+    else:
+        ratio = (cur_v - best_v) / best_v if best_v > 0 else 0.0
+    status = "regression" if ratio > threshold else "pass"
+    return {
+        "status": status,
+        "current": cur_name, "platform": platform,
+        "metric": metric, "higher_is_better": higher_is_better,
+        "current_value": cur_v,
+        "best_prior": best_name, "best_prior_value": best_v,
+        "regression_fraction": round(ratio, 4),
+        "threshold": threshold,
+        "skipped": skipped,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH trajectory regression gate (nonzero exit on "
+        "regression beyond --threshold vs the best prior same-box run)"
+    )
+    ap.add_argument("--dir", default=".", help="directory of BENCH artifacts")
+    ap.add_argument("--glob", default="BENCH_r[0-9]*.json",
+                    help="artifact pattern, trajectory-ordered by name")
+    ap.add_argument("--metric", default="step_s",
+                    help="record field to gate on (default: step_s)")
+    ap.add_argument("--higher-is-better", action="store_true",
+                    help="gate treats larger metric values as better "
+                         "(e.g. --metric value for pods/s)")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="allowed worsening fraction vs best prior "
+                         "same-box run (default 0.1 = 10%%)")
+    ap.add_argument("--current", metavar="FILE",
+                    help="candidate artifact (default: the trajectory's "
+                         "newest entry)")
+    args = ap.parse_args(argv)
+
+    trajectory = load_trajectory(args.dir, args.glob)
+    if args.current:
+        rec, err = load_record(args.current)
+        if rec is None:
+            print(f"regression: ERROR — {err}", file=sys.stderr)
+            return 2
+        current = (os.path.basename(args.current), rec)
+    else:
+        if not trajectory:
+            print(f"regression: ERROR — no artifacts match "
+                  f"{args.glob!r} in {args.dir!r}", file=sys.stderr)
+            return 2
+        current = trajectory[-1]
+
+    verdict = check_regression(
+        trajectory, current, metric=args.metric,
+        higher_is_better=args.higher_is_better, threshold=args.threshold,
+    )
+    print(json.dumps(verdict))
+    if verdict["status"] == "error":
+        print(f"regression: ERROR — {verdict['reason']}", file=sys.stderr)
+        return 2
+    if verdict["status"] == "regression":
+        print(
+            f"regression: FAIL — {verdict['current']} {args.metric}="
+            f"{verdict['current_value']} is "
+            f"{verdict['regression_fraction']:.1%} worse than "
+            f"{verdict['best_prior']} ({verdict['best_prior_value']}) "
+            f"on {verdict['platform']} (threshold "
+            f"{verdict['threshold']:.1%})",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"regression: PASS — {verdict.get('reason', '')}"
+          f"{verdict.get('current')} ok on {verdict.get('platform')}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

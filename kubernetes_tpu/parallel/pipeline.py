@@ -114,9 +114,11 @@ class PipelinedBatchLoop:
         from ..ops.incremental import HoistCache
 
         self.hoist = HoistCache(mesh=mesh, tracer=tracer)
-        # (choices, meta, inc_attrs, t_dispatch, snap) of the dispatched wave
+        # (choices, meta, inc_attrs, t_arrival, t_dispatch, snap) of the
+        # dispatched wave; t_arrival (encode start) anchors the wave's
+        # arrival -> bind SLI
         self._inflight: Optional[
-            Tuple[object, object, dict, float, Snapshot]
+            Tuple[object, object, dict, float, float, Snapshot]
         ] = None
         self._wave = 0
         # per-kind host seconds: [total, overlapped-with-an-in-flight-step]
@@ -223,7 +225,7 @@ class PipelinedBatchLoop:
         )
         from ..scheduler.tracing import incremental_attrs
 
-        return choices, meta, incremental_attrs(self.hoist)
+        return choices, meta, incremental_attrs(self.hoist), t0
 
     def _recover_wave(self, snap: Snapshot, err: BaseException, t0: float):
         """Serial-oracle replay of a wave that died mid-flight (device-step
@@ -259,7 +261,7 @@ class PipelinedBatchLoop:
     def _collect(self) -> Optional[Verdicts]:
         if self._inflight is None:
             return None
-        choices, meta, inc_attrs, t_dispatch, snap = self._inflight
+        choices, meta, inc_attrs, t_arrival, t_dispatch, snap = self._inflight
         self._inflight = None
         t0 = time.perf_counter()
         try:
@@ -314,6 +316,19 @@ class PipelinedBatchLoop:
         self.stats["waves"] += 1
         if self.metrics is not None:
             self.metrics.observe("pipeline_cycle_seconds", t2 - t_dispatch)
+            # the wave's arrival -> bind SLI: one sample per BOUND pod at
+            # the instant its verdict became consumable (commit-callback
+            # end when the loop commits, decode end otherwise).  Identical
+            # within a wave by construction (the loop has no queue), so a
+            # single bucket bump covers the whole wave — O(1), not O(P).
+            # Unscheduled pods (verdict None) never bound, so they
+            # contribute no sample — matching the scheduler path, which
+            # only observes at bind publication.
+            n_bound = sum(1 for v in verdicts.values() if v is not None)
+            if n_bound:
+                self.metrics.hist(
+                    "pod_scheduling_sli_duration_seconds"
+                ).observe(time.perf_counter() - t_arrival, n=n_bound)
         return verdicts
 
     # the step dispatched after the one being collected (None outside that
@@ -439,15 +454,21 @@ def run_serial(
     hard_pod_affinity_weight: float = 1.0,
     donate: Optional[bool] = None,
     mesh=None,
+    tracer=None,
+    metrics=None,
 ) -> Iterator[Verdicts]:
     """The unpipelined oracle for the same stream: encode -> run -> block,
     one snapshot at a time (identical dataflow at depth=0 — used by tests
-    and the overlap benchmark; the harness's --no-pipeline escape hatch)."""
+    and the overlap benchmark; the harness's --no-pipeline escape hatch).
+    tracer/metrics thread through so a --no-pipeline run can still capture
+    spans for attribution and the SLI series (decisions are unaffected)."""
     loop = PipelinedBatchLoop(
         base_config=base_config,
         hard_pod_affinity_weight=hard_pod_affinity_weight,
         donate=donate,
         depth=0,
         mesh=mesh,
+        tracer=tracer,
+        metrics=metrics,
     )
     return loop.run(snapshots)

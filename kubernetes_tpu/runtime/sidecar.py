@@ -598,21 +598,14 @@ class HealthServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
 
     def _render_metrics(self) -> str:
-        lines = []
-        if self.metrics is not None:
-            counters, gauges, hists = self.metrics.snapshot()
-            for name, v in sorted(counters.items()):
-                lines.append(f"# TYPE {name} counter\n{name} {v}")
-            for name, v in sorted(gauges.items()):
-                lines.append(f"# TYPE {name} gauge\n{name} {v}")
-            for name, (p50, p99, count) in sorted(hists.items()):
-                lines.append(
-                    f"# TYPE {name} summary\n"
-                    f"{name}{{quantile=\"0.5\"}} {p50}\n"
-                    f"{name}{{quantile=\"0.99\"}} {p99}\n"
-                    f"{name}_count {count}"
-                )
-        return "\n".join(lines) + "\n"
+        # one renderer for every exposition point: the full registry in
+        # Prometheus text format — counters, gauges, labeled series and
+        # streaming-histogram cumulative buckets (scheduler/metrics.py —
+        # Metrics.expose_text; the apiserver's /metrics route serves the
+        # identical body)
+        if self.metrics is None:
+            return "\n"
+        return self.metrics.expose_text()
 
     def start(self) -> int:
         self._thread.start()

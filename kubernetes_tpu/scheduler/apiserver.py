@@ -107,6 +107,61 @@ class ClusterIPAllocator:
             self._free.append(n)
 
 
+class MetricsServer:
+    """The /metrics exposition route — a minimal HTTP server over the
+    in-process registry (staging/src/k8s.io/component-base/metrics/legacyregistry
+    served through the generic server's /metrics handler).  `render` is a
+    zero-arg callable returning the Prometheus text body
+    (Metrics.expose_text), re-evaluated per scrape; /healthz answers 200 ok
+    so probes can target the same port.  port=0 binds an ephemeral port
+    (returned by start())."""
+
+    def __init__(self, render, host: str = "127.0.0.1", port: int = 0):
+        import http.server
+        import threading
+
+        srv_render = render
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = srv_render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # pragma: no cover — quiet scrapes
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-exposition",
+        )
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
 class APIServer:
     def __init__(
         self,
@@ -117,6 +172,7 @@ class APIServer:
         total_concurrency: int = 600,
         queue_wait_s: float = 5.0,
         tracer=None,
+        metrics=None,
     ):
         from .tracing import Tracer
 
@@ -133,10 +189,40 @@ class APIServer:
         self.admission = AdmissionChain.default(store, policies, webhooks)
         self.audit_log: List[AuditEvent] = []
         self.ips = ClusterIPAllocator()
+        # the registry the /metrics route serves (scheduler/metrics.py —
+        # usually the scheduler's own Metrics, injected so one scrape
+        # covers the whole control plane); lazily created when absent so
+        # metrics_text() always renders valid exposition
+        from .metrics import Metrics
+
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._metrics_server: Optional[MetricsServer] = None
         from .crd import CRDRegistry
 
         # apiextensions: dynamic kinds with per-version structural schemas
         self.crds = CRDRegistry(store)
+
+    # -- the /metrics route --
+    def metrics_text(self) -> str:
+        """The Prometheus text body GET /metrics serves — the full registry
+        (counters, gauges, labeled series, streaming-histogram buckets)."""
+        return self.metrics.expose_text()
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Start (idempotently) the HTTP exposition server for this
+        apiserver's registry; returns the bound port.  KTPU_METRICS=<port>
+        is the env-knob spelling harness/bench runs use."""
+        if self._metrics_server is None:
+            self._metrics_server = MetricsServer(
+                self.metrics_text, host=host, port=port
+            )
+            self._metrics_server.start()
+        return self._metrics_server.port
+
+    def stop_metrics(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
 
     # -- the handler chain --
     def handle(

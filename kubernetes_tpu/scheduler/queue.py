@@ -106,6 +106,13 @@ class PriorityQueue:
         # backoff clock
         self._tracer = tracer
         self._enq_at: Dict[str, float] = {}
+        # uid -> first-admission perf_counter instant, kept across retries:
+        # the arrival half of the pod_scheduling_sli_duration_seconds SLI
+        # (metrics.go — arrival -> bind).  Unlike _enq_at this is stamped
+        # UNCONDITIONALLY (the SLI is metrics-first, not gated on tracing)
+        # and consumed/popped at bind publication (take_arrival) or delete,
+        # so the table stays bounded by in-flight pods.
+        self._arrival_at: Dict[str, float] = {}
         self._seq = itertools.count()
         self._active: List[_Item] = []  # heap
         self._active_uids: Set[str] = set()
@@ -173,6 +180,8 @@ class PriorityQueue:
         self._no_flush.discard(pod.uid)
         heapq.heappush(self._active, _Item(self._key(pod), pod))
         self._active_uids.add(pod.uid)
+        # first admission wins across retries: arrival -> bind is the SLI
+        self._arrival_at.setdefault(pod.uid, _time.perf_counter())
         if self._tracer is not None and self._tracer.enabled:
             # first activation wins: a superseding re-add keeps the original
             # enqueue instant (the wait the pod actually experienced)
@@ -283,6 +292,9 @@ class PriorityQueue:
         gone, so it takes the plain backoff path instead of parking."""
         if cycle_move_seq is not None and self.move_seq != cycle_move_seq:
             events = None
+        # gate-parked pods (backoff=False) enter here without ever passing
+        # add(): their SLI clock starts at first admission too
+        self._arrival_at.setdefault(pod.uid, _time.perf_counter())
         if events and EV_ALL not in events and backoff:
             self._unschedulable[pod.uid] = (pod, set(events), hints or {})
             self._parked_at[pod.uid] = self.clock.now()
@@ -320,9 +332,17 @@ class PriorityQueue:
         return len(moved)
 
     @_locked
+    def take_arrival(self, pod_uid: str) -> Optional[float]:
+        """Pop and return the pod's first-admission instant — called at
+        bind publication so the SLI table never outlives the pods it
+        tracks (a later re-add of the same uid restarts the clock)."""
+        return self._arrival_at.pop(pod_uid, None)
+
+    @_locked
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)
         self._enq_at.pop(pod_uid, None)
+        self._arrival_at.pop(pod_uid, None)
         self._unschedulable.pop(pod_uid, None)
         self._parked_at.pop(pod_uid, None)
         self._no_flush.discard(pod_uid)

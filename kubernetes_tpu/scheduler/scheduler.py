@@ -89,6 +89,18 @@ class Scheduler:
             backoff_jitter=config.pod_backoff_jitter,
         )
         self.metrics = Metrics()
+        # the headline SLI: true per-pod arrival -> bind latency
+        # (metrics.go — pod_scheduling_sli_duration_seconds), stamped at
+        # queue admission and observed at bind publication — batch waves,
+        # deferred pipeline commits and the gang fixpoint all land here.
+        # Cached handle: one lock per bound pod, no registry round-trip.
+        self._sli_hist = self.metrics.hist("pod_scheduling_sli_duration_seconds")
+        # per-wave introspection for the SLI-consistency tests (and
+        # debugging): uid -> latest true SLI / kernel ordinal estimate.
+        # Populated only while tracing is enabled (the cheap-gate contract:
+        # no per-pod bookkeeping off the enabled path).
+        self.last_wave_sli: Dict[str, float] = {}
+        self.last_wave_estimates: Dict[str, float] = {}
         self.events = EventRecorder(store=store)
         from .klog import Logger
 
@@ -612,6 +624,7 @@ class Scheduler:
         fw.run_post_bind(state, snap, pod, node_name)
         self.queue.delete_nominated(pod.uid)
         self.events.record("Scheduled", pod.uid, node=node_name)
+        self._observe_sli(pod.uid)
         dt = time.perf_counter() - t0
         self.metrics.observe("scheduling_attempt_duration_seconds", dt)
         self.metrics.inc("scheduling_attempts_scheduled")
@@ -960,13 +973,18 @@ class Scheduler:
                     # cycle's deferred fan-out would hide under; a
                     # same-profile stream keeps taking it
                     async_window = True
+            uid_of = {p.name: p.uid for p in snap.pending_pods}
             if ords is not None:
                 self._observe_wave_latency(
                     np.asarray(ords)[: meta.n_pods],
                     time.perf_counter() - t_k0,
                     int(sweeps),
+                    # cheap-gate contract: the O(P) uids build only runs
+                    # when tracing is on (its sole consumer is gated too)
+                    uids=([uid_of[meta.pod_names[k]]
+                           for k in range(meta.n_pods)]
+                          if self.tracer.enabled else None),
                 )
-            uid_of = {p.name: p.uid for p in snap.pending_pods}
             verdicts = {
                 uid_of[meta.pod_names[k]]: (
                     meta.node_names[int(choices[k])] if int(choices[k]) >= 0 else None
@@ -1311,9 +1329,30 @@ class Scheduler:
             )
         self.queue.delete_nominated(pod_uid)
         self.events.record("Scheduled", pod_uid, node=node_name)
+        self._observe_sli(pod_uid)
+
+    def _observe_sli(self, pod_uid: str) -> None:
+        """Record the pod's TRUE arrival -> bind latency (the headline SLI)
+        at the instant its bind became durable — the synchronous commit
+        loop, the deferred flush and the CPU binding cycle all call this at
+        their publication point, so a deferred pod's SLI honestly includes
+        the deferral."""
+        arrived = self.queue.take_arrival(pod_uid)
+        if arrived is None:
+            return  # bound outside the queue's lifecycle (direct store bind)
+        sli = time.perf_counter() - arrived
+        self._sli_hist.observe(sli)
+        if self.tracer.enabled and pod_uid in self.last_wave_estimates:
+            # per-wave introspection, scoped to the pods of the CURRENT
+            # batch-kernel wave (the only producer of estimates): gating on
+            # membership keeps the dict bounded by wave size on every bind
+            # path — the CPU binding cycle and other non-batch paths never
+            # populate estimates, so they never accumulate entries here
+            self.last_wave_sli[pod_uid] = sli
 
     def _observe_wave_latency(
-        self, ordinals: np.ndarray, t_kernel: float, sweeps: int
+        self, ordinals: np.ndarray, t_kernel: float, sweeps: int,
+        uids: Optional[List[str]] = None,
     ) -> None:
         """Per-pod estimated scheduling latency within one batch wave.
 
@@ -1335,6 +1374,15 @@ class Scheduler:
         self.metrics.observe_many(
             "scheduling_attempt_duration_estimate_seconds", est
         )
+        if uids is not None and self.tracer.enabled:
+            # per-pod introspection for the SLI-consistency check: the
+            # kernel's ordinal estimate must order/bound like the true
+            # host-measured SLI (tests/test_observability.py).  Both dicts
+            # are PER-WAVE — last_wave_sli is cleared here (its entries for
+            # this wave land later, at bind publication) so a long-lived
+            # traced scheduler never accumulates per-pod state unboundedly.
+            self.last_wave_estimates = dict(zip(uids, est.tolist()))
+            self.last_wave_sli = {}
 
     def _nominate(self, pod: t.Pod, node_name: str) -> None:
         """Record the nomination (queue nominator) and publish it on the pod's

@@ -144,6 +144,10 @@ class TraceCollector:
         self.enabled = enabled
         self._lock = threading.Lock()
         self._spans: Deque[Span] = deque(maxlen=capacity)
+        # spans silently evicted by the ring wrapping: attribution reports
+        # and trace exports read this to FLAG an incomplete trace instead of
+        # under-counting phases (scheduler/attribution.py)
+        self.spans_dropped: int = 0
         # uid -> latest SpanContext, LRU-bounded: a long-lived process tracing
         # millions of pods must not grow this table without bound
         self._pod_ctx: "OrderedDict[str, SpanContext]" = OrderedDict()
@@ -152,12 +156,16 @@ class TraceCollector:
     # -- span sink --
     def add(self, span: Span) -> None:
         with self._lock:
+            if (self._spans.maxlen is not None
+                    and len(self._spans) == self._spans.maxlen):
+                self.spans_dropped += 1
             self._spans.append(span)
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
             self._pod_ctx.clear()
+            self.spans_dropped = 0
 
     def spans(self, name: Optional[str] = None,
               trace_id: Optional[str] = None) -> List[Span]:
@@ -240,7 +248,19 @@ class TraceCollector:
                 "tid": 0,
                 "args": {"name": comp},
             })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            # Perfetto ignores otherData; consumers (harness summary line,
+            # attribution reports) read it to flag incomplete traces —
+            # spans_dropped > 0 means the ring wrapped and phase totals
+            # under-count
+            "otherData": {
+                "spans_dropped": self.spans_dropped,
+                "spans_exported": len(spans),
+                "capacity": self._spans.maxlen,
+            },
+        }
 
     def export_chrome_trace(self, path: str) -> str:
         with open(path, "w") as f:

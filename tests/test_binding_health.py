@@ -95,7 +95,9 @@ def test_health_and_metrics_endpoints():
     code, body = get("/metrics")
     assert code == 200
     assert "scheduling_attempts_scheduled 7" in body
-    assert 'quantile="0.99"' in body
+    # streaming histograms expose cumulative le-buckets + _sum/_count
+    assert 'scheduling_attempt_duration_seconds_bucket{le="+Inf"} 1' in body
+    assert "scheduling_attempt_duration_seconds_count 1" in body
     hs.stop()
 
 

@@ -168,7 +168,7 @@ def test_metrics_and_events_populate():
     store.add_pod(mk_pod("p"))
     sched.run_until_idle()
     assert sched.metrics.counters["scheduling_attempts_scheduled"] == 1
-    assert sched.metrics.hists["batch_scheduling_duration_seconds"].samples
+    assert sched.metrics.hists["batch_scheduling_duration_seconds"].count
     assert sched.events.by_reason("Scheduled")[0].node == "n0"
 
 
