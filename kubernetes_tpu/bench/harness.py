@@ -73,6 +73,12 @@ class PerfData:
     # cycle attribution report (scheduler/attribution.py) when the round
     # captured a span trace with --attribution
     attribution: Optional[Dict] = None
+    # crash-restart accounting (kill.* chaos storms): process restarts the
+    # round survived, and the HA/failover series next to the SLI —
+    # scheduler_restarts_total / leader_election_transitions_total /
+    # failover p50/p99 + checkpoint_corrupt_total (ha_fields)
+    restarts: int = 0
+    ha: Optional[Dict] = None
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -130,11 +136,28 @@ def run_snapshot_workload(
         if device_trace_dir
         else contextlib.nullcontext()
     )
+    from .. import chaos as chaos_mod
+
     t0 = time.perf_counter()
+    restarts = 0
     with cm:
-        sched.run_until_idle()
+        if chaos_mod.enabled():
+            # chaos-armed rounds run the full HA protocol: a kill.* fault
+            # fells the leader and a standby's leader-elected takeover
+            # (lease CAS past expiry -> build + restore()) resumes the run,
+            # so the blackout lands in failover_duration_seconds and the
+            # artifact's ha block (metrics and collector are shared across
+            # incarnations — the SLI spans the blackouts honestly).  Storms
+            # without kill sites never raise, so this is run_until_idle
+            # plus one lease write.
+            from ..scheduler import run_ha_restartable
+
+            sched, restarts = run_ha_restartable(sched)
+        else:
+            sched.run_until_idle()
     wall = time.perf_counter() - t0
-    return _perfdata(name, snap, sched, len(snap.pending_pods), wall)
+    return _perfdata(name, snap, sched, len(snap.pending_pods), wall,
+                     restarts=restarts)
 
 
 # the registry KTPU_METRICS scrapes: whichever run is currently measuring
@@ -154,6 +177,30 @@ def sli_fields(metrics) -> Dict:
         "sli_p99_ms": round(p99 * 1e3, 2),
         "sli_count": count,
     }
+
+
+def ha_fields(metrics) -> Optional[Dict]:
+    """The failover-observability artifact block, stamped next to the SLI
+    triple: restart/transition counters plus the failover_duration_seconds
+    quantiles (leases.py — HAReplica takeover blackout).  None when the run
+    never restarted, took over, or quarantined a checkpoint — untouched
+    rounds keep their artifact shape."""
+    counters, _gauges, _hists = metrics.snapshot()
+    h = metrics.hists.get("failover_duration_seconds")
+    p50, p99, count = h.stats() if h is not None else (0.0, 0.0, 0)
+    out = {
+        "scheduler_restarts_total": counters.get("scheduler_restarts_total", 0.0),
+        "leader_election_transitions_total": counters.get(
+            "leader_election_transitions_total", 0.0
+        ),
+        "checkpoint_corrupt_total": counters.get("checkpoint_corrupt_total", 0.0),
+        "failover_p50_ms": round(p50 * 1e3, 2),
+        "failover_p99_ms": round(p99 * 1e3, 2),
+        "failover_count": count,
+    }
+    if not any(out.values()):
+        return None
+    return out
 
 
 def _export_trace(collector, path: str) -> None:
@@ -204,7 +251,8 @@ def _setup_cluster(snap: Snapshot, mode: str, collector=None):
     return sched
 
 
-def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> PerfData:
+def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float,
+              restarts: int = 0) -> PerfData:
     scheduled = len(sched.events.by_reason("Scheduled"))
     failed = len(sched.events.by_reason("FailedScheduling"))
     source = "attempt"
@@ -240,6 +288,8 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float) -> Per
             if source == "per-pod-estimate" else None
         ),
         **sli,
+        restarts=restarts,
+        ha=ha_fields(sched.metrics),
     )
 
 
@@ -564,7 +614,16 @@ def main(argv=None) -> None:
                          "run must survive the storm and the artifact "
                          "reports injected/recovered counts so recovery "
                          "overhead is priced")
+    ap.add_argument("--chaos-sites", metavar="GLOB",
+                    help="with --chaos: restrict the seeded storm to sites "
+                         "matching the comma-separated fnmatch globs "
+                         "('kill.*' = just the crash-restart kill points; "
+                         "'*,!kill.*' = everything else; '!g' excludes).  "
+                         "Kill storms default KTPU_CHECKPOINT_DIR to a temp "
+                         "dir so restarts replay a real checkpoint")
     args = ap.parse_args(argv)
+    if args.chaos_sites and args.chaos is None:
+        ap.error("--chaos-sites requires --chaos (it shapes the seeded storm)")
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
                  "with the host-span trace)")
@@ -605,17 +664,47 @@ def main(argv=None) -> None:
     from .. import chaos as chaos_mod
 
     if args.chaos is not None:
-        inj = chaos_mod.install(chaos_mod.FaultPlan.from_seed(args.chaos))
+        sites = None
+        if args.chaos_sites:
+            sites = chaos_mod.sites_matching(args.chaos_sites)
+            if not sites:
+                ap.error(f"--chaos-sites {args.chaos_sites!r} matches no "
+                         f"chaos site (known: {', '.join(chaos_mod.SITE_ACTIONS)})")
+        inj = chaos_mod.install(
+            chaos_mod.FaultPlan.from_seed(args.chaos, sites=sites)
+        )
     else:
         inj = chaos_mod.maybe_install_from_env()
     if inj is not None:
         print(f"chaos plan: {inj.plan.describe()}", file=sys.stderr)
+        has_kills = any(
+            f.site in chaos_mod.KILL_SITES for f in inj.plan.faults
+        )
+        if has_kills and args.stream:
+            # the streaming loop has no Scheduler, hence no checkpoint /
+            # restore() to answer a ProcessKilled with — kill storms belong
+            # to the snapshot rounds' HA driver
+            ap.error("kill.* storms need the scheduler's crash-restart "
+                     "protocol — drop --stream (snapshot rounds) or exclude "
+                     "them: --chaos-sites '*,!kill.*'")
+        if has_kills and not os.environ.get("KTPU_CHECKPOINT_DIR"):
+            # a kill storm without a checkpoint dir would still pass parity
+            # (crash-only rebuild), but the point of the storm is to exercise
+            # the WAL/ledger replay — default one so restarts are real
+            import tempfile
+
+            os.environ["KTPU_CHECKPOINT_DIR"] = tempfile.mkdtemp(
+                prefix="ktpu-ckpt-"
+            )
+            print(f"checkpoint dir: {os.environ['KTPU_CHECKPOINT_DIR']} "
+                  "(kill-storm default)", file=sys.stderr)
 
     def _chaos_report():
         if inj is None:
             return None
         rep = inj.report()
         rep["seed"] = inj.plan.seed
+        rep["sites"] = sorted({f.site for f in inj.plan.faults})
         return rep
 
     if args.stream:

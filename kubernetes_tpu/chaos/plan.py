@@ -58,7 +58,27 @@ SITE_ACTIONS: Dict[str, Tuple[str, ...]] = {
     "compile.cache": ("corrupt",),
     "host.stall": ("stall",),
     "kubelet.sync": ("crash",),
+    # process-death kill points (scheduler.kill family): a `kill` action
+    # simulates kill -9 at an enumerated point of the bind path — it raises
+    # ProcessKilled (BaseException: no component's Exception-level recovery
+    # may "handle" it) and latches the module-wide killed() flag so the dead
+    # instance's finally-blocks do nothing a SIGKILL'd process couldn't.
+    # Recovery is a RESTART: scheduler.restart_scheduler builds a fresh
+    # Scheduler on the surviving store and replays the checkpoint.
+    "kill.post_assume": ("kill",),      # post cache.assume, pre checkpoint
+    "kill.post_checkpoint": ("kill",),  # checkpoint durable, bind unpublished
+    "kill.mid_flush": ("kill",),        # mid deferred-commit flush fan-out
+    "kill.mid_step": ("kill",),         # mid device step, donated bufs in flight
 }
+
+# the kill-point family: excluded from seeded storms UNLESS explicitly
+# requested (sites=) — a kill is only recoverable by a caller running the
+# crash-restart protocol, and pre-existing seeds must keep producing the
+# exact same plans (same seed -> same plan, bit for bit)
+KILL_SITES: Tuple[str, ...] = (
+    "kill.post_assume", "kill.post_checkpoint", "kill.mid_flush",
+    "kill.mid_step",
+)
 
 ALWAYS = -1  # Fault.at sentinel: fire on every invocation of the site
 
@@ -70,6 +90,23 @@ class FaultInjected(RuntimeError):
 
     def __init__(self, fault: "Fault"):
         super().__init__(f"injected fault {fault.site}:{fault.action}@{fault.at}")
+        self.fault = fault
+
+
+class ProcessKilled(BaseException):
+    """Simulated kill -9 at an enumerated kill point.
+
+    Deliberately a BaseException: every in-process recovery path catches
+    Exception, and a SIGKILL'd process gets no chance to recover, flush or
+    clean up — the only legitimate response is a restart from checkpoint +
+    LIST/WATCH (scheduler.restart_scheduler).  The injector latches the
+    module-wide killed() flag BEFORE raising so the dying instance's
+    finally-blocks (deferred-bind flush, pipeline drain) see the process as
+    dead and do nothing; the restart driver calls revive() once the
+    replacement is constructed."""
+
+    def __init__(self, fault: "Fault"):
+        super().__init__(f"process killed at {fault.site}@{fault.at}")
         self.fault = fault
 
 
@@ -156,9 +193,16 @@ class FaultPlan:
         """A deterministic storm: n_faults draws of (site, action, ordinal)
         over the first `horizon` invocations of each site.  Same seed ->
         same plan, bit for bit — replaying a failing seed reproduces the
-        exact fault sequence."""
+        exact fault sequence.
+
+        The default pool excludes the kill.* sites: a kill is recoverable
+        only by a caller running the crash-restart protocol, and existing
+        seeds must keep producing identical plans.  Pass sites= (e.g. from
+        sites_matching("kill.*")) to storm the kill points."""
         rng = random.Random(seed)
-        pool = tuple(sites) if sites else tuple(SITE_ACTIONS)
+        pool = tuple(sites) if sites else tuple(
+            s for s in SITE_ACTIONS if s not in KILL_SITES
+        )
         faults = []
         for _ in range(n_faults):
             site = pool[rng.randrange(len(pool))]
@@ -170,6 +214,30 @@ class FaultPlan:
             faults.append(Fault(site, action, rng.randrange(horizon),
                                 param=param))
         return cls(faults, seed=seed)
+
+
+def sites_matching(pattern: str) -> Tuple[str, ...]:
+    """Resolve a comma-separated fnmatch glob list against the site table
+    (`bench.harness --chaos-sites`).  A `!glob` term excludes; with only
+    exclusions the include set defaults to every site.  Examples:
+    "kill.*" -> just the kill points; "*,!kill.*" -> everything else;
+    "scheduler.*,kill.mid_flush" -> a targeted mix."""
+    from fnmatch import fnmatchcase
+
+    include: List[str] = []
+    exclude: List[str] = []
+    for p in pattern.split(","):
+        p = p.strip()
+        if not p:
+            continue
+        (exclude if p.startswith("!") else include).append(p.lstrip("!"))
+    if not include:
+        include = ["*"]
+    return tuple(
+        s for s in SITE_ACTIONS
+        if any(fnmatchcase(s, p) for p in include)
+        and not any(fnmatchcase(s, p) for p in exclude)
+    )
 
 
 class ChaosInjector:
@@ -205,6 +273,12 @@ class ChaosInjector:
             return None
         self._mark("fault_injected", "framework_fault_injected_total",
                    f, tracer, metrics, invocation=n, **attrs)
+        if f.action == "kill":
+            # latch BEFORE raising: the dying instance's unwind (finally
+            # blocks included) must observe killed() and do nothing
+            global _KILLED
+            _KILLED = True
+            raise ProcessKilled(f)
         if f.action in ("hang", "stall"):
             time.sleep(f.param or 0.01)
         if f.action in ("error", "hang", "crash"):
@@ -250,6 +324,23 @@ class ChaosInjector:
 # global read, so the disabled hot-path cost is a dict lookup away from zero)
 _ACTIVE: Optional[ChaosInjector] = None
 _FALLBACK_METRICS = None  # recoveries from ORGANIC faults still count
+# the kill latch: True from the instant a kill fault fires until the restart
+# driver revives — components' drain/flush/cleanup paths check killed() so a
+# dead instance's finally-blocks do nothing a SIGKILL'd process couldn't
+_KILLED = False
+
+
+def killed() -> bool:
+    """True while the simulated process is dead (a kill fault fired and no
+    restart has revived it)."""
+    return _KILLED
+
+
+def revive() -> None:
+    """Clear the kill latch — the restart driver's first act, called once
+    the replacement scheduler is about to be constructed."""
+    global _KILLED
+    _KILLED = False
 
 
 def install(plan: FaultPlan, metrics=None, tracer=None) -> ChaosInjector:
@@ -261,6 +352,7 @@ def install(plan: FaultPlan, metrics=None, tracer=None) -> ChaosInjector:
 def uninstall() -> None:
     global _ACTIVE
     _ACTIVE = None
+    revive()  # a leaked kill latch must not outlive the plan (test hygiene)
 
 
 def active() -> Optional[ChaosInjector]:

@@ -336,6 +336,25 @@ class HoistCache:
                 n_nodes=int(n_nodes), dirty_node_fraction=float(frac),
             )
 
+    def invalidate(self) -> None:
+        """Forget every resident fingerprint and device buffer — the
+        crash-restart/takeover rebuild hook (scheduler.py — restore()).
+
+        A restored scheduler re-derives the world from LIST+WATCH; the
+        identity-based fingerprints this cache trusts are meaningless
+        against the fresh host arrays a new encoder produces, so the first
+        post-restore cycle MUST take the full re-hoist path (the forced
+        re-fingerprint the crash-only rule requires) instead of patching a
+        cache whose lineage died with the old process."""
+        self._static_key = None
+        self._statics = None
+        self._usage_key = None
+        self._usage = None
+        self._req_u_host = None
+        self._prev_used = None
+        self._cls_ent = None
+        self._req_ent = None
+
     def summary(self) -> dict:
         """The bench-artifact triple (BENCH_r06 attribution)."""
         fr = sorted(

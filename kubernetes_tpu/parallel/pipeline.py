@@ -265,6 +265,15 @@ class PipelinedBatchLoop:
         self._inflight = None
         t0 = time.perf_counter()
         try:
+            if chaos.enabled():
+                # kill.mid_step: process death while the dispatched step
+                # (and its donated input buffers) is still in flight — a
+                # BaseException, so the wave-recovery except below cannot
+                # catch it and run()'s teardown drain stays off (a SIGKILL'd
+                # process fetches nothing); only a fresh loop re-encoding
+                # from host state recovers
+                chaos.poke("kill.mid_step", tracer=self.tracer,
+                           metrics=self.metrics)
             fault = (
                 chaos.poke("pipeline.step", tracer=self.tracer,
                            metrics=self.metrics)
@@ -391,10 +400,13 @@ class PipelinedBatchLoop:
             if v is not None:
                 yield v
         finally:
-            if self._inflight is not None:
+            if self._inflight is not None and not chaos.killed():
                 # abandoned mid-stream (caller exception / generator close):
                 # best-effort drain so the final wave's commit callback runs
-                # and nothing stays reserved-but-unpublished
+                # and nothing stays reserved-but-unpublished.  NOT on a kill:
+                # a SIGKILL'd process gets no teardown — the in-flight wave
+                # dies with it and a restarted loop re-encodes from host
+                # state (the crash-restart protocol's business).
                 try:
                     self.drain()
                     chaos.record_recovery(

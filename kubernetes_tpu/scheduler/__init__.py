@@ -1,6 +1,12 @@
 from .framework import Framework, Status, CycleState  # noqa: F401
 from .config import SchedulerConfiguration, Profile  # noqa: F401
-from .scheduler import Scheduler  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Scheduler,
+    reincarnate,
+    restart_scheduler,
+    run_ha_restartable,
+    run_restartable,
+)
 from .store import ClusterStore  # noqa: F401
 from .controllers import ControllerManager  # noqa: F401
 from .kubelet import HollowCluster, HollowKubelet  # noqa: F401

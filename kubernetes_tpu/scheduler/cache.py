@@ -10,8 +10,9 @@ api.Snapshot the encoder and the CPU path both consume.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
+from .. import chaos
 from ..api import types as t
 from ..api.snapshot import Snapshot
 from .framework import NodeInfo
@@ -21,6 +22,16 @@ from .store import ClusterStore, Event, replace_pod_nodename
 class SchedulerCache:
     def __init__(self, store: ClusterStore):
         self._lock = threading.Lock()
+        # crash-consistency hook (scheduler.py — _checkpoint_state): invoked
+        # AFTER every assumed-ledger mutation, outside the cache lock, so the
+        # reservation is durable before the bind path proceeds.  None = no
+        # checkpointing (the default; KTPU_CHECKPOINT_DIR arms it).
+        self.checkpoint_hook: Optional[Callable[[], None]] = None
+        # kill-point router (scheduler.py — _kill_point): lets the owning
+        # scheduler stamp kill.post_assume injections onto ITS tracer and
+        # metrics (and latch _dead) like every other kill site; the bare
+        # chaos.poke fallback keeps a standalone cache stormable
+        self.kill_point: Optional[Callable[[str], None]] = None
         # registry kinds the snapshot LISTs at build time (StorageClass /
         # ResourceSlice / DeviceClass churn far less than pods; a per-cycle
         # LIST matches the reference's informer-cache read)
@@ -66,10 +77,37 @@ class SchedulerCache:
     def assume(self, pod_uid: str, node_name: str) -> None:
         with self._lock:
             self.assumed[pod_uid] = node_name
+        # kill.post_assume: the enumerated kill point BETWEEN the in-memory
+        # reservation and its durable checkpoint — a restart must requeue
+        # the pod (the ledger on disk never saw it)
+        kp = self.kill_point
+        if kp is not None:
+            kp("kill.post_assume")
+        elif chaos.enabled():
+            chaos.poke("kill.post_assume")
+        self._checkpoint()
 
     def forget(self, pod_uid: str) -> None:
         with self._lock:
-            self.assumed.pop(pod_uid, None)
+            dropped = self.assumed.pop(pod_uid, None) is not None
+        if dropped:
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        """Persist the assumed-pod ledger at every reservation change
+        (checkpoint.py — fsync'd atomic-rename; the hook snapshots the
+        ledger itself).  Called OUTSIDE the cache lock: the hook reads
+        assumed via assumed_snapshot(), and file IO under the cache lock
+        would serialize every concurrent binding worker behind fsync."""
+        hook = self.checkpoint_hook
+        if hook is not None:
+            hook()
+
+    def assumed_snapshot(self) -> Dict[str, str]:
+        """Lock-consistent copy of the assumed ledger (the checkpoint's
+        read side)."""
+        with self._lock:
+            return dict(self.assumed)
 
     def _effective_node(self, pod: t.Pod) -> str:
         return pod.node_name or self.assumed.get(pod.uid, "")

@@ -68,19 +68,113 @@ class LeaseStore:
 class LeaderElector:
     """tools/leaderelection — LeaderElector.Run reduced to tick()."""
 
-    def __init__(self, leases: LeaseStore, identity: str, name: str = "kube-scheduler"):
+    def __init__(self, leases: LeaseStore, identity: str,
+                 name: str = "kube-scheduler",
+                 lease_duration_s: float = LEASE_DURATION_S):
         self.leases = leases
         self.identity = identity
         self.name = name
+        self.lease_duration_s = lease_duration_s
 
     def tick(self) -> bool:
         """Attempt acquire/renew; returns True while this identity leads."""
-        return self.leases.try_acquire_or_renew(self.name, self.identity, LEASE_DURATION_S)
+        return self.leases.try_acquire_or_renew(
+            self.name, self.identity, self.lease_duration_s
+        )
 
     @property
     def is_leader(self) -> bool:
         cur = self.leases.get(self.name)
         return cur is not None and cur.holder == self.identity
+
+
+class HAReplica:
+    """One scheduler replica of an active/standby pair — the LeaderElector
+    run loop with the takeover protocol attached.
+
+    Both replicas tick() on their retry period; only the lease holder owns a
+    live Scheduler.  The standby holds NO scheduler at all (a fresh takeover
+    LISTs the world exactly like a restarted process — the crash-only rule),
+    so when the active dies silently (kill -9: it simply stops renewing) the
+    standby's first successful CAS after lease expiry triggers:
+
+      build scheduler (factory) -> restore() (checkpoint + relist + WAL
+      replay + forced hoist re-fingerprint) -> record the blackout
+
+    Blackout = (lease-clock time past the dead leader's expiry when the CAS
+    landed) + (real seconds the takeover build+restore took), observed into
+    `failover_duration_seconds`; every leadership change bumps
+    `leader_election_transitions_total` and emits a `leader.takeover` span.
+    The pair-level invariant (tests): takeover completes within ONE lease
+    duration of the expiry, and placements stay bit-identical to a
+    never-failed scheduler."""
+
+    def __init__(self, identity: str, leases: LeaseStore, make_scheduler,
+                 name: str = "kube-scheduler",
+                 lease_duration_s: float = LEASE_DURATION_S,
+                 metrics=None, tracer=None):
+        self.identity = identity
+        self.elector = LeaderElector(
+            leases, identity, name=name, lease_duration_s=lease_duration_s
+        )
+        self.make_scheduler = make_scheduler
+        self.metrics = metrics
+        self.tracer = tracer
+        self.scheduler = None
+        self.dead = False  # a killed active stops ticking (kill -9 semantics)
+        self._was_leader = False
+        # the chaos kill.* site that felled the leader this standby replaces
+        # (run_ha_restartable stamps it from ProcessKilled.fault) — restore()
+        # records the recovery under that site so injected/recovered counts
+        # reconcile; None for organic takeovers (no injected fault)
+        self.killed_site: Optional[str] = None
+
+    def kill(self) -> None:
+        """Simulate kill -9 on this replica: it stops renewing (the lease
+        simply expires) and its scheduler instance is abandoned mid-state —
+        never drained, never flushed (Scheduler.detach marks it inert the
+        way the OS would reclaim a dead process)."""
+        self.dead = True
+        if self.scheduler is not None:
+            self.scheduler.detach()
+        from .. import chaos
+
+        chaos.revive()  # the latch belongs to the dead replica, not the pair
+
+    def tick(self) -> bool:
+        """One leaderelection retry-period step; returns True while this
+        replica leads.  A dead replica never ticks (its lease decays)."""
+        if self.dead:
+            return False
+        import time as _t
+
+        prev = self.elector.leases.get(self.elector.name)
+        lead = self.elector.tick()
+        if lead and not self._was_leader:
+            t0 = _t.perf_counter()
+            # blackout's lease-clock half: how long past the previous
+            # holder's expiry the takeover CAS landed (0 on first election
+            # or an uncontended hand-back)
+            blackout = 0.0
+            if prev is not None and prev.holder != self.identity:
+                expiry = prev.renew_time + self.elector.lease_duration_s
+                blackout = max(0.0, self.elector.leases.clock.now() - expiry)
+            self.scheduler = self.make_scheduler()
+            self.scheduler.restore(killed_site=self.killed_site)
+            dt = _t.perf_counter() - t0
+            m = self.metrics if self.metrics is not None else self.scheduler.metrics
+            m.inc("leader_election_transitions_total")
+            m.observe("failover_duration_seconds", blackout + dt)
+            tr = self.tracer if self.tracer is not None else self.scheduler.tracer
+            if tr is not None and tr.enabled:
+                tr.record_span(
+                    "leader.takeover", start=t0, end=t0 + dt,
+                    identity=self.identity,
+                    previous=prev.holder if prev is not None else "",
+                    blackout_s=round(blackout, 6),
+                )
+        self._was_leader = lead
+        return lead
 
 
 class NodeLifecycleController:

@@ -30,6 +30,20 @@ commits; no reference analog — the reference never overlaps cycles):
   pipeline_deferred_commit_seconds    deferred bind fan-out flush (usually
                                       inside the next cycle's device-step
                                       window; at a drain point otherwise)
+
+High-availability / crash-restart series (scheduler.py restore() +
+leases.py HAReplica; all flow through expose_text like every other series
+and are stamped into bench artifacts next to sli_p99_ms):
+
+  scheduler_restarts_total            restore-protocol runs: crash restarts
+                                      AND leader takeovers (each relists +
+                                      replays the checkpoint)
+  leader_election_transitions_total   leadership changes (HAReplica.tick)
+  failover_duration_seconds           blackout per takeover: lease-clock
+                                      time past the dead leader's expiry +
+                                      real build/restore seconds
+  checkpoint_corrupt_total            quarantined checkpoints
+                                      (checkpoint.py — <name>.json.corrupt)
 """
 
 from __future__ import annotations

@@ -338,6 +338,31 @@ class PriorityQueue:
         tracks (a later re-add of the same uid restarts the clock)."""
         return self._arrival_at.pop(pod_uid, None)
 
+    # --- crash-restart SLI continuity (scheduler/checkpoint.py) ---
+    @_locked
+    def export_arrivals(self) -> Dict[str, float]:
+        """Per-pod first-admission AGE (seconds waited so far) for the
+        checkpoint: ages are relative, so the restoring process's
+        perf_counter base never needs to match the dead one's."""
+        now = _time.perf_counter()
+        return {uid: now - t for uid, t in self._arrival_at.items()}
+
+    @_locked
+    def restore_arrivals(self, ages: Dict[str, float]) -> int:
+        """Re-base checkpointed admission ages onto this process's clock —
+        a requeued pod's arrival->bind SLI keeps the wait it already
+        served (failover inflates p99 honestly instead of restarting the
+        clock).  Only pods the watch replay re-admitted are touched: a
+        stale checkpoint entry for a pod that no longer exists must not
+        seed an unbounded table.  Returns #restored."""
+        now = _time.perf_counter()
+        n = 0
+        for uid, age in ages.items():
+            if uid in self._arrival_at:
+                self._arrival_at[uid] = now - max(0.0, float(age))
+                n += 1
+        return n
+
     @_locked
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)

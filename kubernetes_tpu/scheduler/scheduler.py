@@ -65,6 +65,8 @@ class Scheduler:
         clock: Optional[Clock] = None,
         logger=None,
         collector=None,
+        metrics=None,
+        checkpoint_dir: Optional[str] = None,
     ):
         from .tracing import TraceCollector, Tracer, default_collector
 
@@ -72,6 +74,14 @@ class Scheduler:
         self.config = config
         self.features = FeatureGates(config.feature_gates)
         self.cache = SchedulerCache(store)
+        # kill.post_assume injections stamp THIS scheduler's tracer/metrics
+        # (and latch _dead) like every other kill site
+        self.cache.kill_point = self._kill_point
+        # simulated-process liveness: a kill.* chaos fault latches this (and
+        # the module-wide chaos.killed()) so the dying instance's unwind —
+        # deferred-bind flush, binding drains — does nothing a SIGKILL'd
+        # process couldn't.  restart_scheduler() builds the replacement.
+        self._dead = False
         # span tracing: callers may inject a TraceCollector (bench rounds use
         # a fresh one per run; pass TraceCollector(enabled=False) to opt out
         # of all span allocation); default = the process-wide collector
@@ -88,7 +98,10 @@ class Scheduler:
             max_backoff_s=config.pod_max_backoff_seconds,
             backoff_jitter=config.pod_backoff_jitter,
         )
-        self.metrics = Metrics()
+        # injectable registry: a crash-restart driver hands the SAME Metrics
+        # to every incarnation, so counters/hists (the SLI included) span
+        # restarts the way an external scrape target would see them
+        self.metrics = metrics if metrics is not None else Metrics()
         # the headline SLI: true per-pod arrival -> bind latency
         # (metrics.go — pod_scheduling_sli_duration_seconds), stamped at
         # queue admission and observed at bind publication — batch waves,
@@ -218,6 +231,20 @@ class Scheduler:
                     # same validated clamp-with-warning (or None) semantics
                     # as the env knob — one resolution path for both
                     self.mesh = mesh_from_env(str(md), source="meshDevices")
+        # crash-consistent state (checkpoint.py): KTPU_CHECKPOINT_DIR (or the
+        # explicit arg) arms a kubelet-style checksummed checkpoint of the
+        # assumed-pod ledger + deferred-commit WAL + SLI arrival stamps —
+        # everything else rebuilds from LIST+WATCH (crash-only).  The ledger
+        # checkpoints at every cache.assume/forget via the cache hook.
+        self._ckpt = None
+        ckpt_dir = checkpoint_dir or os.environ.get("KTPU_CHECKPOINT_DIR")
+        if ckpt_dir:
+            from .checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(
+                ckpt_dir, metrics=self.metrics, logger=self.log
+            )
+            self.cache.checkpoint_hook = self._checkpoint_state
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -656,6 +683,8 @@ class Scheduler:
         """Drain in-flight binding cycles (the reference's graceful shutdown
         waits on the binding goroutines the same way).  Also a drain point
         for the batch path's deferred commit fan-out."""
+        if self._dead or chaos.killed():
+            return  # a SIGKILL'd process drains nothing
         self._flush_deferred_binds()
         if self._bind_pool is None:
             return
@@ -667,6 +696,150 @@ class Scheduler:
                 return
             for f in pending:
                 f.result()
+
+    # --- crash-restart & failover (checkpoint.py + leases.py) ---
+    def _kill_point(self, site: str) -> None:
+        """An enumerated process-death site (chaos kill.* family): poke the
+        injector and, when the plan kills here, mark this instance dead
+        BEFORE the ProcessKilled unwinds — its finally-blocks must behave
+        like a SIGKILL'd process (no flush, no drain, no checkpoint)."""
+        if not chaos.enabled():
+            return
+        try:
+            chaos.poke(site, tracer=self.tracer, metrics=self.metrics)
+        except chaos.ProcessKilled:
+            self._dead = True
+            raise
+
+    def _checkpoint_state(self) -> None:
+        """Persist the crash-restart checkpoint (one fsync'd atomic file):
+        assumed-pod ledger + deferred-commit WAL + SLI arrival ages — the
+        state LIST+WATCH cannot reconstruct, nothing more (crash-only rule).
+        Invoked from the cache hook at every assume/forget, at every WAL
+        append, and at flush completion."""
+        if self._ckpt is None or self._dead or chaos.killed():
+            return
+        from .checkpoint import save_scheduler_state
+
+        save_scheduler_state(
+            self._ckpt,
+            self.cache.assumed_snapshot(),
+            [(p.uid, node) for p, node in self._deferred_binds],
+            self.queue.export_arrivals(),
+            lineage=self.store.lineage,
+        )
+
+    def restore(self, killed_site: Optional[str] = None) -> Dict[str, int]:
+        """The restart/takeover protocol: load the checkpoint, reconcile it
+        against the relisted store, and leave the scheduler ready to resume
+        the pipelined loop.  Designed to run on a FRESH instance (the
+        constructor's watch replay already re-admitted every unbound pod
+        and rebuilt the cache — the LIST half of crash-only recovery):
+
+          1. restore arrival ages (SLI continuity — before any bind so the
+             first post-restore publication observes the true wait)
+          2. replay the deferred-commit WAL exactly once: an entry whose
+             pod is already bound was published pre-crash (skip); an
+             unbound entry's verdict was durably decided, so publish it now
+             (the bind, its events and SLI land exactly once)
+          3. reconcile assumed-but-unbound pods: their reservation died
+             with the process and their verdict was never durably recorded
+             — they stay requeued (watch replay re-admitted them with
+             original arrival stamps) and reschedule deterministically
+          4. force a full hoist re-fingerprint + fresh delta encoder: the
+             resident device caches' identity lineage died with the old
+             process (ops/incremental.py — invalidate)
+
+        Safe (and cheap) when no checkpoint exists: pure crash-only rebuild.
+        killed_site: the chaos kill.* site that felled the previous
+        incarnation (from ProcessKilled.fault) — the recovery is recorded
+        under that same site so per-site injected/recovered counts in the
+        chaos artifact reconcile; None (organic takeover) records no chaos
+        recovery.  Returns a small report dict for logs/tests."""
+        t0 = time.perf_counter()
+        report = {
+            "wal_applied": 0, "wal_skipped": 0, "reconciled_assumed": 0,
+            "restored_arrivals": 0,
+        }
+        doc = None
+        if self._ckpt is not None:
+            from .checkpoint import load_scheduler_state
+
+            doc = load_scheduler_state(self._ckpt)
+        if doc is not None and doc["lineage"] != self.store.lineage:
+            # a checkpoint written against a DIFFERENT cluster: uids are
+            # deterministic (namespace/name), so replaying its WAL here
+            # could bind colliding pods of an unrelated workload.  Not
+            # corruption (the file is a valid checkpoint of some cluster) —
+            # ignore it and rebuild crash-only.
+            self.log.V(1).info(
+                "Checkpoint from another cluster lineage ignored",
+                checkpoint_lineage=doc["lineage"], store_lineage=self.store.lineage,
+            )
+            doc = None
+        if doc:
+            # the blackout (dead time since the last checkpoint) is real
+            # wait the pods served: add it to every checkpointed age so the
+            # SLI inflates honestly instead of forgiving the outage
+            dead_s = (
+                max(0.0, time.time() - doc["saved_wall"])
+                if doc["saved_wall"] else 0.0
+            )
+            report["restored_arrivals"] = self.queue.restore_arrivals(
+                {u: a + dead_s for u, a in doc["arrivals"].items()}
+            )
+            node_names = set(self.store.nodes)
+            for uid, node in doc["wal"]:
+                cur = self.store.pods.get(uid)
+                if cur is None or node not in node_names:
+                    report["wal_skipped"] += 1  # pod/node gone while dead
+                    continue
+                if cur.node_name:
+                    report["wal_skipped"] += 1  # already applied pre-crash
+                    continue
+                self._publish_bind(uid, node)
+                self.queue.delete(uid)  # drop the replay-admitted copy
+                report["wal_applied"] += 1
+            for uid, node in doc["assumed"].items():
+                cur = self.store.pods.get(uid)
+                if cur is not None and not cur.node_name:
+                    # reservation died with the process, verdict never made
+                    # it to the WAL: the pod is already requeued (watch
+                    # replay) with its original arrival stamp — count it
+                    report["reconciled_assumed"] += 1
+        # crash-only rule: resident device caches rebuild from scratch
+        if self._hoist_cache is not None:
+            self._hoist_cache.invalidate()
+        self._delta_enc = None
+        self._deferred_binds = []
+        self.metrics.inc("scheduler_restarts_total")
+        self._checkpoint_state()  # persist the clean post-restore slate
+        dt = time.perf_counter() - t0
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "scheduler.restore", start=t0, end=t0 + dt, **report
+            )
+        if killed_site is not None:
+            # pair the recovery with the fault that killed the previous
+            # incarnation, under the SAME site label the injection counted
+            # against — per-site injected/recovered reconcile in the
+            # chaos artifact (an organic takeover has no injected fault,
+            # so it records nothing here)
+            chaos.record_recovery(
+                killed_site, "restore", tracer=self.tracer,
+                metrics=self.metrics, start=t0, **report,
+            )
+        self.log.V(1).info("Scheduler state restored", **report)
+        return report
+
+    def detach(self) -> None:
+        """Disconnect a DEAD incarnation from the store's watch fan-out —
+        the restart driver's stand-in for the OS reclaiming a killed
+        process's watch connections.  The instance stays inert afterwards
+        (every drain/flush path early-returns on _dead)."""
+        self._dead = True
+        self.store.unwatch(self._on_event)
+        self.store.unwatch(self.cache._on_event)
 
     # --- the TPU batch cycle ---
     def schedule_batch(self) -> Dict[str, Optional[str]]:
@@ -920,6 +1093,12 @@ class Scheduler:
                             arr, cfg, with_ordinals=True, mesh=self.mesh,
                             inc=inc,
                         )
+                        # kill.mid_step: process death with the fixpoint's
+                        # device wave still in flight (the gang path never
+                        # donates, but the step is just as unfetched) — a
+                        # BaseException the wave-recovery except below can
+                        # NOT catch; only a restart recovers
+                        self._kill_point("kill.mid_step")
                         choices = np.asarray(choices)
                         if fault is not None and fault.action == "nan":
                             choices = chaos.poison(choices)
@@ -954,6 +1133,11 @@ class Scheduler:
                                 mesh=self.mesh, inc=inc,
                             )
                         )
+                        # kill.mid_step: process death with the device step
+                        # (and any donated buffers) still in flight — a
+                        # BaseException, so the wave-recovery except below
+                        # can NOT catch it; only a restart recovers
+                        self._kill_point("kill.mid_step")
                         # step i runs on device: the deferred bind/events
                         # fan-out of step i−1 executes NOW, inside the device
                         # window — the commit_overlap half of the pipeline
@@ -1040,10 +1224,19 @@ class Scheduler:
                     if err is not None:
                         node_name = None
                 if node_name:
+                    # assume reserves capacity AND checkpoints the ledger
+                    # (cache hook); kill.post_assume fires inside, between
+                    # the in-memory reservation and its durable save
                     self.cache.assume(pod.uid, node_name)
                     assumed_now.append(pod.uid)
+                    # kill.post_checkpoint: ledger durable, bind unpublished
+                    # — restart must requeue (verdict not in the WAL yet)
+                    self._kill_point("kill.post_checkpoint")
                     if defer_ok and not pod.pvcs:
                         self._deferred_binds.append((pod, node_name))
+                        # WAL append-before-publication-window: a restart
+                        # replays this verdict exactly once (restore())
+                        self._checkpoint_state()
                         result[pod.name] = node_name
                         done.add(pod.name)
                         continue
@@ -1281,14 +1474,19 @@ class Scheduler:
         deferral only moves the store publication, its watch fan-out (a
         no-op move — the gate required zero parked pods) and the Scheduled
         event later in wall time, never across an observable read."""
-        if not self._deferred_binds:
-            return
+        if not self._deferred_binds or self._dead or chaos.killed():
+            return  # nothing deferred, or a dead process publishes nothing
         binds, self._deferred_binds = self._deferred_binds, []
         t0 = time.perf_counter()
         k = 0
         try:
             with self._coalesced_moves():
                 for k, (pod, node_name) in enumerate(binds):
+                    # kill.mid_flush: process death part-way through the
+                    # deferred fan-out — the published prefix survives in
+                    # the store, the tail survives in the WAL; restore()
+                    # replays exactly the unpublished suffix
+                    self._kill_point("kill.mid_flush")
                     cur = self.store.pods.get(pod.uid)
                     if cur is None:
                         # deleted (or preempted) while deferred: the capacity
@@ -1306,6 +1504,9 @@ class Scheduler:
             # the assumed capacity forever and lose the binds
             self._deferred_binds = binds[k:] + self._deferred_binds
             raise
+        # flush complete: the WAL drains with it (exactly-once rule — a
+        # later restart must not replay what the store already shows)
+        self._checkpoint_state()
         dt = time.perf_counter() - t0
         self.metrics.observe("pipeline_deferred_commit_seconds", dt)
         if self.tracer.enabled:
@@ -1465,3 +1666,113 @@ class Scheduler:
                     "still pending (non-quiescent workload)"
                 )
         self.wait_for_bindings()
+
+
+def reincarnate(dead: Scheduler) -> Scheduler:
+    """Build (but do NOT restore) the replacement incarnation on a dead
+    scheduler's store: same config / checkpoint dir, sharing the collector
+    and Metrics so spans and the SLI span the restart like an external
+    observer would see them.  The constructor's watch replay re-admits every
+    unbound pod (the LIST half of crash-only recovery); the caller — either
+    restart_scheduler or an HAReplica takeover — runs restore()."""
+    sched = Scheduler(
+        dead.store,
+        dead.config,
+        collector=dead.collector,
+        metrics=dead.metrics,
+        checkpoint_dir=dead._ckpt.directory if dead._ckpt is not None else None,
+    )
+    # the replacement inherits the dead scheduler's PodGroups: they live in
+    # the cache (seeded by the harness / gang controller), not the store's
+    # watch replay
+    sched.cache.pod_groups.update(dead.cache.pod_groups)
+    # the event recorder models the APISERVER event sink, not process
+    # memory: Scheduled/FailedScheduling events published before the kill
+    # survive it (the bench artifact's scheduled count must span restarts)
+    sched.events = dead.events
+    return sched
+
+
+def restart_scheduler(dead: Scheduler,
+                      killed_site: Optional[str] = None) -> Scheduler:
+    """The crash-restart driver step: given an incarnation a kill.* fault
+    just killed (ProcessKilled escaped), detach its watch subscriptions (the
+    OS reclaiming a dead process's connections), clear the kill latch, and
+    bring up + restore() the replacement on the SAME store.  killed_site
+    (ProcessKilled.fault.site) labels the recovery so it reconciles with
+    the injection in the chaos artifact."""
+    dead.detach()
+    chaos.revive()
+    sched = reincarnate(dead)
+    sched.restore(killed_site=killed_site)
+    return sched
+
+
+def run_restartable(sched: Scheduler, max_restarts: int = 64) -> Tuple[Scheduler, int]:
+    """Drive run_until_idle across kill.* chaos faults: every ProcessKilled
+    is answered with a restart-from-checkpoint (restart_scheduler) and the
+    loop resumes on the replacement.  Returns (final incarnation, #restarts).
+    Non-kill exceptions propagate untouched — they are the live-process
+    recovery paths' business, not a restart's."""
+    restarts = 0
+    while True:
+        try:
+            sched.run_until_idle()
+            return sched, restarts
+        except chaos.ProcessKilled as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            sched = restart_scheduler(sched, killed_site=e.fault.site)
+
+
+def run_ha_restartable(
+    sched: Scheduler, lease_duration_s: float = 0.25, max_restarts: int = 64,
+) -> Tuple[Scheduler, int]:
+    """run_restartable with the active/standby protocol: every kill -9 is
+    answered by a standby LEADER TAKEOVER (leases.py — HAReplica) instead of
+    a bare in-place restart.  The dead leader simply stops renewing; the
+    standby's first successful lease CAS past expiry builds + restores the
+    replacement, so every blackout lands in `failover_duration_seconds` and
+    `leader_election_transitions_total` — the HA series the bench artifact
+    stamps next to the SLI.  The short default lease keeps bench blackouts
+    priced in fractions of a second (production uses the client-go 15 s)."""
+    from .leases import HAReplica, LeaderElector, LeaseStore
+
+    leases = LeaseStore()  # real clock: blackouts are real wall time
+    leader = LeaderElector(
+        leases, "sched-0", lease_duration_s=lease_duration_s
+    )
+    leader.tick()  # incarnation 0 is the initial leader
+    restarts = 0
+    while True:
+        try:
+            sched.run_until_idle()
+            return sched, restarts
+        except chaos.ProcessKilled as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            # the leader's renew loop (a background thread in client-go,
+            # ticking every retry period) was renewing right up to the kill
+            # — run_until_idle is synchronous here, so model its final
+            # renewal at the death instant.  The standby's blackout then
+            # measures death -> takeover (one lease expiry + build/restore),
+            # not lease staleness accumulated across the whole run segment.
+            leader.tick()
+            dead = sched
+            dead.detach()
+            chaos.revive()  # the latch belongs to the dead leader
+            standby = HAReplica(
+                f"sched-{restarts}", leases,
+                lambda d=dead: reincarnate(d),
+                lease_duration_s=lease_duration_s,
+                metrics=dead.metrics, tracer=dead.tracer,
+            )
+            standby.killed_site = e.fault.site
+            # tick on the leaderelection retry period until the dead
+            # leader's lease decays and the takeover CAS lands
+            while not standby.tick():
+                time.sleep(lease_duration_s / 10.0)
+            sched = standby.scheduler
+            leader = standby.elector  # the next kill fells THIS leader

@@ -24,6 +24,7 @@ mutating bindings in-process, so CAS degenerates to serialized apply.
 from __future__ import annotations
 
 import threading
+import uuid
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -86,6 +87,14 @@ class ClusterStore:
         # re-entrant: watchers are invoked under the lock and may read back
         self._lock = threading.RLock()
         self._rv = 0
+        # cluster lineage: uids are deterministic (namespace/name), so a
+        # crash-restart checkpoint written against ANOTHER store instance
+        # could replay colliding uids into this one.  The checkpoint stamps
+        # this id and restore() ignores a lineage mismatch — the analog of
+        # the reference checking it is talking to the same cluster before
+        # trusting local state.  Stable across restarts (the replacement
+        # incarnation reattaches to the SAME store), unique per cluster.
+        self.lineage = uuid.uuid4().hex
         self.nodes: Dict[str, t.Node] = {}
         self.pods: Dict[str, t.Pod] = {}  # by uid
         self.pdbs: Dict[str, t.PodDisruptionBudget] = {}  # by namespace/name

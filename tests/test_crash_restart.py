@@ -1,0 +1,529 @@
+"""Crash-restart & failover parity (ISSUE 7): a process kill at ANY
+enumerated kill point — post-assume/pre-checkpoint, post-checkpoint/pre-bind,
+mid-deferred-flush, mid-device-step with buffers in flight — answered by the
+restart-from-checkpoint protocol yields final placements bit-identical to the
+fault-free serial oracle: zero double-binds, zero lost pods.  Plus: corrupt
+checkpoints are quarantined (never silently discarded), the arrival->bind SLI
+survives restarts, and an active/standby HAReplica pair completes takeover
+within one lease duration with the blackout recorded.
+
+Tier-1 covers every kill point x {pipeline on/off} x {incremental on/off} at
+smoke scale; the full seeded kill-storm soak with mesh8 handoff is `slow`."""
+
+import contextlib
+import copy
+import os
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.scheduler import (
+    ClusterStore,
+    Scheduler,
+    SchedulerConfiguration,
+    restart_scheduler,
+    run_ha_restartable,
+    run_restartable,
+)
+from kubernetes_tpu.scheduler.checkpoint import (
+    CheckpointManager,
+    load_scheduler_state,
+    save_scheduler_state,
+)
+from kubernetes_tpu.scheduler.leases import HAReplica, LeaseStore
+from kubernetes_tpu.scheduler.metrics import Metrics
+from kubernetes_tpu.scheduler.queue import FakeClock
+from kubernetes_tpu.scheduler.tracing import TraceCollector, Tracer
+
+from helpers import mk_node, mk_pod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _run(plan=None, ckpt_dir=None, pipeline=True, gang=True,
+         incremental=True, collector=None, churn=0, metrics=None):
+    """One scheduler lifetime driven through run_restartable: any kill.*
+    fault is answered by restart-from-checkpoint and the run resumes on the
+    replacement incarnation.  Returns (placements, final sched, restarts)."""
+    os.environ["KTPU_PIPELINE"] = "1" if pipeline else "0"
+    os.environ["KTPU_INCREMENTAL"] = "1" if incremental else "0"
+    if ckpt_dir:
+        os.environ["KTPU_CHECKPOINT_DIR"] = str(ckpt_dir)
+    gates = () if gang else (("GangScheduling", False),)
+    try:
+        ctx = (chaos.chaos_plan(plan) if plan is not None
+               else contextlib.nullcontext())
+        with ctx:
+            store = ClusterStore()
+            for i in range(5):
+                store.add_node(mk_node(f"n{i}", cpu=3000, pods=16))
+            sched = Scheduler(
+                store,
+                SchedulerConfiguration(mode="tpu", feature_gates=gates),
+                collector=collector, metrics=metrics,
+            )
+            for i in range(20):
+                store.add_pod(mk_pod(f"p{i}", cpu=250))
+            restarts = 0
+            sched, restarts = run_restartable(sched)
+            rng = random.Random(5)
+            for r in range(churn):
+                bound = sorted(
+                    (p for p in store.pods.values() if p.node_name),
+                    key=lambda p: p.uid,
+                )
+                for v in rng.sample(bound, 6):
+                    store.delete_pod(v.uid)
+                    q = copy.copy(v)
+                    q.name = f"{v.name}-r{r}"
+                    q.uid = ""
+                    q.node_name = ""
+                    q.__post_init__()
+                    store.add_pod(q)
+                sched, more = run_restartable(sched)
+                restarts += more
+            placements = {p.name: p.node_name for p in store.pods.values()}
+            return placements, sched, restarts
+    finally:
+        os.environ.pop("KTPU_PIPELINE", None)
+        os.environ.pop("KTPU_INCREMENTAL", None)
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
+# --- kill-point parity: each enumerated point x pipeline x incremental ---
+@pytest.mark.parametrize("incremental", [True, False])
+@pytest.mark.parametrize("pipeline", [True, False])
+@pytest.mark.parametrize("site", chaos.KILL_SITES)
+def test_kill_point_parity(site, pipeline, incremental, tmp_path):
+    """kill -9 at each enumerated kill point: the restarted incarnation
+    replays the checkpoint and finishes with placements bit-identical to
+    the fault-free serial oracle — no pod double-bound, none lost."""
+    # mid_flush needs the deferred-commit window (non-gang async path with
+    # pipelined commits armed — serial loops publish synchronously, so there
+    # is no mid-flush to die in); the other sites are exercised on the
+    # DEFAULT gang-gated path too
+    if site == "kill.mid_flush" and not pipeline:
+        pytest.skip("no deferred flush exists without pipelined commits")
+    gang = site != "kill.mid_flush"
+    oracle, _, _ = _run(pipeline=False, gang=gang, incremental=incremental)
+    plan = chaos.FaultPlan.parse(f"{site}:kill@1" if site == "kill.mid_step"
+                                 else f"{site}:kill@0")
+    got, sched, restarts = _run(
+        plan, ckpt_dir=tmp_path, pipeline=pipeline, gang=gang,
+        incremental=incremental, churn=1,
+    )
+    oracle_churn, _, _ = _run(pipeline=False, gang=gang,
+                              incremental=incremental, churn=1)
+    assert restarts >= 1, f"{site} never fired — kill point unreachable"
+    assert got == oracle_churn
+    assert all(v for v in got.values())  # zero lost pods
+    assert sched.metrics.counters["scheduler_restarts_total"] >= 1
+
+
+def test_kill_storm_parity_smoke(tmp_path):
+    """A seeded storm across ALL kill points (the acceptance schedule) with
+    churn: every kill answered by a restart, placements bit-identical."""
+    oracle, _, _ = _run(pipeline=False, gang=False, churn=2)
+    plan = chaos.FaultPlan.from_seed(7, sites=chaos.KILL_SITES, n_faults=6)
+    col = TraceCollector()
+    got, sched, restarts = _run(
+        plan, ckpt_dir=tmp_path, gang=False, churn=2, collector=col,
+    )
+    assert restarts >= 2
+    assert got == oracle
+    # every pod bound exactly once across all incarnations (the shared
+    # event sink spans restarts): no double-publication anywhere
+    ev = [e for e in sched.events.by_reason("Scheduled")]
+    uids = [e.pod for e in ev]
+    final_uids = {p.uid for p in
+                  (p for p in sched.store.pods.values() if p.node_name)}
+    assert final_uids <= set(uids)
+    assert col.spans(name="scheduler.restore")
+
+
+def test_kill_without_checkpoint_dir_is_pure_crash_only(tmp_path):
+    """No KTPU_CHECKPOINT_DIR: a killed scheduler still restarts clean —
+    everything rebuilds from LIST+WATCH (the crash-only floor)."""
+    oracle, _, _ = _run(pipeline=False)
+    got, sched, restarts = _run(chaos.FaultPlan.parse("kill.post_assume:kill@0"))
+    assert restarts == 1
+    assert got == oracle
+
+
+def test_mid_flush_kill_replays_exactly_the_unpublished_suffix(tmp_path):
+    """Kill part-way through the deferred fan-out: the published prefix
+    survives in the store, the WAL replays ONLY the unpublished suffix —
+    each pod ends with exactly one Scheduled event (exactly-once rule)."""
+    plan = chaos.FaultPlan.parse("kill.mid_flush:kill@2")
+    got, sched, restarts = _run(plan, ckpt_dir=tmp_path, gang=False)
+    assert restarts == 1
+    assert all(v for v in got.values())
+    ev = sched.events.by_reason("Scheduled")
+    assert len(ev) == 20
+    uids = [e.pod for e in ev]
+    assert len(uids) == len(set(uids))  # no pod published twice
+
+
+def test_killed_latch_suppresses_dead_instance_teardown(tmp_path):
+    """While killed() is latched, the dying instance's drain/flush paths do
+    nothing a SIGKILL'd process couldn't — and revive() re-arms them."""
+    store = ClusterStore()
+    store.add_node(mk_node("n0", cpu=8000, pods=64))
+    sched = Scheduler(store, SchedulerConfiguration(
+        mode="tpu", feature_gates=(("GangScheduling", False),)))
+    p = mk_pod("d0", cpu=100)
+    store.add_pod(p)
+    sched.cache.assume(p.uid, "n0")
+    sched._deferred_binds.append((p, "n0"))
+    from kubernetes_tpu.chaos import plan as _plan_mod
+
+    with chaos.chaos_plan(chaos.FaultPlan.parse("kill.post_assume:kill@99")):
+        _plan_mod._KILLED = True  # the latch lives in the plan module
+        try:
+            sched._flush_deferred_binds()  # dead process publishes nothing
+            assert sched._deferred_binds  # nothing flushed
+            assert store.pods[p.uid].node_name == ""
+        finally:
+            chaos.revive()
+    sched._flush_deferred_binds()
+    assert store.pods[p.uid].node_name == "n0"
+
+
+# --- checkpoint corruption: quarantine, never silence ---
+def test_corrupt_checkpoint_is_quarantined_and_counted(tmp_path):
+    m = Metrics()
+    cm = CheckpointManager(str(tmp_path), metrics=m)
+    save_scheduler_state(cm, {"u1": "n0"}, [("u2", "n1")], {"u1": 1.5})
+    path = os.path.join(str(tmp_path), "scheduler_state.json")
+    with open(path, "w") as f:
+        f.write('{"truncated')  # torn write / disk corruption
+    assert cm.load("scheduler_state") is None
+    assert os.path.exists(path + ".corrupt")  # evidence preserved
+    assert not os.path.exists(path)
+    assert m.counters["checkpoint_corrupt_total"] == 1
+
+
+def test_checksum_mismatch_quarantines_too(tmp_path):
+    import json
+
+    m = Metrics()
+    cm = CheckpointManager(str(tmp_path), metrics=m)
+    cm.save("scheduler_state", {"assumed": {"u": "n"}})
+    path = os.path.join(str(tmp_path), "scheduler_state.json")
+    doc = json.load(open(path))
+    doc["data"]["assumed"]["u"] = "evil"  # bit-flip without re-checksum
+    json.dump(doc, open(path, "w"))
+    assert cm.load("scheduler_state") is None
+    assert os.path.exists(path + ".corrupt")
+    assert m.counters["checkpoint_corrupt_total"] == 1
+
+
+def test_absent_checkpoint_is_not_corruption(tmp_path):
+    m = Metrics()
+    cm = CheckpointManager(str(tmp_path), metrics=m)
+    assert cm.load("scheduler_state") is None  # normal first boot
+    assert m.counters.get("checkpoint_corrupt_total", 0) == 0
+    assert not os.listdir(str(tmp_path))
+
+
+def test_restore_after_corrupt_checkpoint_rebuilds_clean(tmp_path):
+    """A corrupt checkpoint at restore time: quarantined + counted, then a
+    pure crash-only rebuild schedules everything correctly anyway."""
+    oracle, _, _ = _run(pipeline=False)
+    os.environ["KTPU_CHECKPOINT_DIR"] = str(tmp_path)
+    try:
+        store = ClusterStore()
+        for i in range(5):
+            store.add_node(mk_node(f"n{i}", cpu=3000, pods=16))
+        sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+        for i in range(20):
+            store.add_pod(mk_pod(f"p{i}", cpu=250))
+        # poison the checkpoint the constructor's dir now holds
+        with open(os.path.join(str(tmp_path), "scheduler_state.json"), "w") as f:
+            f.write("not json at all")
+        report = sched.restore()
+        assert report["wal_applied"] == 0
+        assert sched.metrics.counters["checkpoint_corrupt_total"] == 1
+        sched.run_until_idle()
+        got = {p.name: p.node_name for p in store.pods.values()}
+        assert got == oracle
+    finally:
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
+def test_checkpoint_from_another_cluster_lineage_is_ignored(tmp_path):
+    """uids are deterministic (namespace/name), so a checkpoint dir reused
+    across clusters — harness rounds share one — must never replay a stale
+    WAL into a new store whose uids merely collide; the same store's own
+    restart still replays it exactly once."""
+    os.environ["KTPU_CHECKPOINT_DIR"] = str(tmp_path)
+    try:
+        store1 = ClusterStore()
+        store1.add_node(mk_node("n0", cpu=8000, pods=16))
+        s1 = Scheduler(store1, SchedulerConfiguration(mode="tpu"))
+        p = mk_pod("same-name", cpu=100)
+        store1.add_pod(p)
+        s1._deferred_binds.append((p, "n0"))
+        s1._checkpoint_state()  # durable WAL entry for p's uid
+        # a NEW cluster reusing the dir, with a COLLIDING uid
+        store2 = ClusterStore()
+        store2.add_node(mk_node("n0", cpu=8000, pods=16))
+        s2 = Scheduler(store2, SchedulerConfiguration(mode="tpu"))
+        store2.add_pod(mk_pod("same-name", cpu=100))
+        report = s2.restore()
+        assert report["wal_applied"] == 0  # stale lineage: nothing replayed
+        assert store2.pods[p.uid].node_name == ""  # no premature bind
+        assert s2.metrics.counters.get("checkpoint_corrupt_total", 0) == 0
+        # the SAME store's restart replays its own WAL exactly once
+        s1._checkpoint_state()
+        s1b = restart_scheduler(s1)
+        assert store1.pods[p.uid].node_name == "n0"
+        assert s1b.metrics.counters["scheduler_restarts_total"] >= 1
+    finally:
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
+# --- SLI continuity across restart ---
+def test_arrival_stamps_ride_the_checkpoint(tmp_path):
+    """A pod that waited before the crash keeps its served wait after the
+    restart: the arrival->bind SLI includes pre-crash queue time instead of
+    restarting the clock (failover inflates p99 honestly)."""
+    os.environ["KTPU_CHECKPOINT_DIR"] = str(tmp_path)
+    try:
+        store = ClusterStore()
+        sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+        store.add_pod(mk_pod("w0", cpu=100))  # no nodes yet: it waits
+        sched._checkpoint_state()
+        doc = load_scheduler_state(sched._ckpt)
+        uid = next(iter(doc["arrivals"]))
+        time.sleep(0.05)  # the wait it serves while the process is "dead"
+        sched2 = restart_scheduler(sched)
+        store.add_node(mk_node("n0", cpu=8000, pods=16))
+        sched2.run_until_idle()
+        p50, p99, count = sched2.metrics.hists[
+            "pod_scheduling_sli_duration_seconds"
+        ].stats()
+        assert count == 1
+        assert p99 >= 0.05  # the pre-restart wait is in the SLI
+        assert store.pods[uid].node_name == "n0"
+    finally:
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
+def test_stale_arrival_entries_do_not_seed_the_queue(tmp_path):
+    """A checkpointed arrival stamp for a pod the relisted world no longer
+    admits must not grow the arrival table unboundedly."""
+    store = ClusterStore()
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    n = sched.queue.restore_arrivals({"ghost-uid": 12.0})
+    assert n == 0
+    assert "ghost-uid" not in sched.queue._arrival_at
+
+
+# --- active/standby failover ---
+def _ha_pair(store, metrics, collector, lease_s=5.0):
+    clock = FakeClock()
+    leases = LeaseStore(clock=clock)
+
+    def make():
+        return Scheduler(store, SchedulerConfiguration(mode="tpu"),
+                         metrics=metrics, collector=collector)
+
+    a = HAReplica("sched-a", leases, make, lease_duration_s=lease_s,
+                  metrics=metrics)
+    b = HAReplica("sched-b", leases, make, lease_duration_s=lease_s,
+                  metrics=metrics)
+    return a, b, clock
+
+
+def test_standby_takes_over_within_one_lease_duration(tmp_path):
+    """Active dies silently (kill -9: it just stops renewing); the standby's
+    first tick past lease expiry wins the CAS, restores, and schedules the
+    backlog — blackout recorded in failover_duration_seconds and the
+    takeover emits a leader.takeover span."""
+    os.environ["KTPU_CHECKPOINT_DIR"] = str(tmp_path)
+    try:
+        metrics = Metrics()
+        col = TraceCollector()
+        store = ClusterStore()
+        for i in range(5):
+            store.add_node(mk_node(f"h{i}", cpu=3000, pods=16))
+        a, b, clock = _ha_pair(store, metrics, col, lease_s=5.0)
+        assert a.tick() is True  # first election
+        assert b.tick() is False  # standby stays cold (no scheduler at all)
+        assert b.scheduler is None
+        for i in range(10):
+            store.add_pod(mk_pod(f"q{i}", cpu=200))
+        a.scheduler.run_until_idle()
+        a.kill()
+        # within the lease the standby CANNOT take over (CAS fails) ...
+        clock.step(4.9)
+        assert b.tick() is False
+        # ... one retry period past expiry it must
+        clock.step(0.2)
+        t0 = metrics.counters.get("leader_election_transitions_total", 0)
+        assert b.tick() is True
+        assert metrics.counters["leader_election_transitions_total"] == t0 + 1
+        for i in range(10, 20):
+            store.add_pod(mk_pod(f"q{i}", cpu=200))
+        b.scheduler.run_until_idle()
+        assert all(p.node_name for p in store.pods.values())
+        p50, p99, count = metrics.hists["failover_duration_seconds"].stats()
+        assert count >= 1
+        spans = col.spans(name="leader.takeover")
+        assert spans
+        # lease-clock blackout half: the takeover landed 0.1 lease-seconds
+        # past expiry — within one lease duration (the pair invariant)
+        blackouts = [s.attributes.get("blackout_s", 0.0) for s in spans]
+        assert max(blackouts) <= 5.0
+        assert metrics.counters["scheduler_restarts_total"] >= 1
+    finally:
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
+def test_run_ha_restartable_records_failover_in_metrics(tmp_path):
+    """The bench driver's answer to a kill storm (harness chaos rounds):
+    every kill fells the leader and a standby's leader-elected takeover
+    resumes the run — parity holds, the blackout lands in
+    failover_duration_seconds, and ha_fields turns it into the artifact's
+    ha block next to the SLI."""
+    oracle, _, _ = _run(pipeline=False, gang=False)
+    os.environ["KTPU_CHECKPOINT_DIR"] = str(tmp_path)
+    lease_s = 0.1
+    try:
+        plan = chaos.FaultPlan.parse("kill.post_checkpoint:kill@0")
+        with chaos.chaos_plan(plan):
+            store = ClusterStore()
+            for i in range(5):
+                store.add_node(mk_node(f"n{i}", cpu=3000, pods=16))
+            col = TraceCollector()
+            sched = Scheduler(
+                store,
+                SchedulerConfiguration(
+                    mode="tpu", feature_gates=(("GangScheduling", False),)
+                ),
+                collector=col,
+            )
+            for i in range(20):
+                store.add_pod(mk_pod(f"p{i}", cpu=250))
+            sched, restarts = run_ha_restartable(sched, lease_duration_s=lease_s)
+            got = {p.name: p.node_name for p in store.pods.values()}
+        assert restarts == 1
+        assert got == oracle
+        m = sched.metrics
+        assert m.counters["leader_election_transitions_total"] >= 1
+        assert m.counters["scheduler_restarts_total"] >= 1
+        _p50, p99, count = m.hists["failover_duration_seconds"].stats()
+        assert count == 1
+        assert p99 > 0
+        # pair invariant: the takeover CAS landed within one lease duration
+        # of the dead leader's expiry (the driver renews at the death
+        # instant, so blackout_s measures death -> takeover overshoot)
+        spans = col.spans(name="leader.takeover")
+        assert spans
+        assert max(
+            s.attributes.get("blackout_s", 0.0) for s in spans
+        ) <= lease_s
+        from kubernetes_tpu.bench.harness import ha_fields
+
+        out = ha_fields(m)
+        assert out["failover_count"] == 1
+        assert out["leader_election_transitions_total"] >= 1
+    finally:
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
+def test_dead_replica_never_reacquires(tmp_path):
+    metrics = Metrics()
+    store = ClusterStore()
+    store.add_node(mk_node("h0", cpu=3000, pods=16))
+    a, b, clock = _ha_pair(store, metrics, TraceCollector(), lease_s=5.0)
+    assert a.tick()
+    a.kill()
+    clock.step(100.0)
+    assert a.tick() is False  # dead stays dead
+    assert b.tick() is True
+
+
+# --- chaos-site selection (bench.harness --chaos-sites) ---
+def test_sites_matching_globs():
+    assert chaos.sites_matching("kill.*") == chaos.KILL_SITES
+    rest = chaos.sites_matching("*,!kill.*")
+    assert not set(rest) & set(chaos.KILL_SITES)
+    assert "sidecar.rpc" in rest
+    mixed = chaos.sites_matching("scheduler.*,kill.mid_flush")
+    assert "scheduler.step" in mixed and "kill.mid_flush" in mixed
+    assert chaos.sites_matching("no.such.*") == ()
+
+
+def test_seeded_storms_exclude_kill_sites_by_default():
+    """Pre-existing seeds must keep producing identical plans: the default
+    pool never draws kill.* (only sites_matching('kill.*') storms do)."""
+    for seed in range(12):
+        plan = chaos.FaultPlan.from_seed(seed)
+        assert not any(f.site in chaos.KILL_SITES for f in plan.faults)
+    killplan = chaos.FaultPlan.from_seed(0, sites=chaos.KILL_SITES)
+    assert all(f.site in chaos.KILL_SITES for f in killplan.faults)
+    assert all(f.action == "kill" for f in killplan.faults)
+
+
+def test_ha_fields_artifact_block():
+    from kubernetes_tpu.bench.harness import ha_fields
+
+    m = Metrics()
+    assert ha_fields(m) is None  # untouched run keeps its artifact shape
+    m.inc("scheduler_restarts_total")
+    m.observe("failover_duration_seconds", 0.2)
+    out = ha_fields(m)
+    assert out["scheduler_restarts_total"] == 1.0
+    assert out["failover_count"] == 1
+    assert out["failover_p99_ms"] > 0
+
+
+def test_chaos_sites_flag_requires_chaos():
+    from kubernetes_tpu.bench.harness import main
+
+    with pytest.raises(SystemExit):
+        main(["--chaos-sites", "kill.*", "--out", "/dev/null"])
+
+
+# --- the slow soak: seeded kill storm + mesh8 active/standby handoff ---
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [3, 11])
+def test_kill_storm_soak_with_handoff_mesh8(mesh8, seed, tmp_path):
+    """Seeded kill-storm soak under the 8-way mesh with an active/standby
+    handoff mid-storm: placements stay bit-identical to the never-failed
+    oracle and the takeover blackout is recorded."""
+    os.environ["KTPU_MESH"] = "8"
+    try:
+        oracle, _, _ = _run(pipeline=False, gang=False, churn=3)
+        plan = chaos.FaultPlan.from_seed(
+            seed, sites=chaos.KILL_SITES, n_faults=10, horizon=24,
+        )
+        got, sched, restarts = _run(
+            plan, ckpt_dir=tmp_path, gang=False, churn=3,
+        )
+        assert got == oracle
+        assert restarts >= 1
+        # handoff on the surviving store: the standby relists + restores
+        metrics = sched.metrics
+        col = TraceCollector()
+        a, b, clock = _ha_pair(sched.store, metrics, col, lease_s=5.0)
+        assert a.tick()
+        a.kill()
+        clock.step(5.2)
+        assert b.tick()
+        _, _, count = metrics.hists["failover_duration_seconds"].stats()
+        assert count >= 1
+        after = {p.name: p.node_name for p in b.scheduler.store.pods.values()}
+        assert after == got  # takeover rewrites nothing
+    finally:
+        os.environ.pop("KTPU_MESH", None)
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
