@@ -1,0 +1,21 @@
+"""ktpu-verify — the project's static-analysis plane (ISSUE 8).
+
+The reference gates every PR behind hack/verify-* + golangci-lint; this
+package is the reproduction's analog: `python -m kubernetes_tpu.analysis`
+enforces the codebase's own invariants (PARITY.md prose rules turned into
+rule ids KTPU001..KTPU006), with a baseline-suppression file and the
+0/1/2 exit-code contract.
+
+Only the runtime lock-check factories are exported at package level — the
+scheduler's hot modules import them at construction time, so this __init__
+must stay dependency-free and cheap (engine/rules/lockorder are imported
+by the CLI and tests directly).
+"""
+
+from . import lockcheck  # noqa: F401
+from .lockcheck import (  # noqa: F401
+    CheckedLock,
+    LockOrderViolation,
+    make_lock,
+    make_rlock,
+)

@@ -1,0 +1,191 @@
+"""ktpu-verify CLI — `python -m kubernetes_tpu.analysis`.
+
+The project's hack/verify-* analog: runs every KTPU rule over the package
+and gates on the baseline.
+
+  python -m kubernetes_tpu.analysis                      # text, exit 0/1/2
+  python -m kubernetes_tpu.analysis --format json        # CI artifact
+  python -m kubernetes_tpu.analysis --write-baseline     # draft suppressions
+  python -m kubernetes_tpu.analysis --lock-graph         # dump KTPU006 graph
+
+Exit-code contract (bench/regression.py's): 0 clean (all findings
+baselined), 1 unbaselined findings, 2 unusable (parse failure, malformed
+baseline).  The baseline lives at kubernetes_tpu/analysis/baseline.json;
+every entry carries a REQUIRED reason (a drafted TODO reason fails the
+load, so --write-baseline output cannot silently pass CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+
+def default_root() -> str:
+    """The installed kubernetes_tpu package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def resolve_root(root: str) -> str:
+    """Re-anchor a repo-root --root at the package directory: every
+    path-scoped rule (KTPU001 allowlist, KTPU002 exemptions, KTPU003
+    donation modules, KTPU004 scope) matches relpaths rooted at
+    `kubernetes_tpu/...` — pointing --root at the repo would otherwise
+    produce spurious findings AND silently disable those scopes at once.
+    Roots not containing the package (rule fixtures) pass through."""
+    root = os.path.abspath(root)
+    if os.path.basename(root) != "kubernetes_tpu":
+        cand = os.path.join(root, "kubernetes_tpu")
+        if os.path.isdir(cand):
+            return cand
+    return root
+
+
+def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None):
+    """The shared gate: load the committed baseline and run the full pass.
+    Used by this CLI and by `bench.harness --verify`, so both exits follow
+    ONE contract.  Raises BaselineError (exit 2) on an unusable baseline."""
+    from .engine import Baseline, analyze_package
+
+    baseline = Baseline.load(baseline_path or default_baseline())
+    return analyze_package(resolve_root(root or default_root()),
+                           baseline=baseline)
+
+
+def main(argv=None) -> int:
+    from .engine import Baseline, BaselineError, analyze_package
+    from .rules import ALL_RULES
+
+    ap = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="ktpu-verify: AST invariant analyzer + lock-order checker",
+    )
+    ap.add_argument("--root", default=default_root(),
+                    help="package directory to analyze (default: the "
+                         "installed kubernetes_tpu)")
+    ap.add_argument("--baseline", default=default_baseline(),
+                    help="baseline suppression file (JSON)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--output", default="",
+                    help="also write the JSON report to this path")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write a draft baseline covering every unbaselined "
+                         "finding (reasons left TODO — fill them in)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the static lock-order graph and exit")
+    args = ap.parse_args(argv)
+    if args.write_baseline and args.no_baseline:
+        # --no-baseline makes `baseline` None, so the draft merge below
+        # would REPLACE the committed file, silently discarding every
+        # human-written suppression reason — refuse the combination
+        ap.error("--write-baseline cannot combine with --no-baseline "
+                 "(the draft merges into the existing baseline)")
+
+    args.root = resolve_root(args.root)
+
+    if args.lock_graph:
+        return _dump_lock_graph(args.root)
+
+    rules = [cls() for cls in ALL_RULES]
+    lockorder = True
+    if args.rules:
+        want = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        known = {r.rule_id for r in rules} | {"KTPU006"}
+        unknown = sorted(want - known)
+        if unknown:
+            # a typoed id would otherwise select ZERO rules and exit 0 —
+            # a CI gate that enforces nothing while reporting clean
+            ap.error(f"unknown rule id(s): {', '.join(unknown)} "
+                     f"(known: {', '.join(sorted(known))})")
+        rules = [r for r in rules if r.rule_id in want]
+        lockorder = "KTPU006" in want  # --rules subsets really subset
+
+    baseline = None
+    if not args.no_baseline:
+        try:
+            # --write-baseline loads leniently: a prior draft's TODO reasons
+            # must not dead-end re-drafting (strict CI runs still refuse them)
+            baseline = Baseline.load(args.baseline, lenient=args.write_baseline)
+        except BaselineError as e:
+            print(f"ktpu-verify: unusable baseline: {e}", file=sys.stderr)
+            return 2
+
+    report = analyze_package(args.root, rules=rules, baseline=baseline,
+                             lockorder=lockorder)
+
+    if args.write_baseline:
+        if report.errors:
+            # an unusable run has incomplete findings: rewriting the
+            # baseline from it would silently drop entries whose file
+            # merely failed to parse — refuse to touch the file
+            for e in report.errors:
+                print(f"ERROR {e}", file=sys.stderr)
+            print("ktpu-verify: refusing to rewrite the baseline from an "
+                  "unusable run (errors above)", file=sys.stderr)
+            return 2
+        draft = Baseline.draft(report.unbaselined)
+        if baseline is not None:
+            # drop TODO entries whose finding was fixed (stale drafts);
+            # human-reasoned stale entries stay — the STALE report line
+            # tells a reviewer to remove them, drafting never deletes a why
+            stale = {e["fingerprint"] for e in report.stale_baseline}
+            keep = [
+                e for e in baseline.entries
+                if not ((e.get("reason") or "").upper().startswith("TODO")
+                        and e["fingerprint"] in stale)
+            ]
+            draft["findings"] = keep + draft["findings"]
+        with open(args.baseline, "w") as f:
+            json.dump(draft, f, indent=2, sort_keys=True)
+            f.write("\n")
+        todo = sum(1 for e in draft["findings"]
+                   if (e.get("reason") or "").upper().startswith("TODO"))
+        print(f"wrote {len(draft['findings'])} baseline entries "
+              f"({todo} TODO) to {args.baseline} — fill in every TODO reason")
+        return 1 if todo else 0  # TODOs left = unresolved work, not clean
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+    return report.exit_code
+
+
+def _dump_lock_graph(root: str) -> int:
+    from .engine import load_modules
+    from .lockorder import LockOrderAnalyzer
+
+    mods, errors = load_modules(root)
+    if errors:
+        for e in errors:
+            print(f"ERROR {e}", file=sys.stderr)
+        return 2
+    edges, witness, reentrant = LockOrderAnalyzer(mods).build_graph()
+    for a in sorted(edges):
+        for b in sorted(edges[a]):
+            w = witness.get((a, b), ("", 0, ""))
+            print(f"{a} -> {b}    # {w[2]} ({w[0]}:{w[1]})")
+    locks = sorted(reentrant)
+    print(f"# {len(locks)} locks, "
+          f"{sum(len(v) for v in edges.values())} edges")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

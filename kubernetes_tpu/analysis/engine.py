@@ -1,0 +1,367 @@
+"""ktpu-verify engine — AST rule registry, baseline suppression, reporting.
+
+The reference gates every PR behind `hack/verify-*` + golangci-lint; this
+reproduction's equally sharp invariants (PARITY.md: donation-aliasing,
+crash-consistency, the snapshot-LIST rule, the cheap-gate contract) lived in
+prose until now.  This engine turns them into enforced findings:
+
+  * every rule (`analysis/rules.py` — KTPU001..005, `analysis/lockorder.py`
+    — KTPU006) walks the parsed AST of every module in the package
+  * a finding is keyed by a LINE-NUMBER-FREE fingerprint
+    (rule | file | enclosing function | normalized source line), so
+    baselines survive unrelated edits
+  * the baseline file suppresses known findings, each with a REQUIRED
+    human reason — `--write-baseline` drafts entries, a reviewer fills in
+    the why
+  * exit-code contract (bench/regression.py style): 0 clean, 1 unbaselined
+    findings, 2 unusable (parse failure, bad baseline) — CI gates on it
+
+`python -m kubernetes_tpu.analysis` is the CLI (`analysis/__main__.py`).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass
+class Finding:
+    rule: str           # KTPU001...
+    message: str        # one-line defect statement
+    file: str           # package-relative posix path
+    line: int           # 1-based (display only — NOT part of the fingerprint)
+    func: str           # enclosing function qualname ("" at module level)
+    snippet: str        # stripped source line
+    baselined: bool = False
+    baseline_reason: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity: stable across unrelated edits.  Two
+        identical offending lines in one function share a fingerprint — one
+        baseline entry deliberately covers both."""
+        norm = re.sub(r"\s+", " ", self.snippet).strip()
+        raw = f"{self.rule}|{self.file}|{self.func}|{norm}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        where = f"{self.file}:{self.line}"
+        ctx = f" [{self.func}]" if self.func else ""
+        tail = f"  (baselined: {self.baseline_reason})" if self.baselined else ""
+        return f"{self.rule} {where}{ctx}: {self.message}{tail}\n    {self.snippet}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule, "message": self.message, "file": self.file,
+            "line": self.line, "func": self.func, "snippet": self.snippet,
+            "fingerprint": self.fingerprint, "baselined": self.baselined,
+            "baseline_reason": self.baseline_reason,
+        }
+
+
+class ModuleInfo:
+    """One parsed module + the node bookkeeping every rule needs: parent
+    links and enclosing-function qualnames."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self._parent: Dict[int, ast.AST] = {}
+        self._qual: Dict[int, str] = {}
+        self._index(self.tree, None, ())
+
+    def _index(self, node: ast.AST, parent: Optional[ast.AST],
+               scope: Tuple[str, ...]) -> None:
+        if parent is not None:
+            self._parent[id(node)] = parent
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            scope = scope + (node.name,)
+        self._qual[id(node)] = ".".join(scope)
+        for child in ast.iter_child_nodes(node):
+            self._index(child, node, scope)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Qualname of the scope enclosing `node` (class + nested funcs)."""
+        return self._qual.get(id(node), "")
+
+    def line_of(self, node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        if 1 <= ln <= len(self.lines):
+            return self.lines[ln - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule, message=message, file=self.relpath,
+            line=getattr(node, "lineno", 0), func=self.qualname(node),
+            snippet=self.line_of(node),
+        )
+
+
+def call_name(call: ast.AST) -> str:
+    """Last-segment name of a call's callee: `contextlib.suppress(...)` ->
+    'suppress', `jit(...)` -> 'jit', anything else -> ''.  The one shared
+    extraction every rule resolves callees through."""
+    if not isinstance(call, ast.Call):
+        return ""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+class Rule:
+    """Base: subclasses set rule_id/title and implement check(mod)."""
+
+    rule_id = "KTPU000"
+    title = ""
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — the run is unusable (exit 2), never
+    silently ungated."""
+
+
+class Baseline:
+    """The suppression file: JSON list of {fingerprint, rule, file, func,
+    snippet, reason}.  Matching is by fingerprint; the rest is for humans
+    reading the file.  A reason is REQUIRED — a baseline without a why is
+    just a muted alarm."""
+
+    def __init__(self, entries: Optional[List[Dict[str, str]]] = None,
+                 lenient: bool = False):
+        self.entries: List[Dict[str, str]] = list(entries or [])
+        self._by_fp: Dict[str, Dict[str, str]] = {}
+        for e in self.entries:
+            fp = e.get("fingerprint", "")
+            reason = (e.get("reason") or "").strip()
+            if not fp:
+                raise BaselineError(f"baseline entry missing fingerprint: {e}")
+            if not reason or reason.upper().startswith("TODO"):
+                if lenient:
+                    # --write-baseline re-drafting: a prior draft's TODO
+                    # entries must not dead-end the tool — they are kept
+                    # (still refused by the strict CI load)
+                    self._by_fp[fp] = e
+                    continue
+                raise BaselineError(
+                    f"baseline entry {fp} ({e.get('file', '?')}) has no "
+                    "reason — every suppression must say why"
+                )
+            self._by_fp[fp] = e
+
+    @classmethod
+    def load(cls, path: str, lenient: bool = False) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([])
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            # an unreadable baseline is an UNUSABLE run (exit 2), never a
+            # traceback that CI misreads as "findings" (exit 1)
+            raise BaselineError(f"unreadable baseline {path}: {e}")
+        if not isinstance(doc, dict) or not isinstance(doc.get("findings"), list):
+            raise BaselineError(f"baseline {path} must be {{'findings': [...]}}")
+        return cls(doc["findings"], lenient=lenient)
+
+    def match(self, f: Finding) -> Optional[str]:
+        e = self._by_fp.get(f.fingerprint)
+        return e.get("reason", "") if e is not None else None
+
+    def unused(self, findings: List[Finding],
+               ran_rules: Optional[List[str]] = None) -> List[Dict[str, str]]:
+        """Entries that matched nothing this run — stale suppressions the
+        report surfaces so fixed findings get un-baselined.  Entries for
+        rules that did NOT run (a --rules subset) are never stale: they
+        may still match on a full run."""
+        hit = {f.fingerprint for f in findings}
+        ran = set(ran_rules) if ran_rules is not None else None
+        return [
+            e for e in self.entries
+            if e["fingerprint"] not in hit
+            and (ran is None or e.get("rule", "") in ran or not e.get("rule"))
+        ]
+
+    @staticmethod
+    def draft(findings: List[Finding]) -> Dict[str, object]:
+        """--write-baseline payload: one entry per unbaselined fingerprint
+        with reason left as TODO (load() refuses TODOs, so a drafted
+        baseline cannot silently pass CI)."""
+        seen: Dict[str, Dict[str, str]] = {}
+        for f in findings:
+            if f.baselined or f.fingerprint in seen:
+                continue
+            seen[f.fingerprint] = {
+                "fingerprint": f.fingerprint, "rule": f.rule, "file": f.file,
+                "func": f.func, "snippet": f.snippet,
+                "reason": "TODO: justify or fix",
+            }
+        return {"findings": list(seen.values())}
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)  # parse/IO failures
+    files_scanned: int = 0
+    rules: List[str] = field(default_factory=list)
+    stale_baseline: List[Dict[str, str]] = field(default_factory=list)
+
+    @property
+    def unbaselined(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def exit_code(self) -> int:
+        """0 clean / 1 new findings / 2 unusable — bench/regression.py's
+        contract, so CI wires both gates identically."""
+        if self.errors:
+            return 2
+        return 1 if self.unbaselined else 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "tool": "ktpu-verify",
+            "files_scanned": self.files_scanned,
+            "rules": self.rules,
+            "findings": [f.to_dict() for f in self.findings],
+            "n_findings": len(self.findings),
+            "n_unbaselined": len(self.unbaselined),
+            "errors": self.errors,
+            "stale_baseline": self.stale_baseline,
+            "exit_code": self.exit_code,
+        }
+
+    def render_text(self) -> str:
+        out: List[str] = []
+        for f in sorted(self.findings, key=lambda f: (f.rule, f.file, f.line)):
+            out.append(f.render())
+        for e in self.errors:
+            out.append(f"ERROR {e}")
+        for e in self.stale_baseline:
+            out.append(
+                f"STALE baseline entry {e['fingerprint']} "
+                f"({e.get('rule', '?')} {e.get('file', '?')}) matched nothing "
+                "— remove it"
+            )
+        nb = len(self.unbaselined)
+        out.append(
+            f"ktpu-verify: {self.files_scanned} files, "
+            f"{len(self.findings)} findings "
+            f"({nb} unbaselined, {len(self.findings) - nb} baselined), "
+            f"{len(self.errors)} errors -> exit {self.exit_code}"
+        )
+        return "\n".join(out)
+
+
+def iter_package_files(root: str) -> List[Tuple[str, str]]:
+    """(relpath, abspath) for every .py under `root`, sorted, pycache
+    skipped.  relpath is rooted at the package name (kubernetes_tpu/...)."""
+    root = os.path.abspath(root)
+    base = os.path.basename(root)
+    out: List[Tuple[str, str]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            ap = os.path.join(dirpath, fn)
+            rp = os.path.join(base, os.path.relpath(ap, root)).replace(os.sep, "/")
+            out.append((rp, ap))
+    return out
+
+
+def load_modules(root: str) -> Tuple[List[ModuleInfo], List[str]]:
+    """Parse every module under `root`: (parsed modules, load errors).  An
+    unreadable file (I/O, syntax, null bytes, bad encoding) is an error the
+    caller reports — the one loader both analyze_package and the
+    --lock-graph dump resolve files through."""
+    mods: List[ModuleInfo] = []
+    errors: List[str] = []
+    for relpath, abspath in iter_package_files(root):
+        try:
+            with open(abspath) as f:
+                source = f.read()
+            mods.append(ModuleInfo(relpath, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            # ValueError covers UnicodeDecodeError and ast.parse's
+            # null-byte rejection — any unreadable file is exit 2, never
+            # a traceback CI misreads as exit 1
+            errors.append(f"{relpath}: {type(e).__name__}: {e}")
+    return mods, errors
+
+
+def analyze_source(source: str, relpath: str, rules: List[Rule]) -> List[Finding]:
+    """Run `rules` over one source blob — the fixture-test entry point."""
+    mod = ModuleInfo(relpath, source)
+    findings: List[Finding] = []
+    for r in rules:
+        findings.extend(r.check(mod))
+    return findings
+
+
+def analyze_package(root: str, rules: Optional[List[Rule]] = None,
+                    baseline: Optional[Baseline] = None,
+                    lockorder: bool = True) -> Report:
+    """The full pass: parse every module, run the per-module rules, then the
+    whole-package lock-order analysis (KTPU006 — skippable via lockorder=False
+    so a --rules subset really runs only what it names), then apply the
+    baseline."""
+    from .lockorder import LockOrderAnalyzer
+    from .rules import ALL_RULES
+
+    if rules is None:
+        rules = [cls() for cls in ALL_RULES]
+    report = Report(rules=[r.rule_id for r in rules]
+                    + (["KTPU006"] if lockorder else []))
+    mods, load_errors = load_modules(root)
+    report.errors.extend(load_errors)
+    report.files_scanned = len(mods)
+    for mod in mods:
+        for r in rules:
+            try:
+                report.findings.extend(r.check(mod))
+            except Exception as e:  # a rule bug must not pass as "clean"
+                report.errors.append(
+                    f"{mod.relpath}: rule {r.rule_id} crashed: "
+                    f"{type(e).__name__}: {e}"
+                )
+    # whole-package analysis: the lock-order graph needs every class at once
+    if lockorder:
+        try:
+            report.findings.extend(LockOrderAnalyzer(mods).check())
+        except Exception as e:
+            report.errors.append(
+                f"lock-order analysis crashed: {type(e).__name__}: {e}")
+    if baseline is not None:
+        for f in report.findings:
+            reason = baseline.match(f)
+            if reason is not None:
+                f.baselined = True
+                f.baseline_reason = reason
+        report.stale_baseline = baseline.unused(report.findings,
+                                                ran_rules=report.rules)
+    return report

@@ -1,0 +1,401 @@
+"""KTPU006 — static lock-order analysis over the whole package.
+
+Extracts the lock-acquisition graph from the AST:
+
+  1. **Lock inventory**: every `self.X = threading.Lock()/RLock()` (or the
+     instrumented `make_lock/make_rlock` factories — analysis/lockcheck.py)
+     inside a class body registers lock node `Class.X`, remembering
+     re-entrancy.
+  2. **Direct nesting**: inside one function, `with self.A:` containing
+     `with self.B:` yields edge A -> B.  `with store.transaction():` counts
+     as acquiring `ClusterStore._lock` (store.py documents transaction()
+     as the store's re-entrant lock).
+  3. **One-level call propagation**: a call made while holding lock A, to a
+     method m resolvable to a lock-owning class (receiver-name heuristic:
+     `store` -> ClusterStore, `queue` -> PriorityQueue, ... — the receiver
+     identifier must be a substring of a candidate class name), yields
+     A -> every lock m acquires directly (same-class `self.m()` calls are
+     closed transitively first).
+  4. **Watch fan-out**: `store.watch(self._on_event)` registers a callback
+     the store invokes UNDER its lock (`_emit` runs inside `with
+     self._lock`), so ClusterStore._lock gains an edge to every lock the
+     callback acquires — the edge family behind the PR-3 snapshot-LIST
+     race and the documented update_snapshot() ABBA comment.
+
+A cycle in the resulting digraph is a potential deadlock: two threads
+interleaving the two witness paths hang.  A self-edge on a NON-re-entrant
+lock is a guaranteed one.  The dynamic twin (KTPU_LOCK_CHECK=1 —
+analysis/lockcheck.py) validates the same property from observed runtime
+acquisition order; this static pass fires at analysis time, before any
+soak.  Heuristic resolution is deliberately conservative — a spurious edge
+is baselined with a reason, a missed deadlock is a 3 a.m. page.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import Finding, ModuleInfo, call_name
+
+_LOCK_FACTORIES = {"Lock": False, "RLock": True,
+                   "make_lock": False, "make_rlock": True}
+
+
+class _ClassInfo:
+    def __init__(self, name: str, relpath: str, node: ast.ClassDef):
+        self.name = name
+        self.relpath = relpath
+        self.node = node
+        self.locks: Dict[str, bool] = {}  # attr -> reentrant
+        # method -> locks it acquires directly (attr names)
+        self.method_locks: Dict[str, Set[str]] = {}
+        # method -> same-class methods it calls (for transitive closure)
+        self.self_calls: Dict[str, Set[str]] = {}
+        # (held lock attr) -> [(receiver ident, method, lineno)]
+        self.calls_under: Dict[str, List[Tuple[str, str, int]]] = {}
+        # direct `with A:` containing `with B:` — (held attr, acquired attr,
+        # lineno), attrs as _scan_method records them ("@store_transaction"
+        # for store.transaction())
+        self.nested: List[Tuple[str, str, int]] = []
+        # callbacks handed to <store-like>.watch(...): method names
+        self.watch_callbacks: Set[str] = set()
+
+
+def _lock_ctor(call: ast.AST) -> Optional[bool]:
+    """reentrant flag when `call` constructs a lock, else None."""
+    return _LOCK_FACTORIES.get(call_name(call))
+
+
+def _self_lock_attr(expr: ast.AST, locks: Dict[str, bool]) -> Optional[str]:
+    """`self.X` where X is a registered lock attr of this class."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and expr.attr in locks:
+        return expr.attr
+    return None
+
+
+def _is_transaction_call(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) \
+        and isinstance(expr.func, ast.Attribute) \
+        and expr.func.attr == "transaction"
+
+
+def _recv_ident(expr: ast.AST) -> str:
+    """Last identifier of a call receiver: self.store.foo() -> 'store';
+    queue.push() -> 'queue'; self.meth() -> 'self'."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+class LockOrderAnalyzer:
+    STORE_CLASS = "ClusterStore"
+    STORE_LOCK = "ClusterStore._lock"
+
+    def __init__(self, mods: List[ModuleInfo]):
+        self.mods = mods
+        self.classes: Dict[str, _ClassInfo] = {}
+
+    # --- pass 1+2: per-class inventory ---
+    def _collect(self) -> None:
+        for mod in self.mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    ci = _ClassInfo(node.name, mod.relpath, node)
+                    self._scan_class(ci)
+                    if ci.locks:
+                        # later definition with the same name wins nothing —
+                        # keep the first lock-owning one (names are unique
+                        # in this package)
+                        self.classes.setdefault(ci.name, ci)
+
+    def _scan_class(self, ci: _ClassInfo) -> None:
+        for item in ci.node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # lock attributes (any method may lazily create one)
+            for n in ast.walk(item):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                    attr = _self_lock_attr_any(n.targets[0])
+                    if attr is not None:
+                        reent = _lock_ctor(n.value)
+                        if reent is not None:
+                            ci.locks[attr] = reent
+        for item in ci.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_method(ci, item)
+
+    def _scan_method(self, ci: _ClassInfo, fn: ast.AST) -> None:
+        name = fn.name
+        ci.method_locks.setdefault(name, set())
+        ci.self_calls.setdefault(name, set())
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, ast.With):
+                # items acquire left-to-right, so `with self._x, self._y:`
+                # is the same ordering edge as nested withs — extend `held`
+                # progressively per item, not once per statement
+                new_held = held
+                for item in node.items:
+                    attr = _self_lock_attr(item.context_expr, ci.locks)
+                    if attr is None and _is_transaction_call(item.context_expr):
+                        attr = "@store_transaction"
+                    if attr is not None:
+                        ci.method_locks[name].add(attr)
+                        for h in new_held:  # the nesting IS the ordering edge
+                            ci.nested.append(
+                                (h, attr, getattr(node, "lineno", 0)))
+                        new_held = new_held + (attr,)
+                for b in node.body:
+                    walk(b, new_held)
+                return
+            if isinstance(node, ast.Call):
+                fn_expr = node.func
+                if isinstance(fn_expr, ast.Attribute):
+                    recv = _recv_ident(fn_expr.value)
+                    meth = fn_expr.attr
+                    if recv == "self":
+                        ci.self_calls[name].add(meth)
+                    if meth == "watch" and recv in ("store", "_store"):
+                        for arg in node.args:
+                            if isinstance(arg, ast.Attribute) \
+                                    and isinstance(arg.value, ast.Name) \
+                                    and arg.value.id == "self":
+                                ci.watch_callbacks.add(arg.attr)
+                    if held:
+                        for h in held:
+                            ci.calls_under.setdefault(h, []).append(
+                                (recv, meth, getattr(node, "lineno", 0)))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue  # nested defs run later, not under this hold
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+
+    # --- pass 3: same-class transitive closure of method_locks ---
+    def _close_self_calls(self) -> None:
+        for ci in self.classes.values():
+            changed = True
+            rounds = 0
+            while changed and rounds < 16:
+                changed = False
+                rounds += 1
+                for m, calls in ci.self_calls.items():
+                    for callee in calls:
+                        extra = ci.method_locks.get(callee, set())
+                        if extra - ci.method_locks[m]:
+                            ci.method_locks[m] |= extra
+                            changed = True
+
+    # --- receiver resolution ---
+    def _candidates(self, recv: str, meth: str) -> List[_ClassInfo]:
+        recv_l = recv.lstrip("_").lower()
+        if not recv_l or recv_l == "self":
+            return []
+        out = []
+        for ci in self.classes.values():
+            if recv_l in ci.name.lower() and (
+                meth in ci.method_locks or meth == "transaction"
+            ):
+                out.append(ci)
+        return out
+
+    def _lock_id(self, ci: _ClassInfo, attr: str) -> str:
+        if attr == "@store_transaction":
+            return self.STORE_LOCK
+        return f"{ci.name}.{attr}"
+
+    # --- pass 4: global edge set ---
+    def build_graph(self) -> Tuple[
+        Dict[str, Set[str]],
+        Dict[Tuple[str, str], Tuple[str, int, str]],
+        Dict[str, bool],
+    ]:
+        """(edges, witness per edge (relpath, line, description),
+        reentrancy per lock id)."""
+        self._collect()
+        self._close_self_calls()
+        reentrant: Dict[str, bool] = {self.STORE_LOCK: True}
+        for ci in self.classes.values():
+            for attr, reent in ci.locks.items():
+                reentrant[f"{ci.name}.{attr}"] = reent
+        edges: Dict[str, Set[str]] = {}
+        witness: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        def add(a: str, b: str, relpath: str, line: int, desc: str) -> None:
+            if a == b:
+                if reentrant.get(a, False):
+                    return  # re-entrant self-hold is legal
+            edges.setdefault(a, set()).add(b)
+            witness.setdefault((a, b), (relpath, line, desc))
+
+        for ci in self.classes.values():
+            for held, calls in ci.calls_under.items():
+                a = self._lock_id(ci, held)
+                for recv, meth, line in calls:
+                    if recv == "self":
+                        for attr in ci.method_locks.get(meth, set()):
+                            add(a, self._lock_id(ci, attr), ci.relpath, line,
+                                f"{ci.name}.{meth}() under {a}")
+                        continue
+                    for target in self._candidates(recv, meth):
+                        if meth == "transaction":
+                            add(a, self.STORE_LOCK, ci.relpath, line,
+                                f"{recv}.transaction() under {a}")
+                            continue
+                        for attr in target.method_locks.get(meth, set()):
+                            add(a, self._lock_id(target, attr), ci.relpath,
+                                line, f"{recv}.{meth}() -> "
+                                      f"{target.name}.{meth} under {a}")
+            # direct nesting: with A: ... with B: — recorded by the SAME
+            # walk that built calls_under (_scan_method), so one traversal
+            # serves both edge families
+            for h, a, line in ci.nested:
+                add(self._lock_id(ci, h), self._lock_id(ci, a),
+                    ci.relpath, line, f"nested with in {ci.name}")
+        self._watch_edges(add)
+        return edges, witness, reentrant
+
+    def _watch_edges(self, add) -> None:
+        store = self.classes.get(self.STORE_CLASS)
+        for ci in self.classes.values():
+            for cb in ci.watch_callbacks:
+                for attr in ci.method_locks.get(cb, set()):
+                    add(self.STORE_LOCK, self._lock_id(ci, attr),
+                        ci.relpath, getattr(ci.node, "lineno", 0),
+                        f"store.watch({ci.name}.{cb}) runs under the store "
+                        "lock (_emit)")
+        # store watch REPLAY also invokes the callback under the lock —
+        # covered by the same edge; nothing extra needed
+        _ = store
+
+    # --- cycles -> findings ---
+    def check(self) -> List[Finding]:
+        edges, witness, reentrant = self.build_graph()
+        findings: List[Finding] = []
+        for cyc in _cycles(edges):
+            if len(cyc) == 1:
+                a = cyc[0]
+                w = witness.get((a, a), ("", 0, ""))
+                findings.append(Finding(
+                    rule="KTPU006",
+                    message=f"non-reentrant lock {a} acquired while already "
+                            f"held ({w[2]}) — guaranteed self-deadlock",
+                    file=w[0], line=w[1], func="",
+                    snippet=f"self-cycle {a}",
+                ))
+                continue
+            pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+            wit = [witness.get(p) for p in pairs if p in witness]
+            loc = wit[0] if wit else ("", 0, "")
+            desc = "; ".join(
+                f"{a}->{b} ({witness[(a, b)][2]} at "
+                f"{witness[(a, b)][0]}:{witness[(a, b)][1]})"
+                for a, b in pairs if (a, b) in witness
+            )
+            findings.append(Finding(
+                rule="KTPU006",
+                message="potential lock-order inversion: "
+                        + " -> ".join(cyc + [cyc[0]]) + " — " + desc,
+                file=loc[0], line=loc[1], func="",
+                snippet="cycle " + " -> ".join(sorted(cyc)),
+            ))
+        return findings
+
+
+def _self_lock_attr_any(expr: ast.AST) -> Optional[str]:
+    """`self.X` target of an assignment (lock inventory)."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycle representatives: one per SCC with >1 node (a
+    shortest cycle through its lexically-first node), plus self-loops.
+    Deterministic output order."""
+    nodes = sorted(set(edges) | {b for bs in edges.values() for b in bs})
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        work = [(v, iter(sorted(edges.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            cur, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(edges.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[cur] = min(low[cur], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                p = work[-1][0]
+                low[p] = min(low[p], low[cur])
+            if low[cur] == index[cur]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == cur:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in nodes:
+        if v not in index:
+            strong(v)
+
+    out: List[List[str]] = []
+    for v in nodes:  # self-loops
+        if v in edges.get(v, ()):
+            out.append([v])
+    for comp in sccs:
+        cyc = _shortest_cycle(comp[0], set(comp), edges)
+        if cyc:
+            out.append(cyc)
+    return out
+
+
+def _shortest_cycle(start: str, comp: Set[str],
+                    edges: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """BFS back to `start` inside its SCC."""
+    from collections import deque
+
+    q = deque([(start, [start])])
+    seen = {start}
+    while q:
+        cur, path = q.popleft()
+        for nxt in sorted(edges.get(cur, ())):
+            if nxt not in comp:
+                continue
+            if nxt == start:
+                return path
+            if nxt not in seen:
+                seen.add(nxt)
+                q.append((nxt, path + [nxt]))
+    return None

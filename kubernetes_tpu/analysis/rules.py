@@ -1,0 +1,549 @@
+"""ktpu-verify rules KTPU001..KTPU005 — the codebase's own invariants.
+
+Each rule is the executable form of a prose rule from PARITY.md / review
+memory (the mapping table lives in PARITY.md §"Static analysis"):
+
+  KTPU001 kill-safety        crash-consistency invariant 3: no in-process
+                             code may swallow ProcessKilled
+  KTPU002 snapshot-LIST      the PR-3 "dict changed size during iteration"
+                             rule: ClusterStore live dicts are iterated only
+                             via the lock-consistent list_*() snapshots or
+                             under store.transaction()
+  KTPU003 donation-aliasing  incremental-cache invariant 4: resident
+                             IncState/HoistCache buffers never ride a
+                             donated argument position
+  KTPU004 determinism        placement decisions in the pure paths (ops/,
+                             api/delta.py) must not read wall clocks,
+                             unseeded RNGs, or unordered-set iteration
+  KTPU005 cheap-gate         O(P) builds feeding spans are gated on
+                             tracer.enabled (the PR-6 contract)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, ModuleInfo, Rule, call_name
+
+
+# --- shared AST helpers ---
+def _names_in(expr: Optional[ast.AST]) -> Set[str]:
+    """Last-segment identifiers mentioned in an exception-type expression:
+    `chaos.ProcessKilled` -> {'chaos', 'ProcessKilled'}."""
+    out: Set[str] = set()
+    if expr is None:
+        return out
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _walk_no_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Descendants of `node`, not descending into nested function/class
+    defs (their control flow is not the enclosing handler's)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_no_defs(child)
+
+
+def _stmts_walk(stmts: Sequence[ast.stmt]) -> Iterable[ast.AST]:
+    for s in stmts:
+        yield s
+        yield from _walk_no_defs(s)
+
+
+def _rebinds(body: Sequence[ast.stmt], name: str) -> bool:
+    """Is `name` assigned anywhere in the handler body?  A rebound `as e`
+    no longer names the caught exception."""
+    for n in _stmts_walk(body):
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign, ast.NamedExpr,
+                            ast.For)):
+            targets = [n.target]
+        for tgt in targets:
+            for t2 in ast.walk(tgt):
+                if isinstance(t2, ast.Name) and t2.id == name:
+                    return True
+    return False
+
+
+def _is_transparent(handler: ast.ExceptHandler) -> bool:
+    """True when the handler unconditionally re-raises THE SAME exception:
+    its LAST top-level statement is a bare `raise` (or `raise e` where `e`
+    is the handler's own un-rebound `as` binding — same object, ProcessKilled
+    propagates unchanged) and nothing in the body can exit another way
+    (return/break/continue) or substitute a different exception
+    (`raise Other(...)` converts ProcessKilled into something the downstream
+    `except Exception` recoveries will catch) — bookkeeping-then-reraise
+    (checkpoint.py's tmp cleanup, _kill_point's dead-latch) stays legal."""
+    body = handler.body
+    if not body:
+        return False
+
+    def reraises_same(r: ast.Raise) -> bool:
+        if r.exc is None:
+            return True
+        return (handler.name is not None
+                and isinstance(r.exc, ast.Name)
+                and r.exc.id == handler.name
+                and not _rebinds(body, handler.name))
+
+    last = body[-1]
+    if not (isinstance(last, ast.Raise) and reraises_same(last)):
+        return False
+    for n in _stmts_walk(body):
+        if isinstance(n, (ast.Return, ast.Break, ast.Continue)):
+            return False
+        if isinstance(n, ast.Raise) and not reraises_same(n):
+            return False
+    return True
+
+
+class KillSafetyRule(Rule):
+    """KTPU001 — no handler may swallow ProcessKilled.
+
+    ProcessKilled is a BaseException precisely so the 21 `except Exception`
+    recovery sites stay transparent to it BY CONSTRUCTION; the holes this
+    rule closes are (a) bare `except:` / `except BaseException:` that do not
+    unconditionally re-raise, (b) catching ProcessKilled anywhere outside
+    the restart drivers, and (c) contextlib.suppress over either."""
+
+    rule_id = "KTPU001"
+    title = "kill-safety: ProcessKilled must escape in-process handlers"
+
+    # the restart drivers: the ONLY code allowed to answer a ProcessKilled
+    # with something other than propagation (they run the crash-restart /
+    # leader-takeover protocol — PARITY.md crash-consistency invariants)
+    ALLOWLIST: Set[Tuple[str, str]] = {
+        ("kubernetes_tpu/scheduler/scheduler.py", "run_restartable"),
+        ("kubernetes_tpu/scheduler/scheduler.py", "run_ha_restartable"),
+    }
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Try):
+                findings.extend(self._check_try(mod, node))
+            elif isinstance(node, ast.Call):
+                findings.extend(self._check_suppress(mod, node))
+        return findings
+
+    def _allowlisted(self, mod: ModuleInfo, node: ast.AST) -> bool:
+        # FULL qualname match: the drivers are module-level functions, so a
+        # future `SomeClass.run_restartable` method elsewhere in the file
+        # does not inherit the exemption
+        return (mod.relpath, mod.qualname(node)) in self.ALLOWLIST
+
+    def _check_try(self, mod: ModuleInfo, node: ast.Try) -> List[Finding]:
+        findings: List[Finding] = []
+        kill_guarded = False  # an earlier transparent ProcessKilled handler
+        for h in node.handlers:
+            names = _names_in(h.type)
+            bare = h.type is None
+            catches_base = bare or "BaseException" in names
+            catches_kill = "ProcessKilled" in names
+            if catches_kill and _is_transparent(h):
+                kill_guarded = True
+                continue
+            if catches_kill and not self._allowlisted(mod, h):
+                findings.append(mod.finding(
+                    self.rule_id, h,
+                    "catches ProcessKilled outside the restart-driver "
+                    "allowlist — only restart drivers may answer a kill",
+                ))
+                continue
+            if catches_base and not kill_guarded and not _is_transparent(h) \
+                    and not self._allowlisted(mod, h):
+                what = "bare except:" if bare else "except BaseException"
+                findings.append(mod.finding(
+                    self.rule_id, h,
+                    f"{what} can swallow ProcessKilled — re-raise "
+                    "unconditionally, narrow to Exception, or guard with a "
+                    "transparent `except ProcessKilled: raise` first",
+                ))
+        return findings
+
+    def _check_suppress(self, mod: ModuleInfo, call: ast.Call) -> List[Finding]:
+        if call_name(call) != "suppress":
+            return []
+        bad = {"BaseException", "ProcessKilled"}
+        for arg in call.args:
+            if _names_in(arg) & bad and not self._allowlisted(mod, call):
+                return [mod.finding(
+                    self.rule_id, call,
+                    "contextlib.suppress over BaseException/ProcessKilled "
+                    "swallows the kill latch",
+                )]
+        return []
+
+
+# --- KTPU002 ---
+# the workload alias properties (store.replicasets/...) return the SAME live
+# dicts as store.objects[kind] — iterating them races the writers identically
+_ALIAS_KIND = {"replicasets": "ReplicaSet", "deployments": "Deployment",
+               "jobs": "Job"}
+_STORE_TABLES = ("pods", "nodes", "pvs", "pvcs", "pdbs") \
+    + tuple(_ALIAS_KIND)
+_ITER_BUILTINS = {
+    "list", "sorted", "set", "tuple", "sum", "any", "all", "max", "min",
+    "len", "frozenset", "enumerate", "iter", "dict",
+}
+
+
+def _store_like(e: ast.AST) -> bool:
+    """`store` / `self.store` / `self._store` / `x.store` receivers."""
+    if isinstance(e, ast.Name):
+        return e.id in ("store", "_store")
+    if isinstance(e, ast.Attribute):
+        return e.attr in ("store", "_store")
+    return False
+
+
+def _store_table(e: ast.AST) -> Optional[str]:
+    """The table name when `e` is a ClusterStore live-dict expression:
+    store.pods / self.store.nodes / store.objects / store.objects[kind]."""
+    if isinstance(e, ast.Attribute) and e.attr in _STORE_TABLES \
+            and _store_like(e.value):
+        return e.attr
+    if isinstance(e, ast.Attribute) and e.attr == "objects" \
+            and _store_like(e.value):
+        return "objects"
+    if isinstance(e, ast.Subscript):
+        v = e.value
+        if isinstance(v, ast.Attribute) and v.attr == "objects" \
+                and _store_like(v.value):
+            return "objects[...]"
+    return None
+
+
+class SnapshotListRule(Rule):
+    """KTPU002 — no iteration/len over ClusterStore live dicts outside
+    store.py: use the lock-consistent list_pods()/list_nodes()/... snapshots
+    (or hold store.transaction() for a multi-object read-modify-write).
+    Point reads (d.get(k), d[k], `k in d`) stay legal — atomic under
+    CPython.  Functions whose name ends in `_locked` are exempt by
+    convention: the suffix asserts the caller holds store.transaction()
+    (the reference's `...Locked` Go naming).  This is the enforced form of
+    the PR-3 fix for the "dictionary changed size during iteration" soak
+    race."""
+
+    rule_id = "KTPU002"
+    title = "snapshot-LIST: no live-dict iteration over ClusterStore tables"
+
+    EXEMPT_FILES = {"kubernetes_tpu/scheduler/store.py"}
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        if mod.relpath in self.EXEMPT_FILES:
+            return []
+        findings: List[Finding] = []
+        flagged: Set[int] = set()
+
+        def flag(node: ast.AST, table: str, how: str) -> None:
+            if id(node) in flagged or self._in_transaction(mod, node):
+                return
+            qual = mod.qualname(node)
+            if qual.split(".")[-1].endswith("_locked"):
+                return  # convention: caller holds store.transaction()
+            flagged.add(id(node))
+            if table.startswith("objects"):
+                api = "list_objects(kind)"
+            elif table in _ALIAS_KIND:
+                api = f'list_objects("{_ALIAS_KIND[table]}")'
+            else:
+                api = f"list_{'node_names' if table == 'nodes' and how == 'len' else table}()"
+            findings.append(mod.finding(
+                self.rule_id, node,
+                f"{how} over live ClusterStore.{table} races the store's "
+                f"writers — use the lock-consistent store.{api} snapshot "
+                "or hold store.transaction()",
+            ))
+
+        for node in ast.walk(mod.tree):
+            # E.values()/.items()/.keys(): a live view is only ever built to
+            # iterate — flag in ANY context (aliasing included)
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("values", "items", "keys"):
+                table = _store_table(node.func.value)
+                if table is not None:
+                    flag(node, table, f".{node.func.attr}() view")
+                continue
+            if isinstance(node, ast.For):
+                table = _store_table(node.iter)
+                if table is not None:
+                    flag(node.iter, table, "iteration")
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    table = _store_table(gen.iter)
+                    if table is not None:
+                        flag(gen.iter, table, "iteration")
+            elif isinstance(node, ast.Starred):
+                table = _store_table(node.value)
+                if table is not None:
+                    flag(node.value, table, "unpacking")
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id in _ITER_BUILTINS:
+                for arg in node.args:
+                    table = _store_table(arg)
+                    if table is not None:
+                        how = "len" if node.func.id == "len" else \
+                            f"{node.func.id}()"
+                        flag(arg, table, how)
+        return findings
+
+    @staticmethod
+    def _in_transaction(mod: ModuleInfo, node: ast.AST) -> bool:
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call) \
+                            and isinstance(ce.func, ast.Attribute) \
+                            and ce.func.attr == "transaction":
+                        return True
+        return False
+
+
+# --- KTPU003 ---
+_RESIDENT_RE = re.compile(r"(^|_)(inc|hoist)(_|$)|^IncState$|^HoistCache$")
+
+
+def _mentions_resident(expr: ast.AST) -> Optional[str]:
+    for n in ast.walk(expr):
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        if ident and _RESIDENT_RE.search(ident):
+            return ident
+    return None
+
+
+class DonationAliasingRule(Rule):
+    """KTPU003 — incremental-cache invariant 4 (PARITY.md): the resident
+    IncState / HoistCache buffers ride a SEPARATE, never-donated kernel
+    argument.  Flags (a) a donated argument position mentioning a resident
+    buffer identifier, and (b) any new `donate_argnums` wrapper declared
+    outside the two audited donation modules."""
+
+    rule_id = "KTPU003"
+    title = "donation-aliasing: resident cache buffers never donate"
+
+    # wrapper -> donated positional indices (ops/assign.py donate_argnums)
+    DONATED_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+        "schedule_batch_donated": (0,),
+        "schedule_batch_ordinals_donated": (0,),
+    }
+    DONATION_MODULES = {
+        "kubernetes_tpu/ops/assign.py",
+        "kubernetes_tpu/parallel/sharded.py",
+    }
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in self.DONATED_WRAPPERS:
+                for idx in self.DONATED_WRAPPERS[name]:
+                    if idx < len(node.args):
+                        hit = _mentions_resident(node.args[idx])
+                        if hit:
+                            findings.append(mod.finding(
+                                self.rule_id, node,
+                                f"donated argument {idx} of {name} mentions "
+                                f"resident buffer {hit!r} — the incremental "
+                                "cache must ride the separate non-donated "
+                                "argument (PARITY.md invariant 4)",
+                            ))
+            donates = any(
+                kw.arg == "donate_argnums"
+                and not (isinstance(kw.value, (ast.Tuple, ast.List))
+                         and not kw.value.elts)  # =() donates nothing
+                for kw in node.keywords
+            )
+            if donates and mod.relpath not in self.DONATION_MODULES:
+                findings.append(mod.finding(
+                    self.rule_id, node,
+                    "donate_argnums outside the audited donation modules "
+                    "(ops/assign.py, parallel/sharded.py) — new donation "
+                    "sites must land where the aliasing audit lives",
+                ))
+        return findings
+
+
+# --- KTPU004 ---
+class DeterminismRule(Rule):
+    """KTPU004 — the pure placement paths (ops/, api/delta.py) must be a
+    function of the encoded cluster alone: no wall clocks, no unseeded
+    global RNGs, no iteration over unordered set expressions feeding
+    decisions.  (Spans/benchmarks use perf_counter, which stays legal —
+    it times, it never decides.)"""
+
+    rule_id = "KTPU004"
+    title = "determinism: pure paths read no clocks/unseeded RNG/set order"
+
+    SCOPE_PREFIXES = ("kubernetes_tpu/ops/",)
+    SCOPE_FILES = {"kubernetes_tpu/api/delta.py"}
+    SEEDED_OK = {"Random", "default_rng", "PRNGKey", "key"}
+
+    def _in_scope(self, relpath: str) -> bool:
+        return relpath in self.SCOPE_FILES or any(
+            relpath.startswith(p) for p in self.SCOPE_PREFIXES
+        )
+
+    def _seeded(self, node: ast.Call, fn: ast.Attribute) -> bool:
+        """A seedable constructor is only legal WITH a seed: an argless
+        `Random()` / `default_rng()` is entropy-seeded — nondeterministic."""
+        return fn.attr in self.SEEDED_OK and bool(node.args or node.keywords)
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        if not self._in_scope(mod.relpath):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                fn = node.func
+                recv = fn.value
+                if isinstance(recv, ast.Name) and recv.id == "time" \
+                        and fn.attr in ("time", "time_ns"):
+                    findings.append(mod.finding(
+                        self.rule_id, node,
+                        "wall clock in a pure path — decisions must not "
+                        "depend on time.time()",
+                    ))
+                if isinstance(recv, ast.Name) and recv.id == "random" \
+                        and not self._seeded(node, fn):
+                    findings.append(mod.finding(
+                        self.rule_id, node,
+                        f"unseeded global random.{fn.attr}() in a pure path "
+                        "— use a seeded random.Random(seed) instance",
+                    ))
+                if isinstance(recv, ast.Attribute) and recv.attr == "random" \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id in ("np", "numpy") \
+                        and not self._seeded(node, fn):
+                    findings.append(mod.finding(
+                        self.rule_id, node,
+                        f"global np.random.{fn.attr}() in a pure path — "
+                        "use a seeded Generator (np.random.default_rng(seed))",
+                    ))
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters = [node.iter]
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters = [g.iter for g in node.generators]
+            for it in iters:
+                if isinstance(it, (ast.Set, ast.SetComp)) or (
+                    isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")
+                ):
+                    findings.append(mod.finding(
+                        self.rule_id, it,
+                        "iterating an unordered set expression in a pure "
+                        "path — wrap in sorted() so placement order is "
+                        "deterministic",
+                    ))
+        return findings
+
+
+# --- KTPU005 ---
+def _mentions_gate(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr == "enabled":
+            return True
+        if isinstance(n, ast.Name) and (
+            "enabled" in n.id or "trac" in n.id
+        ):
+            return True
+    return False
+
+
+class CheapGateRule(Rule):
+    """KTPU005 — the PR-6 cheap-gate contract: an O(P) comprehension built
+    inside a tracer call (record_span and friends) must sit under a
+    `tracer.enabled` gate — an enclosing `if`, a conditional expression, or
+    a function-level early-return guard — so tracing-off runs never pay a
+    per-pod build."""
+
+    rule_id = "KTPU005"
+    title = "cheap-gate: O(P) span builds gated on tracer.enabled"
+
+    TRACER_METHODS = {"record_span", "span", "span_for_pod"}
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.TRACER_METHODS
+                    and self._tracer_recv(node.func.value)):
+                continue
+            has_comp = any(
+                isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp))
+                for arg in (list(node.args)
+                            + [kw.value for kw in node.keywords])
+                for n in ast.walk(arg)
+            )
+            if has_comp and not self._gated(mod, node):
+                findings.append(mod.finding(
+                    self.rule_id, node,
+                    "O(P) comprehension built inside a tracer call without "
+                    "a tracer.enabled gate — tracing-off runs pay it "
+                    "(PR-6 cheap-gate contract)",
+                ))
+        return findings
+
+    @staticmethod
+    def _tracer_recv(recv: ast.AST) -> bool:
+        for n in ast.walk(recv):
+            ident = n.id if isinstance(n, ast.Name) else (
+                n.attr if isinstance(n, ast.Attribute) else "")
+            if ident and ("tracer" in ident or ident == "tr"):
+                return True
+        return False
+
+    def _gated(self, mod: ModuleInfo, node: ast.AST) -> bool:
+        # enclosing if/while/ternary whose test mentions an enabled gate
+        enclosing_fn: Optional[ast.AST] = None
+        for anc in mod.ancestors(node):
+            if isinstance(anc, (ast.If, ast.While, ast.IfExp)) \
+                    and _mentions_gate(anc.test):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and enclosing_fn is None:
+                enclosing_fn = anc
+        # function-level early-return guard before this call:
+        #   if not self.tracer.enabled: return ...
+        if enclosing_fn is not None:
+            for stmt in enclosing_fn.body:
+                if getattr(stmt, "lineno", 10**9) >= getattr(node, "lineno", 0):
+                    break
+                if isinstance(stmt, ast.If) and _mentions_gate(stmt.test) \
+                        and stmt.body \
+                        and isinstance(stmt.body[-1], (ast.Return, ast.Raise)):
+                    return True
+        return False
+
+
+ALL_RULES = [
+    KillSafetyRule,
+    SnapshotListRule,
+    DonationAliasingRule,
+    DeterminismRule,
+    CheapGateRule,
+]

@@ -427,7 +427,7 @@ def run_churn_workload(
     t0 = time.perf_counter()
     sched.run_until_idle()
     for r in range(rounds):
-        bound = [p for p in store.pods.values() if p.node_name]
+        bound = [p for p in store.list_pods() if p.node_name]
         if not bound:
             break  # nothing scheduled: nothing to churn
         k = min(len(bound), max(1, int(len(bound) * churn_fraction)))
@@ -621,12 +621,39 @@ def main(argv=None) -> None:
                          "'*,!kill.*' = everything else; '!g' excludes).  "
                          "Kill storms default KTPU_CHECKPOINT_DIR to a temp "
                          "dir so restarts replay a real checkpoint")
+    ap.add_argument("--verify", action="store_true",
+                    help="run the ktpu-verify static-analysis pass "
+                         "(python -m kubernetes_tpu.analysis) before the "
+                         "workload and embed its JSON report in the "
+                         "artifact; exits with the analyzer's code (1 "
+                         "unbaselined findings / 2 unusable) on failure")
     args = ap.parse_args(argv)
     if args.chaos_sites and args.chaos is None:
         ap.error("--chaos-sites requires --chaos (it shapes the seeded storm)")
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
                  "with the host-span trace)")
+    # --verify: the hack/verify-* analog gates the bench run itself — a
+    # perf artifact produced by a package that fails its own invariants
+    # is not evidence.  The report rides the artifact; failure exits with
+    # the analyzer's 1/2 code BEFORE any workload spends device time.
+    verify_block = None
+    if args.verify:
+        from ..analysis.__main__ import run_verify
+        from ..analysis.engine import BaselineError
+
+        try:
+            verify_report = run_verify()
+        except BaselineError as e:
+            print(f"ktpu-verify: unusable baseline: {e}", file=sys.stderr)
+            sys.exit(2)
+        verify_block = verify_report.to_dict()
+        print(f"ktpu-verify: {verify_report.files_scanned} files, "
+              f"{len(verify_report.unbaselined)} unbaselined findings",
+              file=sys.stderr)
+        if verify_report.exit_code != 0:
+            print(verify_report.render_text(), file=sys.stderr)
+            sys.exit(verify_report.exit_code)
     # run-start reset (scheduler/metrics.py — reset_run_state): route
     # counters are per-run; back-to-back harness invocations in one
     # process must not report each other's kernel routes, metrics or spans
@@ -707,6 +734,18 @@ def main(argv=None) -> None:
         rep["sites"] = sorted({f.site for f in inj.plan.faults})
         return rep
 
+    def _stamp_analysis(doc):
+        """ktpu-verify blocks on the artifact: the embedded static-analysis
+        report (--verify) and, under KTPU_LOCK_CHECK=1, the runtime
+        lock-order graph observed during the run — a storm that closed a
+        cycle ships the witnesses next to its chaos counts."""
+        if verify_block is not None:
+            doc["verify"] = verify_block
+        from ..analysis import lockcheck
+
+        if lockcheck.enabled():
+            doc["lock_check"] = lockcheck.report()
+
     if args.stream:
         waves = [
             workloads.heterogeneous(2000, 5000, seed=s) for s in range(args.stream)
@@ -728,7 +767,11 @@ def main(argv=None) -> None:
             _export_trace(collector, f"{base}.stream.trace.json")
         if inj is not None:
             out["chaos"] = _chaos_report()
-        print(json.dumps(out))
+        _stamp_analysis(out)
+        blob = json.dumps(out)
+        print(blob)
+        if args.out:  # same artifact contract as the snapshot rounds
+            open(args.out, "w").write(blob + "\n")
         return
     if args.config:
         text = open(args.config).read()
@@ -747,6 +790,7 @@ def main(argv=None) -> None:
     doc = {"perfdata": data}
     if inj is not None:
         doc["chaos"] = _chaos_report()
+    _stamp_analysis(doc)
     out = json.dumps(doc, indent=2)
     if args.out:
         open(args.out, "w").write(out)

@@ -81,11 +81,11 @@ def _parity_cross_check(n_nodes: int = 50, n_pre: int = 12) -> bool:
         results.append((
             sorted(
                 (p.name, p.nominated_node_name)
-                for p in store.pods.values()
+                for p in store.list_pods()
                 if p.labels.get("app") == "hi"
             ),
             sorted(
-                p.name for p in store.pods.values()
+                p.name for p in store.list_pods()
                 if p.labels.get("app") == "filler"
             ),
         ))
@@ -105,13 +105,13 @@ def main() -> None:
     sched.run_until_idle()
     wall = time.perf_counter() - t0
     nominated = sum(
-        1 for p in store.pods.values() if p.nominated_node_name
+        1 for p in store.list_pods() if p.nominated_node_name
     )
     # one "Preempted" event per successful preemptION; victims counted as
     # fillers actually removed from the store
     preemptions = len(sched.events.by_reason("Preempted"))
     victims = 2 * n_nodes - sum(
-        1 for p in store.pods.values() if p.labels.get("app") == "filler"
+        1 for p in store.list_pods() if p.labels.get("app") == "filler"
     )
     print(
         json.dumps(

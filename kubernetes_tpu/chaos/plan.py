@@ -42,12 +42,12 @@ from __future__ import annotations
 import contextlib
 import os
 import random
-import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+from ..analysis.lockcheck import make_lock
 
 # site -> the actions a seeded plan may draw for it
 SITE_ACTIONS: Dict[str, Tuple[str, ...]] = {
@@ -257,7 +257,7 @@ class ChaosInjector:
         self.plan = plan
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer if tracer is not None else Tracer(component="chaos")
-        self._lock = threading.Lock()
+        self._lock = make_lock("ChaosInjector._lock")
         self.counts: Dict[str, int] = {}
 
     def poke(self, site: str, tracer=None, metrics=None, **attrs) -> Optional[Fault]:

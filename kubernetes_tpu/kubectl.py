@@ -287,7 +287,7 @@ class Kubectl:
             store = self.api.store
             for d in objs:
                 ready = sum(
-                    rs.ready_replicas for rs in store.objects["ReplicaSet"].values()
+                    rs.ready_replicas for rs in store.list_objects("ReplicaSet")
                     if any(ref.uid == d.uid for ref in rs.owner_references))
                 rows.append([d.name, f"{ready}/{d.replicas}"])
             return _fmt_table(["NAME", "READY"], rows)
@@ -477,8 +477,8 @@ class Kubectl:
         store = self.api.store
         # fresh PDB status before charging evictions
         DisruptionController(store).tick()
-        budgets = {k: copy.copy(p) for k, p in store.pdbs.items()}
-        for pod in list(store.pods.values()):
+        budgets = {p.key: copy.copy(p) for p in store.list_pdbs()}
+        for pod in store.list_pods():
             if pod.node_name != name:
                 continue
             if any(ref.kind == "DaemonSet" for ref in pod.owner_references):
@@ -564,13 +564,13 @@ class Kubectl:
         store = self.api.store
         if what == "Node":
             used: Dict[str, Dict[str, int]] = {}
-            for p in store.pods.values():
+            for p in store.list_pods():
                 if p.node_name:
                     u = used.setdefault(p.node_name, {})
                     for r, q in p.requests.items():
                         u[r] = u.get(r, 0) + q
             rows = []
-            for n in sorted(store.nodes.values(), key=lambda n: n.name):
+            for n in sorted(store.list_nodes(), key=lambda n: n.name):
                 u = used.get(n.name, {})
                 cpu, mem = u.get("cpu", 0), u.get("memory", 0)
                 ca, ma = n.allocatable.get("cpu", 0), n.allocatable.get("memory", 0)
@@ -582,7 +582,7 @@ class Kubectl:
         if what == "Pod":
             ns = self._ns(flags)
             rows = [[p.name, p.requests.get("cpu", 0), p.requests.get("memory", 0)]
-                    for p in sorted(store.pods.values(), key=lambda p: p.name)
+                    for p in sorted(store.list_pods(), key=lambda p: p.name)
                     if ns is None or p.namespace == ns]
             return _fmt_table(["NAME", "CPU(req)", "MEMORY(req)"], rows)
         raise KubectlError("top supports `nodes` and `pods`")
@@ -602,7 +602,7 @@ class Kubectl:
         ns = self._ns(flags) or "default"
         d = self._get_required("Deployment", ns, name)
         store = self.api.store
-        owned = [rs for rs in store.objects["ReplicaSet"].values()
+        owned = [rs for rs in store.list_objects("ReplicaSet")
                  if any(ref.uid == d.uid for ref in rs.owner_references)]
         ready = sum(rs.ready_replicas for rs in owned)
         if ready >= d.replicas and all(

@@ -36,6 +36,7 @@ import numpy as np
 from ..api import types as t
 from ..api.snapshot import Snapshot
 from . import tpuscore_pb2 as pb
+from ..analysis.lockcheck import make_lock
 from .convert import (
     clone_pod,
     node_from_proto,
@@ -107,8 +108,8 @@ class _Engine:
     def __init__(self, warmup_threshold: int = 4_000_000):
         from ..scheduler.metrics import Metrics
 
-        self._lock = threading.Lock()  # device owner
-        self._state_lock = threading.Lock()  # session bookkeeping
+        self._lock = make_lock("_Engine._lock")  # device owner
+        self._state_lock = make_lock("_Engine._state_lock")  # session bookkeeping
         self._sessions: Dict[str, _Session] = {}  # insertion == LRU order
         self.warmup_threshold = warmup_threshold
         self._compiled: set = set()  # coarse (P_bucket, N_bucket, gang) shapes

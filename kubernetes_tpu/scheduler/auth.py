@@ -70,7 +70,7 @@ class RBACAuthorizer:
         """Yield (role, scope_namespace) pairs the user holds for requests in
         `namespace` — the VisitRulesFor walk."""
         roles: Dict[str, c.Role] = self.store.objects["Role"]  # type: ignore[assignment]
-        bindings = self.store.objects["RoleBinding"].values()
+        bindings = self.store.list_objects("RoleBinding")
         for rb in bindings:  # type: ignore[assignment]
             if not any(self._subject_matches(s, user) for s in rb.subjects):
                 continue

@@ -9,7 +9,6 @@ api.Snapshot the encoder and the CPU path both consume.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional, Set
 
 from .. import chaos
@@ -17,11 +16,12 @@ from ..api import types as t
 from ..api.snapshot import Snapshot
 from .framework import NodeInfo
 from .store import ClusterStore, Event, replace_pod_nodename
+from ..analysis.lockcheck import make_lock
 
 
 class SchedulerCache:
     def __init__(self, store: ClusterStore):
-        self._lock = threading.Lock()
+        self._lock = make_lock("SchedulerCache._lock")
         # crash-consistency hook (scheduler.py — _checkpoint_state): invoked
         # AFTER every assumed-ledger mutation, outside the cache lock, so the
         # reservation is durable before the bind path proceeds.  None = no

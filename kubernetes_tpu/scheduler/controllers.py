@@ -122,7 +122,7 @@ class ReplicaSetController:
             self.store.update_workload("ReplicaSet", replace(rs, ready_replicas=ready))
 
     def tick(self) -> None:
-        for rs in list(self.store.replicasets.values()):
+        for rs in self.store.list_objects("ReplicaSet"):
             self.sync(rs)
 
 
@@ -164,7 +164,7 @@ class DeploymentController:
         new_name = f"{d.name}-{hash_}"
         mine = [
             rs
-            for rs in self.store.replicasets.values()
+            for rs in self.store.list_objects("ReplicaSet")
             if rs.namespace == d.namespace
             and any(r.uid == d.uid for r in rs.owner_references)
         ]
@@ -223,7 +223,7 @@ class DeploymentController:
                 self.store.delete_workload("ReplicaSet", rs.key)
 
     def tick(self) -> None:
-        for d in list(self.store.deployments.values()):
+        for d in self.store.list_objects("Deployment"):
             self.sync(d)
 
 
@@ -294,7 +294,7 @@ class JobController:
             )
 
     def tick(self) -> None:
-        for job in list(self.store.jobs.values()):
+        for job in self.store.list_objects("Job"):
             self.sync(job)
 
 
@@ -486,7 +486,8 @@ class DaemonSetController:
                 if target:
                     have[target] = pod
         want = {
-            name for name, node in self.store.nodes.items() if self._eligible(ds, node)
+            node.name for node in self.store.list_nodes()
+            if self._eligible(ds, node)
         }
         for name in sorted(want - set(have)):
             tmpl = ds.template or t.Pod(name="x")
@@ -550,7 +551,7 @@ class CronJobController:
             return
         active = [
             j
-            for j in self.store.jobs.values()
+            for j in self.store.list_objects("Job")
             if j.namespace == cj.namespace
             and any(r.uid == cj.uid for r in j.owner_references)
             and not j.complete
@@ -717,7 +718,7 @@ class TTLAfterFinishedController:
 
     def tick(self) -> None:
         now = self.clock.now()
-        for job in list(self.store.jobs.values()):
+        for job in self.store.list_objects("Job"):
             if (
                 job.ttl_seconds_after_finished is not None
                 and job.completion_time >= 0

@@ -11,11 +11,11 @@ events` lists.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional
+from ..analysis.lockcheck import make_lock
 
 
 @dataclass(frozen=True)
@@ -30,7 +30,7 @@ class EventRecorder:
     def __init__(self, capacity: int = 100_000, store=None,
                  publish_limit: int = 10_000, publish_qps: float = 200.0,
                  publish_burst: int = 512):
-        self._lock = threading.Lock()
+        self._lock = make_lock("EventRecorder._lock")
         self.events: List[SchedulingEvent] = []
         self.capacity = capacity
         self._store = store

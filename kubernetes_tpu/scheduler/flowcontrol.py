@@ -19,6 +19,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..api import cluster as c
 from .store import ClusterStore
+from ..analysis.lockcheck import make_lock
 
 
 class RequestRejected(Exception):
@@ -148,11 +149,9 @@ class APFController:
     total_concurrency is divided between levels by concurrency_shares."""
 
     def __init__(self, store: ClusterStore, total_concurrency: int = 600):
-        import threading
-
         self.store = store
         self.total_concurrency = total_concurrency
-        self._lock = threading.Lock()  # guards all queue-set state
+        self._lock = make_lock("APFController._lock")  # guards all queue-set state
         if not store.objects["PriorityLevelConfiguration"]:
             for plc in DEFAULT_LEVELS:
                 store.add_object("PriorityLevelConfiguration", plc)

@@ -22,13 +22,13 @@ from __future__ import annotations
 
 import json
 import sys
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, Optional, Tuple
 
 from .tracing import current_span
+from ..analysis.lockcheck import make_lock
 
 
 @dataclass(frozen=True)
@@ -68,7 +68,7 @@ class Logger:
             self.verbosity = verbosity
             self.ring: Deque[Entry] = deque(maxlen=10_000)
             self._sink = sink
-            self._lock = threading.Lock()
+            self._lock = make_lock("Logger._lock")
         self._ctx = _ctx
 
     # -- klog surface --

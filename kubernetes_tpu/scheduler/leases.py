@@ -198,7 +198,8 @@ class NodeLifecycleController:
         """Reconcile once; returns uids of pods evicted this pass."""
         now = self.leases.clock.now()
         evicted: List[str] = []
-        for name, node in list(self.store.nodes.items()):
+        for node in self.store.list_nodes():
+            name = node.name
             lease = self.leases.get(f"node/{name}")
             stale = lease is None or now > lease.renew_time + self.grace_s
             has_taint = any(tn.key == UNREACHABLE_TAINT_KEY for tn in node.taints)
@@ -217,7 +218,8 @@ class NodeLifecycleController:
                 self.store.update_node(node2)
                 self._tainted_at.pop(name, None)
         # taint-based eviction (NoExecute + tolerationSeconds)
-        for uid, pod in list(self.store.pods.items()):
+        for pod in self.store.list_pods():
+            uid = pod.uid
             if not pod.node_name:
                 continue
             tainted = self._tainted_at.get(pod.node_name)

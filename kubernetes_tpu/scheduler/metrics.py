@@ -50,9 +50,9 @@ from __future__ import annotations
 
 import bisect
 import math
-import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Tuple
+from ..analysis.lockcheck import make_lock
 
 try:
     from prometheus_client import Counter, Gauge, Histogram, REGISTRY
@@ -92,7 +92,7 @@ class StreamingHist:
         self.sum: float = 0.0
         self.min: float = math.inf
         self.max: float = -math.inf
-        self._lock = threading.Lock()
+        self._lock = make_lock("StreamingHist._lock")
 
     def observe(self, v: float, n: int = 1) -> None:
         """Record `v` (n times — a wave of identical per-pod samples costs
@@ -236,7 +236,7 @@ class Metrics:
 
     def __init__(self, prometheus: bool = False):
         # counters/gauges are bumped from binding-cycle worker threads too
-        self._lock = threading.Lock()
+        self._lock = make_lock("Metrics._lock")
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = defaultdict(float)
         self.hists: Dict[str, StreamingHist] = defaultdict(StreamingHist)

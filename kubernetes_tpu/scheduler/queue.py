@@ -24,12 +24,12 @@ import functools
 import heapq
 import itertools
 import random
-import threading
 import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api import types as t
+from ..analysis.lockcheck import make_rlock
 
 INITIAL_BACKOFF_S = 1.0
 MAX_BACKOFF_S = 10.0
@@ -85,7 +85,7 @@ class PriorityQueue:
                  initial_backoff_s: float = INITIAL_BACKOFF_S,
                  max_backoff_s: float = MAX_BACKOFF_S,
                  backoff_jitter: float = 0.0, jitter_seed: int = 0):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("PriorityQueue._lock")
         self.clock = clock or Clock()
         # exponential backoff base/cap (podInitialBackoffSeconds /
         # podMaxBackoffSeconds — wired from SchedulerConfiguration), plus a

@@ -26,7 +26,6 @@ event kinds.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -55,6 +54,7 @@ from .queue import (
 )
 from .state import ScaledState
 from .store import ClusterStore, Event
+from ..analysis.lockcheck import make_lock
 
 
 class Scheduler:
@@ -124,7 +124,7 @@ class Scheduler:
 
         self.extenders = [HTTPExtender(e) for e in config.extenders]
         self._bind_pool = None
-        self._bind_lock = threading.Lock()
+        self._bind_lock = make_lock("Scheduler._bind_lock")
         self._bind_futures: list = []
         if config.binding_workers > 0:
             from concurrent.futures import ThreadPoolExecutor
@@ -145,7 +145,7 @@ class Scheduler:
         self._gang_waiting: Dict[str, List[Tuple[t.Pod, str, object, object]]] = {}
         # watch callbacks fire on whichever thread mutates the store (e.g.
         # binding-pool threads) — the waiting map needs its own lock
-        self._gang_lock = threading.Lock()
+        self._gang_lock = make_lock("Scheduler._gang_lock")
         # one Framework per profile (frameworkForPod — pods select theirs by
         # spec.schedulerName); self.framework stays the default profile's
         self.frameworks: Dict[str, Framework] = {
@@ -175,7 +175,7 @@ class Scheduler:
         # events' MoveAllToActiveOrBackoffQueue calls collapse into one move
         # per event kind at loop exit (the reference fires one move per
         # CLUSTER event; a 10k-pod batch bind is 10k events back-to-back)
-        self._move_lock = threading.Lock()
+        self._move_lock = make_lock("Scheduler._move_lock")
         self._move_coalesce: Optional[set] = None
         # resident incremental encoder for the batch path: cluster-side device
         # state persists across cycles, absorbing bind/delete deltas
@@ -788,7 +788,7 @@ class Scheduler:
             report["restored_arrivals"] = self.queue.restore_arrivals(
                 {u: a + dead_s for u, a in doc["arrivals"].items()}
             )
-            node_names = set(self.store.nodes)
+            node_names = set(self.store.list_node_names())
             for uid, node in doc["wal"]:
                 cur = self.store.pods.get(uid)
                 if cur is None or node not in node_names:

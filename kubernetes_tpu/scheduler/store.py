@@ -23,12 +23,12 @@ mutating bindings in-process, so CAS degenerates to serialized apply.
 
 from __future__ import annotations
 
-import threading
 import uuid
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as t
+from ..analysis.lockcheck import make_rlock
 
 
 @dataclass(frozen=True)
@@ -85,7 +85,7 @@ def _key_of(obj) -> str:
 class ClusterStore:
     def __init__(self) -> None:
         # re-entrant: watchers are invoked under the lock and may read back
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ClusterStore._lock")
         self._rv = 0
         # cluster lineage: uids are deterministic (namespace/name), so a
         # crash-restart checkpoint written against ANOTHER store instance

@@ -41,11 +41,11 @@ import itertools
 import json
 import os
 import random
-import threading
 import time
 from collections import OrderedDict, deque
 from contextvars import ContextVar
 from typing import Deque, Dict, Iterator, List, NamedTuple, Optional, Tuple, Union
+from ..analysis.lockcheck import make_lock
 
 
 class SpanContext(NamedTuple):
@@ -142,7 +142,7 @@ class TraceCollector:
     def __init__(self, capacity: int = 65536, enabled: bool = True,
                  max_pod_contexts: int = 65536):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = make_lock("TraceCollector._lock")
         self._spans: Deque[Span] = deque(maxlen=capacity)
         # spans silently evicted by the ring wrapping: attribution reports
         # and trace exports read this to FLAG an incomplete trace instead of

@@ -658,10 +658,25 @@ def _storm_run(seed):
     return got, sched, col
 
 
-def test_chaos_storm_smoke_seed0():
+def test_chaos_storm_smoke_seed0(monkeypatch):
     oracle, _ = _churn_run(pipeline=False)
-    got, sched, col = _storm_run(0)
-    assert got == oracle
+    # the tier-1 storm smoke runs under the runtime lock-order checker
+    # (ISSUE 8): every lock constructed below becomes a CheckedLock, and
+    # any observed acquisition order that closes a cycle fails the smoke
+    from kubernetes_tpu.analysis import lockcheck
+
+    monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+    lockcheck.reset()
+    try:
+        got, sched, col = _storm_run(0)
+        assert got == oracle
+        lockcheck.assert_clean()
+        assert lockcheck.order_graph()  # the checker observed real nesting
+    finally:
+        # the checker state is process-global: reset even on failure so a
+        # later lock-check test doesn't inherit this storm's edges
+        monkeypatch.delenv("KTPU_LOCK_CHECK")
+        lockcheck.reset()
 
 
 @pytest.mark.slow

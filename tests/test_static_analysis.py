@@ -1,0 +1,856 @@
+"""ktpu-verify (ISSUE 8): the AST invariant analyzer + lock-order checker.
+
+Three layers under test:
+
+  1. per-rule fixtures — one failing and one passing snippet per rule
+     (KTPU001..KTPU005) plus the whole-package KTPU006 lock-order pass,
+     proving each rule FIRES and each documented exemption holds;
+  2. the engine machinery — line-number-free fingerprints, baseline
+     suppression (required reasons, stale-entry surfacing, draft workflow),
+     and the 0/1/2 exit-code contract shared with bench/regression.py;
+  3. the runtime half — CheckedLock order recording, cycle detection from
+     single-thread observations, and the acceptance gate: the package
+     itself analyzes clean, and a seeded chaos storm run under
+     KTPU_LOCK_CHECK=1 reports no lock-order cycle.
+"""
+
+import copy
+import json
+import os
+import random
+import threading
+
+import pytest
+
+import kubernetes_tpu
+from kubernetes_tpu import chaos
+from kubernetes_tpu.analysis import CheckedLock, LockOrderViolation, lockcheck
+from kubernetes_tpu.analysis.__main__ import default_baseline, main as cli_main
+from kubernetes_tpu.analysis.engine import (
+    Baseline,
+    BaselineError,
+    ModuleInfo,
+    analyze_package,
+    analyze_source,
+)
+from kubernetes_tpu.analysis.lockorder import LockOrderAnalyzer
+from kubernetes_tpu.analysis.rules import (
+    ALL_RULES,
+    CheapGateRule,
+    DeterminismRule,
+    DonationAliasingRule,
+    KillSafetyRule,
+    SnapshotListRule,
+)
+from kubernetes_tpu.scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+
+from helpers import mk_node, mk_pod
+
+ANY = "kubernetes_tpu/scheduler/somefile.py"
+
+
+def _run(rule, source, relpath=ANY):
+    return analyze_source(source, relpath, [rule()])
+
+
+# --- KTPU001 kill-safety ---
+def test_ktpu001_fires_on_bare_except():
+    fs = _run(KillSafetyRule, "try:\n    work()\nexcept:\n    pass\n")
+    assert len(fs) == 1 and fs[0].rule == "KTPU001"
+    assert "swallow ProcessKilled" in fs[0].message
+
+
+def test_ktpu001_fires_on_nontransparent_baseexception():
+    src = "try:\n    work()\nexcept BaseException:\n    log()\n"
+    assert len(_run(KillSafetyRule, src)) == 1
+
+
+def test_ktpu001_transparent_reraise_is_legal():
+    # bookkeeping-then-reraise (checkpoint.py's tmp cleanup) stays legal
+    src = "try:\n    work()\nexcept BaseException:\n    cleanup()\n    raise\n"
+    assert _run(KillSafetyRule, src) == []
+
+
+def test_ktpu001_raise_as_binding_is_transparent():
+    # `raise e` re-raising the handler's own un-rebound `as` binding is the
+    # same exception object — ProcessKilled propagates unchanged
+    src = ("try:\n    work()\nexcept BaseException as e:\n"
+           "    cleanup()\n    raise e\n")
+    assert _run(KillSafetyRule, src) == []
+    # ...but a REBOUND binding is a conversion
+    rebound = ("try:\n    work()\nexcept BaseException as e:\n"
+               "    e = RuntimeError('other')\n    raise e\n")
+    assert len(_run(KillSafetyRule, rebound)) == 1
+
+
+def test_ktpu001_raise_conversion_is_not_transparent():
+    # a conditional `raise Other(...)` before the final bare raise converts
+    # ProcessKilled into a plain Exception that downstream recoveries catch
+    src = (
+        "try:\n"
+        "    work()\n"
+        "except BaseException:\n"
+        "    if oops:\n"
+        "        raise RuntimeError('converted')\n"
+        "    raise\n"
+    )
+    fs = _run(KillSafetyRule, src)
+    assert len(fs) == 1 and "swallow ProcessKilled" in fs[0].message
+
+
+def test_ktpu001_except_exception_is_legal():
+    # ProcessKilled is a BaseException BY CONSTRUCTION: Exception handlers
+    # are transparent to it — the 21 recovery sites stay untouched
+    src = "try:\n    work()\nexcept Exception:\n    recover()\n"
+    assert _run(KillSafetyRule, src) == []
+
+
+def test_ktpu001_fires_on_processkilled_outside_allowlist():
+    src = "try:\n    work()\nexcept ProcessKilled:\n    return None\n"
+    src = "def f():\n" + "\n".join("    " + l for l in src.splitlines()) + "\n"
+    fs = _run(KillSafetyRule, src)
+    assert len(fs) == 1 and "restart-driver allowlist" in fs[0].message
+
+
+def test_ktpu001_allowlisted_restart_driver_may_catch_kill():
+    src = (
+        "def run_restartable(sched):\n"
+        "    try:\n"
+        "        sched.run()\n"
+        "    except ProcessKilled:\n"
+        "        return restart(sched)\n"
+    )
+    assert _run(KillSafetyRule, src,
+                relpath="kubernetes_tpu/scheduler/scheduler.py") == []
+    # ...but only in scheduler.py: the same code elsewhere is a finding
+    assert len(_run(KillSafetyRule, src)) == 1
+
+
+def test_ktpu001_allowlist_does_not_cover_same_named_methods():
+    # the exemption is the MODULE-LEVEL driver, not any method that happens
+    # to share its name
+    src = (
+        "class Foo:\n"
+        "    def run_restartable(self):\n"
+        "        try:\n"
+        "            work()\n"
+        "        except ProcessKilled:\n"
+        "            return None\n"
+    )
+    assert len(_run(KillSafetyRule, src,
+                    relpath="kubernetes_tpu/scheduler/scheduler.py")) == 1
+
+
+def test_ktpu001_kill_guard_legalizes_following_broad_handler():
+    src = (
+        "try:\n"
+        "    work()\n"
+        "except ProcessKilled:\n"
+        "    raise\n"
+        "except BaseException:\n"
+        "    log()\n"
+    )
+    assert _run(KillSafetyRule, src) == []
+
+
+def test_ktpu001_fires_on_contextlib_suppress_baseexception():
+    src = "with contextlib.suppress(BaseException):\n    work()\n"
+    fs = _run(KillSafetyRule, src)
+    assert len(fs) == 1 and "suppress" in fs[0].message
+    assert _run(
+        KillSafetyRule, "with contextlib.suppress(KeyError):\n    work()\n"
+    ) == []
+
+
+# --- KTPU002 snapshot-LIST ---
+def test_ktpu002_fires_on_live_dict_values_iteration():
+    src = "for p in store.pods.values():\n    use(p)\n"
+    fs = _run(SnapshotListRule, src)
+    assert len(fs) == 1 and fs[0].rule == "KTPU002"
+    assert "list_pods()" in fs[0].message
+
+
+def test_ktpu002_fires_on_len_and_comprehension():
+    assert len(_run(SnapshotListRule, "n = len(self.store.pods)\n")) == 1
+    assert len(_run(
+        SnapshotListRule, "xs = [p for p in self.store.nodes.items()]\n"
+    )) == 1
+    assert len(_run(
+        SnapshotListRule, "for k in sorted(store.objects['ReplicaSet']):\n"
+        "    use(k)\n"
+    )) == 1
+
+
+def test_ktpu002_covers_workload_alias_properties():
+    # store.replicasets/.deployments/.jobs alias the SAME live dicts as
+    # store.objects[kind] — iterating them races the writers identically
+    src = "for rs in store.replicasets.values():\n    use(rs)\n"
+    fs = _run(SnapshotListRule, src)
+    assert len(fs) == 1 and 'list_objects("ReplicaSet")' in fs[0].message
+    assert len(_run(SnapshotListRule,
+                    "active = [j for j in store.jobs.values()]\n")) == 1
+    # point reads on the alias stay legal
+    assert _run(SnapshotListRule, "x = store.jobs.get(key)\n") == []
+    assert _run(SnapshotListRule, "ok = key in store.deployments\n") == []
+
+
+def test_ktpu002_point_reads_and_snapshots_are_legal():
+    assert _run(SnapshotListRule, "p = store.pods.get(uid)\n") == []
+    assert _run(SnapshotListRule, "for p in store.list_pods():\n    use(p)\n") == []
+    assert _run(SnapshotListRule, "ok = uid in store.pods\n") == []
+
+
+def test_ktpu002_transaction_scope_is_exempt():
+    src = (
+        "with self.store.transaction():\n"
+        "    for p in self.store.pods.values():\n"
+        "        use(p)\n"
+    )
+    assert _run(SnapshotListRule, src) == []
+
+
+def test_ktpu002_locked_suffix_and_store_py_are_exempt():
+    src = (
+        "def _scan_locked(store):\n"
+        "    return [p for p in store.pods.values()]\n"
+    )
+    assert _run(SnapshotListRule, src) == []
+    live = "for p in store.pods.values():\n    use(p)\n"
+    assert _run(SnapshotListRule, live,
+                relpath="kubernetes_tpu/scheduler/store.py") == []
+
+
+# --- KTPU003 donation-aliasing ---
+def test_ktpu003_fires_on_resident_buffer_in_donated_position():
+    src = "out = schedule_batch_donated(state.inc, pods)\n"
+    fs = _run(DonationAliasingRule, src)
+    assert len(fs) == 1 and "donated argument 0" in fs[0].message
+    assert _run(
+        DonationAliasingRule, "out = schedule_batch_donated(dev, pods, inc)\n"
+    ) == []
+
+
+def test_ktpu003_fires_on_hoist_cache_donation():
+    src = "r = schedule_batch_ordinals_donated(hoist_cache.resident, w)\n"
+    assert len(_run(DonationAliasingRule, src)) == 1
+
+
+def test_ktpu003_fires_on_new_donation_site_outside_audited_modules():
+    src = "f = jax.jit(step, donate_argnums=(0,))\n"
+    fs = _run(DonationAliasingRule, src,
+              relpath="kubernetes_tpu/parallel/other.py")
+    assert len(fs) == 1 and "audited donation modules" in fs[0].message
+    # the two audited modules may declare donation wrappers
+    assert _run(DonationAliasingRule, src,
+                relpath="kubernetes_tpu/ops/assign.py") == []
+    # donate_argnums=() donates nothing — legal anywhere
+    assert _run(DonationAliasingRule,
+                "f = jax.jit(step, donate_argnums=())\n") == []
+
+
+# --- KTPU004 determinism ---
+OPS = "kubernetes_tpu/ops/newkernel.py"
+
+
+def test_ktpu004_fires_on_wall_clock_in_pure_path():
+    fs = _run(DeterminismRule, "t = time.time()\n", relpath=OPS)
+    assert len(fs) == 1 and "wall clock" in fs[0].message
+    # perf_counter times, it never decides — legal
+    assert _run(DeterminismRule, "t = time.perf_counter()\n", relpath=OPS) == []
+    # out of scope: the impure layers may read clocks
+    assert _run(DeterminismRule, "t = time.time()\n") == []
+
+
+def test_ktpu004_fires_on_unseeded_rng():
+    assert len(_run(DeterminismRule, "x = random.random()\n", relpath=OPS)) == 1
+    assert len(_run(DeterminismRule, "x = np.random.rand(3)\n", relpath=OPS)) == 1
+    assert _run(DeterminismRule, "rng = random.Random(seed)\n", relpath=OPS) == []
+    assert _run(DeterminismRule,
+                "rng = np.random.default_rng(seed)\n", relpath=OPS) == []
+
+
+def test_ktpu004_argless_seeded_ctor_is_not_seeded():
+    # Random()/default_rng() without a seed is entropy-seeded — flagged;
+    # the same constructors WITH a seed stay legal
+    src = "rng = np.random.default_rng()\n"
+    fs = _run(DeterminismRule, src, relpath="kubernetes_tpu/ops/assign.py")
+    assert len(fs) == 1
+    assert _run(DeterminismRule, "rng = np.random.default_rng(7)\n",
+                relpath="kubernetes_tpu/ops/assign.py") == []
+    assert len(_run(DeterminismRule, "r = random.Random()\n",
+                    relpath="kubernetes_tpu/ops/assign.py")) == 1
+    assert _run(DeterminismRule, "r = random.Random(seed)\n",
+                relpath="kubernetes_tpu/ops/assign.py") == []
+
+
+def test_ktpu004_fires_on_unordered_set_iteration():
+    src = "for n in set(names):\n    place(n)\n"
+    fs = _run(DeterminismRule, src, relpath="kubernetes_tpu/api/delta.py")
+    assert len(fs) == 1 and "unordered set" in fs[0].message
+    assert _run(DeterminismRule, "for n in sorted(set(names)):\n    place(n)\n",
+                relpath=OPS) == []
+
+
+# --- KTPU005 cheap-gate ---
+def test_ktpu005_fires_on_ungated_o_p_span_build():
+    src = (
+        "def emit(self, pods):\n"
+        "    self.tracer.record_span('w', t0, uids=[p.uid for p in pods])\n"
+    )
+    fs = _run(CheapGateRule, src)
+    assert len(fs) == 1 and "cheap-gate" in fs[0].message
+
+
+def test_ktpu005_enclosing_if_gate_is_legal():
+    src = (
+        "def emit(self, pods):\n"
+        "    if self.tracer.enabled:\n"
+        "        self.tracer.record_span('w', t0, uids=[p.uid for p in pods])\n"
+    )
+    assert _run(CheapGateRule, src) == []
+
+
+def test_ktpu005_early_return_guard_is_legal():
+    src = (
+        "def emit(self, pods):\n"
+        "    if not self.tracer.enabled:\n"
+        "        return\n"
+        "    self.tracer.record_span('w', t0, uids=[p.uid for p in pods])\n"
+    )
+    assert _run(CheapGateRule, src) == []
+
+
+def test_ktpu005_constant_span_is_legal_ungated():
+    src = "def emit(self):\n    self.tracer.record_span('w', t0, n=3)\n"
+    assert _run(CheapGateRule, src) == []
+
+
+# --- KTPU006 static lock-order ---
+_INVERTED = """
+class DataStore:
+    def __init__(self):
+        self._lock = make_lock("DataStore._lock")
+    def get(self):
+        with self._lock:
+            return 1
+    def poke(self, workqueue):
+        with self._lock:
+            workqueue.push(1)
+
+class WorkQueue:
+    def __init__(self):
+        self._lock = make_lock("WorkQueue._lock")
+    def push(self, x):
+        with self._lock:
+            pass
+    def drain(self, datastore):
+        with self._lock:
+            datastore.get()
+"""
+
+
+def _lockorder(source, relpath=ANY):
+    return LockOrderAnalyzer([ModuleInfo(relpath, source)]).check()
+
+
+def test_ktpu006_fires_on_lock_order_inversion():
+    fs = _lockorder(_INVERTED)
+    assert len(fs) == 1 and fs[0].rule == "KTPU006"
+    assert "inversion" in fs[0].message
+    assert "DataStore._lock" in fs[0].message
+    assert "WorkQueue._lock" in fs[0].message
+
+
+def test_ktpu006_consistent_order_is_clean():
+    clean = _INVERTED.replace("datastore.get()", "pass")
+    assert _lockorder(clean) == []
+
+
+def test_ktpu006_self_deadlock_on_plain_lock():
+    src = (
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = make_lock('Box._lock')\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self.b()\n"
+        "    def b(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    fs = _lockorder(src)
+    assert len(fs) == 1 and "self-deadlock" in fs[0].message
+    # the same shape over an RLock is a legal re-entrant hold
+    assert _lockorder(src.replace("make_lock", "make_rlock")) == []
+
+
+def test_ktpu006_multi_item_with_is_an_ordering_edge():
+    # `with self._x, self._y:` acquires left-to-right — the most idiomatic
+    # two-lock form must produce the same edges as nested withs
+    src = (
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._x = make_lock('Pair._x')\n"
+        "        self._y = make_lock('Pair._y')\n"
+        "    def one(self):\n"
+        "        with self._x, self._y:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._y, self._x:\n"
+        "            pass\n"
+    )
+    fs = _lockorder(src)
+    assert len(fs) == 1 and "inversion" in fs[0].message
+    # consistent multi-item order is clean
+    consistent = src.replace("with self._y, self._x:", "with self._x, self._y:")
+    assert _lockorder(consistent) == []
+
+
+def test_ktpu006_watch_callback_runs_under_store_lock():
+    src = (
+        "class Follower:\n"
+        "    def __init__(self, store):\n"
+        "        self._lock = threading.Lock()\n"
+        "        store.watch(self._on_event)\n"
+        "    def _on_event(self, ev):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def scan(self, store):\n"
+        "        with self._lock:\n"
+        "            with store.transaction():\n"
+        "                pass\n"
+    )
+    # watch edge ClusterStore._lock -> Follower._lock, nesting edge
+    # Follower._lock -> ClusterStore._lock (transaction): a cycle
+    fs = _lockorder(src)
+    assert len(fs) == 1 and "ClusterStore._lock" in fs[0].message
+
+
+def test_static_lock_graph_of_the_package_is_acyclic():
+    root = os.path.dirname(os.path.abspath(kubernetes_tpu.__file__))
+    mods = []
+    from kubernetes_tpu.analysis.engine import iter_package_files
+
+    for relpath, abspath in iter_package_files(root):
+        with open(abspath) as f:
+            mods.append(ModuleInfo(relpath, f.read()))
+    analyzer = LockOrderAnalyzer(mods)
+    assert analyzer.check() == []
+    edges, _, _ = analyzer.build_graph()
+    # the known edge families exist — the analyzer is looking, not blind
+    assert any(a == "ClusterStore._lock" for a in edges)
+
+
+# --- engine: fingerprints, baseline, exit codes ---
+def test_fingerprint_survives_line_shifts():
+    src = "for p in store.pods.values():\n    use(p)\n"
+    a = _run(SnapshotListRule, src)[0]
+    b = _run(SnapshotListRule, "# comment\n\n\n" + src)[0]
+    assert a.line != b.line and a.fingerprint == b.fingerprint
+
+
+def test_baseline_requires_reasons():
+    with pytest.raises(BaselineError):
+        Baseline([{"fingerprint": "abc", "reason": ""}])
+    with pytest.raises(BaselineError):
+        Baseline([{"fingerprint": "abc", "reason": "TODO: justify or fix"}])
+    with pytest.raises(BaselineError):
+        Baseline([{"reason": "no fingerprint"}])
+
+
+def test_baseline_suppresses_and_surfaces_stale(tmp_path):
+    src = "for p in store.pods.values():\n    use(p)\n"
+    f = _run(SnapshotListRule, src)[0]
+    bl = Baseline([
+        {"fingerprint": f.fingerprint, "reason": "audited: single-writer"},
+        {"fingerprint": "deadbeefdeadbeef", "reason": "fixed long ago"},
+    ])
+    assert bl.match(f) == "audited: single-writer"
+    stale = bl.unused([f])
+    assert [e["fingerprint"] for e in stale] == ["deadbeefdeadbeef"]
+
+
+def test_draft_baseline_cannot_silently_pass(tmp_path):
+    src = "for p in store.pods.values():\n    use(p)\n"
+    f = _run(SnapshotListRule, src)[0]
+    draft = Baseline.draft([f])
+    assert draft["findings"][0]["reason"].startswith("TODO")
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(draft))
+    with pytest.raises(BaselineError):
+        Baseline.load(str(p))
+
+
+def test_exit_code_contract(tmp_path):
+    # 1: a package dir with one unbaselined finding
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("for p in store.pods.values():\n    use(p)\n")
+    rep = analyze_package(str(pkg))
+    assert rep.exit_code == 1 and len(rep.unbaselined) == 1
+    # 0: the same finding baselined with a reason
+    bl = Baseline([{
+        "fingerprint": rep.findings[0].fingerprint,
+        "reason": "fixture: suppressed on purpose",
+    }])
+    assert analyze_package(str(pkg), baseline=bl).exit_code == 0
+    # 2: a module that does not parse is an unusable run, never "clean"
+    (pkg / "broken.py").write_text("def f(:\n")
+    assert analyze_package(str(pkg), baseline=bl).exit_code == 2
+
+
+def test_exit_code_contract_unreadable_source(tmp_path):
+    # a null byte makes ast.parse raise ValueError (not SyntaxError) — still
+    # an unusable run (exit 2), never a traceback CI misreads as exit 1
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "nul.py").write_bytes(b"x = 1\n\x00bad")
+    rep = analyze_package(str(pkg))
+    assert rep.exit_code == 2 and rep.errors
+
+
+def test_cli_unknown_rule_id_refused(tmp_path):
+    # a typoed --rules id must not select zero rules and report clean
+    with pytest.raises(SystemExit) as ei:
+        cli_main(["--rules", "KTPU999"])
+    assert ei.value.code == 2
+
+
+def test_stale_baseline_ignores_rules_subset(tmp_path):
+    # an entry for a rule that did not run is NOT stale — it may still
+    # match on a full run, so a subset run must not advise deleting it
+    entry = {"fingerprint": "ab" * 8, "rule": "KTPU002", "reason": "live"}
+    bl = Baseline([entry])
+    assert bl.unused([], ran_rules=["KTPU001"]) == []
+    assert bl.unused([], ran_rules=["KTPU001", "KTPU002"]) == [entry]
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    rep = analyze_package(
+        str(pkg), rules=[r for r in (cls() for cls in ALL_RULES)
+                         if r.rule_id == "KTPU001"],
+        baseline=bl, lockorder=False)
+    assert rep.stale_baseline == [] and rep.exit_code == 0
+
+
+# --- the acceptance gate: the package itself is clean ---
+def test_package_analyzes_clean_under_committed_baseline():
+    root = os.path.dirname(os.path.abspath(kubernetes_tpu.__file__))
+    baseline = Baseline.load(default_baseline())
+    rep = analyze_package(root, baseline=baseline)
+    assert rep.errors == []
+    assert [f.render() for f in rep.unbaselined] == []
+    assert rep.stale_baseline == []
+    assert rep.exit_code == 0
+    assert rep.files_scanned > 50
+
+
+def test_cli_json_artifact(tmp_path, capsys):
+    out = tmp_path / "verify.json"
+    rc = cli_main(["--format", "json", "--output", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["tool"] == "ktpu-verify"
+    assert doc["exit_code"] == 0
+    assert set(doc["rules"]) == {
+        "KTPU001", "KTPU002", "KTPU003", "KTPU004", "KTPU005", "KTPU006",
+    }
+    assert json.loads(capsys.readouterr().out)["n_unbaselined"] == 0
+
+
+def test_cli_rules_subset_really_subsets(tmp_path, capsys):
+    # --rules KTPU002 must not drag the whole-package KTPU006 pass along
+    rc = cli_main(["--format", "json", "--rules", "KTPU002"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["rules"] == ["KTPU002"]
+    rc = cli_main(["--format", "json", "--rules", "KTPU006"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["rules"] == ["KTPU006"]
+
+
+def test_write_baseline_refuses_no_baseline():
+    # the combination would overwrite the committed file with TODO drafts,
+    # discarding every human-written suppression reason
+    with pytest.raises(SystemExit):
+        cli_main(["--write-baseline", "--no-baseline"])
+
+
+def test_unreadable_baseline_is_unusable_not_findings(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text("{")  # truncated JSON
+    with pytest.raises(BaselineError):
+        Baseline.load(str(p))
+    rc = cli_main(["--baseline", str(p)])
+    assert rc == 2  # unusable, never misread as "findings"
+
+
+def test_cli_root_reanchors_at_the_package_dir(tmp_path, capsys):
+    # --root pointed at a REPO root (containing kubernetes_tpu/) must
+    # re-anchor at the package so path-scoped rules keep matching — the
+    # repo root must analyze identically to the default package root
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(kubernetes_tpu.__file__)))
+    rc = cli_main(["--root", repo_root])
+    assert rc == 0
+    assert "0 findings" in capsys.readouterr().out
+    # fixture roots without the package pass through unchanged
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("for p in store.pods.values():\n    use(p)\n")
+    assert cli_main(["--root", str(pkg), "--no-baseline"]) == 1
+
+
+def test_write_baseline_refuses_unusable_run(tmp_path):
+    # a parse error means incomplete findings: rewriting the baseline would
+    # silently drop entries for the unparsed file — refuse, leave it alone
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    original = ('{"findings": [{"fingerprint": "ab", "rule": "KTPU002", '
+                '"reason": "TODO: x"}]}')
+    bl.write_text(original)
+    rc = cli_main(["--root", str(pkg), "--baseline", str(bl),
+                   "--write-baseline"])
+    assert rc == 2
+    assert bl.read_text() == original  # untouched
+
+
+def test_write_baseline_redraft_is_not_a_dead_end(tmp_path):
+    # a prior draft's TODO reasons must not brick --write-baseline itself:
+    # re-drafting loads leniently, drops stale TODO entries, and exits by
+    # remaining-TODO count (strict CI runs still refuse TODOs)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("for p in store.pods.values():\n    use(p)\n")
+    bl = tmp_path / "bl.json"
+    rc = cli_main(["--root", str(pkg), "--baseline", str(bl),
+                   "--write-baseline"])
+    assert rc == 1  # a TODO entry was written: unresolved work
+    assert "TODO" in bl.read_text()
+    assert cli_main(["--root", str(pkg), "--baseline", str(bl)]) == 2
+    (pkg / "bad.py").write_text("x = 1\n")  # finding fixed
+    rc = cli_main(["--root", str(pkg), "--baseline", str(bl),
+                   "--write-baseline"])
+    assert rc == 0  # stale TODO dropped, nothing left to justify
+    assert json.loads(bl.read_text()) == {"findings": []}
+
+
+# --- runtime lock checker (KTPU_LOCK_CHECK=1) ---
+@pytest.fixture
+def clean_lockcheck():
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+def test_checkedlock_detects_inverted_order(clean_lockcheck):
+    a, b = CheckedLock("A"), CheckedLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # closes the cycle: A->B observed, now B->A
+            pass
+    vs = lockcheck.violations()
+    assert len(vs) == 1
+    assert "A" in vs[0].cycle and "B" in vs[0].cycle
+    assert vs[0].witnesses  # the prior A->B edge is named as evidence
+    with pytest.raises(LockOrderViolation):
+        lockcheck.assert_clean()
+
+
+def test_checkedlock_consistent_order_across_threads(clean_lockcheck):
+    a, b = CheckedLock("A"), CheckedLock("B")
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    with a:
+        with b:
+            pass
+    assert lockcheck.violations() == []
+    assert ("A", "B") in lockcheck.order_graph()
+    lockcheck.assert_clean()
+
+
+def test_checkedlock_reentrant_hold_adds_no_edge(clean_lockcheck):
+    a = CheckedLock("A", reentrant=True)
+    with a:
+        with a:
+            pass
+    assert lockcheck.order_graph() == {}
+    lockcheck.assert_clean()
+
+
+def test_checkedlock_distinct_instances_of_one_name_flagged(clean_lockcheck):
+    """Per-object locks (StreamingHist._lock, one per histogram) share a
+    name: nesting two DIFFERENT instances is order-ambiguous at the name
+    level — the mirror nesting on another thread is an ABBA deadlock, so
+    the checker flags it (lockdep's same-class rule) instead of mistaking
+    it for a re-entrant hold."""
+    a, b = CheckedLock("StreamingHist._lock"), CheckedLock("StreamingHist._lock")
+    with a:
+        with b:
+            pass
+    vs = lockcheck.violations()
+    assert len(vs) == 1 and vs[0].cycle == [
+        "StreamingHist._lock", "StreamingHist._lock",
+    ]
+    assert "distinct instances" in vs[0].witnesses[0]
+
+
+def test_checkedlock_records_violation_before_blocking(clean_lockcheck):
+    """Lockdep's rule: the ordering edge lands BEFORE the potentially-
+    deadlocking wait, so an actual ABBA hang still leaves the violation
+    and witnesses in the graph instead of two threads stuck inside
+    acquire() with nothing recorded."""
+    a, b = CheckedLock("A"), CheckedLock("B")
+    with a:
+        with b:
+            pass  # establishes A -> B
+    a.acquire()  # main thread holds A
+
+    def worker():
+        b.acquire()
+        got = a.acquire(timeout=0.2)  # blocks: main holds A
+        if got:
+            a.release()
+        b.release()
+
+    t = threading.Thread(target=worker, name="worker")
+    t.start()
+    t.join()
+    a.release()
+    vs = lockcheck.violations()
+    assert vs and set(vs[0].cycle) == {"A", "B"}
+
+
+def test_checkedlock_cross_thread_release_purges_hold(clean_lockcheck):
+    # releasing a plain Lock from a thread other than its acquirer is a
+    # legal handoff — the acquirer's hold stack must be purged, else its
+    # every later acquisition records a false ordering edge
+    a, b = CheckedLock("A"), CheckedLock("B")
+    a.acquire()
+    t = threading.Thread(target=a.release)
+    t.start()
+    t.join()
+    with b:
+        pass  # must NOT record A -> B
+    assert lockcheck.order_graph() == {}
+    lockcheck.assert_clean()
+
+
+def test_checkedlock_illegal_release_keeps_checker_state(clean_lockcheck):
+    # an illegal cross-thread RLock release raises from the inner lock with
+    # the hold records untouched — the true owner's later edges still land
+    a, b = CheckedLock("A", reentrant=True), CheckedLock("B")
+    a.acquire()
+
+    err: list = []
+
+    def bad_release():
+        try:
+            a.release()
+        except RuntimeError as e:
+            err.append(e)
+
+    t = threading.Thread(target=bad_release)
+    t.start()
+    t.join()
+    assert err  # the release itself raised
+    with b:
+        pass  # main still holds A: edge A -> B must be recorded
+    a.release()
+    assert ("A", "B") in lockcheck.order_graph()
+    lockcheck.assert_clean()
+
+
+def test_checkedlock_nonreentrant_self_reacquire_recorded(clean_lockcheck):
+    # the holder re-acquiring a non-reentrant lock blocks forever — the
+    # guaranteed self-deadlock must be on record before the hang
+    c = CheckedLock("C")
+    c.acquire()
+    assert not c.acquire(timeout=0.05)
+    c.release()
+    vs = lockcheck.violations()
+    assert vs and vs[0].cycle == ["C", "C"]
+
+
+def test_make_lock_reads_env_at_construction(monkeypatch):
+    monkeypatch.delenv("KTPU_LOCK_CHECK", raising=False)
+    assert not isinstance(lockcheck.make_lock("x"), CheckedLock)
+    monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+    lk = lockcheck.make_lock("x")
+    assert isinstance(lk, CheckedLock) and not lk.reentrant
+    rl = lockcheck.make_rlock("y")
+    assert isinstance(rl, CheckedLock) and rl.reentrant
+    monkeypatch.setenv("KTPU_LOCK_CHECK", "0")
+    assert not isinstance(lockcheck.make_lock("x"), CheckedLock)
+
+
+def test_lockcheck_report_shape(clean_lockcheck):
+    a, b = CheckedLock("A"), CheckedLock("B")
+    with a:
+        with b:
+            pass
+    rep = lockcheck.report()
+    assert rep["edges"] == ["A -> B"]
+    assert rep["violations"] == []
+
+
+# --- the acceptance storm: seeded chaos churn under KTPU_LOCK_CHECK=1 ---
+def _lock_checked_churn(seed):
+    store = ClusterStore()
+    for i in range(5):
+        store.add_node(mk_node(f"n{i}", cpu=3000, pods=16))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for i in range(20):
+        store.add_pod(mk_pod(f"p{i}", cpu=250))
+    sched.run_until_idle()
+    rng = random.Random(seed)
+    for r in range(2):
+        bound = sorted(
+            (p for p in store.list_pods() if p.node_name), key=lambda p: p.uid
+        )
+        for v in rng.sample(bound, 6):
+            store.delete_pod(v.uid)
+            q = copy.copy(v)
+            q.name = f"{v.name}-r{r}"
+            q.uid = ""
+            q.node_name = ""
+            q.__post_init__()
+            store.add_pod(q)
+        sched.run_until_idle()
+    return {p.name: p.node_name for p in store.list_pods()}
+
+
+def test_chaos_storm_under_lock_check_is_cycle_free(monkeypatch, clean_lockcheck):
+    """ISSUE 8 acceptance: a seeded chaos storm run with every lock
+    instrumented reports no lock-order cycle — and placements stay
+    bit-identical to the un-instrumented oracle (the checker observes,
+    it never perturbs)."""
+    monkeypatch.setenv("KTPU_PIPELINE", "1")
+    oracle = _lock_checked_churn(5)  # plain locks (env not yet set)
+    monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+    lockcheck.reset()
+    plan = chaos.FaultPlan.from_seed(
+        0, sites=("scheduler.step", "host.stall"), n_faults=4
+    )
+    try:
+        with chaos.chaos_plan(plan):
+            got = _lock_checked_churn(5)
+    finally:
+        chaos.uninstall()
+    assert got == oracle
+    lockcheck.assert_clean()
+    rep = lockcheck.report()
+    # the checker actually observed the hot nesting — not silently off
+    assert "ClusterStore._lock -> Scheduler._move_lock" in rep["edges"]
