@@ -7,6 +7,9 @@ and gates on the baseline.
   python -m kubernetes_tpu.analysis --format json        # CI artifact
   python -m kubernetes_tpu.analysis --write-baseline     # draft suppressions
   python -m kubernetes_tpu.analysis --lock-graph         # dump KTPU006 graph
+  python -m kubernetes_tpu.analysis --device             # + device pass
+  python -m kubernetes_tpu.analysis --rules KTPU007,KTPU008,KTPU009,KTPU010,KTPU011,KTPU012
+                                                         # device pass only
 
 Exit-code contract (bench/regression.py's): 0 clean (all findings
 baselined), 1 unbaselined findings, 2 unusable (parse failure, malformed
@@ -49,19 +52,33 @@ def resolve_root(root: str) -> str:
     return root
 
 
-def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None):
-    """The shared gate: load the committed baseline and run the full pass.
-    Used by this CLI and by `bench.harness --verify`, so both exits follow
-    ONE contract.  Raises BaselineError (exit 2) on an unusable baseline."""
-    from .engine import Baseline, analyze_package
+def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None,
+               device: bool = False):
+    """The shared gate: load the committed baseline and run the full pass —
+    the AST rules, plus the DEVICE pass (KTPU007..012, devicecheck.py)
+    when `device` is set.  Used by this CLI and by `bench.harness
+    --verify[-device]`, so both exits follow ONE contract.  Raises
+    BaselineError (exit 2) on an unusable baseline."""
+    from .engine import Baseline, analyze_package, apply_baseline
 
     baseline = Baseline.load(baseline_path or default_baseline())
-    return analyze_package(resolve_root(root or default_root()),
-                           baseline=baseline)
+    report = analyze_package(resolve_root(root or default_root()),
+                             baseline=None if device else baseline)
+    if device:
+        from .devicecheck import run_device_pass
+
+        dev = run_device_pass(baseline=None)
+        report.findings.extend(dev.findings)
+        report.errors.extend(dev.errors)
+        report.rules = report.rules + dev.rules
+        report.device = dev.device
+        apply_baseline(report, baseline)
+    return report
 
 
 def main(argv=None) -> int:
-    from .engine import Baseline, BaselineError, analyze_package
+    from .engine import Baseline, BaselineError, analyze_package, apply_baseline
+    from .jaxrules import DEVICE_RULE_IDS
     from .rules import ALL_RULES
 
     ap = argparse.ArgumentParser(
@@ -79,7 +96,14 @@ def main(argv=None) -> int:
     ap.add_argument("--output", default="",
                     help="also write the JSON report to this path")
     ap.add_argument("--rules", default="",
-                    help="comma-separated rule ids to run (default: all)")
+                    help="comma-separated rule ids to run (default: all AST "
+                         "rules; naming a KTPU007..012 id also runs the "
+                         "device pass for it)")
+    ap.add_argument("--device", action="store_true",
+                    help="also run the device pass (KTPU007..012 — trace "
+                         "every production kernel route and check the "
+                         "compiled invariants; compiles kernels, takes "
+                         "~1 min on the CPU sim)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write a draft baseline covering every unbaselined "
                          "finding (reasons left TODO — fill them in)")
@@ -100,9 +124,10 @@ def main(argv=None) -> int:
 
     rules = [cls() for cls in ALL_RULES]
     lockorder = True
+    device_ids = list(DEVICE_RULE_IDS) if args.device else []
     if args.rules:
         want = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
-        known = {r.rule_id for r in rules} | {"KTPU006"}
+        known = {r.rule_id for r in rules} | {"KTPU006"} | set(DEVICE_RULE_IDS)
         unknown = sorted(want - known)
         if unknown:
             # a typoed id would otherwise select ZERO rules and exit 0 —
@@ -111,6 +136,10 @@ def main(argv=None) -> int:
                      f"(known: {', '.join(sorted(known))})")
         rules = [r for r in rules if r.rule_id in want]
         lockorder = "KTPU006" in want  # --rules subsets really subset
+        # --device UNIONS with a --rules subset: an AST-only subset must
+        # not silently drop the device pass the flag explicitly requested
+        named = [r for r in DEVICE_RULE_IDS if r in want]
+        device_ids = named or device_ids
 
     baseline = None
     if not args.no_baseline:
@@ -122,8 +151,26 @@ def main(argv=None) -> int:
             print(f"ktpu-verify: unusable baseline: {e}", file=sys.stderr)
             return 2
 
-    report = analyze_package(args.root, rules=rules, baseline=baseline,
-                             lockorder=lockorder)
+    run_ast = bool(rules) or lockorder
+    if run_ast:
+        report = analyze_package(args.root, rules=rules, baseline=None,
+                                 lockorder=lockorder)
+    else:
+        # a pure device-rule subset (--rules KTPU007,...) skips the AST
+        # walk entirely — subsets really subset
+        from .engine import Report
+
+        report = Report(rules=[])
+    if device_ids:
+        from .devicecheck import run_device_pass
+
+        dev = run_device_pass(rule_ids=device_ids, baseline=None)
+        report.findings.extend(dev.findings)
+        report.errors.extend(dev.errors)
+        report.rules = report.rules + dev.rules
+        report.files_scanned = max(report.files_scanned, dev.files_scanned)
+        report.device = dev.device
+    report = apply_baseline(report, baseline)
 
     if args.write_baseline:
         if report.errors:

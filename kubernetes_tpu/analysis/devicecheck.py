@@ -1,0 +1,537 @@
+"""ktpu-verify device pass — traces the production placement kernels and
+feeds the captured artifacts to the KTPU007..KTPU012 rules (jaxrules.py).
+
+WHAT IS TRACED.  Every production kernel route the batch scheduler can
+take: {chunked, rounds, inc} x {donate on/off} x {single-device, mesh8} —
+twelve routes, each exercised exactly the way parallel/pipeline.py and
+scheduler.py drive it (DeltaEncoder encode -> HoistCache.ensure ->
+schedule_batch_routed / sharded_schedule_batch_routed), at a deliberately
+tiny deterministic scale (the invariants checked are properties of the
+PROGRAM — dtype flow, aliasing, collective order, cache keys — not of the
+workload size).  The report lists every route with its status; a route
+that fails to trace is an ERROR (exit 2), never a silent skip.
+
+WHAT IS CAPTURED per route (RouteTrace):
+
+  * the jaxpr (jax.make_jaxpr) — dtype flow + collective order walks
+  * the StableHLO lowering text — donation aliasing / buffer-donor marks
+  * compiled memory analysis (donate=off variant; backends may expose
+    none — recorded as unavailable, not reconciled)
+  * a 3-cycle warm loop (cold + two synthetic warm deltas: bind a few
+    placed pods, re-pend the rest under fresh names — the encoder's delta
+    path and the HoistCache patch path both engage): kernel re-trace and
+    jit-cache growth counts, lowering byte-stability, and a transfer-guard
+    run (cycles 2-3 execute under
+    jax.transfer_guard_host_to_device/device_to_device("disallow") with
+    every input explicitly placed)
+
+The pass is read-only with respect to kernel behavior: it saves/restores
+the routing env and ops.assign.TRACE_COUNTS, never donates a resident
+buffer, and tests/test_devicecheck.py pins analyzed-vs-unanalyzed runs
+bit-identical.
+
+Entry points: run_device_pass() (CLI `python -m kubernetes_tpu.analysis
+--rules KTPU007,...` / `--device`, and `bench.harness --verify-device`),
+RouteTrace.from_callable() (fixture tests build synthetic traces).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .engine import Baseline, Report
+
+# kernel-route anchor for findings/fingerprints (the kernels under test)
+ROUTE_FILE = "kubernetes_tpu/ops/assign.py"
+
+_ALIAS_RE = re.compile(
+    r"%arg(\d+):[^{)]*\{[^}]*tf\.aliasing_output = (\d+)")
+_DONOR_RE = re.compile(r"%arg(\d+):[^{)]*\{[^}]*jax\.buffer_donor")
+
+
+def ensure_devices(n: int = 8) -> None:
+    """Force an n-device virtual CPU platform so the mesh routes trace
+    without TPU hardware.  XLA_FLAGS is read at BACKEND INITIALIZATION,
+    not at jax import, so this works until the first backend use; a
+    process whose backend is already up keeps its platform (the skipped
+    mesh routes are then listed with the reason — never silently)."""
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                return
+        except Exception:
+            return  # cannot tell — do not disturb a live backend
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+@dataclass
+class RouteTrace:
+    """Captured artifacts of one traced kernel route — what jaxrules.py
+    checks.  Fixture tests build small synthetic ones via from_callable."""
+
+    name: str                 # e.g. "chunked/donate/mesh8"
+    kind: str                 # chunked | rounds | inc (fixtures: free-form)
+    donate: bool
+    n_shards: int
+    file: str = ROUTE_FILE    # finding anchor
+    status: str = "traced"    # traced | skipped
+    skip_reason: str = ""
+    jaxpr: Any = None         # ClosedJaxpr
+    out_avals: Tuple = ()
+    integer_out_indices: Tuple[int, ...] = ()
+    lowered_text: Optional[str] = None
+    aliased: List[Tuple[int, int]] = field(default_factory=list)
+    donor_args: int = 0
+    alias_required_out: Optional[int] = None
+    collectives: List[str] = field(default_factory=list)
+    cond_divergences: List[str] = field(default_factory=list)
+    warm: Dict[str, Any] = field(default_factory=dict)
+    transfer_violation: Optional[str] = None
+    memory: Optional[Dict[str, int]] = None
+    est: Optional[Dict[str, int]] = None
+    workload: Dict[str, Any] = field(default_factory=dict)
+
+    def capture(self, jaxpr_fn, jaxpr_args, jitted_fn, lower_args):
+        """Fill the program-capture fields — jaxpr + collective walk,
+        lowering text, donation alias/donor marks — from ONE extraction
+        path shared by trace_route (real kernels) and from_callable
+        (fixtures), so the fixture tests and the production pass can never
+        check different parsing logic.  Returns the Lowered for optional
+        memory analysis."""
+        import jax
+
+        from .jaxrules import collective_walk
+
+        closed = jax.make_jaxpr(jaxpr_fn)(*jaxpr_args)
+        self.jaxpr = closed
+        self.out_avals = tuple(closed.out_avals)
+        self.collectives, self.cond_divergences = collective_walk(
+            closed.jaxpr)
+        with _quiet_donation():
+            lowered = jitted_fn.lower(*lower_args)
+        self.lowered_text = lowered.as_text()
+        self.aliased = [(int(a), int(o))
+                        for a, o in _ALIAS_RE.findall(self.lowered_text)]
+        self.donor_args = len(_DONOR_RE.findall(self.lowered_text))
+        return lowered
+
+    @classmethod
+    def from_callable(cls, name: str, fn, *args, donate_argnums=(),
+                      integer_out_indices=(), alias_required_out=None,
+                      n_shards: int = 1, kind: str = "fixture",
+                      compile_memory: bool = False) -> "RouteTrace":
+        """Trace an arbitrary callable into a RouteTrace — the fixture-test
+        entry (a deliberately f64-promoting kernel, a dropped donation, a
+        shard-divergent collective); capture() is the shared extraction."""
+        import jax
+
+        t = cls(name=name, kind=kind, donate=bool(donate_argnums),
+                n_shards=n_shards,
+                integer_out_indices=tuple(integer_out_indices),
+                alias_required_out=alias_required_out)
+        lowered = t.capture(
+            fn, args, jax.jit(fn, donate_argnums=donate_argnums), args)
+        if compile_memory:
+            t.memory = _memory_stats(lowered)
+        return t
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "kind": self.kind, "donate": self.donate,
+            "n_shards": self.n_shards, "status": self.status,
+            "skip_reason": self.skip_reason,
+            "collectives": list(self.collectives),
+            "cond_divergences": list(self.cond_divergences),
+            "n_aliased": len(self.aliased), "donor_args": self.donor_args,
+            "warm": dict(self.warm),
+            "transfer_violation": self.transfer_violation,
+            "memory": self.memory, "est": self.est,
+            "workload": dict(self.workload),
+        }
+
+
+@dataclass(frozen=True)
+class RouteSpec:
+    kind: str        # chunked | rounds | inc
+    donate: bool
+    n_shards: int
+
+    @property
+    def name(self) -> str:
+        return (f"{self.kind}/{'donate' if self.donate else 'nodonate'}/"
+                f"{'mesh%d' % self.n_shards if self.n_shards > 1 else 'single'}")
+
+
+def enumerate_routes(mesh_size: int = 8) -> List[RouteSpec]:
+    """The production route matrix: {chunked, rounds, inc} x {donate
+    on/off} x {single-device, mesh}."""
+    return [
+        RouteSpec(kind, donate, ns)
+        for kind in ("chunked", "rounds", "inc")
+        for donate in (False, True)
+        for ns in (1, mesh_size)
+    ]
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """The 'Some donated buffers were not usable' warning is expected
+    noise on whole-ClusterArrays donation (schedule_batch_routed suppresses
+    it identically)."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def _memory_stats(lowered) -> Optional[Dict[str, int]]:
+    """CompiledMemoryStats -> plain dict, or None when the backend exposes
+    no memory analysis (KTPU012 records the route as unreconciled instead
+    of guessing)."""
+    try:
+        ma = lowered.compile().memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    try:
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+        }
+    except AttributeError:
+        return None
+
+
+def _route_snapshot(kind: str):
+    """Deterministic tiny workload per route kind.  heterogeneous is the
+    north-star shape (fit+balanced only -> chunked routing, template-
+    stamped specs -> real equivalence classes for inc); spread_affinity
+    carries pairwise terms -> rounds routing."""
+    from ..bench import workloads
+
+    if kind in ("chunked", "inc"):
+        return workloads.heterogeneous(16, 120, seed=5)
+    return workloads.spread_affinity(16, 48, seed=5)
+
+
+def _bind_warm_delta(snap, meta, choices, cycle: int, k: int = 4):
+    """The synthetic warm delta: k placed pods become bound (spec objects
+    shared — template stamping keeps the class set identity-stable), the
+    rest re-pend under fresh names.  Mirrors the warm churn the pipeline
+    sees between cycles."""
+    import numpy as np
+
+    from ..api.snapshot import Snapshot
+
+    ch = np.asarray(choices)
+    by_name = {p.name: p for p in snap.pending_pods}
+    bound = list(snap.bound_pods)
+    n_bound = 0
+    for i in range(meta.n_pods):
+        if int(ch[i]) >= 0 and n_bound < k:
+            pod = by_name[meta.pod_names[i]]
+            bound.append(dataclasses.replace(
+                pod, node_name=meta.node_names[int(ch[i])]))
+            n_bound += 1
+    pend = [
+        dataclasses.replace(p, name=f"w{cycle}-{p.name}", uid="")
+        for p in snap.pending_pods
+    ]
+    return Snapshot(nodes=snap.nodes, pending_pods=pend, bound_pods=bound)
+
+
+def _place(arr, mesh):
+    """EXPLICIT device placement of a host ClusterArrays — what
+    api/delta.py encode_device does on the production path, so the warm
+    loop's transfer guard only sees intended transfers."""
+    import jax
+
+    if mesh is None:
+        return jax.tree_util.tree_map(jax.device_put, arr)
+    from ..parallel.sharded import field_shardings
+
+    img = arr.image_score.shape[1] == arr.N
+    sh = field_shardings(mesh, img)
+    return dataclasses.replace(arr, **{
+        name: jax.device_put(getattr(arr, name), s)
+        for name, s in sh.items()
+    })
+
+
+@contextlib.contextmanager
+def _no_implicit_transfers():
+    import jax
+
+    with jax.transfer_guard_host_to_device("disallow"), \
+            jax.transfer_guard_device_to_device("disallow"):
+        yield
+
+
+def _single_fns(donate: bool):
+    from ..ops import assign as A
+
+    return A.schedule_batch_donated if donate else A.schedule_batch
+
+
+def _sharded_fn(mesh, arr, cfg, donate, inc):
+    """The exact lru-cached jit parallel/sharded.py routes this call to —
+    fetching it through the same key means _cache_size() watches the
+    production cache entry, not a twin."""
+    from ..ops import assign as A
+    from ..parallel import sharded as S
+
+    if A._chunk_routed(arr, cfg):
+        kind = "chunked"
+    elif A._rounds_routed(arr, cfg):
+        kind = "rounds"
+    else:
+        kind = "scan"
+    inc = A.inc_applicable(arr, cfg, inc) if kind != "scan" else None
+    inc_sig = None
+    if inc is not None:
+        inc_sig = (inc.elig_u is not None, inc.traw_u is not None,
+                   inc.naraw_u is not None, inc.img_u is not None)
+    fn = S._sharded_routed_fn(
+        mesh, arr.image_score.shape[1] == arr.N, kind, cfg, False, donate,
+        inc_sig,
+    )
+    return fn, inc, kind
+
+
+def _cache_size(fn) -> int:
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return 0
+
+
+def trace_route(spec: RouteSpec) -> RouteTrace:
+    """Trace ONE production route end to end (see module docstring for the
+    capture list).  Raises on any failure — run_device_pass converts that
+    into a report ERROR (exit 2): a route that cannot trace is lost
+    coverage, not a clean pass."""
+    import jax
+    import numpy as np
+
+    from ..api.delta import DeltaEncoder
+    from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
+    from ..ops import assign as A
+    from ..ops.incremental import HoistCache
+    from ..parallel.mesh import make_mesh, shard_hbm_estimate
+
+    t = RouteTrace(name=spec.name, kind=spec.kind, donate=spec.donate,
+                   n_shards=spec.n_shards,
+                   integer_out_indices=(0, 1), alias_required_out=1)
+    if spec.n_shards > 1 and len(jax.devices()) < spec.n_shards:
+        t.status = "skipped"
+        t.skip_reason = (f"{spec.n_shards}-device mesh needs "
+                         f">= {spec.n_shards} devices "
+                         f"(have {len(jax.devices())})")
+        return t
+
+    mesh = make_mesh(spec.n_shards) if spec.n_shards > 1 else None
+    snap = _route_snapshot(spec.kind)
+    enc = DeltaEncoder()
+    cache = HoistCache(mesh=mesh) if spec.kind == "inc" else None
+
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want_chunked = spec.kind in ("chunked", "inc")
+    if want_chunked != A._chunk_routed(arr, cfg) or (
+            spec.kind == "rounds" and not A._rounds_routed(arr, cfg)):
+        raise RuntimeError(
+            f"{spec.name}: workload did not route the {spec.kind} kernel "
+            "(routing predicates moved?)")
+
+    inc = cache.ensure(arr, meta, cfg) if cache is not None else None
+    if spec.kind == "inc" and inc is None:
+        raise RuntimeError(f"{spec.name}: HoistCache.ensure declined — no "
+                           "incremental route to trace")
+
+    arr_dev = _place(arr, mesh)
+
+    # ---- program capture: jaxpr, lowering, donation marks, memory ----
+    if mesh is None:
+        fn = _single_fns(spec.donate)
+        lower_args = (arr_dev, cfg, inc)
+        if spec.kind == "inc":
+            jaxpr_fn = lambda a, i: A.schedule_batch_impl(a, cfg, i)  # noqa: E731
+            jaxpr_args = (arr_dev, inc)
+        else:
+            jaxpr_fn = lambda a: A.schedule_batch_impl(a, cfg, None)  # noqa: E731
+            jaxpr_args = (arr_dev,)
+    else:
+        fn, inc_eff, _routed_kind = _sharded_fn(
+            mesh, arr_dev, cfg, spec.donate, inc)
+        lower_args = (arr_dev,) if inc_eff is None else (arr_dev, inc_eff)
+        jaxpr_fn, jaxpr_args = fn, lower_args
+    lowered = t.capture(jaxpr_fn, jaxpr_args, fn, lower_args)
+    if not spec.donate:
+        t.memory = _memory_stats(lowered)
+
+    chunk = {"chunked": A._CHUNK, "inc": A._INC_CHUNK,
+             "rounds": A._RCHUNK}[spec.kind]
+    t.est = shard_hbm_estimate(
+        arr.P, arr.N, spec.n_shards, n_res=arr.R,
+        n_terms=arr.term_counts0.shape[0], chunk=chunk,
+        u_classes=(int(inc.req_u.shape[0]) if inc is not None else None),
+    )
+    t.workload = {
+        "P": int(arr.P), "N": int(arr.N), "R": int(arr.R),
+        "T": int(arr.term_counts0.shape[0]), "chunk": int(chunk),
+        "U1": int(inc.req_u.shape[0]) if inc is not None else None,
+    }
+
+    # ---- warm loop: cold cycle + two guarded warm deltas ----
+    def call(a_dev, cfg_c, inc_state):
+        # cfg_c is the CYCLE's inferred config: a warm delta that moves it
+        # churns the jit cache key, which must show up as a retrace below
+        # (KTPU010) — never be masked by reusing the cold cfg closure
+        return A.schedule_batch_routed(
+            a_dev, cfg_c, donate=spec.donate, mesh=mesh, inc=inc_state)
+
+    choices, _used = call(arr_dev, cfg, inc)
+    size0 = _cache_size(fn)
+    warm_texts: List[str] = []
+    retraces = 0
+    last_size = size0
+    cur = _bind_warm_delta(snap, meta, choices, 1)
+    for cyc in (2, 3):
+        arr_w, meta_w = enc.encode(cur)
+        cfg_w = infer_score_config(arr_w, DEFAULT_SCORE_CONFIG)
+        violated = False
+        with _no_implicit_transfers():
+            try:
+                inc_w = (cache.ensure(arr_w, meta_w, cfg_w)
+                         if cache is not None else None)
+                aw_dev = _place(arr_w, mesh)
+            except Exception as e:  # noqa: BLE001 — guard violations surface
+                if "transfer" not in str(e).lower() \
+                        and "disallow" not in str(e).lower():
+                    raise
+                t.transfer_violation = t.transfer_violation or \
+                    f"cycle {cyc} (hoist/placement): {e}"
+                violated = True
+        if violated:
+            # re-run unguarded so the warm-delta chain stays intact
+            inc_w = (cache.ensure(arr_w, meta_w, cfg_w)
+                     if cache is not None else None)
+            aw_dev = _place(arr_w, mesh)
+        # lowering capture BEFORE the call: donated buffers are consumed
+        # by it, and lower() re-traces (which must not count as a kernel
+        # re-trace below)
+        if mesh is None:
+            fn_w, largs = fn, (aw_dev, cfg_w, inc_w)
+        else:
+            fn_w, inc_eff_w, _k = _sharded_fn(
+                mesh, aw_dev, cfg_w, spec.donate, inc_w)
+            largs = (aw_dev,) if inc_eff_w is None else (aw_dev, inc_eff_w)
+        with _quiet_donation():
+            warm_texts.append(fn_w.lower(*largs).as_text())
+        pre_counts = dict(A.TRACE_COUNTS)
+        try:
+            with _no_implicit_transfers():
+                out = call(aw_dev, cfg_w, inc_w)
+                jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001
+            if "transfer" not in str(e).lower() \
+                    and "disallow" not in str(e).lower():
+                raise
+            t.transfer_violation = t.transfer_violation or \
+                f"cycle {cyc} (step): {e}"
+            aw_dev = _place(arr_w, mesh)
+            out = call(aw_dev, cfg_w, inc_w)
+        retraces += sum(
+            A.TRACE_COUNTS[k] - pre_counts[k] for k in pre_counts)
+        last_size = _cache_size(fn_w)
+        choices_w = np.asarray(out[0])
+        cur = _bind_warm_delta(cur, meta_w, choices_w, cyc)
+    t.warm = {
+        "cycles": 3,
+        "retraces": retraces,
+        "cache_growth": max(0, last_size - size0),
+        "lowered_stable": warm_texts[0] == warm_texts[1],
+    }
+    return t
+
+
+@contextlib.contextmanager
+def _pass_env():
+    """Force the production routing for the pass, restore EVERYTHING after
+    (env + TRACE_COUNTS) — the no-mutation contract the parity test pins."""
+    from ..ops import assign as A
+
+    saved_env = {k: os.environ.get(k)
+                 for k in ("KTPU_FORCE_CHUNKED", "KTPU_INCREMENTAL")}
+    saved_counts = dict(A.TRACE_COUNTS)
+    os.environ["KTPU_FORCE_CHUNKED"] = "1"
+    os.environ.pop("KTPU_INCREMENTAL", None)
+    try:
+        yield
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        A.TRACE_COUNTS.clear()
+        A.TRACE_COUNTS.update(saved_counts)
+
+
+def run_device_pass(rule_ids: Optional[Sequence[str]] = None,
+                    baseline: Optional[Baseline] = None,
+                    mesh_size: int = 8) -> Report:
+    """Trace every production route and run the (selected) device rules.
+
+    Returns an engine.Report (same fingerprint/baseline/exit contract as
+    the AST pass) whose `device` block lists EVERY route with its status —
+    no silent route skips.  A route that raises is an ERROR (exit 2)."""
+    ensure_devices(mesh_size)
+
+    from .jaxrules import ALL_DEVICE_RULES
+
+    rules = [cls() for cls in ALL_DEVICE_RULES]
+    if rule_ids is not None:
+        want = {r.upper() for r in rule_ids}
+        rules = [r for r in rules if r.rule_id in want]
+    report = Report(rules=[r.rule_id for r in rules])
+    traces: List[RouteTrace] = []
+    with _pass_env():
+        for spec in enumerate_routes(mesh_size):
+            try:
+                traces.append(trace_route(spec))
+            except Exception as e:  # noqa: BLE001 — lost coverage = exit 2
+                report.errors.append(
+                    f"{spec.name}: trace failed: {type(e).__name__}: {e}")
+    report.files_scanned = len([t for t in traces if t.status == "traced"])
+    for r in rules:
+        try:
+            report.findings.extend(r.check(traces))
+        except Exception as e:  # a rule bug must not pass as "clean"
+            report.errors.append(
+                f"device rule {r.rule_id} crashed: {type(e).__name__}: {e}")
+    from .engine import apply_baseline
+
+    apply_baseline(report, baseline)
+    report.device = {
+        "routes": [t.to_dict() for t in traces],
+        "n_traced": sum(1 for t in traces if t.status == "traced"),
+        "n_skipped": sum(1 for t in traces if t.status == "skipped"),
+    }
+    return report

@@ -229,6 +229,10 @@ class Report:
     files_scanned: int = 0
     rules: List[str] = field(default_factory=list)
     stale_baseline: List[Dict[str, str]] = field(default_factory=list)
+    # device-pass block (analysis/devicecheck.py): every traced kernel
+    # route with its status — the no-silent-route-skips ledger.  None for
+    # AST-only runs.
+    device: Optional[Dict[str, object]] = None
 
     @property
     def unbaselined(self) -> List[Finding]:
@@ -253,6 +257,7 @@ class Report:
             "errors": self.errors,
             "stale_baseline": self.stale_baseline,
             "exit_code": self.exit_code,
+            **({"device": self.device} if self.device is not None else {}),
         }
 
     def render_text(self) -> str:
@@ -267,6 +272,15 @@ class Report:
                 f"({e.get('rule', '?')} {e.get('file', '?')}) matched nothing "
                 "— remove it"
             )
+        if self.device is not None:
+            out.append(
+                f"device pass: {self.device.get('n_traced', 0)} routes "
+                f"traced, {self.device.get('n_skipped', 0)} skipped"
+            )
+            for r in self.device.get("routes", []):
+                if r.get("status") == "skipped":
+                    out.append(
+                        f"  SKIPPED {r['name']}: {r.get('skip_reason', '?')}")
         nb = len(self.unbaselined)
         out.append(
             f"ktpu-verify: {self.files_scanned} files, "
@@ -323,6 +337,22 @@ def analyze_source(source: str, relpath: str, rules: List[Rule]) -> List[Finding
     return findings
 
 
+def apply_baseline(report: Report, baseline: Optional[Baseline]) -> Report:
+    """Mark baselined findings + compute stale entries — ONE application
+    point shared by analyze_package, the device pass, and the CLI's merged
+    AST+device report (applying per-pass would double-report staleness)."""
+    if baseline is None:
+        return report
+    for f in report.findings:
+        reason = baseline.match(f)
+        if reason is not None:
+            f.baselined = True
+            f.baseline_reason = reason
+    report.stale_baseline = baseline.unused(report.findings,
+                                            ran_rules=report.rules)
+    return report
+
+
 def analyze_package(root: str, rules: Optional[List[Rule]] = None,
                     baseline: Optional[Baseline] = None,
                     lockorder: bool = True) -> Report:
@@ -356,12 +386,4 @@ def analyze_package(root: str, rules: Optional[List[Rule]] = None,
         except Exception as e:
             report.errors.append(
                 f"lock-order analysis crashed: {type(e).__name__}: {e}")
-    if baseline is not None:
-        for f in report.findings:
-            reason = baseline.match(f)
-            if reason is not None:
-                f.baselined = True
-                f.baseline_reason = reason
-        report.stale_baseline = baseline.unused(report.findings,
-                                                ran_rules=report.rules)
-    return report
+    return apply_baseline(report, baseline)

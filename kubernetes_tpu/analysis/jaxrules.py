@@ -1,0 +1,408 @@
+"""ktpu-verify device rules KTPU007..KTPU012 — invariants of the COMPILED
+placement kernels.
+
+The AST rules (rules.py) see Python; the invariants that gate the north
+star live below it, in the jaxprs and compiled executables of
+ops/assign.py / ops/incremental.py / parallel/sharded.py.  Each rule here
+checks one machine-readable artifact captured by analysis/devicecheck.py
+(a RouteTrace per production kernel route):
+
+  KTPU007 dtype-flow          no f64 promotion anywhere in the traced
+                              program; the integer argmax/tie-break lattice
+                              is never narrowed through bf16/f16 (the
+                              load-bearing precondition for ROADMAP 4's
+                              bf16 scores: raw scores may shrink, node ids
+                              and usage counts may not)
+  KTPU008 donation-honored    declared donate_argnums survive lowering as
+                              input_output_aliases / buffer-donor marks —
+                              the runtime twin of KTPU003 (a backend that
+                              silently ignores donation doubles peak HBM
+                              without failing any test)
+  KTPU009 collective-sequence under a mesh every shard runs the identical
+                              ordered collective sequence — a collective
+                              inside one `cond` branch but not the other is
+                              a cross-shard deadlock waiting for the first
+                              shard-divergent predicate (ROADMAP 3's 2-D
+                              mesh raises the stakes)
+  KTPU010 recompile-guard     warm cycles must not re-trace or re-lower the
+                              cached kernels — a silent recompile erases
+                              PR 5's 4.2x warm-cycle win
+  KTPU011 transfer-guard      the warm loop runs clean under
+                              jax.transfer_guard("disallow"): no implicit
+                              host<->device transfers hiding in the hot path
+  KTPU012 hbm-estimate        the compiled memory analysis (where the
+                              backend exposes it) reconciles with
+                              parallel/mesh.shard_hbm_estimate within
+                              HBM_TOLERANCE — the PARITY.md scale ceiling
+                              is a checked number, not prose
+
+Rules operate on devicecheck.RouteTrace objects (fixture tests build small
+synthetic traces with RouteTrace.from_callable), return engine.Finding
+lists, and ride the same fingerprint/baseline/exit contract as the AST
+pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding
+
+# dtypes that may never appear in a placement kernel (f64 promotion breaks
+# the cross-backend bit-identity contract; complex is nonsense here)
+_FORBIDDEN_DTYPES = ("float64", "complex64", "complex128")
+# float dtypes too narrow to carry the integer lattice exactly (int -> f32
+# is exact below 2^24, the documented invariant; int -> bf16/f16 is not)
+_NARROW_FLOATS = ("bfloat16", "float16")
+
+# collective primitives whose cross-shard ORDER is the deadlock surface
+COLLECTIVE_PRIMS = (
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter", "psum_scatter", "pgather", "all_gather_invariant",
+)
+
+# KTPU012: measured-per-shard bytes may exceed the analytic estimate by at
+# most this factor before the PARITY.md ceiling is declared prose (stated
+# tolerance — the estimate models dominant blocks, not every XLA temp)
+HBM_TOLERANCE = 4.0
+
+
+class DeviceRule:
+    """Base: subclasses set rule_id/title and implement check(traces).
+
+    check receives the FULL trace list (KTPU009 compares traces of one
+    route group pairwise); single-trace rules iterate it."""
+
+    rule_id = "KTPU000"
+    title = ""
+
+    def check(self, traces: Sequence) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _finding(trace, rule_id: str, message: str, detail: str = "") -> Finding:
+    """A device finding anchored at the route, not a source line: the
+    fingerprint is rule | route file | route name | detail, so baselines
+    survive kernel edits that do not change the violated property."""
+    return Finding(
+        rule=rule_id, message=message, file=trace.file, line=0,
+        func=trace.name, snippet=detail or trace.name,
+    )
+
+
+class DtypeFlowRule(DeviceRule):
+    """KTPU007 — walk every eqn output dtype through the jaxpr (sub-jaxprs
+    included): no f64/complex anywhere, no integer->{bf16,f16} narrowing,
+    no f32->f64 widening, and the kernel outputs the route declares integer
+    (assignment, node_used, commit ordinals) stay integer dtypes."""
+
+    rule_id = "KTPU007"
+    title = "dtype-flow: no f64 promotion; integer tie-break lattice exact"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if t.jaxpr is None:
+                continue
+            seen: Set[str] = set()
+            for eqn, aval in _iter_eqn_avals(t.jaxpr.jaxpr):
+                name = getattr(getattr(aval, "dtype", None), "name", "")
+                if name in _FORBIDDEN_DTYPES:
+                    key = f"{eqn.primitive.name}->{name}"
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(_finding(
+                            t, self.rule_id,
+                            f"{name} value produced by `{eqn.primitive.name}`"
+                            " — f64/complex promotion breaks cross-backend "
+                            "bit-identity",
+                            key,
+                        ))
+                if eqn.primitive.name == "convert_element_type":
+                    src = getattr(
+                        getattr(eqn.invars[0], "aval", None), "dtype", None
+                    )
+                    if src is None:
+                        continue
+                    src_name = getattr(src, "name", "")
+                    if src_name.startswith(("int", "uint", "bool")) \
+                            and name in _NARROW_FLOATS:
+                        key = f"{src_name}->{name}"
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(_finding(
+                                t, self.rule_id,
+                                f"integer lattice narrowed {src_name} -> "
+                                f"{name} — tie-breaks/usage counts must "
+                                "stay exact (int or f32 below 2^24)",
+                                key,
+                            ))
+            for i in t.integer_out_indices:
+                if i >= len(t.out_avals):
+                    continue
+                name = getattr(
+                    getattr(t.out_avals[i], "dtype", None), "name", ""
+                )
+                if not name.startswith(("int", "uint", "bool")):
+                    findings.append(_finding(
+                        t, self.rule_id,
+                        f"kernel output {i} (declared integer-exact) has "
+                        f"dtype {name}",
+                        f"out{i}:{name}",
+                    ))
+        return findings
+
+
+class DonationHonoredRule(DeviceRule):
+    """KTPU008 — routes declaring donation must show it in the lowering:
+
+    * single-device: the node_used->used_final aliasing class must be
+      realized — the used output is backed by a donated input buffer of the
+      same shape/dtype (`tf.aliasing_output` on some donated argument
+      pointing at the used output).  jax aliases ANY shape-matching donated
+      leaf, so the check is output-side: the big persistent [N, R] buffer
+      must not be a fresh allocation.
+    * mesh: the sharded input node_used and the (replicated or resharded)
+      used output have different per-device shapes, so an alias is not
+      always expressible — the lowering must still carry at least one
+      aliasing/donor mark (donation freeing [P, Nl] inputs early is the
+      point at scale); zero marks means the backend dropped donation
+      silently."""
+
+    rule_id = "KTPU008"
+    title = "donation-honored: donate_argnums survive to input_output_aliases"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if not t.donate or t.lowered_text is None:
+                continue
+            aliased_outs = {out for (_a, out) in t.aliased}
+            if t.n_shards == 1:
+                if t.alias_required_out is not None \
+                        and t.alias_required_out not in aliased_outs:
+                    findings.append(_finding(
+                        t, self.rule_id,
+                        "declared donation did not alias the used-state "
+                        f"output (index {t.alias_required_out}) — the "
+                        "compiler dropped it; peak HBM doubles silently",
+                        f"missing-alias-out{t.alias_required_out}",
+                    ))
+            elif not t.aliased and not t.donor_args:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    "declared donation left no input_output_aliases or "
+                    "buffer-donor marks in the sharded lowering — donation "
+                    "was dropped end to end",
+                    "no-aliases-no-donors",
+                ))
+        return findings
+
+
+class CollectiveSequenceRule(DeviceRule):
+    """KTPU009 — mesh routes: (a) the traced program must actually contain
+    collectives (a sharded route with none is a routing bug: shards are
+    deciding independently); (b) no `cond` whose branches carry different
+    collective subsequences (the first shard-divergent predicate deadlocks
+    the mesh); (c) every trace of the same (kind, n_shards) group — donate
+    on/off — must carry the IDENTICAL ordered sequence (a sequence that
+    moves under a donation flag is trace-order nondeterminism)."""
+
+    rule_id = "KTPU009"
+    title = "collective-sequence: identical ordered collectives per shard"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        groups: Dict[Tuple[str, int], List] = {}
+        for t in traces:
+            if t.n_shards <= 1 or t.jaxpr is None:
+                continue
+            if not t.collectives:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    "sharded route lowered to ZERO collectives — shards "
+                    "cannot be agreeing on placements",
+                    "no-collectives",
+                ))
+            for desc in t.cond_divergences:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    "cond branches carry different collective sequences "
+                    f"({desc}) — a shard-divergent predicate deadlocks "
+                    "the mesh",
+                    f"cond:{desc}",
+                ))
+            groups.setdefault((t.kind, t.n_shards), []).append(t)
+        for (kind, ns), grp in groups.items():
+            seqs = {tuple(t.collectives) for t in grp}
+            if len(seqs) > 1:
+                findings.append(_finding(
+                    grp[0], self.rule_id,
+                    f"route group ({kind}, mesh{ns}) traced "
+                    f"{len(seqs)} distinct collective sequences across "
+                    "donate variants — the program order is not a pure "
+                    "function of the route",
+                    f"group-divergence:{kind}:{ns}",
+                ))
+        return findings
+
+
+class RecompileGuardRule(DeviceRule):
+    """KTPU010 — the warm loop (two synthetic warm deltas after the cold
+    cycle) must ride the jit cache: zero kernel re-traces (TRACE_COUNTS),
+    zero cache-entry growth, and the lowering of the warm step must be
+    byte-stable across deltas (an unstable lowering means some host value
+    is leaking into the cache key — the next shape bump recompiles)."""
+
+    rule_id = "KTPU010"
+    title = "recompile-guard: warm deltas never re-trace the cached kernels"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            w = t.warm
+            if not w:
+                continue
+            if w.get("retraces", 0) > 0 or w.get("cache_growth", 0) > 0:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    f"warm cycle re-traced the kernel "
+                    f"(retraces={w.get('retraces', 0)}, new cache entries="
+                    f"{w.get('cache_growth', 0)}) — a silent recompile "
+                    "erases the 4.2x incremental warm-cycle win",
+                    "warm-retrace",
+                ))
+            if w.get("lowered_stable") is False:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    "lowering is not byte-stable across two warm deltas — "
+                    "a host value is leaking into the cache key",
+                    "unstable-lowering",
+                ))
+        return findings
+
+
+class TransferGuardRule(DeviceRule):
+    """KTPU011 — the warm loop (hoist ensure + kernel step on explicitly
+    placed buffers) ran under jax.transfer_guard_host_to_device("disallow")
+    + device_to_device("disallow"); any implicit transfer raised and was
+    captured into the trace."""
+
+    rule_id = "KTPU011"
+    title = "transfer-guard: warm loop clean under transfer_guard(disallow)"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if t.transfer_violation:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    "implicit host<->device transfer in the warm loop: "
+                    f"{t.transfer_violation}",
+                    "transfer-violation",
+                ))
+        return findings
+
+
+class HbmEstimateRule(DeviceRule):
+    """KTPU012 — compiled memory analysis vs the analytic per-shard budget
+    (parallel/mesh.shard_hbm_estimate): measured per-shard bytes
+    (argument + output + temp + alias) must stay within HBM_TOLERANCE x
+    the estimate.  Backends that expose no memory analysis are recorded on
+    the route (devicecheck marks memory=None), never silently passed as
+    reconciled."""
+
+    rule_id = "KTPU012"
+    title = "hbm-estimate: compiled memory reconciles with the PARITY budget"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if t.memory is None or t.est is None:
+                continue
+            measured = sum(
+                t.memory.get(k, 0) for k in
+                ("argument_bytes", "output_bytes", "temp_bytes",
+                 "alias_bytes")
+            )
+            per_shard = measured / max(1, t.n_shards)
+            budget = t.est.get("total", 0)
+            if budget and per_shard > HBM_TOLERANCE * budget:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    f"compiled per-shard memory {int(per_shard)} B exceeds "
+                    f"{HBM_TOLERANCE}x the analytic budget {int(budget)} B "
+                    "— the PARITY.md scale ceiling no longer holds",
+                    f"hbm:{int(per_shard)}>{HBM_TOLERANCE}x{int(budget)}",
+                ))
+        return findings
+
+
+def _sub_jaxprs(eqn):
+    """Every Jaxpr nested in an eqn's params (pjit/scan/while/cond/
+    shard_map/custom_* all stash theirs differently)."""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            inner = getattr(item, "jaxpr", item)
+            if hasattr(inner, "eqns"):
+                yield inner
+
+
+def _iter_eqn_avals(jaxpr):
+    """(eqn, outvar aval) pairs in program order, depth-first through
+    sub-jaxprs at the point of their eqn."""
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            if aval is not None:
+                yield eqn, aval
+        for sub in _sub_jaxprs(eqn):
+            yield from _iter_eqn_avals(sub)
+
+
+def collective_walk(jaxpr) -> Tuple[List[str], List[str]]:
+    """(ordered collective primitive names, cond-divergence descriptors)
+    for a jaxpr — depth-first, so the order is the canonical program order
+    every shard executes.  A `cond` contributes its FIRST branch's
+    subsequence to the main order (branches are required identical; the
+    divergence list reports when they are not)."""
+    seq: List[str] = []
+    divergences: List[str] = []
+
+    def walk(jx) -> List[str]:
+        out: List[str] = []
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "cond":
+                branches = [
+                    walk(getattr(b, "jaxpr", b))
+                    for b in eqn.params.get("branches", ())
+                ]
+                if branches:
+                    if any(b != branches[0] for b in branches[1:]):
+                        divergences.append(
+                            "/".join(",".join(b) or "-" for b in branches)
+                        )
+                    out.extend(branches[0])
+                continue
+            if name in COLLECTIVE_PRIMS:
+                out.append(name)
+            for sub in _sub_jaxprs(eqn):
+                out.extend(walk(sub))
+        return out
+
+    seq = walk(jaxpr)
+    return seq, divergences
+
+
+ALL_DEVICE_RULES = [
+    DtypeFlowRule,
+    DonationHonoredRule,
+    CollectiveSequenceRule,
+    RecompileGuardRule,
+    TransferGuardRule,
+    HbmEstimateRule,
+]
+
+DEVICE_RULE_IDS = tuple(r.rule_id for r in ALL_DEVICE_RULES)

@@ -17,6 +17,11 @@ memory (the mapping table lives in PARITY.md §"Static analysis"):
                              unseeded RNGs, or unordered-set iteration
   KTPU005 cheap-gate         O(P) builds feeding spans are gated on
                              tracer.enabled (the PR-6 contract)
+  KTPU013 knob-drift         every literal KTPU_* env READ has a row in
+                             README's "Configuration knobs" table
+
+(KTPU007..KTPU012 — the jaxpr/compiled-kernel device rules — live in
+jaxrules.py and are traced by devicecheck.py.)
 """
 
 from __future__ import annotations
@@ -348,7 +353,24 @@ class DonationAliasingRule(Rule):
     DONATION_MODULES = {
         "kubernetes_tpu/ops/assign.py",
         "kubernetes_tpu/parallel/sharded.py",
+        # the device pass (KTPU008) is the donation audit's runtime twin:
+        # its RouteTrace.from_callable re-declares callers' donate_argnums
+        # to check the COMPILED aliasing — a tracer of donation, never a
+        # new donation site for resident buffers
+        "kubernetes_tpu/analysis/devicecheck.py",
     }
+
+    # Donation audit table — modules REVIEWED for donation and found to
+    # have none on purpose (recorded here so the audit outcome is code,
+    # not PR archaeology):
+    #   ops/preempt.py — preempt_eval / preempt_eval_wave once carried a
+    #     no-op `donate_argnums=()`; dropped (this PR) instead of donating
+    #     for real: the wave's inputs are the SHARED state snapshot
+    #     (used_now/victim tables serve every same-priority preemptor and
+    #     the host's sequential commit pass re-reads them — snap2
+    #     freshness reuse), and `arr` is the encoder's resident
+    #     ClusterArrays, which the donation contract forbids consuming.
+    AUDITED_NO_DONATE = ("kubernetes_tpu/ops/preempt.py",)
 
     def check(self, mod: ModuleInfo) -> List[Finding]:
         findings: List[Finding] = []
@@ -540,10 +562,105 @@ class CheapGateRule(Rule):
         return False
 
 
+# --- KTPU013 ---
+class KnobDriftRule(Rule):
+    """KTPU013 — knob drift: every `os.environ.get("KTPU_*")` /
+    `os.getenv("KTPU_*")` / `os.environ["KTPU_*"]` READ in the package must
+    have a matching row in README's "Configuration knobs" table.  An
+    undocumented knob is a behavior switch operators cannot discover and
+    reviewers cannot audit; a documented-but-unread knob is a row the
+    stale-baseline report equivalent of this rule's inverse would flag —
+    here only the read side gates (doc-only rows may describe harness
+    FLAGS).  Writes (`os.environ[...] = ...`), `pop`, and non-literal
+    names (loops over knob tuples) are not reads and never flag."""
+
+    rule_id = "KTPU013"
+    title = "knob-drift: every KTPU_* env read has a README knob row"
+
+    SECTION = "## Configuration knobs"
+
+    def __init__(self, known_knobs: Optional[Set[str]] = None):
+        # fixture tests inject the documented set; the real pass reads the
+        # README next to the package directory
+        self._known = known_knobs
+
+    def _documented(self) -> Set[str]:
+        if self._known is not None:
+            return self._known
+        import os as _os
+
+        pkg = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        readme = _os.path.join(_os.path.dirname(pkg), "README.md")
+        try:
+            with open(readme) as f:
+                text = f.read()
+        except OSError:
+            return set()
+        # scope to the knobs table: a knob mentioned only in prose
+        # elsewhere is not a reference row.  FAIL CLOSED on a missing /
+        # renamed heading — treating the whole README as the table would
+        # silently degrade this gate to near-vacuous (any prose mention
+        # passes); an empty documented set instead flags every read loudly
+        start = text.find(self.SECTION)
+        if start < 0:
+            self._known = set()
+            return self._known
+        end = text.find("\n## ", start + len(self.SECTION))
+        text = text[start:end if end >= 0 else len(text)]
+        self._known = set(re.findall(r"KTPU_[A-Z0-9_]+", text))
+        return self._known
+
+    @staticmethod
+    def _knob_name(node: ast.AST) -> Optional[str]:
+        """The literal KTPU_* name a node READS from the process env, or
+        None."""
+        def lit(e: ast.AST) -> Optional[str]:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str) \
+                    and e.value.startswith("KTPU_") and len(e.value) > 5:
+                return e.value
+            return None
+
+        def is_environ(e: ast.AST) -> bool:
+            return isinstance(e, ast.Attribute) and e.attr == "environ"
+
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                    and is_environ(fn.value) and node.args:
+                return lit(node.args[0])
+            if isinstance(fn, ast.Attribute) and fn.attr == "getenv" \
+                    and node.args:
+                return lit(node.args[0])
+        if isinstance(node, ast.Subscript) and is_environ(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            return lit(node.slice)
+        return None
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str]] = set()
+        for node in ast.walk(mod.tree):
+            name = self._knob_name(node)
+            if name is None or name in self._documented():
+                continue
+            key = (mod.qualname(node), name)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(mod.finding(
+                self.rule_id, node,
+                f"env knob {name} is read here but has no row in README's "
+                '"Configuration knobs" table — document it or delete the '
+                "read",
+            ))
+        return findings
+
+
 ALL_RULES = [
     KillSafetyRule,
     SnapshotListRule,
     DonationAliasingRule,
     DeterminismRule,
     CheapGateRule,
+    KnobDriftRule,
 ]

@@ -580,6 +580,15 @@ def main(argv=None) -> None:
     from ._cpu import force_cpu_from_env
     from ..ops.aot import maybe_enable_compile_cache
 
+    # --verify-device wants the mesh routes: force the virtual multi-device
+    # CPU platform BEFORE jax initializes (no-op if jax is already up —
+    # the skipped mesh routes are then listed with the reason).  Must
+    # precede force_cpu_from_env, which imports jax.
+    if "--verify-device" in (argv if argv is not None else sys.argv[1:]) \
+            or os.environ.get("KTPU_VERIFY_DEVICE") == "1":
+        from ..analysis.devicecheck import ensure_devices
+
+        ensure_devices()
     force_cpu_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", help="workload YAML file")
@@ -627,6 +636,15 @@ def main(argv=None) -> None:
                          "workload and embed its JSON report in the "
                          "artifact; exits with the analyzer's code (1 "
                          "unbaselined findings / 2 unusable) on failure")
+    ap.add_argument("--verify-device", action="store_true",
+                    help="with (or implying) --verify: also run the "
+                         "ktpu-verify DEVICE pass (KTPU007..012 — trace "
+                         "every production kernel route, check dtype flow, "
+                         "donation aliasing, collective order, cache-key "
+                         "stability, transfer guard, HBM budget; "
+                         "analysis/devicecheck.py); the per-route report "
+                         "rides the artifact's verify block and the exit "
+                         "contract is shared (also via KTPU_VERIFY_DEVICE=1)")
     args = ap.parse_args(argv)
     if args.chaos_sites and args.chaos is None:
         ap.error("--chaos-sites requires --chaos (it shapes the seeded storm)")
@@ -638,12 +656,16 @@ def main(argv=None) -> None:
     # is not evidence.  The report rides the artifact; failure exits with
     # the analyzer's 1/2 code BEFORE any workload spends device time.
     verify_block = None
+    verify_device = (args.verify_device
+                     or os.environ.get("KTPU_VERIFY_DEVICE") == "1")
+    if verify_device:
+        args.verify = True  # --verify-device implies the full gate
     if args.verify:
         from ..analysis.__main__ import run_verify
         from ..analysis.engine import BaselineError
 
         try:
-            verify_report = run_verify()
+            verify_report = run_verify(device=verify_device)
         except BaselineError as e:
             print(f"ktpu-verify: unusable baseline: {e}", file=sys.stderr)
             sys.exit(2)

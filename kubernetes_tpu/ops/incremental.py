@@ -300,6 +300,19 @@ class HoistCache:
         sh = self._node_sharding()
         return jax.device_put(a, sh) if sh is not None else jax.device_put(a)
 
+    def _place_rows(self, a):
+        """Explicit placement of [N, R] usage/alloc rows entering the
+        jitted hoists — row-sharded under a mesh (the ClusterArrays
+        node_used spec), so the jit never implicitly reshards them (the
+        KTPU011 transfer-guard rule: every hot-path transfer is explicit)."""
+        if self.mesh is None:
+            return jax.device_put(a)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import NODE_AXIS
+
+        return jax.device_put(a, NamedSharding(self.mesh, P(NODE_AXIS, None)))
+
     def _place_rep(self, name: str, host: np.ndarray):
         """Replicated device copy memoized by host identity/value (the
         class index and per-class requests are identity-stable across
@@ -447,8 +460,12 @@ class HoistCache:
             dirty = np.flatnonzero((used_h != self._prev_used).any(axis=1))
         req_dev = self._place_rep("_req_ent", req_u)
         if not usage_ok or 2 * len(dirty) >= np_nodes:
-            nu = _pad_rows(used_h, pad)
-            na = _pad_rows(arr.node_alloc, pad)
+            # EXPLICIT host->device staging of the usage rows: the hoist
+            # runs on the warm hot path, which must stay clean under
+            # jax.transfer_guard("disallow") (KTPU011 — implicit transfers
+            # of jit arguments would hide a per-cycle H2D copy here)
+            nu = self._place_rows(_pad_rows(used_h, pad))
+            na = self._place_rows(_pad_rows(arr.node_alloc, pad))
             base_u, fit_u = _usage_hoist(req_dev, nu, na, cfg)
             self._usage = (self._place_node(base_u), self._place_node(fit_u))
             self.stats["full"] += 1
@@ -460,10 +477,14 @@ class HoistCache:
             action = action or "hit"
         else:
             b = _round_up_pow2(len(dirty))
-            cols = np.full(b, np_nodes, dtype=np.int32)
-            cols[: len(dirty)] = dirty
-            nu = _pad_rows(used_h, pad)
-            na = _pad_rows(arr.node_alloc, pad)
+            cols_h = np.full(b, np_nodes, dtype=np.int32)
+            cols_h[: len(dirty)] = dirty
+            # explicit staging, same KTPU011 rationale as the full hoist
+            sh_rep = self._rep_sharding()
+            cols = (jax.device_put(cols_h, sh_rep) if sh_rep is not None
+                    else jax.device_put(cols_h))
+            nu = self._place_rows(_pad_rows(used_h, pad))
+            na = self._place_rows(_pad_rows(arr.node_alloc, pad))
             base_u, fit_u = _patch_hoist(
                 self._usage[0], self._usage[1], req_dev, nu, na, cols, cfg
             )

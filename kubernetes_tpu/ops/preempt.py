@@ -27,7 +27,6 @@ path covers the fit-bound preemption that dominates at scale.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
@@ -100,14 +99,21 @@ def _eval_body(
     return cand, nvio, vmax, vsum, vcnt, is_victim, static_ok
 
 
-@partial(jax.jit, donate_argnums=())
+# DELIBERATELY NON-DONATING (KTPU003 audit table, analysis/rules.py —
+# AUDITED_NO_DONATE): every input is either the encoder's resident
+# ClusterArrays or the priority-shared state snapshot (used_now / victim
+# tables) that serves the whole same-priority wave and the host's
+# sequential commit pass afterwards — donation would consume buffers the
+# caller re-reads.  A no-op `donate_argnums=()` used to say this
+# implicitly; the audit table says it out loud.
+@jax.jit
 def preempt_eval(*args) -> Tuple[jax.Array, ...]:
     """One preemptor (see _eval_body): -> (cand, nvio, vmax, vsum, vcnt,
     is_victim)."""
     return _eval_body(*args)[:6]
 
 
-@partial(jax.jit, donate_argnums=())
+@jax.jit
 def preempt_eval_wave(
     arr: ClusterArrays,
     pod_idxs: jax.Array,  # i32[K]: the wave's preemptor rows in arr

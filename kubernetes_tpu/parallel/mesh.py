@@ -142,14 +142,27 @@ def pad_nodes(arr, n_shards: int):
 
 def shard_hbm_estimate(
     n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
-    n_terms: int = 1, chunk: int = 128,
+    n_terms: int = 1, chunk: int = 128, u_classes: Optional[int] = None,
 ) -> Dict[str, int]:
     """Per-shard device-memory estimate (bytes) for the routed kernels'
-    dominant blocks at [P, N] scale (PARITY.md HBM budget, sharded): the
-    two [P, Nl] bool masks (static feasibility + node-selection) shard
-    column-wise; the per-chunk hoist and [T, Nl] count state shard with
-    them; the chunked kernel's gathered [C, N] score matrix (plus its
-    transpose) and the [N, R] usage/alloc arrays are replicated per shard."""
+    dominant blocks (PARITY.md HBM budget, sharded): the two [P, Nl] bool
+    masks (static feasibility + node-selection) shard column-wise; the
+    per-chunk hoist and [T, Nl] count state shard with them; the chunked
+    kernel's gathered [C, N] score matrix (plus its transpose) and the
+    [N, R] usage/alloc arrays are replicated per shard.
+
+    `round_loop` covers the prefix-commit round machinery's O(C^2) blocks
+    — the [C, C, R] exclusive prefix-sum of intra-round requests (input +
+    associative-scan carry + output) and the [C, 2C] candidate/validation
+    matrices.  Replicated per shard, independent of N: negligible at
+    production scale (~1 MB at C=128 vs ~277 MB of masks) but DOMINANT at
+    the tiny scales the device pass (analysis/devicecheck.py — KTPU012)
+    traces, so the estimate stays honest at every scale the reconciliation
+    runs at.
+
+    `u_classes` (incremental routes, ops/incremental.py): adds the
+    resident [U1, Nl] class matrices (static/base/fit + the carried copy)
+    the IncState pins per shard."""
     nl = -(-n_nodes // n_shards)
     b = {
         "pn_masks": 2 * n_pods * nl,                 # sf + nodesel, bool
@@ -157,7 +170,14 @@ def shard_hbm_estimate(
         "count_state": 4 * max(1, n_terms) * nl * 4, # cnt/anti/pref/dom
         "gathered_scores": 2 * chunk * n_nodes * 4,  # [C, N] total0 + .T
         "node_side_replicated": 2 * n_nodes * n_res * 4,  # alloc + used
+        # [C, C, R] prefix-sum (x3 live copies) + [C, 2C] f32 (x2)
+        "round_loop": 3 * chunk * chunk * n_res * 4
+        + 4 * chunk * chunk * 4,
     }
+    if u_classes:
+        # stat/base/fit resident + the gathered [U1, N] carry the chunk
+        # scan rides (full N: the class hoist is stitched once per cycle)
+        b["class_matrices"] = 4 * u_classes * n_nodes * 4
     b["total"] = sum(b.values())
     return b
 

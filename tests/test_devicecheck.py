@@ -1,0 +1,454 @@
+"""ktpu-verify device pass (ISSUE 10): KTPU007..KTPU012 trace the compiled
+placement kernels and gate their invariants — dtype flow, donation
+aliasing, collective order, cache-key stability, transfer cleanliness, the
+HBM budget — plus the KTPU013 knob-drift lint.
+
+Ordering note: the parity test runs FIRST (tier-1 runs -p no:randomly, so
+file order holds): it measures kernel decisions, triggers the one full
+device pass this module pays for, and measures again — analyzed vs
+unanalyzed runs must be bit-identical and the pass must restore env +
+TRACE_COUNTS.  Every later test reuses the cached pass report."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.analysis import jaxrules
+from kubernetes_tpu.analysis.devicecheck import (
+    ROUTE_FILE,
+    RouteTrace,
+    enumerate_routes,
+    run_device_pass,
+)
+from kubernetes_tpu.analysis.engine import Baseline, Report, analyze_source
+from kubernetes_tpu.analysis.rules import KnobDriftRule
+from kubernetes_tpu.bench import workloads
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.ops.assign import TRACE_COUNTS, schedule_batch_routed
+from kubernetes_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_map
+
+_PASS_CACHE = {}
+
+
+def _full_pass() -> Report:
+    """The one full device pass this module pays for (~45 s CPU sim)."""
+    if "rep" not in _PASS_CACHE:
+        _PASS_CACHE["rep"] = run_device_pass(baseline=Baseline([]))
+    return _PASS_CACHE["rep"]
+
+
+def _decisions():
+    """Chunked-route decisions on a fixed workload — the parity probe."""
+    from kubernetes_tpu.api.delta import DeltaEncoder
+
+    snap = workloads.heterogeneous(16, 120, seed=11)
+    arr, meta = DeltaEncoder().encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    c, u = schedule_batch_routed(arr, cfg, donate=False)
+    return np.asarray(c).copy(), np.asarray(u).copy()
+
+
+# ---- tentpole acceptance: no-mutation parity + the tier-1 clean gate ----
+
+def test_device_pass_never_mutates_kernel_behavior(monkeypatch):
+    """Analyzed vs unanalyzed runs bit-identical, env + TRACE_COUNTS
+    restored — the pass is a pure observer of the kernels."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    before_c, before_u = _decisions()
+    env_before = {k: os.environ.get(k)
+                  for k in ("KTPU_FORCE_CHUNKED", "KTPU_INCREMENTAL",
+                            "KTPU_DONATE")}
+    counts_before = dict(TRACE_COUNTS)
+    rep = _full_pass()
+    assert rep is not None
+    assert {k: os.environ.get(k) for k in env_before} == env_before
+    assert dict(TRACE_COUNTS) == counts_before
+    after_c, after_u = _decisions()
+    np.testing.assert_array_equal(after_c, before_c)
+    np.testing.assert_array_equal(after_u, before_u)
+
+
+def test_committed_package_is_device_pass_clean():
+    """The tier-1 gate: every production route traces, the committed
+    package is clean under the committed (empty) baseline — the acceptance
+    criterion `--rules KTPU007,...,KTPU012` exits 0."""
+    rep = _full_pass()
+    assert rep.errors == []
+    assert rep.unbaselined == [], "\n".join(
+        f.render() for f in rep.unbaselined)
+    assert rep.exit_code == 0
+
+
+def test_every_route_listed_no_silent_skips():
+    """The report lists EVERY enumerated route; on the tier-1 8-device CPU
+    platform all 12 trace (a skip anywhere must carry a reason)."""
+    rep = _full_pass()
+    routes = {r["name"]: r for r in rep.device["routes"]}
+    assert set(routes) == {s.name for s in enumerate_routes(8)}
+    assert len(routes) == 12
+    assert rep.device["n_traced"] == 12 and rep.device["n_skipped"] == 0
+    for r in routes.values():
+        assert r["status"] == "traced"
+        assert r["warm"].get("cycles") == 3
+    # donation marks on every donated route; collectives on every mesh route
+    for r in routes.values():
+        if r["donate"]:
+            assert r["n_aliased"] or r["donor_args"], r["name"]
+        if r["n_shards"] > 1:
+            assert r["collectives"], r["name"]
+        if not r["donate"]:
+            assert r["memory"] is not None, r["name"]  # CPU exposes it
+
+
+# ---- KTPU007 dtype-flow fixtures ----
+
+def test_ktpu007_f64_promoting_kernel_detected():
+    with jax.experimental.enable_x64():
+        t = RouteTrace.from_callable(
+            "fx/f64", lambda a: a * 2.0, np.ones(4, np.float64))
+    fs = jaxrules.DtypeFlowRule().check([t])
+    assert fs and "float64" in fs[0].message
+    rep = Report(findings=fs)
+    assert rep.exit_code == 1
+
+
+def test_ktpu007_integer_lattice_bf16_narrowing_detected():
+    t = RouteTrace.from_callable(
+        "fx/bf16", lambda a: jnp.argmax(a.astype(jnp.bfloat16)),
+        jnp.arange(8, dtype=jnp.int32))
+    fs = jaxrules.DtypeFlowRule().check([t])
+    assert fs and "bfloat16" in fs[0].message
+
+
+def test_ktpu007_integer_output_demotion_detected():
+    t = RouteTrace.from_callable(
+        "fx/outf", lambda a: a.astype(jnp.float32),
+        jnp.arange(4, dtype=jnp.int32), integer_out_indices=(0,))
+    fs = jaxrules.DtypeFlowRule().check([t])
+    assert fs and "declared integer-exact" in fs[0].message
+
+
+def test_ktpu007_clean_fixture_passes():
+    t = RouteTrace.from_callable(
+        "fx/ok", lambda a: (jnp.argmax(a.astype(jnp.float32)), a + 1),
+        jnp.arange(8, dtype=jnp.int32), integer_out_indices=(0, 1))
+    assert jaxrules.DtypeFlowRule().check([t]) == []
+
+
+# ---- KTPU008 donation fixtures ----
+
+def test_ktpu008_dropped_donation_detected():
+    """A donated input the compiler cannot alias to the declared output:
+    the rule flags the silently-dropped donation (exit 1)."""
+    t = RouteTrace.from_callable(
+        "fx/drop", lambda a, b: b + 1.0, jnp.zeros(3), jnp.zeros(4),
+        donate_argnums=(0,), alias_required_out=0)
+    fs = jaxrules.DonationHonoredRule().check([t])
+    assert fs and "dropped" in fs[0].message
+
+
+def test_ktpu008_honored_donation_passes():
+    t = RouteTrace.from_callable(
+        "fx/ok", lambda a: a + 1.0, jnp.zeros((4, 4)),
+        donate_argnums=(0,), alias_required_out=0)
+    assert t.aliased == [(0, 0)]
+    assert jaxrules.DonationHonoredRule().check([t]) == []
+
+
+def test_ktpu008_nondonating_route_not_checked():
+    t = RouteTrace.from_callable(
+        "fx/nd", lambda a, b: b + 1.0, jnp.zeros(3), jnp.zeros(4),
+        alias_required_out=0)
+    assert jaxrules.DonationHonoredRule().check([t]) == []
+
+
+# ---- KTPU009 collective-sequence fixtures ----
+
+def test_ktpu009_shard_divergent_collective_detected(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    def divergent(x):
+        i = jax.lax.axis_index(NODE_AXIS)
+        return jax.lax.cond(
+            i == 0,
+            lambda v: jax.lax.psum(v, NODE_AXIS),
+            lambda v: v * 2.0,
+            x,
+        )
+
+    fn = shard_map(divergent, mesh=mesh8, in_specs=(P(NODE_AXIS),),
+                   out_specs=P(NODE_AXIS), check_rep=False)
+    t = RouteTrace.from_callable("fx/div", fn, jnp.ones(8), n_shards=8)
+    assert t.cond_divergences
+    fs = jaxrules.CollectiveSequenceRule().check([t])
+    assert any("cond branches" in f.message for f in fs)
+
+
+def test_ktpu009_uniform_collective_passes(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    fn = shard_map(lambda x: jax.lax.psum(x, NODE_AXIS), mesh=mesh8,
+                   in_specs=(P(NODE_AXIS),), out_specs=P(),
+                   check_rep=False)
+    t = RouteTrace.from_callable("fx/ok", fn, jnp.ones(8), n_shards=8)
+    assert t.collectives == ["psum"]
+    assert jaxrules.CollectiveSequenceRule().check([t]) == []
+
+
+def test_ktpu009_group_divergence_across_variants_detected(mesh8):
+    """Two traces of one (kind, mesh) group with different collective
+    sequences — trace-order nondeterminism the group check catches."""
+    from jax.sharding import PartitionSpec as P
+
+    def mk(seq_fn, name):
+        fn = shard_map(seq_fn, mesh=mesh8, in_specs=(P(NODE_AXIS),),
+                       out_specs=P(), check_rep=False)
+        return RouteTrace.from_callable(name, fn, jnp.ones(8), n_shards=8,
+                                        kind="grp")
+
+    t1 = mk(lambda x: jax.lax.psum(x, NODE_AXIS), "grp/a")
+    t2 = mk(lambda x: jax.lax.pmax(jax.lax.psum(x, NODE_AXIS), NODE_AXIS),
+            "grp/b")
+    fs = jaxrules.CollectiveSequenceRule().check([t1, t2])
+    assert any("distinct collective sequences" in f.message for f in fs)
+
+
+# ---- KTPU010 recompile-guard fixtures ----
+
+def test_ktpu010_cache_key_churning_static_arg_detected():
+    """A static arg whose value varies per warm cycle re-traces every
+    call — measured off the real jit cache, fed to the rule."""
+    f = jax.jit(lambda x, k: x + k, static_argnums=1)
+    f(jnp.zeros(4), 1)
+    s0 = f._cache_size()
+    f(jnp.zeros(4), 2)  # churned static -> new cache entry
+    s1 = f._cache_size()
+    assert s1 > s0
+    t = RouteTrace("fx/churn", kind="fixture", donate=False, n_shards=1)
+    t.warm = {"cycles": 3, "retraces": 0, "cache_growth": s1 - s0,
+              "lowered_stable": True}
+    fs = jaxrules.RecompileGuardRule().check([t])
+    assert fs and "recompile" in fs[0].message
+
+
+def test_ktpu010_unstable_lowering_detected_and_clean_passes():
+    t = RouteTrace("fx/unstable", kind="fixture", donate=False, n_shards=1)
+    t.warm = {"cycles": 3, "retraces": 0, "cache_growth": 0,
+              "lowered_stable": False}
+    assert jaxrules.RecompileGuardRule().check([t])
+    t2 = RouteTrace("fx/ok", kind="fixture", donate=False, n_shards=1)
+    t2.warm = {"cycles": 3, "retraces": 0, "cache_growth": 0,
+               "lowered_stable": True}
+    assert jaxrules.RecompileGuardRule().check([t2]) == []
+
+
+# ---- KTPU011 transfer-guard fixtures ----
+
+def test_ktpu011_implicit_transfer_detected():
+    violation = None
+    try:
+        with jax.transfer_guard_host_to_device("disallow"):
+            _ = (jnp.asarray(np.ones(4)) + 1).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        violation = str(e)
+    assert violation and "disallow" in violation.lower()
+    t = RouteTrace("fx/transfer", kind="fixture", donate=False, n_shards=1)
+    t.transfer_violation = violation
+    fs = jaxrules.TransferGuardRule().check([t])
+    assert fs and "implicit host<->device transfer" in fs[0].message
+    t2 = RouteTrace("fx/ok", kind="fixture", donate=False, n_shards=1)
+    assert jaxrules.TransferGuardRule().check([t2]) == []
+
+
+# ---- KTPU012 hbm-estimate fixtures ----
+
+def test_ktpu012_budget_overrun_detected_and_tolerance_passes():
+    t = RouteTrace("fx/hbm", kind="fixture", donate=False, n_shards=1)
+    t.est = {"total": 1000}
+    t.memory = {"argument_bytes": 0, "output_bytes": 0,
+                "temp_bytes": int(1000 * jaxrules.HBM_TOLERANCE * 2),
+                "alias_bytes": 0}
+    fs = jaxrules.HbmEstimateRule().check([t])
+    assert fs and "exceeds" in fs[0].message
+    t.memory["temp_bytes"] = int(1000 * jaxrules.HBM_TOLERANCE) - 1
+    assert jaxrules.HbmEstimateRule().check([t]) == []
+    t.memory = None  # backend without memory analysis: recorded, no guess
+    assert jaxrules.HbmEstimateRule().check([t]) == []
+
+
+# ---- KTPU013 knob-drift fixtures ----
+
+def _knob_findings(source, known):
+    return analyze_source(source, "kubernetes_tpu/scheduler/fx.py",
+                          [KnobDriftRule(known_knobs=known)])
+
+
+def test_ktpu013_undocumented_knob_read_detected():
+    src = 'import os\nV = os.environ.get("KTPU_SECRET_KNOB", "1")\n'
+    fs = _knob_findings(src, {"KTPU_DOCUMENTED"})
+    assert fs and "KTPU_SECRET_KNOB" in fs[0].message
+    # all three read forms flag
+    for form in ('os.getenv("KTPU_SECRET_KNOB")',
+                 'os.environ["KTPU_SECRET_KNOB"]'):
+        fs = _knob_findings(f"import os\nV = {form}\n", set())
+        assert fs, form
+
+
+def test_ktpu013_documented_and_non_reads_pass():
+    src = (
+        "import os\n"
+        'A = os.environ.get("KTPU_DOCUMENTED")\n'          # documented
+        'os.environ["KTPU_SECRET_KNOB"] = "1"\n'           # write
+        'os.environ.pop("KTPU_SECRET_KNOB", None)\n'       # pop
+        "for var in KNOBS:\n    os.environ.get(var)\n"     # non-literal
+    )
+    assert _knob_findings(src, {"KTPU_DOCUMENTED"}) == []
+
+
+def test_ktpu013_package_has_no_knob_drift():
+    """Every KTPU_* env read in the committed package has a README row."""
+    from kubernetes_tpu.analysis.__main__ import default_root, resolve_root
+    from kubernetes_tpu.analysis.engine import analyze_package
+
+    rep = analyze_package(resolve_root(default_root()),
+                          rules=[KnobDriftRule()], lockorder=False)
+    assert rep.errors == []
+    assert rep.findings == [], "\n".join(f.render() for f in rep.findings)
+
+
+# ---- CLI + harness wiring ----
+
+def _canned_report():
+    rep = Report(rules=list(jaxrules.DEVICE_RULE_IDS))
+    rep.device = {"routes": [], "n_traced": 0, "n_skipped": 0}
+    return rep
+
+
+def test_cli_device_rule_subset_routes_to_device_pass(monkeypatch, capsys,
+                                                      tmp_path):
+    """--rules KTPU007 skips the AST walk and runs ONLY the device pass
+    (canned here — the real pass is paid once above)."""
+    from kubernetes_tpu.analysis import __main__ as cli
+    from kubernetes_tpu.analysis import devicecheck
+
+    calls = {}
+
+    def fake_pass(rule_ids=None, baseline=None, mesh_size=8):
+        calls["rule_ids"] = list(rule_ids or [])
+        return _canned_report()
+
+    monkeypatch.setattr(devicecheck, "run_device_pass", fake_pass)
+    out = tmp_path / "rep.json"
+    rc = cli.main(["--rules", "KTPU007,KTPU011", "--format", "json",
+                   "--output", str(out)])
+    assert rc == 0
+    assert calls["rule_ids"] == ["KTPU007", "KTPU011"]
+    import json
+
+    doc = json.loads(out.read_text())
+    assert "device" in doc and doc["exit_code"] == 0
+    # the AST rules did NOT run on a pure device subset
+    assert "KTPU001" not in doc["rules"]
+
+
+def test_cli_device_flag_unions_with_ast_rules_subset(monkeypatch, capsys):
+    """--device combined with an AST-only --rules subset must still run
+    the device pass (all six device rules), not silently drop it."""
+    from kubernetes_tpu.analysis import __main__ as cli
+    from kubernetes_tpu.analysis import devicecheck
+
+    calls = {}
+
+    def fake_pass(rule_ids=None, baseline=None, mesh_size=8):
+        calls["rule_ids"] = list(rule_ids or [])
+        return _canned_report()
+
+    monkeypatch.setattr(devicecheck, "run_device_pass", fake_pass)
+    rc = cli.main(["--rules", "KTPU013", "--device", "--format", "json"])
+    capsys.readouterr()
+    assert rc == 0
+    assert calls["rule_ids"] == list(jaxrules.DEVICE_RULE_IDS)
+
+
+def test_ktpu013_missing_readme_section_fails_closed(monkeypatch):
+    """A renamed/missing "Configuration knobs" heading must flag every
+    read (empty documented set), never degrade to a whole-README scan
+    where any prose mention passes."""
+    rule = KnobDriftRule()
+    monkeypatch.setattr(type(rule), "SECTION", "## No Such Heading XYZ")
+    src = 'import os\nV = os.environ.get("KTPU_MESH")\n'  # prose-documented
+    fs = analyze_source(src, "kubernetes_tpu/scheduler/fx.py", [rule])
+    assert fs and "KTPU_MESH" in fs[0].message
+
+
+def test_cli_unknown_device_rule_id_refused():
+    from kubernetes_tpu.analysis import __main__ as cli
+
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["--rules", "KTPU099"])
+    assert ei.value.code == 2
+
+
+def test_harness_verify_device_embeds_report(monkeypatch, tmp_path):
+    """--verify-device implies --verify and ships the device block in the
+    artifact's verify report (canned pass — wiring only)."""
+    from kubernetes_tpu.analysis import __main__ as cli
+    from kubernetes_tpu.bench import harness
+
+    seen = {}
+
+    def fake_verify(root=None, baseline_path=None, device=False):
+        seen["device"] = device
+        return _canned_report()
+
+    monkeypatch.setattr(cli, "run_verify", fake_verify)
+    yaml = tmp_path / "tiny.yaml"
+    yaml.write_text(
+        "name: Tiny\nops:\n"
+        "  - {op: createCluster, generator: basic, nodes: 8, pods: 16}\n"
+        "  - {op: measure}\n"
+    )
+    out = tmp_path / "out.json"
+    harness.main(["--config", str(yaml), "--out", str(out),
+                  "--verify-device"])
+    assert seen["device"] is True
+    import json
+
+    doc = json.loads(out.read_text())
+
+    def find_verify(d):
+        if isinstance(d, dict):
+            if "verify" in d:
+                return d["verify"]
+            for v in d.values():
+                r = find_verify(v)
+                if r is not None:
+                    return r
+        if isinstance(d, list):
+            for v in d:
+                r = find_verify(v)
+                if r is not None:
+                    return r
+        return None
+
+    v = find_verify(doc)
+    assert v is not None and "device" in v
+
+
+# ---- finding identity ----
+
+def test_device_finding_fingerprints_are_route_stable():
+    """Two findings for the same (rule, route, detail) share a
+    fingerprint regardless of construction order — baselines key on the
+    violated property, not a source line."""
+    from kubernetes_tpu.analysis.jaxrules import _finding
+
+    t = RouteTrace("chunked/donate/single", kind="chunked", donate=True,
+                   n_shards=1)
+    a = _finding(t, "KTPU008", "msg one", "missing-alias-out1")
+    b = _finding(t, "KTPU008", "msg two (reworded)", "missing-alias-out1")
+    assert a.fingerprint == b.fingerprint
+    assert a.file == ROUTE_FILE

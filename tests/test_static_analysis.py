@@ -554,6 +554,7 @@ def test_cli_json_artifact(tmp_path, capsys):
     assert doc["exit_code"] == 0
     assert set(doc["rules"]) == {
         "KTPU001", "KTPU002", "KTPU003", "KTPU004", "KTPU005", "KTPU006",
+        "KTPU013",
     }
     assert json.loads(capsys.readouterr().out)["n_unbaselined"] == 0
 
