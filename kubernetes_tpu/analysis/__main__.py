@@ -8,8 +8,13 @@ and gates on the baseline.
   python -m kubernetes_tpu.analysis --write-baseline     # draft suppressions
   python -m kubernetes_tpu.analysis --lock-graph         # dump KTPU006 graph
   python -m kubernetes_tpu.analysis --device             # + device pass
+  python -m kubernetes_tpu.analysis --shard              # + shard pass
+  python -m kubernetes_tpu.analysis --device --shard     # the full verify
+                                                         # gate (one trace)
   python -m kubernetes_tpu.analysis --rules KTPU007,KTPU008,KTPU009,KTPU010,KTPU011,KTPU012
                                                          # device pass only
+  python -m kubernetes_tpu.analysis --rules KTPU014,KTPU015,KTPU016,KTPU017,KTPU018
+                                                         # shard pass only
 
 Exit-code contract (bench/regression.py's): 0 clean (all findings
 baselined), 1 unbaselined findings, 2 unusable (parse failure, malformed
@@ -53,25 +58,43 @@ def resolve_root(root: str) -> str:
 
 
 def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None,
-               device: bool = False):
+               device: bool = False, shard: bool = False):
     """The shared gate: load the committed baseline and run the full pass —
     the AST rules, plus the DEVICE pass (KTPU007..012, devicecheck.py)
-    when `device` is set.  Used by this CLI and by `bench.harness
-    --verify[-device]`, so both exits follow ONE contract.  Raises
-    BaselineError (exit 2) on an unusable baseline."""
+    when `device` is set, plus the SHARD pass (KTPU014..018, shardcheck.py)
+    when `shard` is set — the two trace passes share one 12-route trace.
+    Used by this CLI and by `bench.harness --verify[-device|-shard]`, so
+    every exit follows ONE contract.  Raises BaselineError (exit 2) on an
+    unusable baseline."""
     from .engine import Baseline, analyze_package, apply_baseline
 
     baseline = Baseline.load(baseline_path or default_baseline())
     report = analyze_package(resolve_root(root or default_root()),
-                             baseline=None if device else baseline)
-    if device:
-        from .devicecheck import run_device_pass
+                             baseline=None if (device or shard) else baseline)
+    if device or shard:
+        pretraced = None
+        if device and shard:
+            from .devicecheck import collect_traces
 
-        dev = run_device_pass(baseline=None)
-        report.findings.extend(dev.findings)
-        report.errors.extend(dev.errors)
-        report.rules = report.rules + dev.rules
-        report.device = dev.device
+            pretraced = collect_traces()
+        if device:
+            from .devicecheck import run_device_pass
+
+            dev = run_device_pass(baseline=None, pretraced=pretraced)
+            report.findings.extend(dev.findings)
+            report.errors.extend(dev.errors)
+            report.rules = report.rules + dev.rules
+            report.device = dev.device
+        if shard:
+            from .shardcheck import run_shard_pass
+
+            shd = run_shard_pass(baseline=None, pretraced=pretraced)
+            report.findings.extend(shd.findings)
+            report.errors.extend(shd.errors)
+            report.rules = report.rules + shd.rules
+            if shd.device is not None:
+                report.device = shd.device
+        report.errors = list(dict.fromkeys(report.errors))
         apply_baseline(report, baseline)
     return report
 
@@ -80,6 +103,7 @@ def main(argv=None) -> int:
     from .engine import Baseline, BaselineError, analyze_package, apply_baseline
     from .jaxrules import DEVICE_RULE_IDS
     from .rules import ALL_RULES
+    from .shardcheck import SHARD_RULE_IDS
 
     ap = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.analysis",
@@ -98,12 +122,20 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default="",
                     help="comma-separated rule ids to run (default: all AST "
                          "rules; naming a KTPU007..012 id also runs the "
-                         "device pass for it)")
+                         "device pass for it, a KTPU014..018 id the shard "
+                         "pass)")
     ap.add_argument("--device", action="store_true",
                     help="also run the device pass (KTPU007..012 — trace "
                          "every production kernel route and check the "
                          "compiled invariants; compiles kernels, takes "
                          "~1 min on the CPU sim)")
+    ap.add_argument("--shard", action="store_true",
+                    help="also run the shard pass (KTPU014..018 — the "
+                         "partition-rule-table authority scan plus the "
+                         "replicated-giant / axis-consistency / "
+                         "comm-reconciliation / out-sharding gates over "
+                         "the traced routes; shares the route traces with "
+                         "--device, so --device --shard traces once)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write a draft baseline covering every unbaselined "
                          "finding (reasons left TODO — fill them in)")
@@ -125,9 +157,11 @@ def main(argv=None) -> int:
     rules = [cls() for cls in ALL_RULES]
     lockorder = True
     device_ids = list(DEVICE_RULE_IDS) if args.device else []
+    shard_ids = list(SHARD_RULE_IDS) if args.shard else []
     if args.rules:
         want = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
-        known = {r.rule_id for r in rules} | {"KTPU006"} | set(DEVICE_RULE_IDS)
+        known = ({r.rule_id for r in rules} | {"KTPU006"}
+                 | set(DEVICE_RULE_IDS) | set(SHARD_RULE_IDS))
         unknown = sorted(want - known)
         if unknown:
             # a typoed id would otherwise select ZERO rules and exit 0 —
@@ -136,10 +170,12 @@ def main(argv=None) -> int:
                      f"(known: {', '.join(sorted(known))})")
         rules = [r for r in rules if r.rule_id in want]
         lockorder = "KTPU006" in want  # --rules subsets really subset
-        # --device UNIONS with a --rules subset: an AST-only subset must
-        # not silently drop the device pass the flag explicitly requested
+        # --device/--shard UNION with a --rules subset: an AST-only subset
+        # must not silently drop a pass the flag explicitly requested
         named = [r for r in DEVICE_RULE_IDS if r in want]
         device_ids = named or device_ids
+        named_shard = [r for r in SHARD_RULE_IDS if r in want]
+        shard_ids = named_shard or shard_ids
 
     baseline = None
     if not args.no_baseline:
@@ -156,20 +192,45 @@ def main(argv=None) -> int:
         report = analyze_package(args.root, rules=rules, baseline=None,
                                  lockorder=lockorder)
     else:
-        # a pure device-rule subset (--rules KTPU007,...) skips the AST
-        # walk entirely — subsets really subset
+        # a pure device/shard-rule subset (--rules KTPU007,... /
+        # KTPU014,...) skips the package AST walk entirely — subsets
+        # really subset (KTPU014 scans modules inside its own pass)
         from .engine import Report
 
         report = Report(rules=[])
+    # --device and --shard share ONE 12-route trace when both will trace
+    pretraced = None
+    shard_traces = any(r != "KTPU014" for r in shard_ids)
+    if device_ids and shard_traces:
+        from .devicecheck import collect_traces
+
+        pretraced = collect_traces()
     if device_ids:
         from .devicecheck import run_device_pass
 
-        dev = run_device_pass(rule_ids=device_ids, baseline=None)
+        if pretraced is not None:
+            dev = run_device_pass(rule_ids=device_ids, baseline=None,
+                                  pretraced=pretraced)
+        else:
+            dev = run_device_pass(rule_ids=device_ids, baseline=None)
         report.findings.extend(dev.findings)
         report.errors.extend(dev.errors)
         report.rules = report.rules + dev.rules
         report.files_scanned = max(report.files_scanned, dev.files_scanned)
         report.device = dev.device
+    if shard_ids:
+        from .shardcheck import run_shard_pass
+
+        shd = run_shard_pass(rule_ids=shard_ids, baseline=None,
+                             pretraced=pretraced, root=args.root)
+        report.findings.extend(shd.findings)
+        report.errors.extend(shd.errors)
+        report.rules = report.rules + shd.rules
+        report.files_scanned = max(report.files_scanned, shd.files_scanned)
+        if shd.device is not None:
+            report.device = shd.device
+    # shared traces surface the same trace errors in both passes — dedupe
+    report.errors = list(dict.fromkeys(report.errors))
     report = apply_baseline(report, baseline)
 
     if args.write_baseline:

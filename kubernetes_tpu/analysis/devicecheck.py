@@ -101,6 +101,19 @@ class RouteTrace:
     memory: Optional[Dict[str, int]] = None
     est: Optional[Dict[str, int]] = None
     workload: Dict[str, Any] = field(default_factory=dict)
+    # ---- shard-pass capture (analysis/shardcheck.py, KTPU015..018) ----
+    # per resident buffer entering the program: {qualname, shape, itemsize,
+    # spec, dims} — resolved through the partition rule table
+    shard_fields: List[Dict[str, Any]] = field(default_factory=list)
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    # ordered (collective prim, output bytes) pairs from the jaxpr walk —
+    # the measured side of the KTPU017 comm reconciliation
+    collective_bytes: List[Tuple[str, int]] = field(default_factory=list)
+    comm_est: Optional[Dict[str, int]] = None
+    # per kernel output: {declared, compiled, equivalent} — compiled
+    # shardings vs the table's out.* rows (KTPU018); None = not captured
+    # (single-device route, or backend exposing no output shardings)
+    out_sharding_report: Optional[List[Dict[str, Any]]] = None
 
     def capture(self, jaxpr_fn, jaxpr_args, jitted_fn, lower_args):
         """Fill the program-capture fields — jaxpr + collective walk,
@@ -111,13 +124,14 @@ class RouteTrace:
         memory analysis."""
         import jax
 
-        from .jaxrules import collective_walk
+        from .jaxrules import collective_bytes, collective_walk
 
         closed = jax.make_jaxpr(jaxpr_fn)(*jaxpr_args)
         self.jaxpr = closed
         self.out_avals = tuple(closed.out_avals)
         self.collectives, self.cond_divergences = collective_walk(
             closed.jaxpr)
+        self.collective_bytes = collective_bytes(closed.jaxpr)
         with _quiet_donation():
             lowered = jitted_fn.lower(*lower_args)
         self.lowered_text = lowered.as_text()
@@ -158,6 +172,17 @@ class RouteTrace:
             "transfer_violation": self.transfer_violation,
             "memory": self.memory, "est": self.est,
             "workload": dict(self.workload),
+            # the per-route shard report (KTPU015..018 artifacts)
+            "shard": {
+                "n_fields": len(self.shard_fields),
+                "mesh_axes": dict(self.mesh_axes),
+                "collective_bytes": [
+                    [p, int(b)] for p, b in self.collective_bytes],
+                "comm_bytes_measured": int(
+                    sum(b for _p, b in self.collective_bytes)),
+                "comm_est": self.comm_est,
+                "out_shardings": self.out_sharding_report,
+            },
         }
 
 
@@ -202,7 +227,14 @@ def _memory_stats(lowered) -> Optional[Dict[str, int]]:
     no memory analysis (KTPU012 records the route as unreconciled instead
     of guessing)."""
     try:
-        ma = lowered.compile().memory_analysis()
+        return _memory_of_compiled(lowered.compile())
+    except Exception:
+        return None
+
+
+def _memory_of_compiled(compiled) -> Optional[Dict[str, int]]:
+    try:
+        ma = compiled.memory_analysis()
     except Exception:
         return None
     if ma is None:
@@ -216,6 +248,91 @@ def _memory_stats(lowered) -> Optional[Dict[str, int]]:
         }
     except AttributeError:
         return None
+
+
+def _out_sharding_report(compiled, mesh, declared, out_ndims) -> Optional[list]:
+    """Per-output {declared, compiled, equivalent} — the KTPU018 capture.
+    `declared` is the ordered list of out.* table qualnames for this
+    route's outputs; `out_ndims` their ranks (from the captured out_avals).
+    Backends/jax versions exposing no output shardings record None
+    (reported unreconciled, never silently passed)."""
+    from ..parallel.partition_rules import sharding_for
+
+    try:
+        outs = list(compiled.output_shardings)
+    except Exception:
+        return None
+    report = []
+    for qualname, sh, ndim in zip(declared, outs, out_ndims):
+        want = sharding_for(mesh, qualname)
+        try:
+            eq = bool(sh.is_equivalent_to(want, ndim))
+        except Exception:
+            eq = None
+        report.append({
+            "declared": qualname,
+            "compiled": repr(sh),
+            "equivalent": eq,
+        })
+    return report
+
+
+def _shard_field_report(arr, inc, image_sharded: bool) -> list:
+    """Per resident buffer: qualname, concrete shape, itemsize, resolved
+    spec (through the partition rule table), dims symbols — what KTPU015
+    (replicated-giant) and KTPU016 (axis-consistency) check per route."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from ..parallel.partition_rules import (
+        FIELD_DIMS, clusterarrays_specs, spec_for,
+    )
+
+    out = []
+    specs = clusterarrays_specs(image_sharded)
+    missing = [
+        f"arr.{f.name}" for f in _dc.fields(type(arr))
+        if f"arr.{f.name}" not in FIELD_DIMS
+    ]
+    if missing:
+        # fail CLOSED, matching spec_for: a resident field outside the
+        # size model would silently escape KTPU015/016 — make it a trace
+        # error (exit 2), not a quiet coverage hole
+        raise ValueError(
+            f"resident field(s) missing from partition_rules.FIELD_DIMS: "
+            f"{missing} — add dims/itemsize rows next to the field's "
+            "partition rule"
+        )
+    for f in _dc.fields(type(arr)):
+        q = f"arr.{f.name}"
+        a = np.asarray(getattr(arr, f.name))
+        dims = FIELD_DIMS[q][0]
+        if f.name == "image_score" and not image_sharded:
+            # the [P, 1] broadcast form: the node dim is a constant 1, not
+            # an N-scaling axis (the real [P, N] matrix shards on nodes)
+            dims = ("P", "_1")
+        out.append({
+            "qualname": q,
+            "shape": tuple(int(s) for s in a.shape),
+            "itemsize": int(a.dtype.itemsize),
+            "spec": tuple(getattr(specs, f.name)),
+            "dims": dims,
+        })
+    if inc is not None:
+        for name in inc._fields:
+            v = getattr(inc, name)
+            if v is None:
+                continue
+            q = f"inc.{name}"
+            out.append({
+                "qualname": q,
+                "shape": tuple(int(s) for s in v.shape),
+                "itemsize": int(v.dtype.itemsize),
+                "spec": tuple(spec_for(q)),
+                "dims": FIELD_DIMS[q][0],
+            })
+    return out
 
 
 def _route_snapshot(kind: str):
@@ -383,19 +500,47 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
         jaxpr_fn, jaxpr_args = fn, lower_args
     lowered = t.capture(jaxpr_fn, jaxpr_args, fn, lower_args)
     if not spec.donate:
-        t.memory = _memory_stats(lowered)
+        try:
+            compiled = lowered.compile()
+        except Exception:
+            compiled = None
+        if compiled is not None:
+            t.memory = _memory_of_compiled(compiled)
+            if mesh is not None:
+                # KTPU018: the compiled outputs vs the table's out.* rows
+                declared = ["out.assignment", "out.node_used"]
+                t.out_sharding_report = _out_sharding_report(
+                    compiled, mesh, declared,
+                    [len(a.shape) for a in t.out_avals],
+                )
 
     chunk = {"chunked": A._CHUNK, "inc": A._INC_CHUNK,
              "rounds": A._RCHUNK}[spec.kind]
+    u1 = int(inc.req_u.shape[0]) if inc is not None else None
     t.est = shard_hbm_estimate(
         arr.P, arr.N, spec.n_shards, n_res=arr.R,
         n_terms=arr.term_counts0.shape[0], chunk=chunk,
-        u_classes=(int(inc.req_u.shape[0]) if inc is not None else None),
+        u_classes=u1,
     )
+    # ---- shard-pass capture: resident-buffer report + comm budget ----
+    from ..parallel.mesh import shard_comm_estimate
+
+    img = arr.image_score.shape[1] == arr.N
+    t.shard_fields = _shard_field_report(arr, inc, img)
+    t.mesh_axes = (
+        {str(k): int(v) for k, v in mesh.shape.items()}
+        if mesh is not None else {}
+    )
+    if mesh is not None:
+        t.comm_est = shard_comm_estimate(
+            arr.P, arr.N, spec.n_shards, n_res=arr.R,
+            n_terms=arr.term_counts0.shape[0], chunk=chunk,
+            u_classes=u1, kind=spec.kind,
+        )
     t.workload = {
         "P": int(arr.P), "N": int(arr.N), "R": int(arr.R),
         "T": int(arr.term_counts0.shape[0]), "chunk": int(chunk),
-        "U1": int(inc.req_u.shape[0]) if inc is not None else None,
+        "U1": u1,
     }
 
     # ---- warm loop: cold cycle + two guarded warm deltas ----
@@ -494,16 +639,37 @@ def _pass_env():
         A.TRACE_COUNTS.update(saved_counts)
 
 
+def collect_traces(mesh_size: int = 8) -> Tuple[List[RouteTrace], List[str]]:
+    """Trace every production route once: (traces, errors).  The one trace
+    collector the device pass (KTPU007..012) and the shard pass
+    (KTPU014..018, analysis/shardcheck.py) share — `--device --shard` pays
+    a single 12-route trace, and the two passes can never check different
+    captures."""
+    ensure_devices(mesh_size)
+    traces: List[RouteTrace] = []
+    errors: List[str] = []
+    with _pass_env():
+        for spec in enumerate_routes(mesh_size):
+            try:
+                traces.append(trace_route(spec))
+            except Exception as e:  # noqa: BLE001 — lost coverage = exit 2
+                errors.append(
+                    f"{spec.name}: trace failed: {type(e).__name__}: {e}")
+    return traces, errors
+
+
 def run_device_pass(rule_ids: Optional[Sequence[str]] = None,
                     baseline: Optional[Baseline] = None,
-                    mesh_size: int = 8) -> Report:
+                    mesh_size: int = 8,
+                    pretraced: Optional[Tuple[List[RouteTrace], List[str]]] = None,
+                    ) -> Report:
     """Trace every production route and run the (selected) device rules.
 
     Returns an engine.Report (same fingerprint/baseline/exit contract as
     the AST pass) whose `device` block lists EVERY route with its status —
-    no silent route skips.  A route that raises is an ERROR (exit 2)."""
-    ensure_devices(mesh_size)
-
+    no silent route skips.  A route that raises is an ERROR (exit 2).
+    `pretraced` reuses a collect_traces() result (the CLI's shared-trace
+    path when --device and --shard both run)."""
     from .jaxrules import ALL_DEVICE_RULES
 
     rules = [cls() for cls in ALL_DEVICE_RULES]
@@ -511,14 +677,10 @@ def run_device_pass(rule_ids: Optional[Sequence[str]] = None,
         want = {r.upper() for r in rule_ids}
         rules = [r for r in rules if r.rule_id in want]
     report = Report(rules=[r.rule_id for r in rules])
-    traces: List[RouteTrace] = []
-    with _pass_env():
-        for spec in enumerate_routes(mesh_size):
-            try:
-                traces.append(trace_route(spec))
-            except Exception as e:  # noqa: BLE001 — lost coverage = exit 2
-                report.errors.append(
-                    f"{spec.name}: trace failed: {type(e).__name__}: {e}")
+    traces, trace_errors = (
+        pretraced if pretraced is not None else collect_traces(mesh_size)
+    )
+    report.errors.extend(trace_errors)
     report.files_scanned = len([t for t in traces if t.status == "traced"])
     for r in rules:
         try:

@@ -396,6 +396,45 @@ def collective_walk(jaxpr) -> Tuple[List[str], List[str]]:
     return seq, divergences
 
 
+def collective_bytes(jaxpr) -> List[Tuple[str, int]]:
+    """Ordered (collective primitive, output bytes) pairs for a jaxpr —
+    the measured side of the KTPU017 comm reconciliation
+    (analysis/shardcheck.py).  Depth-first in canonical program order, one
+    entry per collective EQN (static program bytes: a collective inside a
+    scan/while body counts once, matching shard_comm_estimate's
+    definition); bytes are the eqn's summed output aval sizes — the
+    traffic each shard stitches at that point."""
+    out: List[Tuple[str, int]] = []
+
+    def eqn_bytes(eqn) -> int:
+        total = 0
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            size = getattr(aval, "size", None)
+            dtype = getattr(aval, "dtype", None)
+            if size is not None and dtype is not None:
+                total += int(size) * int(dtype.itemsize)
+        return total
+
+    def walk(jx) -> None:
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "cond":
+                # branches are required identical (KTPU009): count the
+                # FIRST branch's subsequence, same rule as collective_walk
+                branches = eqn.params.get("branches", ())
+                if branches:
+                    walk(getattr(branches[0], "jaxpr", branches[0]))
+                continue
+            if name in COLLECTIVE_PRIMS:
+                out.append((name, eqn_bytes(eqn)))
+            for sub in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
 ALL_DEVICE_RULES = [
     DtypeFlowRule,
     DonationHonoredRule,

@@ -1,0 +1,428 @@
+"""ktpu-verify shard pass — KTPU014..KTPU018: the sharding-flow gates.
+
+PR 4 sharded the node axis; ROADMAP 3 (2-D pods x nodes mesh, 500k x 100k)
+is blocked on sharding plumbing nobody could *check*.  This pass makes the
+declarative rule table (parallel/partition_rules.py) enforceable: one AST
+rule guarantees the table is the only spec authority, and four trace rules
+— riding the SAME twelve-route tracer as the device pass
+(analysis/devicecheck.py — collect_traces) and the same
+fingerprint/baseline/0-1-2 exit contract — prove every compiled program
+obeys what the table declares:
+
+  KTPU014 rule-table-resolution  any NamedSharding / PartitionSpec literal
+                                 or device_put(..., sharding=) outside
+                                 parallel/partition_rules.py is a finding —
+                                 the KTPU003-style "one blessed module"
+                                 rule for placement truth
+  KTPU015 replicated-giant       a resident buffer whose dims scale with
+                                 P, N, or U x N left fully replicated above
+                                 an analytic byte threshold (at the
+                                 ROADMAP-3 target dims) — today's
+                                 replicated pod-axis buffers become tracked
+                                 findings with REQUIRED-reason baselines
+                                 the 2-D mesh PR burns down, not invisible
+                                 debt
+  KTPU016 axis-consistency       every PartitionSpec axis name exists in
+                                 the mesh; node-scaling dims map to the
+                                 node axis (and only them); sharded dims
+                                 divide the axis size after padding
+  KTPU017 comm-reconciliation    per-route collective bytes measured from
+                                 the captured jaxpr reconcile within
+                                 COMM_TOLERANCE with the analytic
+                                 parallel/mesh.shard_comm_estimate — an
+                                 accidental extra all-gather per warm
+                                 cycle becomes exit 1
+  KTPU018 out-sharding drift     the compiled outputs' shardings match the
+                                 table's declared out.* rows — a compiler
+                                 decision to replicate a sharded output
+                                 cannot silently pass
+
+Entry points: run_shard_pass() (CLI `python -m kubernetes_tpu.analysis
+--shard` / `--rules KTPU014,...`, `bench.harness --verify-shard` /
+KTPU_VERIFY_SHARD=1); the rules operate on devicecheck.RouteTrace objects
+(fixture tests build synthetic ones).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Baseline, Finding, ModuleInfo, Report, Rule, call_name
+
+# anchor for table-derived findings: the rule table IS the fix site
+TABLE_FILE = "kubernetes_tpu/parallel/partition_rules.py"
+
+# KTPU015: a replicated resident buffer above this many analytic bytes at
+# the ROADMAP-3 target dims (partition_rules.SCALE_DIMS — 500k pods x 100k
+# nodes) is a tracked scaling debt.  1 MiB: every multi-byte pod-axis
+# vector crosses it at 500k pods; vocabulary-axis tables never do.
+REPLICATED_GIANT_BYTES = 1 << 20
+
+# KTPU017: measured static-program collective bytes may exceed the
+# analytic shard_comm_estimate by at most this factor (stated tolerance —
+# the estimate models the dominant stitches, not every scalar pmax; same
+# contract as jaxrules.HBM_TOLERANCE for KTPU012).
+COMM_TOLERANCE = 4.0
+
+
+# --------------------------------------------------------------------------
+# KTPU014 — AST: the rule table is the ONLY spec authority
+# --------------------------------------------------------------------------
+
+
+class ShardSpecLiteralRule(Rule):
+    """KTPU014 — placement truth lives in parallel/partition_rules.py and
+    nowhere else: flags (a) any ``NamedSharding(...)`` or
+    ``PartitionSpec(...)`` construction (through any import alias) outside
+    the blessed module, (b) any ``device_put(..., sharding=...)`` keyword
+    placement outside it.  Call sites receive specs/shardings from the
+    table's resolvers (spec_for / sharding_for / clusterarrays_shardings);
+    a literal anywhere else is a second spec authority waiting to drift —
+    the KTPU003 "audited module" pattern applied to sharding."""
+
+    rule_id = "KTPU014"
+    title = "rule-table-resolution: PartitionSpec literals only in the table"
+
+    BLESSED = {TABLE_FILE}
+    _SPEC_NAMES = {"NamedSharding", "PartitionSpec", "GSPMDSharding",
+                   "PositionalSharding"}
+
+    def _aliases(self, mod: ModuleInfo) -> Set[str]:
+        """Module-local names bound to jax sharding constructors via
+        ``from jax.sharding import PartitionSpec as P`` style imports."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and "sharding" in node.module:
+                for alias in node.names:
+                    if alias.name in self._SPEC_NAMES:
+                        out.add(alias.asname or alias.name)
+        return out
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        if mod.relpath in self.BLESSED:
+            return []
+        aliases = self._aliases(mod)
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in self._SPEC_NAMES or name in aliases:
+                findings.append(mod.finding(
+                    self.rule_id, node,
+                    f"{name}(...) literal outside the partition rule table "
+                    "— resolve the spec through parallel/partition_rules "
+                    "(spec_for/sharding_for); one table, one truth",
+                ))
+            elif name == "device_put" and any(
+                    kw.arg == "sharding" for kw in node.keywords):
+                findings.append(mod.finding(
+                    self.rule_id, node,
+                    "device_put(..., sharding=) outside the partition rule "
+                    "table — pass a table-resolved sharding positionally "
+                    "from sharding_for/clusterarrays_shardings",
+                ))
+        return findings
+
+
+# --------------------------------------------------------------------------
+# trace rules (RouteTrace-driven, devicecheck.collect_traces)
+# --------------------------------------------------------------------------
+
+
+class ShardTraceRule:
+    """Base for the trace-driven shard rules: check(traces) over the full
+    RouteTrace list, same shape as jaxrules.DeviceRule."""
+
+    rule_id = "KTPU000"
+    title = ""
+
+    def check(self, traces: Sequence) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _route_finding(trace, rule_id: str, message: str, detail: str) -> Finding:
+    """Route-anchored finding (fingerprint = rule | route file | route name
+    | detail — survives kernel edits that keep the violated property)."""
+    return Finding(
+        rule=rule_id, message=message, file=trace.file, line=0,
+        func=trace.name, snippet=detail,
+    )
+
+
+def _field_finding(rule_id: str, qualname: str, message: str,
+                   detail: str) -> Finding:
+    """Field-anchored finding: keyed to the rule table row, NOT the route —
+    one replicated pod-axis buffer is one piece of debt however many routes
+    carry it, so one baseline entry covers it."""
+    return Finding(
+        rule=rule_id, message=message, file=TABLE_FILE, line=0,
+        func=qualname, snippet=detail,
+    )
+
+
+def _scaled_bytes(entry: Dict) -> int:
+    """Analytic bytes of one shard-report entry at the ROADMAP-3 target
+    dims: scale symbols (P/N/U) at SCALE_DIMS, vocabulary symbols at their
+    CANONICAL_DIMS size (workload-independent, so the finding set — and
+    therefore the committed baseline — never moves with the traced
+    workload), unknown symbols at 1."""
+    from ..parallel.partition_rules import CANONICAL_DIMS, SCALE_DIMS
+
+    total = int(entry["itemsize"])
+    for sym in entry["dims"]:
+        total *= SCALE_DIMS.get(sym) or CANONICAL_DIMS.get(sym, 1)
+    return total
+
+
+class ReplicatedGiantRule(ShardTraceRule):
+    """KTPU015 — the exact ROADMAP-3a gap, as a gate: any resident buffer
+    (arr.* / inc.*) a mesh route carries FULLY REPLICATED whose dims scale
+    with P, N, or U, above REPLICATED_GIANT_BYTES at the target dims.
+    Deduped per field across routes; legitimately-replicated-for-now
+    buffers carry REQUIRED-reason baseline entries naming the 2-D mesh
+    follow-up, so the debt is enumerated, visible, and burnable."""
+
+    rule_id = "KTPU015"
+    title = "replicated-giant: no P/N/U-scaling buffer left fully replicated"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        from ..parallel.partition_rules import NODE_AXIS, SCALE_SYMBOLS
+
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+        for t in traces:
+            if t.n_shards <= 1:
+                continue
+            for entry in t.shard_fields:
+                q = entry["qualname"]
+                if q in seen:
+                    continue
+                spec = tuple(entry["spec"])
+                if NODE_AXIS in spec:
+                    continue  # sharded — not replicated debt
+                scaling = [s for s in entry["dims"] if s in SCALE_SYMBOLS]
+                if not scaling:
+                    continue  # vocabulary-axis table, bounded by design
+                size = _scaled_bytes(entry)
+                if size <= REPLICATED_GIANT_BYTES:
+                    continue
+                seen.add(q)
+                findings.append(_field_finding(
+                    self.rule_id, q,
+                    f"{q} ({'x'.join(entry['dims'])}) is fully replicated "
+                    f"across the mesh at ~{size // (1 << 20)} MiB per shard "
+                    "(ROADMAP-3 target dims) — shard it or baseline it "
+                    "with the follow-up that will",
+                    f"replicated-giant:{q}:{'x'.join(entry['dims'])}",
+                ))
+        return findings
+
+
+class AxisConsistencyRule(ShardTraceRule):
+    """KTPU016 — the spec/mesh/shape contract, per traced route: (a) every
+    axis a spec names exists in the mesh; (b) the node axis shards exactly
+    the node-scaling dimension (a spec placing "nodes" on a vocabulary dim
+    is a silent wrong-axis reshard); (c) the sharded dimension divides the
+    axis size (padding must have happened before placement)."""
+
+    rule_id = "KTPU016"
+    title = "axis-consistency: spec axes exist, map to N, and divide"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        from ..parallel.partition_rules import NODE_AXIS
+
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def once(key: str) -> bool:
+            if key in seen:
+                return False
+            seen.add(key)
+            return True
+
+        for t in traces:
+            if t.n_shards <= 1 or not t.mesh_axes:
+                continue
+            for entry in t.shard_fields:
+                q = entry["qualname"]
+                spec = tuple(entry["spec"])
+                shape = tuple(entry["shape"])
+                dims = tuple(entry["dims"])
+                for axis in spec:
+                    if axis is None:
+                        continue
+                    if axis not in t.mesh_axes and once(f"axis:{q}:{axis}"):
+                        findings.append(_route_finding(
+                            t, self.rule_id,
+                            f"{q}: spec axis {axis!r} does not exist in the "
+                            f"mesh (axes: {sorted(t.mesh_axes)}) — the "
+                            "placement silently replicates",
+                            f"unknown-axis:{q}:{axis}",
+                        ))
+                if NODE_AXIS in spec:
+                    k = spec.index(NODE_AXIS)
+                    if k < len(dims) and dims[k] != "N" \
+                            and once(f"map:{q}"):
+                        findings.append(_route_finding(
+                            t, self.rule_id,
+                            f"{q}: the node axis shards dim {k} "
+                            f"({dims[k]!r}), not the node-scaling "
+                            "dimension — wrong-axis sharding",
+                            f"node-axis-mismap:{q}:{k}",
+                        ))
+                    n_ax = t.mesh_axes.get(NODE_AXIS, t.n_shards)
+                    if k < len(shape) and shape[k] % max(1, n_ax) \
+                            and once(f"div:{q}"):
+                        findings.append(_route_finding(
+                            t, self.rule_id,
+                            f"{q}: sharded dim {k} (size {shape[k]}) does "
+                            f"not divide the {NODE_AXIS} axis size {n_ax} "
+                            "— the route ran unpadded",
+                            f"indivisible:{q}:{shape[k]}%{n_ax}",
+                        ))
+        return findings
+
+
+class CommReconcileRule(ShardTraceRule):
+    """KTPU017 — collective traffic is a checked number: the static-program
+    collective bytes measured from the captured jaxpr
+    (jaxrules.collective_bytes — one entry per collective eqn at its
+    output size) must stay within COMM_TOLERANCE x the analytic
+    parallel/mesh.shard_comm_estimate for the route.  An accidental extra
+    all-gather of the [C, N] score block roughly doubles the measured side
+    and breaches the budget — exit 1, not a silent ICI tax."""
+
+    rule_id = "KTPU017"
+    title = "comm-reconciliation: collective bytes within the analytic budget"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if t.n_shards <= 1 or t.comm_est is None:
+                continue
+            budget = int(t.comm_est.get("total", 0))
+            measured = int(sum(b for _p, b in t.collective_bytes))
+            if budget and measured > COMM_TOLERANCE * budget:
+                top = sorted(t.collective_bytes, key=lambda pb: -pb[1])[:3]
+                findings.append(_route_finding(
+                    t, self.rule_id,
+                    f"measured collective bytes {measured} exceed "
+                    f"{COMM_TOLERANCE}x the analytic budget {budget} "
+                    f"(largest: {', '.join(f'{p}={b}' for p, b in top)}) — "
+                    "an unbudgeted collective entered the program",
+                    f"comm:{measured}>{COMM_TOLERANCE}x{budget}",
+                ))
+        return findings
+
+
+class OutShardingDriftRule(ShardTraceRule):
+    """KTPU018 — the compiled executable's output shardings must realize
+    the table's out.* rows: GSPMD is free to re-layout internals, but an
+    output the table declares node-sharded coming back replicated (or vice
+    versa) changes every consumer's transfer profile without failing a
+    single test.  Routes whose backend exposes no output shardings are
+    recorded unreconciled on the route report — never silently passed."""
+
+    rule_id = "KTPU018"
+    title = "out-sharding: compiled outputs match the declared table rows"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if not t.out_sharding_report:
+                continue
+            for i, entry in enumerate(t.out_sharding_report):
+                if entry.get("equivalent") is False:
+                    findings.append(_route_finding(
+                        t, self.rule_id,
+                        f"compiled output {i} drifted from the declared "
+                        f"{entry['declared']} spec (compiled: "
+                        f"{entry['compiled']}) — the compiler overrode the "
+                        "table",
+                        f"out-drift:{i}:{entry['declared']}",
+                    ))
+        return findings
+
+
+ALL_SHARD_TRACE_RULES = [
+    ReplicatedGiantRule,
+    AxisConsistencyRule,
+    CommReconcileRule,
+    OutShardingDriftRule,
+]
+
+SHARD_RULE_IDS = ("KTPU014",) + tuple(r.rule_id for r in ALL_SHARD_TRACE_RULES)
+
+
+# --------------------------------------------------------------------------
+# the pass
+# --------------------------------------------------------------------------
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_shard_pass(rule_ids: Optional[Sequence[str]] = None,
+                   baseline: Optional[Baseline] = None,
+                   mesh_size: int = 8,
+                   pretraced: Optional[Tuple[list, List[str]]] = None,
+                   root: Optional[str] = None) -> Report:
+    """Run the (selected) shard rules: the KTPU014 AST scan over the
+    package plus the KTPU015..018 trace rules over the twelve production
+    routes (devicecheck.collect_traces — shared with the device pass via
+    `pretraced`, so `--device --shard` traces once).  Same report/
+    fingerprint/baseline/exit contract as the other passes; a route that
+    fails to trace is an ERROR (exit 2), never a silent skip."""
+    from .engine import apply_baseline, load_modules
+
+    want = (
+        {r.upper() for r in rule_ids} if rule_ids is not None
+        else set(SHARD_RULE_IDS)
+    )
+    selected = [r for r in SHARD_RULE_IDS if r in want]
+    report = Report(rules=selected)
+
+    if "KTPU014" in want:
+        mods, load_errors = load_modules(root or _package_root())
+        report.errors.extend(load_errors)
+        report.files_scanned = len(mods)
+        rule = ShardSpecLiteralRule()
+        for mod in mods:
+            try:
+                report.findings.extend(rule.check(mod))
+            except Exception as e:  # a rule bug must not pass as "clean"
+                report.errors.append(
+                    f"{mod.relpath}: rule KTPU014 crashed: "
+                    f"{type(e).__name__}: {e}")
+
+    trace_rules = [cls() for cls in ALL_SHARD_TRACE_RULES
+                   if cls.rule_id in want]
+    if trace_rules:
+        if pretraced is not None:
+            traces, trace_errors = pretraced
+        else:
+            from .devicecheck import collect_traces
+
+            traces, trace_errors = collect_traces(mesh_size)
+        report.errors.extend(trace_errors)
+        n_traced = sum(1 for t in traces if t.status == "traced")
+        report.files_scanned = max(report.files_scanned, n_traced)
+        for r in trace_rules:
+            try:
+                report.findings.extend(r.check(traces))
+            except Exception as e:
+                report.errors.append(
+                    f"shard rule {r.rule_id} crashed: "
+                    f"{type(e).__name__}: {e}")
+        report.device = {
+            "routes": [t.to_dict() for t in traces],
+            "n_traced": n_traced,
+            "n_skipped": sum(1 for t in traces if t.status == "skipped"),
+        }
+    apply_baseline(report, baseline)
+    return report

@@ -814,8 +814,8 @@ class DeltaEncoder:
         self._cs: Optional[ClusterSide] = None
         self._dev: Dict[str, Tuple] = {}  # field -> (host array, device array)
         self.stats = {"full": 0, "delta": 0, "verified": 0}
-        # device mesh for resident-buffer placement (set_mesh): node-axis
-        # arrays are placed with NamedSharding so the sharded step reads
+        # device mesh for resident-buffer placement (set_mesh): arrays are
+        # placed per the partition rule table so the sharded step reads
         # them in place — warm deltas re-place only changed fields' shards
         self._mesh = None
         self._pad_memo: Dict[str, Tuple] = {}
@@ -879,9 +879,10 @@ class DeltaEncoder:
         self._dev.clear()
 
     def set_mesh(self, mesh) -> None:
-        """Place all subsequent device buffers over `mesh`: node-axis arrays
-        sharded per parallel/sharded.py's spec table (NamedSharding), the
-        rest replicated — so a mesh-routed step (ops/assign.py —
+        """Place all subsequent device buffers over `mesh`: every field's
+        sharding resolved through the declarative partition rule table
+        (parallel/partition_rules.py, via field_shardings) — so a
+        mesh-routed step (ops/assign.py —
         schedule_batch_routed(mesh=)) reads the RESIDENT shards in place and
         a warm-cycle delta re-places only the changed fields, never
         gathering or re-scattering the cluster side.  Node counts not

@@ -580,12 +580,14 @@ def main(argv=None) -> None:
     from ._cpu import force_cpu_from_env
     from ..ops.aot import maybe_enable_compile_cache
 
-    # --verify-device wants the mesh routes: force the virtual multi-device
-    # CPU platform BEFORE jax initializes (no-op if jax is already up —
-    # the skipped mesh routes are then listed with the reason).  Must
-    # precede force_cpu_from_env, which imports jax.
-    if "--verify-device" in (argv if argv is not None else sys.argv[1:]) \
-            or os.environ.get("KTPU_VERIFY_DEVICE") == "1":
+    # --verify-device/--verify-shard want the mesh routes: force the
+    # virtual multi-device CPU platform BEFORE jax initializes (no-op if
+    # jax is already up — the skipped mesh routes are then listed with the
+    # reason).  Must precede force_cpu_from_env, which imports jax.
+    _early_argv = argv if argv is not None else sys.argv[1:]
+    if "--verify-device" in _early_argv or "--verify-shard" in _early_argv \
+            or os.environ.get("KTPU_VERIFY_DEVICE") == "1" \
+            or os.environ.get("KTPU_VERIFY_SHARD") == "1":
         from ..analysis.devicecheck import ensure_devices
 
         ensure_devices()
@@ -645,6 +647,17 @@ def main(argv=None) -> None:
                          "analysis/devicecheck.py); the per-route report "
                          "rides the artifact's verify block and the exit "
                          "contract is shared (also via KTPU_VERIFY_DEVICE=1)")
+    ap.add_argument("--verify-shard", action="store_true",
+                    help="with (or implying) --verify: also run the "
+                         "ktpu-verify SHARD pass (KTPU014..018 — the "
+                         "partition-rule-table authority scan plus "
+                         "replicated-giant, axis-consistency, collective-"
+                         "bytes reconciliation and out-sharding gates over "
+                         "the traced routes; analysis/shardcheck.py); the "
+                         "per-route shard report rides the artifact's "
+                         "verify block, the route traces are shared with "
+                         "--verify-device, and the exit contract is shared "
+                         "(also via KTPU_VERIFY_SHARD=1)")
     args = ap.parse_args(argv)
     if args.chaos_sites and args.chaos is None:
         ap.error("--chaos-sites requires --chaos (it shapes the seeded storm)")
@@ -658,14 +671,17 @@ def main(argv=None) -> None:
     verify_block = None
     verify_device = (args.verify_device
                      or os.environ.get("KTPU_VERIFY_DEVICE") == "1")
-    if verify_device:
-        args.verify = True  # --verify-device implies the full gate
+    verify_shard = (args.verify_shard
+                    or os.environ.get("KTPU_VERIFY_SHARD") == "1")
+    if verify_device or verify_shard:
+        args.verify = True  # --verify-device/--verify-shard imply the gate
     if args.verify:
         from ..analysis.__main__ import run_verify
         from ..analysis.engine import BaselineError
 
         try:
-            verify_report = run_verify(device=verify_device)
+            verify_report = run_verify(device=verify_device,
+                                       shard=verify_shard)
         except BaselineError as e:
             print(f"ktpu-verify: unusable baseline: {e}", file=sys.stderr)
             sys.exit(2)
@@ -760,9 +776,20 @@ def main(argv=None) -> None:
         """ktpu-verify blocks on the artifact: the embedded static-analysis
         report (--verify) and, under KTPU_LOCK_CHECK=1, the runtime
         lock-order graph observed during the run — a storm that closed a
-        cycle ships the witnesses next to its chaos counts."""
+        cycle ships the witnesses next to its chaos counts.  With the shard
+        pass, the worst per-route measured collective bytes are also
+        stamped top-level as `comm_bytes`, so `bench.regression --metric
+        comm_bytes --higher-is-better=no` gates the all-gather budget
+        alongside step time."""
         if verify_block is not None:
             doc["verify"] = verify_block
+            routes = (verify_block.get("device") or {}).get("routes", [])
+            comm = [
+                r.get("shard", {}).get("comm_bytes_measured", 0)
+                for r in routes if r.get("n_shards", 1) > 1
+            ]
+            if comm:
+                doc["comm_bytes"] = max(comm)
         from ..analysis import lockcheck
 
         if lockcheck.enabled():

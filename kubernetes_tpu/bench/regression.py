@@ -19,9 +19,14 @@ Usage:
 
 Default metric is step_s (lower is better — the warm device step the
 BENCH_r01–r06 trajectory tracks); --metric value --higher-is-better gates
-on throughput instead.  Prior runs missing the metric or on another box
-are skipped with a note (the r01/r02 real-TPU artifacts predate step_s),
-never failed on — only the CURRENT run's record is load-bearing.
+on throughput instead, and --metric comm_bytes gates the per-route
+collective-traffic budget the shard pass measures (the harness stamps the
+worst mesh route's measured bytes top-level under --verify-shard, so an
+accidental extra all-gather regression-gates alongside step time).
+Dotted metric names traverse nested blocks (e.g. verify.n_unbaselined).
+Prior runs missing the metric or on another box are skipped with a note
+(the r01/r02 real-TPU artifacts predate step_s), never failed on — only
+the CURRENT run's record is load-bearing.
 """
 
 from __future__ import annotations
@@ -74,7 +79,17 @@ def load_trajectory(dir_: str, pattern: str) -> List[Tuple[str, Dict]]:
 
 
 def _metric(rec: Dict, name: str) -> Optional[float]:
-    v = rec.get(name)
+    """Numeric metric from a record.  `name` may be a dotted path into
+    nested blocks (e.g. `verify.device.n_traced`); the flat top-level form
+    covers the stamped scalars — `step_s`, `value`, and `comm_bytes` (the
+    worst per-route measured collective bytes the harness stamps from the
+    shard pass, so the all-gather budget regression-gates exactly like
+    step time: `--metric comm_bytes`)."""
+    v: object = rec
+    for part in name.split("."):
+        if not isinstance(v, dict):
+            return None
+        v = v.get(part)
     if isinstance(v, (int, float)) and not isinstance(v, bool):
         return float(v)
     return None

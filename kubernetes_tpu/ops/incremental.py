@@ -20,8 +20,9 @@ the DEVICE step incremental too, with two stacked levers:
    static side (feasibility masks, taint/node-affinity raws — stable across
    warm cycles while node labels/taints and the wave's class set hold) and
    a usage-DEPENDENT side (fit + balanced base scores + fit mask).  Both
-   stay RESIDENT on device across cycles (NamedSharding-placed under a
-   mesh, like the DeltaEncoder's buffers).  On a warm cycle only the
+   stay RESIDENT on device across cycles (placed per the partition rule
+   table under a mesh, like the DeltaEncoder's buffers).  On a warm cycle
+   only the
    columns of nodes whose usage changed since the previous encode — the
    dirty set, diffed against the encoder's previous node_used and
    cross-checked with the dirty-node set api/delta.py tracks — are
@@ -210,24 +211,15 @@ _EMPTY = np.empty(0, dtype=np.int64)
 
 
 def inc_partition_specs(inc: IncState):
-    """PartitionSpec tree matching `inc`'s populated structure: node-axis
-    class matrices shard with the ClusterArrays node fields; the class
-    index and per-class requests replicate (parallel/sharded.py in_specs)."""
-    from jax.sharding import PartitionSpec as P
+    """PartitionSpec tree matching `inc`'s populated structure, resolved
+    through the declarative rule table (parallel/partition_rules.py —
+    the inc.* rows): node-axis class matrices shard with the ClusterArrays
+    node fields; the class index and per-class requests replicate."""
+    from ..parallel.partition_rules import incstate_specs
 
-    from ..parallel.mesh import NODE_AXIS
-
-    ns = P(None, NODE_AXIS)
-    return IncState(
-        cls=P(),
-        req_u=P(None, None),
-        stat_u=ns,
-        base_u=ns,
-        fit_u=ns,
-        elig_u=ns if inc.elig_u is not None else None,
-        traw_u=ns if inc.traw_u is not None else None,
-        naraw_u=ns if inc.naraw_u is not None else None,
-        img_u=ns if inc.img_u is not None else None,
+    return incstate_specs(
+        inc.elig_u is not None, inc.traw_u is not None,
+        inc.naraw_u is not None, inc.img_u is not None,
     )
 
 
@@ -277,22 +269,21 @@ class HoistCache:
         }
         self.history = []
 
-    # -- placement helpers --
+    # -- placement helpers (specs resolved through the partition rule
+    # table — parallel/partition_rules.py, the KTPU014 single authority) --
     def _node_sharding(self):
         if self.mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.partition_rules import sharding_for
 
-        from ..parallel.mesh import NODE_AXIS
-
-        return NamedSharding(self.mesh, P(None, NODE_AXIS))
+        return sharding_for(self.mesh, "inc.stat_u")
 
     def _rep_sharding(self):
         if self.mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.partition_rules import sharding_for
 
-        return NamedSharding(self.mesh, P())
+        return sharding_for(self.mesh, "inc.cls")
 
     def _place_node(self, a):
         if a is None:
@@ -303,15 +294,13 @@ class HoistCache:
     def _place_rows(self, a):
         """Explicit placement of [N, R] usage/alloc rows entering the
         jitted hoists — row-sharded under a mesh (the ClusterArrays
-        node_used spec), so the jit never implicitly reshards them (the
+        node_used table row), so the jit never implicitly reshards them (the
         KTPU011 transfer-guard rule: every hot-path transfer is explicit)."""
         if self.mesh is None:
             return jax.device_put(a)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.partition_rules import sharding_for
 
-        from ..parallel.mesh import NODE_AXIS
-
-        return jax.device_put(a, NamedSharding(self.mesh, P(NODE_AXIS, None)))
+        return jax.device_put(a, sharding_for(self.mesh, "arr.node_used"))
 
     def _place_rep(self, name: str, host: np.ndarray):
         """Replicated device copy memoized by host identity/value (the
@@ -479,10 +468,15 @@ class HoistCache:
             b = _round_up_pow2(len(dirty))
             cols_h = np.full(b, np_nodes, dtype=np.int32)
             cols_h[: len(dirty)] = dirty
-            # explicit staging, same KTPU011 rationale as the full hoist
-            sh_rep = self._rep_sharding()
-            cols = (jax.device_put(cols_h, sh_rep) if sh_rep is not None
-                    else jax.device_put(cols_h))
+            # explicit staging, same KTPU011 rationale as the full hoist;
+            # placement through the table's hoist.cols row (replicated)
+            if self.mesh is not None:
+                from ..parallel.partition_rules import sharding_for
+
+                cols = jax.device_put(
+                    cols_h, sharding_for(self.mesh, "hoist.cols"))
+            else:
+                cols = jax.device_put(cols_h)
             nu = self._place_rows(_pad_rows(used_h, pad))
             na = self._place_rows(_pad_rows(arr.node_alloc, pad))
             base_u, fit_u = _patch_hoist(

@@ -1,4 +1,15 @@
-from .mesh import make_mesh, mesh_from_env, pad_nodes, shard_hbm_estimate  # noqa: F401
+from .mesh import (  # noqa: F401
+    make_mesh,
+    mesh_from_env,
+    pad_nodes,
+    shard_comm_estimate,
+    shard_hbm_estimate,
+)
+from .partition_rules import (  # noqa: F401
+    PARTITION_RULES,
+    sharding_for,
+    spec_for,
+)
 from .pipeline import PipelinedBatchLoop, PipelinedRunner, run_serial  # noqa: F401
 from .sharded import (  # noqa: F401
     field_shardings,

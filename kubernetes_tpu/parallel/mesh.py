@@ -16,7 +16,9 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh
 
-NODE_AXIS = "nodes"
+# the mesh axis name + rule table live in partition_rules (the single
+# source of sharding truth); re-exported here for the existing import sites
+from .partition_rules import NODE_AXIS, node_axis_fields  # noqa: F401
 
 # jax moved shard_map out of experimental around 0.5; alias whichever this
 # runtime has so the sharded paths work on both (the seed's bare
@@ -27,24 +29,16 @@ try:
 except AttributeError:  # jax <= 0.4.x
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
-# ClusterArrays fields carrying the node axis, with (axis, pad fill).  The
-# fill values replicate the encoder's own bucketing padding (api/delta.py —
-# _assemble: node_valid False is the master gate, so padded nodes are
-# statically infeasible for every pod and can never attain a normalization
-# extreme or win an argmax; node_dom's fill is resolved per-array to the
-# "key absent" sentinel D).  image_score pads on axis 1 only when it is a
-# real [P, N] matrix.
-NODE_AXIS_FIELDS: Dict[str, Tuple[int, object]] = {
-    "node_valid": (0, False),
-    "node_alloc": (0, 0),
-    "node_used": (0, 0),
-    "node_unsched": (0, False),
-    "node_labels": (0, 0),
-    "node_taint_ns": (0, False),
-    "node_taint_pref": (0, False),
-    "node_dom": (1, None),  # None -> D sentinel, resolved per array set
-    "node_ports0": (0, False),
-}
+# ClusterArrays fields carrying the node axis, with (axis, pad fill) —
+# DERIVED from the partition rule table (a field is padded on exactly the
+# axis the table shards), no longer maintained in parallel with the specs.
+# The fill values replicate the encoder's own bucketing padding
+# (api/delta.py — _assemble: node_valid False is the master gate, so padded
+# nodes are statically infeasible for every pod and can never attain a
+# normalization extreme or win an argmax; node_dom's fill is resolved
+# per-array to the "key absent" sentinel D).  image_score pads on axis 1
+# only when it is a real [P, N] matrix.
+NODE_AXIS_FIELDS: Dict[str, Tuple[int, object]] = node_axis_fields()
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -178,6 +172,55 @@ def shard_hbm_estimate(
         # stat/base/fit resident + the gathered [U1, N] carry the chunk
         # scan rides (full N: the class hoist is stitched once per cycle)
         b["class_matrices"] = 4 * u_classes * n_nodes * 4
+    # the resident INPUT set (every ClusterArrays field + the IncState
+    # matrices), summed from the per-field size model the partition rule
+    # table derives — the same model KTPU015's replicated-giant threshold
+    # and shard_comm_estimate consume, so the three can never drift onto
+    # different field sets (previously these argument bytes were simply
+    # missing from the hand-listed term sum)
+    from .partition_rules import resident_input_bytes
+
+    b["resident_inputs"] = resident_input_bytes(
+        n_pods, n_nodes, n_shards, n_res=n_res, n_terms=n_terms,
+        u_classes=u_classes,
+    )
+    b["total"] = sum(b.values())
+    return b
+
+
+def shard_comm_estimate(
+    n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
+    n_terms: int = 1, chunk: int = 128, u_classes: Optional[int] = None,
+    kind: str = "chunked",
+) -> Dict[str, int]:
+    """Analytic per-shard collective-traffic estimate (bytes) for ONE traced
+    program of the sharded routed kernels — the KTPU017 reconciliation
+    budget, sibling to shard_hbm_estimate (KTPU012).  Bytes are STATIC
+    program bytes: each collective in the traced jaxpr counts once at its
+    output size (the same definition analysis/shardcheck.collective_bytes
+    measures), so the two sides reconcile on one number.
+
+    Terms (what the kernels stitch across shards per program):
+
+      ``gathered_scores``  the shard-local [C, Nl] hoist blocks all-gather
+                           to the full [C, N] score matrix the commit scan
+                           reads (raw + masked copies ride the same stitch)
+      ``commit_psums``     owner-shard psum broadcasts of committed pods'
+                           domain/usage columns and the scan's scalar
+                           reductions (pmax/pmin argmax stitches) — [C, N]
+                           and [C, R]-scale blocks
+      ``class_stitch``     incremental routes: the [U1, N] class-matrix
+                           gather the per-cycle hoist stitches once
+
+    The estimate models the dominant blocks, not every scalar pmax; the
+    KTPU017 tolerance (analysis/shardcheck.COMM_TOLERANCE) absorbs the
+    rest, exactly as HBM_TOLERANCE does for KTPU012."""
+    b = {
+        "gathered_scores": 2 * chunk * n_nodes * 4,
+        "commit_psums": 2 * chunk * n_nodes * 4 + 4 * chunk * n_res * 4,
+    }
+    if u_classes and kind == "inc":
+        b["class_stitch"] = 4 * u_classes * n_nodes * 4
     b["total"] = sum(b.values())
     return b
 
@@ -208,9 +251,9 @@ def global_arrays(mesh: Mesh, tree):
     for multi-controller jit: every [*, N]/[N, *] array must enter a global-
     mesh program as a jax.Array spanning processes; each process contributes
     its addressable shards from its full local copy."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .partition_rules import replicated_sharding
 
-    rep = NamedSharding(mesh, P())
+    rep = replicated_sharding(mesh)
 
     def lift(x):
         return jax.make_array_from_process_local_data(rep, x)

@@ -1,0 +1,360 @@
+"""The declarative sharding rule table — the SINGLE source of placement truth.
+
+Before this module, per-field ``NamedSharding``/``PartitionSpec`` literals
+were hand-spread across ``parallel/sharded.py`` (a 40-line spec pytree),
+``ops/incremental.py`` (three placement helpers), ``parallel/mesh.py``
+(``NODE_AXIS_FIELDS`` maintained in parallel with the specs), and
+``parallel/ring.py`` — four copies of one fact, none checkable.  This module
+replaces them with an ordered regex -> ``PartitionSpec`` rule table in the
+``match_partition_rules`` style (SNIPPETS.md [2]): every resident-buffer
+placement — ``DeltaEncoder`` device buffers, ``HoistCache`` class matrices,
+the sharded jit wrappers' in/out specs, the ring stages — resolves through
+``spec_for(qualname)``, and the ktpu-verify shard pass
+(``analysis/shardcheck.py``, KTPU014..018) proves every compiled program
+obeys what the table declares.
+
+Qualname convention (the rule keys):
+
+  ``arr.<field>``    ClusterArrays resident fields (api/snapshot.py)
+  ``inc.<field>``    IncState resident class matrices (ops/incremental.py)
+  ``out.<name>``     kernel outputs of the sharded routed step
+  ``ring.<name>``    ring/all-to-all stage buffers (parallel/ring.py)
+  ``hoist.<name>``   HoistCache staging vectors (dirty-column ids)
+  ``mesh.replicated``  the multi-host global-array lift (parallel/mesh.py)
+
+Adding a field is ONE row here (regex, spec, dims, itemsize); everything
+else — ``NODE_AXIS_FIELDS`` padding, the per-field size model feeding
+``shard_hbm_estimate``/``shard_comm_estimate`` and the KTPU015
+replicated-giant threshold math, the sharded wrappers' specs — derives from
+the row, and the shard pass fails closed on an unmatched qualname instead
+of silently replicating.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as _dc_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+# The mesh axis names.  NODE_AXIS lives here (not parallel/mesh.py) so the
+# table is import-cycle-free; mesh.py re-exports it for existing callers.
+NODE_AXIS = "nodes"
+MESH_AXES = (NODE_AXIS,)
+
+# Scale-dimension symbols: axes whose size grows with the cluster (pods,
+# nodes, equivalence classes).  Everything else ("R", "T", "L", ...) is a
+# vocabulary axis bounded by spec diversity, not cluster size.
+SCALE_SYMBOLS = ("P", "N", "U")
+
+# ROADMAP-3 target dims for the KTPU015 replicated-giant analysis: the 2-D
+# pods x nodes mesh item is sized at 500k pods x 100k nodes; U extrapolates
+# the measured class counts (U ~ 101 at 50k pods, BENCH_r06).
+SCALE_DIMS: Dict[str, int] = {"P": 500_000, "N": 100_000, "U": 1_024}
+
+# Canonical secondary-dimension sizes for the analytic size model.  These
+# deliberately replace the per-workload traced sizes so the KTPU015 finding
+# set (and therefore the committed baseline) is workload-independent.
+CANONICAL_DIMS: Dict[str, int] = {
+    "R": 4, "T": 8, "L": 16, "TT": 2, "PW": 2, "T2": 8, "MM": 2,
+    "A1": 1, "A2": 1, "B": 2, "C": 2, "PT": 4, "S": 32, "E": 4,
+    "D1": 64, "K": 4, "G": 64,
+}
+
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """One row of the table: first regex match wins (``match_partition_rules``
+    semantics).  ``pad_fill`` is the node-axis padding fill for fields the
+    derived ``NODE_AXIS_FIELDS`` covers (None -> the per-array D sentinel)."""
+
+    pattern: str
+    spec: P
+    pad_fill: object = 0
+
+    def matches(self, qualname: str) -> bool:
+        return re.search(self.pattern, qualname) is not None
+
+
+# --------------------------------------------------------------------------
+# THE TABLE.  Ordered; first match wins; no match is an error (fail closed).
+# --------------------------------------------------------------------------
+
+PARTITION_RULES: Tuple[PartitionRule, ...] = (
+    # --- ClusterArrays node-axis resident fields (shard over the mesh) ---
+    PartitionRule(r"^arr\.node_(valid|unsched)$", P(NODE_AXIS)),
+    PartitionRule(r"^arr\.node_dom$", P(None, NODE_AXIS), pad_fill=None),
+    PartitionRule(
+        r"^arr\.(node_alloc|node_used|node_labels|node_taint_ns"
+        r"|node_taint_pref|node_ports0)$",
+        P(NODE_AXIS, None),
+    ),
+    # [P, N] image-locality matrix: node-sharded when it is a real matrix;
+    # clusterarrays_specs() degrades it to replicated for the [P, 1]
+    # broadcast form (the shape-conditional rule, snippet-style)
+    PartitionRule(r"^arr\.image_score$", P(None, NODE_AXIS)),
+    # --- ClusterArrays pod/vocab fields (replicated: the ROADMAP-3a debt
+    # KTPU015 tracks — the 2-D pods x nodes mesh will shard the pod axis) ---
+    PartitionRule(r"^arr\.sel_mask$", P(None, None, None)),
+    PartitionRule(
+        r"^arr\.(pod_valid|pod_prio|pod_nodename|pod_has_sel|pod_group"
+        r"|group_min|term_key)$",
+        P(),
+    ),
+    # the remaining 2-D pod/vocab matrices, ENUMERATED (no catch-all: an
+    # unlisted future field must fail spec_for loudly, not replicate
+    # silently — the fail-closed contract KTPU014/16 build on)
+    PartitionRule(
+        r"^arr\.(pod_req|pod_tol_ns|pod_tol_pref|pod_terms|sel_kind"
+        r"|pod_pref_terms|pod_pref_weights|m_pend|pod_match_terms"
+        r"|pod_match_vals|pod_aff_self|term_counts0|anti_counts0"
+        r"|pod_aff_terms|pod_anti_terms|pod_pref_aff_terms|pod_pref_aff_w"
+        r"|pref_own0|pod_spread_terms|pod_spread_maxskew|pod_spread_hard"
+        r"|pod_ports)$",
+        P(None, None),
+    ),
+    # --- IncState resident class matrices (ops/incremental.py) ---
+    PartitionRule(r"^inc\.cls$", P()),
+    PartitionRule(r"^inc\.req_u$", P(None, None)),
+    PartitionRule(r"^inc\..*_u$", P(None, NODE_AXIS)),
+    # --- sharded routed-step outputs (parallel/sharded.py out_specs) ---
+    PartitionRule(r"^out\.node_used_scan$", P(NODE_AXIS, None)),
+    PartitionRule(r"^out\.(assignment|node_used|ordinals|n_commits)$", P()),
+    # --- ring / all-to-all stages (parallel/ring.py) ---
+    PartitionRule(r"^ring\.sel_mask$", P(NODE_AXIS, None, None)),
+    PartitionRule(r"^ring\.(sel_kind|labels|match_out|a2a_in)$",
+                  P(NODE_AXIS, None)),
+    PartitionRule(r"^ring\.a2a_out$", P(None, NODE_AXIS)),
+    # --- host-staging vectors + the multi-host replicated lift ---
+    PartitionRule(r"^(hoist\.cols|mesh\.replicated)$", P()),
+)
+
+
+# --------------------------------------------------------------------------
+# Per-field size model (dims symbols x itemsize) — shared by
+# shard_hbm_estimate / shard_comm_estimate (parallel/mesh.py) and the
+# KTPU015 replicated-giant threshold math (analysis/shardcheck.py), so the
+# analytic budgets and the lint can never drift onto different field sets.
+# --------------------------------------------------------------------------
+
+# qualname -> (dims symbols, itemsize).  Covers the RESIDENT buffer set:
+# every ClusterArrays field + every IncState field.  A ClusterArrays field
+# added without a row here fails the shard pass's coverage check loudly.
+FIELD_DIMS: Dict[str, Tuple[Tuple[str, ...], int]] = {
+    "arr.node_valid": (("N",), 1),
+    "arr.node_alloc": (("N", "R"), 4),
+    "arr.node_used": (("N", "R"), 4),
+    "arr.node_unsched": (("N",), 1),
+    "arr.node_labels": (("N", "L"), 4),
+    "arr.node_taint_ns": (("N", "T"), 1),
+    "arr.node_taint_pref": (("N", "T"), 1),
+    "arr.node_dom": (("K", "N"), 4),
+    "arr.node_ports0": (("N", "PT"), 1),
+    "arr.pod_valid": (("P",), 1),
+    "arr.pod_req": (("P", "R"), 4),
+    "arr.pod_prio": (("P",), 4),
+    "arr.pod_tol_ns": (("P", "T"), 1),
+    "arr.pod_tol_pref": (("P", "T"), 1),
+    "arr.pod_nodename": (("P",), 4),
+    "arr.pod_terms": (("P", "TT"), 4),
+    "arr.pod_has_sel": (("P",), 1),
+    "arr.sel_mask": (("S", "E", "L"), 4),
+    "arr.sel_kind": (("S", "E"), 4),
+    "arr.pod_pref_terms": (("P", "PW"), 4),
+    "arr.pod_pref_weights": (("P", "PW"), 4),
+    "arr.term_key": (("T2",), 4),
+    "arr.m_pend": (("T2", "P"), 4),
+    "arr.pod_match_terms": (("P", "MM"), 4),
+    "arr.pod_match_vals": (("P", "MM"), 4),
+    "arr.pod_aff_self": (("P", "A1"), 1),
+    "arr.term_counts0": (("T2", "D1"), 4),
+    "arr.anti_counts0": (("T2", "D1"), 4),
+    "arr.pod_aff_terms": (("P", "A1"), 4),
+    "arr.pod_anti_terms": (("P", "A2"), 4),
+    "arr.pod_pref_aff_terms": (("P", "B"), 4),
+    "arr.pod_pref_aff_w": (("P", "B"), 4),
+    "arr.pref_own0": (("T2", "D1"), 4),
+    "arr.pod_spread_terms": (("P", "C"), 4),
+    "arr.pod_spread_maxskew": (("P", "C"), 4),
+    "arr.pod_spread_hard": (("P", "C"), 1),
+    "arr.pod_ports": (("P", "PT"), 1),
+    "arr.pod_group": (("P",), 4),
+    "arr.group_min": (("G",), 4),
+    "arr.image_score": (("P", "N"), 4),
+    "inc.cls": (("P",), 4),
+    "inc.req_u": (("U", "R"), 4),
+    "inc.stat_u": (("U", "N"), 1),
+    "inc.base_u": (("U", "N"), 4),
+    "inc.fit_u": (("U", "N"), 1),
+    "inc.elig_u": (("U", "N"), 1),
+    "inc.traw_u": (("U", "N"), 4),
+    "inc.naraw_u": (("U", "N"), 4),
+    "inc.img_u": (("U", "N"), 4),
+}
+
+
+# --------------------------------------------------------------------------
+# resolution
+# --------------------------------------------------------------------------
+
+
+def rule_for(qualname: str) -> PartitionRule:
+    """First matching rule, ``match_partition_rules`` style.  Fails CLOSED:
+    a qualname outside the table raises instead of silently replicating —
+    the resolver is how KTPU014 guarantees there is exactly one spec
+    authority."""
+    for rule in PARTITION_RULES:
+        if rule.matches(qualname):
+            return rule
+    raise ValueError(
+        f"no partition rule matches {qualname!r} — add a row to "
+        "parallel/partition_rules.PARTITION_RULES (one regex row; the "
+        "shard pass proves the rest)"
+    )
+
+
+def spec_for(qualname: str) -> P:
+    return rule_for(qualname).spec
+
+
+def sharding_for(mesh, qualname: str):
+    """NamedSharding over `mesh` for one table row — the ONE constructor
+    every placement site routes through (KTPU014 flags NamedSharding
+    literals anywhere else in the package)."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec_for(qualname))
+
+
+def replicated_sharding(mesh):
+    """Fully-replicated NamedSharding (the ``mesh.replicated`` row)."""
+    return sharding_for(mesh, "mesh.replicated")
+
+
+def clusterarrays_shardings(mesh, image_sharded: bool) -> Dict[str, object]:
+    """field name -> NamedSharding for every ClusterArrays field —
+    the construction half of parallel/sharded.field_shardings (which
+    memoizes per (mesh, image_sharded)); placement sites receive built
+    shardings, never build their own (KTPU014)."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    specs = clusterarrays_specs(image_sharded)
+    return {
+        f.name: NamedSharding(mesh, getattr(specs, f.name))
+        for f in dataclasses.fields(type(specs))
+    }
+
+
+def clusterarrays_specs(image_sharded: bool):
+    """PartitionSpec pytree over every ClusterArrays field, resolved row by
+    row from the table (replaces parallel/sharded.py's hand-written
+    ``_node_sharding_specs``).  ``image_sharded`` keys the shape-conditional
+    image_score rule: the [P, 1] broadcast form replicates."""
+    import dataclasses
+
+    from ..api.snapshot import ClusterArrays
+
+    specs = {}
+    for f in dataclasses.fields(ClusterArrays):
+        if f.name == "image_score" and not image_sharded:
+            specs[f.name] = P(None, None)
+        else:
+            specs[f.name] = spec_for(f"arr.{f.name}")
+    return ClusterArrays(**specs)
+
+
+def incstate_specs(elig: bool, traw: bool, naraw: bool, img: bool):
+    """IncState PartitionSpec pytree for the populated optional structure
+    (None leaves drop out of the pytree — parallel/sharded.py in_specs /
+    ops/incremental.inc_partition_specs both resolve through here)."""
+    from ..ops.incremental import IncState
+
+    return IncState(
+        cls=spec_for("inc.cls"),
+        req_u=spec_for("inc.req_u"),
+        stat_u=spec_for("inc.stat_u"),
+        base_u=spec_for("inc.base_u"),
+        fit_u=spec_for("inc.fit_u"),
+        elig_u=spec_for("inc.elig_u") if elig else None,
+        traw_u=spec_for("inc.traw_u") if traw else None,
+        naraw_u=spec_for("inc.naraw_u") if naraw else None,
+        img_u=spec_for("inc.img_u") if img else None,
+    )
+
+
+def node_axis_fields() -> Dict[str, Tuple[int, object]]:
+    """field name -> (node axis index, pad fill), DERIVED from the table:
+    every ClusterArrays field whose spec carries the node axis, at the axis
+    position the spec shards.  Replaces the hand-maintained
+    ``parallel/mesh.NODE_AXIS_FIELDS`` dict (one fact, one place).
+    image_score stays excluded — its [P, N]-vs-[P, 1] shape conditionality
+    is handled at the padding call sites, exactly as before."""
+    import dataclasses
+
+    from ..api.snapshot import ClusterArrays
+
+    out: Dict[str, Tuple[int, object]] = {}
+    for f in dataclasses.fields(ClusterArrays):
+        if f.name == "image_score":
+            continue
+        rule = rule_for(f"arr.{f.name}")
+        if NODE_AXIS in tuple(rule.spec):
+            out[f.name] = (tuple(rule.spec).index(NODE_AXIS), rule.pad_fill)
+    return out
+
+
+# --------------------------------------------------------------------------
+# the shared analytic size model
+# --------------------------------------------------------------------------
+
+
+def field_bytes(qualname: str, dims_env: Optional[Dict[str, int]] = None,
+                n_shards: int = 1) -> int:
+    """Analytic PER-SHARD bytes of one resident field under `dims_env`
+    (symbol -> size; CANONICAL_DIMS fills the gaps).  A dimension the
+    table shards divides by ``n_shards``; replicated fields pay full size
+    on every shard — the quantity KTPU015 thresholds and the
+    ``resident_inputs`` term of ``shard_hbm_estimate`` sums."""
+    dims, itemsize = FIELD_DIMS[qualname]
+    env = dict(CANONICAL_DIMS)
+    env.update(SCALE_DIMS)
+    if dims_env:
+        env.update(dims_env)
+    spec = tuple(spec_for(qualname))
+    total = itemsize
+    for i, sym in enumerate(dims):
+        size = env[sym]
+        if i < len(spec) and spec[i] == NODE_AXIS:
+            size = -(-size // max(1, n_shards))
+        total *= max(1, size)
+    return total
+
+
+def sharded_on_nodes(qualname: str) -> bool:
+    return NODE_AXIS in tuple(spec_for(qualname))
+
+
+def resident_input_bytes(
+    n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
+    n_terms: int = 1, u_classes: Optional[int] = None,
+    image_sharded: bool = False,
+) -> int:
+    """Per-shard bytes of the resident input set (every ``arr.*`` field,
+    plus ``inc.*`` when the incremental route rides) — the table-derived
+    term ``shard_hbm_estimate`` adds so the analytic HBM budget covers the
+    argument bytes the compiled memory analysis measures."""
+    env = {"P": n_pods, "N": n_nodes, "R": n_res, "T2": max(1, n_terms),
+           "U": u_classes or 1}
+    total = 0
+    for q in FIELD_DIMS:
+        if q.startswith("inc.") and not u_classes:
+            continue
+        if q == "arr.image_score" and not image_sharded:
+            # the [P, 1] broadcast form: pod axis only
+            total += 4 * max(1, n_pods)
+            continue
+        total += field_bytes(q, env, n_shards)
+    return total

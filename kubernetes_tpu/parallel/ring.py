@@ -24,9 +24,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from .mesh import NODE_AXIS, shard_map
+from .partition_rules import spec_for
 
 PODS_AXIS = NODE_AXIS  # one mesh axis; it shards whichever array axis a stage needs
 
@@ -82,8 +83,9 @@ def ring_match(sel_mask: jax.Array, sel_kind: jax.Array, labels: jax.Array, mesh
     fn = shard_map(
         f,
         mesh=mesh,
-        in_specs=(P(PODS_AXIS, None, None), P(PODS_AXIS, None), P(PODS_AXIS, None)),
-        out_specs=P(PODS_AXIS, None),
+        in_specs=(spec_for("ring.sel_mask"), spec_for("ring.sel_kind"),
+                  spec_for("ring.labels")),
+        out_specs=spec_for("ring.match_out"),
     )
     return jax.jit(fn)(sel_mask, sel_kind, labels)
 
@@ -99,6 +101,6 @@ def all_to_all_pods_to_nodes(x: jax.Array, mesh: Mesh):
         # split the node axis into d chunks, exchange, concat on the pod axis
         return lax.all_to_all(blk, PODS_AXIS, split_axis=1, concat_axis=0, tiled=True)
 
-    fn = shard_map(f, mesh=mesh, in_specs=(P(PODS_AXIS, None),),
-                       out_specs=P(None, PODS_AXIS))
+    fn = shard_map(f, mesh=mesh, in_specs=(spec_for("ring.a2a_in"),),
+                   out_specs=spec_for("ring.a2a_out"))
     return jax.jit(fn)(x)

@@ -23,59 +23,22 @@ from functools import lru_cache, partial
 from typing import Tuple
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..api.snapshot import ClusterArrays
 from ..ops.assign import schedule_scan
 from ..ops.scores import ScoreConfig
 from .mesh import NODE_AXIS, shard_map
+from .partition_rules import clusterarrays_specs, incstate_specs, spec_for
 
 
 def _node_sharding_specs(image_sharded: bool) -> ClusterArrays:
-    """PartitionSpec pytree: [N, ...] / [*, N] arrays sharded on the node axis,
-    pod-axis and vocab-table arrays replicated."""
-    return ClusterArrays(
-        node_valid=P(NODE_AXIS),
-        node_alloc=P(NODE_AXIS, None),
-        node_used=P(NODE_AXIS, None),
-        node_unsched=P(NODE_AXIS),
-        node_labels=P(NODE_AXIS, None),
-        node_taint_ns=P(NODE_AXIS, None),
-        node_taint_pref=P(NODE_AXIS, None),
-        pod_valid=P(),
-        pod_req=P(None, None),
-        pod_prio=P(),
-        pod_tol_ns=P(None, None),
-        pod_tol_pref=P(None, None),
-        pod_nodename=P(),
-        pod_terms=P(None, None),
-        pod_has_sel=P(),
-        sel_mask=P(None, None, None),
-        sel_kind=P(None, None),
-        pod_pref_terms=P(None, None),
-        pod_pref_weights=P(None, None),
-        node_dom=P(None, NODE_AXIS),
-        term_key=P(),
-        m_pend=P(None, None),
-        pod_match_terms=P(None, None),
-        pod_match_vals=P(None, None),
-        pod_aff_self=P(None, None),
-        term_counts0=P(None, None),
-        anti_counts0=P(None, None),
-        pod_aff_terms=P(None, None),
-        pod_anti_terms=P(None, None),
-        pod_pref_aff_terms=P(None, None),
-        pod_pref_aff_w=P(None, None),
-        pref_own0=P(None, None),
-        pod_spread_terms=P(None, None),
-        pod_spread_maxskew=P(None, None),
-        pod_spread_hard=P(None, None),
-        pod_ports=P(None, None),
-        node_ports0=P(NODE_AXIS, None),
-        pod_group=P(),
-        group_min=P(),
-        image_score=P(None, NODE_AXIS) if image_sharded else P(None, None),
-    )
+    """PartitionSpec pytree for every ClusterArrays field, resolved through
+    the declarative rule table (parallel/partition_rules.py).  The former
+    hand-written 40-line spec literal is gone: adding a field is one table
+    row, and the ktpu-verify shard pass (KTPU014..018) proves the compiled
+    placements obey it."""
+    return clusterarrays_specs(image_sharded)
 
 
 def sharded_schedule_batch(
@@ -95,7 +58,7 @@ def sharded_schedule_batch(
         ),
         mesh=mesh,
         in_specs=(_node_sharding_specs(img),),
-        out_specs=(P(), P(NODE_AXIS, None)),
+        out_specs=(spec_for("out.assignment"), spec_for("out.node_used_scan")),
     )
     return jax.jit(fn)(arr)
 
@@ -113,15 +76,9 @@ def field_shardings(mesh: Mesh, image_sharded: bool):
 
 @lru_cache(maxsize=None)
 def _field_shardings_cached(mesh: Mesh, image_sharded: bool):
-    import dataclasses
+    from .partition_rules import clusterarrays_shardings
 
-    from jax.sharding import NamedSharding
-
-    specs = _node_sharding_specs(image_sharded)
-    return {
-        f.name: NamedSharding(mesh, getattr(specs, f.name))
-        for f in dataclasses.fields(type(specs))
-    }
+    return clusterarrays_shardings(mesh, image_sharded)
 
 
 # jit cache for the sharded routed kernels, keyed on everything trace-
@@ -148,7 +105,8 @@ def _sharded_routed_fn(
                 return c, u, jnp.arange(a.P, dtype=jnp.int32), jnp.int32(a.P)
             return c, u
 
-        used_spec = P(NODE_AXIS, None)  # the scan's used stays node-sharded
+        # the scan's used stays node-sharded (table row out.node_used_scan)
+        used_spec = spec_for("out.node_used_scan")
     else:
         kernel = (
             A.schedule_scan_chunked if kind == "chunked"
@@ -169,19 +127,16 @@ def _sharded_routed_fn(
                     image_sharded=image_sharded,
                 )
 
-        used_spec = P()  # chunked/rounds carry usage replicated
+        # chunked/rounds carry usage replicated (table row out.node_used)
+        used_spec = spec_for("out.node_used")
     in_specs = (_node_sharding_specs(image_sharded),)
     if kind != "scan" and inc_sig is not None:
-        from ..ops.incremental import IncState
-
-        ns = P(None, NODE_AXIS)
-        elig, traw, naraw, img = inc_sig
-        in_specs = in_specs + (IncState(
-            cls=P(), req_u=P(None, None), stat_u=ns, base_u=ns, fit_u=ns,
-            elig_u=ns if elig else None, traw_u=ns if traw else None,
-            naraw_u=ns if naraw else None, img_u=ns if img else None,
-        ),)
-    out_specs = (P(), used_spec) + ((P(), P()) if with_ordinals else ())
+        # the resident IncState's populated structure, from the rule table
+        in_specs = in_specs + (incstate_specs(*inc_sig),)
+    out_specs = (spec_for("out.assignment"), used_spec) + (
+        (spec_for("out.ordinals"), spec_for("out.n_commits"))
+        if with_ordinals else ()
+    )
     fn = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_rep=False,

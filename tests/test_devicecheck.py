@@ -400,8 +400,10 @@ def test_harness_verify_device_embeds_report(monkeypatch, tmp_path):
 
     seen = {}
 
-    def fake_verify(root=None, baseline_path=None, device=False):
+    def fake_verify(root=None, baseline_path=None, device=False,
+                    shard=False):
         seen["device"] = device
+        seen["shard"] = shard
         return _canned_report()
 
     monkeypatch.setattr(cli, "run_verify", fake_verify)
