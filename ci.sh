@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# The CI gate — the exact checks every push must pass, runnable by humans
+# too (`./ci.sh`), so CI and a laptop can never disagree about what green
+# means.  Three stages, fail-fast:
+#
+#   1. tier-1 tests        the ROADMAP.md tier-1 command (not slow, 870 s cap)
+#   2. ktpu-verify         AST + device + shard passes (KTPU001–018) — the
+#                          verify stack PRs 8–10 built, gated on every push
+#   3. regression gate     bench/regression.py over the BENCH_r*.json
+#                          trajectory (same-platform comparison only)
+#
+# Exit non-zero on the first failing stage.  .github/workflows/ci.yml runs
+# exactly this script.
+set -uo pipefail
+cd "$(dirname "$0")"
+
+echo "=== [1/3] tier-1 tests ==="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+  echo "ci: tier-1 tests failed (rc=$rc)" >&2
+  exit "$rc"
+fi
+
+echo "=== [2/3] ktpu-verify (AST + device + shard) ==="
+JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard || {
+  rc=$?
+  echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
+  exit "$rc"
+}
+
+echo "=== [3/3] bench regression gate ==="
+python -m kubernetes_tpu.bench.regression || {
+  rc=$?
+  if [ "$rc" -eq 2 ]; then
+    # unusable = no comparable same-platform artifact pair on this runner —
+    # the gate is advisory there (CI boxes have no BENCH trajectory of
+    # their own); a real regression (exit 1) still fails the build
+    echo "ci: regression gate unusable on this runner (no comparable artifacts) — skipped"
+  else
+    echo "ci: bench regression gate failed (rc=$rc)" >&2
+    exit "$rc"
+  fi
+}
+
+echo "CI green"

@@ -141,6 +141,14 @@ def main(argv=None) -> int:
                          "finding (reasons left TODO — fill them in)")
     ap.add_argument("--lock-graph", action="store_true",
                     help="print the static lock-order graph and exit")
+    ap.add_argument("--flight", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="read a decision flight-recorder dump post-mortem "
+                         "(scheduler/flightrecorder.py — written to the "
+                         "checkpoint dir when a kill.* site or a wave "
+                         "recovery fires) and exit; PATH defaults to "
+                         "$KTPU_CHECKPOINT_DIR/flight.json.  Exit 0 "
+                         "parseable, 2 missing/corrupt")
     args = ap.parse_args(argv)
     if args.write_baseline and args.no_baseline:
         # --no-baseline makes `baseline` None, so the draft merge below
@@ -153,6 +161,8 @@ def main(argv=None) -> int:
 
     if args.lock_graph:
         return _dump_lock_graph(args.root)
+    if args.flight is not None:
+        return _dump_flight(args.flight)
 
     rules = [cls() for cls in ALL_RULES]
     lockorder = True
@@ -273,6 +283,37 @@ def main(argv=None) -> int:
     else:
         print(report.render_text())
     return report.exit_code
+
+
+def _dump_flight(path: str) -> int:
+    """Post-mortem reader for the decision flight recorder: render the dump
+    a dying scheduler left in its checkpoint dir.  A missing or corrupt
+    dump is exit 2 (unusable evidence), matching the shared contract."""
+    from ..scheduler.flightrecorder import (
+        FLIGHT_FILENAME, load_flight, render_flight,
+    )
+
+    if not path:
+        ckpt = os.environ.get("KTPU_CHECKPOINT_DIR", "")
+        if not ckpt:
+            print("ktpu-verify: --flight needs a path or KTPU_CHECKPOINT_DIR",
+                  file=sys.stderr)
+            return 2
+        path = os.path.join(ckpt, FLIGHT_FILENAME)
+    try:
+        doc = load_flight(path)
+        text = render_flight(doc)
+    except ValueError as e:
+        print(f"ktpu-verify: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # noqa: BLE001 — malformed evidence is exit 2
+        # a structurally-valid dump with wrong-typed fields must still be
+        # "unusable" (2), never a traceback CI misreads as exit 1
+        print(f"ktpu-verify: malformed flight dump {path}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    print(text)
+    return 0
 
 
 def _dump_lock_graph(root: str) -> int:

@@ -1139,6 +1139,31 @@ class DeltaEncoder:
                 )
 
 
+def class_groups(meta, rows):
+    """Decode-side class plumbing for the diagnosis plane (ops/explain.py):
+    group device pod rows by their equivalence class — every pod of one
+    class shares its spec rep bit-for-bit (_pod_side builds pod arrays per
+    unique spec), so one diagnosis per class serves all its pods.
+
+    Returns (reps i64[F] — the first seen row of each distinct class, in
+    first-appearance order; row -> rep position).  Falls back to one class
+    per distinct row when the encode carried no class index (the plain
+    encode_snapshot path)."""
+    first: dict = {}
+    group_of: dict = {}
+    reps: list = []
+    cls_of = meta.pod_class
+    for r in rows:
+        r = int(r)
+        c = int(cls_of[r]) if cls_of is not None else r
+        g = first.get(c)
+        if g is None:
+            g = first[c] = len(reps)
+            reps.append(r)
+        group_of[r] = g
+    return np.asarray(reps, dtype=np.int64), group_of
+
+
 def _cached(cs: ClusterSide, name: str, key, builder):
     """Padded-array cache: rebuild only when `key` changes, else return the
     SAME object (numpy identity drives encode_device's transfer skipping).

@@ -79,6 +79,14 @@ class PerfData:
     # failover p50/p99 + checkpoint_corrupt_total (ha_fields)
     restarts: int = 0
     ha: Optional[Dict] = None
+    # explainability plane (ISSUE 13), stamped next to the event counts:
+    # API-object event publications the recorder's token bucket refused
+    # (events_publish_dropped_total — without it the drop is silent), and
+    # the run's top unschedulable reasons from
+    # pod_unschedulable_reasons_total{reason} (KTPU_EXPLAIN=1 device
+    # cycles + every CPU-path failure)
+    events_publish_dropped: int = 0
+    unschedulable_reasons: Optional[Dict[str, int]] = None
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -203,6 +211,25 @@ def ha_fields(metrics) -> Optional[Dict]:
     return out
 
 
+def event_fields(metrics) -> Dict:
+    """The explainability artifact pair next to the event counts:
+    events_publish_dropped (token-bucket refusals — scheduled/unschedulable
+    counts read the COMPLETE in-memory log, so a nonzero value here means
+    `kubectl get events` undercounts them) and the run's top unschedulable
+    reasons (one definition shared by PerfData and the streaming artifact)."""
+    counters, _gauges, _hists = metrics.snapshot()
+    dropped = counters.get("events_publish_dropped_total", 0.0)
+    series = metrics.labeled_counter_series("pod_unschedulable_reasons_total")
+    reasons = {
+        dict(key).get("reason", ""): int(v)
+        for key, v in sorted(series.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    }
+    return {
+        "events_publish_dropped": int(dropped),
+        "unschedulable_reasons": reasons or None,
+    }
+
+
 def _export_trace(collector, path: str) -> None:
     """Write the Perfetto export and print the one-line trace summary —
     flagging an INCOMPLETE trace (ring wrapped, spans dropped) so
@@ -290,6 +317,7 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float,
         **sli,
         restarts=restarts,
         ha=ha_fields(sched.metrics),
+        **event_fields(sched.metrics),
     )
 
 
@@ -353,6 +381,7 @@ def run_streaming_workload(
             pipelined_s=None, overlap_gain=None, overlap_fraction=0.0,
             pods_per_sec=round(pods / t_serial, 1) if t_serial > 0 else 0.0,
             **sli_fields(metrics),
+            **event_fields(metrics),
         )
         if collector is not None:
             from ..scheduler.attribution import attribute_spans
@@ -374,6 +403,7 @@ def run_streaming_workload(
         route_trace_counts=dict(TRACE_COUNTS),
         # the headline SLI next to throughput: per-pod arrival -> bind
         **sli_fields(metrics),
+        **event_fields(metrics),
         # incremental warm-cycle attribution (ops/incremental.py)
         **runner.hoist.summary(),
     )

@@ -358,7 +358,11 @@ class Kubectl:
             if evs:
                 buf.write("Events:\n")
                 for e in evs[-10:]:
-                    buf.write(f"  {e.reason}\t{e.node or e.message}\n")
+                    # reason + node + message (the diagnosis plane's
+                    # "0/N nodes are available: …" lands here — the
+                    # `kubectl describe pod` surface operators grep)
+                    detail = "\t".join(x for x in (e.node, e.message) if x)
+                    buf.write(f"  {e.reason}\t{detail}\n")
         return buf.getvalue()
 
     # --------------------------------------------------------- apply/create

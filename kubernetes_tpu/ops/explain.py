@@ -1,0 +1,326 @@
+"""Unschedulable diagnosis kernel — the explainability plane (ISSUE 13).
+
+The reference scheduler's signature observability surface is the per-pod
+`Diagnosis` built by schedule_one.go (NodeToStatusMap: one failing plugin
+status per node) rendered by fitError.Error() into the message every operator
+greps for: "0/5000 nodes are available: 2000 Insufficient cpu, 1500 node(s)
+had untolerated taint.".  The device batch path fuses all filters into one
+eligibility mask (ops/filters.py — static_feasible & fit_ok), so the verdict
+`-1` carries no reason — this module re-derives the reasons ON DEMAND, for
+the FAILED equivalence classes only (U_f ≪ P), strictly off the warm step:
+
+  one jitted O(U_f·N) evaluation -> i32[U_f, NUM_REASONS] per-class
+  {reason -> node count} vectors -> decoded through the class index back to
+  per-pod upstream-shaped messages + pod_unschedulable_reasons_total{reason}.
+
+Reason attribution rule (shared bit-for-bit by the kernel and the host
+oracle `explain_oracle`; PARITY.md "Explainability"): every VALID node is
+claimed by exactly ONE reason, the first failing filter in the reference's
+plugin order —
+
+  NodeName > NodeUnschedulable > TaintToleration > NodeAffinity >
+  NodeResourcesFit (first insufficient resource in meta.resources order) >
+  residual ("otherwise feasible": nodes that pass every capacity-independent
+  filter and fit at the supplied usage — blocked in-scan by commit-state
+  terms the fused kernels fold in: pod affinity/spread/ports, capacity
+  races, speculation repair, or gang-quorum revocation)
+
+so per-class counts always sum to the valid-node count — an exactly
+checkable invariant, unlike upstream's multi-reason statuses (deviation
+documented in PARITY.md).  Counts are computed against the CALLER-SUPPLIED
+node usage (the scheduler passes post-cycle usage: what the operator sees
+and the retry will face).
+
+KTPU_EXPLAIN=1 gates the whole plane (KTPU005 cheap-gate pattern: one env
+read per failing cycle, zero work otherwise); the kernel is additive-only —
+it never touches the twelve production routes (KTPU010/KTPU011 stay clean
+with it enabled).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .filters import term_match
+
+# fixed structural reason codes (kernel column order; fit columns follow at
+# FIT_BASE..FIT_BASE+R-1, the residual "otherwise feasible" column is last)
+R_NODENAME = 0
+R_UNSCHED = 1
+R_TAINT = 2
+R_AFFINITY = 3
+FIT_BASE = 4
+
+LBL_NODENAME = "node(s) didn't match the requested node name"
+LBL_UNSCHED = "node(s) were unschedulable"
+LBL_TAINT = "node(s) had untolerated taint"
+LBL_AFFINITY = "node(s) didn't match Pod's node affinity/selector"
+LBL_FEASIBLE = "node(s) were otherwise feasible (blocked in-scan: capacity race, pod affinity/spread/ports, or gang quorum)"
+
+
+def explain_enabled() -> bool:
+    """KTPU_EXPLAIN=1 arms the diagnosis plane (default off: the device
+    failure path records reason-free events exactly as before)."""
+    return os.environ.get("KTPU_EXPLAIN", "") == "1"
+
+
+def n_reasons(n_resources: int) -> int:
+    return FIT_BASE + n_resources + 1
+
+
+def reason_labels(resources: Sequence[str]) -> List[str]:
+    """Column index -> upstream-shaped reason label (fitError vocabulary)."""
+    return (
+        [LBL_NODENAME, LBL_UNSCHED, LBL_TAINT, LBL_AFFINITY]
+        + [f"Insufficient {r}" for r in resources]
+        + [LBL_FEASIBLE]
+    )
+
+
+@jax.jit
+def _explain_kernel(
+    node_valid, node_alloc, node_used, node_unsched, node_labels,
+    node_taint_ns, sel_mask, sel_kind,
+    rep_valid, rep_req, rep_tol_ns, rep_nodename, rep_terms, rep_has_sel,
+):
+    """i32[F, 4+R+1] one-reason-per-node counts for F class representatives.
+
+    Pure re-expression of ops/filters.py's primitives as per-filter masks:
+    the SAME counting matmuls (exact in f32, < 2^24 literals), the SAME
+    subtraction-form fit test — only un-fused, so each node's first failing
+    filter is observable.  O(F·N) elementwise + two [F,T/S]-sized matmuls;
+    never on the warm step."""
+    N = node_valid.shape[0]
+    R = rep_req.shape[1]
+    valid = node_valid[None, :]  # [1, N] broadcasts over F
+
+    # NodeName.Filter (filters.nodename_ok, negated)
+    n_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+    pin = rep_nodename[:, None]
+    name_bad = jnp.where(pin == -1, False, pin != n_idx)  # [F, N]
+
+    # TaintToleration.Filter (filters.taints_ok): the synthetic
+    # node.kubernetes.io/unschedulable taint (api/snapshot.py) is in
+    # node_taint_ns too, so an intolerable taint on an unschedulable node
+    # is claimed by NodeUnschedulable first — the reference's plugin order.
+    intolerable = jnp.einsum(
+        "ft,nt->fn",
+        (~rep_tol_ns).astype(jnp.float32),
+        node_taint_ns.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ) > 0  # [F, N]
+    unsched_bad = intolerable & node_unsched[None, :]
+    taint_bad = intolerable & ~node_unsched[None, :]
+
+    # NodeAffinity.Filter + spec.nodeSelector (filters.node_selection_ok)
+    tm = term_match(sel_mask, sel_kind, node_labels)  # [S, N]
+    ids = jnp.maximum(rep_terms, 0)  # [F, TT]
+    per_term = tm[ids] & (rep_terms >= 0)[:, :, None]  # [F, TT, N]
+    aff_bad = jnp.where(rep_has_sel[:, None], ~per_term.any(axis=1), False)
+
+    # NodeResourcesFit at the supplied usage (filters.fit_ok's overflow-safe
+    # subtraction form; req == 0 never blocks)
+    free = node_alloc[None, :, :] - node_used[None, :, :]  # [1, N, R]
+    req = rep_req[:, None, :]  # [F, 1, R]
+    short = (req != 0) & (req > free)  # [F, N, R]
+    fit_bad = short.any(axis=2)
+
+    # priority claim: first failing filter owns the node
+    claimed = jnp.zeros_like(name_bad)
+    cols = []
+    for mask in (name_bad, unsched_bad, taint_bad, aff_bad):
+        claim = mask & ~claimed & valid
+        cols.append(claim.sum(axis=1, dtype=jnp.int32))
+        claimed = claimed | claim
+    fit_claim = fit_bad & ~claimed & valid  # [F, N]
+    first_r = jnp.argmax(short, axis=2)  # first insufficient resource
+    onehot = (
+        (jnp.arange(R, dtype=first_r.dtype)[None, None, :] == first_r[:, :, None])
+        & fit_claim[:, :, None]
+    )
+    fit_counts = onehot.sum(axis=1, dtype=jnp.int32)  # [F, R]
+    claimed = claimed | fit_claim
+    feasible = (valid & ~claimed).sum(axis=1, dtype=jnp.int32)
+
+    out = jnp.concatenate(
+        [jnp.stack(cols, axis=1), fit_counts, feasible[:, None]], axis=1
+    )
+    return jnp.where(rep_valid[:, None], out, 0)
+
+
+def _pad_pow2(n: int, minimum: int = 4) -> int:
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+def explain_classes(
+    arr, reps: np.ndarray, node_used: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Per-class reason-count vectors: i64[F, 4+R+1] for the class
+    representatives `reps` (device pod row indices).  `node_used` defaults to
+    the encoded cycle-start usage; the scheduler passes post-cycle usage.
+
+    The rep rows are gathered on host (F is tiny — failed classes only) so
+    the jit signature is [F_pad, ·]: F_pad is the next power of two (min 4),
+    keeping retraces bounded by log2(U) per cluster shape, never per cycle.
+    """
+    reps = np.asarray(reps, dtype=np.int64)
+    k = n_reasons(arr.pod_req.shape[1])
+    if reps.size == 0:
+        return np.zeros((0, k), dtype=np.int64)
+    used = arr.node_used if node_used is None else node_used
+    f_pad = _pad_pow2(int(reps.size))
+    pad_reps = np.zeros(f_pad, dtype=np.int64)
+    pad_reps[: reps.size] = reps
+    rep_valid = np.zeros(f_pad, dtype=bool)
+    rep_valid[: reps.size] = True
+    counts = _explain_kernel(
+        np.asarray(arr.node_valid), np.asarray(arr.node_alloc),
+        np.asarray(used), np.asarray(arr.node_unsched),
+        np.asarray(arr.node_labels), np.asarray(arr.node_taint_ns),
+        np.asarray(arr.sel_mask), np.asarray(arr.sel_kind),
+        rep_valid,
+        np.asarray(arr.pod_req)[pad_reps],
+        np.asarray(arr.pod_tol_ns)[pad_reps],
+        np.asarray(arr.pod_nodename)[pad_reps],
+        np.asarray(arr.pod_terms)[pad_reps],
+        np.asarray(arr.pod_has_sel)[pad_reps],
+    )
+    return np.asarray(counts)[: reps.size].astype(np.int64)
+
+
+def explain_oracle(
+    arr, reps: Sequence[int], node_used: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Independent host recount of explain_classes — per-node python/numpy
+    evaluation of the same attribution rule (parity IS the feature: the
+    kernel's counts must equal this exactly, tests/test_explain.py)."""
+    used = np.asarray(arr.node_used if node_used is None else node_used,
+                      dtype=np.int64)
+    alloc = np.asarray(arr.node_alloc, dtype=np.int64)
+    R = alloc.shape[1]
+    k = n_reasons(R)
+    out = np.zeros((len(reps), k), dtype=np.int64)
+    node_valid = np.asarray(arr.node_valid)
+    sel_mask = np.asarray(arr.sel_mask)  # [S, E, L]
+    sel_kind = np.asarray(arr.sel_kind)  # [S, E]
+    node_labels = np.asarray(arr.node_labels)  # [N, L]
+    from ..api import vocab as v
+
+    # per-term node-satisfaction matrix, integer-exact matmul (the kernel
+    # uses the f32 MXU path; both are exact below 2^24 literals)
+    cnt = np.einsum("sel,nl->sen", sel_mask.astype(np.int64),
+                    node_labels.astype(np.int64))
+    ok_e = np.where(
+        sel_kind[:, :, None] == v.KIND_ANY, cnt > 0,
+        np.where(sel_kind[:, :, None] == v.KIND_NONE, cnt == 0,
+                 sel_kind[:, :, None] == v.KIND_PAD),
+    )
+    tm = ok_e.all(axis=1)  # [S, N]
+    for f, p in enumerate(reps):
+        p = int(p)
+        req = np.asarray(arr.pod_req[p], dtype=np.int64)
+        tol = np.asarray(arr.pod_tol_ns[p])
+        pin = int(arr.pod_nodename[p])
+        terms = [int(s) for s in arr.pod_terms[p] if s >= 0]
+        has_sel = bool(arr.pod_has_sel[p])
+        for n in range(alloc.shape[0]):
+            if not node_valid[n]:
+                continue
+            if pin != -1 and pin != n:
+                out[f, R_NODENAME] += 1
+                continue
+            intol = bool(np.any(arr.node_taint_ns[n] & ~tol))
+            if intol and bool(arr.node_unsched[n]):
+                out[f, R_UNSCHED] += 1
+                continue
+            if intol:
+                out[f, R_TAINT] += 1
+                continue
+            if has_sel and not any(tm[s, n] for s in terms):
+                out[f, R_AFFINITY] += 1
+                continue
+            short = [j for j in range(R)
+                     if req[j] != 0 and req[j] > alloc[n, j] - used[n, j]]
+            if short:
+                out[f, FIT_BASE + short[0]] += 1
+                continue
+            out[f, k - 1] += 1
+    return out
+
+
+def render_unschedulable(n_nodes: int, counts: Mapping[str, int]) -> str:
+    """The fitError.Error() analog, shared by the device diagnosis AND the
+    CPU path's per-plugin statuses: "0/N nodes are available: c1 reason1,
+    c2 reason2." — reasons ordered by descending count then label (a
+    deterministic rendering of upstream's sorted reason histogram)."""
+    present = sorted(
+        ((int(c), lbl) for lbl, c in counts.items() if c > 0),
+        key=lambda cl: (-cl[0], cl[1]),
+    )
+    head = f"0/{n_nodes} nodes are available"
+    if not present:
+        return head + "."
+    return head + ": " + ", ".join(f"{c} {lbl}" for c, lbl in present) + "."
+
+
+def dominant_reason(counts: Mapping[str, int]) -> str:
+    """The single reason label claiming the most nodes — the label
+    pod_unschedulable_reasons_total{reason} aggregates under.  Ties break
+    to the EARLIER entry in the mapping's insertion order, so callers must
+    pass a deterministically ordered mapping: the device decode passes
+    filter-priority column order; the CPU path passes label-sorted counts
+    (its accumulation order follows the rotating node cursor)."""
+    best, best_c = "", -1
+    for lbl, c in counts.items():
+        if int(c) > best_c:
+            best, best_c = lbl, int(c)
+    return best
+
+
+def diagnose_failed(
+    arr, meta, failed_rows: Sequence[int],
+    node_used: Optional[np.ndarray] = None,
+) -> Tuple[Dict[int, str], Dict[int, str], List[dict]]:
+    """The decode half: group failed device rows by equivalence class
+    (api/delta.class_groups — all pods of one class share spec, hence share
+    the diagnosis), run ONE kernel evaluation over the class reps, and map
+    the per-class vectors back to per-row messages.
+
+    Returns (row -> message, row -> dominant reason label, per-class flight
+    records [{rep_row, pods, counts}]).
+    """
+    from ..api.delta import class_groups
+
+    reps, group_of = class_groups(meta, failed_rows)
+    if reps.size == 0:
+        return {}, {}, []
+    counts = explain_classes(arr, reps, node_used)
+    labels = reason_labels(meta.resources)
+    per_class_counts: List[Dict[str, int]] = []
+    class_msgs: List[str] = []
+    class_dom: List[str] = []
+    for g in range(reps.size):
+        cc = {labels[j]: int(counts[g, j]) for j in range(len(labels))
+              if counts[g, j] > 0}
+        per_class_counts.append(cc)
+        class_msgs.append(render_unschedulable(meta.n_nodes, cc))
+        class_dom.append(dominant_reason(cc))
+    messages = {int(r): class_msgs[g] for r, g in group_of.items()}
+    dominant = {int(r): class_dom[g] for r, g in group_of.items()}
+    pods_per_class = [0] * reps.size
+    for g in group_of.values():
+        pods_per_class[g] += 1
+    records = [
+        {"rep_row": int(reps[g]), "pods": pods_per_class[g],
+         "counts": per_class_counts[g]}
+        for g in range(reps.size)
+    ]
+    return messages, dominant, records

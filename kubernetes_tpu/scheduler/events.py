@@ -29,11 +29,16 @@ class SchedulingEvent:
 class EventRecorder:
     def __init__(self, capacity: int = 100_000, store=None,
                  publish_limit: int = 10_000, publish_qps: float = 200.0,
-                 publish_burst: int = 512):
+                 publish_burst: int = 512, metrics=None):
         self._lock = make_lock("EventRecorder._lock")
         self.events: List[SchedulingEvent] = []
         self.capacity = capacity
         self._store = store
+        # events_publish_dropped_total: API-object publications the token
+        # bucket refused.  Before this counter the drop was SILENT — the
+        # in-memory decision log stayed complete while `kubectl get events`
+        # quietly thinned out, with nothing on /metrics to say so.
+        self._metrics = metrics
         self._seq = 0
         self._agg: dict = {}  # aggregation key -> Event object key
         # bounded Event-object footprint: oldest objects are deleted past the
@@ -62,6 +67,8 @@ class EventRecorder:
                 if self._tokens >= 1.0:
                     self._tokens -= 1.0
                     self._publish(reason, pod, node, message)
+                elif self._metrics is not None:
+                    self._metrics.inc("events_publish_dropped_total")
 
     def _publish(self, reason: str, pod: str, node: str, message: str) -> None:
         from ..api.cluster import ClusterEvent

@@ -309,6 +309,14 @@ class Metrics:
         with self._lock:
             return sum(self.labeled_counters.get(name, {}).values())
 
+    def labeled_counter_series(self, name: str) -> Dict[LabelKey, float]:
+        """Consistent copy of one labeled counter's series (label key ->
+        value) — artifact emitters aggregate from it (e.g. the harness's
+        top unschedulable reasons from
+        pod_unschedulable_reasons_total{reason})."""
+        with self._lock:
+            return dict(self.labeled_counters.get(name, {}))
+
     @staticmethod
     def render_labels(key: LabelKey) -> str:
         """Prometheus exposition form for a label key:

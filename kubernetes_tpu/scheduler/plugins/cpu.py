@@ -171,8 +171,14 @@ class NodeResourcesFit(Plugin):
         sc = state.data["scaled"]
         i = sc.index[info.node.name]
         req = sc.req_of(pod)
-        if np.any((req > 0) & (sc.used[i] + req > sc.alloc[i])):
-            return Status.unschedulable("Insufficient resources")
+        short = (req > 0) & (sc.used[i] + req > sc.alloc[i])
+        if np.any(short):
+            # upstream fitError vocabulary ("Insufficient cpu"), first
+            # failing resource — the reason the diagnosis renderer
+            # aggregates (same attribution rule as ops/explain.py)
+            return Status.unschedulable(
+                f"Insufficient {sc.resources[int(np.argmax(short))]}"
+            )
         return Status()
 
     def Score(self, state, snap, pod, info: NodeInfo) -> float:

@@ -114,7 +114,7 @@ class Scheduler:
         # no per-pod bookkeeping off the enabled path).
         self.last_wave_sli: Dict[str, float] = {}
         self.last_wave_estimates: Dict[str, float] = {}
-        self.events = EventRecorder(store=store)
+        self.events = EventRecorder(store=store, metrics=self.metrics)
         from .klog import Logger
 
         # contextual logger (klog.LoggerWithValues shape); callers may pass
@@ -245,6 +245,16 @@ class Scheduler:
                 ckpt_dir, metrics=self.metrics, logger=self.log
             )
             self.cache.checkpoint_hook = self._checkpoint_state
+        # decision flight recorder (flightrecorder.py): bounded in-memory
+        # ring of per-cycle decision records (verdict/class fingerprints,
+        # dirty columns, diagnosis vectors, trace ids), dumped into the
+        # checkpoint dir on an enumerated kill site or a wave-recovery
+        # parity event — the crash black box
+        # `python -m kubernetes_tpu.analysis --flight` reads post-mortem
+        from .flightrecorder import FlightRecorder
+
+        self._flight = FlightRecorder(directory=ckpt_dir)
+        self._last_diagnosis: List[dict] = []
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -515,10 +525,45 @@ class Scheduler:
                 return None
         if not feasible:
             nominated, pst = fw.run_post_filters(state, snap, pod, statuses)
-            self.events.record(
-                "FailedScheduling", pod.uid,
-                message=f"0/{len(infos)} nodes available" + (f"; preemption nominated {nominated}" if pst.ok else ""),
-            )
+            # fitError-shaped diagnosis from the per-plugin statuses this
+            # cycle already holds (schedule_one.go — Diagnosis /
+            # NodeToStatusMap), rendered by the SAME renderer the device
+            # path's explain kernel uses (ops/explain.py)
+            from ..ops.explain import dominant_reason, render_unschedulable
+
+            counts: Dict[str, int] = {}
+            metric_label: Dict[str, str] = {}
+            for fst in statuses.values():
+                reason = ((fst.reasons[0] if fst.reasons else "")
+                          or fst.plugin or "unschedulable")
+                counts[reason] = counts.get(reason, 0) + 1
+                # bounded metric-label rule: plugin-stamped reasons are a
+                # closed vocabulary (builtin plugins, static strings +
+                # per-resource), but free-form sources (extender text)
+                # would mint a new labeled series per distinct string —
+                # collapse those so label cardinality stays bounded
+                metric_label[reason] = reason if fst.plugin else "extender"
+            if not statuses and not st.ok:
+                # PreFilter rejection marks every node (schedule_one.go —
+                # a PreFilter status fails the whole cluster at once)
+                reason = ((st.reasons[0] if st.reasons else "")
+                          or st.plugin or "PreFilter rejected")
+                counts[reason] = len(infos)
+                metric_label[reason] = (reason if st.plugin
+                                        else "PreFilter rejected")
+            # label-sorted: the accumulation order above follows the
+            # rotating node cursor, so a tied dominant reason would flap
+            # between runs without a deterministic insertion order
+            counts = {k: counts[k] for k in sorted(counts)}
+            if counts:
+                self.metrics.inc_labeled(
+                    "pod_unschedulable_reasons_total",
+                    reason=metric_label[dominant_reason(counts)],
+                )
+            msg = render_unschedulable(len(infos), counts)
+            if pst.ok:
+                msg = msg.rstrip(".") + f"; preemption nominated {nominated}."
+            self.events.record("FailedScheduling", pod.uid, message=msg)
             self.log.V(2).info("Unable to schedule pod", pod=pod.uid,
                                nodes=len(infos), failed=len(statuses),
                                nominated=nominated if pst.ok else "")
@@ -709,6 +754,13 @@ class Scheduler:
             chaos.poke(site, tracer=self.tracer, metrics=self.metrics)
         except chaos.ProcessKilled:
             self._dead = True
+            # black-box dump on the way down — diagnostic-only, never read
+            # by restore() (flightrecorder.py documents the deviation from
+            # the strict SIGKILL discipline)
+            try:
+                self._flight.dump(reason=site)
+            except Exception:  # noqa: BLE001 — evidence must not mask the kill
+                pass
             raise
 
     def _checkpoint_state(self) -> None:
@@ -1205,7 +1257,81 @@ class Scheduler:
         except Exception:
             self._release_crashed_commit(snap, done, assumed_now)
             raise
+        self._flight_record(profile_name, snap, result, len(failed), meta)
         return result, len(failed)
+
+    def _diagnose_failed(self, snap, result, arr, meta, failed) -> Dict[str, str]:
+        """Device-path unschedulable diagnosis (ops/explain.py): one
+        O(U_f·N) kernel evaluation over the FAILED equivalence classes,
+        decoded through the class index to upstream-shaped per-pod messages
+        against POST-CYCLE usage — cycle-start node_used plus the requests
+        this cycle's commits placed (`result`), i.e. what the operator sees
+        and the retry will face.  The per-class records land in the flight
+        recorder; pod_unschedulable_reasons_total{reason} counts each failed
+        pod under its dominant reason."""
+        from ..ops.explain import diagnose_failed
+
+        t0 = time.perf_counter()
+        row_of = {name: k for k, name in enumerate(meta.pod_names)}
+        node_row = {name: j for j, name in enumerate(meta.node_names)}
+        used = np.array(arr.node_used, copy=True)
+        for p in snap.pending_pods:
+            k = row_of.get(p.name)
+            j = node_row.get(result.get(p.name) or "")
+            if k is not None and j is not None:
+                used[j] += arr.pod_req[k]
+        rows = [row_of[p.name] for p in failed if p.name in row_of]
+        messages, dominant, records = diagnose_failed(arr, meta, rows, used)
+        self._last_diagnosis = records
+        msgs: Dict[str, str] = {}
+        for p in failed:
+            r = row_of.get(p.name)
+            if r in messages:
+                msgs[p.uid] = messages[r]
+                self.metrics.inc_labeled(
+                    "pod_unschedulable_reasons_total", reason=dominant[r]
+                )
+        dt = time.perf_counter() - t0
+        self.metrics.observe("scheduling_explain_duration_seconds", dt)
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "batch.explain", start=t0, end=t0 + dt,
+                failed=len(failed), classes=len(records),
+            )
+        return msgs
+
+    def _flight_record(self, profile_name, snap, result, n_failed, meta) -> None:
+        """One compact decision record per profile batch for the flight
+        recorder's ring — fingerprints, not payloads (a 50k-pod wave is a
+        few hundred bytes here).  Armed by the checkpoint dir: without one
+        nothing can ever dump the ring, so the warm cycle skips even the
+        O(P) fingerprint passes."""
+        if not self._flight.directory:
+            return
+        from .flightrecorder import fingerprint
+        from .tracing import current_trace_id
+
+        rec = {
+            "ts": time.time(),
+            "profile": profile_name,
+            "mode": self.config.mode,
+            "pods": len(snap.pending_pods),
+            "scheduled": sum(1 for v in result.values() if v),
+            "failed": n_failed,
+            "verdict_crc": fingerprint(result),
+            "trace_id": current_trace_id(),
+        }
+        if meta is not None:
+            rec["classes"] = meta.n_classes
+            if meta.pod_class is not None:
+                rec["class_crc"] = fingerprint(meta.pod_class)
+            rec["dirty_cols"] = (
+                int(meta.dirty_nodes.size) if meta.dirty_nodes is not None
+                else -1
+            )
+        if self._last_diagnosis:
+            rec["diagnosis"] = self._last_diagnosis
+        self._flight.record(**rec)
 
     def _commit_profile_batch(
         self, profile_name, snap, verdicts, result, failed, defer_ok,
@@ -1251,6 +1377,19 @@ class Scheduler:
                 # (victim evictions); its view must match the serial loop's,
                 # so the deferred fan-out lands first
                 self._flush_deferred_binds()
+            # on-demand unschedulable diagnosis (ops/explain.py —
+            # KTPU_EXPLAIN=1): per-failed-class reason counts decoded to
+            # upstream-shaped FailedScheduling messages.  Strictly off the
+            # warm step: only failing cycles pay, and only for U_f classes.
+            diag_msgs: Dict[str, str] = {}
+            self._last_diagnosis = []
+            if failed and arr is not None:
+                from ..ops.explain import explain_enabled
+
+                if explain_enabled():
+                    diag_msgs = self._diagnose_failed(
+                        snap, result, arr, meta, failed
+                    )
             # failure path: preemption through the CPU PostFilter, then requeue.
             # Three lazily-maintained pieces, each invalidated only by what
             # actually stales it:
@@ -1316,7 +1455,8 @@ class Scheduler:
                                 q for q in failed[pod_i:]
                                 if q.priority > min_bound_prio
                             ])
-                self.events.record("FailedScheduling", pod.uid)
+                self.events.record("FailedScheduling", pod.uid,
+                                   message=diag_msgs.get(pod.uid, ""))
                 if min_bound_prio is None or pod.priority <= min_bound_prio:
                     if batched is not None:
                         batched.note_nomination_cleared(pod)
@@ -1460,6 +1600,10 @@ class Scheduler:
             "scheduler.step", "serial_replay", tracer=self.tracer,
             metrics=self.metrics, start=t0, error=type(err).__name__,
         )
+        # a wave that needed serial replay is parity evidence: dump the
+        # decision ring next to the checkpoint so the miss ships with its
+        # history (flightrecorder.py)
+        self._flight.dump(reason=f"wave_recovery:{type(err).__name__}")
         return choices, ords, sweeps
 
     def _flush_deferred_binds(self) -> None:

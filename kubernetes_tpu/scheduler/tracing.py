@@ -127,6 +127,14 @@ def current_span() -> Optional[Span]:
     return _CURRENT.get()
 
 
+def current_trace_id() -> str:
+    """The active span's trace id ("" outside any span / tracing off) —
+    the flight recorder stamps it so a post-mortem record joins back to
+    the Perfetto trace of the cycle that produced it."""
+    s = _CURRENT.get()
+    return s.trace_id if s is not None else ""
+
+
 class TraceCollector:
     """Thread-safe in-process span ring + pod-context table.
 

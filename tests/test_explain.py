@@ -1,0 +1,465 @@
+"""Explainability plane (ISSUE 13): the device-derived unschedulable
+diagnosis must be pure observation — explain-on vs explain-off decisions
+bit-identical across kernels and meshes, reason counts equal to the host
+oracle EXACTLY (parity is the feature), the production routes undisturbed
+(KTPU010 zero retrace / KTPU011 transfer-guard clean with KTPU_EXPLAIN=1) —
+and the decision flight recorder must leave a readable dump when a chaos
+kill or a wave recovery fires."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.api.delta import DeltaEncoder, class_groups
+from kubernetes_tpu.ops import explain as ex
+from kubernetes_tpu.ops.assign import TRACE_COUNTS, reset_trace_counts
+from kubernetes_tpu.ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.scheduler import (
+    ClusterStore,
+    Scheduler,
+    SchedulerConfiguration,
+    run_restartable,
+)
+from kubernetes_tpu.scheduler.events import EventRecorder
+from kubernetes_tpu.scheduler.flightrecorder import (
+    FlightRecorder,
+    load_flight,
+    render_flight,
+)
+from kubernetes_tpu.scheduler.metrics import Metrics
+
+from helpers import mk_node, mk_pod, random_cluster
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _mixed_nodes():
+    return [
+        mk_node("n0", cpu=1000, labels={"zone": "a"}),
+        mk_node("n1", cpu=1000, labels={"zone": "b"},
+                taints=(t.Taint(key="gpu", effect=t.NO_SCHEDULE),)),
+        mk_node("n2", cpu=120, labels={"zone": "a"}),
+        mk_node("n3", cpu=1000, unschedulable=True),
+    ]
+
+
+def _failing_pods():
+    return [
+        mk_pod("fit0", cpu=100),
+        mk_pod("big0", cpu=5000),
+        mk_pod("zoned0", cpu=50, node_selector={"zone": "nowhere"}),
+        mk_pod("zoned1", cpu=50, node_selector={"zone": "nowhere"}),
+    ]
+
+
+# --- kernel == host oracle, exactly ---
+@pytest.mark.parametrize("seed", [3, 11, 29])
+def test_kernel_counts_equal_host_oracle(seed):
+    """Randomized clusters with taints + selectors: the jitted reason
+    counts equal the independent numpy recount bit-for-bit, and every
+    class's counts sum to the valid-node total (one reason per node)."""
+    rng = random.Random(seed)
+    snap = random_cluster(rng, n_nodes=16, n_pods=48,
+                          with_taints=True, with_selectors=True)
+    arr, meta = DeltaEncoder().encode(snap)
+    rows = list(range(meta.n_pods))
+    reps, _ = class_groups(meta, rows)
+    got = ex.explain_classes(arr, reps)
+    want = ex.explain_oracle(arr, reps)
+    np.testing.assert_array_equal(got, want)
+    n_valid = int(np.asarray(arr.node_valid).sum())
+    assert (got.sum(axis=1) == n_valid).all()
+
+
+def test_kernel_counts_respect_supplied_usage():
+    """Post-cycle usage flows through: filling a node flips its claim to
+    Insufficient cpu in kernel and oracle alike."""
+    snap = Snapshot(nodes=_mixed_nodes(), pending_pods=[mk_pod("p", cpu=500)])
+    arr, meta = DeltaEncoder().encode(snap)
+    used = np.array(arr.node_used, copy=True)
+    used[0, meta.resources.index("cpu")] += 900  # n0 nearly full now
+    got = ex.explain_classes(arr, np.array([0]), used)
+    want = ex.explain_oracle(arr, [0], used)
+    np.testing.assert_array_equal(got, want)
+    labels = ex.reason_labels(meta.resources)
+    counts = {labels[j]: int(got[0, j]) for j in range(len(labels))}
+    assert counts["Insufficient cpu"] >= 1
+
+
+def test_class_groups_dedupes_and_falls_back():
+    snap = Snapshot(nodes=_mixed_nodes(), pending_pods=_failing_pods())
+    arr, meta = DeltaEncoder().encode(snap)
+    rows = list(range(meta.n_pods))
+    reps, group_of = class_groups(meta, rows)
+    # zoned0/zoned1 share a spec -> one rep serves both rows
+    assert len(reps) < len(rows)
+    assert len({group_of[r] for r in rows}) == len(reps)
+    meta.pod_class = None  # plain-encode fallback: one class per row
+    reps2, group_of2 = class_groups(meta, rows)
+    assert list(reps2) == rows
+    assert all(group_of2[r] == i for i, r in enumerate(rows))
+
+
+# --- renderer + dominant reason ---
+def test_render_unschedulable_is_upstream_shaped_and_deterministic():
+    msg = ex.render_unschedulable(
+        5, {"Insufficient cpu": 2, "node(s) were unschedulable": 3}
+    )
+    assert msg == ("0/5 nodes are available: 3 node(s) were unschedulable, "
+                   "2 Insufficient cpu.")
+    assert ex.render_unschedulable(7, {}) == "0/7 nodes are available."
+    # count ties order by label; zero counts are dropped
+    msg = ex.render_unschedulable(2, {"b reason": 1, "a reason": 1, "z": 0})
+    assert msg == "0/2 nodes are available: 1 a reason, 1 b reason."
+
+
+def test_dominant_reason_tie_breaks_to_higher_priority_entry():
+    assert ex.dominant_reason({"first": 2, "second": 2, "third": 1}) == "first"
+    assert ex.dominant_reason({"a": 1, "b": 3}) == "b"
+
+
+# --- decisions bit-identical with explain on/off, routes undisturbed ---
+def _run_batch_sched(explain: bool, monkeypatch, mesh_env=None,
+                     force_chunked=None):
+    monkeypatch.setenv("KTPU_EXPLAIN", "1" if explain else "0")
+    if mesh_env is not None:
+        monkeypatch.setenv("KTPU_MESH", mesh_env)
+    else:
+        monkeypatch.delenv("KTPU_MESH", raising=False)
+    if force_chunked is not None:
+        monkeypatch.setenv("KTPU_FORCE_CHUNKED", force_chunked)
+    store = ClusterStore()
+    for nd in _mixed_nodes():
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for p in _failing_pods():
+        store.add_pod(p)
+    sched.run_until_idle()
+    # a warm delta: new arrivals after the first cycle (exercises the
+    # resident encoder / hoist path explain must not perturb)
+    store.add_pod(mk_pod("late0", cpu=100))
+    store.add_pod(mk_pod("late-big", cpu=9000))
+    sched.run_until_idle()
+    placements = {p.name: p.node_name for p in store.pods.values()}
+    return placements, sched
+
+
+@pytest.mark.parametrize("mesh_env", [None, "8"])
+@pytest.mark.parametrize("force_chunked", [None, "1"])
+def test_decisions_bit_identical_explain_on_off(mesh_env, force_chunked,
+                                                monkeypatch):
+    """The acceptance gate: with KTPU_EXPLAIN=1 every placement is
+    bit-identical to the explain-off run — across the plain and forced
+    chunked/rounds routings, single-device and mesh8 — and the production
+    kernels trace exactly as often (the explain kernel adds no retrace)."""
+    _run_batch_sched(False, monkeypatch, mesh_env, force_chunked)  # warm jit
+    reset_trace_counts()
+    off, _ = _run_batch_sched(False, monkeypatch, mesh_env, force_chunked)
+    routes_off = dict(TRACE_COUNTS)
+    reset_trace_counts()
+    on, sched = _run_batch_sched(True, monkeypatch, mesh_env, force_chunked)
+    routes_on = dict(TRACE_COUNTS)
+    assert on == off
+    assert routes_on == routes_off
+    # and the on-run really diagnosed: every FailedScheduling carries the
+    # upstream-shaped message
+    fails = sched.events.by_reason("FailedScheduling")
+    assert fails and all(
+        e.message.startswith("0/4 nodes are available:") for e in fails
+    )
+
+
+def test_incremental_route_decisions_unperturbed(monkeypatch):
+    """{chunked_inc, rounds_inc} × explain: running the diagnosis between
+    warm cycles changes neither the verdicts nor the inc-route trace
+    counts (the ISSUE's {inc} × {single-device} cell; the scheduler-level
+    test above covers inc under KTPU_MESH=8)."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    from kubernetes_tpu.ops.assign import schedule_batch_routed
+    from kubernetes_tpu.ops.incremental import HoistCache
+
+    rng = random.Random(13)
+    snap = random_cluster(rng, n_nodes=24, n_pods=120)
+
+    def run(with_explain: bool):
+        enc, cache = DeltaEncoder(), HoistCache()
+        s = snap
+        out = []
+        reset_trace_counts()
+        for _cycle in range(3):
+            arr, meta = enc.encode(s)
+            cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+            inc = cache.ensure(arr, meta, cfg)
+            choices, _ = schedule_batch_routed(arr, cfg, donate=False, inc=inc)
+            ch = np.asarray(choices)
+            out.append(ch.tolist())
+            if with_explain:
+                failed = [k for k in range(meta.n_pods) if ch[k] < 0]
+                msgs, dom, recs = ex.diagnose_failed(arr, meta, failed)
+                assert set(msgs) == set(failed)
+            bound = []
+            for k in range(meta.n_pods):
+                if ch[k] >= 0 and len(bound) < 4:
+                    p = next(q for q in s.pending_pods
+                             if q.name == meta.pod_names[k])
+                    import dataclasses
+
+                    bound.append(dataclasses.replace(
+                        p, node_name=meta.node_names[int(ch[k])]))
+            import dataclasses
+
+            pend = [dataclasses.replace(p, name=f"w-{p.name}", uid="")
+                    for p in s.pending_pods]
+            s = Snapshot(nodes=s.nodes, pending_pods=pend, bound_pods=bound)
+        return out, {k: v for k, v in TRACE_COUNTS.items() if v}
+
+    _, routes_cold = run(False)  # cold run: proves the inc route engaged
+    assert any(k.endswith("_inc") for k in routes_cold), routes_cold
+    verdicts_off, routes_off = run(False)  # warm from here: clean A/B
+    verdicts_on, routes_on = run(True)
+    assert verdicts_on == verdicts_off
+    assert routes_on == routes_off
+
+
+# --- event messages equal a host-oracle recount exactly ---
+def test_device_failure_events_match_host_oracle_recount(monkeypatch):
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    store = ClusterStore()
+    nodes = _mixed_nodes()
+    for nd in nodes:
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for p in _failing_pods():
+        store.add_pod(p)
+    sched.run_until_idle()
+    failed_pods = [p for p in store.pods.values() if not p.node_name]
+    bound_pods = [p for p in store.pods.values() if p.node_name]
+    assert failed_pods and bound_pods
+    # independent recount: fresh encode of the POST-CYCLE state (bound pods
+    # fold into node_used exactly like the scheduler's post-commit usage)
+    arr2, meta2 = DeltaEncoder().encode(Snapshot(
+        nodes=nodes, pending_pods=failed_pods, bound_pods=bound_pods,
+    ))
+    labels = ex.reason_labels(meta2.resources)
+    by_uid = {e.pod: e.message
+              for e in sched.events.by_reason("FailedScheduling")}
+    for p in failed_pods:
+        row = meta2.pod_names.index(p.name)
+        counts = ex.explain_oracle(arr2, [row])[0]
+        want = ex.render_unschedulable(
+            meta2.n_nodes,
+            {labels[j]: int(counts[j]) for j in range(len(labels))},
+        )
+        assert by_uid[p.uid] == want
+    # the labeled metric aggregated one dominant reason per failed pod
+    series = sched.metrics.labeled_counter_series(
+        "pod_unschedulable_reasons_total")
+    assert sum(series.values()) == len(failed_pods)
+
+
+def test_explain_off_keeps_device_events_silent(monkeypatch):
+    monkeypatch.setenv("KTPU_EXPLAIN", "0")
+    store = ClusterStore()
+    for nd in _mixed_nodes():
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(mk_pod("big", cpu=5000))
+    sched.run_until_idle()
+    fails = sched.events.by_reason("FailedScheduling")
+    assert fails and all(e.message == "" for e in fails)
+    assert sched.metrics.labeled_counter_series(
+        "pod_unschedulable_reasons_total") == {}
+
+
+# --- CPU path shares the renderer ---
+def test_cpu_path_message_renders_per_plugin_breakdown():
+    store = ClusterStore()
+    for nd in _mixed_nodes():
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="cpu"))
+    store.add_pod(mk_pod("big", cpu=5000))
+    sched.run_until_idle(max_cycles=2)
+    [e] = sched.events.by_reason("FailedScheduling")[:1]
+    assert e.message.startswith("0/4 nodes are available:")
+    assert "Insufficient cpu" in e.message
+    # per-node one-status counts sum to the cluster size
+    total = sum(int(part.strip().split(" ", 1)[0])
+                for part in e.message.split(":", 1)[1].rstrip(".").split(","))
+    assert total == 4
+    series = sched.metrics.labeled_counter_series(
+        "pod_unschedulable_reasons_total")
+    assert sum(series.values()) >= 1
+
+
+# --- kubectl surfaces ---
+def test_kubectl_describe_and_events_show_diagnosis(monkeypatch):
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    from kubernetes_tpu.kubectl import make_admin_kubectl
+
+    store = ClusterStore()
+    for nd in _mixed_nodes():
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(mk_pod("big", cpu=5000))
+    sched.run_until_idle()
+    kc = make_admin_kubectl(store=store, recorder=sched.events)
+    out = kc.run("describe pod big")
+    assert "FailedScheduling" in out
+    assert "0/4 nodes are available:" in out
+    ev = kc.run("get events")
+    assert "0/4 nodes are available:" in ev
+
+
+# --- KTPU010 / KTPU011 stay clean with the plane armed ---
+def test_device_pass_retrace_and_transfer_rules_clean_with_explain(monkeypatch):
+    """KTPU_EXPLAIN=1 while the ktpu-verify device pass traces all twelve
+    production routes: zero warm-cycle retraces (KTPU010) and a
+    transfer-guard-clean warm loop (KTPU011) — the plane is additive."""
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    from kubernetes_tpu.analysis.devicecheck import run_device_pass
+
+    rep = run_device_pass(rule_ids=["KTPU010", "KTPU011"])
+    assert rep.errors == []
+    assert rep.findings == [], [f.fingerprint for f in rep.findings]
+
+
+# --- flight recorder ---
+def test_flight_ring_is_bounded_and_ordered(tmp_path):
+    fr = FlightRecorder(directory=str(tmp_path), capacity=4)
+    for i in range(10):
+        fr.record(profile="default", pods=i)
+    recs = fr.records()
+    assert len(recs) == 4
+    assert [r["seq"] for r in recs] == [7, 8, 9, 10]
+    path = fr.dump(reason="test")
+    doc = load_flight(path)
+    assert doc["reason"] == "test" and len(doc["records"]) == 4
+    assert "pods=9" in render_flight(doc)
+
+
+def test_flight_dump_absent_without_directory():
+    fr = FlightRecorder(directory=None, capacity=2)
+    fr.record(pods=1)
+    assert fr.dump(reason="x") is None
+
+
+def test_chaos_kill_leaves_readable_flight_dump(tmp_path, monkeypatch):
+    """The acceptance path: a kill.post_assume chaos kill dumps the ring
+    into the checkpoint dir; the dump parses, names the killing site, and
+    the post-mortem CLI reads it (exit 0) — while the restarted run still
+    converges."""
+    monkeypatch.setenv("KTPU_CHECKPOINT_DIR", str(tmp_path))
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    with chaos.chaos_plan(chaos.FaultPlan.parse("kill.post_assume:kill@0")):
+        store = ClusterStore()
+        for nd in _mixed_nodes():
+            store.add_node(nd)
+        sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+        for p in _failing_pods():
+            store.add_pod(p)
+        sched, restarts = run_restartable(sched)
+    assert restarts == 1
+    dump = tmp_path / "flight.json"
+    assert dump.exists()
+    doc = load_flight(str(dump))
+    assert doc["reason"] == "kill.post_assume"
+    # the CLI contract: readable dump = exit 0; corrupt = exit 2
+    from kubernetes_tpu.analysis.__main__ import main as verify_main
+
+    assert verify_main(["--flight", str(dump)]) == 0
+    dump.write_text("{not json")
+    assert verify_main(["--flight", str(dump)]) == 2
+    # structurally corrupt (valid JSON, wrong shape) is unusable too, not
+    # a traceback / exit-1 misread as an analyzer finding
+    dump.write_text('{"records": 5}')
+    assert verify_main(["--flight", str(dump)]) == 2
+    # ... and so is a list-of-dicts dump with wrong-TYPED fields
+    dump.write_text('{"records": [{"seq": 1, "trace_id": 123}]}')
+    assert verify_main(["--flight", str(dump)]) == 2
+    assert verify_main(["--flight", str(tmp_path / "missing.json")]) == 2
+
+
+def test_flight_k_knob_clamps_instead_of_crashing(monkeypatch):
+    monkeypatch.setenv("KTPU_FLIGHT_K", "not-a-number")
+    fr = FlightRecorder()
+    assert fr.capacity == 64
+    monkeypatch.setenv("KTPU_FLIGHT_K", "3")
+    assert FlightRecorder().capacity == 3
+
+
+def test_flight_records_capture_diagnosis_and_fingerprints(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    store = ClusterStore()
+    for nd in _mixed_nodes():
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"),
+                      checkpoint_dir=str(tmp_path))
+    for p in _failing_pods():
+        store.add_pod(p)
+    sched.run_until_idle()
+    recs = sched._flight.records()
+    assert recs
+    first = recs[0]
+    assert first["failed"] >= 3 and first["scheduled"] >= 1
+    assert first["verdict_crc"] and first["class_crc"]
+    assert first["diagnosis"]
+    assert all("counts" in d and d["pods"] >= 1 for d in first["diagnosis"])
+    # records are JSON-serializable as dumped (no numpy leakage)
+    json.dumps(recs)
+
+
+def test_unarmed_scheduler_skips_flight_recording(monkeypatch):
+    """No checkpoint dir = nothing could ever dump the ring, so the warm
+    cycle must not pay the per-cycle fingerprint passes either."""
+    monkeypatch.delenv("KTPU_CHECKPOINT_DIR", raising=False)
+    store = ClusterStore()
+    for nd in _mixed_nodes():
+        store.add_node(nd)
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    store.add_pod(mk_pod("p0", cpu=100))
+    sched.run_until_idle()
+    assert sched._flight.records() == []
+
+
+# --- EventRecorder drop accounting ---
+def test_events_publish_dropped_total_counts_token_bucket_refusals():
+    store = ClusterStore()
+    m = Metrics()
+    rec = EventRecorder(store=store, publish_qps=0.0, publish_burst=1,
+                        metrics=m)
+    rec.record("FailedScheduling", "default/p0", message="m")
+    rec.record("FailedScheduling", "default/p1", message="m")
+    rec.record("FailedScheduling", "default/p2", message="m")
+    assert m.counters["events_publish_dropped_total"] == 2
+    # the in-memory decision log stays complete either way
+    assert len(rec.by_reason("FailedScheduling")) == 3
+
+
+def test_harness_event_fields_stamp_drops_and_top_reasons():
+    from kubernetes_tpu.bench.harness import event_fields
+
+    m = Metrics()
+    assert event_fields(m) == {"events_publish_dropped": 0,
+                               "unschedulable_reasons": None}
+    m.inc("events_publish_dropped_total", 3)
+    for _ in range(2):
+        m.inc_labeled("pod_unschedulable_reasons_total",
+                      reason="Insufficient cpu")
+    m.inc_labeled("pod_unschedulable_reasons_total",
+                  reason="node(s) were unschedulable")
+    out = event_fields(m)
+    assert out["events_publish_dropped"] == 3
+    assert out["unschedulable_reasons"] == {
+        "Insufficient cpu": 2, "node(s) were unschedulable": 1,
+    }
