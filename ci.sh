@@ -1,20 +1,29 @@
 #!/usr/bin/env bash
 # The CI gate — the exact checks every push must pass, runnable by humans
 # too (`./ci.sh`), so CI and a laptop can never disagree about what green
-# means.  Three stages, fail-fast:
+# means.  Four stages, fail-fast:
 #
 #   1. tier-1 tests        the ROADMAP.md tier-1 command (not slow, 870 s cap)
-#   2. ktpu-verify         AST + device + shard passes (KTPU001–018) — the
-#                          verify stack PRs 8–10 built, gated on every push
-#   3. regression gate     bench/regression.py over the BENCH_r*.json
-#                          trajectory (same-platform comparison only)
+#   2. ktpu-verify         AST + device + shard passes (KTPU001–019, the
+#                          device cost observatory's KTPU019 sub-phase
+#                          ledger gate included) — the verify stack PRs
+#                          8–10 built, gated on every push
+#   3. --profile smoke     the device cost observatory end to end in a
+#                          fresh process (bench.harness --stream --profile):
+#                          sub-phase capture + analytic reconciliation must
+#                          pass (the harness exits 1 on either failure)
+#   4. regression gates    bench/regression.py over the BENCH_r*.json
+#                          trajectory (same-platform comparison only), plus
+#                          the observatory's round_loop_fraction /
+#                          device_flops / device_hbm_bytes scalars from the
+#                          stage-3 artifact
 #
 # Exit non-zero on the first failing stage.  .github/workflows/ci.yml runs
 # exactly this script.
 set -uo pipefail
 cd "$(dirname "$0")"
 
-echo "=== [1/3] tier-1 tests ==="
+echo "=== [1/4] tier-1 tests ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
@@ -26,25 +35,43 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
-echo "=== [2/3] ktpu-verify (AST + device + shard) ==="
+echo "=== [2/4] ktpu-verify (AST + device + shard, incl. KTPU019) ==="
 JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard || {
   rc=$?
   echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
   exit "$rc"
 }
 
-echo "=== [3/3] bench regression gate ==="
-python -m kubernetes_tpu.bench.regression || {
+echo "=== [3/4] device cost observatory smoke (--profile) ==="
+# fresh process (XLA parses dump flags once); reduced stream shape so the
+# smoke prices the capture path, not the full BENCH scale
+rm -rf /tmp/ktpu-ci-profile
+JAX_PLATFORMS=cpu KTPU_STREAM_SHAPE=512x128 \
+  python -m kubernetes_tpu.bench.harness --stream 2 \
+  --profile /tmp/ktpu-ci-profile --out /tmp/KTPU_CI_PROFILE.json \
+  > /dev/null || {
+  rc=$?
+  echo "ci: --profile smoke failed (rc=$rc; capture or reconciliation)" >&2
+  exit "$rc"
+}
+
+echo "=== [4/4] bench regression gates ==="
+# exit 2 = no comparable same-platform artifact pair on this runner — the
+# gate is advisory there (CI boxes have no BENCH trajectory of their own);
+# a real regression (exit 1) still fails the build
+run_gate() {
+  python -m kubernetes_tpu.bench.regression "$@"
   rc=$?
   if [ "$rc" -eq 2 ]; then
-    # unusable = no comparable same-platform artifact pair on this runner —
-    # the gate is advisory there (CI boxes have no BENCH trajectory of
-    # their own); a real regression (exit 1) still fails the build
-    echo "ci: regression gate unusable on this runner (no comparable artifacts) — skipped"
-  else
-    echo "ci: bench regression gate failed (rc=$rc)" >&2
+    echo "ci: regression gate ($*) unusable on this runner — skipped"
+  elif [ "$rc" -ne 0 ]; then
+    echo "ci: bench regression gate ($*) failed (rc=$rc)" >&2
     exit "$rc"
   fi
 }
+run_gate
+run_gate --metric round_loop_fraction --current /tmp/KTPU_CI_PROFILE.json
+run_gate --metric device_flops --current /tmp/KTPU_CI_PROFILE.json
+run_gate --metric device_hbm_bytes --current /tmp/KTPU_CI_PROFILE.json
 
 echo "CI green"

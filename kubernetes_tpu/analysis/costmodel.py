@@ -1,0 +1,329 @@
+"""Analytic kernel-interior cost model — the roofline ledger half of the
+device cost observatory (KTPU019's evidence).
+
+bench/profiling.py MEASURES where the device step's time goes by mapping
+profiler ops back to their owning `jax.named_scope` sub-phase
+(ops/scopes.py).  This module predicts the same breakdown from first
+principles: it walks the jaxprs the twelve-route tracer
+(analysis/devicecheck.collect_traces) already captures and charges every
+LEAF eqn's FLOPs and HBM bytes to the same owning sub-phase — the innermost
+declared scope on its name stack, `ops.scopes.subphase_of`, so an op can
+never be owned by two different sub-phases across the two halves.
+
+Cost model (deliberately simple — dominant blocks, not every XLA temp,
+exactly the shard_hbm_estimate / shard_comm_estimate philosophy whose
+KTPU012/KTPU017 tolerances absorb the rest):
+
+  FLOPs      dot_general = 2 x out_size x contraction_size; reductions /
+             cumulative ops = input size; sort/top_k = n log2 n; everything
+             else = output size (one op per element)
+  HBM bytes  sum of input + output aval bytes per eqn (the roofline
+             convention: every operand streams once)
+  comm bytes collective eqns' output bytes — the same definition
+             jaxrules.collective_bytes measures, so the three estimators
+             share one field model
+  loops      a `scan` body multiplies by its static `length`; a `while`
+             body by KTPU_COST_ROUNDS (the prefix-commit round loop's trip
+             count is data-dependent; the default is the measured
+             rounds/chunk mean from BENCH_ROUNDS_PROOF_r05) — static
+             program cost scaled to expected dynamic cost
+
+Roofline classification: per sub-phase, modeled time is
+max(flops/peak_flops, hbm/peak_hbm, comm/peak_ici) and the binding resource
+names the bound (compute / memory / comm).  Peaks are knobs
+(KTPU_PEAK_FLOPS / KTPU_PEAK_HBM_BPS / KTPU_PEAK_ICI_BPS, defaulting to
+TPU v5e-ish numbers); on the CPU sim the absolute seconds are fiction but
+the SHARES are what KTPU019 reconciles, and shares only need the relative
+cost model.
+
+`round_loop_fraction` is a ROLLUP: the share of modeled time on eqns whose
+scope path passes through `round_loop` at any depth (the loop's interior
+speculate/repair/commit included) — ROADMAP-1's target as one number, the
+same rollup bench/profiling.py computes on the measured side.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..ops.scopes import SUBPHASES, subphase_of
+
+# an unowned eqn is "heavy" (a KTPU019 finding) when it carries at least
+# this fraction of the route's total modeled time — scale-free, so tiny
+# glue (reshapes, converts, loop counters) never flags while any real
+# block outside the declared scopes does
+HEAVY_FRACTION = 0.01
+
+# KTPU019 reconciliation tolerance: the analytic and measured round-loop
+# shares must agree within this FACTOR (ratio of the larger to the smaller,
+# after a 0.05 absolute floor so two "negligible" shares always reconcile).
+# Stated tolerance, same contract shape as jaxrules.HBM_TOLERANCE: the
+# model prices dominant blocks against assumed peaks, not the machine.
+SUBPHASE_TOLERANCE = 4.0
+
+_ROLLUP = "round_loop"
+
+
+def assumed_rounds() -> int:
+    """KTPU_COST_ROUNDS — the while-loop trip count the analytic ledger
+    charges per prefix-commit round loop (data-dependent at runtime;
+    default 9 ≈ the north-star rounds/chunk mean, BENCH_ROUNDS_PROOF_r05
+    "8.7 rounds/chunk at north-star scale")."""
+    return int(os.environ.get("KTPU_COST_ROUNDS", "9"))
+
+
+@dataclass(frozen=True)
+class Roofline:
+    """Peak numbers the ledger classifies against (bytes/s, flop/s)."""
+
+    peak_flops: float
+    peak_hbm_bps: float
+    peak_ici_bps: float
+
+    @classmethod
+    def from_env(cls) -> "Roofline":
+        """KTPU_PEAK_FLOPS / KTPU_PEAK_HBM_BPS / KTPU_PEAK_ICI_BPS, with
+        TPU v5e-flavored defaults (f32 MXU ~98 TFLOP/s, HBM ~819 GB/s, ICI
+        ~4.5e10 B/s per link).  Operators profiling other hardware set the
+        knobs; shares (what KTPU019 gates) are peak-insensitive whenever
+        one resource binds uniformly."""
+        return cls(
+            peak_flops=float(os.environ.get("KTPU_PEAK_FLOPS", "9.8e13")),
+            peak_hbm_bps=float(os.environ.get("KTPU_PEAK_HBM_BPS", "8.19e11")),
+            peak_ici_bps=float(os.environ.get("KTPU_PEAK_ICI_BPS", "4.5e10")),
+        )
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    return int(size) * int(dtype.itemsize)
+
+
+def _out_size(eqn) -> int:
+    return sum(
+        int(getattr(getattr(ov, "aval", None), "size", 0) or 0)
+        for ov in eqn.outvars
+    )
+
+
+def _in_size(eqn) -> int:
+    return sum(
+        int(getattr(getattr(iv, "aval", None), "size", 0) or 0)
+        for iv in eqn.invars
+    )
+
+
+_REDUCE_PRIMS = (
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "reduce_window_sum", "reduce_window",
+    "reduce_window_max", "cumsum", "cummax", "cummin", "reduce_precision",
+)
+_SORT_PRIMS = ("sort", "top_k", "approx_top_k")
+
+
+def _eqn_flops(eqn) -> float:
+    name = eqn.primitive.name
+    if name == "dot_general":
+        (lhs_c, _rhs_c), _batch = eqn.params["dimension_numbers"]
+        lhs_shape = getattr(getattr(eqn.invars[0], "aval", None), "shape", ())
+        contract = 1
+        for d in lhs_c:
+            contract *= int(lhs_shape[d]) if d < len(lhs_shape) else 1
+        return 2.0 * _out_size(eqn) * max(1, contract)
+    if name in _REDUCE_PRIMS:
+        return float(_in_size(eqn))
+    if name in _SORT_PRIMS:
+        shape = getattr(getattr(eqn.invars[0], "aval", None), "shape", (1,))
+        n = int(shape[-1]) if shape else 1
+        return float(_in_size(eqn)) * math.log2(max(2, n))
+    return float(_out_size(eqn))
+
+
+def _leaf_costs(jaxpr, prefix: str = "", mult: float = 1.0,
+                while_trip: Optional[float] = None):
+    """Yield (scope_path, prim_name, flops, hbm_bytes, comm_bytes) per LEAF
+    eqn, scaled by the product of enclosing loop trip counts.  Containers
+    (scan / while / cond / pjit / custom_*) are never charged themselves —
+    their interiors are, under the container's scope prefix (interior name
+    stacks are relative to their container)."""
+    from .jaxrules import COLLECTIVE_PRIMS, _sub_jaxprs
+
+    if while_trip is None:
+        while_trip = float(assumed_rounds())
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ns = str(getattr(eqn.source_info, "name_stack", "") or "")
+        path = f"{prefix}/{ns}" if prefix and ns else (prefix or ns)
+        if name == "scan":
+            inner = eqn.params["jaxpr"]
+            inner = getattr(inner, "jaxpr", inner)
+            length = float(eqn.params.get("length", 1) or 1)
+            yield from _leaf_costs(inner, path, mult * length, while_trip)
+            continue
+        if name == "while":
+            for key, m in (("cond_jaxpr", 1.0), ("body_jaxpr", while_trip)):
+                inner = eqn.params[key]
+                inner = getattr(inner, "jaxpr", inner)
+                yield from _leaf_costs(inner, path, mult * m, while_trip)
+            continue
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            if branches:  # KTPU009 requires identical branches: charge one
+                inner = getattr(branches[0], "jaxpr", branches[0])
+                yield from _leaf_costs(inner, path, mult, while_trip)
+            continue
+        subs = list(_sub_jaxprs(eqn))
+        if subs:  # pjit / custom_* / shard_map wrappers: transparent
+            for sub in subs:
+                yield from _leaf_costs(sub, path, mult, while_trip)
+            continue
+        hbm = sum(_aval_bytes(v) for v in (*eqn.invars, *eqn.outvars))
+        comm = 0
+        if name in COLLECTIVE_PRIMS:
+            comm = sum(_aval_bytes(ov) for ov in eqn.outvars)
+        yield (path, name, mult * _eqn_flops(eqn), mult * hbm, mult * comm)
+
+
+def _bound_of(flops: float, hbm: float, comm: float,
+              roof: Roofline) -> Tuple[float, str]:
+    times = {
+        "compute": flops / roof.peak_flops,
+        "memory": hbm / roof.peak_hbm_bps,
+        "comm": comm / roof.peak_ici_bps,
+    }
+    bound = max(times, key=times.get)
+    return times[bound], (bound if times[bound] > 0 else "memory")
+
+
+def dominant_phase(self_fractions: Dict[str, float],
+                   rollup: float) -> Optional[str]:
+    """The table's dominant sub-phase: the round-loop ROLLUP competes
+    against the phases outside the loop (the loop's interior
+    speculate/repair rows are part of the rollup, not rivals to it).  One
+    definition shared by the analytic (this module) and measured
+    (bench/profiling.py) halves."""
+    outside = {
+        p: f for p, f in self_fractions.items()
+        if p not in (_ROLLUP, "speculate", "repair")
+    }
+    outside[_ROLLUP] = rollup
+    return max(outside, key=outside.get) if outside else None
+
+
+def in_round_loop(path: str) -> bool:
+    """Whether a scope path passes through the round loop at any depth —
+    the rollup membership test both halves share."""
+    return f"/{_ROLLUP}" in f"/{path}" or path == _ROLLUP
+
+
+def jaxpr_ledger(closed_jaxpr, while_trip: Optional[float] = None,
+                 roofline: Optional[Roofline] = None) -> Dict[str, Any]:
+    """The per-sub-phase analytic ledger of one traced program.
+
+    Returns {"subphases": {phase: {flops, hbm_bytes, comm_bytes, intensity,
+    bound, modeled_s, fraction}}, "total_*", "round_loop_fraction",
+    "dominant", "heavy_unowned": [...]}.  `fraction` is modeled-time share
+    over ALL leaf eqns ('' = unowned rows sum under the "unowned" key), so
+    the fractions sum to 1.0 by construction; `round_loop_fraction` is the
+    rollup over every eqn whose path passes through `round_loop`."""
+    roof = roofline or Roofline.from_env()
+    jx = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    acc: Dict[str, List[float]] = {}
+    rollup = [0.0, 0.0, 0.0]
+    unowned: Dict[str, List[float]] = {}  # path/prim -> [flops, hbm, comm]
+    for path, prim, flops, hbm, comm in _leaf_costs(
+            jx, while_trip=while_trip):
+        phase = subphase_of(path) or "unowned"
+        a = acc.setdefault(phase, [0.0, 0.0, 0.0, 0.0])
+        a[0] += flops
+        a[1] += hbm
+        a[2] += comm
+        a[3] += 1
+        if in_round_loop(path):
+            rollup[0] += flops
+            rollup[1] += hbm
+            rollup[2] += comm
+        if phase == "unowned":
+            u = unowned.setdefault(f"{prim}@{path or '<top>'}",
+                                   [0.0, 0.0, 0.0])
+            u[0] += flops
+            u[1] += hbm
+            u[2] += comm
+    total_s = 0.0
+    rows: Dict[str, Dict[str, Any]] = {}
+    for phase, (flops, hbm, comm, n) in acc.items():
+        t, bound = _bound_of(flops, hbm, comm, roof)
+        rows[phase] = {
+            "flops": round(flops),
+            "hbm_bytes": round(hbm),
+            "comm_bytes": round(comm),
+            "n_eqns": int(n),
+            "intensity": round(flops / hbm, 4) if hbm else 0.0,
+            "bound": bound,
+            "modeled_s": t,
+        }
+        total_s += t
+    for phase, row in rows.items():
+        row["fraction"] = round(row["modeled_s"] / total_s, 4) if total_s else 0.0
+        row["modeled_s"] = round(row["modeled_s"], 9)
+    rl_t, _ = _bound_of(*rollup, roof)
+    rl_frac = round(rl_t / total_s, 4) if total_s else 0.0
+    dominant = dominant_phase(
+        {p: r["fraction"] for p, r in rows.items()}, rl_frac
+    )
+    heavy = []
+    for key, (flops, hbm, comm) in unowned.items():
+        t, _ = _bound_of(flops, hbm, comm, roof)
+        frac = t / total_s if total_s else 0.0
+        if frac >= HEAVY_FRACTION:
+            heavy.append({"eqn": key, "fraction": round(frac, 4)})
+    heavy.sort(key=lambda h: -h["fraction"])
+    return {
+        "subphases": {p: rows[p] for p in (*SUBPHASES, "unowned") if p in rows},
+        "total_flops": round(sum(r["flops"] for r in rows.values())),
+        "total_hbm_bytes": round(sum(r["hbm_bytes"] for r in rows.values())),
+        "total_comm_bytes": round(sum(r["comm_bytes"] for r in rows.values())),
+        "round_loop_fraction": rl_frac,
+        "dominant": dominant,
+        "assumed_rounds": while_trip if while_trip is not None
+        else assumed_rounds(),
+        "heavy_unowned": heavy,
+    }
+
+
+def route_ledger(trace, while_trip: Optional[float] = None,
+                 roofline: Optional[Roofline] = None) -> Optional[Dict]:
+    """The ledger of one devicecheck.RouteTrace (None when the route was
+    skipped / carries no jaxpr)."""
+    if getattr(trace, "jaxpr", None) is None:
+        return None
+    return jaxpr_ledger(trace.jaxpr, while_trip=while_trip,
+                        roofline=roofline)
+
+
+def reconcile(analytic_rl: float, measured_rl: float,
+              tolerance: float = SUBPHASE_TOLERANCE) -> Dict[str, Any]:
+    """The KTPU019 join: analytic vs measured round-loop share.  Shares
+    below the 0.05 floor reconcile vacuously (both halves call the loop
+    negligible); otherwise the larger/smaller ratio must stay within
+    `tolerance`."""
+    a = max(float(analytic_rl), 0.0)
+    m = max(float(measured_rl), 0.0)
+    floor = 0.05
+    if a < floor and m < floor:
+        return {"ok": True, "analytic": a, "measured": m, "ratio": 1.0,
+                "tolerance": tolerance, "note": "both shares below floor"}
+    lo, hi = min(a, m), max(a, m)
+    ratio = hi / max(lo, floor)
+    return {
+        "ok": ratio <= tolerance,
+        "analytic": round(a, 4), "measured": round(m, 4),
+        "ratio": round(ratio, 4), "tolerance": tolerance,
+    }

@@ -114,6 +114,12 @@ class RouteTrace:
     # shardings vs the table's out.* rows (KTPU018); None = not captured
     # (single-device route, or backend exposing no output shardings)
     out_sharding_report: Optional[List[Dict[str, Any]]] = None
+    # ---- device cost observatory (analysis/costmodel.py, KTPU019) ----
+    # the per-sub-phase analytic roofline ledger of the traced program
+    cost: Optional[Dict[str, Any]] = None
+    # a measured sub-phase table (bench/profiling.py) when one exists for
+    # this route — KTPU019 reconciles the two round-loop shares
+    measured_subphases: Optional[Dict[str, Any]] = None
 
     def capture(self, jaxpr_fn, jaxpr_args, jitted_fn, lower_args):
         """Fill the program-capture fields — jaxpr + collective walk,
@@ -132,6 +138,12 @@ class RouteTrace:
         self.collectives, self.cond_divergences = collective_walk(
             closed.jaxpr)
         self.collective_bytes = collective_bytes(closed.jaxpr)
+        # the analytic per-sub-phase roofline ledger (costmodel.py): ONE
+        # extraction path, so fixtures and the production pass can never
+        # check different cost logic
+        from .costmodel import route_ledger
+
+        self.cost = route_ledger(self)
         with _quiet_donation():
             lowered = jitted_fn.lower(*lower_args)
         self.lowered_text = lowered.as_text()
@@ -183,6 +195,9 @@ class RouteTrace:
                 "comm_est": self.comm_est,
                 "out_shardings": self.out_sharding_report,
             },
+            # the analytic roofline ledger (costmodel.py — the KTPU019
+            # evidence; every traced route must carry one)
+            "cost": self.cost,
         }
 
 

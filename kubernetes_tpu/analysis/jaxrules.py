@@ -35,6 +35,15 @@ checks one machine-readable artifact captured by analysis/devicecheck.py
                               parallel/mesh.shard_hbm_estimate within
                               HBM_TOLERANCE — the PARITY.md scale ceiling
                               is a checked number, not prose
+  KTPU019 subphase-ledger     the device cost observatory's join
+                              (analysis/costmodel.py): every heavy eqn of
+                              every traced route is owned by a declared
+                              named-scope sub-phase (ops/scopes.py —
+                              unannotated kernels are findings, fail
+                              closed like KTPU013), and on routes carrying
+                              a measured profile table the analytic
+                              round-loop share reconciles with the
+                              measured one within SUBPHASE_TOLERANCE
 
 Rules operate on devicecheck.RouteTrace objects (fixture tests build small
 synthetic traces with RouteTrace.from_callable), return engine.Finding
@@ -435,6 +444,61 @@ def collective_bytes(jaxpr) -> List[Tuple[str, int]]:
     return out
 
 
+class SubphaseLedgerRule(DeviceRule):
+    """KTPU019 — the device cost observatory's gate (analysis/costmodel.py):
+
+    * COVERAGE (fail closed, the KTPU013 shape): every leaf eqn carrying
+      >= costmodel.HEAVY_FRACTION of a traced route's modeled time must be
+      owned by a declared named-scope sub-phase (ops/scopes.py).  A heavy
+      unowned eqn is a kernel region the observatory cannot attribute —
+      exactly the blindness this plane exists to remove.
+    * RECONCILIATION: a trace carrying a measured sub-phase table
+      (`measured_subphases`, stamped by bench/profiling.py fixtures and
+      profiled runs) must agree with the analytic ledger on the round-loop
+      rollup share within costmodel.SUBPHASE_TOLERANCE.
+    """
+
+    rule_id = "KTPU019"
+    title = "subphase-ledger: heavy eqns owned by a sub-phase; analytic vs " \
+            "measured round-loop share reconciles"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        from .costmodel import reconcile, route_ledger
+
+        findings: List[Finding] = []
+        for t in traces:
+            ledger = getattr(t, "cost", None) or route_ledger(t)
+            if ledger is None:
+                continue
+            for h in ledger["heavy_unowned"]:
+                findings.append(_finding(
+                    t, self.rule_id,
+                    f"heavy eqn outside every declared sub-phase scope: "
+                    f"{h['eqn']} carries {h['fraction']:.1%} of the route's "
+                    "modeled time — annotate it (ops/scopes.py) or the "
+                    "observatory under-attributes the kernel",
+                    f"unowned:{h['eqn']}",
+                ))
+            measured = getattr(t, "measured_subphases", None)
+            if measured:
+                rec = reconcile(
+                    ledger["round_loop_fraction"],
+                    measured.get("round_loop_fraction", 0.0),
+                )
+                if not rec["ok"]:
+                    findings.append(_finding(
+                        t, self.rule_id,
+                        "analytic round-loop share "
+                        f"{rec['analytic']:.2f} and measured share "
+                        f"{rec['measured']:.2f} diverge by "
+                        f"{rec['ratio']:.1f}x (> {rec['tolerance']}x) — "
+                        "the cost model and the profile disagree about "
+                        "where the kernel's time goes",
+                        "reconcile:round_loop",
+                    ))
+        return findings
+
+
 ALL_DEVICE_RULES = [
     DtypeFlowRule,
     DonationHonoredRule,
@@ -442,6 +506,7 @@ ALL_DEVICE_RULES = [
     RecompileGuardRule,
     TransferGuardRule,
     HbmEstimateRule,
+    SubphaseLedgerRule,
 ]
 
 DEVICE_RULE_IDS = tuple(r.rule_id for r in ALL_DEVICE_RULES)

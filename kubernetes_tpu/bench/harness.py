@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from dataclasses import dataclass
@@ -87,6 +88,12 @@ class PerfData:
     # cycles + every CPU-path failure)
     events_publish_dropped: int = 0
     unschedulable_reasons: Optional[Dict[str, int]] = None
+    # queue-pool depth observability (scheduler/queue.py — depths(), sampled
+    # at each cycle boundary): {pool: {"final": gauge, "peak": high-water}}
+    # for activeQ / backoff / unschedulable / parked, stamped next to
+    # sli_p99_ms — today's single pending_pods gauge cannot tell a retry
+    # storm from an event-starved park
+    queue_depths: Optional[Dict] = None
 
     def to_json(self) -> Dict:
         return self.__dict__
@@ -230,6 +237,23 @@ def event_fields(metrics) -> Dict:
     }
 
 
+def queue_fields(metrics) -> Dict:
+    """The queue-pool depth artifact block — final + peak depth per pool
+    from the cycle-boundary gauges (scheduler.py — _sample_queue_depths).
+    None when the run never sampled (no batch cycle ran), so untouched
+    rounds keep their artifact shape."""
+    _counters, gauges, _hists = metrics.snapshot()
+    out = {}
+    for pool in ("active", "backoff", "unschedulable", "parked"):
+        name = f"queue_pool_{pool}_pods"
+        if name in gauges or f"{name}_peak" in gauges:
+            out[pool] = {
+                "final": int(gauges.get(name, 0)),
+                "peak": int(gauges.get(f"{name}_peak", 0)),
+            }
+    return {"queue_depths": out or None}
+
+
 def _export_trace(collector, path: str) -> None:
     """Write the Perfetto export and print the one-line trace summary —
     flagging an INCOMPLETE trace (ring wrapped, spans dropped) so
@@ -318,7 +342,70 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float,
         restarts=restarts,
         ha=ha_fields(sched.metrics),
         **event_fields(sched.metrics),
+        **queue_fields(sched.metrics),
     )
+
+
+def _analytic_ledger(waves: List[Snapshot], mesh) -> Optional[Dict]:
+    """The analytic half of the --profile reconciliation: trace the SAME
+    routed kernel the measured run executes (first wave's shape, the
+    resident incremental state included) and run the costmodel over its
+    jaxpr.  make_jaxpr only traces — no compile — so this is cheap even at
+    bench scale."""
+    import jax
+
+    from ..analysis.costmodel import jaxpr_ledger
+    from ..api.delta import DeltaEncoder
+    from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
+    from ..ops import assign as A
+    from ..ops.incremental import HoistCache
+
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(waves[0])
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = A.inc_applicable(
+        arr, cfg, HoistCache(mesh=mesh).ensure(arr, meta, cfg)
+    )
+    if inc is not None:
+        closed = jax.make_jaxpr(
+            lambda a, i: A.schedule_batch_impl(a, cfg, i))(arr, inc)
+    else:
+        closed = jax.make_jaxpr(
+            lambda a: A.schedule_batch_impl(a, cfg, None))(arr)
+    return jaxpr_ledger(closed)
+
+
+def _profile_block(out: Dict, profile_dir: str, waves: List[Snapshot],
+                   mesh, collector) -> None:
+    """Join the --profile capture into the streaming artifact: the measured
+    sub-phase table (device_subphases + the regression-gated
+    round_loop_fraction), the analytic roofline ledger, their KTPU019-style
+    reconciliation, and the sub-phase spans merged into the host trace as
+    children of device.step (bench/profiling.py)."""
+    from ..analysis.costmodel import reconcile
+    from .profiling import (
+        load_profile_events, merge_profile_spans, parse_hlo_dumps,
+        subphase_table,
+    )
+
+    op_map = parse_hlo_dumps(os.path.join(profile_dir, "hlo"))
+    events = load_profile_events(profile_dir)
+    table = subphase_table(events, op_map)
+    out["device_subphases"] = table
+    out["round_loop_fraction"] = table["round_loop_fraction"]
+    analytic = _analytic_ledger(waves, mesh)
+    out["cost_analytic"] = analytic
+    if analytic is not None:
+        out["subphase_reconciliation"] = reconcile(
+            analytic["round_loop_fraction"], table["round_loop_fraction"]
+        )
+        # the regression-gated modeled-cost pair (`bench.regression --metric
+        # device_flops` / `device_hbm_bytes`), next to round_loop_fraction;
+        # a --verify-device run re-stamps them from the 12-route ledgers
+        out["device_flops"] = analytic["total_flops"]
+        out["device_hbm_bytes"] = analytic["total_hbm_bytes"]
+    if collector is not None:
+        merge_profile_spans(collector, events, op_map)
 
 
 def run_streaming_workload(
@@ -328,6 +415,7 @@ def run_streaming_workload(
     pipeline: bool = True,
     donate: Optional[bool] = None,
     collector=None,
+    profile_dir: Optional[str] = None,
 ) -> Dict:
     """Measure the pipelined batch loop (parallel/pipeline.py —
     PipelinedBatchLoop) against the serial encode→run→block loop on a
@@ -354,17 +442,29 @@ def run_streaming_workload(
     if warmup:  # hit the XLA cache so the timed runs measure steady state
         for _ in PipelinedBatchLoop(donate=donate, mesh=mesh).run(waves[:1]):
             pass
+    import contextlib
+
     tracer = Tracer(collector, component="pipeline") if collector else None
+
+    def _maybe_profile(measured: bool):
+        # the MEASURED pass runs inside the jax.profiler device trace when
+        # --profile asked for one (scheduler/tracing.py — device_trace);
+        # the warmup/serial-reference passes never profile
+        if profile_dir and measured:
+            return device_trace(profile_dir)
+        return contextlib.nullcontext()
+
     t0 = time.perf_counter()
     # --no-pipeline runs have no later pipelined pass, so the serial loop
     # itself is the traced+metered run (attribution + SLI still emit);
     # when pipelining, the serial pass stays untraced/unmetered — its
     # spans and SLI samples would pollute the pipelined run's report
-    serial = list(run_serial(
-        waves, donate=donate, mesh=mesh,
-        tracer=None if pipeline else tracer,
-        metrics=None if pipeline else metrics,
-    ))
+    with _maybe_profile(not pipeline):
+        serial = list(run_serial(
+            waves, donate=donate, mesh=mesh,
+            tracer=None if pipeline else tracer,
+            metrics=None if pipeline else metrics,
+        ))
     t_serial = time.perf_counter() - t0
     out = {
         "name": name,
@@ -383,15 +483,19 @@ def run_streaming_workload(
             **sli_fields(metrics),
             **event_fields(metrics),
         )
+        if profile_dir:
+            _profile_block(out, profile_dir, waves, mesh, collector)
         if collector is not None:
             from ..scheduler.attribution import attribute_spans
 
-            out["attribution"] = attribute_spans(collector)
+            out["attribution"] = attribute_spans(
+                collector, device_subphases=out.get("device_subphases"))
         return out
     runner = PipelinedBatchLoop(donate=donate, tracer=tracer, mesh=mesh,
                                 metrics=metrics)
     t0 = time.perf_counter()
-    pipelined = list(runner.run(waves))
+    with _maybe_profile(True):
+        pipelined = list(runner.run(waves))
     t_pipe = time.perf_counter() - t0
     assert pipelined == serial, "pipelined verdicts diverged from serial"
     out.update(
@@ -407,12 +511,17 @@ def run_streaming_workload(
         # incremental warm-cycle attribution (ops/incremental.py)
         **runner.hoist.summary(),
     )
+    if profile_dir:
+        _profile_block(out, profile_dir, waves, mesh, collector)
     if collector is not None:
         # cycle attribution from the captured spans, embedded next to
-        # route_trace_counts (scheduler/attribution.py)
+        # route_trace_counts (scheduler/attribution.py); with a --profile
+        # capture the kernel-interior sub-phase table nests below
+        # device_kernel in the same report
         from ..scheduler.attribution import attribute_spans
 
-        out["attribution"] = attribute_spans(collector)
+        out["attribution"] = attribute_spans(
+            collector, device_subphases=out.get("device_subphases"))
     return out
 
 
@@ -621,6 +730,23 @@ def main(argv=None) -> None:
         from ..analysis.devicecheck import ensure_devices
 
         ensure_devices()
+    # --profile DIR: arm the XLA HLO text dump NOW — XLA parses the dump
+    # flags once per process, and the op->named-scope join needs the dump
+    # of every kernel this process compiles (bench/profiling.py)
+    if "--profile" in _early_argv:
+        try:
+            _pdir = _early_argv[_early_argv.index("--profile") + 1]
+        except IndexError:
+            _pdir = ""
+        if _pdir and not _pdir.startswith("-"):
+            from .profiling import enable_hlo_dump
+
+            enable_hlo_dump(os.path.join(_pdir, "hlo"))
+        # the observatory's target is the production round loop: route the
+        # chunked kernels even on the CPU sim (the device pass's _pass_env
+        # and every BENCH soak run force the same routing); an explicit
+        # operator setting still wins
+        os.environ.setdefault("KTPU_FORCE_CHUNKED", "1")
     force_cpu_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", help="workload YAML file")
@@ -649,6 +775,21 @@ def main(argv=None) -> None:
     ap.add_argument("--trace-device", metavar="DIR",
                     help="with --trace: also capture a jax.profiler device "
                          "trace per round under DIR (TensorBoard format)")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="with --stream: the device cost observatory — "
+                         "capture the measured pass's jax.profiler device "
+                         "trace under DIR plus an XLA HLO dump (DIR/hlo), "
+                         "map every compiled op back to its owning "
+                         "named-scope sub-phase (ops/scopes.py), and emit "
+                         "the kernel-interior sub-phase self-time table "
+                         "(device_subphases + the regression-gated "
+                         "round_loop_fraction) with the analytic roofline "
+                         "reconciliation (analysis/costmodel.py) in the "
+                         "artifact; sub-phase spans join the --trace "
+                         "Perfetto export as children of device.step.  "
+                         "Needs a fresh process (XLA parses dump flags "
+                         "once); exits 1 on a failed capture or "
+                         "reconciliation")
     ap.add_argument("--chaos", type=int, metavar="SEED",
                     help="arm the fault injector with FaultPlan.from_seed "
                          "(also via KTPU_CHAOS_SEED / KTPU_FAULT_PLAN): the "
@@ -694,6 +835,16 @@ def main(argv=None) -> None:
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
                  "with the host-span trace)")
+    if args.profile and not args.stream:
+        ap.error("--profile pairs with --stream (the warm pipelined loop is "
+                 "what the sub-phase table attributes; snapshot rounds keep "
+                 "--trace-device for raw captures)")
+    if args.profile and (args.compile_cache
+                         or os.environ.get("KTPU_COMPILE_CACHE_DIR")):
+        ap.error("--profile cannot combine with --compile-cache / "
+                 "KTPU_COMPILE_CACHE_DIR: a compile-cache hit compiles "
+                 "nothing, so the HLO dump (the op -> sub-phase join "
+                 "source) would be empty")
     # --verify: the hack/verify-* analog gates the bench run itself — a
     # perf artifact produced by a package that fails its own invariants
     # is not evidence.  The report rides the artifact; failure exits with
@@ -820,23 +971,67 @@ def main(argv=None) -> None:
             ]
             if comm:
                 doc["comm_bytes"] = max(comm)
+            # worst per-route analytic FLOPs / HBM bytes from the cost
+            # ledgers (analysis/costmodel.py), stamped top-level so
+            # `bench.regression --metric device_flops` / `device_hbm_bytes`
+            # gates the kernel's modeled cost exactly like comm_bytes
+            costs = [r.get("cost") or {} for r in routes]
+            flops = [c.get("total_flops", 0) for c in costs if c]
+            hbm = [c.get("total_hbm_bytes", 0) for c in costs if c]
+            if flops:
+                doc["device_flops"] = max(flops)
+            if hbm:
+                doc["device_hbm_bytes"] = max(hbm)
         from ..analysis import lockcheck
 
         if lockcheck.enabled():
             doc["lock_check"] = lockcheck.report()
 
     if args.stream:
+        # KTPU_STREAM_SHAPE=PODSxNODES resizes the per-wave workload (the
+        # default is the BENCH stream shape; CI's --profile smoke and the
+        # profile-capture test shrink it to stay inside their budgets)
+        shape = os.environ.get("KTPU_STREAM_SHAPE", "5000x2000")
+        try:
+            s_pods, s_nodes = (int(x) for x in shape.lower().split("x"))
+        except ValueError:
+            ap.error(f"KTPU_STREAM_SHAPE={shape!r}: expected PODSxNODES "
+                     "(e.g. 5000x2000)")
         waves = [
-            workloads.heterogeneous(2000, 5000, seed=s) for s in range(args.stream)
+            workloads.heterogeneous(s_nodes, s_pods, seed=s)
+            for s in range(args.stream)
         ]
         collector = (
             TraceCollector() if (args.trace or args.attribution) else None
         )
         out = run_streaming_workload(
-            f"stream-{args.stream}x5000", waves,
+            f"stream-{args.stream}x{s_pods}", waves,
             pipeline=not args.no_pipeline,
             collector=collector,
+            profile_dir=args.profile,
         )
+        profile_failed = None
+        if args.profile:
+            from .profiling import render_subphases
+
+            tbl = out.get("device_subphases") or {}
+            if tbl.get("incomplete", True):
+                profile_failed = (
+                    "no annotated kernel ops captured — stale process "
+                    "(XLA dump flags parse once) or the run never hit a "
+                    "placement kernel"
+                )
+            else:
+                print("device sub-phase self-time (within device_kernel):",
+                      file=sys.stderr)
+                print(render_subphases(tbl), file=sys.stderr)
+                rec = out.get("subphase_reconciliation") or {}
+                if rec and not rec.get("ok"):
+                    profile_failed = (
+                        f"analytic round-loop share {rec['analytic']} vs "
+                        f"measured {rec['measured']} diverge "
+                        f"{rec['ratio']}x (> {rec['tolerance']}x)"
+                    )
         if args.attribution and "attribution" in out:
             from ..scheduler.attribution import render_attribution
 
@@ -851,6 +1046,9 @@ def main(argv=None) -> None:
         print(blob)
         if args.out:  # same artifact contract as the snapshot rounds
             open(args.out, "w").write(blob + "\n")
+        if profile_failed:  # artifact written first — it IS the evidence
+            print(f"profile: FAIL — {profile_failed}", file=sys.stderr)
+            sys.exit(1)
         return
     if args.config:
         text = open(args.config).read()

@@ -1,0 +1,290 @@
+"""Measured kernel-interior sub-phase attribution — the profiler half of
+the device cost observatory (`bench.harness --profile`).
+
+PR 6's attribution engine proves `device_kernel` owns the warm cycle, then
+goes blind below the jit boundary.  This module looks inside: the kernels
+are annotated with `jax.named_scope` sub-phases (ops/scopes.py), and three
+artifacts join into a measured per-sub-phase self-time table:
+
+  1. the jax.profiler device trace the harness already knows how to start
+     (scheduler/tracing.py — device_trace) writes a Perfetto-loadable
+     `*.trace.json.gz` whose per-op events carry `args.hlo_op` +
+     `args.hlo_module` — WHICH compiled op ran for how long;
+  2. an XLA HLO text dump (`--xla_dump_to`, armed by enable_hlo_dump
+     BEFORE the run's first compilation) carries each op's
+     `metadata={op_name="jit(...)/.../<scope>/..."}` — which NAMED SCOPE
+     owns it (named scopes survive lowering as op_name components, fusions
+     inherit their root op's metadata);
+  3. ops.scopes.subphase_of maps the op_name path to its owning sub-phase
+     (innermost declared scope) — the same function the analytic ledger
+     (analysis/costmodel.py) applies to jaxpr name stacks, so an op can
+     never be owned by two different sub-phases across the two halves.
+
+The table follows the attribution engine's contract one level down: every
+profiled device op is owned by exactly one sub-phase (`unowned` catches
+ops outside every declared scope), fractions sum to 1.0 within
+device_kernel, and `round_loop_fraction` is the rollup over every op whose
+scope path passes through the round loop — ROADMAP-1's target as one
+regression-gated number.  Only modules containing at least one declared
+scope count as device-kernel work (encode helpers, tiny convert jits and
+host glue never dilute the table).
+
+Fractions are SELF-TIME shares over total device-op time, not wall shares:
+on backends with intra-op parallelism op durations may overlap, and a
+share-of-op-time table stays exact where a wall sweep would double-count.
+
+Caveat: XLA parses dump flags once per process, so --profile needs the
+dump armed before the first compilation — a warm process that already
+compiled the kernels yields an empty op map, which the table reports as
+`incomplete` instead of silently attributing nothing.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..ops.scopes import subphase_of
+
+# instruction lines of an HLO text dump:  [ROOT ]<name> = ...op_name="..."
+_HLO_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s.*op_name=\"([^\"]+)\"")
+_HLO_MODULE_RE = re.compile(r"^HloModule\s+([\w.\-]+)")
+# CONTAINER instructions: their profiler events span the whole loop/branch
+# execution, so counting them would double-charge every interior op — and
+# they carry no op_name metadata after optimization.  The table charges
+# LEAVES only, exactly as the analytic walk (costmodel._leaf_costs) does.
+_HLO_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=")
+_HLO_CONTAINER_RE = re.compile(r"\s(?:while|conditional|call)\(")
+
+
+def enable_hlo_dump(dump_dir: str) -> None:
+    """Arm the per-compilation HLO text dump (the op -> named-scope join
+    source).  XLA reads the dump flags from XLA_FLAGS at its first parse,
+    so this must run before the process compiles anything — bench.harness
+    calls it at --profile argument handling, before any workload."""
+    os.makedirs(dump_dir, exist_ok=True)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_dump_to" in flags:
+        return  # an operator-armed dump wins; never stack two
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
+    ).strip()
+
+
+def parse_hlo_dumps(dump_dir: str) -> Dict[str, Dict[str, Optional[str]]]:
+    """{hlo_module: {instruction name: op_name scope path}} from every
+    `*after_optimizations.txt` dump — the optimized HLO, whose instruction
+    names are exactly what the profiler's `args.hlo_op` reports.  Container
+    instructions (while / conditional / call) map to None — their events
+    are whole-loop envelopes the table must skip, not leaves to charge."""
+    out: Dict[str, Dict[str, Optional[str]]] = {}
+    for path in sorted(glob.glob(os.path.join(dump_dir, "*.txt"))):
+        base = os.path.basename(path)
+        if "after_optimizations" not in base or "-" in base.rsplit(
+                "after_optimizations", 1)[1]:
+            continue  # buffer-assignment / memory-usage side files
+        module = None
+        ops: Dict[str, Optional[str]] = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    if module is None:
+                        m = _HLO_MODULE_RE.match(line)
+                        if m:
+                            module = m.group(1)
+                        continue
+                    m = _HLO_OP_RE.match(line)
+                    if m:
+                        ops[m.group(1)] = m.group(2)
+                        continue
+                    if _HLO_CONTAINER_RE.search(line):
+                        m = _HLO_NAME_RE.match(line)
+                        if m:
+                            ops[m.group(1)] = None  # container envelope
+        except OSError:
+            continue
+        if module and ops:
+            # later dumps of a re-compiled module win (same name, fresh ops)
+            out.setdefault(module, {}).update(ops)
+    return out
+
+
+def load_profile_events(profile_dir: str) -> List[Dict[str, Any]]:
+    """Per-op device events [{module, op, ts_us, dur_us}] from the NEWEST
+    jax.profiler session under `profile_dir` (start_trace stamps one
+    timestamped subdir per capture)."""
+    traces = sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime,
+    )
+    if not traces:
+        return []
+    try:
+        doc = json.loads(gzip.open(traces[-1]).read())
+    except (OSError, json.JSONDecodeError, EOFError):
+        return []
+    events: List[Dict[str, Any]] = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        op = args.get("hlo_op")
+        if not op:
+            continue
+        events.append({
+            "module": args.get("hlo_module", ""),
+            "op": op,
+            "ts_us": float(e.get("ts", 0.0)),
+            "dur_us": float(e.get("dur", 0.0)),
+        })
+    return events
+
+
+def _kernel_modules(op_map: Dict[str, Dict[str, Optional[str]]]) -> set:
+    """The ANNOTATED modules — those whose op map carries at least one
+    declared scope (the placement kernels).  One definition shared by the
+    self-time table and the Perfetto span merge, so the two views can never
+    scope to different module sets."""
+    return {
+        m for m, ops in op_map.items()
+        if any(subphase_of(p) for p in ops.values() if p)
+    }
+
+
+def subphase_table(events: List[Dict[str, Any]],
+                   op_map: Dict[str, Dict[str, str]]) -> Dict[str, Any]:
+    """The measured sub-phase self-time table.
+
+    Scoped to ANNOTATED modules (those whose op map contains at least one
+    declared scope — the placement kernels); within them every op is owned
+    by exactly one sub-phase via its op_name path (`unowned` for ops
+    outside all scopes), so fractions sum to 1.0 within device_kernel by
+    construction.  `round_loop_fraction` is the rollup over ops whose path
+    passes through the round loop; `dominant` compares that rollup against
+    the phases outside the loop (costmodel.dominant_phase — the shared
+    definition)."""
+    from ..analysis.costmodel import dominant_phase, in_round_loop
+
+    kernel_modules = _kernel_modules(op_map)
+    self_us: Dict[str, float] = {}
+    rollup_us = 0.0
+    total_us = 0.0
+    n_ops = 0
+    for e in events:
+        mod = e["module"]
+        if mod not in kernel_modules:
+            continue
+        path = op_map[mod].get(e["op"], "")
+        if path is None:  # container envelope (while/cond): leaves only
+            continue
+        phase = subphase_of(path) or "unowned"
+        self_us[phase] = self_us.get(phase, 0.0) + e["dur_us"]
+        if in_round_loop(path):
+            rollup_us += e["dur_us"]
+        total_us += e["dur_us"]
+        n_ops += 1
+    fractions = {
+        p: (us / total_us if total_us else 0.0) for p, us in self_us.items()
+    }
+    rl = rollup_us / total_us if total_us else 0.0
+    return {
+        "subphases": {
+            p: {"seconds": round(us / 1e6, 6),
+                "fraction": round(fractions[p], 4)}
+            for p, us in sorted(self_us.items(), key=lambda kv: -kv[1])
+        },
+        "round_loop_fraction": round(rl, 4),
+        "dominant": dominant_phase(fractions, rl),
+        "n_ops": n_ops,
+        "kernel_modules": sorted(kernel_modules),
+        "total_s": round(total_us / 1e6, 6),
+        # empty = the capture failed (no annotated module profiled — warm
+        # process without the dump, or a run that never hit a kernel);
+        # consumers flag it instead of reporting a vacuous clean table
+        "incomplete": n_ops == 0,
+    }
+
+
+def render_subphases(table: Dict[str, Any], indent: str = "") -> str:
+    """Human rows for one measured table (nested under device_kernel by
+    the attribution renderer)."""
+    lines = []
+    rl = table.get("round_loop_fraction", 0.0)
+    dom = table.get("dominant")
+    for p, d in table.get("subphases", {}).items():
+        # a dominant round_loop marks the ROLLUP row below, not the self
+        # row (the loop's own plumbing is near-zero; its interior phases
+        # carry the time)
+        mark = "  <- dominant" if p == dom and p != "round_loop" else ""
+        lines.append(
+            f"{indent}{p:<16} {d['seconds']:>10.4f} {d['fraction']:>9.1%}"
+            f"{mark}"
+        )
+    lines.append(
+        f"{indent}{'round_loop(all)':<16} {'':>10} {rl:>9.1%}"
+        + ("  <- dominant" if dom == "round_loop" else "")
+    )
+    return "\n".join(lines)
+
+
+def merge_profile_spans(collector, events: List[Dict[str, Any]],
+                        op_map: Dict[str, Dict[str, str]],
+                        max_spans: int = 4096) -> int:
+    """Merge the profiled sub-phase ops into the host span trace as
+    children of `device.step` / `batch.kernel` spans, so one Perfetto
+    export answers both "which phase" and "which kernel region".
+
+    The profiler and the host collector run on different clocks; the merge
+    rebases by aligning the FIRST annotated device op to the start of the
+    first device-kernel span (an offset, not a scale — both sides are
+    monotonic microsecond clocks).  Each synthesized span parents under
+    the device-kernel span whose window contains its midpoint (the first
+    one otherwise).  Caps at `max_spans` spans, largest first — a Perfetto
+    export should not grow by a million one-microsecond ops."""
+    from ..scheduler.tracing import Span
+
+    kernel_modules = _kernel_modules(op_map)
+    evs = [
+        e for e in events
+        if e["module"] in kernel_modules
+        and op_map[e["module"]].get(e["op"], "") is not None
+    ]
+    if not evs or collector is None:
+        return 0
+    anchors = sorted(
+        (s for s in collector.spans()
+         if s.name in ("device.step", "batch.kernel") and s.end is not None),
+        key=lambda s: s.start,
+    )
+    if not anchors:
+        return 0
+    t0_prof = min(e["ts_us"] for e in evs) / 1e6
+    offset = anchors[0].start - t0_prof
+    evs.sort(key=lambda e: -e["dur_us"])
+    n = 0
+    for e in evs[:max_spans]:
+        start = e["ts_us"] / 1e6 + offset
+        end = start + e["dur_us"] / 1e6
+        mid = (start + end) / 2
+        parent = next(
+            (a for a in anchors if a.start <= mid <= a.end), anchors[0]
+        )
+        path = op_map[e["module"]].get(e["op"], "")
+        phase = subphase_of(path) or "unowned"
+        sp = Span(
+            f"device.{phase}", component="device",
+            trace_id=parent.trace_id, parent_id=parent.span_id,
+            start=start,
+            attributes={"hlo_op": e["op"], "hlo_module": e["module"],
+                        "op_name": path},
+        )
+        sp.finish(end)
+        collector.add(sp)
+        n += 1
+    return n

@@ -22,7 +22,14 @@ BENCH_r01–r06 trajectory tracks); --metric value --higher-is-better gates
 on throughput instead, and --metric comm_bytes gates the per-route
 collective-traffic budget the shard pass measures (the harness stamps the
 worst mesh route's measured bytes top-level under --verify-shard, so an
-accidental extra all-gather regression-gates alongside step time).
+accidental extra all-gather regression-gates alongside step time).  The
+device cost observatory stamps three more gated scalars the same way:
+--metric round_loop_fraction (the measured share of kernel time inside
+the prefix-commit round loop, `bench.harness --profile` — ROADMAP-1's
+burn-down number), and --metric device_flops / device_hbm_bytes (the
+analytic ledger's modeled kernel cost, analysis/costmodel.py — a kernel
+that silently grew its FLOP or byte footprint regression-gates even
+before it slows a wall clock).
 Dotted metric names traverse nested blocks (e.g. verify.n_unbaselined).
 Prior runs missing the metric or on another box are skipped with a note
 (the r01/r02 real-TPU artifacts predate step_s), never failed on — only

@@ -41,6 +41,7 @@ from jax import lax
 
 from ..api.snapshot import ClusterArrays
 from . import filters, pairwise
+from .scopes import subphase as _subphase
 from .scores import (
     MAX_NODE_SCORE,
     ScoreConfig,
@@ -151,17 +152,18 @@ def schedule_scan(
         base = jnp.int32(0)
     my_nodes = base + jnp.arange(local_n, dtype=jnp.int32)
 
-    tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)  # [S, Nl]
-    nodesel = filters.node_selection_ok_from(tm, arr)  # [P, Nl]
-    pin = arr.pod_nodename[:, None]
-    nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-    sf = (
-        arr.node_valid[None, :]
-        & arr.pod_valid[:, None]
-        & filters.taints_ok(arr)
-        & nodesel
-        & nodename_ok
-    )
+    with _subphase("hoist"):
+        tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)  # [S, Nl]
+        nodesel = filters.node_selection_ok_from(tm, arr)  # [P, Nl]
+        pin = arr.pod_nodename[:, None]
+        nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+        sf = (
+            arr.node_valid[None, :]
+            & arr.pod_valid[:, None]
+            & filters.taints_ok(arr)
+            & nodesel
+            & nodename_ok
+        )
     n_alloc = arr.node_alloc
     # static per-term node->domain map + key presence, hoisted out of the scan
     # (ops/pairwise.py module docstring: per-node state layout).  D is a
@@ -175,9 +177,11 @@ def schedule_scan(
     # change argmax, so pruning is decision-preserving.
     xs = {"req": arr.pod_req, "sf": sf, "valid": arr.pod_valid}
     if cfg.enable_taint_score:
-        xs["pref"] = taint_prefer_counts(arr)  # [P, Nl]
+        with _subphase("hoist"):
+            xs["pref"] = taint_prefer_counts(arr)  # [P, Nl]
     if cfg.enable_node_pref:
-        xs["na"] = _preferred_node_affinity_raw(arr, tm)  # [P, Nl]
+        with _subphase("hoist"):
+            xs["na"] = _preferred_node_affinity_raw(arr, tm)  # [P, Nl]
     if cfg.enable_pairwise:
         xs.update(
             nodesel=nodesel,
@@ -199,66 +203,91 @@ def schedule_scan(
         xs["img"] = arr.image_score
 
     def norm_reverse(counts, feasible):
-        mx = _rmax(jnp.where(feasible, counts, 0.0), axis_name)
-        return jnp.where(mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / mx, MAX_NODE_SCORE)
+        with _subphase("normalize"):
+            mx = _rmax(jnp.where(feasible, counts, 0.0), axis_name)
+            return jnp.where(
+                mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / mx,
+                MAX_NODE_SCORE,
+            )
 
     def step(state, xs):
         used, cnt_node, anti_node, pref_node, total_t, ports_used = state
         req, feas_row, valid = xs["req"], xs["sf"], xs["valid"]
 
-        feasible = feas_row & filters.fit_ok(req, used, n_alloc)
-        if cfg.enable_ports:
-            feasible &= pairwise.ports_ok(ports_used, xs["ports"])
-        if cfg.enable_pairwise:
-            spread_ok, spread_raw = pairwise.spread_step(
-                cnt_node, has_key_all, xs["spread_t"], xs["spread_skew"],
-                xs["spread_hard"], xs["nodesel"] & arr.node_valid, axis_name,
+        with _subphase("score"):
+            feasible = feas_row & filters.fit_ok(req, used, n_alloc)
+            if cfg.enable_ports:
+                feasible &= pairwise.ports_ok(ports_used, xs["ports"])
+            if cfg.enable_pairwise:
+                spread_ok, spread_raw = pairwise.spread_step(
+                    cnt_node, has_key_all, xs["spread_t"], xs["spread_skew"],
+                    xs["spread_hard"], xs["nodesel"] & arr.node_valid,
+                    axis_name,
+                )
+                feasible &= spread_ok & pairwise.interpod_required_ok(
+                    cnt_node, anti_node, total_t, has_key_all, xs["aff"],
+                    xs["anti"], xs["mt"], xs["mv"], xs["aself"],
+                )
+            requested = used + req[None, :]
+            # score accumulation order mirrors the oracle exactly (float32
+            # parity): fit(strategy), balanced, taint, nodeAffinity, spread
+            total = cfg.fit_weight * fit_score(
+                requested, n_alloc, cfg
+            ) + cfg.balanced_weight * balanced_allocation(
+                requested, n_alloc, cfg.score_resources
             )
-            feasible &= spread_ok & pairwise.interpod_required_ok(
-                cnt_node, anti_node, total_t, has_key_all, xs["aff"], xs["anti"],
-                xs["mt"], xs["mv"], xs["aself"],
+            if cfg.enable_taint_score:
+                total = total + cfg.taint_weight * norm_reverse(
+                    xs["pref"], feasible
+                )
+            if cfg.enable_node_pref:
+                with _subphase("normalize"):
+                    # NodeAffinity preferred: DefaultNormalizeScore (not
+                    # reversed)
+                    na_row = xs["na"]
+                    na_max = _rmax(jnp.where(feasible, na_row, 0.0), axis_name)
+                    total = total + cfg.node_affinity_weight * jnp.where(
+                        na_max > 0, na_row * MAX_NODE_SCORE / na_max, 0.0
+                    )
+            if cfg.enable_pairwise:
+                total = total + cfg.spread_weight * norm_reverse(
+                    spread_raw, feasible
+                )
+            if cfg.enable_pairwise and cfg.enable_interpod_score:
+                # preferred inter-pod affinity: min/max normalization over
+                # feasible (interpodaffinity/scoring.go — NormalizeScore)
+                ip_raw = pairwise.interpod_pref_raw(
+                    cnt_node, pref_node, has_key_all, xs["pref_t"],
+                    xs["pref_w"], xs["mt"], xs["mv"],
+                )
+                with _subphase("normalize"):
+                    mx = _rmax(jnp.where(feasible, ip_raw, -jnp.inf), axis_name)
+                    mn = -_rmax(
+                        jnp.where(feasible, -ip_raw, -jnp.inf), axis_name
+                    )
+                    ip_sc = jnp.where(
+                        mx > mn, MAX_NODE_SCORE * (ip_raw - mn) / (mx - mn), 0.0
+                    )
+                total = total + cfg.interpod_weight * ip_sc
+            if "img" in xs:  # ImageLocality: static, no per-pod normalization
+                total = total + cfg.image_weight * xs["img"]
+            total = jnp.where(feasible, total, -jnp.inf)
+            best = _rmax(total, axis_name)
+            schedulable = (best > -jnp.inf) & valid
+            # lowest global index attaining the max
+            cand = jnp.where((total == best) & feasible, my_nodes, _INT_MAX)
+            choice = jnp.where(
+                schedulable, _rmin(cand, axis_name).astype(jnp.int32), -1
             )
-        requested = used + req[None, :]
-        # score accumulation order mirrors the oracle exactly (float32 parity):
-        # fit(strategy), balanced, taint, nodeAffinity, spread
-        total = cfg.fit_weight * fit_score(
-            requested, n_alloc, cfg
-        ) + cfg.balanced_weight * balanced_allocation(
-            requested, n_alloc, cfg.score_resources
-        )
-        if cfg.enable_taint_score:
-            total = total + cfg.taint_weight * norm_reverse(xs["pref"], feasible)
-        if cfg.enable_node_pref:
-            # NodeAffinity preferred: DefaultNormalizeScore (not reversed)
-            na_row = xs["na"]
-            na_max = _rmax(jnp.where(feasible, na_row, 0.0), axis_name)
-            total = total + cfg.node_affinity_weight * jnp.where(
-                na_max > 0, na_row * MAX_NODE_SCORE / na_max, 0.0
-            )
-        if cfg.enable_pairwise:
-            total = total + cfg.spread_weight * norm_reverse(spread_raw, feasible)
-        if cfg.enable_pairwise and cfg.enable_interpod_score:
-            # preferred inter-pod affinity: min/max normalization over feasible
-            # (interpodaffinity/scoring.go — NormalizeScore)
-            ip_raw = pairwise.interpod_pref_raw(
-                cnt_node, pref_node, has_key_all, xs["pref_t"], xs["pref_w"],
-                xs["mt"], xs["mv"],
-            )
-            mx = _rmax(jnp.where(feasible, ip_raw, -jnp.inf), axis_name)
-            mn = -_rmax(jnp.where(feasible, -ip_raw, -jnp.inf), axis_name)
-            ip_sc = jnp.where(
-                mx > mn, MAX_NODE_SCORE * (ip_raw - mn) / (mx - mn), 0.0
-            )
-            total = total + cfg.interpod_weight * ip_sc
-        if "img" in xs:  # ImageLocality: static, no per-pod normalization
-            total = total + cfg.image_weight * xs["img"]
-        total = jnp.where(feasible, total, -jnp.inf)
-        best = _rmax(total, axis_name)
-        schedulable = (best > -jnp.inf) & valid
-        # lowest global index attaining the max
-        cand = jnp.where((total == best) & feasible, my_nodes, _INT_MAX)
-        choice = jnp.where(schedulable, _rmin(cand, axis_name).astype(jnp.int32), -1)
 
+        with _subphase("commit"):
+            return _step_commit(
+                xs, used, cnt_node, anti_node, pref_node, total_t,
+                ports_used, choice, req,
+            )
+
+    def _step_commit(xs, used, cnt_node, anti_node, pref_node, total_t,
+                     ports_used, choice, req):
         placed = (my_nodes == choice)[:, None]
         used = used + placed.astype(used.dtype) * req[None, :]
         if cfg.enable_pairwise:
@@ -298,10 +327,11 @@ def schedule_scan(
 
     # initial per-node state: ONE hoisted [T, N] gather each (cheap outside
     # the scan), bit-identical to reading the [T, D+1] tables per step
-    cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
-    anti_node0 = jnp.take_along_axis(arr.anti_counts0, dom_by_term, axis=1)
-    pref_node0 = jnp.take_along_axis(arr.pref_own0, dom_by_term, axis=1)
-    total_t0 = arr.term_counts0[:, :D].sum(axis=1)
+    with _subphase("hoist"):
+        cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
+        anti_node0 = jnp.take_along_axis(arr.anti_counts0, dom_by_term, axis=1)
+        pref_node0 = jnp.take_along_axis(arr.pref_own0, dom_by_term, axis=1)
+        total_t0 = arr.term_counts0[:, :D].sum(axis=1)
     state0 = (
         arr.node_used, cnt_node0, anti_node0, pref_node0, total_t0,
         arr.node_ports0,
@@ -535,32 +565,37 @@ def schedule_scan_chunked(
         # per-chunk dense hoist below never trace
         U1 = inc.req_u.shape[0]
         req_u = inc.req_u
-        t0u_init = jnp.where(inc.stat_u & inc.fit_u, inc.base_u, neg_inf)
-        if axis_name:
-            # stitch the shard-local class hoists once per cycle; the chunk
-            # scan then carries the full [U1, N] matrix replicated (the
-            # non-inc path gathers [C, N] per chunk — this is strictly less
-            # collective traffic whenever U1 < C * n_chunks)
-            t0u_init = lax.all_gather(t0u_init, axis_name, axis=1, tiled=True)
-            stat_full = lax.all_gather(
-                inc.stat_u, axis_name, axis=1, tiled=True
-            )
-        else:
-            stat_full = inc.stat_u
+        with _subphase("hoist"):
+            t0u_init = jnp.where(inc.stat_u & inc.fit_u, inc.base_u, neg_inf)
+            if axis_name:
+                # stitch the shard-local class hoists once per cycle; the
+                # chunk scan then carries the full [U1, N] matrix replicated
+                # (the non-inc path gathers [C, N] per chunk — this is
+                # strictly less collective traffic whenever U1 < C *
+                # n_chunks)
+                t0u_init = lax.all_gather(
+                    t0u_init, axis_name, axis=1, tiled=True
+                )
+                stat_full = lax.all_gather(
+                    inc.stat_u, axis_name, axis=1, tiled=True
+                )
+            else:
+                stat_full = inc.stat_u
         clss = inc.cls.reshape(P // C, C)
         sfs = None
     else:
-        tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
-        nodesel = filters.node_selection_ok_from(tm, arr)
-        pin = arr.pod_nodename[:, None]
-        nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-        sf = (
-            arr.node_valid[None, :]
-            & arr.pod_valid[:, None]
-            & filters.taints_ok(arr)
-            & nodesel
-            & nodename_ok
-        )
+        with _subphase("hoist"):
+            tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
+            nodesel = filters.node_selection_ok_from(tm, arr)
+            pin = arr.pod_nodename[:, None]
+            nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+            sf = (
+                arr.node_valid[None, :]
+                & arr.pod_valid[:, None]
+                & filters.taints_ok(arr)
+                & nodesel
+                & nodename_ok
+            )
         n_alloc = arr.node_alloc  # LOCAL node slice — hoist-side only
         sfs = sf.reshape(P // C, C, local_n)
 
@@ -590,17 +625,18 @@ def schedule_scan_chunked(
             # choice: top-k the [U1, N] class matrix and gather [C, K]
             # lists when that is the smaller problem, else gather the
             # [C, N] rows first (a memory move, no score FLOPs either way)
-            if U1 <= C:
-                tv_u, ti_u = lax.top_k(t0u, K)
-                topv, topi = tv_u[ccls], ti_u[ccls]
-            else:
-                topv, topi = lax.top_k(t0u[ccls], K)
-            # per-pod validity (stat_u deliberately excludes pod_valid so
-            # the resident state survives gang revocations): an invalid
-            # pod's list empties exactly as the dense path's all--inf row
-            # would, and every choice below is additionally cvalid-gated
-            topv = jnp.where(cvalid[:, None], topv, neg_inf)
-            t0u_T = t0u.T  # [N, U1] — contiguous row gathers below
+            with _subphase("score"):
+                if U1 <= C:
+                    tv_u, ti_u = lax.top_k(t0u, K)
+                    topv, topi = tv_u[ccls], ti_u[ccls]
+                else:
+                    topv, topi = lax.top_k(t0u[ccls], K)
+                # per-pod validity (stat_u deliberately excludes pod_valid so
+                # the resident state survives gang revocations): an invalid
+                # pod's list empties exactly as the dense path's all--inf row
+                # would, and every choice below is additionally cvalid-gated
+                topv = jnp.where(cvalid[:, None], topv, neg_inf)
+                t0u_T = t0u.T  # [N, U1] — contiguous row gathers below
 
             def stat_at(node_ids):
                 # hoisted-entry feasibility at candidate columns, per pod:
@@ -619,24 +655,30 @@ def schedule_scan_chunked(
             # ops batched, so float32 results are bit-identical to the plain
             # scan); shard-local: [C, Nl, R] intermediates, this kernel's
             # biggest block
-            requested = used0_l[None, :, :] + creq[:, None, :]  # [C, Nl, R]
-            fit0 = jax.vmap(filters.fit_ok, (0, None, None))(
-                creq, used0_l, n_alloc
-            )
-            total0 = cfg.fit_weight * jax.vmap(
-                lambda rq, al: fit_score(rq, al, cfg), (0, None)
-            )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
-                balanced_allocation, (0, None, None)
-            )(requested, n_alloc, res)
-            total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, Nl]
-            if axis_name:
-                # stitch the shard-local hoists into the full masked score
-                # matrix; from here the round loop is replicated verbatim
-                total0 = lax.all_gather(total0, axis_name, axis=1, tiled=True)
-            topv, topi = lax.top_k(total0, K)  # [C, K] each
-            # row-major transpose: [C, D] static-feasibility lookups below
-            # become contiguous row gathers instead of strided column gathers
-            total0_T = total0.T  # [N, C]
+            with _subphase("hoist"):
+                requested = used0_l[None, :, :] + creq[:, None, :]  # [C,Nl,R]
+                fit0 = jax.vmap(filters.fit_ok, (0, None, None))(
+                    creq, used0_l, n_alloc
+                )
+                total0 = cfg.fit_weight * jax.vmap(
+                    lambda rq, al: fit_score(rq, al, cfg), (0, None)
+                )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
+                    balanced_allocation, (0, None, None)
+                )(requested, n_alloc, res)
+                total0 = jnp.where(csf & fit0, total0, neg_inf)  # [C, Nl]
+                if axis_name:
+                    # stitch the shard-local hoists into the full masked
+                    # score matrix; from here the round loop is replicated
+                    # verbatim
+                    total0 = lax.all_gather(
+                        total0, axis_name, axis=1, tiled=True
+                    )
+            with _subphase("score"):
+                topv, topi = lax.top_k(total0, K)  # [C, K] each
+                # row-major transpose: [C, D] static-feasibility lookups
+                # below become contiguous row gathers instead of strided
+                # column gathers
+                total0_T = total0.T  # [N, C]
 
             def stat_at(node_ids):
                 return total0_T[node_ids].T > neg_inf  # [C, D]
@@ -663,143 +705,146 @@ def schedule_scan_chunked(
             committed, out, ord_, cleank, dlist, dsu, nd, nrounds = st
             unc = ~committed
             # ---- pass 1: speculative choices vs live usage ----
-            dn = jnp.maximum(dlist, 0)
-            dvalid = dlist >= 0
-            dfit, dvals, dstat = rescore(dn, dsu)
-            M2 = jnp.where(dvalid[None] & dstat & dfit, dvals, neg_inf)
-            usablek = cleank & (topv > neg_inf)
-            ukey = jnp.where(usablek, K - jnp.arange(K, dtype=jnp.int32), 0)
-            _, upos = lax.top_k(ukey, Z)  # first Z usable positions
-            uok = jnp.take_along_axis(ukey, upos, 1) > 0  # [C, Z]
-            head = jnp.take_along_axis(topi, upos[:, :1], 1)[:, 0]  # [C]
-            have0 = uok[:, 0]
-            # seed: rank among earlier uncommitted pods with the same head
-            # (same-spec pods share whole lists; they take successive
-            # entries), then advance pointers past cross-group collisions
-            same_head = (
-                (head[:, None] == head[None, :]) & have0[None, :] & unc[None, :]
-            )
-            ptr = jnp.minimum(
-                (same_head & jlt).sum(axis=1).astype(jnp.int32), Z - 1
-            )
-            # jump-to-first-unclaimed iterations: each pod claims its
-            # pointed entry; pods whose entry is claimed by an earlier pod
-            # jump to their first entry claimed by no earlier pod.  The
-            # rank seed already disperses same-head (same-spec) groups, so
-            # a couple of iterations settle cross-group collision chains.
-            nodes_z = jnp.take_along_axis(topi, upos, 1)  # [C, Z]
-            okr = jnp.take_along_axis(uok, ptr[:, None], 1)[:, 0] & unc
-            for _ in range(_SPEC_ITERS):
-                claim = jnp.where(
-                    okr,
-                    jnp.take_along_axis(nodes_z, ptr[:, None], 1)[:, 0],
-                    -1,
+            with _subphase("speculate"):
+                dn = jnp.maximum(dlist, 0)
+                dvalid = dlist >= 0
+                dfit, dvals, dstat = rescore(dn, dsu)
+                M2 = jnp.where(dvalid[None] & dstat & dfit, dvals, neg_inf)
+                usablek = cleank & (topv > neg_inf)
+                ukey = jnp.where(usablek, K - jnp.arange(K, dtype=jnp.int32), 0)
+                _, upos = lax.top_k(ukey, Z)  # first Z usable positions
+                uok = jnp.take_along_axis(ukey, upos, 1) > 0  # [C, Z]
+                head = jnp.take_along_axis(topi, upos[:, :1], 1)[:, 0]  # [C]
+                have0 = uok[:, 0]
+                # seed: rank among earlier uncommitted pods with the same head
+                # (same-spec pods share whole lists; they take successive
+                # entries), then advance pointers past cross-group collisions
+                same_head = (
+                    (head[:, None] == head[None, :]) & have0[None, :] & unc[None, :]
                 )
-                cb = (
-                    (nodes_z[:, :, None] == claim[None, None, :])
-                    & jlt[:, None, :]
-                ).any(axis=2)
-                free = uok & ~cb
-                has = free.any(axis=1)
-                ptr = jnp.where(has, jnp.argmax(free, axis=1), Z - 1)
-                okr = has & unc
-            sel = jnp.take_along_axis(upos, ptr[:, None], 1)[:, 0]
-            vu = jnp.where(
-                okr, jnp.take_along_axis(topv, sel[:, None], 1)[:, 0], neg_inf
-            )
-            iu = jnp.take_along_axis(topi, sel[:, None], 1)[:, 0]
-            best1, cand1 = best_and_cand(
-                M2, jnp.broadcast_to(dn[None], (C, C)), vu, iu
-            )
-            c = jnp.where(
-                (best1 > neg_inf) & unc & cvalid, cand1.astype(jnp.int32), -1
-            )
+                ptr = jnp.minimum(
+                    (same_head & jlt).sum(axis=1).astype(jnp.int32), Z - 1
+                )
+                # jump-to-first-unclaimed iterations: each pod claims its
+                # pointed entry; pods whose entry is claimed by an earlier pod
+                # jump to their first entry claimed by no earlier pod.  The
+                # rank seed already disperses same-head (same-spec) groups, so
+                # a couple of iterations settle cross-group collision chains.
+                nodes_z = jnp.take_along_axis(topi, upos, 1)  # [C, Z]
+                okr = jnp.take_along_axis(uok, ptr[:, None], 1)[:, 0] & unc
+                for _ in range(_SPEC_ITERS):
+                    claim = jnp.where(
+                        okr,
+                        jnp.take_along_axis(nodes_z, ptr[:, None], 1)[:, 0],
+                        -1,
+                    )
+                    cb = (
+                        (nodes_z[:, :, None] == claim[None, None, :])
+                        & jlt[:, None, :]
+                    ).any(axis=2)
+                    free = uok & ~cb
+                    has = free.any(axis=1)
+                    ptr = jnp.where(has, jnp.argmax(free, axis=1), Z - 1)
+                    okr = has & unc
+                sel = jnp.take_along_axis(upos, ptr[:, None], 1)[:, 0]
+                vu = jnp.where(
+                    okr, jnp.take_along_axis(topv, sel[:, None], 1)[:, 0], neg_inf
+                )
+                iu = jnp.take_along_axis(topi, sel[:, None], 1)[:, 0]
+                best1, cand1 = best_and_cand(
+                    M2, jnp.broadcast_to(dn[None], (C, C)), vu, iu
+                )
+                c = jnp.where(
+                    (best1 > neg_inf) & unc & cvalid, cand1.astype(jnp.int32), -1
+                )
             # ---- pass 2: revalidate under intra-round prefix commits ----
-            act = unc & (c >= 0)
-            cn = jnp.maximum(c, 0)
-            # cumulative usage each pod i sees at node c_j from pods k < i
-            # (exclusive int32 prefix sum == the adds the per-pod scan
-            # performs, in the same order — exact; log-depth associative
-            # scan, jnp.cumsum lowers to O(C^2) reduce_window on TPU)
-            E = (c[:, None] == c[None, :]) & act[:, None]  # [C(k), C(j)]
-            T = E[:, :, None] * creq[:, None, :]  # [C, C, R]
-            cum = lax.associative_scan(jnp.add, T, axis=0) - T
-            # round-start usage at c_j: dirty nodes live in dsu, clean nodes
-            # are untouched since chunk start
-            eqd = (c[:, None] == dlist[None, :]) & dvalid[None, :]  # [C, C]
-            hasslot = eqd.any(axis=1)
-            sl = jnp.argmax(eqd, axis=1)
-            cu = jnp.where(hasslot[:, None], dsu[sl], used0[cn])  # [C, R]
-            ca = n_alloc_full[cn]
-            cstat = stat_at(cn)  # [C, C]
-            uij = cu[None] + cum  # [C, C, R]
-            # fit of pod i at node c_j under its intra-round usage uij[i, j]
-            fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
-            reqij = uij + req_b
-            shape = reqij.shape
-            vij = score_flat(
-                reqij.reshape(-1, R),
-                jnp.broadcast_to(ca[None], shape).reshape(-1, R),
-            ).reshape(C, C)
-            Mij = jnp.where(act[None, :] & jlt & cstat & fitij, vij, neg_inf)
-            # dirty nodes picked intra-round before i: superseded by Mij.
-            # prefix-any over j < i as a [C,C]x[C,C] bool matmul (MXU)
-            D2 = (dlist[None, :] == c[:, None]) & act[:, None] & dvalid[None, :]
-            excl2 = (
-                jlt.astype(jnp.float32) @ D2.astype(jnp.float32)
-            ) > 0.0  # [C(i), C(d)]
-            M2x = jnp.where(excl2, neg_inf, M2)
-            # list entries picked intra-round: one [C, K, C] compare, two
-            # masked reductions (also reused for the cleank carry update)
-            cmp = topi[:, :, None] == c[None, None, :]  # [C, K, C(j)]
-            chosen_before = (cmp & (jlt & act[None, :])[:, None, :]).any(2)
-            cleank2 = cleank & ~chosen_before
-            jf2 = jnp.argmax(cleank2, axis=1)
-            vu2 = jnp.where(
-                cleank2.any(axis=1),
-                jnp.take_along_axis(topv, jf2[:, None], 1)[:, 0],
-                neg_inf,
-            )
-            iu2 = jnp.take_along_axis(topi, jf2[:, None], 1)[:, 0]
-            vals_all = jnp.concatenate([M2x, Mij], axis=1)  # [C, 2C]
-            nodes_all = jnp.concatenate(
-                [
-                    jnp.broadcast_to(dn[None], (C, C)),
-                    jnp.broadcast_to(cn[None], (C, C)),
-                ],
-                axis=1,
-            )
-            best2, cand2 = best_and_cand(vals_all, nodes_all, vu2, iu2)
-            t = jnp.where(
-                (best2 > neg_inf) & unc & cvalid, cand2.astype(jnp.int32), -1
-            )
+            with _subphase("repair"):
+                act = unc & (c >= 0)
+                cn = jnp.maximum(c, 0)
+                # cumulative usage each pod i sees at node c_j from pods k < i
+                # (exclusive int32 prefix sum == the adds the per-pod scan
+                # performs, in the same order — exact; log-depth associative
+                # scan, jnp.cumsum lowers to O(C^2) reduce_window on TPU)
+                E = (c[:, None] == c[None, :]) & act[:, None]  # [C(k), C(j)]
+                T = E[:, :, None] * creq[:, None, :]  # [C, C, R]
+                cum = lax.associative_scan(jnp.add, T, axis=0) - T
+                # round-start usage at c_j: dirty nodes live in dsu, clean nodes
+                # are untouched since chunk start
+                eqd = (c[:, None] == dlist[None, :]) & dvalid[None, :]  # [C, C]
+                hasslot = eqd.any(axis=1)
+                sl = jnp.argmax(eqd, axis=1)
+                cu = jnp.where(hasslot[:, None], dsu[sl], used0[cn])  # [C, R]
+                ca = n_alloc_full[cn]
+                cstat = stat_at(cn)  # [C, C]
+                uij = cu[None] + cum  # [C, C, R]
+                # fit of pod i at node c_j under its intra-round usage uij[i, j]
+                fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
+                reqij = uij + req_b
+                shape = reqij.shape
+                vij = score_flat(
+                    reqij.reshape(-1, R),
+                    jnp.broadcast_to(ca[None], shape).reshape(-1, R),
+                ).reshape(C, C)
+                Mij = jnp.where(act[None, :] & jlt & cstat & fitij, vij, neg_inf)
+                # dirty nodes picked intra-round before i: superseded by Mij.
+                # prefix-any over j < i as a [C,C]x[C,C] bool matmul (MXU)
+                D2 = (dlist[None, :] == c[:, None]) & act[:, None] & dvalid[None, :]
+                excl2 = (
+                    jlt.astype(jnp.float32) @ D2.astype(jnp.float32)
+                ) > 0.0  # [C(i), C(d)]
+                M2x = jnp.where(excl2, neg_inf, M2)
+                # list entries picked intra-round: one [C, K, C] compare, two
+                # masked reductions (also reused for the cleank carry update)
+                cmp = topi[:, :, None] == c[None, None, :]  # [C, K, C(j)]
+                chosen_before = (cmp & (jlt & act[None, :])[:, None, :]).any(2)
+                cleank2 = cleank & ~chosen_before
+                jf2 = jnp.argmax(cleank2, axis=1)
+                vu2 = jnp.where(
+                    cleank2.any(axis=1),
+                    jnp.take_along_axis(topv, jf2[:, None], 1)[:, 0],
+                    neg_inf,
+                )
+                iu2 = jnp.take_along_axis(topi, jf2[:, None], 1)[:, 0]
+                vals_all = jnp.concatenate([M2x, Mij], axis=1)  # [C, 2C]
+                nodes_all = jnp.concatenate(
+                    [
+                        jnp.broadcast_to(dn[None], (C, C)),
+                        jnp.broadcast_to(cn[None], (C, C)),
+                    ],
+                    axis=1,
+                )
+                best2, cand2 = best_and_cand(vals_all, nodes_all, vu2, iu2)
+                t = jnp.where(
+                    (best2 > neg_inf) & unc & cvalid, cand2.astype(jnp.int32), -1
+                )
             # ---- commit the longest exact prefix ----
-            bad = unc & (t != c)
-            firstbad = jnp.where(bad.any(), jnp.argmax(bad), C).astype(
-                jnp.int32
-            )
-            prefix = unc & (idxC < firstbad)
-            pact = prefix & (c >= 0)
-            out = jnp.where(prefix, c, out)
-            ord_ = jnp.where(prefix, nrounds, ord_)  # commit-round ordinal
-            committed = committed | prefix
-            # stale list entries: nodes picked by the committed prefix
-            cleank = cleank & ~(cmp & pact[None, None, :]).any(2)
-            # per-node committed adds this round (sum over the prefix's
-            # pods; one add per node — int32, exact)
-            Epact = E & pact[:, None]  # [C(k), C(j)]
-            adds = (Epact[:, :, None] * creq[:, None, :]).sum(axis=0)  # [C,R]
-            minpos = jnp.where(Epact, idxC[:, None], C).min(axis=0)  # [C(j)]
-            owner = pact & (minpos == idxC)  # first chooser of its node
-            is_new = owner & ~hasslot
-            rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-            newpos = jnp.where(is_new, nd + rank, C)
-            dlist = dlist.at[newpos].set(c, mode="drop")
-            dsu = dsu.at[newpos].set(used0[cn] + adds, mode="drop")
-            dsu = dsu.at[jnp.where(owner & hasslot, sl, C)].add(
-                adds, mode="drop"
-            )
-            nd = nd + is_new.sum().astype(jnp.int32)
+            with _subphase("commit"):
+                bad = unc & (t != c)
+                firstbad = jnp.where(bad.any(), jnp.argmax(bad), C).astype(
+                    jnp.int32
+                )
+                prefix = unc & (idxC < firstbad)
+                pact = prefix & (c >= 0)
+                out = jnp.where(prefix, c, out)
+                ord_ = jnp.where(prefix, nrounds, ord_)  # commit-round ordinal
+                committed = committed | prefix
+                # stale list entries: nodes picked by the committed prefix
+                cleank = cleank & ~(cmp & pact[None, None, :]).any(2)
+                # per-node committed adds this round (sum over the prefix's
+                # pods; one add per node — int32, exact)
+                Epact = E & pact[:, None]  # [C(k), C(j)]
+                adds = (Epact[:, :, None] * creq[:, None, :]).sum(axis=0)  # [C,R]
+                minpos = jnp.where(Epact, idxC[:, None], C).min(axis=0)  # [C(j)]
+                owner = pact & (minpos == idxC)  # first chooser of its node
+                is_new = owner & ~hasslot
+                rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+                newpos = jnp.where(is_new, nd + rank, C)
+                dlist = dlist.at[newpos].set(c, mode="drop")
+                dsu = dsu.at[newpos].set(used0[cn] + adds, mode="drop")
+                dsu = dsu.at[jnp.where(owner & hasslot, sl, C)].add(
+                    adds, mode="drop"
+                )
+                nd = nd + is_new.sum().astype(jnp.int32)
             return committed, out, ord_, cleank, dlist, dsu, nd, nrounds + 1
 
         st0 = (
@@ -812,14 +857,16 @@ def schedule_scan_chunked(
             jnp.int32(0),
             jnp.int32(0),
         )
-        committed, out, ord_, _, _, _, _, nrounds = lax.while_loop(
-            lambda st: ~st[0].all(), round_body, st0
-        )
-        placed = (out >= 0)[:, None]
-        ucols = jnp.where(out >= 0, out, N)
-        used_out = used0.at[ucols].add(
-            jnp.where(placed, creq, 0), mode="drop"
-        )
+        with _subphase("round_loop"):
+            committed, out, ord_, _, _, _, _, nrounds = lax.while_loop(
+                lambda st: ~st[0].all(), round_body, st0
+            )
+        with _subphase("commit"):
+            placed = (out >= 0)[:, None]
+            ucols = jnp.where(out >= 0, out, N)
+            used_out = used0.at[ucols].add(
+                jnp.where(placed, creq, 0), mode="drop"
+            )
         if not use_inc:
             return used_out, (out, nrounds, ord_)
         # patch the carried class hoist at the committed node columns
@@ -829,20 +876,21 @@ def schedule_scan_chunked(
         # so the carried matrix stays bit-identical to a per-chunk dense
         # re-hoist throughout the scan.  Duplicate committed columns write
         # identical values (same node, same final usage).
-        cn_out = jnp.maximum(out, 0)
-        col_used = used_out[cn_out]  # [C, R]
-        col_alloc = n_alloc_full[cn_out]
-        col_fit = jax.vmap(filters.fit_ok, (0, None, None))(
-            req_u, col_used, col_alloc
-        )  # [U1, C]
-        reqd_u = col_used[None, :, :] + req_u[:, None, :]  # [U1, C, R]
-        col_base = score_flat(
-            reqd_u.reshape(-1, R),
-            jnp.broadcast_to(col_alloc[None], reqd_u.shape).reshape(-1, R),
-        ).reshape(U1, C)
-        col_stat = stat_full[:, cn_out]  # [U1, C]
-        newv = jnp.where(col_stat & col_fit, col_base, neg_inf)
-        t0u = t0u.at[:, ucols].set(newv, mode="drop")
+        with _subphase("commit"):
+            cn_out = jnp.maximum(out, 0)
+            col_used = used_out[cn_out]  # [C, R]
+            col_alloc = n_alloc_full[cn_out]
+            col_fit = jax.vmap(filters.fit_ok, (0, None, None))(
+                req_u, col_used, col_alloc
+            )  # [U1, C]
+            reqd_u = col_used[None, :, :] + req_u[:, None, :]  # [U1, C, R]
+            col_base = score_flat(
+                reqd_u.reshape(-1, R),
+                jnp.broadcast_to(col_alloc[None], reqd_u.shape).reshape(-1, R),
+            ).reshape(U1, C)
+            col_stat = stat_full[:, cn_out]  # [U1, C]
+            newv = jnp.where(col_stat & col_fit, col_base, neg_inf)
+            t0u = t0u.at[:, ucols].set(newv, mode="drop")
         return (used_out, t0u), (out, nrounds, ord_)
 
     if use_inc:
@@ -1045,17 +1093,18 @@ def schedule_scan_rounds(
         img_on = inc.img_u is not None
     else:
         img_on = _image_on(arr, cfg, image_sharded)
-        tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
-        nodesel = filters.node_selection_ok_from(tm, arr)
-        pin = arr.pod_nodename[:, None]
-        nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-        sf = (
-            arr.node_valid[None, :]
-            & arr.pod_valid[:, None]
-            & filters.taints_ok(arr)
-            & nodesel
-            & nodename_ok
-        )
+        with _subphase("hoist"):
+            tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
+            nodesel = filters.node_selection_ok_from(tm, arr)
+            pin = arr.pod_nodename[:, None]
+            nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+            sf = (
+                arr.node_valid[None, :]
+                & arr.pod_valid[:, None]
+                & filters.taints_ok(arr)
+                & nodesel
+                & nodename_ok
+            )
     n_alloc = arr.node_alloc
 
     def score_flat(requested, alloc):
@@ -1075,9 +1124,11 @@ def schedule_scan_rounds(
     else:
         xs["sf"] = seg(sf)
         if cfg.enable_taint_score:
-            xs["traw"] = seg(taint_prefer_counts(arr))
+            with _subphase("hoist"):
+                xs["traw"] = seg(taint_prefer_counts(arr))
         if cfg.enable_node_pref:
-            xs["naraw"] = seg(_preferred_node_affinity_raw(arr, tm))
+            with _subphase("hoist"):
+                xs["naraw"] = seg(_preferred_node_affinity_raw(arr, tm))
         if img_on:
             xs["img"] = seg(arr.image_score)
         if pw:
@@ -1134,45 +1185,53 @@ def schedule_scan_rounds(
             cimg = cx["img"] if img_on else None
 
         # --- per-chunk static: interference incidence [C, C] ---
-        if pw:
-            rd = slot_indicator(cx["spread_t"]) + slot_indicator(
-                cx["aff"]
-            ) + slot_indicator(cx["anti"])
-            wr_cnt = slot_indicator(cx["mt"], cx["mv"])
-            rd_anti = slot_indicator(cx["mt"])
-            wr_anti = slot_indicator(cx["anti"])
-            share = (rd @ wr_cnt.T + rd_anti @ wr_anti.T) > 0.0
-            if ips:
-                rd_pref = slot_indicator(cx["pref_t"])
-                wr_pref = slot_indicator(cx["pref_t"])
-                if cfg.hard_pod_affinity_weight:
-                    wr_pref = jnp.maximum(wr_pref, slot_indicator(cx["aff"]))
-                share |= (
-                    rd_pref @ wr_cnt.T + rd_anti @ wr_pref.T
-                ) > 0.0
-        else:
-            share = jnp.zeros((C, C), dtype=jnp.bool_)
-        if cfg.enable_ports:
-            pf = cx["ports"].astype(jnp.float32)
-            share |= (pf @ pf.T) > 0.0
+        with _subphase("hoist"):
+            if pw:
+                rd = slot_indicator(cx["spread_t"]) + slot_indicator(
+                    cx["aff"]
+                ) + slot_indicator(cx["anti"])
+                wr_cnt = slot_indicator(cx["mt"], cx["mv"])
+                rd_anti = slot_indicator(cx["mt"])
+                wr_anti = slot_indicator(cx["anti"])
+                share = (rd @ wr_cnt.T + rd_anti @ wr_anti.T) > 0.0
+                if ips:
+                    rd_pref = slot_indicator(cx["pref_t"])
+                    wr_pref = slot_indicator(cx["pref_t"])
+                    if cfg.hard_pod_affinity_weight:
+                        wr_pref = jnp.maximum(
+                            wr_pref, slot_indicator(cx["aff"])
+                        )
+                    share |= (
+                        rd_pref @ wr_cnt.T + rd_anti @ wr_pref.T
+                    ) > 0.0
+            else:
+                share = jnp.zeros((C, C), dtype=jnp.bool_)
+            if cfg.enable_ports:
+                pf = cx["ports"].astype(jnp.float32)
+                share |= (pf @ pf.T) > 0.0
 
         # --- chunk-start base hoist (patched per round at dirty columns) ---
         def base_at(used):
             # `used` is the FULL [N, R] array; the hoist reads this shard's
             # node slice only — [C, Nl] blocks, elementwise, bit-identical
             # to the same columns of the dense hoist
-            if axis_name:
-                used_l = lax.dynamic_slice_in_dim(used, base, local_n, axis=0)
-            else:
-                used_l = used
-            requested = used_l[None, :, :] + creq[:, None, :]
-            fit = jax.vmap(filters.fit_ok, (0, None, None))(creq, used_l, n_alloc)
-            b = cfg.fit_weight * jax.vmap(
-                lambda rq, al: fit_score(rq, al, cfg), (0, None)
-            )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
-                balanced_allocation, (0, None, None)
-            )(requested, n_alloc, res)
-            return b, fit
+            with _subphase("hoist"):
+                if axis_name:
+                    used_l = lax.dynamic_slice_in_dim(
+                        used, base, local_n, axis=0
+                    )
+                else:
+                    used_l = used
+                requested = used_l[None, :, :] + creq[:, None, :]
+                fit = jax.vmap(filters.fit_ok, (0, None, None))(
+                    creq, used_l, n_alloc
+                )
+                b = cfg.fit_weight * jax.vmap(
+                    lambda rq, al: fit_score(rq, al, cfg), (0, None)
+                )(requested, n_alloc) + cfg.balanced_weight * jax.vmap(
+                    balanced_allocation, (0, None, None)
+                )(requested, n_alloc, res)
+                return b, fit
 
         if not use_inc:
             base0_init, fit0_init = base_at(used0)
@@ -1188,86 +1247,88 @@ def schedule_scan_rounds(
             unc = ~committed
 
             # ---- exact re-hoist vs round-start state ----
-            if use_inc:
-                # per-pod rows of the patched class matrices [U1, Nl]
-                fit0_p = fit0[ccls]
-                base0_p = base0[ccls]
-            else:
-                fit0_p, base0_p = fit0, base0
-            feasible = csf & fit0_p
-            if cfg.enable_ports:
-                feasible &= jax.vmap(pairwise.ports_ok, (None, 0))(
-                    ports_used, cx["ports"]
+            with _subphase("score"):
+                if use_inc:
+                    # per-pod rows of the patched class matrices [U1, Nl]
+                    fit0_p = fit0[ccls]
+                    base0_p = base0[ccls]
+                else:
+                    fit0_p, base0_p = fit0, base0
+                feasible = csf & fit0_p
+                if cfg.enable_ports:
+                    feasible &= jax.vmap(pairwise.ports_ok, (None, 0))(
+                        ports_used, cx["ports"]
+                    )
+                if pw:
+                    spread_ok, spread_raw = jax.vmap(
+                        partial(pairwise.spread_step, axis_name=axis_name),
+                        (None, None, 0, 0, 0, 0),
+                    )(cnt_node, has_key_all, cx["spread_t"], cx["skew"],
+                      cx["hard"], celig)
+                    interpod_ok = jax.vmap(
+                        pairwise.interpod_required_ok,
+                        (None, None, None, None, 0, 0, 0, 0, 0),
+                    )(cnt_node, anti_node, total_t, has_key_all, cx["aff"],
+                      cx["anti"], cx["mt"], cx["mv"], cx["aself"])
+                    feasible &= spread_ok & interpod_ok
+            with _subphase("normalize"):
+                total = base0_p
+                # per-pod NormalizeScore scalars over the CURRENT feasible set,
+                # accumulated in the plain scan's stage order (float parity);
+                # under sharding the scalars stitch with pmax, like the scan
+                if cfg.enable_taint_score:
+                    t_mx = _rmax(jnp.where(feasible, ctraw, 0.0), axis_name)
+                    total = total + cfg.taint_weight * jnp.where(
+                        (t_mx > 0)[:, None],
+                        MAXS - MAXS * ctraw / t_mx[:, None],
+                        MAXS,
+                    )
+                if cfg.enable_node_pref:
+                    na_mx = _rmax(jnp.where(feasible, cnaraw, 0.0), axis_name)
+                    total = total + cfg.node_affinity_weight * jnp.where(
+                        (na_mx > 0)[:, None],
+                        cnaraw * MAXS / na_mx[:, None],
+                        0.0,
+                    )
+                if pw:
+                    s_mx = _rmax(jnp.where(feasible, spread_raw, 0.0), axis_name)
+                    total = total + cfg.spread_weight * jnp.where(
+                        (s_mx > 0)[:, None],
+                        MAXS - MAXS * spread_raw / s_mx[:, None],
+                        MAXS,
+                    )
+                if ips:
+                    ip_raw = jax.vmap(
+                        pairwise.interpod_pref_raw,
+                        (None, None, None, 0, 0, 0, 0),
+                    )(cnt_node, pref_node, has_key_all, cx["pref_t"],
+                      cx["pref_w"], cx["mt"], cx["mv"])
+                    ip_mx = _rmax(
+                        jnp.where(feasible, ip_raw, neg_inf), axis_name
+                    )
+                    ip_mn = -_rmax(
+                        jnp.where(feasible, -ip_raw, neg_inf), axis_name
+                    )
+                    total = total + cfg.interpod_weight * jnp.where(
+                        (ip_mx > ip_mn)[:, None],
+                        MAXS * (ip_raw - ip_mn[:, None])
+                        / (ip_mx[:, None] - ip_mn[:, None]),
+                        0.0,
+                    )
+                if img_on:
+                    total = total + cfg.image_weight * cimg
+                total = jnp.where(feasible, total, neg_inf)
+                best = _rmax(total, axis_name)
+                cand = _rmin(
+                    jnp.where(
+                        (total == best[:, None]) & feasible,
+                        my_nodes[None, :], _INT_MAX,
+                    ),
+                    axis_name,
                 )
-            if pw:
-                spread_ok, spread_raw = jax.vmap(
-                    partial(pairwise.spread_step, axis_name=axis_name),
-                    (None, None, 0, 0, 0, 0),
-                )(cnt_node, has_key_all, cx["spread_t"], cx["skew"],
-                  cx["hard"], celig)
-                interpod_ok = jax.vmap(
-                    pairwise.interpod_required_ok,
-                    (None, None, None, None, 0, 0, 0, 0, 0),
-                )(cnt_node, anti_node, total_t, has_key_all, cx["aff"],
-                  cx["anti"], cx["mt"], cx["mv"], cx["aself"])
-                feasible &= spread_ok & interpod_ok
-            total = base0_p
-            # per-pod NormalizeScore scalars over the CURRENT feasible set,
-            # accumulated in the plain scan's stage order (float parity);
-            # under sharding the scalars stitch with pmax, like the scan
-            if cfg.enable_taint_score:
-                t_mx = _rmax(jnp.where(feasible, ctraw, 0.0), axis_name)
-                total = total + cfg.taint_weight * jnp.where(
-                    (t_mx > 0)[:, None],
-                    MAXS - MAXS * ctraw / t_mx[:, None],
-                    MAXS,
+                c0 = jnp.where(
+                    (best > neg_inf) & cvalid, cand.astype(jnp.int32), -1
                 )
-            if cfg.enable_node_pref:
-                na_mx = _rmax(jnp.where(feasible, cnaraw, 0.0), axis_name)
-                total = total + cfg.node_affinity_weight * jnp.where(
-                    (na_mx > 0)[:, None],
-                    cnaraw * MAXS / na_mx[:, None],
-                    0.0,
-                )
-            if pw:
-                s_mx = _rmax(jnp.where(feasible, spread_raw, 0.0), axis_name)
-                total = total + cfg.spread_weight * jnp.where(
-                    (s_mx > 0)[:, None],
-                    MAXS - MAXS * spread_raw / s_mx[:, None],
-                    MAXS,
-                )
-            if ips:
-                ip_raw = jax.vmap(
-                    pairwise.interpod_pref_raw,
-                    (None, None, None, 0, 0, 0, 0),
-                )(cnt_node, pref_node, has_key_all, cx["pref_t"],
-                  cx["pref_w"], cx["mt"], cx["mv"])
-                ip_mx = _rmax(
-                    jnp.where(feasible, ip_raw, neg_inf), axis_name
-                )
-                ip_mn = -_rmax(
-                    jnp.where(feasible, -ip_raw, neg_inf), axis_name
-                )
-                total = total + cfg.interpod_weight * jnp.where(
-                    (ip_mx > ip_mn)[:, None],
-                    MAXS * (ip_raw - ip_mn[:, None])
-                    / (ip_mx[:, None] - ip_mn[:, None]),
-                    0.0,
-                )
-            if img_on:
-                total = total + cfg.image_weight * cimg
-            total = jnp.where(feasible, total, neg_inf)
-            best = _rmax(total, axis_name)
-            cand = _rmin(
-                jnp.where(
-                    (total == best[:, None]) & feasible,
-                    my_nodes[None, :], _INT_MAX,
-                ),
-                axis_name,
-            )
-            c0 = jnp.where(
-                (best > neg_inf) & cvalid, cand.astype(jnp.int32), -1
-            )
             # ---- dispersal speculation: same-choice pods would otherwise
             # truncate the prefix at every duplicate (measured 1.9 pods/
             # round on BASELINE config 3 without it).  Pod i speculates its
@@ -1277,23 +1338,24 @@ def schedule_scan_rounds(
             # tie-break), so ranks walk the plateau exactly like the
             # sequential scan does.  A wrong guess is caught by the exact
             # repair below and only shortens the prefix. ----
-            same0 = (
-                (c0[:, None] == c0[None, :])
-                & (c0[None, :] >= 0)
-                & unc[None, :]
-            )
-            rank = (same0 & jlt).sum(axis=1).astype(jnp.int32)
-            Zr = min(32, N)
-            topv, topi = _global_top_k(total, Zr, axis_name, base)
-            sel = jnp.minimum(rank, Zr - 1)[:, None]
-            v_sel = jnp.take_along_axis(topv, sel, 1)[:, 0]
-            c_sp = jnp.take_along_axis(topi, sel, 1)[:, 0].astype(jnp.int32)
-            c = jnp.where(
-                unc & (c0 >= 0) & (rank > 0) & (rank < Zr)
-                & (v_sel > neg_inf),
-                c_sp,
-                c0,
-            )
+            with _subphase("speculate"):
+                same0 = (
+                    (c0[:, None] == c0[None, :])
+                    & (c0[None, :] >= 0)
+                    & unc[None, :]
+                )
+                rank = (same0 & jlt).sum(axis=1).astype(jnp.int32)
+                Zr = min(32, N)
+                topv, topi = _global_top_k(total, Zr, axis_name, base)
+                sel = jnp.minimum(rank, Zr - 1)[:, None]
+                v_sel = jnp.take_along_axis(topv, sel, 1)[:, 0]
+                c_sp = jnp.take_along_axis(topi, sel, 1)[:, 0].astype(jnp.int32)
+                c = jnp.where(
+                    unc & (c0 >= 0) & (rank > 0) & (rank < Zr)
+                    & (v_sel > neg_inf),
+                    c_sp,
+                    c0,
+                )
 
             # ---- exact repair under the intra-round prefix ----
             def repair(c):
@@ -1301,105 +1363,106 @@ def schedule_scan_rounds(
                 sequential argmax given pods j < i commit c_j; hard_i =
                 the repair's premises are void for i (term-sharing or an
                 extreme-attaining feasibility drop among its prefix)."""
-                act = unc & (c >= 0)
-                cn = jnp.maximum(c, 0)
-                E = (c[:, None] == c[None, :]) & act[:, None]
-                T3 = E[:, :, None] * creq[:, None, :]
-                cum = lax.associative_scan(jnp.add, T3, axis=0) - T3
-                ca = n_alloc_full[cn]  # [C, R]
-                uij = used[cn][None, :, :] + cum  # [C(i), C(j), R]
-                fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
-                reqij = uij + creq[:, None, :]
-                shape3 = reqij.shape
-                baseij = score_flat(
-                    reqij.reshape(-1, R),
-                    jnp.broadcast_to(ca[None], shape3).reshape(-1, R),
-                ).reshape(C, C)
-                # round-start raws at the candidate nodes: each [C, C] block
-                # gathered from its owner shard (shard-local values, psum
-                # broadcast — no full-matrix traffic)
-                feas0_at = _gather_cols(feasible, cn, axis_name, base, local_n)
-                newtot = baseij
-                extreme_at = jnp.zeros((C, C), dtype=jnp.bool_)
-                if cfg.enable_taint_score:
-                    r_at = _gather_cols(ctraw, cn, axis_name, base, local_n)
-                    newtot = newtot + cfg.taint_weight * jnp.where(
-                        (t_mx > 0)[:, None],
-                        MAXS - MAXS * r_at / t_mx[:, None],
-                        MAXS,
+                with _subphase("repair"):
+                    act = unc & (c >= 0)
+                    cn = jnp.maximum(c, 0)
+                    E = (c[:, None] == c[None, :]) & act[:, None]
+                    T3 = E[:, :, None] * creq[:, None, :]
+                    cum = lax.associative_scan(jnp.add, T3, axis=0) - T3
+                    ca = n_alloc_full[cn]  # [C, R]
+                    uij = used[cn][None, :, :] + cum  # [C(i), C(j), R]
+                    fitij = jax.vmap(filters.fit_ok, (0, 0, None))(creq, uij, ca)
+                    reqij = uij + creq[:, None, :]
+                    shape3 = reqij.shape
+                    baseij = score_flat(
+                        reqij.reshape(-1, R),
+                        jnp.broadcast_to(ca[None], shape3).reshape(-1, R),
+                    ).reshape(C, C)
+                    # round-start raws at the candidate nodes: each [C, C] block
+                    # gathered from its owner shard (shard-local values, psum
+                    # broadcast — no full-matrix traffic)
+                    feas0_at = _gather_cols(feasible, cn, axis_name, base, local_n)
+                    newtot = baseij
+                    extreme_at = jnp.zeros((C, C), dtype=jnp.bool_)
+                    if cfg.enable_taint_score:
+                        r_at = _gather_cols(ctraw, cn, axis_name, base, local_n)
+                        newtot = newtot + cfg.taint_weight * jnp.where(
+                            (t_mx > 0)[:, None],
+                            MAXS - MAXS * r_at / t_mx[:, None],
+                            MAXS,
+                        )
+                        extreme_at |= (t_mx > 0)[:, None] & (r_at == t_mx[:, None])
+                    if cfg.enable_node_pref:
+                        r_at = _gather_cols(
+                            cnaraw, cn, axis_name, base, local_n
+                        )
+                        newtot = newtot + cfg.node_affinity_weight * jnp.where(
+                            (na_mx > 0)[:, None],
+                            r_at * MAXS / na_mx[:, None],
+                            0.0,
+                        )
+                        extreme_at |= (na_mx > 0)[:, None] & (
+                            r_at == na_mx[:, None]
+                        )
+                    if pw:
+                        r_at = _gather_cols(
+                            spread_raw, cn, axis_name, base, local_n
+                        )
+                        newtot = newtot + cfg.spread_weight * jnp.where(
+                            (s_mx > 0)[:, None],
+                            MAXS - MAXS * r_at / s_mx[:, None],
+                            MAXS,
+                        )
+                        extreme_at |= (s_mx > 0)[:, None] & (r_at == s_mx[:, None])
+                    if ips:
+                        r_at = _gather_cols(ip_raw, cn, axis_name, base, local_n)
+                        newtot = newtot + cfg.interpod_weight * jnp.where(
+                            (ip_mx > ip_mn)[:, None],
+                            MAXS * (r_at - ip_mn[:, None])
+                            / (ip_mx[:, None] - ip_mn[:, None]),
+                            0.0,
+                        )
+                        extreme_at |= (ip_mx > ip_mn)[:, None] & (
+                            (r_at == ip_mx[:, None]) | (r_at == ip_mn[:, None])
+                        )
+                    if img_on:
+                        newtot = newtot + cfg.image_weight * _gather_cols(
+                            cimg, cn, axis_name, base, local_n
+                        )
+                    newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
+                    dropped = feas0_at & ~fitij
+                    hard = (
+                        (share | (dropped & extreme_at)) & jlt & act[None, :]
+                    ).any(axis=1)
+                    # unpicked nodes keep round-start scores; picked nodes take
+                    # the rescored newtot
+                    O = ((c[:, None] == my_nodes[None, :]) & act[:, None]).astype(
+                        jnp.float32
+                    )  # [C(j), N] pick indicator
+                    picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, Nl]
+                    av = _rmax(jnp.where(picked_before, neg_inf, total), axis_name)
+                    a_n = _rmin(
+                        jnp.where(
+                            (total == av[:, None]) & ~picked_before,
+                            my_nodes[None, :],
+                            _INT_MAX,
+                        ),
+                        axis_name,
                     )
-                    extreme_at |= (t_mx > 0)[:, None] & (r_at == t_mx[:, None])
-                if cfg.enable_node_pref:
-                    r_at = _gather_cols(
-                        cnaraw, cn, axis_name, base, local_n
+                    Mj = jnp.where(act[None, :] & jlt, newtot, neg_inf)
+                    vb = jnp.max(Mj, axis=1)
+                    b_n = jnp.where(Mj == vb[:, None], cn[None, :], _INT_MAX).min(
+                        axis=1
                     )
-                    newtot = newtot + cfg.node_affinity_weight * jnp.where(
-                        (na_mx > 0)[:, None],
-                        r_at * MAXS / na_mx[:, None],
-                        0.0,
+                    t_val = jnp.maximum(av, vb)
+                    t_n = jnp.where(
+                        vb > av, b_n,
+                        jnp.where(av > vb, a_n, jnp.minimum(a_n, b_n)),
                     )
-                    extreme_at |= (na_mx > 0)[:, None] & (
-                        r_at == na_mx[:, None]
+                    t = jnp.where(
+                        (t_val > neg_inf) & cvalid, t_n.astype(jnp.int32), -1
                     )
-                if pw:
-                    r_at = _gather_cols(
-                        spread_raw, cn, axis_name, base, local_n
-                    )
-                    newtot = newtot + cfg.spread_weight * jnp.where(
-                        (s_mx > 0)[:, None],
-                        MAXS - MAXS * r_at / s_mx[:, None],
-                        MAXS,
-                    )
-                    extreme_at |= (s_mx > 0)[:, None] & (r_at == s_mx[:, None])
-                if ips:
-                    r_at = _gather_cols(ip_raw, cn, axis_name, base, local_n)
-                    newtot = newtot + cfg.interpod_weight * jnp.where(
-                        (ip_mx > ip_mn)[:, None],
-                        MAXS * (r_at - ip_mn[:, None])
-                        / (ip_mx[:, None] - ip_mn[:, None]),
-                        0.0,
-                    )
-                    extreme_at |= (ip_mx > ip_mn)[:, None] & (
-                        (r_at == ip_mx[:, None]) | (r_at == ip_mn[:, None])
-                    )
-                if img_on:
-                    newtot = newtot + cfg.image_weight * _gather_cols(
-                        cimg, cn, axis_name, base, local_n
-                    )
-                newtot = jnp.where(feas0_at & fitij, newtot, neg_inf)
-                dropped = feas0_at & ~fitij
-                hard = (
-                    (share | (dropped & extreme_at)) & jlt & act[None, :]
-                ).any(axis=1)
-                # unpicked nodes keep round-start scores; picked nodes take
-                # the rescored newtot
-                O = ((c[:, None] == my_nodes[None, :]) & act[:, None]).astype(
-                    jnp.float32
-                )  # [C(j), N] pick indicator
-                picked_before = (jlt.astype(jnp.float32) @ O) > 0.0  # [C, Nl]
-                av = _rmax(jnp.where(picked_before, neg_inf, total), axis_name)
-                a_n = _rmin(
-                    jnp.where(
-                        (total == av[:, None]) & ~picked_before,
-                        my_nodes[None, :],
-                        _INT_MAX,
-                    ),
-                    axis_name,
-                )
-                Mj = jnp.where(act[None, :] & jlt, newtot, neg_inf)
-                vb = jnp.max(Mj, axis=1)
-                b_n = jnp.where(Mj == vb[:, None], cn[None, :], _INT_MAX).min(
-                    axis=1
-                )
-                t_val = jnp.maximum(av, vb)
-                t_n = jnp.where(
-                    vb > av, b_n,
-                    jnp.where(av > vb, a_n, jnp.minimum(a_n, b_n)),
-                )
-                t = jnp.where(
-                    (t_val > neg_inf) & cvalid, t_n.astype(jnp.int32), -1
-                )
-                return t, hard
+                    return t, hard
 
             # iterate speculate -> repair: a wrong guess at pod k corrupts
             # only guesses AFTER k, and its own repair is exact, so feeding
@@ -1415,122 +1478,123 @@ def schedule_scan_rounds(
             # ---- commit: the longest prefix whose speculation matched the
             # exact repair, plus the FIRST divergence-only pod committing
             # its exact t (hard interference voids t, so not that one) ----
-            div = t != c
-            bad = unc & (hard | div)
-            firstbad = jnp.where(bad.any(), jnp.argmax(bad), C).astype(
-                jnp.int32
-            )
-            fb_commit = (idxC == firstbad) & unc & ~hard
-            c_final = jnp.where(fb_commit, t, c)
-            prefix = unc & (idxC < firstbad)
-            commit_set = prefix | fb_commit
-            pact = commit_set & (c_final >= 0)
-            cn_final = jnp.maximum(c_final, 0)
-            out = jnp.where(commit_set, c_final, out)
-            ord_ = jnp.where(commit_set, nrounds, ord_)  # commit ordinal
-            committed = committed | commit_set
+            with _subphase("commit"):
+                div = t != c
+                bad = unc & (hard | div)
+                firstbad = jnp.where(bad.any(), jnp.argmax(bad), C).astype(
+                    jnp.int32
+                )
+                fb_commit = (idxC == firstbad) & unc & ~hard
+                c_final = jnp.where(fb_commit, t, c)
+                prefix = unc & (idxC < firstbad)
+                commit_set = prefix | fb_commit
+                pact = commit_set & (c_final >= 0)
+                cn_final = jnp.maximum(c_final, 0)
+                out = jnp.where(commit_set, c_final, out)
+                ord_ = jnp.where(commit_set, nrounds, ord_)  # commit ordinal
+                committed = committed | commit_set
 
-            # ---- absorb the committed picks into the live state ----
-            ucols = jnp.where(pact, c_final, N)  # N = drop sentinel (GLOBAL)
-            adds = jnp.zeros((N, R), dtype=used.dtype).at[ucols].add(
-                jnp.where(pact[:, None], creq, 0), mode="drop"
-            )
-            used = used + adds
-            # patch base/fit at the dirtied columns against the NEW usage
-            col_used = used[cn_final]  # [C, R] (committed cols; others dropped)
-            col_alloc = n_alloc_full[cn_final]
-            if use_inc:
-                # class-level column recompute: one [U1, C] block replaces
-                # the per-pod [C, C] one (per-pod rows are class-row
-                # gathers, so the scattered values are identical)
-                col_req = col_used[None, :, :] + req_u[:, None, :]  # [U1,C,R]
-                col_fit = jax.vmap(
-                    lambda rq: filters.fit_ok(rq, col_used, col_alloc)
-                )(req_u)
-                col_base = score_flat(
-                    col_req.reshape(-1, R),
-                    jnp.broadcast_to(
-                        col_alloc[None], col_req.shape
-                    ).reshape(-1, R),
-                ).reshape(U1, C)
-            else:
-                col_req = col_used[None, :, :] + creq[:, None, :]  # [C, C, R]
-                col_fit = jax.vmap(
-                    lambda rq: filters.fit_ok(rq, col_used, col_alloc)
-                )(creq)
-                col_base = score_flat(
-                    col_req.reshape(-1, R),
-                    jnp.broadcast_to(col_alloc[None], col_req.shape).reshape(
-                        -1, R
-                    ),
-                ).reshape(C, C)
-            if axis_name:
-                # each shard patches only the columns it owns; foreign and
-                # sentinel ids map to local_n and drop (duplicate committed
-                # columns write identical values — same node, same usage)
-                lucols = jnp.where(
-                    (ucols >= base) & (ucols < base + local_n),
-                    ucols - base, local_n,
+                # ---- absorb the committed picks into the live state ----
+                ucols = jnp.where(pact, c_final, N)  # N = drop sentinel (GLOBAL)
+                adds = jnp.zeros((N, R), dtype=used.dtype).at[ucols].add(
+                    jnp.where(pact[:, None], creq, 0), mode="drop"
                 )
-            else:
-                lucols = ucols
-            base0 = base0.at[:, lucols].set(col_base, mode="drop")
-            fit0 = fit0.at[:, lucols].set(col_fit, mode="drop")
-            if cfg.enable_ports:
-                ports_used = ports_used.at[lucols].max(
-                    cx["ports"] & pact[:, None], mode="drop"
-                )
-            if pw:
-                def scatter_rows(state, ids, w):
-                    """state[T, N] += w * (dom matches the pod's chosen
-                    domain), rows = the (pod, slot) flattening.  Under
-                    sharding the chosen node's domain per term comes from
-                    the owner shard (psum broadcast — the schedule_scan
-                    commit pattern) and each shard adds to its own
-                    [*, Nl] columns."""
-                    tids = jnp.maximum(ids, 0).reshape(-1)  # [C*S]
-                    nodes = jnp.broadcast_to(
-                        cn_final[:, None], ids.shape
-                    ).reshape(-1)
-                    wf = w.reshape(-1)
-                    dcol = _gather_at_nodes(
-                        dom_by_term, tids, nodes, axis_name, base, local_n
-                    )  # [C*S]
-                    same = dom_by_term[tids] == dcol[:, None]  # [C*S, Nl]
-                    return state.at[tids].add(wf[:, None] * same), (
-                        tids, dcol, wf
+                used = used + adds
+                # patch base/fit at the dirtied columns against the NEW usage
+                col_used = used[cn_final]  # [C, R] (committed cols; others dropped)
+                col_alloc = n_alloc_full[cn_final]
+                if use_inc:
+                    # class-level column recompute: one [U1, C] block replaces
+                    # the per-pod [C, C] one (per-pod rows are class-row
+                    # gathers, so the scattered values are identical)
+                    col_req = col_used[None, :, :] + req_u[:, None, :]  # [U1,C,R]
+                    col_fit = jax.vmap(
+                        lambda rq: filters.fit_ok(rq, col_used, col_alloc)
+                    )(req_u)
+                    col_base = score_flat(
+                        col_req.reshape(-1, R),
+                        jnp.broadcast_to(
+                            col_alloc[None], col_req.shape
+                        ).reshape(-1, R),
+                    ).reshape(U1, C)
+                else:
+                    col_req = col_used[None, :, :] + creq[:, None, :]  # [C, C, R]
+                    col_fit = jax.vmap(
+                        lambda rq: filters.fit_ok(rq, col_used, col_alloc)
+                    )(creq)
+                    col_base = score_flat(
+                        col_req.reshape(-1, R),
+                        jnp.broadcast_to(col_alloc[None], col_req.shape).reshape(
+                            -1, R
+                        ),
+                    ).reshape(C, C)
+                if axis_name:
+                    # each shard patches only the columns it owns; foreign and
+                    # sentinel ids map to local_n and drop (duplicate committed
+                    # columns write identical values — same node, same usage)
+                    lucols = jnp.where(
+                        (ucols >= base) & (ucols < base + local_n),
+                        ucols - base, local_n,
                     )
+                else:
+                    lucols = ucols
+                base0 = base0.at[:, lucols].set(col_base, mode="drop")
+                fit0 = fit0.at[:, lucols].set(col_fit, mode="drop")
+                if cfg.enable_ports:
+                    ports_used = ports_used.at[lucols].max(
+                        cx["ports"] & pact[:, None], mode="drop"
+                    )
+                if pw:
+                    def scatter_rows(state, ids, w):
+                        """state[T, N] += w * (dom matches the pod's chosen
+                        domain), rows = the (pod, slot) flattening.  Under
+                        sharding the chosen node's domain per term comes from
+                        the owner shard (psum broadcast — the schedule_scan
+                        commit pattern) and each shard adds to its own
+                        [*, Nl] columns."""
+                        tids = jnp.maximum(ids, 0).reshape(-1)  # [C*S]
+                        nodes = jnp.broadcast_to(
+                            cn_final[:, None], ids.shape
+                        ).reshape(-1)
+                        wf = w.reshape(-1)
+                        dcol = _gather_at_nodes(
+                            dom_by_term, tids, nodes, axis_name, base, local_n
+                        )  # [C*S]
+                        same = dom_by_term[tids] == dcol[:, None]  # [C*S, Nl]
+                        return state.at[tids].add(wf[:, None] * same), (
+                            tids, dcol, wf
+                        )
 
-                w_mt = jnp.where(
-                    (cx["mt"] >= 0) & pact[:, None], cx["mv"], 0.0
-                )
-                cnt_node, (tids_mt, dcol_mt, wf_mt) = scatter_rows(
-                    cnt_node, cx["mt"], w_mt
-                )
-                total_t = total_t.at[tids_mt].add(
-                    wf_mt * (dcol_mt < D)
-                )
-                w_an = (
-                    (cx["anti"] >= 0) & pact[:, None]
-                ).astype(anti_node.dtype)
-                anti_node, _ = scatter_rows(anti_node, cx["anti"], w_an)
-                if ips:
-                    w_pf = jnp.where(
-                        (cx["pref_t"] >= 0) & pact[:, None],
-                        cx["pref_w"], 0.0,
+                    w_mt = jnp.where(
+                        (cx["mt"] >= 0) & pact[:, None], cx["mv"], 0.0
                     )
-                    pref_node, _ = scatter_rows(
-                        pref_node, cx["pref_t"], w_pf
+                    cnt_node, (tids_mt, dcol_mt, wf_mt) = scatter_rows(
+                        cnt_node, cx["mt"], w_mt
                     )
-                    if cfg.hard_pod_affinity_weight:
-                        w_ha = jnp.where(
-                            (cx["aff"] >= 0) & pact[:, None],
-                            jnp.float32(cfg.hard_pod_affinity_weight),
-                            0.0,
+                    total_t = total_t.at[tids_mt].add(
+                        wf_mt * (dcol_mt < D)
+                    )
+                    w_an = (
+                        (cx["anti"] >= 0) & pact[:, None]
+                    ).astype(anti_node.dtype)
+                    anti_node, _ = scatter_rows(anti_node, cx["anti"], w_an)
+                    if ips:
+                        w_pf = jnp.where(
+                            (cx["pref_t"] >= 0) & pact[:, None],
+                            cx["pref_w"], 0.0,
                         )
                         pref_node, _ = scatter_rows(
-                            pref_node, cx["aff"], w_ha
+                            pref_node, cx["pref_t"], w_pf
                         )
+                        if cfg.hard_pod_affinity_weight:
+                            w_ha = jnp.where(
+                                (cx["aff"] >= 0) & pact[:, None],
+                                jnp.float32(cfg.hard_pod_affinity_weight),
+                                0.0,
+                            )
+                            pref_node, _ = scatter_rows(
+                                pref_node, cx["aff"], w_ha
+                            )
             return (committed, out, ord_, base0, fit0, used, cnt_node,
                     anti_node, pref_node, total_t, ports_used, nrounds + 1)
 
@@ -1543,7 +1607,8 @@ def schedule_scan_rounds(
             used0, cnt_node, anti_node, pref_node, total_t, ports_used,
             jnp.int32(0),
         )
-        st = lax.while_loop(lambda s: ~s[0].all(), round_body, st0)
+        with _subphase("round_loop"):
+            st = lax.while_loop(lambda s: ~s[0].all(), round_body, st0)
         (_, out, ord_, base0_f, fit0_f, used, cnt_node, anti_node, pref_node,
          total_t, ports_used, nrounds) = st
         carry_out = (used, cnt_node, anti_node, pref_node, total_t, ports_used)
@@ -1555,10 +1620,11 @@ def schedule_scan_rounds(
             carry_out = carry_out + (base0_f, fit0_f)
         return carry_out, (out, nrounds, ord_)
 
-    cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
-    anti_node0 = jnp.take_along_axis(arr.anti_counts0, dom_by_term, axis=1)
-    pref_node0 = jnp.take_along_axis(arr.pref_own0, dom_by_term, axis=1)
-    total_t0 = arr.term_counts0[:, :D].sum(axis=1)
+    with _subphase("hoist"):
+        cnt_node0 = jnp.take_along_axis(arr.term_counts0, dom_by_term, axis=1)
+        anti_node0 = jnp.take_along_axis(arr.anti_counts0, dom_by_term, axis=1)
+        pref_node0 = jnp.take_along_axis(arr.pref_own0, dom_by_term, axis=1)
+        total_t0 = arr.term_counts0[:, :D].sum(axis=1)
     carry0 = (
         used_init, cnt_node0, anti_node0, pref_node0, total_t0,
         arr.node_ports0,

@@ -132,23 +132,28 @@ def gang_fixpoint_device(
     if G == 0:  # trace-time static: no groups -> plain batch
         return schedule_batch_impl(arr, cfg)
 
+    from .scopes import subphase
+
     def body(carry):
         pv, _, _, _ = carry
         arr_i = dataclasses.replace(arr, pod_valid=pv)
         choices, used = schedule_batch_impl(arr_i, cfg)
-        mask = (pod_group >= 0) & pv
-        gidx = jnp.where(mask, pod_group, G)  # G = drop sentinel
-        sched = jnp.zeros(G, dtype=jnp.int32).at[gidx].add(
-            (choices >= 0).astype(jnp.int32), mode="drop"
-        )
-        present = jnp.zeros(G, dtype=bool).at[gidx].set(True, mode="drop")
-        bad = present & (sched < group_min)
-        anybad = bad.any()
-        in_bad = bad[jnp.maximum(pod_group, 0)] & (pod_group >= 0) & pv
-        first_g = pod_group[jnp.argmax(in_bad)]
-        newly = (pod_group == first_g) & pv
-        pv_next = jnp.where(anybad, pv & ~newly, pv)
-        return pv_next, choices, used, ~anybad
+        # quorum count + earliest-failed-group revocation = this iteration's
+        # commit disposition (the kernel interior carries its own sub-phases)
+        with subphase("commit"):
+            mask = (pod_group >= 0) & pv
+            gidx = jnp.where(mask, pod_group, G)  # G = drop sentinel
+            sched = jnp.zeros(G, dtype=jnp.int32).at[gidx].add(
+                (choices >= 0).astype(jnp.int32), mode="drop"
+            )
+            present = jnp.zeros(G, dtype=bool).at[gidx].set(True, mode="drop")
+            bad = present & (sched < group_min)
+            anybad = bad.any()
+            in_bad = bad[jnp.maximum(pod_group, 0)] & (pod_group >= 0) & pv
+            first_g = pod_group[jnp.argmax(in_bad)]
+            newly = (pod_group == first_g) & pv
+            pv_next = jnp.where(anybad, pv & ~newly, pv)
+            return pv_next, choices, used, ~anybad
 
     init = (
         arr.pod_valid,

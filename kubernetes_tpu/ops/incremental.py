@@ -136,20 +136,22 @@ def _static_hoist(cv, want_elig, want_traw, want_naraw):
     and class-row consistency holds throughout."""
     from . import filters
     from .assign import _preferred_node_affinity_raw
+    from .scopes import subphase
     from .scores import taint_prefer_counts
 
-    tm = filters.term_match(cv.sel_mask, cv.sel_kind, cv.node_labels)
-    nodesel = filters.node_selection_ok_from(tm, cv)
-    stat = (
-        cv.node_valid[None, :]
-        & filters.taints_ok(cv)
-        & nodesel
-        & filters.nodename_ok(cv)
-    )
-    elig = (nodesel & cv.node_valid[None, :]) if want_elig else None
-    traw = taint_prefer_counts(cv) if want_traw else None
-    naraw = _preferred_node_affinity_raw(cv, tm) if want_naraw else None
-    return stat, elig, traw, naraw
+    with subphase("hoist"):
+        tm = filters.term_match(cv.sel_mask, cv.sel_kind, cv.node_labels)
+        nodesel = filters.node_selection_ok_from(tm, cv)
+        stat = (
+            cv.node_valid[None, :]
+            & filters.taints_ok(cv)
+            & nodesel
+            & filters.nodename_ok(cv)
+        )
+        elig = (nodesel & cv.node_valid[None, :]) if want_elig else None
+        traw = taint_prefer_counts(cv) if want_traw else None
+        naraw = _preferred_node_affinity_raw(cv, tm) if want_naraw else None
+        return stat, elig, traw, naraw
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -158,16 +160,20 @@ def _usage_hoist(req_u, node_used, node_alloc, cfg):
     vmapped over classes instead of pods (elementwise per (row, node), so
     float32 results are bit-identical to the per-pod dense hoist)."""
     from . import filters
+    from .scopes import subphase
     from .scores import balanced_allocation, fit_score
 
-    requested = node_used[None, :, :] + req_u[:, None, :]
-    fit = jax.vmap(filters.fit_ok, (0, None, None))(req_u, node_used, node_alloc)
-    base = cfg.fit_weight * jax.vmap(
-        lambda rq, al: fit_score(rq, al, cfg), (0, None)
-    )(requested, node_alloc) + cfg.balanced_weight * jax.vmap(
-        balanced_allocation, (0, None, None)
-    )(requested, node_alloc, cfg.score_resources)
-    return base, fit
+    with subphase("hoist"):
+        requested = node_used[None, :, :] + req_u[:, None, :]
+        fit = jax.vmap(filters.fit_ok, (0, None, None))(
+            req_u, node_used, node_alloc
+        )
+        base = cfg.fit_weight * jax.vmap(
+            lambda rq, al: fit_score(rq, al, cfg), (0, None)
+        )(requested, node_alloc) + cfg.balanced_weight * jax.vmap(
+            balanced_allocation, (0, None, None)
+        )(requested, node_alloc, cfg.score_resources)
+        return base, fit
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -182,22 +188,26 @@ def _patch_hoist(base_u, fit_u, req_u, node_used, node_alloc, cols, cfg):
     pipeline the in-flight step may still be reading it (the
     donation-aliasing rule in the module docstring)."""
     from . import filters
+    from .scopes import subphase
     from .scores import balanced_allocation, fit_score
 
-    n = base_u.shape[1]
-    safe = jnp.minimum(cols, n - 1)
-    cu = node_used[safe]  # [D, R]
-    ca = node_alloc[safe]
-    fit_c = jax.vmap(filters.fit_ok, (0, None, None))(req_u, cu, ca)  # [U1, D]
-    reqd = cu[None, :, :] + req_u[:, None, :]  # [U1, D, R]
-    base_c = cfg.fit_weight * jax.vmap(
-        lambda rq: fit_score(rq, ca, cfg)
-    )(reqd) + cfg.balanced_weight * jax.vmap(
-        lambda rq: balanced_allocation(rq, ca, cfg.score_resources)
-    )(reqd)
-    base_u = base_u.at[:, cols].set(base_c, mode="drop")
-    fit_u = fit_u.at[:, cols].set(fit_c, mode="drop")
-    return base_u, fit_u
+    with subphase("hoist"):
+        n = base_u.shape[1]
+        safe = jnp.minimum(cols, n - 1)
+        cu = node_used[safe]  # [D, R]
+        ca = node_alloc[safe]
+        fit_c = jax.vmap(filters.fit_ok, (0, None, None))(
+            req_u, cu, ca
+        )  # [U1, D]
+        reqd = cu[None, :, :] + req_u[:, None, :]  # [U1, D, R]
+        base_c = cfg.fit_weight * jax.vmap(
+            lambda rq: fit_score(rq, ca, cfg)
+        )(reqd) + cfg.balanced_weight * jax.vmap(
+            lambda rq: balanced_allocation(rq, ca, cfg.score_resources)
+        )(reqd)
+        base_u = base_u.at[:, cols].set(base_c, mode="drop")
+        fit_u = fit_u.at[:, cols].set(fit_c, mode="drop")
+        return base_u, fit_u
 
 
 def _round_up_pow2(x: int, minimum: int = 16) -> int:

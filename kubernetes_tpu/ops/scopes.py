@@ -1,0 +1,84 @@
+"""Named sub-phase scopes — the kernel-interior attribution vocabulary.
+
+PR 6's attribution engine stops at `device_kernel`; these scopes extend the
+per-phase metering BELOW the jit boundary (PAPER.md's
+framework_extension_point_duration_seconds culture, applied to the compiled
+program itself).  Every production kernel (ops/assign.py, ops/incremental.py,
+ops/gang.py, parallel/ring.py) annotates its regions with `jax.named_scope`
+from the ONE declared vocabulary below; the scope names survive lowering as
+HLO `op_name` metadata, which is what joins the two observability halves:
+
+  measured  bench/profiling.py maps jax.profiler device-trace ops back to
+            their owning sub-phase (innermost declared scope wins) and emits
+            the self-time table `bench.harness --profile` extends
+            scheduler/attribution.py with, below `device_kernel`
+  analytic  analysis/costmodel.py walks the traced jaxprs and charges every
+            leaf eqn's FLOPs/HBM bytes to the same owning sub-phase — the
+            roofline ledger KTPU019 reconciles against the measured table
+
+SUBPHASES is deliberately closed: a kernel region outside every declared
+scope is an attribution hole (KTPU019 flags heavy unowned eqns, fail-closed
+like KTPU013), and a new name here must land in both halves at once —
+costmodel and profiling import the tuple from this module so the three can
+never drift onto different vocabularies.
+
+KTPU_NAMED_SCOPES=0 turns every scope into a no-op at TRACE time (the
+parity escape hatch: tests/test_costmodel.py proves annotation changes zero
+placements and zero TRACE_COUNTS across every route x donation variant by
+comparing the two settings).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+# the declared kernel-interior sub-phases, in canonical report order:
+#   hoist       per-chunk / per-cycle score-matrix builds ([C, Nl] dense,
+#               [U1, N] class hoists, static-feasibility preludes)
+#   score       top-k candidate extraction + per-round exact re-hoists
+#   normalize   per-pod NormalizeScore scalar stitches (rounds kernel)
+#   round_loop  the prefix-commit while_loop itself — loop plumbing and any
+#               interior work not owned by a finer scope (the O(C^2K)
+#               ROADMAP-1 target)
+#   speculate   pass-1 dispersal speculation (rank seeding, pointer jumps)
+#   repair      pass-2 exact revalidation under the intra-round prefix
+#   commit      prefix commit + usage/count-state absorption + column patch
+SUBPHASES = (
+    "hoist", "score", "normalize", "round_loop", "speculate", "repair",
+    "commit",
+)
+
+
+def scopes_enabled() -> bool:
+    """KTPU_NAMED_SCOPES=0 disables sub-phase annotation (read at TRACE
+    time: flipping it after a shape/cfg is jit-cached has no effect on that
+    cache entry — parity tests clear the jit caches between settings)."""
+    return os.environ.get("KTPU_NAMED_SCOPES", "") != "0"
+
+
+def subphase(name: str):
+    """`jax.named_scope(name)` for a DECLARED sub-phase (or a no-op under
+    KTPU_NAMED_SCOPES=0).  Undeclared names raise at trace time: the scope
+    vocabulary is the contract both observatory halves key on."""
+    if name not in SUBPHASES:
+        raise ValueError(
+            f"undeclared kernel sub-phase {name!r} (declared: {SUBPHASES})"
+        )
+    if not scopes_enabled():
+        return contextlib.nullcontext()
+    return jax.named_scope(name)
+
+
+def subphase_of(path: str) -> str:
+    """The owning sub-phase of an HLO op_name / jaxpr name-stack path — the
+    INNERMOST declared scope component ('' when none owns it).  One
+    definition shared by the measured (bench/profiling.py) and analytic
+    (analysis/costmodel.py) halves, so an op can never be owned by two
+    different sub-phases across the two ledgers."""
+    for comp in reversed(path.split("/")):
+        if comp in SUBPHASES:
+            return comp
+    return ""

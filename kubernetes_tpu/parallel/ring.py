@@ -61,11 +61,16 @@ def ring_match(sel_mask: jax.Array, sel_kind: jax.Array, labels: jax.Array, mesh
         perm = [(j, (j - 1) % d) for j in range(d)]
 
         def body(i, carry):
+            from ..ops.scopes import subphase
+
             lab_blk, out = carry
             src = (idx + i) % d  # origin shard of the block we currently hold
-            tile = _eval_block(sel_m, sel_k, lab_blk)  # [S/d, P/d]
-            out = lax.dynamic_update_slice(out, tile, (0, src * p_local))
-            lab_blk = lax.ppermute(lab_blk, PODS_AXIS, perm)
+            with subphase("score"):
+                tile = _eval_block(sel_m, sel_k, lab_blk)  # [S/d, P/d]
+            with subphase("commit"):
+                out = lax.dynamic_update_slice(out, tile, (0, src * p_local))
+            with subphase("hoist"):
+                lab_blk = lax.ppermute(lab_blk, PODS_AXIS, perm)
             return (lab_blk, out)
 
         zeros = jnp.zeros((sel_m.shape[0], P_total), dtype=jnp.bool_)

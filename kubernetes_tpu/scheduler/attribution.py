@@ -60,6 +60,14 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 PHASE_OF: Dict[str, str] = {
     "batch.kernel": "device_kernel",
     "device.step": "device_kernel",
+    # kernel-interior sub-phase spans (bench/profiling.py —
+    # merge_profile_spans): they live INSIDE device-kernel windows, so the
+    # sweep charges their instants to device_kernel exactly as before; the
+    # sub-phase split is reported one level down (report["device_subphases"])
+    **{f"device.{p}": "device_kernel" for p in (
+        "hoist", "score", "normalize", "round_loop", "speculate", "repair",
+        "commit", "unowned",
+    )},
     "stitch": "allgather_stitch",
     "allgather": "allgather_stitch",
     "hoist.update": "hoist_update",
@@ -152,14 +160,22 @@ def _fractions(phases: Dict[str, float], wall: float) -> Dict[str, Dict[str, flo
     }
 
 
-def attribute_spans(collector_or_spans, spans_dropped: Optional[int] = None) -> Dict:
+def attribute_spans(collector_or_spans, spans_dropped: Optional[int] = None,
+                    device_subphases: Optional[Dict] = None) -> Dict:
     """The attribution report: per-cycle and whole-run phase breakdowns.
 
     Accepts a TraceCollector (reads .spans() and .spans_dropped) or a bare
     span iterable (pass spans_dropped explicitly for completeness
     flagging).  Returns a machine-readable dict — embedded in bench/harness
     JSON artifacts next to route_trace_counts; render_attribution() prints
-    it as a table."""
+    it as a table.
+
+    `device_subphases` (bench/profiling.subphase_table, when the run
+    captured a `--profile` device trace) embeds the kernel-interior
+    sub-phase table one level below `device_kernel`: its fractions are
+    shares WITHIN the device kernel (they sum to 1.0 there), and
+    render_attribution nests the rows under the device_kernel line so one
+    report answers both "which phase" and "which kernel region"."""
     if hasattr(collector_or_spans, "spans"):
         spans = collector_or_spans.spans()
         if spans_dropped is None:
@@ -227,7 +243,7 @@ def attribute_spans(collector_or_spans, spans_dropped: Optional[int] = None) -> 
     run_wall = t_max - run0
     nonzero = {p: s for p, s in totals.items() if p != "unattributed" and s > 0}
     dominant = max(nonzero, key=nonzero.get) if nonzero else None
-    return {
+    report = {
         "wall_s": round(run_wall, 6),
         "pre_window_s": round(run0 - t_min, 6),
         "n_cycles": len(anchors),
@@ -238,6 +254,9 @@ def attribute_spans(collector_or_spans, spans_dropped: Optional[int] = None) -> 
         "spans_dropped": spans_dropped,
         "complete": spans_dropped == 0,
     }
+    if device_subphases is not None:
+        report["device_subphases"] = device_subphases
+    return report
 
 
 def render_attribution(report: Dict) -> str:
@@ -251,12 +270,19 @@ def render_attribution(report: Dict) -> str:
            "phase totals under-count]")
     ]
     lines.append(f"{'phase':<18} {'seconds':>10} {'fraction':>9}")
+    sub = report.get("device_subphases")
     for p in PHASES:
         d = report["phases"].get(p)
         if d is None or d["seconds"] == 0.0:
             continue
         mark = "  <- dominant" if p == report.get("dominant_phase") else ""
         lines.append(f"{p:<18} {d['seconds']:>10.4f} {d['fraction']:>9.1%}{mark}")
+        if p == "device_kernel" and sub and not sub.get("incomplete"):
+            # the kernel-interior split (bench/profiling.py): fractions are
+            # WITHIN device_kernel (self-time shares, sum to 1.0 there)
+            from ..bench.profiling import render_subphases
+
+            lines.append(render_subphases(sub, indent="  . "))
     for c in report.get("cycles", [])[:32]:
         top = sorted(
             ((p, d["fraction"]) for p, d in c["phases"].items()

@@ -273,6 +273,14 @@ class Metrics:
         if p is not None:
             p.set(v)
 
+    def set_max(self, name: str, v: float) -> None:
+        """Raise a gauge to `v` if higher (peak-depth gauges: the queue-pool
+        samples keep `<pool>_peak` high-water marks next to the live
+        depths, so one scrape answers both 'now' and 'worst this run')."""
+        with self._lock:
+            if v > self.gauges.get(name, float("-inf")):
+                self.gauges[name] = v
+
     def hist(self, name: str) -> StreamingHist:
         """The (unlabeled) histogram for `name`, created on first use — hot
         paths cache the returned handle so repeat observations skip the

@@ -153,6 +153,26 @@ class PriorityQueue:
     def pending_total(self) -> int:
         return len(self._active) + len(self._backoff) + len(self._unschedulable)
 
+    @_locked
+    def depths(self) -> Dict[str, int]:
+        """Per-pool depths in ONE lock acquisition — the queue-pool
+        observability sample the batch cycle stamps onto /metrics at each
+        cycle boundary (scheduler.py — _sample_queue_depths).  `parked` is
+        the backoff+unschedulable union the deferred-commit gate keys on;
+        the pools are reported separately so an operator can tell a retry
+        storm (backoff) from an event-starved park (unschedulable).
+        Matured backoff entries flush first (the __len__/pop convention) —
+        a pod whose backoff just expired is activeQ work THIS cycle, and
+        reporting it as backoff would under-count the peak at exactly the
+        retry-storm moment these gauges diagnose."""
+        self._flush_backoff()
+        return {
+            "active": len(self._active_uids),
+            "backoff": len(self._backoff),
+            "unschedulable": len(self._unschedulable),
+            "parked": len(self._backoff) + len(self._unschedulable),
+        }
+
     @property
     @_locked
     def parked_total(self) -> int:

@@ -908,11 +908,22 @@ class Scheduler:
         per-profile programs could never reach quorum in any of them
         (cross-profile gang livelock, round-3 advisor finding)."""
         t0 = time.perf_counter()
+        self._sample_queue_depths()  # pre-drain: the activeQ's true depth
         batch: List[t.Pod] = self.queue.pop_all()
         if not batch:
             return {}
         with self.tracer.span("batch.cycle", pods=len(batch)):
             return self._schedule_batch_traced(batch, t0)
+
+    def _sample_queue_depths(self) -> None:
+        """Per-pool queue-depth gauges (activeQ / backoff / unschedulable /
+        parked), sampled at each cycle boundary — one queue lock
+        acquisition, four live gauges + four `_peak` high-water marks on
+        /metrics (the reference exposes only the aggregate pending_pods;
+        a retry storm and an event-starved park look identical there)."""
+        for pool, v in self.queue.depths().items():
+            self.metrics.set(f"queue_pool_{pool}_pods", v)
+            self.metrics.set_max(f"queue_pool_{pool}_pods_peak", v)
 
     def _schedule_batch_traced(
         self, batch: List[t.Pod], t0: float
@@ -960,6 +971,7 @@ class Scheduler:
         self.metrics.inc("scheduling_attempts_scheduled", len(batch) - n_failed)
         self.metrics.inc("scheduling_attempts_unschedulable", n_failed)
         self.metrics.set("pending_pods", self.queue.pending_total)
+        self._sample_queue_depths()  # post-commit: requeues landed
         return result
 
     def _schedule_profile_batch(

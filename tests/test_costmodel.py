@@ -1,0 +1,416 @@
+"""Device cost observatory (ISSUE 14): named-scope sub-phase attribution,
+the analytic roofline ledger (analysis/costmodel.py), the measured profile
+table (bench/profiling.py), and the KTPU019 gate that joins them.
+
+Ordering note: the parity test spawns one subprocess with
+KTPU_NAMED_SCOPES=0 and compares against in-process runs — annotation must
+change zero placements and zero TRACE_COUNTS across every route x donation
+variant."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.analysis import costmodel
+from kubernetes_tpu.analysis.devicecheck import RouteTrace
+from kubernetes_tpu.analysis.jaxrules import SubphaseLedgerRule
+from kubernetes_tpu.bench.profiling import (
+    merge_profile_spans,
+    parse_hlo_dumps,
+    subphase_table,
+)
+from kubernetes_tpu.ops.scopes import SUBPHASES, subphase, subphase_of
+
+
+# ---- the scope vocabulary ----
+
+def test_subphase_vocabulary_is_closed():
+    with pytest.raises(ValueError):
+        subphase("not_a_phase")
+    # innermost declared component owns the op — one definition for both
+    # observatory halves
+    assert subphase_of("jit(f)/jit(main)/round_loop/repair/mul") == "repair"
+    assert subphase_of("jit(f)/hoist/dot_general") == "hoist"
+    assert subphase_of("jit(f)/transpose/whatever") == ""
+    assert subphase_of("") == ""
+    assert set(SUBPHASES) >= {"hoist", "round_loop", "speculate", "repair",
+                              "commit", "score", "normalize"}
+
+
+# ---- analytic ledger: exact FLOPs on a known kernel ----
+
+def _known_fn(x, w):
+    with subphase("hoist"):
+        y = x @ w  # [m, k] @ [k, n]
+    with subphase("commit"):
+        return y + 1.0
+
+
+def test_known_flop_kernel_exact_ledger():
+    m, k, n = 8, 16, 4
+    closed = jax.make_jaxpr(_known_fn)(
+        jnp.ones((m, k), jnp.float32), jnp.ones((k, n), jnp.float32)
+    )
+    led = costmodel.jaxpr_ledger(closed)
+    hoist = led["subphases"]["hoist"]
+    assert hoist["flops"] == 2 * m * k * n
+    # roofline bytes: every operand streams once (in + out)
+    assert hoist["hbm_bytes"] == 4 * (m * k + k * n + m * n)
+    commit = led["subphases"]["commit"]
+    assert commit["flops"] == m * n  # one add per element
+    # fractions sum to 1.0 over every charged row
+    assert sum(r["fraction"] for r in led["subphases"].values()) == \
+        pytest.approx(1.0, abs=0.01)
+    assert led["heavy_unowned"] == []
+    assert led["round_loop_fraction"] == 0.0
+
+
+def test_loop_trip_scaling():
+    def f(x):
+        with subphase("hoist"):
+            x = x * 2.0
+        with subphase("round_loop"):
+            def body(st):
+                i, a = st
+                with subphase("repair"):
+                    a = a @ a
+                return i + 1, a
+            _, x = jax.lax.while_loop(lambda st: st[0] < 3, body, (0, x))
+        with subphase("commit"):
+            def sbody(c, _):
+                return c + 1.0, ()
+            x, _ = jax.lax.scan(sbody, x, None, length=7)
+        return x
+
+    closed = jax.make_jaxpr(f)(jnp.ones((4, 4), jnp.float32))
+    led5 = costmodel.jaxpr_ledger(closed, while_trip=5)
+    led10 = costmodel.jaxpr_ledger(closed, while_trip=10)
+    # the while body's dot scales with the assumed trip count
+    assert led5["subphases"]["repair"]["flops"] == 5 * 2 * 4 * 4 * 4
+    assert led10["subphases"]["repair"]["flops"] == 10 * 2 * 4 * 4 * 4
+    # the scan body's add scales with the static length
+    assert led5["subphases"]["commit"]["flops"] == 7 * 16
+    # repair lives inside the loop: the rollup owns it
+    assert led5["round_loop_fraction"] >= led5["subphases"]["repair"]["fraction"]
+    assert led5["dominant"] == "round_loop"
+
+
+# ---- KTPU019: coverage fails closed, reconciliation gates the join ----
+
+def _unannotated_fixture():
+    def f(x):
+        with subphase("hoist"):
+            y = x * 1.5
+        return y @ y  # heavy dot OUTSIDE every declared scope
+
+    return RouteTrace.from_callable(
+        "fixture/unannotated", f, jnp.ones((32, 32), jnp.float32))
+
+
+def test_heavy_unowned_eqn_is_a_finding():
+    t = _unannotated_fixture()
+    assert t.cost is not None  # capture attaches the ledger
+    assert t.cost["heavy_unowned"], "the naked dot must show up"
+    findings = SubphaseLedgerRule().check([t])
+    assert any("unowned" in f.snippet for f in findings)
+
+
+def test_annotated_fixture_is_clean():
+    def f(x):
+        with subphase("hoist"):
+            y = x * 1.5
+        with subphase("score"):
+            return y @ y
+
+    t = RouteTrace.from_callable(
+        "fixture/annotated", f, jnp.ones((32, 32), jnp.float32))
+    assert SubphaseLedgerRule().check([t]) == []
+
+
+def _loop_fixture():
+    def f(x):
+        with subphase("hoist"):
+            x = x + 1.0
+        with subphase("round_loop"):
+            def body(st):
+                i, a = st
+                with subphase("repair"):
+                    a = a @ a
+                return i + 1, a
+            _, x = jax.lax.while_loop(lambda st: st[0] < 3, body, (0, x))
+        return x
+
+    return RouteTrace.from_callable(
+        "fixture/loop", f, jnp.ones((64, 64), jnp.float32))
+
+
+def test_reconciliation_pass_and_fail_fixtures():
+    t = _loop_fixture()
+    analytic_rl = t.cost["round_loop_fraction"]
+    assert analytic_rl > 0.9  # the dot-in-loop dominates the model
+    # pass: measured agrees
+    t.measured_subphases = {"round_loop_fraction": analytic_rl}
+    assert SubphaseLedgerRule().check([t]) == []
+    # fail: measured says the loop is negligible
+    t.measured_subphases = {"round_loop_fraction": 0.06}
+    findings = SubphaseLedgerRule().check([t])
+    assert any("reconcile" in f.snippet for f in findings)
+    # unit contract: floor + ratio semantics
+    assert costmodel.reconcile(0.03, 0.04)["ok"]  # both below floor
+    assert costmodel.reconcile(0.9, 0.5)["ok"]    # 1.8x < tolerance
+    assert not costmodel.reconcile(0.9, 0.05)["ok"]
+
+
+# ---- measured half: dump parsing + self-time table ----
+
+_FAKE_DUMP = textwrap.dedent("""\
+    HloModule jit_kernel, entry_computation_layout={()->f32[4]}
+
+    %fused_computation (p: f32[4]) -> f32[4] {
+      ROOT %mul.1 = f32[4] multiply(%p, %p), metadata={op_name="jit(k)/jit(main)/round_loop/repair/mul"}
+    }
+
+    ENTRY %main () -> f32[4] {
+      %dot.5 = f32[4,4] dot(%a, %a), metadata={op_name="jit(k)/jit(main)/hoist/dot_general"}
+      %while.9 = (s32[], f32[4]) while(%tuple.1), condition=%cond, body=%body
+      %fusion.2 = f32[4] fusion(%dot.5), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(k)/jit(main)/round_loop/repair/mul"}
+      ROOT %add.3 = f32[4] add(%fusion.2, %fusion.2), metadata={op_name="jit(k)/jit(main)/commit/add"}
+    }
+""")
+
+
+def _fake_profile(tmp_path):
+    hlo = tmp_path / "hlo"
+    hlo.mkdir()
+    (hlo / "module_0001.jit_kernel.cpu_after_optimizations.txt").write_text(
+        _FAKE_DUMP)
+    events = [
+        {"module": "jit_kernel", "op": "dot.5", "ts_us": 0.0, "dur_us": 10.0},
+        {"module": "jit_kernel", "op": "while.9", "ts_us": 10.0,
+         "dur_us": 80.0},  # container envelope — must not be charged
+        {"module": "jit_kernel", "op": "fusion.2", "ts_us": 12.0,
+         "dur_us": 60.0},
+        {"module": "jit_kernel", "op": "add.3", "ts_us": 95.0, "dur_us": 30.0},
+        {"module": "jit_other", "op": "dot.1", "ts_us": 0.0, "dur_us": 500.0},
+    ]
+    return str(hlo), events
+
+
+def test_subphase_table_from_fixture_dump(tmp_path):
+    hlo_dir, events = _fake_profile(tmp_path)
+    op_map = parse_hlo_dumps(hlo_dir)
+    assert op_map["jit_kernel"]["while.9"] is None  # container detected
+    # the fused computation's interior line must not shadow entry ops
+    table = subphase_table(events, op_map)
+    # jit_other has no declared scopes: out of scope entirely
+    assert table["kernel_modules"] == ["jit_kernel"]
+    subs = table["subphases"]
+    total = 10.0 + 60.0 + 30.0  # leaves only; while.9 excluded
+    assert subs["hoist"]["fraction"] == pytest.approx(10 / total, abs=1e-3)
+    assert subs["repair"]["fraction"] == pytest.approx(60 / total, abs=1e-3)
+    assert subs["commit"]["fraction"] == pytest.approx(30 / total, abs=1e-3)
+    assert sum(d["fraction"] for d in subs.values()) == \
+        pytest.approx(1.0, abs=0.01)
+    assert table["round_loop_fraction"] == pytest.approx(60 / total, abs=1e-3)
+    assert not table["incomplete"]
+    # no events at all -> incomplete, never a vacuous clean table
+    assert subphase_table([], op_map)["incomplete"]
+
+
+def test_merge_profile_spans_nests_under_device_step(tmp_path):
+    from kubernetes_tpu.scheduler.tracing import Span, TraceCollector
+
+    hlo_dir, events = _fake_profile(tmp_path)
+    op_map = parse_hlo_dumps(hlo_dir)
+    col = TraceCollector()
+    anchor = Span("device.step", component="pipeline", start=100.0)
+    anchor.finish(101.0)
+    col.add(anchor)
+    n = merge_profile_spans(col, events, op_map)
+    assert n == 3  # leaves of the kernel module only
+    children = [s for s in col.spans() if s.name.startswith("device.")
+                and s.name != "device.step"]
+    assert {s.name for s in children} == {
+        "device.hoist", "device.repair", "device.commit"}
+    assert all(s.parent_id == anchor.span_id for s in children)
+    assert all(s.trace_id == anchor.trace_id for s in children)
+
+
+def test_attribution_nests_device_subphases():
+    from kubernetes_tpu.scheduler.attribution import (
+        attribute_spans, render_attribution,
+    )
+    from kubernetes_tpu.scheduler.tracing import Span
+
+    sp = Span("device.step", start=0.0)
+    sp.finish(1.0)
+    table = {
+        "subphases": {"repair": {"seconds": 0.9, "fraction": 0.9},
+                      "hoist": {"seconds": 0.1, "fraction": 0.1}},
+        "round_loop_fraction": 0.9, "dominant": "round_loop",
+        "n_ops": 2, "kernel_modules": ["jit_kernel"], "total_s": 1.0,
+        "incomplete": False,
+    }
+    rep = attribute_spans([sp], spans_dropped=0, device_subphases=table)
+    assert rep["device_subphases"] is table
+    text = render_attribution(rep)
+    # nested under device_kernel, not a separate table
+    dk = text.index("device_kernel")
+    assert "  . repair" in text and text.index("  . repair") > dk
+    assert "round_loop(all)" in text
+
+
+# ---- queue-pool depth observability (satellite) ----
+
+def test_queue_pool_depths_and_artifact_fields():
+    from kubernetes_tpu.bench.harness import queue_fields
+    from kubernetes_tpu.scheduler.metrics import Metrics
+    from kubernetes_tpu.scheduler.queue import FakeClock, PriorityQueue
+    from helpers import mk_pod
+
+    q = PriorityQueue(clock=FakeClock())
+    for i in range(3):
+        q.add(mk_pod(f"d{i}"))
+    p_backoff = q.pop()
+    q.add_unschedulable(p_backoff, backoff=True)
+    p_parked = q.pop()
+    q.add_unschedulable(p_parked, {"Node/Add"}, backoff=True)
+    d = q.depths()
+    assert d == {"active": 1, "backoff": 1, "unschedulable": 1, "parked": 2}
+    m = Metrics()
+    for pool, v in d.items():
+        m.set(f"queue_pool_{pool}_pods", v)
+        m.set_max(f"queue_pool_{pool}_pods_peak", v)
+    m.set_max("queue_pool_active_pods_peak", 7)  # a later, deeper sample
+    m.set_max("queue_pool_active_pods_peak", 2)  # never lowers
+    qf = queue_fields(m)["queue_depths"]
+    assert qf["active"] == {"final": 1, "peak": 7}
+    assert qf["parked"] == {"final": 2, "peak": 2}
+
+
+def test_scheduler_samples_queue_depth_gauges():
+    from kubernetes_tpu.scheduler import (
+        ClusterStore, Scheduler, SchedulerConfiguration,
+    )
+    from helpers import mk_node, mk_pod
+
+    store = ClusterStore()
+    store.add_node(mk_node("n1"))
+    sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+    for i in range(4):
+        store.add_pod(mk_pod(f"p{i}"))
+    sched.run_until_idle()
+    _c, gauges, _h = sched.metrics.snapshot()
+    assert gauges.get("queue_pool_active_pods_peak", 0) >= 4
+    assert gauges.get("queue_pool_active_pods") == 0  # drained at idle
+
+
+# ---- named-scope parity: annotation changes nothing (satellite) ----
+
+_PARITY_PROG = """
+import json, os, sys
+os.environ["KTPU_FORCE_CHUNKED"] = "1"
+import numpy as np
+from kubernetes_tpu.bench import workloads
+from kubernetes_tpu.api.delta import DeltaEncoder
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.ops import assign as A
+from kubernetes_tpu.ops.incremental import HoistCache
+
+out = {}
+for kind in ("chunked", "rounds", "inc"):
+    snap = (workloads.spread_affinity(16, 48, seed=5) if kind == "rounds"
+            else workloads.heterogeneous(16, 120, seed=5))
+    for donate in (False, True):
+        enc = DeltaEncoder()
+        arr, meta = enc.encode(snap)
+        cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+        inc = None
+        if kind == "inc":
+            inc = HoistCache().ensure(arr, meta, cfg)
+        pre = dict(A.TRACE_COUNTS)
+        c, u = A.schedule_batch_routed(arr, cfg, donate=donate, inc=inc)
+        delta = {k: A.TRACE_COUNTS[k] - pre[k] for k in pre
+                 if A.TRACE_COUNTS[k] != pre[k]}
+        out[f"{kind}/{donate}"] = {
+            "choices": np.asarray(c).tolist(),
+            "trace_delta": delta,
+        }
+print(json.dumps(out))
+"""
+
+
+def test_named_scope_annotation_changes_nothing():
+    """KTPU_NAMED_SCOPES=0 vs the default across {chunked, rounds, inc} x
+    {donate on/off}: bit-identical placements AND identical TRACE_COUNTS
+    route deltas — the scopes are metadata, never program structure.  Both
+    settings run in fresh subprocesses (the knob is read at trace time, so
+    flipping it against a warm jit cache would be vacuous)."""
+    outs = []
+    for scopes in ("1", "0"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   KTPU_NAMED_SCOPES=scopes)
+        r = subprocess.run(
+            [sys.executable, "-c", _PARITY_PROG], env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        outs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    on, off = outs
+    assert on.keys() == off.keys()
+    for key in on:
+        assert on[key]["choices"] == off[key]["choices"], key
+        assert on[key]["trace_delta"] == off[key]["trace_delta"], key
+
+
+# ---- profile-capture smoke on the forced 8-device CPU platform ----
+
+def test_profile_capture_smoke(tmp_path):
+    """`bench.harness --stream 1 --profile` in a fresh subprocess (XLA
+    parses dump flags once per process) on the forced 8-device CPU
+    platform: the artifact must carry a sub-phase table whose fractions
+    sum to 1.0 within device_kernel and a passing reconciliation."""
+    prof = tmp_path / "prof"
+    out = tmp_path / "out.json"
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", KTPU_STREAM_SHAPE="256x64",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.bench.harness",
+         "--stream", "1", "--profile", str(prof), "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    table = doc["device_subphases"]
+    assert not table["incomplete"]
+    assert sum(d["fraction"] for d in table["subphases"].values()) == \
+        pytest.approx(1.0, abs=0.02)
+    assert doc["subphase_reconciliation"]["ok"], doc["subphase_reconciliation"]
+    assert doc["round_loop_fraction"] > 0.2  # the loop is the story
+    assert doc["device_flops"] > 0 and doc["device_hbm_bytes"] > 0
+
+
+# ---- the production routes carry ledgers (cached single route) ----
+
+def test_traced_route_carries_cost_ledger():
+    from kubernetes_tpu.analysis.devicecheck import RouteSpec, trace_route
+
+    os.environ["KTPU_FORCE_CHUNKED"] = "1"
+    try:
+        t = trace_route(RouteSpec("chunked", False, 1))
+    finally:
+        os.environ.pop("KTPU_FORCE_CHUNKED", None)
+    assert t.cost is not None
+    assert t.cost["round_loop_fraction"] > 0.5
+    assert t.cost["dominant"] == "round_loop"
+    assert t.cost["heavy_unowned"] == []
+    assert t.to_dict()["cost"]["total_flops"] > 0

@@ -428,10 +428,13 @@ def test_cli_device_and_shard_share_one_trace(monkeypatch, capsys):
 
 
 def test_cli_typoed_shard_rule_id_refused():
+    # KTPU099 does not exist (KTPU019 became the device cost observatory's
+    # sub-phase ledger rule): a typoed id must refuse, never select zero
+    # rules and exit 0
     from kubernetes_tpu.analysis import __main__ as cli
 
     with pytest.raises(SystemExit) as ei:
-        cli.main(["--rules", "KTPU015,KTPU019"])
+        cli.main(["--rules", "KTPU015,KTPU099"])
     assert ei.value.code == 2
 
 
