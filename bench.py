@@ -323,9 +323,27 @@ def main() -> None:
 
     attribution = attribute_spans(collector)
     print(render_attribution(attribution), file=sys.stderr)
-    from kubernetes_tpu.bench.harness import sli_fields
+    from kubernetes_tpu.bench.harness import memwatch_fields, sli_fields
 
     sli = sli_fields(metrics)
+    # HBM telemetry (scheduler/memwatch.py): the loop's ledger sampled
+    # every cycle boundary — measured peak / resident census stamped
+    # top-level (hbm_peak_bytes is regression-gated like step_s) and the
+    # sentinel verdict rides the memwatch block.  ONE stamping contract
+    # shared with --stream (harness.memwatch_fields); bench sizes
+    # per_shard_hbm_bytes exactly from the encoded arr dims below, so the
+    # census-derived variant is dropped in favor of it.
+    mem_fields = memwatch_fields(loop, metrics, n_shards)
+    mem_fields.pop("per_shard_hbm_bytes", None)
+    per_shard_hbm = shard_hbm_estimate(
+        arr.P, arr.N, n_shards, arr.R,
+        n_terms=arr.term_counts0.shape[0],
+    )["total"]
+    # the PR-4 scale-out numbers as LIVE gauges, not just artifact fields
+    # (unconditional — scale-out facts outlive a KTPU_MEMWATCH=0 run):
+    # a /metrics scrape (KTPU_METRICS) sees the same story the JSON tells
+    metrics.set("n_shards", n_shards)
+    metrics.set("per_shard_hbm_bytes", per_shard_hbm)
 
     scheduled = int((choices[: meta.n_pods] >= 0).sum())
     # steady-state cycles: submit walls once the pipeline is full (each
@@ -386,10 +404,11 @@ def main() -> None:
                 # mesh scale-out: shard count + the per-shard HBM estimate
                 # of the kernel's dominant blocks at this shape
                 "n_shards": n_shards,
-                "per_shard_hbm_bytes": shard_hbm_estimate(
-                    arr.P, arr.N, n_shards, arr.R,
-                    n_terms=arr.term_counts0.shape[0],
-                )["total"],
+                "per_shard_hbm_bytes": per_shard_hbm,
+                # measured HBM telemetry: hbm_peak_bytes /
+                # hbm_resident_bytes + the memwatch sentinel block
+                # (scheduler/memwatch.py; KTPU_MEMWATCH=0 omits)
+                **mem_fields,
                 # which kernel the routed call actually compiled (trace-time
                 # proof; the fallback must exercise the production route)
                 "route_trace_counts": dict(_trace_counts()),

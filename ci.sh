@@ -4,19 +4,24 @@
 # means.  Four stages, fail-fast:
 #
 #   1. tier-1 tests        the ROADMAP.md tier-1 command (not slow, 870 s cap)
-#   2. ktpu-verify         AST + device + shard passes (KTPU001–019, the
-#                          device cost observatory's KTPU019 sub-phase
-#                          ledger gate included) — the verify stack PRs
-#                          8–10 built, gated on every push
-#   3. --profile smoke     the device cost observatory end to end in a
-#                          fresh process (bench.harness --stream --profile):
-#                          sub-phase capture + analytic reconciliation must
-#                          pass (the harness exits 1 on either failure)
+#   2. ktpu-verify         AST + device + shard + mem passes (KTPU001–020:
+#                          the device cost observatory's KTPU019 sub-phase
+#                          ledger AND the HBM telemetry plane's KTPU020
+#                          measured-vs-analytic reconciliation — leak
+#                          sentinel clean + census==size-model on all
+#                          twelve routes, on the forced 8-device platform)
+#   3. --profile smoke     the device cost observatory + memwatch end to
+#                          end in a fresh process (bench.harness --stream
+#                          --profile): sub-phase capture + analytic
+#                          reconciliation must pass AND the stream's leak
+#                          sentinel must be clean (the harness exits 1 on
+#                          any of the three failures)
 #   4. regression gates    bench/regression.py over the BENCH_r*.json
 #                          trajectory (same-platform comparison only), plus
 #                          the observatory's round_loop_fraction /
-#                          device_flops / device_hbm_bytes scalars from the
-#                          stage-3 artifact
+#                          device_flops / device_hbm_bytes scalars and the
+#                          memwatch plane's measured hbm_peak_bytes from
+#                          the stage-3 artifact
 #
 # Exit non-zero on the first failing stage.  .github/workflows/ci.yml runs
 # exactly this script.
@@ -35,23 +40,28 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
-echo "=== [2/4] ktpu-verify (AST + device + shard, incl. KTPU019) ==="
-JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard || {
+echo "=== [2/4] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
+JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard --mem || {
   rc=$?
   echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
   exit "$rc"
 }
 
-echo "=== [3/4] device cost observatory smoke (--profile) ==="
+echo "=== [3/4] device cost observatory + memwatch smoke (--profile) ==="
 # fresh process (XLA parses dump flags once); reduced stream shape so the
-# smoke prices the capture path, not the full BENCH scale
+# smoke prices the capture path, not the full BENCH scale.  The stream's
+# artifact also carries the memwatch block: the harness exits 1 when the
+# leak sentinel trips, so this stage is the memwatch smoke too.
 rm -rf /tmp/ktpu-ci-profile
+# --stream 3, not 2: the sentinel needs >= 3 samples (SENTINEL_MIN_SAMPLES)
+# before it may call a monotone rise a leak — a 2-wave stream could never
+# trip the exit-1 gate this stage exists for
 JAX_PLATFORMS=cpu KTPU_STREAM_SHAPE=512x128 \
-  python -m kubernetes_tpu.bench.harness --stream 2 \
+  python -m kubernetes_tpu.bench.harness --stream 3 \
   --profile /tmp/ktpu-ci-profile --out /tmp/KTPU_CI_PROFILE.json \
   > /dev/null || {
   rc=$?
-  echo "ci: --profile smoke failed (rc=$rc; capture or reconciliation)" >&2
+  echo "ci: --profile/memwatch smoke failed (rc=$rc; capture, reconciliation, or leak sentinel)" >&2
   exit "$rc"
 }
 
@@ -73,5 +83,6 @@ run_gate
 run_gate --metric round_loop_fraction --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric device_flops --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric device_hbm_bytes --current /tmp/KTPU_CI_PROFILE.json
+run_gate --metric hbm_peak_bytes --current /tmp/KTPU_CI_PROFILE.json
 
 echo "CI green"

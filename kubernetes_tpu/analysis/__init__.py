@@ -6,9 +6,11 @@ enforces the codebase's own invariants (PARITY.md prose rules turned into
 rule ids KTPU001..KTPU006 + KTPU013 at the AST layer, KTPU007..KTPU012 at
 the jaxpr/compiled-kernel layer — devicecheck.py/jaxrules.py, and
 KTPU014..KTPU018 at the sharding layer — shardcheck.py over the
-declarative partition rule table in parallel/partition_rules.py), with a
-baseline-suppression file and the 0/1/2 exit-code contract.
-`--device --shard` is the full verify gate (one shared 12-route trace).
+declarative partition rule table in parallel/partition_rules.py, and
+KTPU020 at the device-memory layer — memrules.py over the live ledger in
+scheduler/memwatch.py), with a baseline-suppression file and the 0/1/2
+exit-code contract.  `--device --shard --mem` is the full verify gate
+(one shared 12-route trace).
 
 Only the runtime lock-check factories are exported at package level — the
 scheduler's hot modules import them at construction time, so this __init__

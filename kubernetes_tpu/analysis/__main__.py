@@ -9,12 +9,15 @@ and gates on the baseline.
   python -m kubernetes_tpu.analysis --lock-graph         # dump KTPU006 graph
   python -m kubernetes_tpu.analysis --device             # + device pass
   python -m kubernetes_tpu.analysis --shard              # + shard pass
-  python -m kubernetes_tpu.analysis --device --shard     # the full verify
+  python -m kubernetes_tpu.analysis --mem                # + mem pass
+  python -m kubernetes_tpu.analysis --device --shard --mem
+                                                         # the full verify
                                                          # gate (one trace)
   python -m kubernetes_tpu.analysis --rules KTPU007,KTPU008,KTPU009,KTPU010,KTPU011,KTPU012
                                                          # device pass only
   python -m kubernetes_tpu.analysis --rules KTPU014,KTPU015,KTPU016,KTPU017,KTPU018
                                                          # shard pass only
+  python -m kubernetes_tpu.analysis --rules KTPU020      # mem pass only
 
 Exit-code contract (bench/regression.py's): 0 clean (all findings
 baselined), 1 unbaselined findings, 2 unusable (parse failure, malformed
@@ -58,22 +61,24 @@ def resolve_root(root: str) -> str:
 
 
 def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None,
-               device: bool = False, shard: bool = False):
+               device: bool = False, shard: bool = False, mem: bool = False):
     """The shared gate: load the committed baseline and run the full pass —
     the AST rules, plus the DEVICE pass (KTPU007..012, devicecheck.py)
     when `device` is set, plus the SHARD pass (KTPU014..018, shardcheck.py)
-    when `shard` is set — the two trace passes share one 12-route trace.
-    Used by this CLI and by `bench.harness --verify[-device|-shard]`, so
+    when `shard` is set, plus the MEM pass (KTPU020, memrules.py) when
+    `mem` is set — the trace passes share one 12-route trace.  Used by
+    this CLI and by `bench.harness --verify[-device|-shard|-mem]`, so
     every exit follows ONE contract.  Raises BaselineError (exit 2) on an
     unusable baseline."""
     from .engine import Baseline, analyze_package, apply_baseline
 
     baseline = Baseline.load(baseline_path or default_baseline())
+    any_trace = device or shard or mem
     report = analyze_package(resolve_root(root or default_root()),
-                             baseline=None if (device or shard) else baseline)
-    if device or shard:
+                             baseline=None if any_trace else baseline)
+    if any_trace:
         pretraced = None
-        if device and shard:
+        if sum((device, shard, mem)) >= 2:
             from .devicecheck import collect_traces
 
             pretraced = collect_traces()
@@ -94,6 +99,15 @@ def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None,
             report.rules = report.rules + shd.rules
             if shd.device is not None:
                 report.device = shd.device
+        if mem:
+            from .memrules import run_mem_pass
+
+            mm = run_mem_pass(baseline=None, pretraced=pretraced)
+            report.findings.extend(mm.findings)
+            report.errors.extend(mm.errors)
+            report.rules = report.rules + mm.rules
+            if mm.device is not None:
+                report.device = mm.device
         report.errors = list(dict.fromkeys(report.errors))
         apply_baseline(report, baseline)
     return report
@@ -102,6 +116,7 @@ def run_verify(root: Optional[str] = None, baseline_path: Optional[str] = None,
 def main(argv=None) -> int:
     from .engine import Baseline, BaselineError, analyze_package, apply_baseline
     from .jaxrules import DEVICE_RULE_IDS
+    from .memrules import MEM_RULE_IDS
     from .rules import ALL_RULES
     from .shardcheck import SHARD_RULE_IDS
 
@@ -123,7 +138,7 @@ def main(argv=None) -> int:
                     help="comma-separated rule ids to run (default: all AST "
                          "rules; naming a KTPU007..012 id also runs the "
                          "device pass for it, a KTPU014..018 id the shard "
-                         "pass)")
+                         "pass, KTPU020 the mem pass)")
     ap.add_argument("--device", action="store_true",
                     help="also run the device pass (KTPU007..012 — trace "
                          "every production kernel route and check the "
@@ -136,6 +151,15 @@ def main(argv=None) -> int:
                          "comm-reconciliation / out-sharding gates over "
                          "the traced routes; shares the route traces with "
                          "--device, so --device --shard traces once)")
+    ap.add_argument("--mem", action="store_true",
+                    help="also run the mem pass (KTPU020 — the HBM "
+                         "telemetry plane's measured-vs-analytic "
+                         "reconciliation over the traced routes: live "
+                         "peak within tolerance of shard_hbm_estimate, "
+                         "resident census == the FIELD_DIMS size model, "
+                         "leak sentinel clean; analysis/memrules.py; "
+                         "shares the route traces with --device/--shard, "
+                         "so --device --shard --mem traces once)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write a draft baseline covering every unbaselined "
                          "finding (reasons left TODO — fill them in)")
@@ -168,10 +192,12 @@ def main(argv=None) -> int:
     lockorder = True
     device_ids = list(DEVICE_RULE_IDS) if args.device else []
     shard_ids = list(SHARD_RULE_IDS) if args.shard else []
+    mem_ids = list(MEM_RULE_IDS) if args.mem else []
     if args.rules:
         want = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
         known = ({r.rule_id for r in rules} | {"KTPU006"}
-                 | set(DEVICE_RULE_IDS) | set(SHARD_RULE_IDS))
+                 | set(DEVICE_RULE_IDS) | set(SHARD_RULE_IDS)
+                 | set(MEM_RULE_IDS))
         unknown = sorted(want - known)
         if unknown:
             # a typoed id would otherwise select ZERO rules and exit 0 —
@@ -180,12 +206,14 @@ def main(argv=None) -> int:
                      f"(known: {', '.join(sorted(known))})")
         rules = [r for r in rules if r.rule_id in want]
         lockorder = "KTPU006" in want  # --rules subsets really subset
-        # --device/--shard UNION with a --rules subset: an AST-only subset
-        # must not silently drop a pass the flag explicitly requested
+        # --device/--shard/--mem UNION with a --rules subset: an AST-only
+        # subset must not silently drop a pass the flag explicitly requested
         named = [r for r in DEVICE_RULE_IDS if r in want]
         device_ids = named or device_ids
         named_shard = [r for r in SHARD_RULE_IDS if r in want]
         shard_ids = named_shard or shard_ids
+        named_mem = [r for r in MEM_RULE_IDS if r in want]
+        mem_ids = named_mem or mem_ids
 
     baseline = None
     if not args.no_baseline:
@@ -202,16 +230,17 @@ def main(argv=None) -> int:
         report = analyze_package(args.root, rules=rules, baseline=None,
                                  lockorder=lockorder)
     else:
-        # a pure device/shard-rule subset (--rules KTPU007,... /
-        # KTPU014,...) skips the package AST walk entirely — subsets
-        # really subset (KTPU014 scans modules inside its own pass)
+        # a pure device/shard/mem-rule subset (--rules KTPU007,... /
+        # KTPU014,... / KTPU020) skips the package AST walk entirely —
+        # subsets really subset (KTPU014 scans modules inside its own pass)
         from .engine import Report
 
         report = Report(rules=[])
-    # --device and --shard share ONE 12-route trace when both will trace
+    # the trace passes share ONE 12-route trace when two or more will trace
     pretraced = None
     shard_traces = any(r != "KTPU014" for r in shard_ids)
-    if device_ids and shard_traces:
+    n_tracing = sum((bool(device_ids), shard_traces, bool(mem_ids)))
+    if n_tracing >= 2:
         from .devicecheck import collect_traces
 
         pretraced = collect_traces()
@@ -239,7 +268,18 @@ def main(argv=None) -> int:
         report.files_scanned = max(report.files_scanned, shd.files_scanned)
         if shd.device is not None:
             report.device = shd.device
-    # shared traces surface the same trace errors in both passes — dedupe
+    if mem_ids:
+        from .memrules import run_mem_pass
+
+        mm = run_mem_pass(rule_ids=mem_ids, baseline=None,
+                          pretraced=pretraced)
+        report.findings.extend(mm.findings)
+        report.errors.extend(mm.errors)
+        report.rules = report.rules + mm.rules
+        report.files_scanned = max(report.files_scanned, mm.files_scanned)
+        if mm.device is not None:
+            report.device = mm.device
+    # shared traces surface the same trace errors in every pass — dedupe
     report.errors = list(dict.fromkeys(report.errors))
     report = apply_baseline(report, baseline)
 

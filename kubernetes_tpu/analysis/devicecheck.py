@@ -120,6 +120,12 @@ class RouteTrace:
     # a measured sub-phase table (bench/profiling.py) when one exists for
     # this route — KTPU019 reconciles the two round-loop shares
     measured_subphases: Optional[Dict[str, Any]] = None
+    # ---- HBM telemetry plane (scheduler/memwatch.py, KTPU020) ----
+    # the per-route memory block: measured live peak vs the analytic
+    # budget, the resident-buffer census vs the FIELD_DIMS model, the
+    # leak-sentinel verdict across the warm loop, memory_stats
+    # availability.  Every traced route must carry one (fail closed).
+    mem: Optional[Dict[str, Any]] = None
 
     def capture(self, jaxpr_fn, jaxpr_args, jitted_fn, lower_args):
         """Fill the program-capture fields — jaxpr + collective walk,
@@ -198,6 +204,9 @@ class RouteTrace:
             # the analytic roofline ledger (costmodel.py — the KTPU019
             # evidence; every traced route must carry one)
             "cost": self.cost,
+            # the HBM telemetry block (memwatch.py — the KTPU020
+            # evidence; every traced route must carry one)
+            "mem": self.mem,
         }
 
 
@@ -482,6 +491,20 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
     enc = DeltaEncoder()
     cache = HoistCache(mesh=mesh) if spec.kind == "inc" else None
 
+    # the HBM telemetry ledger (scheduler/memwatch.py): baseline the
+    # measured side BEFORE this route allocates anything, so earlier
+    # routes' leftovers never count against it; cycle samples land after
+    # the cold step and each warm step, and the assembled per-route `mem`
+    # block is what KTPU020 (analysis/memrules.py) reconciles.  The
+    # tracer deliberately ignores KTPU_MEMWATCH (the RUNTIME plane's kill
+    # switch): KTPU020 fails closed on a route without a memory block, so
+    # a verify run must always meter — lost coverage is never a pass.
+    from ..scheduler.memwatch import DeviceMemoryLedger
+
+    ledger = DeviceMemoryLedger(mesh=mesh)
+    ledger.baseline()
+    mem_samples: List[Dict[str, Any]] = []
+
     arr, meta = enc.encode(snap)
     cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
     want_chunked = spec.kind in ("chunked", "inc")
@@ -567,6 +590,8 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
             a_dev, cfg_c, donate=spec.donate, mesh=mesh, inc=inc_state)
 
     choices, _used = call(arr_dev, cfg, inc)
+    mem_samples.append(ledger.cycle_sample(
+        arr=arr_dev, inc=inc, hoist=cache, label="cold"))
     size0 = _cache_size(fn)
     warm_texts: List[str] = []
     retraces = 0
@@ -620,6 +645,12 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
         retraces += sum(
             A.TRACE_COUNTS[k] - pre_counts[k] for k in pre_counts)
         last_size = _cache_size(fn_w)
+        # cycle-boundary memory sample (outside the transfer guard — the
+        # ledger only reads buffer metadata, never values): donated waves'
+        # consumed inputs drop out of the census here, which is exactly
+        # the "donation retires the buffer" invariant the sentinel checks
+        mem_samples.append(ledger.cycle_sample(
+            arr=aw_dev, inc=inc_w, hoist=cache, label=f"warm{cyc}"))
         choices_w = np.asarray(out[0])
         cur = _bind_warm_delta(cur, meta_w, choices_w, cyc)
     t.warm = {
@@ -627,6 +658,35 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
         "retraces": retraces,
         "cache_growth": max(0, last_size - size0),
         "lowered_stable": warm_texts[0] == warm_texts[1],
+    }
+    # ---- the per-route memory block (KTPU020's evidence) ----
+    # measured: the ledger's live high-water delta (memory_stats peak on
+    # backends exposing it, live-array bytes otherwise — the source is
+    # recorded either way, never silently substituted); analytic: the
+    # SAME shard_hbm_estimate budget KTPU012 reconciles, globalized
+    # (per-shard total x shards — the live-array measure is process-
+    # global logical bytes).  The census ships totals + any UNMATCHED
+    # entries (matched ones need no enumeration in the artifact).
+    census = ledger.last_census or {}
+    t.mem = {
+        "measured_peak_bytes": ledger.hbm_peak_bytes(),
+        "analytic_budget_bytes": int(
+            (t.est or {}).get("total", 0)) * max(1, spec.n_shards),
+        "source": ledger.source(),
+        "memory_stats_available": ledger.memory_stats_available,
+        "census": {
+            "matched": ledger.census_matched,
+            "resident_bytes": census.get("resident_bytes", 0),
+            "per_shard_bytes": census.get("per_shard_bytes", 0),
+            "model_bytes": census.get("model_bytes", 0),
+            "n_buffers": census.get("n_buffers", 0),
+            # every unmatched entry SEEN ACROSS THE RUN (matched is an
+            # AND over all samples — a transient cold-cycle drift must
+            # ship its offending qualname, not an empty list)
+            "entries": list(ledger.census_unmatched.values()),
+        },
+        "sentinel": ledger.sentinel.verdict(),
+        "samples": mem_samples,
     }
     return t
 

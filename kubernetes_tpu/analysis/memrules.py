@@ -1,0 +1,170 @@
+"""ktpu-verify mem pass — KTPU020: measured-vs-analytic HBM reconciliation.
+
+KTPU012 reconciles the COMPILED memory analysis against the analytic
+per-shard budget; this pass reconciles the MEASURED side — the live
+device-memory ledger (scheduler/memwatch.py) sampled across each traced
+route's warm loop — against the same budget, and gates the ledger's own
+invariants:
+
+  KTPU020 mem-reconcile   every traced route must carry a memory block
+                          (fail closed — a route the ledger could not
+                          meter is lost coverage, the KTPU013 shape), its
+                          resident-buffer census must equal the
+                          FIELD_DIMS size model per buffer (the ledger
+                          and shard_hbm_estimate share one model — a
+                          mismatch is drift), its measured live peak must
+                          stay within MEM_TOLERANCE x the analytic
+                          shard_hbm_estimate budget, and its leak
+                          sentinel must be clean (unaccounted live bytes
+                          rising monotonically across the warm cycles —
+                          a retained retired buffer — is exit 1).
+                          memory_stats-less backends are recorded on the
+                          route block (source: live_arrays), never
+                          silently passed as a device measurement.
+
+Rides the twelve-route tracer (analysis/devicecheck.py — collect_traces;
+`--device --shard --mem` unions share ONE trace) and the engine's
+fingerprint/baseline/0-1-2 exit contract.  Fixture tests build synthetic
+RouteTrace mem blocks (an injected leak, a census drift) and pin exit 1.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .engine import Baseline, Finding, Report
+
+# measured live peak may exceed the analytic per-route budget by at most
+# this factor (stated tolerance — the budget models the dominant blocks;
+# same contract as jaxrules.HBM_TOLERANCE / shardcheck.COMM_TOLERANCE)
+MEM_TOLERANCE = 4.0
+
+
+class MemTraceRule:
+    """Base shape shared with jaxrules.DeviceRule / shardcheck trace rules:
+    check(traces) over the full RouteTrace list."""
+
+    rule_id = "KTPU000"
+    title = ""
+
+    def check(self, traces: Sequence) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _route_finding(trace, rule_id: str, message: str, detail: str) -> Finding:
+    """Route-anchored finding (fingerprint = rule | route file | route name
+    | detail — survives kernel edits that keep the violated property)."""
+    return Finding(
+        rule=rule_id, message=message, file=trace.file, line=0,
+        func=trace.name, snippet=detail,
+    )
+
+
+class MemReconcileRule(MemTraceRule):
+    """KTPU020 — the four gates, per traced route (see module docstring):
+    block present, census == FIELD_DIMS model, measured peak within
+    MEM_TOLERANCE x the analytic budget, sentinel clean."""
+
+    rule_id = "KTPU020"
+    title = "mem-reconcile: measured HBM peak within the analytic budget; " \
+            "census matches the size model; leak sentinel clean"
+
+    def check(self, traces: Sequence) -> List[Finding]:
+        findings: List[Finding] = []
+        for t in traces:
+            if t.status != "traced":
+                continue
+            mem = getattr(t, "mem", None)
+            if not mem:
+                # fail CLOSED: a traced route without a memory block means
+                # the ledger never metered it — lost coverage, not a pass
+                findings.append(_route_finding(
+                    t, self.rule_id,
+                    "traced route carries no memory block — the device-"
+                    "memory ledger did not meter it (lost coverage, the "
+                    "KTPU013 fail-closed shape)",
+                    "no-mem-block",
+                ))
+                continue
+            census = mem.get("census") or {}
+            if census.get("matched") is False:
+                bad = [e["qualname"] for e in census.get("entries", [])
+                       if not e.get("matched")]
+                findings.append(_route_finding(
+                    t, self.rule_id,
+                    "resident-buffer census diverged from the FIELD_DIMS "
+                    f"size model ({', '.join(bad[:4]) or '?'}) — the "
+                    "ledger and shard_hbm_estimate no longer share one "
+                    "size model",
+                    "census-model-drift",
+                ))
+            measured = int(mem.get("measured_peak_bytes") or 0)
+            budget = int(mem.get("analytic_budget_bytes") or 0)
+            if budget and measured > MEM_TOLERANCE * budget:
+                findings.append(_route_finding(
+                    t, self.rule_id,
+                    f"measured live-memory peak {measured} B exceeds "
+                    f"{MEM_TOLERANCE}x the analytic budget {budget} B "
+                    f"(source: {mem.get('source', '?')}) — the measured "
+                    "HBM ceiling no longer reconciles with "
+                    "shard_hbm_estimate",
+                    f"mem:{measured}>{MEM_TOLERANCE}x{budget}",
+                ))
+            sentinel = mem.get("sentinel") or {}
+            if sentinel.get("leaking"):
+                findings.append(_route_finding(
+                    t, self.rule_id,
+                    "leak sentinel: unaccounted live device bytes grew "
+                    "monotonically across the warm cycles "
+                    f"(growth {sentinel.get('growth_bytes', '?')} B > "
+                    f"slack {sentinel.get('slack_bytes', '?')} B) — a "
+                    "retired buffer is being retained",
+                    "sentinel-leak",
+                ))
+        return findings
+
+
+ALL_MEM_RULES = [MemReconcileRule]
+
+MEM_RULE_IDS = tuple(r.rule_id for r in ALL_MEM_RULES)
+
+
+def run_mem_pass(rule_ids: Optional[Sequence[str]] = None,
+                 baseline: Optional[Baseline] = None,
+                 mesh_size: int = 8,
+                 pretraced: Optional[Tuple[list, List[str]]] = None,
+                 ) -> Report:
+    """Run the (selected) mem rules over the twelve production routes
+    (devicecheck.collect_traces — shared with the device/shard passes via
+    `pretraced`, so `--device --shard --mem` traces once).  Same report/
+    fingerprint/baseline/exit contract as the other passes; a route that
+    fails to trace is an ERROR (exit 2), never a silent skip."""
+    from .engine import apply_baseline
+
+    rules = [cls() for cls in ALL_MEM_RULES]
+    if rule_ids is not None:
+        want = {r.upper() for r in rule_ids}
+        rules = [r for r in rules if r.rule_id in want]
+    report = Report(rules=[r.rule_id for r in rules])
+    if pretraced is not None:
+        traces, trace_errors = pretraced
+    else:
+        from .devicecheck import collect_traces
+
+        traces, trace_errors = collect_traces(mesh_size)
+    report.errors.extend(trace_errors)
+    n_traced = sum(1 for t in traces if t.status == "traced")
+    report.files_scanned = n_traced
+    for r in rules:
+        try:
+            report.findings.extend(r.check(traces))
+        except Exception as e:  # a rule bug must not pass as "clean"
+            report.errors.append(
+                f"mem rule {r.rule_id} crashed: {type(e).__name__}: {e}")
+    report.device = {
+        "routes": [t.to_dict() for t in traces],
+        "n_traced": n_traced,
+        "n_skipped": sum(1 for t in traces if t.status == "skipped"),
+    }
+    apply_baseline(report, baseline)
+    return report

@@ -254,6 +254,28 @@ def queue_fields(metrics) -> Dict:
     return {"queue_depths": out or None}
 
 
+def memwatch_fields(loop, metrics, n_shards: int) -> Dict:
+    """The HBM telemetry artifact block (scheduler/memwatch.py): the
+    loop's ledger summary — `hbm_peak_bytes` / `hbm_resident_bytes`
+    stamped top-level so `bench.regression --metric hbm_peak_bytes` gates
+    the measured HBM trajectory like step time — plus the PR-4 scale-out
+    numbers as LIVE gauges (`n_shards`, `per_shard_hbm_bytes`), so a
+    /metrics scrape sees the same story the artifact tells.  Empty when
+    KTPU_MEMWATCH=0 disabled the ledger."""
+    mw = getattr(loop, "memwatch", None)
+    if mw is None:
+        return {}
+    fields = mw.summary()
+    if metrics is not None:
+        metrics.set("n_shards", n_shards)
+    est = mw.per_shard_hbm_estimate()
+    if est is not None:
+        fields["per_shard_hbm_bytes"] = est
+        if metrics is not None:
+            metrics.set("per_shard_hbm_bytes", est)
+    return fields
+
+
 def _export_trace(collector, path: str) -> None:
     """Write the Perfetto export and print the one-line trace summary —
     flagging an INCOMPLETE trace (ring wrapped, spans dropped) so
@@ -428,7 +450,7 @@ def run_streaming_workload(
     loop, so pre-pipeline numbers remain reproducible bit-for-bit."""
     from ..ops.assign import TRACE_COUNTS
     from ..parallel.mesh import mesh_from_env
-    from ..parallel.pipeline import PipelinedBatchLoop, run_serial
+    from ..parallel.pipeline import PipelinedBatchLoop
     from ..scheduler.metrics import Metrics, reset_run_state
     from ..scheduler.tracing import Tracer
 
@@ -458,13 +480,20 @@ def run_streaming_workload(
     # --no-pipeline runs have no later pipelined pass, so the serial loop
     # itself is the traced+metered run (attribution + SLI still emit);
     # when pipelining, the serial pass stays untraced/unmetered — its
-    # spans and SLI samples would pollute the pipelined run's report
+    # spans and SLI samples would pollute the pipelined run's report.
+    # Built as an explicit depth-0 loop (run_serial's exact dataflow) so
+    # the --no-pipeline branch can read the loop's memwatch ledger.
+    serial_loop = PipelinedBatchLoop(
+        donate=donate, mesh=mesh, depth=0,
+        tracer=None if pipeline else tracer,
+        metrics=None if pipeline else metrics,
+        # when pipelining, only the pipelined runner's ledger is ever
+        # stamped — sampling the reference pass would be pure waste
+        # inside the timed serial_s window
+        memwatch=None if not pipeline else False,
+    )
     with _maybe_profile(not pipeline):
-        serial = list(run_serial(
-            waves, donate=donate, mesh=mesh,
-            tracer=None if pipeline else tracer,
-            metrics=None if pipeline else metrics,
-        ))
+        serial = list(serial_loop.run(waves))
     t_serial = time.perf_counter() - t0
     out = {
         "name": name,
@@ -482,6 +511,9 @@ def run_streaming_workload(
             pods_per_sec=round(pods / t_serial, 1) if t_serial > 0 else 0.0,
             **sli_fields(metrics),
             **event_fields(metrics),
+            # measured HBM telemetry (scheduler/memwatch.py):
+            # hbm_peak_bytes / hbm_resident_bytes + the sentinel block
+            **memwatch_fields(serial_loop, metrics, out["n_shards"]),
         )
         if profile_dir:
             _profile_block(out, profile_dir, waves, mesh, collector)
@@ -510,6 +542,10 @@ def run_streaming_workload(
         **event_fields(metrics),
         # incremental warm-cycle attribution (ops/incremental.py)
         **runner.hoist.summary(),
+        # measured HBM telemetry (scheduler/memwatch.py): hbm_peak_bytes
+        # / hbm_resident_bytes stamped top-level (regression-gated) + the
+        # sentinel block; the scale-out gauges mirror the artifact
+        **memwatch_fields(runner, metrics, out["n_shards"]),
     )
     if profile_dir:
         _profile_block(out, profile_dir, waves, mesh, collector)
@@ -725,8 +761,10 @@ def main(argv=None) -> None:
     # reason).  Must precede force_cpu_from_env, which imports jax.
     _early_argv = argv if argv is not None else sys.argv[1:]
     if "--verify-device" in _early_argv or "--verify-shard" in _early_argv \
+            or "--verify-mem" in _early_argv \
             or os.environ.get("KTPU_VERIFY_DEVICE") == "1" \
-            or os.environ.get("KTPU_VERIFY_SHARD") == "1":
+            or os.environ.get("KTPU_VERIFY_SHARD") == "1" \
+            or os.environ.get("KTPU_VERIFY_MEM") == "1":
         from ..analysis.devicecheck import ensure_devices
 
         ensure_devices()
@@ -829,6 +867,18 @@ def main(argv=None) -> None:
                          "verify block, the route traces are shared with "
                          "--verify-device, and the exit contract is shared "
                          "(also via KTPU_VERIFY_SHARD=1)")
+    ap.add_argument("--verify-mem", action="store_true",
+                    help="with (or implying) --verify: also run the "
+                         "ktpu-verify MEM pass (KTPU020 — the HBM "
+                         "telemetry plane's measured-vs-analytic "
+                         "reconciliation: live peak within tolerance of "
+                         "shard_hbm_estimate, resident census == the "
+                         "FIELD_DIMS size model, leak sentinel clean; "
+                         "analysis/memrules.py); the per-route mem report "
+                         "rides the artifact's verify block, the route "
+                         "traces are shared with --verify-device/"
+                         "--verify-shard, and the exit contract is shared "
+                         "(also via KTPU_VERIFY_MEM=1)")
     args = ap.parse_args(argv)
     if args.chaos_sites and args.chaos is None:
         ap.error("--chaos-sites requires --chaos (it shapes the seeded storm)")
@@ -854,15 +904,18 @@ def main(argv=None) -> None:
                      or os.environ.get("KTPU_VERIFY_DEVICE") == "1")
     verify_shard = (args.verify_shard
                     or os.environ.get("KTPU_VERIFY_SHARD") == "1")
-    if verify_device or verify_shard:
-        args.verify = True  # --verify-device/--verify-shard imply the gate
+    verify_mem = (args.verify_mem
+                  or os.environ.get("KTPU_VERIFY_MEM") == "1")
+    if verify_device or verify_shard or verify_mem:
+        args.verify = True  # the trace-pass flags imply the gate
     if args.verify:
         from ..analysis.__main__ import run_verify
         from ..analysis.engine import BaselineError
 
         try:
             verify_report = run_verify(device=verify_device,
-                                       shard=verify_shard)
+                                       shard=verify_shard,
+                                       mem=verify_mem)
         except BaselineError as e:
             print(f"ktpu-verify: unusable baseline: {e}", file=sys.stderr)
             sys.exit(2)
@@ -982,6 +1035,20 @@ def main(argv=None) -> None:
                 doc["device_flops"] = max(flops)
             if hbm:
                 doc["device_hbm_bytes"] = max(hbm)
+            # worst per-route MEASURED HBM peak / resident census from the
+            # mem pass's ledgers (scheduler/memwatch.py), stamped like
+            # comm_bytes so `bench.regression --metric hbm_peak_bytes`
+            # gates the measured trajectory; a --stream run's own ledger
+            # summary (workload-scale, already stamped) wins over the
+            # trace-scale route numbers
+            mems = [r.get("mem") or {} for r in routes]
+            peaks = [m.get("measured_peak_bytes", 0) for m in mems if m]
+            res = [(m.get("census") or {}).get("resident_bytes", 0)
+                   for m in mems if m]
+            if peaks:
+                doc.setdefault("hbm_peak_bytes", max(peaks))
+            if res:
+                doc.setdefault("hbm_resident_bytes", max(res))
         from ..analysis import lockcheck
 
         if lockcheck.enabled():
@@ -1032,6 +1099,19 @@ def main(argv=None) -> None:
                         f"measured {rec['measured']} diverge "
                         f"{rec['ratio']}x (> {rec['tolerance']}x)"
                     )
+        # the memwatch smoke gate: a --stream run whose leak sentinel
+        # tripped (unaccounted live device bytes rising monotonically
+        # across the waves) fails like a profile-capture failure — the
+        # artifact, written below, is the evidence
+        memwatch_failed = None
+        sentinel = (out.get("memwatch") or {}).get("sentinel") or {}
+        if sentinel.get("leaking"):
+            memwatch_failed = (
+                f"leak sentinel: unaccounted live device bytes grew "
+                f"{sentinel.get('growth_bytes', '?')} B monotonically "
+                f"(> slack {sentinel.get('slack_bytes', '?')} B) across "
+                "the stream"
+            )
         if args.attribution and "attribution" in out:
             from ..scheduler.attribution import render_attribution
 
@@ -1048,6 +1128,9 @@ def main(argv=None) -> None:
             open(args.out, "w").write(blob + "\n")
         if profile_failed:  # artifact written first — it IS the evidence
             print(f"profile: FAIL — {profile_failed}", file=sys.stderr)
+            sys.exit(1)
+        if memwatch_failed:  # same contract: artifact first, then fail
+            print(f"memwatch: FAIL — {memwatch_failed}", file=sys.stderr)
             sys.exit(1)
         return
     if args.config:

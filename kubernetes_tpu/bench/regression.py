@@ -30,6 +30,11 @@ burn-down number), and --metric device_flops / device_hbm_bytes (the
 analytic ledger's modeled kernel cost, analysis/costmodel.py — a kernel
 that silently grew its FLOP or byte footprint regression-gates even
 before it slows a wall clock).
+The HBM telemetry plane stamps the MEASURED device-memory high-water the
+same way: --metric hbm_peak_bytes (scheduler/memwatch.py — the live
+peak the cycle-boundary ledger observed, stamped top-level by bench.py
+and every --stream artifact), so a kernel or cache change that silently
+doubles peak HBM fails the gate like a step-time regression.
 Dotted metric names traverse nested blocks (e.g. verify.n_unbaselined).
 Prior runs missing the metric or on another box are skipped with a note
 (the r01/r02 real-TPU artifacts predate step_s), never failed on — only

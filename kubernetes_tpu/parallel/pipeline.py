@@ -86,6 +86,7 @@ class PipelinedBatchLoop:
         tracer=None,
         metrics=None,
         mesh=None,
+        memwatch: Optional[bool] = None,
     ):
         from ..ops.assign import donation_supported
 
@@ -114,6 +115,28 @@ class PipelinedBatchLoop:
         from ..ops.incremental import HoistCache
 
         self.hoist = HoistCache(mesh=mesh, tracer=tracer)
+        # HBM telemetry ledger (scheduler/memwatch.py): cycle-boundary
+        # live/census samples + leak sentinel; summary() stamps
+        # hbm_peak_bytes / hbm_resident_bytes into bench artifacts and
+        # the device_hbm_* gauge family onto /metrics.  KTPU_MEMWATCH=0
+        # disables the plane; memwatch=False forces it off per loop (the
+        # harness's untimed serial-reference pass — its ledger is never
+        # read and its sampling would tax the serial baseline the
+        # overlap_gain comparison measures against).
+        from ..scheduler.memwatch import DeviceMemoryLedger, memwatch_enabled
+
+        arm = memwatch_enabled() if memwatch is None else bool(memwatch)
+        self.memwatch = (
+            DeviceMemoryLedger(mesh=mesh, metrics=metrics) if arm else None
+        )
+        if self.memwatch is not None:
+            # anchor the measured side NOW, before this loop places
+            # anything: the first cycle sample lands after wave 1's
+            # resident buffers are live, and a lazy baseline there would
+            # fold the loop's own footprint into the zero point —
+            # hbm_peak_bytes (regression-gated) would under-report to ~0
+            # on live_arrays backends
+            self.memwatch.baseline()
         # (choices, meta, inc_attrs, t_arrival, t_dispatch, snap) of the
         # dispatched wave; t_arrival (encode start) anchors the wave's
         # arrival -> bind SLI
@@ -323,6 +346,16 @@ class PipelinedBatchLoop:
                 overlap_credit=ccredit, pods=len(verdicts),
             )
         self.stats["waves"] += 1
+        if self.memwatch is not None:
+            # cycle-boundary memory sample: the resident census is the
+            # encoder's device-buffer table (empty on donating loops —
+            # fresh transfers retire with their wave) plus the hoist
+            # cache's class matrices/usage rows/memos; metadata only,
+            # never reads buffer values
+            self.memwatch.cycle_sample(
+                encoder=self.enc, hoist=self.hoist,
+                label=f"wave{self._wave - 1}",
+            )
         if self.metrics is not None:
             self.metrics.observe("pipeline_cycle_seconds", t2 - t_dispatch)
             # the wave's arrival -> bind SLI: one sample per BOUND pod at

@@ -142,6 +142,17 @@ def render_flight(doc: Dict) -> str:
                      f" dirty_cols={r.get('dirty_cols', -1)}")
         if r.get("trace_id"):
             line += f" trace={r['trace_id'][:8]}"
+        mem = r.get("mem")
+        if isinstance(mem, dict):
+            # the HBM block (scheduler/memwatch.py): was the dying cycle
+            # near the device-memory ceiling?
+            line += (
+                f" hbm[in_use={mem.get('in_use', '?')}"
+                f" peak={mem.get('peak', '?')}"
+                f" resident={mem.get('resident', '?')}"
+                f" unaccounted={mem.get('unaccounted', '?')}"
+                f" src={mem.get('source', '?')}]"
+            )
         out.append(line)
         diagnosis = r.get("diagnosis")
         for d in diagnosis if isinstance(diagnosis, list) else []:

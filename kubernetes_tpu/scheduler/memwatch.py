@@ -1,0 +1,491 @@
+"""HBM telemetry plane — the live device-memory ledger (ISSUE 15).
+
+Every observability plane so far meters *time*; device *memory* was an
+analytic estimate (parallel/mesh.shard_hbm_estimate) checked only against
+the compiled memory analysis at trace scale (KTPU012).  This module is the
+measured half, the cAdvisor/`/metrics/resource` analog of the device
+plane: upstream kubelet feeds metrics-server a live resource summary the
+scheduler consumes; here the scheduler's own device plane gets one.
+
+Three cooperating pieces:
+
+  * per-device LIVE stats — ``Device.memory_stats()`` (``bytes_in_use`` /
+    ``peak_bytes_in_use``) sampled at cycle boundaries, high-water kept
+    across the run.  Backends without it (the CPU sim returns None) are
+    recorded as unavailable, NEVER silently passed — KTPU012's discipline.
+    ``jax.live_arrays()`` is the always-available fallback source: the sum
+    of live device-array bytes the process holds (logical bytes — a
+    replicated array counts once), so the ledger meters every backend.
+
+  * a host-side CENSUS of every *resident* device buffer the framework
+    owns — the DeltaEncoder's resident ClusterArrays table, the
+    HoistCache's class matrices / usage rows / replicated memos, any
+    IncState in flight — each entry sized through the partition rule
+    table's FIELD_DIMS model (``partition_rules.field_bytes``), so the
+    ledger and ``shard_hbm_estimate`` resolve one size model and can never
+    drift onto different field sets.  ``matched`` compares the model
+    against the buffer's true per-device bytes; a mismatch is a KTPU020
+    finding (analysis/memrules.py), not a quiet coverage hole.
+
+  * a LEAK SENTINEL: across warm cycles, *unaccounted* live device bytes
+    (live minus census) growing monotonically is a failure — donation
+    retiring a wave's buffers, a restore()/invalidate(), or a chaos
+    wave-recovery must return the census to baseline.  The sentinel's
+    verdict rides bench artifacts, the twelve-route tracer's per-route
+    ``mem`` block, and KTPU020.
+
+``KTPU_MEMWATCH=0`` disables the plane (default on — a census walk is a
+few dict lookups per cycle; the live-array walk is O(live buffers), a few
+dozen on the warm path).  Wired into PipelinedBatchLoop (cycle samples),
+the Scheduler batch path (gauges next to the queue-depth family, flight-
+recorder memory block), bench.py and ``bench.harness --stream``
+(``hbm_peak_bytes`` / ``hbm_resident_bytes`` stamped top-level,
+regression-gated), and the devicecheck tracer (per-route ``mem`` blocks
+KTPU020 reconciles).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+# sentinel slack: unaccounted growth below this many bytes across the
+# observed window is allocator noise (small host-staging vectors, jit
+# bookkeeping), not a leak.  Exported so fixture tests and the README
+# document ONE number.
+SENTINEL_SLACK_BYTES = 1 << 18  # 256 KiB
+
+# the sentinel needs at least this many samples (>= 2 deltas) before it
+# will call a monotone rise a leak — one noisy delta is not a trend
+SENTINEL_MIN_SAMPLES = 3
+
+# rolling-window bound on the sentinel's sample history (the KTPU_FLIGHT_K
+# pattern): the plane is always-on in a long-running scheduler, and a leak
+# detector must not itself grow without bound.  A leak outlasting the
+# window still flags — every delta inside the window is positive.
+SENTINEL_WINDOW = 512
+
+
+def memwatch_enabled() -> bool:
+    """KTPU_MEMWATCH=0 disables the device-memory ledger (read per
+    construction, so tests and operators flip it without a fresh
+    process).  Default ON: the per-cycle cost is a census dict walk plus
+    one live-array sweep."""
+    return os.environ.get("KTPU_MEMWATCH", "") != "0"
+
+
+# --------------------------------------------------------------------------
+# measured side: device stats + live arrays
+# --------------------------------------------------------------------------
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    """Per-device ``memory_stats()`` snapshot: ``{"available": bool,
+    "devices": [{device, bytes_in_use, peak_bytes_in_use}, ...],
+    "bytes_in_use": total, "peak_bytes_in_use": total}``.
+
+    Graceful on backends without stats (CPU sim returns None, some expose
+    no method): the block says ``available: False`` and totals are 0 —
+    recorded, never silently passed as a measurement (KTPU012's
+    discipline; KTPU020 then reconciles on the live-array source and the
+    route report shows WHY)."""
+    import jax
+
+    devices = []
+    in_use = peak = 0
+    available = False
+    for d in jax.devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            devices.append({"device": str(d), "available": False})
+            continue
+        b = int(stats.get("bytes_in_use", 0))
+        p = int(stats.get("peak_bytes_in_use", b))
+        devices.append({
+            "device": str(d), "available": True,
+            "bytes_in_use": b, "peak_bytes_in_use": p,
+        })
+        in_use += b
+        peak += p
+        available = True
+    return {
+        "available": available,
+        "devices": devices,
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+    }
+
+
+def live_device_bytes() -> Dict[str, int]:
+    """Total LOGICAL bytes of every live device array the process holds
+    (``jax.live_arrays()``; a replicated array counts its logical size
+    once) — the always-available measured source.  Deleted/donated arrays
+    report nbytes through metadata even when their buffers are gone, so
+    they are skipped via ``is_deleted`` where exposed."""
+    import jax
+
+    total = 0
+    n = 0
+    for a in jax.live_arrays():
+        try:
+            if a.is_deleted():
+                continue
+        except Exception:
+            pass
+        try:
+            total += int(a.nbytes)
+            n += 1
+        except Exception:
+            continue
+    return {"bytes": total, "arrays": n}
+
+
+def _per_device_bytes(a) -> int:
+    """True per-device bytes of one device array: the max over devices of
+    the shard bytes resident there (replicated -> full size per device,
+    node-sharded -> the slice).  Shard METADATA only — never reads values
+    (safe on buffers about to be donated)."""
+    per: Dict[Any, int] = {}
+    try:
+        shards = a.addressable_shards
+    except Exception:
+        return int(getattr(a, "nbytes", 0))
+    for s in shards:
+        try:
+            per[s.device] = per.get(s.device, 0) + int(s.data.nbytes)
+        except Exception:
+            return int(getattr(a, "nbytes", 0))
+    return max(per.values()) if per else int(getattr(a, "nbytes", 0))
+
+
+# --------------------------------------------------------------------------
+# the census: resident buffers sized through the FIELD_DIMS model
+# --------------------------------------------------------------------------
+
+
+def model_bytes_for(qualname: str, shape, n_shards: int = 1) -> Optional[int]:
+    """Analytic per-shard bytes of one resident buffer via the partition
+    rule table's size model: FIELD_DIMS dims symbols bound to the CONCRETE
+    shape, then ``partition_rules.field_bytes`` (the same routine
+    ``shard_hbm_estimate``'s resident_inputs term and KTPU015's threshold
+    math sum) — one size model, so the ledger cannot drift from the
+    estimate.  None for a qualname outside the model (the census marks it
+    unmodeled; KTPU020 flags it)."""
+    from ..parallel.partition_rules import FIELD_DIMS, field_bytes
+
+    ent = FIELD_DIMS.get(qualname)
+    if ent is None:
+        return None
+    dims, _itemsize = ent
+    if len(dims) != len(shape):
+        return None
+    env = {sym: int(s) for sym, s in zip(dims, shape)}
+    return field_bytes(qualname, env, n_shards)
+
+
+def _census_entry(qualname: str, a, n_shards: int) -> Dict[str, Any]:
+    shape = tuple(int(s) for s in a.shape)
+    actual = _per_device_bytes(a)
+    model = model_bytes_for(qualname, shape, n_shards)
+    # model >= itemsize by construction (field_bytes clamps every dim to
+    # >= 1 so an analytic budget is never zero); a zero-size concrete
+    # buffer occupies no device bytes — not a drift, just empty
+    matched = model is not None and (actual == 0 or model == actual)
+    return {
+        "qualname": qualname,
+        "shape": shape,
+        "nbytes": int(getattr(a, "nbytes", 0)),   # global logical bytes
+        "per_shard_bytes": actual,                # true per-device bytes
+        "model_bytes": model,                     # FIELD_DIMS-model bytes
+        "matched": bool(matched),
+    }
+
+
+def census_buffers(arr=None, inc=None, encoder=None, hoist=None,
+                   n_shards: int = 1) -> Dict[str, Any]:
+    """The host-side census of every resident device buffer the framework
+    owns, deduped by buffer identity (an IncState's leaves ARE the
+    HoistCache's device entries — one buffer, one entry):
+
+      * ``arr``      a device-placed ClusterArrays (qualnames ``arr.*``)
+      * ``inc``      an IncState of device leaves (qualnames ``inc.*``)
+      * ``encoder``  a DeltaEncoder — its resident device-buffer table
+      * ``hoist``    a HoistCache — statics, usage rows, replicated memos
+
+    Returns ``{"entries": [...], "resident_bytes": global logical total,
+    "per_shard_bytes": per-device total, "model_bytes": FIELD_DIMS-model
+    total, "matched": every entry's model equals its true per-device
+    bytes, "n_buffers": count}`` — ``matched`` is the KTPU020
+    census-vs-model equality."""
+    import dataclasses as _dc
+
+    entries: List[Dict[str, Any]] = []
+    seen: set = set()
+
+    def add(qualname: str, a) -> None:
+        if a is None or id(a) in seen:
+            return
+        if not hasattr(a, "shape"):
+            return
+        seen.add(id(a))
+        try:
+            if a.is_deleted():
+                return  # donated/retired: no longer resident anywhere
+        except Exception:
+            pass
+        entries.append(_census_entry(qualname, a, n_shards))
+
+    if arr is not None:
+        for f in _dc.fields(type(arr)):
+            add(f"arr.{f.name}", getattr(arr, f.name))
+    if encoder is not None:
+        for name, ent in getattr(encoder, "_dev", {}).items():
+            add(f"arr.{name}", ent[1])
+    if hoist is not None:
+        statics = getattr(hoist, "_statics", None)
+        if statics is not None:
+            for q, a in zip(("inc.stat_u", "inc.elig_u", "inc.traw_u",
+                             "inc.naraw_u", "inc.img_u"), statics):
+                add(q, a)
+        usage = getattr(hoist, "_usage", None)
+        if usage is not None:
+            add("inc.base_u", usage[0])
+            add("inc.fit_u", usage[1])
+        for attr, q in (("_cls_ent", "inc.cls"), ("_req_ent", "inc.req_u")):
+            ent = getattr(hoist, attr, None)
+            if ent is not None:
+                add(q, ent[1])
+    if inc is not None:
+        for name in inc._fields:
+            add(f"inc.{name}", getattr(inc, name))
+    return {
+        "entries": entries,
+        "resident_bytes": sum(e["nbytes"] for e in entries),
+        "per_shard_bytes": sum(e["per_shard_bytes"] for e in entries),
+        "model_bytes": sum(e["model_bytes"] or 0 for e in entries),
+        "matched": all(e["matched"] for e in entries),
+        "n_buffers": len(entries),
+    }
+
+
+# --------------------------------------------------------------------------
+# the leak sentinel
+# --------------------------------------------------------------------------
+
+
+class LeakSentinel:
+    """Monotone-growth detector over the per-cycle UNACCOUNTED live bytes
+    (live minus census): a donated wave retiring, a restore()/
+    invalidate(), or a chaos wave-recovery must return the process to
+    baseline — unaccounted bytes rising on EVERY observed delta beyond
+    ``slack_bytes`` total is a leak (a retained retired buffer, a cache
+    entry surviving invalidation).  Single noisy deltas, shrinkage, or
+    sub-slack drift all stay clean."""
+
+    def __init__(self, slack_bytes: int = SENTINEL_SLACK_BYTES,
+                 min_samples: int = SENTINEL_MIN_SAMPLES,
+                 window: int = SENTINEL_WINDOW):
+        from collections import deque
+
+        self.slack_bytes = int(slack_bytes)
+        self.min_samples = max(2, int(min_samples))
+        self.samples = deque(maxlen=max(self.min_samples, int(window)))
+
+    def observe(self, unaccounted_bytes: int) -> None:
+        self.samples.append(int(unaccounted_bytes))
+
+    def verdict(self) -> Dict[str, Any]:
+        samples = list(self.samples)
+        deltas = [b - a for a, b in zip(samples, samples[1:])]
+        growth = (samples[-1] - samples[0]) if samples else 0
+        leaking = (
+            len(samples) >= self.min_samples
+            and all(d > 0 for d in deltas)
+            and growth > self.slack_bytes
+        )
+        return {
+            "leaking": bool(leaking),
+            "samples": samples,
+            "deltas": deltas,
+            "growth_bytes": int(growth),
+            "slack_bytes": self.slack_bytes,
+        }
+
+
+# --------------------------------------------------------------------------
+# the ledger
+# --------------------------------------------------------------------------
+
+
+class DeviceMemoryLedger:
+    """The per-run device-memory ledger: cycle-boundary samples of the
+    measured side (memory_stats where available, live arrays always),
+    the resident-buffer census, high-water marks, gauges, and the leak
+    sentinel — one object threaded through the pipelined loop, the
+    scheduler batch path, and the twelve-route tracer.
+
+    ``baseline()`` anchors the measured deltas (call it before the first
+    placement so pre-existing process buffers — another route's leftovers,
+    warmup constants — never count against this run)."""
+
+    def __init__(self, mesh=None, metrics=None,
+                 slack_bytes: int = SENTINEL_SLACK_BYTES):
+        self.mesh = mesh
+        self.n_shards = int(mesh.size) if mesh is not None else 1
+        self.metrics = metrics
+        self.sentinel = LeakSentinel(slack_bytes=slack_bytes)
+        self._baseline_live = 0
+        self._baselined = False
+        self.peak_live_bytes = 0          # high-water live delta vs baseline
+        self.peak_stats_bytes = 0         # high-water memory_stats in-use
+        self.peak_resident_bytes = 0      # high-water census (global)
+        self.last_census: Optional[Dict[str, Any]] = None
+        self.last_stats: Optional[Dict[str, Any]] = None
+        self.memory_stats_available = False
+        self.samples = 0
+        self.census_matched = True
+        # every UNMATCHED census entry seen across the run, first
+        # occurrence per qualname — census_matched is an AND over all
+        # samples, so the evidence must accumulate with it (a transient
+        # cold-sample drift would otherwise produce a finding naming no
+        # buffer)
+        self.census_unmatched: Dict[str, Dict[str, Any]] = {}
+
+    def baseline(self) -> None:
+        """Anchor the measured side at the current live-byte level."""
+        self._baseline_live = live_device_bytes()["bytes"]
+        self._baselined = True
+
+    def cycle_sample(self, arr=None, inc=None, encoder=None, hoist=None,
+                     label: str = "") -> Dict[str, Any]:
+        """One cycle-boundary observation: census the resident buffers,
+        sample the measured side, feed the sentinel, raise the high-water
+        marks, stamp the ``device_hbm_*`` gauge family.  Returns the
+        sample dict (the per-route tracer embeds the final one)."""
+        if not self._baselined:
+            self.baseline()
+        census = census_buffers(arr=arr, inc=inc, encoder=encoder,
+                                hoist=hoist, n_shards=self.n_shards)
+        live = live_device_bytes()
+        stats = device_memory_stats()
+        live_delta = max(0, live["bytes"] - self._baseline_live)
+        unaccounted = live["bytes"] - self._baseline_live \
+            - census["resident_bytes"]
+        self.sentinel.observe(unaccounted)
+        self.peak_live_bytes = max(self.peak_live_bytes, live_delta)
+        self.peak_resident_bytes = max(
+            self.peak_resident_bytes, census["resident_bytes"])
+        if stats["available"]:
+            self.memory_stats_available = True
+            self.peak_stats_bytes = max(
+                self.peak_stats_bytes,
+                stats["peak_bytes_in_use"] or stats["bytes_in_use"])
+        self.last_census = census
+        self.last_stats = stats
+        self.census_matched = self.census_matched and census["matched"]
+        for e in census["entries"]:
+            if not e["matched"]:
+                self.census_unmatched.setdefault(e["qualname"], e)
+        self.samples += 1
+        if self.metrics is not None:
+            # the live family next to the queue-depth gauges: current,
+            # peak (set_max high-water), resident census, unaccounted.
+            # in-use reuses THIS sample's sweep (the one-sweep-per-cycle
+            # promise) instead of calling in_use_bytes(), which would
+            # walk the live arrays a second time on statless backends
+            in_use = (stats["bytes_in_use"] if stats["available"]
+                      else live_delta)
+            self.metrics.set("device_hbm_in_use_bytes", in_use)
+            self.metrics.set_max("device_hbm_peak_bytes",
+                                 self.hbm_peak_bytes())
+            self.metrics.set("device_hbm_resident_bytes",
+                             census["resident_bytes"])
+            self.metrics.set("device_hbm_unaccounted_bytes", unaccounted)
+        return {
+            "label": label,
+            "live_bytes": live["bytes"],
+            "live_delta_bytes": live_delta,
+            "resident_bytes": census["resident_bytes"],
+            "unaccounted_bytes": unaccounted,
+            "census_matched": census["matched"],
+            "memory_stats_available": stats["available"],
+        }
+
+    # -- read side --
+
+    def source(self) -> str:
+        return "memory_stats" if self.memory_stats_available \
+            else "live_arrays"
+
+    def in_use_bytes(self) -> int:
+        if self.memory_stats_available and self.last_stats is not None:
+            return int(self.last_stats["bytes_in_use"])
+        return max(0, live_device_bytes()["bytes"] - self._baseline_live)
+
+    def hbm_peak_bytes(self) -> int:
+        """The measured high-water: memory_stats peak where the backend
+        exposes one, else the live-array delta peak."""
+        if self.memory_stats_available:
+            return int(self.peak_stats_bytes)
+        return int(self.peak_live_bytes)
+
+    def per_shard_hbm_estimate(self) -> Optional[int]:
+        """The analytic ``per_shard_hbm_bytes`` twin (bench.py's JSON
+        field), derived from the dims of the resident buffers the last
+        census actually saw — so a live `/metrics` scrape can carry the
+        same scale-out story the artifact tells.  None when the census
+        has no resident ClusterArrays (e.g. a donating loop: fresh
+        per-wave transfers, nothing resident to size)."""
+        c = self.last_census or {}
+        shapes = {e["qualname"]: e["shape"] for e in c.get("entries", [])}
+        pr = shapes.get("arr.pod_req")
+        nu = shapes.get("arr.node_used")
+        if not (pr and nu):
+            return None
+        tc = shapes.get("arr.term_counts0")
+        u = shapes.get("inc.req_u")
+        from ..ops import assign as A
+        from ..parallel.mesh import shard_hbm_estimate
+
+        chunk = A._INC_CHUNK if u else A._CHUNK
+        return int(shard_hbm_estimate(
+            pr[0], nu[0], self.n_shards, n_res=pr[1],
+            n_terms=(tc[0] if tc else 1), chunk=chunk,
+            u_classes=(u[0] if u else None),
+        )["total"])
+
+    def summary(self) -> Dict[str, Any]:
+        """The artifact block: ``hbm_peak_bytes`` / ``hbm_resident_bytes``
+        stamped top-level by bench.py and `--stream`, plus source,
+        availability, the census match flag, and the sentinel verdict."""
+        return {
+            "hbm_peak_bytes": self.hbm_peak_bytes(),
+            "hbm_resident_bytes": int(self.peak_resident_bytes),
+            "memwatch": {
+                "source": self.source(),
+                "memory_stats_available": self.memory_stats_available,
+                "samples": self.samples,
+                "census_matched": self.census_matched,
+                "n_buffers": (self.last_census or {}).get("n_buffers", 0),
+                "sentinel": self.sentinel.verdict(),
+            },
+        }
+
+    def memory_block(self) -> Dict[str, Any]:
+        """The COMPACT block a flight-recorder record carries, so a
+        post-mortem answers "were we near the ceiling when it died" —
+        in-use, peak, resident census, unaccounted, source."""
+        unacc = 0
+        if self.sentinel.samples:
+            unacc = self.sentinel.samples[-1]
+        return {
+            "in_use": self.in_use_bytes(),
+            "peak": self.hbm_peak_bytes(),
+            "resident": (self.last_census or {}).get("resident_bytes", 0),
+            "unaccounted": unacc,
+            "source": self.source(),
+        }

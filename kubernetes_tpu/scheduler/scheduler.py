@@ -255,6 +255,19 @@ class Scheduler:
 
         self._flight = FlightRecorder(directory=ckpt_dir)
         self._last_diagnosis: List[dict] = []
+        # HBM telemetry ledger (memwatch.py): per-device live stats +
+        # resident-buffer census sampled at both batch-cycle boundaries
+        # (next to the queue-depth gauges), device_hbm_* gauge family on
+        # /metrics, and the compact memory block every flight-recorder
+        # record carries — a post-mortem answers "were we near the
+        # ceiling when it died".  KTPU_MEMWATCH=0 disables; non-tpu modes
+        # own no device buffers, so nothing to meter there.
+        from .memwatch import DeviceMemoryLedger, memwatch_enabled
+
+        self._memwatch = (
+            DeviceMemoryLedger(mesh=self.mesh, metrics=self.metrics)
+            if config.mode == "tpu" and memwatch_enabled() else None
+        )
         store.watch(self._on_event)
 
     # --- watch plumbing ---
@@ -924,6 +937,20 @@ class Scheduler:
         for pool, v in self.queue.depths().items():
             self.metrics.set(f"queue_pool_{pool}_pods", v)
             self.metrics.set_max(f"queue_pool_{pool}_pods_peak", v)
+        self._sample_device_memory()
+
+    def _sample_device_memory(self) -> None:
+        """Cycle-boundary HBM sample (memwatch.py), riding the same two
+        boundary calls as the queue-depth gauges: live device stats
+        (memory_stats where the backend exposes it, live arrays
+        otherwise), the resident census (the delta encoder's device table
+        + the hoist cache), the leak sentinel, and the device_hbm_* gauge
+        family on /metrics."""
+        if self._memwatch is None:
+            return
+        self._memwatch.cycle_sample(
+            encoder=self._delta_enc, hoist=self._hoist_cache, label="cycle",
+        )
 
     def _schedule_batch_traced(
         self, batch: List[t.Pod], t0: float
@@ -1343,6 +1370,11 @@ class Scheduler:
             )
         if self._last_diagnosis:
             rec["diagnosis"] = self._last_diagnosis
+        if self._memwatch is not None:
+            # the compact HBM block (memwatch.py — in-use/peak/resident/
+            # unaccounted): a post-mortem reading the dump can answer
+            # "were we near the device-memory ceiling when it died"
+            rec["mem"] = self._memwatch.memory_block()
         self._flight.record(**rec)
 
     def _commit_profile_batch(

@@ -401,9 +401,10 @@ def test_harness_verify_device_embeds_report(monkeypatch, tmp_path):
     seen = {}
 
     def fake_verify(root=None, baseline_path=None, device=False,
-                    shard=False):
+                    shard=False, mem=False):
         seen["device"] = device
         seen["shard"] = shard
+        seen["mem"] = mem
         return _canned_report()
 
     monkeypatch.setattr(cli, "run_verify", fake_verify)

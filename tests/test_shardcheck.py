@@ -447,9 +447,10 @@ def test_harness_verify_shard_embeds_report(monkeypatch, tmp_path):
     seen = {}
 
     def fake_verify(root=None, baseline_path=None, device=False,
-                    shard=False):
+                    shard=False, mem=False):
         seen["device"] = device
         seen["shard"] = shard
+        seen["mem"] = mem
         rep = _canned_report()
         rep.device = {
             "routes": [{
