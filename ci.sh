@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # The CI gate — the exact checks every push must pass, runnable by humans
 # too (`./ci.sh`), so CI and a laptop can never disagree about what green
-# means.  Four stages, fail-fast:
+# means.  Five stages, fail-fast:
 #
 #   1. tier-1 tests        the ROADMAP.md tier-1 command (not slow, 870 s cap)
 #   2. ktpu-verify         AST + device + shard + mem passes (KTPU001–020:
@@ -16,7 +16,11 @@
 #                          reconciliation must pass AND the stream's leak
 #                          sentinel must be clean (the harness exits 1 on
 #                          any of the three failures)
-#   4. regression gates    bench/regression.py over the BENCH_r*.json
+#   4. open-loop smoke     the load observatory end to end (bench.harness
+#                          --open-loop rollout --sli-attribution at reduced
+#                          scale): the artifact must stamp a finite headline
+#                          SLI with per-phase p99 shares summing to ~1.0
+#   5. regression gates    bench/regression.py over the BENCH_r*.json
 #                          trajectory (same-platform comparison only), plus
 #                          the observatory's round_loop_fraction /
 #                          device_flops / device_hbm_bytes scalars and the
@@ -28,7 +32,7 @@
 set -uo pipefail
 cd "$(dirname "$0")"
 
-echo "=== [1/4] tier-1 tests ==="
+echo "=== [1/5] tier-1 tests ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
@@ -40,14 +44,14 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
-echo "=== [2/4] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
+echo "=== [2/5] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
 JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard --mem || {
   rc=$?
   echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
   exit "$rc"
 }
 
-echo "=== [3/4] device cost observatory + memwatch smoke (--profile) ==="
+echo "=== [3/5] device cost observatory + memwatch smoke (--profile) ==="
 # fresh process (XLA parses dump flags once); reduced stream shape so the
 # smoke prices the capture path, not the full BENCH scale.  The stream's
 # artifact also carries the memwatch block: the harness exits 1 when the
@@ -65,7 +69,29 @@ JAX_PLATFORMS=cpu KTPU_STREAM_SHAPE=512x128 \
   exit "$rc"
 }
 
-echo "=== [4/4] bench regression gates ==="
+echo "=== [4/5] open-loop load observatory smoke ==="
+# reduced-scale rollout ramp on the cpu sim: proves the open-loop driver,
+# the CO-safe SLI stamping and the phase decomposition end to end.  The
+# python step asserts the acceptance contract on the artifact itself.
+JAX_PLATFORMS=cpu KTPU_OPEN_LOOP_SCALE=0.5 \
+  python -m kubernetes_tpu.bench.harness --open-loop rollout \
+  --sli-attribution --out /tmp/KTPU_CI_OPENLOOP.json > /dev/null || {
+  rc=$?
+  echo "ci: open-loop smoke failed (rc=$rc)" >&2
+  exit "$rc"
+}
+python - <<'PY' || { echo "ci: open-loop artifact contract violated" >&2; exit 1; }
+import json, math
+art = json.load(open("/tmp/KTPU_CI_OPENLOOP.json"))
+assert art["latency_mode"] == "open-loop", art["latency_mode"]
+assert art["sli_count"] > 0
+for k in ("sli_p50_ms", "sli_p99_ms"):
+    assert math.isfinite(art[k]) and art[k] >= 0, (k, art[k])
+shares = sum(p["p99_share"] for p in art["sli_phases"].values())
+assert abs(shares - 1.0) < 1e-3, art["sli_phases"]
+PY
+
+echo "=== [5/5] bench regression gates ==="
 # exit 2 = no comparable same-platform artifact pair on this runner — the
 # gate is advisory there (CI boxes have no BENCH trajectory of their own);
 # a real regression (exit 1) still fails the build
@@ -84,5 +110,6 @@ run_gate --metric round_loop_fraction --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric device_flops --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric device_hbm_bytes --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric hbm_peak_bytes --current /tmp/KTPU_CI_PROFILE.json
+run_gate --metric sli_p99_ms --current /tmp/KTPU_CI_OPENLOOP.json
 
 echo "CI green"

@@ -60,6 +60,13 @@ class PerfData:
     batches: int = 1  # waves (batch-duration samples), NOT latency samples
     amortized_ms_per_pod: float = 0.0
     latency_source: str = "batch"
+    # how the run was DRIVEN, for the regression gate's comparability
+    # guard: "closed-loop" (snapshot/churn/stream rounds), "batch" (the
+    # latency_source=="batch" degenerate case — per-wave walls, p50==p99,
+    # never comparable against a real distribution) or "open-loop"
+    # (bench/loadgen.py replay artifacts).  bench/regression.py skips
+    # priors whose latency_mode differs when gating a latency metric.
+    latency_mode: str = "closed-loop"
     # error bar on per-pod-estimate latencies: the uniform-sweep assumption
     # was calibrated against true cumulative wall at chunk-prefix
     # boundaries (bench/latency_calibration.py, round 5: max |measured -
@@ -355,6 +362,9 @@ def _perfdata(name: str, snap: Snapshot, sched, n_pods: int, wall: float,
         batches=batch_hist.count if batch_hist else 0,
         amortized_ms_per_pod=round(wall * 1e3 / scheduled, 3) if scheduled else 0.0,
         latency_source=source,
+        # per-wave batch walls are p50==p99 degenerate: label them so the
+        # regression gate never compares them against a real distribution
+        latency_mode="batch" if source == "batch" else "closed-loop",
         latency_estimate_error=(
             "±5.5% wall fraction (cpu-sim, config-3 scale, r05; re-measure"
             " per backend/shape: bench/latency_calibration.py)"
@@ -793,6 +803,22 @@ def main(argv=None) -> None:
     ap.add_argument("--full", action="store_true", help="run BASELINE configs at full scale")
     ap.add_argument("--stream", type=int, metavar="WAVES",
                     help="run the host<->device pipelining benchmark instead")
+    ap.add_argument("--open-loop", metavar="TRACE",
+                    help="replay an arrival trace OPEN-LOOP against the "
+                         "scheduler (bench/loadgen.py): a named scenario "
+                         "(rollout|drain|storm, seeded by "
+                         "KTPU_OPEN_LOOP_SEED) or a path to a trace JSON.  "
+                         "SLI ages are stamped from the trace arrival "
+                         "timestamps (coordinated-omission-safe) and the "
+                         "artifact stamps sli_p50_ms/sli_p99_ms, the "
+                         "per-phase p99 shares and a decision_crc; the "
+                         "worst pods' span timelines export as a Perfetto "
+                         "trace next to --out")
+    ap.add_argument("--sli-attribution", action="store_true",
+                    help="with --open-loop: print the which-phase-owns-"
+                         "the-p99 table (per-phase p99 shares over "
+                         "pod_sli_phase_duration_seconds, the dominant "
+                         "phase and the worst-pod exemplars) to stderr")
     ap.add_argument("--no-pipeline", action="store_true",
                     help="serial encode->run->block loop and synchronous "
                          "batch commits (pre-pipeline numbers stay "
@@ -882,6 +908,11 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.chaos_sites and args.chaos is None:
         ap.error("--chaos-sites requires --chaos (it shapes the seeded storm)")
+    if args.sli_attribution and not args.open_loop:
+        ap.error("--sli-attribution pairs with --open-loop (the report "
+                 "reads the open-loop phase decomposition)")
+    if args.open_loop and args.stream:
+        ap.error("--open-loop and --stream are different drivers — pick one")
     if args.trace_device and not args.trace:
         ap.error("--trace-device requires --trace (the device trace pairs "
                  "with the host-span trace)")
@@ -1053,6 +1084,53 @@ def main(argv=None) -> None:
 
         if lockcheck.enabled():
             doc["lock_check"] = lockcheck.report()
+
+    if args.open_loop:
+        # the open-loop load observatory (bench/loadgen.py): replay the
+        # trace against a fresh scheduler with CO-safe SLI stamping, then
+        # emit ONE artifact — sli fields + phase shares top-level,
+        # attribution block, exemplar Perfetto export — through the same
+        # print-blob + --out + _stamp_analysis contract as every branch
+        from ..scheduler.tracing import TraceCollector
+        from .loadgen import (
+            SCENARIOS,
+            export_sli_exemplars,
+            load_or_build_trace,
+            render_attribution_table,
+            replay_trace,
+        )
+
+        try:
+            trace = load_or_build_trace(args.open_loop)
+        except ValueError as e:
+            ap.error(str(e))
+        collector = TraceCollector()
+        out, sched = replay_trace(trace, mode=args.mode, collector=collector)
+        base = (args.out[:-5] if args.out and args.out.endswith(".json")
+                else args.out) or f"OPENLOOP_{trace.scenario}"
+        if args.open_loop in SCENARIOS:
+            # generated traces save next to the artifact so the EXACT run
+            # replays from JSON (`--open-loop <path>`)
+            out["trace_path"] = trace.save(f"{base}.arrivals.json")
+        worst = [w["pod"] for w in out["sli_attribution"]["worst_pods"]]
+        out["sli_attribution"]["exemplar_export"] = export_sli_exemplars(
+            collector, worst, f"{base}.exemplars.trace.json"
+        )
+        if args.trace:
+            _export_trace(collector, f"{base}.trace.json")
+        if inj is not None:
+            out["chaos"] = _chaos_report()
+        _stamp_analysis(out)
+        if args.sli_attribution:
+            print(render_attribution_table(out), file=sys.stderr)
+        blob = json.dumps(out, indent=2)
+        print(blob)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(blob + "\n")
+        if metrics_srv is not None:
+            metrics_srv.stop()
+        return
 
     if args.stream:
         # KTPU_STREAM_SHAPE=PODSxNODES resizes the per-wave workload (the

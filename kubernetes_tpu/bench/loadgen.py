@@ -1,0 +1,564 @@
+"""Open-loop load observatory: arrival-trace generation + replay.
+
+The headline ROADMAP metric is `pod_scheduling_sli_duration_seconds` p99
+under OPEN-LOOP load — the reference never stops the world (activeQ +
+watch semantics), so a load generator that waits for the scheduler before
+sending the next pod (closed-loop) measures the wrong thing.  This module
+provides the missing half of the measurement plane:
+
+  - **Traces** (`ArrivalTrace`): a seeded, deterministic sequence of
+    arrival events, serialized as replayable JSON.  Three named bursty
+    scenarios ship built-in (`SCENARIOS`): `rollout` (deployment-rollout
+    ramp — geometric surge batches over a Poisson background), `drain`
+    (node-drain wave — a burst train of evicted pods re-arriving mid-run)
+    and `storm` (scale-to-zero storm — idle trickle, then one
+    instantaneous burst, then trailing Poisson).  Same trace + same seed
+    → identical arrival sequences, byte for byte.
+
+  - **Replay** (`replay_trace`): feeds a trace open-loop against a real
+    Scheduler with a COORDINATED-OMISSION-SAFE clock: every pod's SLI age
+    is stamped from the *trace* arrival timestamp via
+    `queue.stamp_arrival`, never from the instant the replay loop got
+    around to injecting it — so a stalled cycle inflates p99 honestly
+    instead of silently shrinking the measured backlog.  The default
+    pacing is VIRTUAL (no sleeps): the replay clock advances one quantum
+    per scheduling cycle and the queue's injectable FakeClock advances
+    with it, so backoff maturation — and therefore every scheduling
+    decision — is bit-reproducible across replays (`decision_crc`).
+    `KTPU_OPEN_LOOP_PACE=real` sleeps to the trace timeline instead
+    (`KTPU_OPEN_LOOP_SPEED` scales it) for wall-clock soak runs.
+
+  - **Attribution** (`sli_attribution` / `render_attribution_table`):
+    which phase owns the p99 — per-phase p99 shares over the
+    `pod_sli_phase_duration_seconds{phase=...}` decomposition the
+    scheduler observes at bind publication, the K worst pods' phase
+    vectors, and a Perfetto export of those pods' full span timelines
+    (`export_sli_exemplars`).
+
+Knobs: KTPU_OPEN_LOOP_QUANTUM_MS (replay cycle quantum, default 250),
+KTPU_OPEN_LOOP_PACE (virtual|real), KTPU_OPEN_LOOP_SPEED (real-pace
+multiplier), KTPU_OPEN_LOOP_SCALE (scenario size multiplier),
+KTPU_OPEN_LOOP_SEED (scenario seed for the named CLI path),
+KTPU_OPEN_LOOP_EXEMPLARS (worst-K, read by the scheduler).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+MILLI = 1000
+GI = 1024 ** 3
+
+TRACE_VERSION = 1
+
+# the weighted spot sizes real workloads request (same palette as
+# bench/workloads.py — keeps open-loop pods encodable into the identical
+# equivalence classes the closed-loop benches exercise)
+_CPU_CHOICES = (100, 250, 500, 1000)
+_MEM_MB_CHOICES = (128, 256, 512, 1024)
+
+
+@dataclass
+class ArrivalEvent:
+    """One pod arrival: trace-relative time + the pod's resource shape."""
+
+    t: float
+    name: str
+    cpu_m: int
+    mem_mb: int
+    priority: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "t": round(self.t, 6),
+            "name": self.name,
+            "cpu_m": self.cpu_m,
+            "mem_mb": self.mem_mb,
+            "priority": self.priority,
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ArrivalEvent":
+        return cls(
+            t=float(doc["t"]),
+            name=str(doc["name"]),
+            cpu_m=int(doc["cpu_m"]),
+            mem_mb=int(doc["mem_mb"]),
+            priority=int(doc.get("priority", 0)),
+        )
+
+
+@dataclass
+class ArrivalTrace:
+    """A replayable open-loop arrival trace (seeded, deterministic)."""
+
+    name: str
+    scenario: str
+    seed: int
+    nodes: int
+    duration_s: float
+    events: List[ArrivalEvent] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "version": TRACE_VERSION,
+            "name": self.name,
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "nodes": self.nodes,
+            "duration_s": round(self.duration_s, 6),
+            "events": [e.to_json() for e in self.events],
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ArrivalTrace":
+        v = int(doc.get("version", TRACE_VERSION))
+        if v > TRACE_VERSION:
+            raise ValueError(f"trace version {v} is newer than {TRACE_VERSION}")
+        return cls(
+            name=str(doc["name"]),
+            scenario=str(doc.get("scenario", doc["name"])),
+            seed=int(doc.get("seed", 0)),
+            nodes=int(doc["nodes"]),
+            duration_s=float(doc["duration_s"]),
+            events=[ArrivalEvent.from_json(e) for e in doc["events"]],
+        )
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ArrivalTrace":
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+    def fingerprint(self) -> str:
+        """crc32 over the canonical serialization — two generations (or a
+        save/load round-trip) with identical arrival sequences fingerprint
+        identically."""
+        blob = json.dumps(self.to_json(), sort_keys=True).encode()
+        return f"{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
+
+
+# --- arrival-shape primitives ---
+
+def poisson_arrivals(rng: random.Random, rate: float,
+                     t0: float, t1: float) -> List[float]:
+    """Homogeneous Poisson arrivals at `rate`/s over [t0, t1) —
+    exponential inter-arrival gaps from the seeded rng."""
+    out: List[float] = []
+    if rate <= 0.0:
+        return out
+    t = t0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= t1:
+            return out
+        out.append(t)
+
+
+def burst_train(t0: float, bursts: int, size: int, spacing: float,
+                jitter: float = 0.0,
+                rng: Optional[random.Random] = None) -> List[float]:
+    """`bursts` bursts of `size` near-simultaneous arrivals, `spacing`
+    seconds apart, each arrival jittered by U[0, jitter) — the node-drain
+    / controller-resync shape (a wave per drained node)."""
+    out: List[float] = []
+    for b in range(bursts):
+        base = t0 + b * spacing
+        for _ in range(size):
+            dt = rng.uniform(0.0, jitter) if (rng is not None and jitter > 0) else 0.0
+            out.append(base + dt)
+    return out
+
+
+def _mk_events(scenario: str, rng: random.Random,
+               times: List[float], priorities: Optional[List[int]] = None
+               ) -> List[ArrivalEvent]:
+    """Times -> named events in chronological order.  Resource shapes are
+    drawn from the seeded rng AFTER sorting, so the (time, shape) pairing
+    — and hence every downstream scheduling decision — is a pure function
+    of (scenario, seed)."""
+    order = sorted(range(len(times)), key=lambda i: times[i])
+    events = []
+    for k, i in enumerate(order):
+        events.append(ArrivalEvent(
+            t=round(times[i], 6),
+            name=f"{scenario}-{k:05d}",
+            cpu_m=rng.choice(_CPU_CHOICES),
+            mem_mb=rng.choice(_MEM_MB_CHOICES),
+            priority=(priorities[i] if priorities is not None else 0),
+        ))
+    return events
+
+
+def _scale() -> float:
+    try:
+        return max(0.01, float(os.environ.get("KTPU_OPEN_LOOP_SCALE", "1")))
+    except ValueError:
+        return 1.0
+
+
+# --- the three named scenarios ---
+
+def rollout_trace(seed: int = 0, scale: Optional[float] = None) -> ArrivalTrace:
+    """Deployment-rollout ramp: geometric surge batches (a controller
+    scaling up replicas wave by wave) over a light Poisson background —
+    the load grows faster than a fixed-rate generator would ever drive."""
+    scale = _scale() if scale is None else scale
+    rng = random.Random(seed)
+    times: List[float] = []
+    for k in range(10):  # surge wave k: ~3 * 1.4^k pods at t = 0.6k
+        size = max(1, round(3 * (1.4 ** k) * scale))
+        times.extend(burst_train(0.6 * k, 1, size, 0.0, jitter=0.08, rng=rng))
+    times.extend(poisson_arrivals(rng, 4.0 * scale, 0.0, 6.0))
+    return ArrivalTrace(
+        name=f"rollout-s{seed}", scenario="rollout", seed=seed,
+        nodes=max(4, round(24 * min(1.0, scale))), duration_s=6.0,
+        events=_mk_events("rollout", rng, times),
+    )
+
+
+def drain_trace(seed: int = 0, scale: Optional[float] = None) -> ArrivalTrace:
+    """Node-drain wave: steady Poisson background, then four node-sized
+    eviction bursts back to back at t=4 — the drained pods re-arrive at
+    elevated priority (they were running; their controllers recreate them
+    ahead of new work)."""
+    scale = _scale() if scale is None else scale
+    rng = random.Random(seed)
+    bg = poisson_arrivals(rng, 10.0 * scale, 0.0, 8.0)
+    per_node = max(1, round(25 * scale))
+    drain = burst_train(4.0, 4, per_node, 0.3, jitter=0.05, rng=rng)
+    times = bg + drain
+    prios = [0] * len(bg) + [100] * len(drain)
+    return ArrivalTrace(
+        name=f"drain-s{seed}", scenario="drain", seed=seed,
+        nodes=max(4, round(24 * min(1.0, scale))), duration_s=8.0,
+        events=_mk_events("drain", rng, times, prios),
+    )
+
+
+def storm_trace(seed: int = 0, scale: Optional[float] = None) -> ArrivalTrace:
+    """Scale-to-zero storm: near-idle trickle, then EVERYTHING arrives in
+    one instant (a serverless platform waking a scaled-to-zero fleet),
+    then a trailing Poisson of stragglers.  The largest shipped trace —
+    tier-1 exercises it only under the `slow` marker."""
+    scale = _scale() if scale is None else scale
+    rng = random.Random(seed)
+    trickle = poisson_arrivals(rng, 1.0 * scale, 0.0, 6.0)
+    burst = burst_train(6.0, 1, max(1, round(600 * scale)), 0.0)
+    tail = poisson_arrivals(rng, 5.0 * scale, 6.0, 10.0)
+    return ArrivalTrace(
+        name=f"storm-s{seed}", scenario="storm", seed=seed,
+        nodes=max(4, round(32 * min(1.0, scale))), duration_s=10.0,
+        events=_mk_events("storm", rng, trickle + burst + tail),
+    )
+
+
+SCENARIOS = {
+    "rollout": rollout_trace,
+    "drain": drain_trace,
+    "storm": storm_trace,
+}
+
+
+def load_or_build_trace(spec: str, seed: Optional[int] = None) -> ArrivalTrace:
+    """`spec` is a named scenario (rollout|drain|storm; seeded by
+    KTPU_OPEN_LOOP_SEED unless `seed` given) or a path to a trace JSON."""
+    if spec in SCENARIOS:
+        if seed is None:
+            try:
+                seed = int(os.environ.get("KTPU_OPEN_LOOP_SEED", "0"))
+            except ValueError:
+                seed = 0
+        return SCENARIOS[spec](seed=seed)
+    if os.path.exists(spec):
+        return ArrivalTrace.load(spec)
+    raise ValueError(
+        f"unknown trace {spec!r}: not a named scenario "
+        f"({'|'.join(sorted(SCENARIOS))}) and no such file"
+    )
+
+
+# --- replay ---
+
+def _mk_nodes(n: int):
+    from ..api import types as t
+
+    return [
+        t.Node(
+            name=f"node-{i}",
+            allocatable={t.CPU: 32 * MILLI, t.MEMORY: 128 * GI, t.PODS: 110},
+            labels={t.LABEL_ZONE: f"zone-{i % 3}"},
+        )
+        for i in range(n)
+    ]
+
+
+def _mk_pod(ev: ArrivalEvent):
+    from ..api import types as t
+
+    return t.Pod(
+        name=ev.name,
+        requests={t.CPU: ev.cpu_m, t.MEMORY: ev.mem_mb * 1024 ** 2},
+        priority=ev.priority,
+    )
+
+
+def phase_stats(metrics) -> Dict[str, dict]:
+    """Per-phase (p50_ms, p99_ms, count, p99_share) over the
+    pod_sli_phase_duration_seconds decomposition.  Shares are each phase's
+    fraction of the summed per-phase p99s — they sum to ~1.0 by
+    construction, and because a pod's phases telescope exactly to its SLI,
+    the dominant share genuinely names the window that owns the tail."""
+    from ..scheduler.metrics import SLI_PHASES
+
+    out: Dict[str, dict] = {}
+    p99s: Dict[str, float] = {}
+    for ph in SLI_PHASES:
+        p50, p99, count = metrics.labeled_hist(
+            "pod_sli_phase_duration_seconds", phase=ph
+        ).stats()
+        out[ph] = {
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "count": count,
+        }
+        p99s[ph] = p99
+    total = sum(p99s.values())
+    for ph in SLI_PHASES:
+        out[ph]["p99_share"] = round(p99s[ph] / total, 4) if total > 0 else 0.0
+    return out
+
+
+def sli_attribution(metrics, sched) -> dict:
+    """The --sli-attribution block: per-phase shares + dominant phase +
+    the worst-K exemplar pods' phase vectors."""
+    phases = phase_stats(metrics)
+    dominant = max(phases, key=lambda ph: phases[ph]["p99_share"])
+    return {
+        "phases": phases,
+        "dominant_phase": dominant,
+        "worst_pods": sched.worst_sli_pods(),
+        "exemplar_export": None,  # stamped by the harness after export
+    }
+
+
+def replay_trace(
+    trace: ArrivalTrace,
+    mode: str = "tpu",
+    collector=None,
+    quantum_s: Optional[float] = None,
+    pace: Optional[str] = None,
+    max_barren_cycles: int = 64,
+):
+    """Replay `trace` open-loop against a fresh Scheduler; returns
+    (artifact dict, scheduler).
+
+    Each replay cycle injects every event whose trace time is due, stamps
+    its coordinated-omission-safe arrival (see module docstring), runs the
+    production cycle driver once (`run_until_idle` — the deferred-commit
+    pipeline engages exactly as in a streaming run), then advances both
+    the virtual replay clock and the queue's FakeClock by one quantum so
+    backoff maturation is replay-deterministic.  After the trace drains,
+    `max_barren_cycles` consecutive cycles without a new bind ends the
+    run; whatever is still pending is reported as unschedulable rather
+    than spinning forever."""
+    from ..scheduler import ClusterStore, Scheduler, SchedulerConfiguration
+    from ..scheduler.flightrecorder import fingerprint
+    from ..scheduler.metrics import Metrics, reset_run_state
+    from ..scheduler.queue import FakeClock
+    from ..scheduler.tracing import TraceCollector
+
+    if quantum_s is None:
+        try:
+            quantum_s = float(
+                os.environ.get("KTPU_OPEN_LOOP_QUANTUM_MS", "250")) / 1e3
+        except ValueError:
+            quantum_s = 0.25
+    quantum_s = max(1e-3, quantum_s)
+    pace = pace or os.environ.get("KTPU_OPEN_LOOP_PACE", "virtual")
+    try:
+        speed = max(1e-3, float(os.environ.get("KTPU_OPEN_LOOP_SPEED", "1")))
+    except ValueError:
+        speed = 1.0
+
+    if collector is None:
+        collector = TraceCollector()
+    metrics = Metrics()
+    reset_run_state(metrics=metrics, collector=collector)
+    store = ClusterStore()
+    for node in _mk_nodes(trace.nodes):
+        store.add_node(node)
+    clk = FakeClock()
+    sched = Scheduler(
+        store, SchedulerConfiguration(mode=mode),
+        clock=clk, collector=collector, metrics=metrics,
+    )
+
+    events = sorted(trace.events, key=lambda e: (e.t, e.name))
+    t_wall0 = time.perf_counter()
+    v_now = 0.0
+    i = 0
+    cycles = 0
+    barren = 0
+    bound_prev = 0
+    while True:
+        while i < len(events) and events[i].t <= v_now + 1e-9:
+            ev = events[i]
+            pod = _mk_pod(ev)
+            store.add_pod(pod)  # watch admission stamps a send-time arrival
+            # ... which the trace arrival instant immediately back-dates:
+            # the CO-safe clock.  Virtual pace: age = how far the replay
+            # clock has run past the trace timestamp.  Real pace: the
+            # wall instant the trace said the pod arrives.
+            if pace == "real":
+                sched.queue.stamp_arrival(pod.uid, t_wall0 + ev.t / speed)
+            else:
+                sched.queue.stamp_arrival(
+                    pod.uid, time.perf_counter() - (v_now - ev.t))
+            i += 1
+        pending = sched.queue.pending_total
+        if i >= len(events) and pending == 0:
+            break
+        if pending:
+            sched.run_until_idle()
+        bound = sum(1 for p in store.list_pods() if p.node_name)
+        barren = 0 if bound > bound_prev else barren + 1
+        bound_prev = bound
+        cycles += 1
+        if i >= len(events) and barren >= max_barren_cycles:
+            break  # permanently-unschedulable leftovers: report, don't spin
+        v_now += quantum_s
+        clk.step(quantum_s)
+        if pace == "real":
+            target = t_wall0 + v_now / speed
+            now = time.perf_counter()
+            if now < target:
+                time.sleep(target - now)
+    wall_s = time.perf_counter() - t_wall0
+
+    from .harness import sli_fields
+
+    assignments = {
+        p.name: p.node_name for p in store.list_pods() if p.node_name
+    }
+    leftover = sched.queue.pending_total
+    artifact = {
+        "name": f"open-loop:{trace.name}",
+        "latency_mode": "open-loop",
+        "platform": _platform(),
+        "scenario": trace.scenario,
+        "seed": trace.seed,
+        "trace_crc": trace.fingerprint(),
+        "trace_events": len(events),
+        "trace_duration_s": trace.duration_s,
+        "nodes": trace.nodes,
+        "pods": len(events),
+        "scheduled": len(assignments),
+        "unschedulable": leftover,
+        "cycles": cycles,
+        "quantum_ms": round(quantum_s * 1e3, 3),
+        "pace": pace,
+        "wall_s": round(wall_s, 4),
+        # sorted-name map: replays injecting in a different cycle pattern
+        # but deciding identically must fingerprint identically
+        "decision_crc": fingerprint(dict(sorted(assignments.items()))),
+        **sli_fields(metrics),
+        "sli_phases": phase_stats(metrics),
+        "sli_attribution": sli_attribution(metrics, sched),
+    }
+    return artifact, sched
+
+
+def _platform() -> str:
+    """Artifact platform label, same vocabulary as bench.py/matrix.py
+    (cross-platform latencies differ 20-40x; the regression gate skips
+    mismatched priors)."""
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no jax, no devices: still an artifact
+        backend = "cpu"
+    return backend if backend != "cpu" else "cpu-sim-fallback"
+
+
+# --- reports ---
+
+def render_attribution_table(artifact: dict) -> str:
+    """Human-readable --sli-attribution report: the per-phase share table,
+    the dominant phase, and the worst-pod exemplars."""
+    att = artifact.get("sli_attribution", {})
+    phases = att.get("phases", {})
+    lines = [
+        f"open-loop SLI attribution — scenario={artifact.get('scenario')} "
+        f"seed={artifact.get('seed')} pods={artifact.get('pods')} "
+        f"p50={artifact.get('sli_p50_ms')}ms p99={artifact.get('sli_p99_ms')}ms "
+        f"(n={artifact.get('sli_count')})",
+        f"{'phase':<14} {'p50_ms':>10} {'p99_ms':>10} {'p99_share':>10}",
+    ]
+    for ph, st in phases.items():
+        lines.append(
+            f"{ph:<14} {st['p50_ms']:>10.3f} {st['p99_ms']:>10.3f} "
+            f"{st['p99_share']:>10.4f}"
+        )
+    dom = att.get("dominant_phase")
+    if dom in phases:
+        lines.append(
+            f"dominant phase: {dom} "
+            f"(owns {phases[dom]['p99_share'] * 100:.1f}% of the p99)"
+        )
+    worst = att.get("worst_pods") or []
+    if worst:
+        lines.append("worst pods (exemplars):")
+        for w in worst:
+            vec = "  ".join(
+                f"{ph}={v:.3f}ms" for ph, v in w["phases_ms"].items()
+            )
+            lines.append(f"  {w['pod']}  sli={w['sli_ms']:.3f}ms  {vec}")
+    if att.get("exemplar_export"):
+        lines.append(f"exemplar Perfetto export: {att['exemplar_export']}")
+    return "\n".join(lines)
+
+
+def export_sli_exemplars(collector, pod_uids, path: str) -> Optional[str]:
+    """Perfetto/chrome-trace export of the exemplar pods' FULL span
+    timelines: every span on a trace chain that touched one of the worst-K
+    pods (queue.wait, batch.* cycle spans, bind instants, pipeline
+    overlap spans), so the attribution table's tail numbers can be read
+    against real timelines.  Returns the path, or None with no spans."""
+    uids = set(pod_uids)
+    if not uids:
+        return None
+    trace_ids = {
+        s.trace_id
+        for s in collector.spans()
+        if s.trace_id and s.attributes.get("pod") in uids
+    }
+    if not trace_ids:
+        return None
+    doc = collector.chrome_trace()
+    events = [
+        ev for ev in doc.get("traceEvents", [])
+        if ev.get("ph") == "M"
+        or (ev.get("args") or {}).get("trace_id") in trace_ids
+    ]
+    doc = dict(doc, traceEvents=events)
+    doc["otherData"] = dict(
+        doc.get("otherData", {}),
+        exemplar_pods=sorted(uids),
+        exemplar_spans=sum(1 for ev in events if ev.get("ph") != "M"),
+    )
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

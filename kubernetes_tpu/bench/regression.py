@@ -107,6 +107,17 @@ def _metric(rec: Dict, name: str) -> Optional[float]:
     return None
 
 
+# latency-metric basenames whose values are only comparable between runs
+# DRIVEN the same way: a batch artifact's p99 is a per-wave wall (p50==p99
+# degenerate) and an open-loop p99 includes trace-timed queue backlog —
+# comparing either against a closed-loop distribution gates noise.  The
+# guard keys on the metric's last path segment so nested forms
+# (`foo.sli_p99_ms`) get it too, and applies ONLY to these metrics: value /
+# step_s / comm_bytes comparisons stay valid across driver modes (and
+# across old artifacts that predate the latency_mode stamp).
+LATENCY_METRICS = ("sli_p50_ms", "sli_p99_ms", "p50_ms", "p90_ms", "p99_ms")
+
+
 def check_regression(
     trajectory: List[Tuple[str, Dict]],
     current: Tuple[str, Dict],
@@ -115,8 +126,9 @@ def check_regression(
     threshold: float = 0.1,
 ) -> Dict:
     """The gate: compare `current` against the best PRIOR same-platform run
-    on `metric`.  Returns a machine-readable verdict dict with `status` in
-    {"pass", "regression", "error"}."""
+    on `metric` (same latency_mode too, for latency metrics — see
+    LATENCY_METRICS).  Returns a machine-readable verdict dict with
+    `status` in {"pass", "regression", "error"}."""
     cur_name, cur = current
     cur_v = _metric(cur, metric)
     if cur_v is None:
@@ -126,6 +138,8 @@ def check_regression(
             "current": cur_name,
         }
     platform = cur.get("platform", "unknown")
+    guard_mode = metric.split(".")[-1] in LATENCY_METRICS
+    latency_mode = cur.get("latency_mode")
     prior: List[Tuple[str, float]] = []
     skipped: List[str] = []
     for name, rec in trajectory:
@@ -133,6 +147,11 @@ def check_regression(
             continue
         if rec.get("platform", "unknown") != platform:
             skipped.append(f"{name} (platform {rec.get('platform', 'unknown')!r})")
+            continue
+        if guard_mode and rec.get("latency_mode") != latency_mode:
+            skipped.append(
+                f"{name} (latency_mode {rec.get('latency_mode')!r} != "
+                f"{latency_mode!r})")
             continue
         v = _metric(rec, metric)
         if v is None:

@@ -107,6 +107,20 @@ class PipelinedBatchLoop:
         self.commit = commit
         self.tracer = tracer
         self.metrics = metrics
+        # wave-uniform SLI phase decomposition (scheduler/metrics.py —
+        # SLI_PHASES): the loop has no queue, so queue_wait is observed as
+        # 0, wave_wait is the encode/dispatch window, device_kernel the
+        # dispatch -> fetch window and bind the decode + commit fan-out.
+        # Cached handles, one bucket bump per phase per wave.
+        self._phase_hists = None
+        if metrics is not None:
+            from ..scheduler.metrics import SLI_PHASES
+
+            self._phase_hists = {
+                ph: metrics.labeled_hist("pod_sli_phase_duration_seconds",
+                                         phase=ph)
+                for ph in SLI_PHASES
+            }
         # incremental warm-cycle hoist (ops/incremental.py): equivalence-
         # class deduped scores resident on device across cycles, dirty-node
         # patched per warm delta.  Passed to the routed step as a separate,
@@ -368,9 +382,19 @@ class PipelinedBatchLoop:
             # only observes at bind publication.
             n_bound = sum(1 for v in verdicts.values() if v is not None)
             if n_bound:
+                # one t_end for the SLI sample AND the bind phase so the
+                # wave's phases telescope exactly to its SLI
+                t_end = time.perf_counter()
                 self.metrics.hist(
                     "pod_scheduling_sli_duration_seconds"
-                ).observe(time.perf_counter() - t_arrival, n=n_bound)
+                ).observe(t_end - t_arrival, n=n_bound)
+                self._phase_hists["queue_wait"].observe(0.0, n=n_bound)
+                self._phase_hists["wave_wait"].observe(
+                    max(0.0, t_dispatch - t_arrival), n=n_bound)
+                self._phase_hists["device_kernel"].observe(
+                    max(0.0, t1 - t_dispatch), n=n_bound)
+                self._phase_hists["bind"].observe(
+                    max(0.0, t_end - t1), n=n_bound)
         return verdicts
 
     # the step dispatched after the one being collected (None outside that

@@ -154,6 +154,23 @@ def render_flight(doc: Dict) -> str:
                 f" src={mem.get('source', '?')}]"
             )
         out.append(line)
+        sli = r.get("sli_phases")
+        if isinstance(sli, dict):
+            # the per-wave SLI phase block (scheduler._sli_phase_block):
+            # where did this cycle's bound pods spend their latency, and
+            # which pod was slowest?
+            mean = sli.get("mean_ms")
+            mean = mean if isinstance(mean, dict) else {}
+            dom = max(mean, key=lambda k: mean[k]) if mean else "?"
+            worst = sli.get("worst")
+            worst = worst if isinstance(worst, dict) else {}
+            out.append(
+                f"        sli x{sli.get('pods', '?')} pods:"
+                f" dominant={dom}"
+                f" mean_ms={{{', '.join(f'{k}={v}' for k, v in mean.items())}}}"
+                f" worst={worst.get('pod', '?')}"
+                f"@{worst.get('sli_ms', '?')}ms"
+            )
         diagnosis = r.get("diagnosis")
         for d in diagnosis if isinstance(diagnosis, list) else []:
             if not isinstance(d, dict):

@@ -72,6 +72,22 @@ DEFAULT_BUCKET_BOUNDS: Tuple[float, ...] = tuple(
     1e-6 * (2.0 ** k) for k in range(28)
 )
 
+# Per-pod SLI phase decomposition: adjacent windows of the arrival → bind
+# SLI, observed as pod_sli_phase_duration_seconds{phase=...} labeled
+# StreamingHists at bind publication (scheduler.py — _observe_sli_phases;
+# parallel/pipeline.py observes the wave-uniform analog).  The boundaries
+# come from the span machinery's instants:
+#   queue_wait     queue admission → activeQ pop (the queue.wait span)
+#   wave_wait      pop → the deciding kernel's dispatch (batch.kernel start;
+#                  the encode window in the pipelined loop)
+#   device_kernel  kernel dispatch → the pod's decision ready (commit-ordinal
+#                  estimate; the device.step window in the pipelined loop)
+#   bind           decision ready → bind publication (deferred-commit
+#                  latency included)
+# The four instants are clamped to a monotone chain, so a pod's phases sum
+# EXACTLY to its SLI sample — the attribution table's shares are exhaustive.
+SLI_PHASES: Tuple[str, ...] = ("queue_wait", "wave_wait", "device_kernel", "bind")
+
 
 class StreamingHist:
     """Bounded-memory streaming histogram: fixed buckets, O(1)-ish observe,

@@ -113,6 +113,12 @@ class PriorityQueue:
         # and consumed/popped at bind publication (take_arrival) or delete,
         # so the table stays bounded by in-flight pods.
         self._arrival_at: Dict[str, float] = {}
+        # uid -> latest activeQ-pop instant (tracer-gated, like _enq_at):
+        # the queue_wait/wave_wait boundary of the per-pod SLI phase
+        # decomposition (pod_sli_phase_duration_seconds — scheduler.py
+        # _observe_sli_phases).  Consumed at bind publication (take_popped)
+        # or delete, so the table stays bounded like _arrival_at.
+        self._popped_at: Dict[str, float] = {}
         self._seq = itertools.count()
         self._active: List[_Item] = []  # heap
         self._active_uids: Set[str] = set()
@@ -246,6 +252,10 @@ class PriorityQueue:
                 self._attempts[item.pod.uid] = self._attempts.get(item.pod.uid, 0) + 1
                 tr = self._tracer
                 if tr is not None and tr.enabled:
+                    # latest pop wins: after a retry the wait that counts
+                    # toward queue_wait is everything up to the pop that
+                    # finally led to the bind
+                    self._popped_at[item.pod.uid] = _time.perf_counter()
                     t0 = self._enq_at.pop(item.pod.uid, None)
                     if t0 is not None:
                         # enqueue -> pop as a finished span on the pod's
@@ -358,6 +368,28 @@ class PriorityQueue:
         tracks (a later re-add of the same uid restarts the clock)."""
         return self._arrival_at.pop(pod_uid, None)
 
+    @_locked
+    def take_popped(self, pod_uid: str) -> Optional[float]:
+        """Pop and return the pod's latest activeQ-pop instant — the
+        queue_wait/wave_wait boundary of the SLI phase decomposition.
+        None when tracing was off or the pod never popped (same lifecycle
+        as the queue.wait span it pairs with)."""
+        return self._popped_at.pop(pod_uid, None)
+
+    @_locked
+    def stamp_arrival(self, pod_uid: str, at: float) -> None:
+        """Override the pod's first-admission instant with an EXTERNAL
+        arrival timestamp (perf_counter domain, possibly in the past) —
+        the open-loop replay's coordinated-omission-safe clock
+        (bench/loadgen.py): SLI age is measured from the TRACE arrival
+        instant, never from send time, so a stalled replay cycle inflates
+        p99 honestly instead of hiding the backlog.  Earliest stamp wins,
+        matching add()'s first-admission-wins contract in either call
+        order."""
+        cur = self._arrival_at.get(pod_uid)
+        if cur is None or at < cur:
+            self._arrival_at[pod_uid] = at
+
     # --- crash-restart SLI continuity (scheduler/checkpoint.py) ---
     @_locked
     def export_arrivals(self) -> Dict[str, float]:
@@ -388,6 +420,7 @@ class PriorityQueue:
         self._active_uids.discard(pod_uid)
         self._enq_at.pop(pod_uid, None)
         self._arrival_at.pop(pod_uid, None)
+        self._popped_at.pop(pod_uid, None)
         self._unschedulable.pop(pod_uid, None)
         self._parked_at.pop(pod_uid, None)
         self._no_flush.discard(pod_uid)

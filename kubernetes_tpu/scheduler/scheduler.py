@@ -26,6 +26,9 @@ event kinds.
 from __future__ import annotations
 
 import contextlib
+import heapq
+import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -42,7 +45,7 @@ from .config import SchedulerConfiguration
 from .events import EventRecorder
 from .features import FeatureGates
 from .framework import CycleState, Framework, NodeInfo, Status
-from .metrics import Metrics
+from .metrics import Metrics, SLI_PHASES
 from .plugins.cpu import default_plugins
 from .queue import (
     EV_NODE_ADD,
@@ -55,6 +58,35 @@ from .queue import (
 from .state import ScaledState
 from .store import ClusterStore, Event
 from ..analysis.lockcheck import make_lock
+
+
+def _sli_phase_block(wave_phases: Dict[str, Dict[str, float]]) -> dict:
+    """Compact per-cycle phase summary for the flight recorder: per-phase
+    mean/max across the cycle's bound pods plus the worst pod's full phase
+    vector (a record stays a few hundred bytes at any wave size)."""
+    n = len(wave_phases)
+    total = {ph: 0.0 for ph in SLI_PHASES}
+    peak = {ph: 0.0 for ph in SLI_PHASES}
+    worst_uid, worst_sli, worst_vec = "", -1.0, {}
+    for uid, phases in wave_phases.items():
+        sli = sum(phases.values())
+        if sli > worst_sli:
+            worst_uid, worst_sli, worst_vec = uid, sli, phases
+        for ph, v in phases.items():
+            total[ph] += v
+            if v > peak[ph]:
+                peak[ph] = v
+    return {
+        "pods": n,
+        "mean_ms": {ph: round(total[ph] / n * 1e3, 3) for ph in SLI_PHASES},
+        "max_ms": {ph: round(peak[ph] * 1e3, 3) for ph in SLI_PHASES},
+        "worst": {
+            "pod": worst_uid,
+            "sli_ms": round(worst_sli * 1e3, 3),
+            "phases_ms": {ph: round(v * 1e3, 3)
+                          for ph, v in worst_vec.items()},
+        },
+    }
 
 
 class Scheduler:
@@ -114,6 +146,40 @@ class Scheduler:
         # no per-pod bookkeeping off the enabled path).
         self.last_wave_sli: Dict[str, float] = {}
         self.last_wave_estimates: Dict[str, float] = {}
+        # per-pod SLI phase decomposition (queue_wait | wave_wait |
+        # device_kernel | bind — metrics.py SLI_PHASES): labeled
+        # StreamingHists observed at bind publication from the span
+        # machinery's instants, so all per-pod bookkeeping here sits behind
+        # the same tracer.enabled cheap gate as the spans themselves.
+        # Cached handles, one lock per phase per bound pod.
+        self._phase_hists = {
+            ph: self.metrics.labeled_hist("pod_sli_phase_duration_seconds",
+                                          phase=ph)
+            for ph in SLI_PHASES
+        }
+        # uid -> (kernel dispatch instant, decision-ready instant): the
+        # wave_wait/device_kernel/bind boundaries, stamped per kernel wave
+        # from the commit-ordinal estimates and consumed at publication —
+        # deferred binds keep their marks until the flush, so `bind`
+        # honestly includes the deferral window.
+        self._phase_marks: Dict[str, Tuple[float, float]] = {}
+        # uid -> phase vector for pods bound this batch cycle (cleared at
+        # each cycle start): the flight recorder stamps this per record so
+        # a post-kill dump shows the latency anatomy of in-flight pods.
+        self.last_wave_phases: Dict[str, Dict[str, float]] = {}
+        # bounded worst-K exemplar heap for the open-loop observatory's
+        # --sli-attribution report: (sli, seq, uid, phases), min-heap on
+        # sli so the K worst survive; seq breaks ties (dicts don't compare)
+        self._sli_worst: List[Tuple[float, int, str, Dict[str, float]]] = []
+        self._sli_worst_seq = itertools.count()
+        # binding-cycle worker threads publish concurrently on the CPU
+        # path — heapq ops are not atomic, unlike the per-uid dict writes
+        self._sli_worst_lock = make_lock("Scheduler._sli_worst_lock")
+        try:
+            self._sli_worst_k = max(
+                1, int(os.environ.get("KTPU_OPEN_LOOP_EXEMPLARS", "5")))
+        except ValueError:
+            self._sli_worst_k = 5
         self.events = EventRecorder(store=store, metrics=self.metrics)
         from .klog import Logger
 
@@ -191,8 +257,6 @@ class Scheduler:
         # reserved synchronously through cache.assume either way, so every
         # encode sees identical bound state.  KTPU_PIPELINE=0 (or the
         # config knob) restores the fully synchronous commit.
-        import os
-
         self._pipeline_commit = (
             config.pipeline_commit and os.environ.get("KTPU_PIPELINE") != "0"
         )
@@ -921,6 +985,9 @@ class Scheduler:
         per-profile programs could never reach quorum in any of them
         (cross-profile gang livelock, round-3 advisor finding)."""
         t0 = time.perf_counter()
+        if self.last_wave_phases:
+            # per-cycle phase anatomy: this cycle's binds repopulate it
+            self.last_wave_phases = {}
         self._sample_queue_depths()  # pre-drain: the activeQ's true depth
         batch: List[t.Pod] = self.queue.pop_all()
         if not batch:
@@ -1260,6 +1327,16 @@ class Scheduler:
                            for k in range(meta.n_pods)]
                           if self.tracer.enabled else None),
                 )
+                if self.tracer.enabled and self.last_wave_estimates:
+                    # phase-decomposition marks: the kernel dispatch instant
+                    # and the pod's decision-ready instant (dispatch +
+                    # commit-ordinal estimate).  Consumed at bind
+                    # publication (_observe_sli_phases); a deferred bind
+                    # keeps its marks until the flush, so `bind` honestly
+                    # includes the deferral window.  A failed pod's marks
+                    # are dropped in the commit loop; a retry re-stamps.
+                    for uid, est in self.last_wave_estimates.items():
+                        self._phase_marks[uid] = (t_k0, t_k0 + est)
             verdicts = {
                 uid_of[meta.pod_names[k]]: (
                     meta.node_names[int(choices[k])] if int(choices[k]) >= 0 else None
@@ -1370,6 +1447,12 @@ class Scheduler:
             )
         if self._last_diagnosis:
             rec["diagnosis"] = self._last_diagnosis
+        if self.last_wave_phases:
+            # latency anatomy of the pods bound this cycle (tracer-gated,
+            # like the vectors themselves): per-phase mean/max across the
+            # wave plus the worst pod's full phase vector — a post-kill
+            # dump answers "where were the in-flight pods spending time"
+            rec["sli_phases"] = _sli_phase_block(self.last_wave_phases)
         if self._memwatch is not None:
             # the compact HBM block (memwatch.py — in-use/peak/resident/
             # unaccounted): a post-mortem reading the dump can answer
@@ -1416,6 +1499,9 @@ class Scheduler:
                 else:
                     failed.append(pod)
                     result[pod.name] = None
+                    # a failed pod's retry wave re-stamps fresh marks; keep
+                    # the table bounded by pods awaiting publication
+                    self._phase_marks.pop(pod.uid, None)
             if failed:
                 # the preemption loop below reads AND mutates the store
                 # (victim evictions); its view must match the serial loop's,
@@ -1681,6 +1767,7 @@ class Scheduler:
                         # reservation died with the Deleted event; never
                         # resurrect the pod as bound
                         self.cache.forget(pod.uid)
+                        self._phase_marks.pop(pod.uid, None)
                         continue
                     if cur.node_name == node_name:
                         continue  # already published (a crashed flush retried)
@@ -1728,16 +1815,77 @@ class Scheduler:
         the deferral."""
         arrived = self.queue.take_arrival(pod_uid)
         if arrived is None:
+            self._phase_marks.pop(pod_uid, None)
             return  # bound outside the queue's lifecycle (direct store bind)
-        sli = time.perf_counter() - arrived
+        now = time.perf_counter()
+        sli = now - arrived
         self._sli_hist.observe(sli)
-        if self.tracer.enabled and pod_uid in self.last_wave_estimates:
+        if not self.tracer.enabled:
+            return
+        self._observe_sli_phases(pod_uid, arrived, now, sli)
+        if pod_uid in self.last_wave_estimates:
             # per-wave introspection, scoped to the pods of the CURRENT
             # batch-kernel wave (the only producer of estimates): gating on
             # membership keeps the dict bounded by wave size on every bind
             # path — the CPU binding cycle and other non-batch paths never
             # populate estimates, so they never accumulate entries here
             self.last_wave_sli[pod_uid] = sli
+
+    def _observe_sli_phases(
+        self, pod_uid: str, arrived: float, now: float, sli: float
+    ) -> None:
+        """Decompose one pod's SLI into the four adjacent phase windows
+        (metrics.py — SLI_PHASES) from the span machinery's instants: the
+        queue's pop stamp and this wave's kernel marks.  The instants are
+        clamped to a monotone chain arrived <= popped <= k0 <= ready <= now,
+        so the phases telescope to EXACTLY the SLI sample — the attribution
+        report's shares are exhaustive by construction.  Paths without
+        kernel marks (CPU binding cycle, restore replays) collapse
+        wave_wait/device_kernel to zero and attribute the remainder to
+        queue_wait + bind."""
+        marks = self._phase_marks.pop(pod_uid, None)
+        popped = self.queue.take_popped(pod_uid)
+        if popped is None:
+            popped = arrived
+        popped = min(max(arrived, popped), now)
+        k0, ready = marks if marks is not None else (popped, popped)
+        k0 = min(max(popped, k0), now)
+        ready = min(max(k0, ready), now)
+        phases = {
+            "queue_wait": popped - arrived,
+            "wave_wait": k0 - popped,
+            "device_kernel": ready - k0,
+            "bind": now - ready,
+        }
+        for ph, v in phases.items():
+            self._phase_hists[ph].observe(v)
+        if marks is not None:
+            # batch-wave pods only: the flight recorder's per-cycle latency
+            # anatomy (cleared at each batch-cycle start, so bounded)
+            self.last_wave_phases[pod_uid] = phases
+        # bounded worst-K exemplar heap (--sli-attribution): min-heap on
+        # sli keeps the K worst; seq breaks ties so dicts never compare
+        entry = (sli, next(self._sli_worst_seq), pod_uid, phases)
+        with self._sli_worst_lock:
+            if len(self._sli_worst) < self._sli_worst_k:
+                heapq.heappush(self._sli_worst, entry)
+            elif sli > self._sli_worst[0][0]:
+                heapq.heapreplace(self._sli_worst, entry)
+
+    def worst_sli_pods(self) -> List[dict]:
+        """The K worst bound pods by true SLI (KTPU_OPEN_LOOP_EXEMPLARS,
+        default 5), worst first, each with its phase vector — the
+        --sli-attribution report's exemplar set (bench/loadgen.py exports
+        their full span timelines as a Perfetto trace)."""
+        return [
+            {
+                "pod": uid,
+                "sli_ms": round(s * 1e3, 3),
+                "phases_ms": {ph: round(v * 1e3, 3)
+                              for ph, v in phases.items()},
+            }
+            for s, _, uid, phases in sorted(self._sli_worst, reverse=True)
+        ]
 
     def _observe_wave_latency(
         self, ordinals: np.ndarray, t_kernel: float, sweeps: int,

@@ -327,6 +327,37 @@ def test_stale_arrival_entries_do_not_seed_the_queue(tmp_path):
     assert "ghost-uid" not in sched.queue._arrival_at
 
 
+def test_open_loop_arrival_age_survives_kill_restore(tmp_path):
+    """Load-observatory continuity: a pod admitted BEFORE a
+    kill.post_checkpoint crash keeps its ORIGINAL arrival age through
+    run_restartable's restore() — the post-restart bind observes the full
+    pre-crash wait on the SHARED Metrics, so an open-loop replay that spans
+    a restart still reports coordinated-omission-safe latencies instead of
+    restarting every victim's clock at the reincarnation."""
+    os.environ["KTPU_CHECKPOINT_DIR"] = str(tmp_path)
+    try:
+        metrics = Metrics()
+        store = ClusterStore()
+        store.add_node(mk_node("n0", cpu=3000, pods=16))
+        sched = Scheduler(store, SchedulerConfiguration(mode="tpu"),
+                          metrics=metrics)
+        store.add_pod(mk_pod("aged", cpu=250))
+        time.sleep(0.08)  # the pre-crash wait the restored SLI must retain
+        plan = chaos.FaultPlan.parse("kill.post_checkpoint:kill@0")
+        with chaos.chaos_plan(plan):
+            sched, restarts = run_restartable(sched)
+        assert restarts == 1
+        assert store.pods["default/aged"].node_name == "n0"
+        p50, p99, count = metrics.hists[
+            "pod_scheduling_sli_duration_seconds"
+        ].stats()
+        assert count == 1
+        # a clock restarted at reincarnation would observe ~ms, not 80ms+
+        assert p99 >= 0.08
+    finally:
+        os.environ.pop("KTPU_CHECKPOINT_DIR", None)
+
+
 # --- active/standby failover ---
 def _ha_pair(store, metrics, collector, lease_s=5.0):
     clock = FakeClock()

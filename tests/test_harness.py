@@ -64,3 +64,38 @@ ops:
     assert out.latency_source == "per-pod-estimate", out
     assert out.scheduled == 60
     assert 0 < out.p50_ms < out.p90_ms <= out.p99_ms, out
+    assert out.latency_mode == "closed-loop"
+
+
+def test_perfdata_batch_walls_get_the_batch_latency_mode():
+    """Satellite: an artifact whose only latency source is the per-wave
+    batch wall (p50==p99 degenerate) is labeled latency_mode="batch", so
+    bench/regression.py never gates a batch wall against a real closed-
+    or open-loop latency distribution; estimate-backed runs keep
+    "closed-loop"."""
+    from kubernetes_tpu.bench.harness import _perfdata
+    from kubernetes_tpu.bench.workloads import basic
+    from kubernetes_tpu.scheduler.metrics import Metrics
+
+    class _Events:
+        def by_reason(self, reason):
+            return []
+
+    class _Sched:
+        def __init__(self):
+            self.metrics = Metrics()
+            self.events = _Events()
+
+    snap = basic(2, 2, seed=0)
+    batch_only = _Sched()
+    batch_only.metrics.observe("batch_scheduling_duration_seconds", 0.01)
+    out = _perfdata("t", snap, batch_only, n_pods=2, wall=0.1)
+    assert out.latency_source == "batch"
+    assert out.latency_mode == "batch"
+
+    estimated = _Sched()
+    estimated.metrics.observe(
+        "scheduling_attempt_duration_estimate_seconds", 0.01)
+    out2 = _perfdata("t", snap, estimated, n_pods=2, wall=0.1)
+    assert out2.latency_source == "per-pod-estimate"
+    assert out2.latency_mode == "closed-loop"

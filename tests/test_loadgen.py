@@ -1,0 +1,241 @@
+"""Open-loop load observatory (ISSUE 16, bench/loadgen.py): arrival traces
+are seed-deterministic and replayable JSON, the replay clock is
+coordinated-omission-safe (SLI ages stamp from the TRACE arrival time, not
+the injection instant), same trace + seed twice yields bit-identical
+scheduling decisions, the CLI stamps the headline SLI + per-phase p99
+attribution top-level, and the regression gate refuses to compare latency
+distributions across driver modes.
+
+Tier-1 replays the rollout ramp at reduced scale; the full scale-to-zero
+storm (600-pod instantaneous burst) runs under the `slow` marker."""
+
+import json
+import math
+import os
+
+import pytest
+
+from kubernetes_tpu.bench.loadgen import (
+    SCENARIOS,
+    ArrivalEvent,
+    ArrivalTrace,
+    drain_trace,
+    load_or_build_trace,
+    replay_trace,
+    rollout_trace,
+    storm_trace,
+)
+from kubernetes_tpu.bench.regression import LATENCY_METRICS, check_regression
+from kubernetes_tpu.scheduler.metrics import SLI_PHASES
+
+
+# ------------------------------------------------- trace generation
+
+
+def test_traces_are_seed_deterministic():
+    """Same (scenario, seed, scale) -> identical event sequences, byte for
+    byte (the replayability contract); a different seed diverges."""
+    for name, fn in SCENARIOS.items():
+        a = fn(seed=3, scale=0.2)
+        b = fn(seed=3, scale=0.2)
+        assert [e.to_json() for e in a.events] == \
+               [e.to_json() for e in b.events], name
+        assert a.fingerprint() == b.fingerprint(), name
+        c = fn(seed=4, scale=0.2)
+        assert a.fingerprint() != c.fingerprint(), name
+        # chronological, named in arrival order
+        ts = [e.t for e in a.events]
+        assert ts == sorted(ts), name
+        assert a.events, name
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    t1 = rollout_trace(seed=1, scale=0.2)
+    path = t1.save(str(tmp_path / "trace.json"))
+    t2 = ArrivalTrace.load(path)
+    assert t2.fingerprint() == t1.fingerprint()
+    assert [e.to_json() for e in t2.events] == [e.to_json() for e in t1.events]
+    assert (t2.name, t2.scenario, t2.seed, t2.nodes) == \
+           (t1.name, t1.scenario, t1.seed, t1.nodes)
+    # the CLI path resolves a file spec to the same trace
+    t3 = load_or_build_trace(path)
+    assert t3.fingerprint() == t1.fingerprint()
+
+
+def test_load_or_build_trace_rejects_unknown_spec():
+    with pytest.raises(ValueError, match="not a named scenario"):
+        load_or_build_trace("no-such-scenario-or-file")
+
+
+def test_scenarios_have_their_bursty_shapes():
+    """The three shipped scenarios are genuinely bursty, not renamed
+    Poisson: the storm has one instantaneous wake-the-fleet burst, the
+    rollout ramp grows geometrically, and the drain wave re-arrives at
+    elevated priority after t=4."""
+    storm = storm_trace(seed=0, scale=0.2)
+    at_six = sum(1 for e in storm.events if e.t == 6.0)
+    assert at_six == round(600 * 0.2)  # simultaneous arrivals, one instant
+
+    rollout = rollout_trace(seed=0, scale=0.5)
+    half = rollout.duration_s / 2
+    early = sum(1 for e in rollout.events if e.t < half)
+    late = sum(1 for e in rollout.events if e.t >= half)
+    assert late > 2 * early  # geometric ramp: the back half dwarfs the front
+
+    drain = drain_trace(seed=0, scale=0.2)
+    prios = {e.priority for e in drain.events}
+    assert prios == {0, 100}
+    assert all(e.t >= 4.0 for e in drain.events if e.priority == 100)
+
+
+# ------------------------------------------------- open-loop replay
+
+
+def _tiny_trace(events, nodes=2, duration=1.0):
+    return ArrivalTrace(name="tiny", scenario="tiny", seed=0, nodes=nodes,
+                        duration_s=duration, events=events)
+
+
+def test_replay_clock_is_coordinated_omission_safe():
+    """A pod due at trace t=0.2 that the replay only injects at the 1s
+    cycle boundary must age from 0.2, not from injection: the measured SLI
+    carries the >=0.8s the open-loop world already waited.  (A send-time
+    clock — the coordinated-omission bug — would report ~ms here.)"""
+    trace = _tiny_trace([ArrivalEvent(t=0.2, name="late", cpu_m=100,
+                                      mem_mb=128)])
+    art, _sched = replay_trace(trace, quantum_s=1.0)
+    assert art["scheduled"] == 1 and art["sli_count"] == 1
+    assert art["sli_p99_ms"] >= 800.0, art["sli_p99_ms"]
+    assert art["latency_mode"] == "open-loop"
+
+
+def test_replay_is_decision_deterministic():
+    """Same trace, same seed, two replays: identical arrival sequences and
+    bit-identical scheduling decisions (the virtual-pace FakeClock makes
+    backoff maturation a pure function of the cycle count)."""
+    trace = rollout_trace(seed=2, scale=0.15)
+    a1, _ = replay_trace(trace)
+    a2, _ = replay_trace(trace)
+    assert a1["trace_crc"] == a2["trace_crc"] == trace.fingerprint()
+    assert a1["decision_crc"] == a2["decision_crc"]
+    assert a1["scheduled"] == a2["scheduled"] > 0
+    assert a1["unschedulable"] == a2["unschedulable"]
+
+
+def test_replay_artifact_attribution_block():
+    """The replay artifact carries the full attribution plane: headline
+    SLI stamped top-level, per-phase p99 shares summing to ~1.0, a named
+    dominant phase, and worst-pod exemplars with complete phase vectors."""
+    art, sched = replay_trace(rollout_trace(seed=0, scale=0.15))
+    assert art["sli_count"] == art["scheduled"] > 0
+    assert math.isfinite(art["sli_p50_ms"]) and art["sli_p50_ms"] >= 0
+    assert math.isfinite(art["sli_p99_ms"])
+    assert art["sli_p99_ms"] >= art["sli_p50_ms"]
+    phases = art["sli_phases"]
+    assert set(phases) == set(SLI_PHASES)
+    share_sum = sum(st["p99_share"] for st in phases.values())
+    assert abs(share_sum - 1.0) < 1e-3, phases
+    att = art["sli_attribution"]
+    assert att["dominant_phase"] in SLI_PHASES
+    assert att["worst_pods"], "no exemplar pods recorded"
+    for w in att["worst_pods"]:
+        assert set(w["phases_ms"]) == set(SLI_PHASES)
+        assert w["sli_ms"] >= 0
+
+
+@pytest.mark.slow
+def test_storm_replay_full_scale():
+    """The full scale-to-zero storm: a 600-pod instantaneous burst against
+    32 nodes still drains deterministically with a sane attribution."""
+    trace = storm_trace(seed=0, scale=1.0)
+    a1, _ = replay_trace(trace)
+    a2, _ = replay_trace(trace)
+    assert a1["decision_crc"] == a2["decision_crc"]
+    assert a1["scheduled"] == a1["pods"] and a1["unschedulable"] == 0
+    share_sum = sum(st["p99_share"] for st in a1["sli_phases"].values())
+    assert abs(share_sum - 1.0) < 1e-3
+
+
+# ------------------------------------------------- CLI acceptance
+
+
+def test_cli_open_loop_stamps_artifact_and_exports(tmp_path, monkeypatch,
+                                                   capsys):
+    """THE acceptance path: `--open-loop rollout --sli-attribution` writes
+    an artifact with the headline SLI top-level, shares summing to ~1.0,
+    the replayable arrival trace next to it, and a Perfetto exemplar
+    export of the worst pods' span timelines."""
+    from kubernetes_tpu.bench import harness
+
+    monkeypatch.setenv("KTPU_OPEN_LOOP_SCALE", "0.15")
+    out_path = tmp_path / "OL.json"
+    harness.main(["--open-loop", "rollout", "--sli-attribution",
+                  "--out", str(out_path)])
+    captured = capsys.readouterr()
+    assert "dominant phase:" in captured.err  # the human table, on stderr
+
+    art = json.loads(out_path.read_text())
+    assert art["latency_mode"] == "open-loop"
+    assert art["sli_count"] > 0
+    assert math.isfinite(art["sli_p50_ms"]) and math.isfinite(art["sli_p99_ms"])
+    share_sum = sum(st["p99_share"] for st in art["sli_phases"].values())
+    assert abs(share_sum - 1.0) < 1e-3
+
+    # the generated trace saved next to the artifact replays the EXACT run
+    trace_path = art["trace_path"]
+    assert os.path.dirname(trace_path) == str(tmp_path)
+    assert ArrivalTrace.load(trace_path).fingerprint() == art["trace_crc"]
+
+    # the exemplar export is a loadable chrome trace with real span events
+    exemplar = art["sli_attribution"]["exemplar_export"]
+    assert exemplar and os.path.exists(exemplar)
+    doc = json.loads(open(exemplar).read())
+    assert doc["otherData"]["exemplar_pods"]
+    assert doc["otherData"]["exemplar_spans"] > 0
+    assert any(ev.get("ph") != "M" for ev in doc["traceEvents"])
+
+
+# ------------------------------------------------- regression gating
+
+
+def _rec(latency_mode, **fields):
+    rec = {"platform": "cpu-sim-fallback"}
+    if latency_mode is not None:
+        rec["latency_mode"] = latency_mode
+    rec.update(fields)
+    return rec
+
+
+def test_regression_gate_never_compares_latency_across_driver_modes():
+    """Satellite: a batch p99 (per-wave wall) must never gate an open-loop
+    p99 — the gate skips cross-mode priors for latency metrics, still
+    gates same-mode priors, and ignores latency_mode entirely for
+    non-latency metrics like step_s."""
+    assert "sli_p99_ms" in LATENCY_METRICS
+    cur = ("r3.json", _rec("open-loop", sli_p99_ms=500.0))
+    batch_prior = ("r1.json", _rec("batch", sli_p99_ms=5.0))
+    ol_prior = ("r2.json", _rec("open-loop", sli_p99_ms=480.0))
+
+    # batch prior skipped, open-loop prior gates: 500 vs 480 is within 10%
+    v = check_regression([batch_prior, ol_prior, cur], cur,
+                         metric="sli_p99_ms")
+    assert v["status"] == "pass"
+    assert v["best_prior"] == "r2.json"
+    assert any("latency_mode" in s for s in v["skipped"])
+
+    # only a cross-mode prior: no comparable prior at all -> pass
+    v2 = check_regression([batch_prior, cur], cur, metric="sli_p99_ms")
+    assert v2["status"] == "pass" and "no comparable" in v2["reason"]
+
+    # a real same-mode regression still fails
+    bad = ("r4.json", _rec("open-loop", sli_p99_ms=1000.0))
+    v3 = check_regression([batch_prior, ol_prior, bad], bad,
+                          metric="sli_p99_ms")
+    assert v3["status"] == "regression"
+
+    # non-latency metrics compare across modes (old artifacts predate the
+    # latency_mode stamp and must keep gating step_s)
+    old = ("r0.json", {"platform": "cpu-sim-fallback", "step_s": 1.0})
+    cur_s = ("r5.json", _rec("open-loop", step_s=1.05))
+    v4 = check_regression([old, cur_s], cur_s, metric="step_s")
+    assert v4["status"] == "pass" and v4["best_prior"] == "r0.json"

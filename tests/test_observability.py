@@ -21,6 +21,7 @@ from kubernetes_tpu.scheduler.attribution import (
 )
 from kubernetes_tpu.scheduler.metrics import (
     DEFAULT_BUCKET_BOUNDS,
+    SLI_PHASES,
     Metrics,
     StreamingHist,
     reset_run_state,
@@ -121,6 +122,47 @@ def test_snapshot_reads_hist_stats_atomically_under_concurrency():
     for th in threads:
         th.join()
     assert not errors, errors
+
+
+def test_streaming_hist_stats_never_tear_under_observe_many_hammer():
+    """Satellite: hammer ONE StreamingHist's observe_many from several
+    threads (the open-loop phase hists take concurrent waves from the
+    binding-cycle pool) while the main thread reads stats() — every
+    (p50, p99, count) triple must be internally consistent: count lands on
+    a whole batch multiple, count is monotone, and once samples exist the
+    quantiles straddle the bimodal input (a torn read — counts merged but
+    not yet all buckets — would surface as an impossible triple)."""
+    h = StreamingHist()
+    stop = threading.Event()
+    batch = [1e-3] * 600 + [4.0] * 400  # p50 in the ms mode, p99 in the s mode
+
+    def hammer():
+        while not stop.is_set():
+            h.observe_many(batch)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    import time
+
+    last = 0
+    reads = 0
+    deadline = time.monotonic() + 0.3
+    try:
+        while time.monotonic() < deadline:
+            p50, p99, count = h.stats()
+            assert count % 1000 == 0, "torn count mid-observe_many merge"
+            assert count >= last, "count went backwards"
+            last = count
+            if count:
+                assert p50 <= 0.01, f"p50 {p50} escaped the 1ms mode"
+                assert p99 >= 1.0, f"p99 {p99} lost the 4s mode"
+                reads += 1
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    assert reads > 0 and last > 0  # the race actually ran
 
 
 # ------------------------------------------------- arrival -> bind SLI
@@ -235,6 +277,59 @@ def test_pipeline_loop_records_wave_sli():
         pass
     h = m.hists["pod_scheduling_sli_duration_seconds"]
     assert h.count == sum(len(w.pending_pods) for w in waves)
+
+
+# ------------------------------------- per-pod SLI phase decomposition
+
+
+def test_sli_phase_decomposition_telescopes_to_sli_batch_mode():
+    """The four pod_sli_phase_duration_seconds components (queue_wait,
+    wave_wait, device_kernel, bind) telescope EXACTLY to the arrival->bind
+    SLI on the batch path: one sample per phase per bound pod, and the
+    phase sums add up to the SLI sum — the monotone clamp redistributes
+    time between phases but never invents or drops any."""
+    col = TraceCollector()
+    store, sched = _cluster("tpu", collector=col)
+    for i in range(25):
+        store.add_pod(mk_pod(f"ph{i}", cpu=100))
+    sched.run_until_idle()
+    sli = sched.metrics.hists["pod_scheduling_sli_duration_seconds"]
+    assert sli.count == 25
+    total = 0.0
+    for ph in SLI_PHASES:
+        h = sched.metrics.labeled_hist(
+            "pod_sli_phase_duration_seconds", phase=ph)
+        assert h.count == 25, ph
+        total += h.sum
+    assert total == pytest.approx(sli.sum, rel=1e-6, abs=1e-6)
+    # consumed at publication like the arrival table: no leak
+    assert sched.queue._popped_at == {}
+    # the flight recorder's per-wave block saw the same pods
+    worst = sched.worst_sli_pods()
+    assert worst and all(set(w["phases_ms"]) == set(SLI_PHASES)
+                         for w in worst)
+
+
+def test_pipeline_loop_records_wave_phase_decomposition():
+    """The pipelined loop publishes the same labeled phase hists with its
+    wave-uniform decomposition: every bound pod contributes one sample per
+    phase, and queue_wait is identically zero (a pipelined wave is
+    dispatched whole — pods never sit in a per-pod queue)."""
+    from kubernetes_tpu.bench.workloads import heterogeneous
+    from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop
+
+    m = Metrics()
+    waves = [heterogeneous(8, 20, seed=s) for s in range(3)]
+    loop = PipelinedBatchLoop(metrics=m)
+    for _ in loop.run(waves):
+        pass
+    n = m.hists["pod_scheduling_sli_duration_seconds"].count
+    assert n == sum(len(w.pending_pods) for w in waves)
+    for ph in SLI_PHASES:
+        h = m.labeled_hist("pod_sli_phase_duration_seconds", phase=ph)
+        assert h.count == n, ph
+    qw = m.labeled_hist("pod_sli_phase_duration_seconds", phase="queue_wait")
+    assert qw.sum == 0.0
 
 
 # ------------------------------------------------- cycle attribution
