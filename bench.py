@@ -323,9 +323,19 @@ def main() -> None:
 
     attribution = attribute_spans(collector)
     print(render_attribution(attribution), file=sys.stderr)
-    from kubernetes_tpu.bench.harness import memwatch_fields, sli_fields
+    from kubernetes_tpu.bench.harness import (
+        commit_wave_fields,
+        memwatch_fields,
+        sli_fields,
+    )
 
     sli = sli_fields(metrics)
+    # commit-wave anatomy (ops/assign.py — class-batched commit waves):
+    # rounds_executed is the sweep count the batching collapses (wave
+    # blocks + stage-B rounds; regression-gated in ci.sh like step_s),
+    # classes_committed_per_round the class-level batching factor.  One
+    # untimed ordinal probe — decisions bit-identical to the timed runs.
+    wave_anatomy = commit_wave_fields(arr, cfg, meta, inc=inc, mesh=mesh)
     # HBM telemetry (scheduler/memwatch.py): the loop's ledger sampled
     # every cycle boundary — measured peak / resident census stamped
     # top-level (hbm_peak_bytes is regression-gated like step_s) and the
@@ -425,6 +435,9 @@ def main() -> None:
                 # went to stderr above)
                 "attribution": attribution,
                 **loop.hoist.summary(),
+                # commit-wave anatomy next to the hoist attribution:
+                # rounds_executed / classes_committed_per_round
+                **wave_anatomy,
             }
         )
     )

@@ -23,16 +23,23 @@
 #   5. regression gates    bench/regression.py over the BENCH_r*.json
 #                          trajectory (same-platform comparison only), plus
 #                          the observatory's round_loop_fraction /
-#                          device_flops / device_hbm_bytes scalars and the
+#                          device_flops / device_hbm_bytes scalars, the
 #                          memwatch plane's measured hbm_peak_bytes from
-#                          the stage-3 artifact
+#                          the stage-3 artifact, and the commit-wave
+#                          rounds_executed sweep count (class-batched
+#                          commit waves — the number the batching collapses)
+#   6. autotune smoke      bench/autotune.py end to end: sweep 2 knob
+#                          candidates in fresh subprocesses, persist the
+#                          winner next to the (smoke) compile cache, and
+#                          prove a second process RELOADS it (ops/tuning.py
+#                          env > winner > default resolution)
 #
 # Exit non-zero on the first failing stage.  .github/workflows/ci.yml runs
 # exactly this script.
 set -uo pipefail
 cd "$(dirname "$0")"
 
-echo "=== [1/5] tier-1 tests ==="
+echo "=== [1/6] tier-1 tests ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
@@ -44,14 +51,14 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
-echo "=== [2/5] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
+echo "=== [2/6] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
 JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard --mem || {
   rc=$?
   echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
   exit "$rc"
 }
 
-echo "=== [3/5] device cost observatory + memwatch smoke (--profile) ==="
+echo "=== [3/6] device cost observatory + memwatch smoke (--profile) ==="
 # fresh process (XLA parses dump flags once); reduced stream shape so the
 # smoke prices the capture path, not the full BENCH scale.  The stream's
 # artifact also carries the memwatch block: the harness exits 1 when the
@@ -69,7 +76,7 @@ JAX_PLATFORMS=cpu KTPU_STREAM_SHAPE=512x128 \
   exit "$rc"
 }
 
-echo "=== [4/5] open-loop load observatory smoke ==="
+echo "=== [4/6] open-loop load observatory smoke ==="
 # reduced-scale rollout ramp on the cpu sim: proves the open-loop driver,
 # the CO-safe SLI stamping and the phase decomposition end to end.  The
 # python step asserts the acceptance contract on the artifact itself.
@@ -91,7 +98,7 @@ shares = sum(p["p99_share"] for p in art["sli_phases"].values())
 assert abs(shares - 1.0) < 1e-3, art["sli_phases"]
 PY
 
-echo "=== [5/5] bench regression gates ==="
+echo "=== [5/6] bench regression gates ==="
 # exit 2 = no comparable same-platform artifact pair on this runner — the
 # gate is advisory there (CI boxes have no BENCH trajectory of their own);
 # a real regression (exit 1) still fails the build
@@ -111,5 +118,41 @@ run_gate --metric device_flops --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric device_hbm_bytes --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric hbm_peak_bytes --current /tmp/KTPU_CI_PROFILE.json
 run_gate --metric sli_p99_ms --current /tmp/KTPU_CI_OPENLOOP.json
+# the commit-wave sweep count (class-batched commit waves): BENCH_r07+
+# stamps rounds_executed; a change that silently reinflates the round
+# count fails here even when wall time hides it on a fast box
+run_gate --metric rounds_executed
+
+echo "=== [6/6] autotune smoke (sweep -> persist -> reload) ==="
+# two tiny candidates in fresh subprocesses (the knobs are trace-time
+# constants); the second probe must RELOAD the persisted winner with no
+# knob env set — proving the ops/tuning.py env > winner > default chain
+rm -rf /tmp/ktpu-ci-tuning
+JAX_PLATFORMS=cpu KTPU_FORCE_CHUNKED=1 \
+  python -m kubernetes_tpu.bench.autotune sweep --nodes 128 --pods 256 \
+  --candidates "32:48:12:256,16:32:6:128" --tuning-dir /tmp/ktpu-ci-tuning \
+  > /tmp/KTPU_CI_AUTOTUNE.json || {
+  rc=$?
+  echo "ci: autotune sweep failed (rc=$rc)" >&2
+  exit "$rc"
+}
+JAX_PLATFORMS=cpu KTPU_TUNING_DIR=/tmp/ktpu-ci-tuning \
+  python -m kubernetes_tpu.bench.autotune probe --nodes 64 --pods 128 \
+  > /tmp/KTPU_CI_AUTOTUNE_RELOAD.json || {
+  rc=$?
+  echo "ci: autotune reload probe failed (rc=$rc)" >&2
+  exit "$rc"
+}
+python - <<'PY' || { echo "ci: autotune winner not reloaded" >&2; exit 1; }
+import json
+sweep = json.load(open("/tmp/KTPU_CI_AUTOTUNE.json"))
+probe = json.load(open("/tmp/KTPU_CI_AUTOTUNE_RELOAD.json"))
+assert sweep["winner"], sweep
+assert sweep["persisted"], "sweep did not persist a winner file"
+# the fresh probe process resolved every tuned knob to the persisted
+# winner (no knob env set — only KTPU_TUNING_DIR)
+for k, v in sweep["winner"].items():
+    assert probe["knobs"][k] == v, (k, probe["knobs"], sweep["winner"])
+PY
 
 echo "CI green"

@@ -1181,7 +1181,16 @@ def _pod_side(cs, snap, reps, inv, p, P, N, T, L, req_s):
     """All wave-derived (pod-side) arrays as one dict — built per unique
     spec and scattered through inv; cacheable as a unit (see _assemble).
     reference: the per-cycle half of backend/cache/snapshot.go —
-    UpdateSnapshot, recast columnar."""
+    UpdateSnapshot, recast columnar.
+
+    `inv` (scattered into IncState.cls by the hoist cache) is the class
+    grouping the commit-wave stage batches on (ops/assign.py —
+    _wave_commit_stage): pods sharing a spec share a class row, so the
+    wave commits them off one top-k candidate list instead of one
+    contention round each.  Nothing here changes for that — the class
+    index was already exact — but the grouping is now load-bearing for
+    ordinals, so spec-key completeness (pod_group included) is pinned by
+    tests/test_class_waves.py in addition to test_incremental.py."""
     from .snapshot import _image_score_matrix, _round_up_pow2
 
     U = len(reps)

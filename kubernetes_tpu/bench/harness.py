@@ -201,6 +201,63 @@ def sli_fields(metrics) -> Dict:
     }
 
 
+def commit_wave_fields(arr, cfg, meta, inc=None, mesh=None) -> Dict:
+    """The commit-wave anatomy pair stamped next to unique_classes /
+    dirty_node_fraction (ops/assign.py — class-batched commit waves):
+
+    - ``rounds_executed``: the kernel's total sweep count for one warm
+      wave (wave blocks + any stage-B repair rounds on the batched route;
+      the full prefix-commit round count when KTPU_CLASS_WAVES=0).  This
+      is THE number the class batching collapses, so ci.sh regression-
+      gates it over the BENCH_r*.json trajectory like step_s.
+    - ``classes_committed_per_round``: mean distinct equivalence classes
+      committed per sweep over the scheduled pods — the class-level
+      batching factor a wave buys over the one-pod-frontier round loop
+      (≈1.0 there by construction).  None on routes without class state.
+
+    One untimed ordinal probe of the routed kernel (decisions are
+    bit-identical to the timed runs — PARITY.md), shared by bench.py and
+    the --stream artifact."""
+    import numpy as np
+
+    from ..ops.assign import schedule_batch_ordinals_routed
+
+    c, _, o, s = schedule_batch_ordinals_routed(
+        arr, cfg, donate=False, mesh=mesh, inc=inc
+    )
+    c = np.asarray(c)[: meta.n_pods]
+    o = np.asarray(o)[: meta.n_pods]
+    cpr = None
+    cls = getattr(inc, "cls", None)
+    m = c >= 0
+    if cls is not None and m.any():
+        pairs = np.stack([o[m], np.asarray(cls)[: meta.n_pods][m]])
+        n_rounds = len(np.unique(o[m]))
+        cpr = round(np.unique(pairs, axis=1).shape[1] / max(1, n_rounds), 2)
+    return {
+        "rounds_executed": int(s),
+        "classes_committed_per_round": cpr,
+    }
+
+
+def _commit_wave_probe(snap: "Snapshot", mesh) -> Dict:
+    """commit_wave_fields over a raw Snapshot: encode + warm the class
+    hoist exactly like the pipelined loop does, then run the one untimed
+    ordinal probe (the streaming artifact's stamping path)."""
+    from ..api.delta import DeltaEncoder
+    from ..ops import DEFAULT_SCORE_CONFIG, infer_score_config
+    from ..ops.assign import inc_route_applies
+    from ..ops.incremental import HoistCache
+
+    arr, meta = DeltaEncoder().encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = (
+        HoistCache(mesh=mesh).ensure(arr, meta, cfg)
+        if inc_route_applies(arr, cfg) else None
+    )
+    return commit_wave_fields(arr, cfg, meta, inc=inc, mesh=mesh)
+
+
 def ha_fields(metrics) -> Optional[Dict]:
     """The failover-observability artifact block, stamped next to the SLI
     triple: restart/transition counters plus the failover_duration_seconds
@@ -514,6 +571,11 @@ def run_streaming_workload(
         "n_shards": int(mesh.size) if mesh is not None else 1,
         "route_trace_counts": dict(TRACE_COUNTS),
     }
+    # commit-wave anatomy (rounds_executed / classes_committed_per_round):
+    # one untimed ordinal probe of the last wave, stamped next to the
+    # hoist summary's unique_classes / dirty_node_fraction below — outside
+    # both measured loops, so it never pollutes serial_s or pipelined_s
+    out.update(_commit_wave_probe(waves[-1], mesh))
     pods = out["n_pods"]
     if not pipeline:
         out.update(

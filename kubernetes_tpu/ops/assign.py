@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..api.snapshot import ClusterArrays
-from . import filters, pairwise
+from . import filters, pairwise, tuning
 from .scopes import subphase as _subphase
 from .scores import (
     MAX_NODE_SCORE,
@@ -366,9 +366,39 @@ _RCHUNK = int(os.environ.get("KTPU_RCHUNK", "16"))
 # inc@32 1.2 s, same decisions throughout (chunk size never changes
 # decisions, only commit ordinals).  P (bucketed, pow2 >= _CHUNK) is
 # always divisible by it.
-_INC_CHUNK = int(os.environ.get("KTPU_INC_CHUNK", "32"))
+_INC_CHUNK = tuning.tuned_knob("KTPU_INC_CHUNK", 32)
 _SPECZ = 16  # usable list entries precomputed per pod for pass-1 speculation
 _SPEC_ITERS = 4  # jump-to-first-unclaimed iterations (cross-group collisions)
+
+# ---- class-batched commit waves (incremental route only) ----
+# The wave stage (_wave_commit_stage) resolves MOST pods before the
+# prefix-commit round loop ever runs: per EPOCH it top-k's the resident
+# [U1, N] class matrix once, then commits pods in blocks of E via a
+# certified stale-max interference check — O(P/E) block iterations of
+# [U1, E]-scale work instead of O(P/C) chunks x O(C) rounds of
+# O(C^2 K)-scale work.  Decisions stay bit-identical to the serial oracle:
+# an uncertifiable pod triggers ONE exact dense [N, R] rescore (the
+# "genuinely interfering class" fallback) and an epoch refresh, and
+# whatever the block budget leaves uncommitted falls through to the
+# unchanged round loop (stage B).  Knobs are trace-time constants resolved
+# env > autotuned winner (ops/tuning.py, bench/autotune.py) > default:
+#   KTPU_CLASS_WAVES  0 disables the wave stage (pure round-loop A/B leg)
+#   KTPU_WAVE_K       per-class candidate list length per epoch (top-k K)
+#   KTPU_WAVE_BLOCK   pods certified per block iteration (E)
+#   KTPU_WAVE_ITERS   pointer-dispersal fixpoint iterations per block
+#                     (verified exactly afterwards — more iterations only
+#                     reduce benign truncations, never change decisions)
+_CLASS_WAVES = os.environ.get("KTPU_CLASS_WAVES", "1") != "0"
+# defaults from the north-star-scale sweep (50k x 20k, CPU sim): small
+# blocks with a deep dispersal fixpoint beat wide blocks — certification
+# truncates at the first unsettled pod, so past ~E=64 extra width only
+# adds per-block cost.  KW=256 balances epoch lifetime (list exhaustion
+# forces a [U1, N] top-k refresh) against per-block walk width; measured
+# 86 refreshes over 1319 blocks at 50k x 20k.  bench/autotune.py persists
+# per-box winners that override these (ops/tuning.py).
+_WAVE_K = tuning.tuned_knob("KTPU_WAVE_K", 256)
+_WAVE_BLOCK = tuning.tuned_knob("KTPU_WAVE_BLOCK", 48)
+_WAVE_ITERS = tuning.tuned_knob("KTPU_WAVE_ITERS", 12)
 
 # speculate->repair iterations per round (rounds kernel).  Swept in fresh
 # processes at BASELINE config-3 scale, 10k x 5k warm steps on the CPU sim
@@ -400,6 +430,11 @@ TRACE_COUNTS = {
     # variants of the production kernels
     "chunked_inc": 0, "rounds_inc": 0,
     "sharded_chunked_inc": 0, "sharded_rounds_inc": 0,
+    # class-batched commit-wave stage (_wave_commit_stage): bumped when the
+    # incremental chunked kernel traces WITH the wave stage armed
+    # (KTPU_CLASS_WAVES) — trace-guard tests prove the wave actually
+    # compiled (or didn't, for the degenerate U == P dense route)
+    "class_waves": 0,
 }
 
 
@@ -445,6 +480,318 @@ def _chunk_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
     if ov == "0":
         return False
     return jax.default_backend() != "cpu" and _chunkable(arr, cfg)
+
+
+def _wave_commit_stage(
+    cls, pvalid, preq, used_init, t0u_init, stat_full, n_alloc_full,
+    req_u, score_flat,
+):
+    """CLASS-BATCHED COMMIT WAVES — the stage that collapses the O(C^2 K)
+    prefix-commit round loop (ISSUE 17 / ROADMAP-1).  Commits pods in
+    BLOCKS of E at the frontier, certifying each commit against an EXACT
+    stale-max interference check instead of re-speculating per round.
+
+    EPOCH STRUCTURE.  An epoch starts by top-k'ing the resident (and
+    continuously-patched) [U1, N] class matrix into per-class candidate
+    lists (tv, ti)[U1, KW] — `lax.top_k` keeps equal values in ascending
+    node order, the deterministic selectHost tie-break.  Within an epoch,
+    every certified commit goes to a node no other commit of the epoch has
+    touched (`claimed`), so each touched node's POST-placement score column
+    s2[U1] is computed exactly once and never superseded — which makes a
+    running lexicographic (max value, min node) register (bmax, bnode)[U1]
+    over those columns an EXACT summary of every touched node, per class.
+
+    CERTIFICATION.  A pod's speculative pick is the first feasible
+    unclaimed entry of its class list (pointer walk below).  That entry
+    dominates every UNtouched node: untouched nodes keep their epoch-start
+    scores (usage only grows at touched nodes), in-list entries are sorted
+    with lowest-index ties, and out-of-list nodes score <= the last list
+    entry with a higher index than any equal-valued in-list node.  So the
+    pod's true argmax is either its pick or the best touched node — and
+    the latter is exactly (bmax, bnode) extended with the in-block earlier
+    picks' s2 columns via an exclusive associative scan.  The pick is
+    CERTIFIED when it wins that lexicographic comparison; a -1 (unschedul-
+    able) outcome is certified when the class list was not truncated
+    (nf < KW: every epoch-start-feasible node is IN the list), every
+    usable entry is claimed by an earlier pod, and no touched node is
+    feasible (ex_v == -inf).  Fit monotonicity (usage only grows) keeps
+    epoch-start infeasibility valid all epoch.
+
+    POINTER WALK.  Same-class pods in a block share identical lists, so
+    they are seeded with successive usable entries (rank within the
+    class), then _WAVE_ITERS jump-to-first-unclaimed iterations settle
+    cross-class collision chains — and an exact VERIFY pass (the pod owns
+    its node, every earlier usable entry is claimed by an earlier pod)
+    demotes any unsettled pod to uncertified, so the iteration count can
+    never change decisions, only the benign truncation rate.
+
+    FALLBACK.  The first uncertified pod q of a block is resolved by ONE
+    exact dense [N, R] rescore under the prefix-committed usage — the
+    "genuinely interfering class" per-pod fallback, bit-identical to the
+    sequential scan's step for that pod (it handles same-node stacking by
+    construction) — and the epoch ends (refresh next block).  Every block
+    therefore commits >= 1 pod (its full prefix, or the fallback pod), the
+    committed set is always a contiguous PREFIX of the batch, and the loop
+    terminates; a static block budget caps the worst case, handing any
+    remainder to the unchanged round loop (stage B) which continues the
+    serial order exactly.
+
+    The resident t0u matrix is patched at every committed column (prefix
+    columns from their s2 snapshots, the fallback column by one [U1, R]
+    recompute), so it stays bit-identical to a fresh class hoist against
+    the running usage throughout — the cross-chunk dirty-list carry.
+
+    Returns (committed bool[P], out i32[P], ordinal i32[P] — the block
+    index, a device-sweep ordinal like the round loop's round index,
+    used i32[N, R], t0u f32[U1, N], n_blocks i32)."""
+    P = cls.shape[0]
+    U1, N = t0u_init.shape
+    R = preq.shape[1]
+    E = min(_WAVE_BLOCK, P)
+    KW = min(_WAVE_K, N)
+    # >= 1 pod commits per block, so P blocks always suffice; the budget
+    # bounds pathological truncation storms (every block falling back at
+    # q=0) — anything left over is stage B's, exactness never at stake
+    max_blocks = (P // E + 1) * 8 + 32
+    neg_inf = -jnp.inf
+    idxE = jnp.arange(E, dtype=jnp.int32)
+    ltE = idxE[None, :] < idxE[:, None]  # [i, j]: j < i
+    kw_rng = jnp.arange(KW, dtype=jnp.int32)
+
+    def refresh(t0u):
+        tv, ti = lax.top_k(t0u, KW)  # [U1, KW] — ties to the lower index
+        nf = (tv > neg_inf).sum(axis=1).astype(jnp.int32)
+        return tv, ti, nf
+
+    def body(st):
+        (f, committed, out, ordn, used, t0u, claimed, bmax, bnode,
+         tv, ti, nf, need_ep, epochs, blocks) = st
+        # ---- (A) epoch refresh: new lists from the patched t0u; the
+        # claimed set and the touched-node register restart empty ----
+        tv, ti, nf = lax.cond(
+            need_ep, refresh, lambda _: (tv, ti, nf), t0u
+        )
+        claimed = jnp.where(need_ep, False, claimed)
+        bmax = jnp.where(need_ep, neg_inf, bmax)
+        bnode = jnp.where(need_ep, _INT_MAX, bnode)
+        # ---- (B) the block: E pods at the frontier (clamped at the tail;
+        # re-covered pods are inactive and certify vacuously) ----
+        start = jnp.minimum(f, P - E).astype(jnp.int32)
+        bidx = start + idxE
+        bcls = cls[bidx]  # [E]
+        breq = preq[bidx]  # [E, R]
+        bval = pvalid[bidx]
+        active = ~committed[bidx]
+        live = active & bval
+        # ---- (C) pointer walk: first feasible unclaimed list entry ----
+        tvb = tv[bcls]  # [E, KW]
+        tib = ti[bcls]
+        avail = (tvb > neg_inf) & ~claimed[tib] & live[:, None]
+        same = (bcls[:, None] == bcls[None, :]) & live[None, :]
+        rank = (same & ltE).sum(axis=1).astype(jnp.int32)
+        csum = jnp.cumsum(avail.astype(jnp.int32), axis=1)
+        hit = csum == (rank + 1)[:, None]  # the (rank+1)-th usable entry
+        pos = jnp.where(
+            hit.any(axis=1), jnp.argmax(hit, axis=1).astype(jnp.int32), KW
+        )
+
+        def picked_nodes(pos):
+            posc = jnp.minimum(pos, KW - 1)
+            nd = jnp.take_along_axis(tib, posc[:, None], 1)[:, 0]
+            return jnp.where(pos < KW, nd, N)  # N: sentinel (no pick)
+
+        def claims(pos):
+            nd = picked_nodes(pos)
+            cm = jnp.full(N + 1, _INT_MAX, jnp.int32).at[nd].min(idxE)
+            return nd, cm  # cm[n]: earliest block pod pointing at n
+
+        for _ in range(_WAVE_ITERS):
+            _, cm = claims(pos)
+            elig = avail & ~(cm[tib] < idxE[:, None])
+            pos = jnp.where(
+                elig.any(axis=1),
+                jnp.argmax(elig, axis=1).astype(jnp.int32), KW
+            )
+        nd, cm = claims(pos)
+        # exact settlement check — unsettled pods fall back, so the
+        # iteration count above is a pure perf knob
+        own_ok = cm[nd] == idxE
+        earlier_cl = cm[tib] < idxE[:, None]
+        before = kw_rng[None, :] < pos[:, None]  # pos == KW: all entries
+        prefix_ok = jnp.all(~(avail & before) | earlier_cl, axis=1)
+        posc = jnp.minimum(pos, KW - 1)
+        a_val = jnp.where(
+            pos < KW, jnp.take_along_axis(tvb, posc[:, None], 1)[:, 0],
+            neg_inf,
+        )
+        a_node = nd
+        picked = live & (pos < KW)
+        # ---- (D) post-placement snapshot columns s2[U1, E]: every class'
+        # exact masked score at each picked node AFTER its pod lands —
+        # the value a fresh hoist would compute there, and the exact
+        # interference evidence for later pods ----
+        an = jnp.minimum(a_node, N - 1)
+        nu = used[an] + breq  # [E, R]
+        na = n_alloc_full[an]
+        free = na - nu
+        fit2 = jnp.all(
+            (req_u[:, None, :] == 0) | (req_u[:, None, :] <= free[None]),
+            axis=2,
+        )  # [U1, E] — same subtraction form as filters.fit_ok
+        reqd2 = nu[None] + req_u[:, None, :]  # [U1, E, R]
+        v2 = score_flat(
+            reqd2.reshape(-1, R),
+            jnp.broadcast_to(na[None], reqd2.shape).reshape(-1, R),
+        ).reshape(U1, E)
+        s2 = jnp.where(
+            stat_full[:, an] & fit2 & picked[None, :], v2, neg_inf
+        )
+        s2n = jnp.where(picked, a_node, _INT_MAX)
+        # ---- (E) exclusive lexicographic scan: best touched node each pod
+        # sees = epoch register (bmax, bnode) + earlier in-block columns --
+        v_ext = jnp.concatenate([bmax[:, None], s2], axis=1)  # [U1, E+1]
+        n_ext = jnp.concatenate(
+            [bnode[:, None], jnp.broadcast_to(s2n[None], (U1, E))], axis=1
+        )
+
+        def lexmax(a, b):
+            av, an_ = a
+            bv, bn = b
+            tb = (bv > av) | ((bv == av) & (bn < an_))
+            return jnp.where(tb, bv, av), jnp.where(tb, bn, an_)
+
+        sv, sn = lax.associative_scan(lexmax, (v_ext, n_ext), axis=1)
+        ex_v = sv[bcls, idxE]  # [E] — exclusive: col b covers base + <b
+        ex_n = sn[bcls, idxE]
+        # ---- (F) certification ----
+        covered = (nf[bcls] < KW) if KW < N else jnp.full(E, True)
+        cert_pick = (
+            picked & own_ok & prefix_ok
+            & ((a_val > ex_v) | ((a_val == ex_v) & (a_node < ex_n)))
+        )
+        cert_neg = (
+            live & (pos >= KW) & prefix_ok & covered & (ex_v == neg_inf)
+        )
+        cert = ~live | cert_pick | cert_neg  # invalid pods: -1, certified
+        ncert = active & ~cert
+        q = jnp.where(ncert.any(), jnp.argmax(ncert), E).astype(jnp.int32)
+        inpre = idxE < q
+        commit_b = active & inpre
+        place_b = commit_b & cert_pick
+        ucol = jnp.where(place_b, a_node, N)
+        used2 = used.at[ucol].add(
+            jnp.where(place_b[:, None], breq, 0), mode="drop"
+        )
+        # ---- (G) per-pod fallback: one exact dense rescore for the first
+        # uncertified pod, under the prefix-committed usage ----
+        do_fb = q < E
+        qc = jnp.minimum(q, E - 1)
+        fcls = bcls[qc]
+        freq = breq[qc]
+
+        def fb_rescore(args):
+            used2, freq, fstat = args
+            ffit = filters.fit_ok(freq, used2, n_alloc_full)  # [N]
+            fvals = jnp.where(
+                fstat & ffit,
+                score_flat(used2 + freq[None], n_alloc_full),
+                neg_inf,
+            )
+            return jnp.where(
+                fvals.max() > neg_inf, jnp.argmax(fvals), -1
+            ).astype(jnp.int32)
+
+        # the [N, R] rescore only runs when the block actually truncated
+        # (cond false-branch = the skip, matching the stage-B convention:
+        # the analytic ledger charges the branch that runs on the collapsed
+        # fast path)
+        t_fb = lax.cond(
+            do_fb, fb_rescore, lambda _: jnp.int32(-1),
+            (used2, freq, stat_full[fcls]),
+        )
+        fb_ok = do_fb & (t_fb >= 0)
+        fcol = jnp.where(fb_ok, t_fb, N)
+        used3 = used2.at[fcol].add(jnp.where(fb_ok, freq, 0), mode="drop")
+        # ---- (H) absorb: outputs, claims, register fold, t0u patch ----
+        scat = jnp.where(commit_b, bidx, P)
+        out = out.at[scat].set(
+            jnp.where(place_b, a_node, -1), mode="drop"
+        )
+        committed = committed.at[scat].set(True, mode="drop")
+        ordn = ordn.at[scat].set(blocks, mode="drop")
+        fscat = jnp.where(do_fb, start + q, P)
+        out = out.at[fscat].set(t_fb, mode="drop")
+        committed = committed.at[fscat].set(True, mode="drop")
+        ordn = ordn.at[fscat].set(blocks, mode="drop")
+        claimed = claimed.at[ucol].set(True, mode="drop")
+        # a fallback STACKS when its exact argmax is a node this epoch
+        # already touched (the prefix claims are already folded in above)
+        # — the one case that breaks the touched-once-per-epoch invariant
+        # and forces a refresh.  An untouched fallback node just becomes
+        # one more touched node: claim it, fold its post-placement column,
+        # and the epoch continues
+        fnc = jnp.minimum(fcol, N - 1)
+        stacked = fb_ok & claimed[fnc]
+        claimed = claimed.at[fcol].set(True, mode="drop")
+        # fold the committed prefix's columns into the epoch register
+        cv = jnp.where(inpre[None], s2, neg_inf)
+        cn = jnp.where(inpre, s2n, _INT_MAX)
+        m = cv.max(axis=1)
+        mn = jnp.where(cv == m[:, None], cn[None], _INT_MAX).min(axis=1)
+        tb = (m > bmax) | ((m == bmax) & (mn < bnode))
+        bmax = jnp.where(tb, m, bmax)
+        bnode = jnp.where(tb, mn, bnode)
+        # patch committed columns: prefix picks from their s2 snapshots
+        # (each touched once this epoch — exact), then the fallback column
+        # by one [U1, R] recompute against the post-fallback usage (it may
+        # STACK on a prefix node; last write wins with the exact value)
+        t0u = t0u.at[:, ucol].set(s2, mode="drop")
+        fnu = used3[fnc]
+        fna = n_alloc_full[fnc]
+        ffit_u = jnp.all(
+            (req_u == 0) | (req_u <= (fna - fnu)[None]), axis=1
+        )  # [U1]
+        fv_u = score_flat(
+            fnu[None] + req_u, jnp.broadcast_to(fna[None], req_u.shape)
+        )
+        fcv = jnp.where(stat_full[:, fnc] & ffit_u, fv_u, neg_inf)
+        t0u = t0u.at[:, fcol].set(fcv, mode="drop")
+        # fold the fallback's post-placement column too (dead on refresh)
+        fv2 = jnp.where(fb_ok, fcv, neg_inf)
+        fn2 = jnp.where(fb_ok, t_fb, _INT_MAX)
+        t2 = (fv2 > bmax) | ((fv2 == bmax) & (fn2 < bnode))
+        bmax = jnp.where(t2, fv2, bmax)
+        bnode = jnp.where(t2, fn2, bnode)
+        f = jnp.where(q == E, start + E, start + q + 1).astype(jnp.int32)
+        # refresh on stacking (exactness demands it) or on a starved block
+        # (the epoch lists are spent — new top-k beats grinding fallbacks)
+        need_ep = do_fb & (stacked | (q < E // 8))
+        return (f, committed, out, ordn, used3, t0u, claimed, bmax, bnode,
+                tv, ti, nf, need_ep, epochs + need_ep.astype(jnp.int32),
+                blocks + 1)
+
+    st0 = (
+        jnp.int32(0),
+        jnp.zeros(P, dtype=jnp.bool_),
+        jnp.full(P, -1, dtype=jnp.int32),
+        jnp.zeros(P, dtype=jnp.int32),
+        used_init,
+        t0u_init,
+        jnp.zeros(N, dtype=jnp.bool_),
+        jnp.full(U1, neg_inf, dtype=t0u_init.dtype),
+        jnp.full(U1, _INT_MAX, dtype=jnp.int32),
+        jnp.zeros((U1, KW), dtype=t0u_init.dtype),
+        jnp.zeros((U1, KW), dtype=jnp.int32),
+        jnp.zeros(U1, dtype=jnp.int32),
+        jnp.bool_(True),
+        jnp.int32(0),
+        jnp.int32(0),
+    )
+    st = lax.while_loop(
+        lambda s: (s[0] < P) & (s[-1] < max_blocks), body, st0
+    )
+    _, committed, out, ordn, used, t0u = st[:6]
+    return committed, out, ordn, used, t0u, st[-1], st[-2]
 
 
 def schedule_scan_chunked(
@@ -615,10 +962,36 @@ def schedule_scan_chunked(
         cand = jnp.minimum(cd, jnp.where(vu == best, iu, _INT_MAX))
         return best, cand
 
+    # ---- class-batched commit waves (stage A) ----
+    # The wave resolves a contiguous PREFIX of the batch (usually all of
+    # it) before any chunk traces; the round loop below becomes stage B,
+    # continuing the serial order over whatever the block budget left.
+    # Runs on the replicated post-gather inputs, so it adds ZERO
+    # collectives under sharding — the per-shard collective sequence is
+    # KTPU009-identical to the wave-off trace.
+    wave = use_inc and _CLASS_WAVES
+    if wave:
+        TRACE_COUNTS["class_waves"] += 1
+        with _subphase("commit_batch"):
+            wcom, wout, wordn, used_wave, t0u_wave, n_blocks, _n_ep = (
+                _wave_commit_stage(
+                    inc.cls, arr.pod_valid, arr.pod_req, used_init,
+                    t0u_init, stat_full, n_alloc_full, req_u, score_flat,
+                )
+            )
+        wcom_c = wcom.reshape(P // C, C)
+        wout_c = wout.reshape(P // C, C)
+
     def chunk(carry, xs):
         if use_inc:
             used0, t0u = carry  # t0u: masked class scores vs current used0
-            creq, ccls, cvalid = xs
+            if wave:
+                # wave-committed pods enter the round loop pre-committed
+                # with their decisions in place; a fully-covered chunk's
+                # while_loop runs zero rounds
+                creq, ccls, cvalid, wcom0, wout0 = xs
+            else:
+                creq, ccls, cvalid = xs
             # per-pod scores are gathers of the pod's CLASS row — identical
             # rows, and lax.top_k on identical rows is deterministic, so
             # topv/topi match the dense path bit-for-bit.  Trace-time
@@ -848,8 +1221,8 @@ def schedule_scan_chunked(
             return committed, out, ord_, cleank, dlist, dsu, nd, nrounds + 1
 
         st0 = (
-            jnp.zeros(C, dtype=jnp.bool_),
-            jnp.full(C, -1, dtype=jnp.int32),
+            wcom0 if use_inc and wave else jnp.zeros(C, dtype=jnp.bool_),
+            wout0 if use_inc and wave else jnp.full(C, -1, dtype=jnp.int32),
             jnp.zeros(C, dtype=jnp.int32),
             jnp.ones((C, K), dtype=jnp.bool_),
             jnp.full(C, -1, dtype=jnp.int32),
@@ -862,8 +1235,14 @@ def schedule_scan_chunked(
                 lambda st: ~st[0].all(), round_body, st0
             )
         with _subphase("commit"):
-            placed = (out >= 0)[:, None]
-            ucols = jnp.where(out >= 0, out, N)
+            # wave-committed pods' requests already live in used0 (the wave
+            # adds them as it commits) — only this chunk's round-loop
+            # commits are new
+            newly = out >= 0
+            if use_inc and wave:
+                newly = newly & ~wcom0
+            placed = newly[:, None]
+            ucols = jnp.where(newly, out, N)
             used_out = used0.at[ucols].add(
                 jnp.where(placed, creq, 0), mode="drop"
             )
@@ -893,7 +1272,32 @@ def schedule_scan_chunked(
             t0u = t0u.at[:, ucols].set(newv, mode="drop")
         return (used_out, t0u), (out, nrounds, ord_)
 
-    if use_inc:
+    if use_inc and wave:
+        # stage B: the round loop continues the serial order over whatever
+        # the wave's block budget left (normally nothing).  The lax.cond
+        # makes the skip REAL: when the wave committed every pod the whole
+        # chunk scan is skipped at run time, and the analytic ledger
+        # (analysis/costmodel.py charges branch 0 of a cond — KTPU009
+        # obliges: neither branch holds a collective on this path) prices
+        # round_loop at the passthrough, matching the measured collapse.
+        def _stage_b(used_w, t0u_w):
+            (uf, _), (ch, rd, od) = lax.scan(
+                chunk, (used_w, t0u_w),
+                (reqs, clss, valids, wcom_c, wout_c),
+            )
+            return ch, uf, rd, od
+
+        def _skip(used_w, t0u_w):
+            return (
+                wout_c, used_w,
+                jnp.zeros(P // C, dtype=jnp.int32),
+                jnp.zeros((P // C, C), dtype=jnp.int32),
+            )
+
+        choices, used_final, rounds, ords = lax.cond(
+            ~jnp.all(wcom), _stage_b, _skip, used_wave, t0u_wave
+        )
+    elif use_inc:
         (used_final, _), (choices, rounds, ords) = lax.scan(
             chunk, (used_init, t0u_init), (reqs, clss, valids)
         )
@@ -911,8 +1315,16 @@ def schedule_scan_chunked(
         base = jnp.concatenate(
             [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(rounds)[:-1]]
         )
-        return (choices.reshape(P), used_final,
-                (base[:, None] + ords).reshape(P), rounds.sum())
+        ords_g = (base[:, None] + ords).reshape(P)
+        if use_inc and wave:
+            # wave-committed pods carry their BLOCK index (the device sweep
+            # that decided them); stage-B rounds number on from the wave's
+            # blocks, and the TOTAL sweep count — the latency-estimate
+            # denominator — is wave blocks + stage-B rounds
+            ords_g = jnp.where(wcom, wordn, ords_g + n_blocks)
+            return (choices.reshape(P), used_final, ords_g,
+                    rounds.sum() + n_blocks)
+        return choices.reshape(P), used_final, ords_g, rounds.sum()
     if with_rounds:
         return choices.reshape(P), used_final, rounds
     return choices.reshape(P), used_final

@@ -65,7 +65,12 @@ def schedule_with_gangs(
     the only per-iteration change is pod_valid, which the resident class
     state deliberately excludes (the kernels fold validity per pod), and a
     revocation masks whole equivalence classes — pod_group is part of the
-    spec key — so class-row consistency holds at every iteration."""
+    spec key — so class-row consistency holds at every iteration.  The
+    class-batched commit-wave stage (assign._wave_commit_stage) therefore
+    rides each fixpoint iteration unchanged: it reads only the shared
+    IncState rows plus the iteration's pod_valid, and the sweeps_prior
+    offset below keeps the returned ordinals a single global commit order
+    across iterations exactly as for the round loop."""
     from .assign import (
         schedule_batch_ordinals_routed,
         schedule_batch_routed,

@@ -38,6 +38,14 @@ is bit-identical to a from-scratch hoist of the same cluster state, and
 kernel decisions are bit-identical to the serial oracle
 (tests/test_incremental.py pins the full matrix).
 
+The same resident [U, N] matrices are the substrate of the class-batched
+commit waves (ops/assign.py — _wave_commit_stage, ISSUE 17): the wave's
+per-class top-k candidate lists are `lax.top_k` over exactly these rows, so
+a patched cache that is bit-identical to the dense hoist makes the wave's
+commits bit-identical to the serial round loop too — the parity guarantee
+above and the wave invariants (PARITY.md — "Class-batched commit-wave
+invariants") are one argument, not two.
+
 DONATION-ALIASING RULE (PARITY.md): the resident cache buffers are passed
 to the step as a SEPARATE, never-donated argument — a donated kernel only
 ever consumes the per-wave `ClusterArrays` transfers.  The cache also never

@@ -46,9 +46,16 @@ import jax
 #   speculate   pass-1 dispersal speculation (rank seeding, pointer jumps)
 #   repair      pass-2 exact revalidation under the intra-round prefix
 #   commit      prefix commit + usage/count-state absorption + column patch
+#   commit_batch  the class-batched commit-wave stage (ops/assign.py —
+#               _wave_commit_stage): epoch top-k refresh, block pointer
+#               walk, certification scan and wave commits.  A SIBLING of
+#               round_loop, not part of its rollup — the wave replaces the
+#               prefix-commit loop's work, so lumping it under round_loop
+#               would hide exactly the collapse `round_loop_fraction`
+#               exists to measure
 SUBPHASES = (
     "hoist", "score", "normalize", "round_loop", "speculate", "repair",
-    "commit",
+    "commit", "commit_batch",
 )
 
 

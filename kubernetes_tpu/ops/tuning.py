@@ -1,0 +1,121 @@
+"""Persisted per-platform autotune winners — the knob half of
+`bench/autotune.py`.
+
+The chunked-kernel shape knobs (KTPU_INC_CHUNK and the commit-wave family
+KTPU_WAVE_K / KTPU_WAVE_BLOCK / KTPU_WAVE_ITERS) are TRACE-TIME constants:
+they are read once at `ops.assign` import and baked into every jit trace,
+which is why sweeps run each candidate in a fresh subprocess
+(bench/autotune.py, same discipline as bench/rounds_proof.py's
+KTPU_REPAIR_ITERS sweep).  None of them change DECISIONS — chunk size and
+wave shape move only commit ordinals and wall time (PARITY.md), so a tuned
+winner is a pure performance choice and safe to persist.
+
+Resolution order for every tuned knob (ops/assign.py — `tuned_knob`):
+
+  1. the explicit env var (operator override, always wins)
+  2. the persisted per-platform winner file, when one exists
+  3. the shipped default
+
+The winner file lives NEXT TO the persistent compilation cache
+(KTPU_TUNING_DIR, defaulting to KTPU_COMPILE_CACHE_DIR) as
+``ktpu-tuned-<platform>.json`` — the same "per-box self-serve state"
+location: a box that persists compiled programs also remembers which knob
+shape those programs should be compiled with.  When neither dir is set the
+lookup is a no-op and the shipped defaults apply; importing this module
+never initializes a JAX backend in that case (the platform name is only
+resolved once a directory is configured).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+# knobs the autotuner may persist; anything else in a winner file is
+# ignored on load (fail-open: a stale file from a future/past version
+# can never inject an unknown trace-time constant)
+TUNABLE_KNOBS = (
+    "KTPU_INC_CHUNK", "KTPU_WAVE_K", "KTPU_WAVE_BLOCK", "KTPU_WAVE_ITERS",
+)
+
+
+def tuning_dir() -> Optional[str]:
+    """KTPU_TUNING_DIR, falling back to KTPU_COMPILE_CACHE_DIR (the winner
+    file sits next to the compile cache); None disables persistence."""
+    return (
+        os.environ.get("KTPU_TUNING_DIR")
+        or os.environ.get("KTPU_COMPILE_CACHE_DIR")
+        or None
+    )
+
+
+def _platform(platform: Optional[str] = None) -> str:
+    if platform:
+        return platform
+    import jax
+
+    return jax.default_backend()
+
+
+def tuning_path(platform: Optional[str] = None) -> Optional[str]:
+    """Path of the per-platform winner file, or None when no tuning/compile
+    cache dir is configured."""
+    root = tuning_dir()
+    if not root:
+        return None
+    return os.path.join(root, f"ktpu-tuned-{_platform(platform)}.json")
+
+
+def load_tuned(platform: Optional[str] = None) -> Dict[str, Any]:
+    """The persisted winner's knob dict (TUNABLE_KNOBS subset), or {} when
+    no winner exists.  Fail-open on any read/parse error: autotune state
+    must never be able to break scheduling."""
+    path = tuning_path(platform)
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        knobs = doc.get("knobs", {})
+        return {k: int(v) for k, v in knobs.items() if k in TUNABLE_KNOBS}
+    except (OSError, ValueError, TypeError):
+        return {}
+
+
+def save_tuned(
+    knobs: Dict[str, int], score: Dict[str, Any],
+    platform: Optional[str] = None,
+) -> Optional[str]:
+    """Persist the winning knob dict + its scorecard (measured seconds and
+    the analytic-ledger shares that justified it) for `platform`.  Returns
+    the written path, or None when no tuning dir is configured."""
+    path = tuning_path(platform)
+    if not path:
+        return None
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {
+        "knobs": {k: int(v) for k, v in knobs.items() if k in TUNABLE_KNOBS},
+        "score": score,
+        "platform": _platform(platform),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)  # atomic: concurrent readers see old or new
+    return path
+
+
+def tuned_knob(name: str, default: int) -> int:
+    """Trace-time knob resolution: env var > persisted winner > default.
+    Called at `ops.assign` IMPORT time — the resolved value is baked into
+    every jit trace, exactly like the plain int(os.environ.get(...))
+    pattern it extends."""
+    raw = os.environ.get(name, "")
+    if raw:
+        return int(raw)
+    tuned = load_tuned()
+    if name in tuned:
+        return int(tuned[name])
+    return default

@@ -156,7 +156,11 @@ def sharded_schedule_batch_routed(
     """The PRODUCTION routed step — chunked / rounds / per-pod scan, the same
     trace-time routing as ops.assign.schedule_batch_routed — node-axis
     sharded over `mesh`, decisions bit-identical to the single-device route
-    (tests/test_sharded_routed.py).  Node counts not divisible by the mesh
+    (tests/test_sharded_routed.py).  The class-batched commit-wave stage
+    inside the chunked route runs AFTER the node-axis gather, on replicated
+    values only, so arming it adds zero collectives — the per-shard
+    collective sequence is KTPU009-identical with waves on or off
+    (tests/test_class_waves.py — mesh8 parity).  Node counts not divisible by the mesh
     are padded with permanently invalid nodes (parallel/mesh.py —
     pad_nodes); the returned node_used covers the padded axis (slice to the
     caller's N — padded rows are always zero).

@@ -1,0 +1,319 @@
+"""Class-batched commit waves (ISSUE 17): the wave stage that collapses
+the prefix-commit round loop must be BIT-IDENTICAL to the serial oracle
+and the dense kernels across {chunked, rounds, inc} x {donate on/off} x
+{single-device, mesh8} over warm churn, survive a seeded chaos storm with
+batching armed, exercise the interference fallback (exact [N, R] rescore
++ epoch continuation) on an adversarial same-class contention wave, and
+stay OFF the wave route for the degenerate U == P wave (trace guard:
+the dedup is a no-op there, so the dense kernel routes)."""
+
+import copy
+import dataclasses
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.api.delta import DeltaEncoder
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.ops import assign
+from kubernetes_tpu.ops.assign import (
+    TRACE_COUNTS,
+    schedule_batch_ordinals_routed,
+    schedule_batch_routed,
+)
+from kubernetes_tpu.ops.incremental import HoistCache
+from kubernetes_tpu.oracle import oracle_schedule
+from kubernetes_tpu.scheduler import (
+    ClusterStore,
+    Scheduler,
+    SchedulerConfiguration,
+)
+
+from helpers import mk_node, mk_pod, random_cluster
+
+
+@pytest.fixture(autouse=True)
+def _force_production_route(monkeypatch):
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _decode(choices, meta):
+    ch = np.asarray(choices)
+    return [
+        (meta.pod_names[k],
+         meta.node_names[int(ch[k])] if int(ch[k]) >= 0 else None)
+        for k in range(meta.n_pods)
+    ]
+
+
+def _bind_some(snap, verdicts, k=4):
+    by_name = {p.name: p for p in snap.pending_pods}
+    bound = []
+    for nm, node in verdicts:
+        if node is not None and len(bound) < k:
+            bound.append(dataclasses.replace(by_name[nm], node_name=node))
+    pend = [
+        dataclasses.replace(p, name=f"w-{p.name}", uid="")
+        for p in snap.pending_pods
+    ]
+    return Snapshot(nodes=snap.nodes, pending_pods=pend, bound_pods=bound)
+
+
+def _snap_for(kernel: str, seed: int = 42):
+    rng = random.Random(seed)
+    if kernel == "chunked":
+        return random_cluster(rng, n_nodes=24, n_pods=120)
+    return random_cluster(
+        rng, n_nodes=24, n_pods=48,
+        with_taints=True, with_selectors=True, with_pairwise=True,
+    )
+
+
+def test_wave_stage_traces_on_inc_chunked_route():
+    """Trace guard: with batching armed (the default), the incremental
+    chunked route compiles WITH the wave stage — class_waves bumps on a
+    fresh trace, and decisions match the dense kernel AND the oracle."""
+    assert assign._CLASS_WAVES  # armed by default
+    snap = _snap_for("chunked")
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = HoistCache().ensure(arr, meta, cfg)
+    assert inc is not None
+    jax.clear_caches()  # strict: prove THIS call traces the wave
+    before = dict(TRACE_COUNTS)
+    got_c, got_u = schedule_batch_routed(arr, cfg, donate=False, inc=inc)
+    assert TRACE_COUNTS["class_waves"] > before["class_waves"]
+    assert TRACE_COUNTS["chunked_inc"] > before["chunked_inc"]
+    want_c, want_u = schedule_batch_routed(arr, cfg, donate=False)
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+    np.testing.assert_array_equal(np.asarray(got_u), np.asarray(want_u))
+    assert _decode(got_c, meta) == oracle_schedule(snap, cfg)
+
+
+@pytest.mark.parametrize("kernel", ["chunked", "rounds"])
+@pytest.mark.parametrize("donate", [False, True])
+def test_wave_warm_churn_parity_single_device(kernel, donate, monkeypatch):
+    """Warm churn with batching armed: every cycle's batched decisions are
+    bit-identical to the dense kernel, the first to the serial oracle, and
+    the resident class matrices survive donation (the aliasing rule the
+    carried dirty list leans on — PARITY.md)."""
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap = _snap_for(kernel)
+    enc = DeltaEncoder()
+    cache = HoistCache()
+    for cycle in range(3):
+        arr, meta = enc.encode(snap)
+        cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+        inc = cache.ensure(arr, meta, cfg)
+        assert inc is not None
+        want_c, want_u = schedule_batch_routed(arr, cfg, donate=False)
+        got_c, got_u = schedule_batch_routed(arr, cfg, donate=donate,
+                                             inc=inc)
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+        np.testing.assert_array_equal(np.asarray(got_u), np.asarray(want_u))
+        got = _decode(got_c, meta)
+        if cycle == 0:
+            assert got == oracle_schedule(snap, cfg)
+        for buf in (inc.stat_u, inc.base_u, inc.fit_u, inc.cls, inc.req_u):
+            assert not buf.is_deleted()
+        snap = _bind_some(snap, got)
+    assert cache.stats["patched"] >= 1, cache.stats
+
+
+@pytest.mark.parametrize("kernel", ["chunked", "rounds"])
+@pytest.mark.parametrize("donate", [False, True])
+def test_wave_warm_churn_parity_mesh8(mesh8, kernel, donate, monkeypatch):
+    """Same matrix across the 8-way mesh: the wave stage runs on the
+    post-gather replicated inputs, so the sharded collective sequence is
+    unchanged (KTPU009) and decisions match the single-device kernel."""
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap = _snap_for(kernel, seed=7)
+    enc = DeltaEncoder()
+    cache = HoistCache(mesh=mesh8)
+    for cycle in range(2):
+        arr, meta = enc.encode(snap)
+        cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+        inc = cache.ensure(arr, meta, cfg)
+        assert inc is not None
+        want_c, want_u = schedule_batch_routed(arr, cfg, donate=False)
+        got_c, got_u = schedule_batch_routed(
+            arr, cfg, donate=donate, mesh=mesh8, inc=inc
+        )
+        n = arr.N
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+        gu = np.asarray(got_u)
+        np.testing.assert_array_equal(gu[:n], np.asarray(want_u))
+        assert not gu[n:].any()
+        snap = _bind_some(snap, _decode(got_c, meta))
+
+
+def test_wave_ordinals_monotone_and_sweeps_counted():
+    """The batched route's commit ordinals stay a valid per-pod latency
+    decomposition: every scheduled pod's ordinal is in [0, sweeps), and
+    the wave collapses sweeps well below the one-pod-per-round count."""
+    snap = _snap_for("chunked", seed=5)
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = HoistCache().ensure(arr, meta, cfg)
+    c, _, o, s = schedule_batch_ordinals_routed(arr, cfg, donate=False,
+                                                inc=inc)
+    c = np.asarray(c)[: meta.n_pods]
+    o = np.asarray(o)[: meta.n_pods]
+    s = int(s)
+    m = c >= 0
+    assert m.any()
+    assert (o[m] >= 0).all() and (o[m] < s).all()
+    # the batching bought something: fewer sweeps than scheduled pods
+    assert s < int(m.sum()), (s, int(m.sum()))
+
+
+def _interference_snap():
+    # one dominant class hammering a handful of nearly-full nodes: almost
+    # every commit moves the winning node's score, so wave blocks truncate
+    # at the certification check and the exact fallback rescore + epoch
+    # continuation must carry the frontier
+    nodes = [mk_node(f"n{i}", cpu=4000, pods=40) for i in range(8)]
+    pods = [
+        dataclasses.replace(mk_pod("big", cpu=1000), name=f"p{i:03d}",
+                            uid="")
+        for i in range(240)
+    ] + [mk_pod(f"q{i}", cpu=500) for i in range(16)]
+    return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+def test_interference_heavy_wave_forces_fallback():
+    """Adversarial same-class contention: the wave kernel's epoch counter
+    must tick (fallback commits stacked onto claimed nodes / truncated
+    blocks force continuation epochs), capacity must exhaust exactly where
+    the serial semantics say, and decisions stay bit-identical to the
+    dense kernel and the oracle."""
+    from kubernetes_tpu.ops.scores import balanced_allocation, fit_score
+
+    snap = _interference_snap()
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = HoistCache().ensure(arr, meta, cfg)
+    assert inc is not None
+
+    # white-box: drive the wave stage directly and read its epoch counter
+    res = cfg.score_resources
+
+    def score_flat(requested, alloc):
+        return cfg.fit_weight * fit_score(requested, alloc, cfg) + \
+            cfg.balanced_weight * balanced_allocation(requested, alloc, res)
+
+    t0u_init = jnp.where(inc.stat_u & inc.fit_u, inc.base_u, -jnp.inf)
+    f = jax.jit(lambda c, pv, pr, ui, t0, st, na, ru:
+                assign._wave_commit_stage(c, pv, pr, ui, t0, st, na, ru,
+                                          score_flat))
+    outs = f(inc.cls, arr.pod_valid, arr.pod_req, arr.node_used, t0u_init,
+             inc.stat_u, arr.node_alloc, inc.req_u)
+    committed, blocks, epochs = (np.asarray(outs[0]), int(outs[5]),
+                                 int(outs[6]))
+    assert committed.any()
+    # interference really forced the fallback/continuation machinery
+    assert epochs > 0, (blocks, epochs)
+
+    # ... and the end-to-end routed decisions are still exact
+    got_c, got_u = schedule_batch_routed(arr, cfg, donate=False, inc=inc)
+    want_c, want_u = schedule_batch_routed(arr, cfg, donate=False)
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+    np.testing.assert_array_equal(np.asarray(got_u), np.asarray(want_u))
+    assert _decode(got_c, meta) == oracle_schedule(snap, cfg)
+    # capacity genuinely exhausted mid-wave (the adversarial regime)
+    ch = np.asarray(got_c)[: meta.n_pods]
+    assert (ch >= 0).any() and (ch < 0).any()
+
+
+def test_degenerate_all_unique_never_traces_wave():
+    """U == P: ensure() refuses the no-op dedup, the routed call takes the
+    DENSE kernel, and the wave stage never traces (class_waves flat)."""
+    nodes = [mk_node(f"n{i}", cpu=16_000, pods=256) for i in range(16)]
+    pods = [mk_pod(f"p{i}", cpu=100 + i) for i in range(128)]
+    snap = Snapshot(nodes=nodes, pending_pods=pods)
+    enc = DeltaEncoder()
+    arr, meta = enc.encode(snap)
+    assert arr.P == 128 and meta.n_classes == 128
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc = HoistCache().ensure(arr, meta, cfg)
+    assert inc is None
+    jax.clear_caches()  # strict: a warm cache would make the guard vacuous
+    before = dict(TRACE_COUNTS)
+    got_c, _ = schedule_batch_routed(arr, cfg, donate=False, inc=inc)
+    assert TRACE_COUNTS["class_waves"] == before["class_waves"]
+    assert TRACE_COUNTS["chunked_inc"] == before["chunked_inc"]
+    assert _decode(got_c, meta) == oracle_schedule(snap, cfg)
+
+
+# --- seeded chaos storm with batching armed: placements bit-identical to
+# the fault-free dense serial churn (the landability bar) ---
+def _churn(pipeline: bool, plan=None, incremental: bool = True):
+    os.environ["KTPU_PIPELINE"] = "1" if pipeline else "0"
+    os.environ["KTPU_INCREMENTAL"] = "" if incremental else "0"
+    try:
+        ctx = (
+            chaos.chaos_plan(plan) if plan is not None
+            else __import__("contextlib").nullcontext()
+        )
+        with ctx:
+            store = ClusterStore()
+            for i in range(6):
+                store.add_node(mk_node(f"n{i}", cpu=4000, pods=24))
+            sched = Scheduler(store, SchedulerConfiguration(mode="tpu"))
+            for i in range(28):
+                store.add_pod(mk_pod(f"p{i}", cpu=250 + 50 * (i % 3)))
+            sched.run_until_idle()
+            rng = random.Random(17)
+            for r in range(2):
+                bound = sorted(
+                    (p for p in store.pods.values() if p.node_name),
+                    key=lambda p: p.uid,
+                )
+                for v in rng.sample(bound, 8):
+                    store.delete_pod(v.uid)
+                    q = copy.copy(v)
+                    q.name = f"{v.name}-r{r}"
+                    q.uid = ""
+                    q.node_name = ""
+                    q.__post_init__()
+                    store.add_pod(q)
+                sched.run_until_idle()
+            placements = {p.name: p.node_name for p in store.pods.values()}
+            return placements, sched
+    finally:
+        os.environ.pop("KTPU_PIPELINE", None)
+        os.environ.pop("KTPU_INCREMENTAL", None)
+
+
+def test_chaos_storm_with_batching_armed():
+    assert assign._CLASS_WAVES
+    oracle, _ = _churn(pipeline=False, incremental=False)  # dense serial
+    plan = chaos.FaultPlan.from_seed(
+        3, sites=("scheduler.step", "host.stall"), n_faults=5
+    )
+    got, sched = _churn(pipeline=True, plan=plan, incremental=True)
+    assert got == oracle
+    # the storm really rode the class-hoisted (wave-armed) route
+    assert sched._hoist_cache is not None
+    assert (
+        sched._hoist_cache.stats["hits"] + sched._hoist_cache.stats["full"]
+        + sched._hoist_cache.stats["static_rebuilds"] > 0
+    ), sched._hoist_cache.stats
