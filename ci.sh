@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # The CI gate — the exact checks every push must pass, runnable by humans
 # too (`./ci.sh`), so CI and a laptop can never disagree about what green
-# means.  Five stages, fail-fast:
+# means.  Seven stages, fail-fast:
 #
 #   1. tier-1 tests        the ROADMAP.md tier-1 command (not slow, 870 s cap)
 #   2. ktpu-verify         AST + device + shard + mem passes (KTPU001–020:
@@ -20,15 +20,24 @@
 #                          --open-loop rollout --sli-attribution at reduced
 #                          scale): the artifact must stamp a finite headline
 #                          SLI with per-phase p99 shares summing to ~1.0
-#   5. regression gates    bench/regression.py over the BENCH_r*.json
+#   5. open-loop storm     the SAME rollout replay under a seeded
+#                          kill.post_checkpoint storm (mid-stream leader
+#                          failover — bench/loadgen.py): decision_crc must
+#                          equal the stage-4 un-killed replay bit-for-bit,
+#                          restarts >= 1, and the admission accounting
+#                          identity shed + scheduled + unschedulable ==
+#                          trace arrivals must hold
+#   6. regression gates    bench/regression.py over the BENCH_r*.json
 #                          trajectory (same-platform comparison only), plus
 #                          the observatory's round_loop_fraction /
 #                          device_flops / device_hbm_bytes scalars, the
 #                          memwatch plane's measured hbm_peak_bytes from
-#                          the stage-3 artifact, and the commit-wave
+#                          the stage-3 artifact, the commit-wave
 #                          rounds_executed sweep count (class-batched
-#                          commit waves — the number the batching collapses)
-#   6. autotune smoke      bench/autotune.py end to end: sweep 2 knob
+#                          commit waves — the number the batching
+#                          collapses), and the storm stage's
+#                          recovered_waves / failover_p99_ms
+#   7. autotune smoke      bench/autotune.py end to end: sweep 2 knob
 #                          candidates in fresh subprocesses, persist the
 #                          winner next to the (smoke) compile cache, and
 #                          prove a second process RELOADS it (ops/tuning.py
@@ -39,7 +48,7 @@
 set -uo pipefail
 cd "$(dirname "$0")"
 
-echo "=== [1/6] tier-1 tests ==="
+echo "=== [1/7] tier-1 tests ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
@@ -51,14 +60,14 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
-echo "=== [2/6] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
+echo "=== [2/7] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
 JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard --mem || {
   rc=$?
   echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
   exit "$rc"
 }
 
-echo "=== [3/6] device cost observatory + memwatch smoke (--profile) ==="
+echo "=== [3/7] device cost observatory + memwatch smoke (--profile) ==="
 # fresh process (XLA parses dump flags once); reduced stream shape so the
 # smoke prices the capture path, not the full BENCH scale.  The stream's
 # artifact also carries the memwatch block: the harness exits 1 when the
@@ -76,7 +85,7 @@ JAX_PLATFORMS=cpu KTPU_STREAM_SHAPE=512x128 \
   exit "$rc"
 }
 
-echo "=== [4/6] open-loop load observatory smoke ==="
+echo "=== [4/7] open-loop load observatory smoke ==="
 # reduced-scale rollout ramp on the cpu sim: proves the open-loop driver,
 # the CO-safe SLI stamping and the phase decomposition end to end.  The
 # python step asserts the acceptance contract on the artifact itself.
@@ -98,7 +107,41 @@ shares = sum(p["p99_share"] for p in art["sli_phases"].values())
 assert abs(shares - 1.0) < 1e-3, art["sli_phases"]
 PY
 
-echo "=== [5/6] bench regression gates ==="
+echo "=== [5/7] open-loop storm: mid-stream failover decision parity ==="
+# the SAME rollout replay, now under a seeded kill.post_checkpoint storm
+# with a checkpoint dir armed: the scheduler must die mid-stream, a
+# standby must take over from the checkpointed trace cursor, and the
+# final decision_crc must equal the stage-4 un-killed replay BIT FOR BIT
+# (blackout moves latency, never placement).  The python step also
+# asserts the CO-honest admission accounting identity — every trace
+# arrival is scheduled, unschedulable, or honestly counted as shed.
+rm -rf /tmp/ktpu-ci-storm-ckpt
+JAX_PLATFORMS=cpu KTPU_OPEN_LOOP_SCALE=0.5 \
+  KTPU_CHECKPOINT_DIR=/tmp/ktpu-ci-storm-ckpt \
+  KTPU_FAULT_PLAN="kill.post_checkpoint:kill@1;kill.post_checkpoint:kill@25" \
+  python -m kubernetes_tpu.bench.harness --open-loop rollout \
+  --out /tmp/KTPU_CI_STORM.json > /dev/null || {
+  rc=$?
+  echo "ci: open-loop storm failed (rc=$rc)" >&2
+  exit "$rc"
+}
+python - <<'PY' || { echo "ci: storm artifact contract violated" >&2; exit 1; }
+import json
+base = json.load(open("/tmp/KTPU_CI_OPENLOOP.json"))
+storm = json.load(open("/tmp/KTPU_CI_STORM.json"))
+# exactly-once, bit-identical placement across the kill: the crc is over
+# every (pod, verdict, node) decision in commit order
+assert storm["decision_crc"] == base["decision_crc"], (
+    storm["decision_crc"], base["decision_crc"])
+assert storm["restarts"] >= 1, storm["restarts"]
+assert storm["ha"] and storm["ha"]["failover_p99_ms"] > 0, storm["ha"]
+# admission accounting identity: shed + scheduled + unschedulable must
+# telescope back to the trace's arrivals — no pod silently dropped
+total = storm["shed"] + storm["scheduled"] + storm["unschedulable"]
+assert total == storm["pods"], (total, storm["pods"])
+PY
+
+echo "=== [6/7] bench regression gates ==="
 # exit 2 = no comparable same-platform artifact pair on this runner — the
 # gate is advisory there (CI boxes have no BENCH trajectory of their own);
 # a real regression (exit 1) still fails the build
@@ -122,8 +165,13 @@ run_gate --metric sli_p99_ms --current /tmp/KTPU_CI_OPENLOOP.json
 # stamps rounds_executed; a change that silently reinflates the round
 # count fails here even when wall time hides it on a fast box
 run_gate --metric rounds_executed
+# storm-stage gates: recovered_waves must not silently drop (a storm that
+# stops restarting stopped testing failover) and the blackout-inclusive
+# failover p99 must not regress vs prior storm artifacts on this box
+run_gate --metric recovered_waves --higher-is-better --current /tmp/KTPU_CI_STORM.json
+run_gate --metric failover_p99_ms --current /tmp/KTPU_CI_STORM.json
 
-echo "=== [6/6] autotune smoke (sweep -> persist -> reload) ==="
+echo "=== [7/7] autotune smoke (sweep -> persist -> reload) ==="
 # two tiny candidates in fresh subprocesses (the knobs are trace-time
 # constants); the second probe must RELOAD the persisted winner with no
 # knob env set — proving the ops/tuning.py env > winner > default chain

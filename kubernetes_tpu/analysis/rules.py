@@ -132,6 +132,11 @@ class KillSafetyRule(Rule):
     ALLOWLIST: Set[Tuple[str, str]] = {
         ("kubernetes_tpu/scheduler/scheduler.py", "run_restartable"),
         ("kubernetes_tpu/scheduler/scheduler.py", "run_ha_restartable"),
+        # the streaming restart drivers (same protocol, stream shape): the
+        # wave-WAL replay loop and the open-loop replay's mid-stream
+        # leader failover
+        ("kubernetes_tpu/parallel/pipeline.py", "run_stream_restartable"),
+        ("kubernetes_tpu/bench/loadgen.py", "replay_trace"),
     }
 
     def check(self, mod: ModuleInfo) -> List[Finding]:

@@ -514,10 +514,23 @@ def run_streaming_workload(
     and the kernel-route trace counts.
 
     pipeline=False (the --no-pipeline escape hatch) runs ONLY the serial
-    loop, so pre-pipeline numbers remain reproducible bit-for-bit."""
+    loop, so pre-pipeline numbers remain reproducible bit-for-bit.
+
+    Kill storms: when the armed chaos plan carries kill.* sites, every
+    pass (warmup included — its pokes consume the same global per-site
+    ordinals) is driven by pipeline.run_stream_restartable, which answers
+    each ProcessKilled with a fresh loop replaying exactly the waves the
+    stream wave WAL has not committed; the measured pass owns the durable
+    WAL (KTPU_CHECKPOINT_DIR) and the artifact stamps restarts /
+    recovered_waves / the ha failover series next to the SLI."""
+    from .. import chaos as chaos_mod
     from ..ops.assign import TRACE_COUNTS
     from ..parallel.mesh import mesh_from_env
-    from ..parallel.pipeline import PipelinedBatchLoop
+    from ..parallel.pipeline import (
+        STREAM_WAL,
+        PipelinedBatchLoop,
+        run_stream_restartable,
+    )
     from ..scheduler.metrics import Metrics, reset_run_state
     from ..scheduler.tracing import Tracer
 
@@ -528,9 +541,31 @@ def run_streaming_workload(
     reset_run_state(metrics=metrics, collector=collector)
     _CURRENT_METRICS["m"] = metrics  # the KTPU_METRICS scrape target
     mesh = mesh_from_env()  # KTPU_MESH: sharded routed step under the loop
+    inj = chaos_mod.active()
+    kills_armed = inj is not None and any(
+        f.site in chaos_mod.ALL_KILL_SITES for f in inj.plan.faults
+    )
+    ckpt = None
+    if kills_armed and os.environ.get("KTPU_CHECKPOINT_DIR"):
+        from ..scheduler.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(os.environ["KTPU_CHECKPOINT_DIR"],
+                                 metrics=metrics)
+        # a fresh bench measurement: a stale stream WAL from an earlier
+        # run would silently skip waves as already-committed
+        stale = os.path.join(ckpt.directory, f"{STREAM_WAL}.json")
+        if os.path.exists(stale):
+            os.remove(stale)
     if warmup:  # hit the XLA cache so the timed runs measure steady state
-        for _ in PipelinedBatchLoop(donate=donate, mesh=mesh).run(waves[:1]):
-            pass
+        if kills_armed:
+            run_stream_restartable(
+                waves[:1],
+                lambda commit, wal: PipelinedBatchLoop(
+                    donate=donate, mesh=mesh, commit=commit, wal=wal),
+            )
+        else:
+            for _ in PipelinedBatchLoop(donate=donate, mesh=mesh).run(waves[:1]):
+                pass
     import contextlib
 
     tracer = Tracer(collector, component="pipeline") if collector else None
@@ -550,7 +585,7 @@ def run_streaming_workload(
     # spans and SLI samples would pollute the pipelined run's report.
     # Built as an explicit depth-0 loop (run_serial's exact dataflow) so
     # the --no-pipeline branch can read the loop's memwatch ledger.
-    serial_loop = PipelinedBatchLoop(
+    serial_kw = dict(
         donate=donate, mesh=mesh, depth=0,
         tracer=None if pipeline else tracer,
         metrics=None if pipeline else metrics,
@@ -559,8 +594,28 @@ def run_streaming_workload(
         # inside the timed serial_s window
         memwatch=None if not pipeline else False,
     )
+    serial_loop = PipelinedBatchLoop(**serial_kw)
+    serial_restarts = 0
     with _maybe_profile(not pipeline):
-        serial = list(serial_loop.run(waves))
+        if kills_armed:
+            holder = [serial_loop]
+
+            def _serial_factory(commit, wal):
+                holder[0] = PipelinedBatchLoop(**serial_kw, commit=commit,
+                                               wal=wal)
+                return holder[0]
+
+            serial, serial_restarts = run_stream_restartable(
+                waves, _serial_factory,
+                # the MEASURED pass owns the durable WAL and the HA
+                # series; when pipelining, this serial pass is only the
+                # unmetered reference oracle
+                checkpoint=None if pipeline else ckpt,
+                metrics=None if pipeline else metrics,
+            )
+            serial_loop = holder[0]  # memwatch/stats read the last loop
+        else:
+            serial = list(serial_loop.run(waves))
     t_serial = time.perf_counter() - t0
     out = {
         "name": name,
@@ -581,12 +636,24 @@ def run_streaming_workload(
         out.update(
             pipelined_s=None, overlap_gain=None, overlap_fraction=0.0,
             pods_per_sec=round(pods / t_serial, 1) if t_serial > 0 else 0.0,
+            # crash-restart accounting: fresh-loop restarts + within-loop
+            # serial-replay recoveries, and the HA/failover series next
+            # to the SLI (same contract as the snapshot rounds)
+            restarts=serial_restarts,
+            recovered_waves=(serial_restarts
+                             + int(serial_loop.stats["recovered"])),
+            ha=ha_fields(metrics),
             **sli_fields(metrics),
             **event_fields(metrics),
             # measured HBM telemetry (scheduler/memwatch.py):
             # hbm_peak_bytes / hbm_resident_bytes + the sentinel block
             **memwatch_fields(serial_loop, metrics, out["n_shards"]),
         )
+        if out["ha"]:
+            # failover quantiles top-level next to sli_p99_ms, so
+            # `bench.regression --metric failover_p99_ms` gates them
+            out["failover_p50_ms"] = out["ha"]["failover_p50_ms"]
+            out["failover_p99_ms"] = out["ha"]["failover_p99_ms"]
         if profile_dir:
             _profile_block(out, profile_dir, waves, mesh, collector)
         if collector is not None:
@@ -597,9 +664,24 @@ def run_streaming_workload(
         return out
     runner = PipelinedBatchLoop(donate=donate, tracer=tracer, mesh=mesh,
                                 metrics=metrics)
+    restarts = 0
     t0 = time.perf_counter()
     with _maybe_profile(True):
-        pipelined = list(runner.run(waves))
+        if kills_armed:
+            holder = [runner]
+
+            def _pipe_factory(commit, wal):
+                holder[0] = PipelinedBatchLoop(donate=donate, tracer=tracer,
+                                               mesh=mesh, metrics=metrics,
+                                               commit=commit, wal=wal)
+                return holder[0]
+
+            pipelined, restarts = run_stream_restartable(
+                waves, _pipe_factory, checkpoint=ckpt, metrics=metrics,
+            )
+            runner = holder[0]  # overlap/hoist/memwatch read the last loop
+        else:
+            pipelined = list(runner.run(waves))
     t_pipe = time.perf_counter() - t0
     assert pipelined == serial, "pipelined verdicts diverged from serial"
     out.update(
@@ -609,6 +691,12 @@ def run_streaming_workload(
         donated_waves=int(runner.stats["donated"]),
         pods_per_sec=round(pods / t_pipe, 1) if t_pipe > 0 else 0.0,
         route_trace_counts=dict(TRACE_COUNTS),
+        # crash-restart accounting (same contract as the snapshot rounds):
+        # fresh-loop restarts + within-loop serial-replay recoveries and
+        # the HA/failover series next to the SLI
+        restarts=restarts,
+        recovered_waves=restarts + int(runner.stats["recovered"]),
+        ha=ha_fields(metrics),
         # the headline SLI next to throughput: per-pod arrival -> bind
         **sli_fields(metrics),
         **event_fields(metrics),
@@ -619,6 +707,11 @@ def run_streaming_workload(
         # sentinel block; the scale-out gauges mirror the artifact
         **memwatch_fields(runner, metrics, out["n_shards"]),
     )
+    if out["ha"]:
+        # failover quantiles top-level next to sli_p99_ms, so
+        # `bench.regression --metric failover_p99_ms` gates them
+        out["failover_p50_ms"] = out["ha"]["failover_p50_ms"]
+        out["failover_p99_ms"] = out["ha"]["failover_p99_ms"]
     if profile_dir:
         _profile_block(out, profile_dir, waves, mesh, collector)
     if collector is not None:
@@ -1070,15 +1163,12 @@ def main(argv=None) -> None:
     if inj is not None:
         print(f"chaos plan: {inj.plan.describe()}", file=sys.stderr)
         has_kills = any(
-            f.site in chaos_mod.KILL_SITES for f in inj.plan.faults
+            f.site in chaos_mod.ALL_KILL_SITES for f in inj.plan.faults
         )
-        if has_kills and args.stream:
-            # the streaming loop has no Scheduler, hence no checkpoint /
-            # restore() to answer a ProcessKilled with — kill storms belong
-            # to the snapshot rounds' HA driver
-            ap.error("kill.* storms need the scheduler's crash-restart "
-                     "protocol — drop --stream (snapshot rounds) or exclude "
-                     "them: --chaos-sites '*,!kill.*'")
+        # every driver survives kill.* now: snapshot rounds via the HA
+        # takeover (run_ha_restartable), --stream via the stream wave WAL
+        # (parallel/pipeline.run_stream_restartable) and --open-loop via
+        # the mid-stream leader failover inside replay_trace
         if has_kills and not os.environ.get("KTPU_CHECKPOINT_DIR"):
             # a kill storm without a checkpoint dir would still pass parity
             # (crash-only rebuild), but the point of the storm is to exercise

@@ -39,7 +39,10 @@ Knobs: KTPU_OPEN_LOOP_QUANTUM_MS (replay cycle quantum, default 250),
 KTPU_OPEN_LOOP_PACE (virtual|real), KTPU_OPEN_LOOP_SPEED (real-pace
 multiplier), KTPU_OPEN_LOOP_SCALE (scenario size multiplier),
 KTPU_OPEN_LOOP_SEED (scenario seed for the named CLI path),
-KTPU_OPEN_LOOP_EXEMPLARS (worst-K, read by the scheduler).
+KTPU_OPEN_LOOP_EXEMPLARS (worst-K, read by the scheduler),
+KTPU_ADMIT_WATERMARK / KTPU_ADMIT_MAX_PARK_S (the overload admission
+valve threaded over the replay's arrival stream —
+scheduler/flowcontrol.AdmissionValve).
 """
 
 from __future__ import annotations
@@ -372,7 +375,26 @@ def replay_trace(
     backoff maturation is replay-deterministic.  After the trace drains,
     `max_barren_cycles` consecutive cycles without a new bind ends the
     run; whatever is still pending is reported as unschedulable rather
-    than spinning forever."""
+    than spinning forever.
+
+    Storm-proofing (two optional planes, both off by default):
+
+    - KILL FAULTS: when the armed chaos plan carries kill.* sites, the
+      replay runs under the HA protocol — a lease table outlives every
+      incarnation and each ProcessKilled is answered by a standby leader
+      takeover (scheduler.ha_takeover) that RETRIES the same driver cycle
+      on the restored replacement.  The driver's (v_now, i) cursor is
+      authoritative and never rewinds; the checkpointed cursor
+      (scheduler._replay_cursor -> restore().restored_cursor) is resume
+      evidence, validated to never run ahead of the live driver.  The
+      artifact's decision_crc must match an un-killed replay bit-for-bit
+      (ci.sh gates it).
+
+    - ADMISSION VALVE: KTPU_ADMIT_WATERMARK>0 threads every due arrival
+      through scheduler/flowcontrol.AdmissionValve — over the watermark
+      the wave shrinks, lowest bands park fair-share, stale parks shed —
+      and the artifact keeps the accounting identity
+      scheduled + unschedulable + shed == trace arrivals."""
     from ..scheduler import ClusterStore, Scheduler, SchedulerConfiguration
     from ..scheduler.flightrecorder import fingerprint
     from ..scheduler.metrics import Metrics, reset_run_state
@@ -405,6 +427,28 @@ def replay_trace(
         clock=clk, collector=collector, metrics=metrics,
     )
 
+    from .. import chaos
+    from ..scheduler.flowcontrol import AdmissionValve
+
+    # overload-graceful admission: invisible at the default watermark 0,
+    # so existing open-loop artifacts replay byte-identically
+    valve = AdmissionValve(metrics=metrics)
+
+    # kill.* faults in the armed plan put the replay under the HA
+    # protocol: the lease table outlives every incarnation and a standby
+    # leader takeover resumes the stream mid-cycle (docstring above)
+    leases = leader = None
+    inj = chaos.active()
+    if inj is not None and any(
+        f.site in chaos.ALL_KILL_SITES for f in inj.plan.faults
+    ):
+        from ..scheduler.leases import LeaderElector, LeaseStore
+
+        leases = LeaseStore()
+        leader = LeaderElector(leases, "sched-0", lease_duration_s=0.25)
+        leader.tick()  # incarnation 0 is the initial leader
+
+    trace_crc = trace.fingerprint()
     events = sorted(trace.events, key=lambda e: (e.t, e.name))
     t_wall0 = time.perf_counter()
     v_now = 0.0
@@ -412,26 +456,72 @@ def replay_trace(
     cycles = 0
     barren = 0
     bound_prev = 0
+    restarts = 0
+    resume_cursor = None
     while True:
+        due = []
         while i < len(events) and events[i].t <= v_now + 1e-9:
-            ev = events[i]
+            due.append(events[i])
+            i += 1
+        admitted = (
+            valve.offer(due, sched.queue.pending_total, v_now)
+            if valve.enabled else due
+        )
+        for ev in admitted:
             pod = _mk_pod(ev)
             store.add_pod(pod)  # watch admission stamps a send-time arrival
             # ... which the trace arrival instant immediately back-dates:
             # the CO-safe clock.  Virtual pace: age = how far the replay
             # clock has run past the trace timestamp.  Real pace: the
-            # wall instant the trace said the pod arrives.
+            # wall instant the trace said the pod arrives.  A valve-parked
+            # pod keeps ITS trace instant too — park time lands in
+            # queue_wait, honestly.
             if pace == "real":
                 sched.queue.stamp_arrival(pod.uid, t_wall0 + ev.t / speed)
             else:
                 sched.queue.stamp_arrival(
                     pod.uid, time.perf_counter() - (v_now - ev.t))
-            i += 1
         pending = sched.queue.pending_total
-        if i >= len(events) and pending == 0:
+        if i >= len(events) and pending == 0 and not valve.parked_count:
             break
+        # the replay cursor rides the scheduler's next checkpoint: a
+        # post-mortem (or a cold standby process) knows exactly which
+        # trace offset the dead leader was serving; the flight recorder
+        # carries the same context into any kill dump
+        if sched._ckpt is not None:
+            sched._replay_cursor = {
+                "v_now": round(v_now, 9), "i": i,
+                "trace_crc": trace_crc, "scenario": trace.scenario,
+            }
+            sched._flight.annotate(
+                trace_crc=trace_crc, scenario=trace.scenario,
+                trace_offset=i, v_now=round(v_now, 6),
+            )
         if pending:
-            sched.run_until_idle()
+            try:
+                sched.run_until_idle()
+            except chaos.ProcessKilled as e:
+                if leader is None:
+                    raise  # no HA plane armed: the kill is the caller's
+                restarts += 1
+                if restarts > 64:
+                    raise
+                from ..scheduler.scheduler import ha_takeover
+
+                sched, leader = ha_takeover(
+                    sched, leases, leader, killed_site=e.fault.site,
+                    lease_duration_s=0.25, name=f"sched-{restarts}",
+                )
+                rc = sched.restored_cursor
+                if rc and rc.get("trace_crc") == trace_crc:
+                    # written BEFORE the wave that died — it may trail the
+                    # live driver but must never run ahead of it
+                    if rc.get("i", 0) > i:
+                        raise RuntimeError(
+                            f"checkpoint cursor i={rc.get('i')} ahead of "
+                            f"driver i={i} — checkpoint from the future")
+                    resume_cursor = dict(rc)
+                continue  # retry the SAME cycle on the new leader
         bound = sum(1 for p in store.list_pods() if p.node_name)
         barren = 0 if bound > bound_prev else barren + 1
         bound_prev = bound
@@ -445,27 +535,33 @@ def replay_trace(
             now = time.perf_counter()
             if now < target:
                 time.sleep(target - now)
+    shed_flush = valve.flush(v_now)  # stream over: parked leftovers shed
     wall_s = time.perf_counter() - t_wall0
 
-    from .harness import sli_fields
+    from .harness import ha_fields, sli_fields
 
     assignments = {
         p.name: p.node_name for p in store.list_pods() if p.node_name
     }
     leftover = sched.queue.pending_total
+    ha = ha_fields(metrics)
     artifact = {
         "name": f"open-loop:{trace.name}",
         "latency_mode": "open-loop",
         "platform": _platform(),
         "scenario": trace.scenario,
         "seed": trace.seed,
-        "trace_crc": trace.fingerprint(),
+        "trace_crc": trace_crc,
         "trace_events": len(events),
         "trace_duration_s": trace.duration_s,
         "nodes": trace.nodes,
         "pods": len(events),
         "scheduled": len(assignments),
         "unschedulable": leftover,
+        # the admission accounting identity the storm gate asserts:
+        # scheduled + unschedulable + shed == trace arrivals
+        "shed": valve.shed_total,
+        "shed_at_flush": shed_flush,
         "cycles": cycles,
         "quantum_ms": round(quantum_s * 1e3, 3),
         "pace": pace,
@@ -473,7 +569,20 @@ def replay_trace(
         # sorted-name map: replays injecting in a different cycle pattern
         # but deciding identically must fingerprint identically
         "decision_crc": fingerprint(dict(sorted(assignments.items()))),
+        # crash-restart accounting: leader takeovers this replay survived
+        # (each recovers the cycle's in-flight wave), the HA/failover
+        # series next to the SLI, and the last validated resume cursor
+        "restarts": restarts,
+        "recovered_waves": restarts,
+        "ha": ha,
+        "resume_cursor": resume_cursor,
+        "admission": valve.report() if valve.enabled else None,
         **sli_fields(metrics),
+        # failover percentiles stamped top-level next to sli_p99_ms so the
+        # regression gate reads them like any other latency scalar (the
+        # nested ha block keeps the full HA series)
+        **({"failover_p50_ms": ha["failover_p50_ms"],
+            "failover_p99_ms": ha["failover_p99_ms"]} if ha else {}),
         "sli_phases": phase_stats(metrics),
         "sli_attribution": sli_attribution(metrics, sched),
     }

@@ -115,7 +115,8 @@ def _metric(rec: Dict, name: str) -> Optional[float]:
 # (`foo.sli_p99_ms`) get it too, and applies ONLY to these metrics: value /
 # step_s / comm_bytes comparisons stay valid across driver modes (and
 # across old artifacts that predate the latency_mode stamp).
-LATENCY_METRICS = ("sli_p50_ms", "sli_p99_ms", "p50_ms", "p90_ms", "p99_ms")
+LATENCY_METRICS = ("sli_p50_ms", "sli_p99_ms", "p50_ms", "p90_ms", "p99_ms",
+                   "failover_p50_ms", "failover_p99_ms")
 
 
 def check_regression(

@@ -69,6 +69,13 @@ SITE_ACTIONS: Dict[str, Tuple[str, ...]] = {
     "kill.post_checkpoint": ("kill",),  # checkpoint durable, bind unpublished
     "kill.mid_flush": ("kill",),        # mid deferred-commit flush fan-out
     "kill.mid_step": ("kill",),         # mid device step, donated bufs in flight
+    # the STREAMING kill family (parallel/pipeline.py): death points of the
+    # pipelined loop itself.  Recovery is pipeline.run_stream_restartable —
+    # a fresh loop replaying every wave the stream WAL has not committed.
+    "kill.submit": ("kill",),           # wave accepted, nothing dispatched
+    "kill.dispatch": ("kill",),         # dispatched, donated bufs in flight
+    "kill.collect": ("kill",),          # verdicts fetched but uncommitted
+    "kill.drain": ("kill",),            # final in-flight wave unharvested
 }
 
 # the kill-point family: excluded from seeded storms UNLESS explicitly
@@ -79,6 +86,17 @@ KILL_SITES: Tuple[str, ...] = (
     "kill.post_assume", "kill.post_checkpoint", "kill.mid_flush",
     "kill.mid_step",
 )
+
+# the streaming loop's kill points, a SEPARATE tuple on purpose: existing
+# seeded storms and parity tests draw from KILL_SITES (from_seed(seed,
+# sites=KILL_SITES) must keep producing identical plans), so new sites may
+# only ever extend the site table at the end, never reshuffle that tuple
+STREAM_KILL_SITES: Tuple[str, ...] = (
+    "kill.submit", "kill.dispatch", "kill.collect", "kill.drain",
+)
+
+# every process-death site (what "has a kill been armed?" checks should use)
+ALL_KILL_SITES: Tuple[str, ...] = KILL_SITES + STREAM_KILL_SITES
 
 ALWAYS = -1  # Fault.at sentinel: fire on every invocation of the site
 
@@ -201,7 +219,7 @@ class FaultPlan:
         sites_matching("kill.*")) to storm the kill points."""
         rng = random.Random(seed)
         pool = tuple(sites) if sites else tuple(
-            s for s in SITE_ACTIONS if s not in KILL_SITES
+            s for s in SITE_ACTIONS if s not in ALL_KILL_SITES
         )
         faults = []
         for _ in range(n_faults):

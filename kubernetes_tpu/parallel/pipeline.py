@@ -87,6 +87,7 @@ class PipelinedBatchLoop:
         metrics=None,
         mesh=None,
         memwatch: Optional[bool] = None,
+        wal: Optional[Callable[[Dict], None]] = None,
     ):
         from ..ops.assign import donation_supported
 
@@ -105,6 +106,11 @@ class PipelinedBatchLoop:
         self.donate = donation_supported() if donate is None else donate
         self.depth = depth
         self.commit = commit
+        # stream wave-WAL hook (run_stream_restartable): called at every
+        # dispatch with the in-flight wave's membership record, BEFORE the
+        # kill.dispatch death point — durable-then-die ordering, so a
+        # restarted driver always knows which wave was in flight
+        self.wal = wal
         self.tracer = tracer
         self.metrics = metrics
         # wave-uniform SLI phase decomposition (scheduler/metrics.py —
@@ -172,6 +178,17 @@ class PipelinedBatchLoop:
         # host code must never read their VALUES, which the safety test
         # asserts by construction (fresh transfers, empty reuse table)
         self.last_donated_probe = None
+
+    def _kill(self, site: str) -> None:
+        """An enumerated process-death point of the STREAMING loop (the
+        chaos kill.submit/dispatch/collect/drain family): poke the
+        injector; a kill latches the module-wide killed() flag before the
+        ProcessKilled unwinds, so run()'s teardown drain and every caller
+        finally do nothing a SIGKILL'd process couldn't.  Recovery is a
+        FRESH loop re-encoding from host state, driven by
+        run_stream_restartable over the stream wave WAL."""
+        if chaos.enabled():
+            chaos.poke(site, tracer=self.tracer, metrics=self.metrics)
 
     # -- accounting helpers --
     def _span(self, name: str, start: float, end: float, **attrs):
@@ -250,9 +267,21 @@ class PipelinedBatchLoop:
                 arr.node_alloc, arr.node_used, arr.pod_prio, arr.pod_nodename,
             )
             self.stats["donated"] += 1
+        if self.wal is not None:
+            # durable-then-die: the wave-WAL record lands before the
+            # dispatch (and its kill point), so a death anywhere past here
+            # leaves the restart driver evidence of what was in flight
+            self.wal({
+                "wave": self._wave,
+                "pods": [p.name for p in snap.pending_pods],
+            })
         choices = schedule_batch_routed(
             arr, cfg, donate=donating, mesh=self.mesh, inc=inc
         )[0]
+        # kill.dispatch: process death with the step just dispatched and
+        # any donated input buffers in flight — nothing fetched, nothing
+        # committed; the whole wave replays on the restarted loop
+        self._kill("kill.dispatch")
         t1 = time.perf_counter()
         credit = self._overlap_credit(probe, running0)
         self._host_phase("encode", t1 - t0, credit)
@@ -347,6 +376,11 @@ class PipelinedBatchLoop:
             "decode_overlap", t1, t2, component="pipeline",
             wave=self._wave - 1, overlapped=credit > 0, overlap_credit=credit,
         )
+        # kill.collect: verdicts fetched and decoded but NOT committed —
+        # the wave is gone from process memory, yet nothing published; the
+        # restart driver must replay it (and exactly-once publication is
+        # its commit ledger's business, not this loop's)
+        self._kill("kill.collect")
         if self.commit is not None:
             c_run0 = self._step_running(probe)
             t3 = time.perf_counter()
@@ -405,6 +439,9 @@ class PipelinedBatchLoop:
         """Encode + dispatch `snap`; return the PREVIOUS wave's verdicts
         (None on the first call).  depth=0 collects BEFORE encoding — the
         serial oracle with identical dataflow."""
+        # kill.submit: process death with the wave accepted but nothing
+        # dispatched — the cheapest kill point (no device state in flight)
+        self._kill("kill.submit")
         if self.depth == 0:
             prev = self._collect()
             nxt = self._dispatch(snap)
@@ -436,6 +473,10 @@ class PipelinedBatchLoop:
 
     def drain(self) -> Optional[Verdicts]:
         """Fetch the final in-flight wave's verdicts (None if none)."""
+        # kill.drain: process death at the stream's end with the final wave
+        # still in flight — the classic lost-tail bug this site exists to
+        # prove impossible under the restart driver
+        self._kill("kill.drain")
         out = self._collect()
         if self.metrics is not None:
             self.metrics.observe(
@@ -541,3 +582,121 @@ def run_serial(
         metrics=metrics,
     )
     return loop.run(snapshots)
+
+
+# --- the streaming crash-restart driver (chaos kill.* over wave streams) ---
+STREAM_WAL = "stream_wal"
+
+
+def load_stream_wal(checkpoint) -> Dict[int, str]:
+    """The committed-wave ledger from the stream wave WAL: {wave index ->
+    verdict crc}.  Empty when unarmed, absent, or corrupt (load() already
+    quarantined + counted corruption; the crash-only floor is a full
+    replay, never a wrong one)."""
+    if checkpoint is None:
+        return {}
+    doc = checkpoint.load(STREAM_WAL)
+    if not doc:
+        return {}
+    return {int(k): str(v) for k, v in (doc.get("committed") or {}).items()}
+
+
+def run_stream_restartable(
+    waves,
+    make_loop: Callable[..., PipelinedBatchLoop],
+    checkpoint=None,
+    metrics=None,
+    max_restarts: int = 16,
+) -> Tuple[list, int]:
+    """Drive a stream of independent waves to completion across kill.*
+    chaos: every ProcessKilled is answered by a FRESH loop (the dead one's
+    device state is unreadable by contract) replaying exactly the waves the
+    commit ledger has not recorded — the streaming analog of
+    scheduler.run_restartable.
+
+    Exactly-once publication: each wave's verdicts land in the results
+    ledger (the model of the apiserver side, which survives the scheduler's
+    death) atomically with a crc append to the stream wave WAL, and commits
+    arrive in submit order, so a kill anywhere leaves a committed prefix +
+    an uncommitted suffix — the next incarnation replays only the suffix.
+    The deterministic encoder makes any accidental replay of a committed
+    wave produce the identical verdicts; the crc equality check turns a
+    divergence (a real double-publication hazard) into a hard error instead
+    of a silent overwrite.
+
+    make_loop(commit, wal) -> PipelinedBatchLoop: the caller configures
+    depth/donation/tracing and MUST thread both callbacks through.
+    checkpoint (CheckpointManager or None) arms the durable ledger; without
+    it the ledger is process-local (still exactly-once within this driver).
+    Blackouts (kill -> replacement loop ready) observe into
+    `failover_duration_seconds` and restarts into `scheduler_restarts_total`
+    — the same HA series the snapshot path stamps (bench ha_fields).
+    Returns (verdicts per wave, in order; #restarts)."""
+    from ..scheduler.flightrecorder import fingerprint
+
+    waves = list(waves)
+    committed: Dict[int, str] = load_stream_wal(checkpoint)
+    results: Dict[int, Verdicts] = {}
+    inflight: Dict[str, object] = {}
+    restarts = 0
+    t_dead: Optional[float] = None
+
+    def _persist() -> None:
+        if checkpoint is not None:
+            checkpoint.save(STREAM_WAL, {
+                "committed": {str(k): v for k, v in committed.items()},
+                "inflight": dict(inflight),
+            })
+
+    while True:
+        todo = [k for k in range(len(waves)) if k not in results]
+        if not todo:
+            return [results[k] for k in range(len(waves))], restarts
+        order = list(todo)  # commits arrive in submit order
+
+        def commit(verdicts: Verdicts, _order=order) -> None:
+            k = _order.pop(0)
+            crc = fingerprint({u: verdicts[u] for u in sorted(verdicts)})
+            prior = committed.get(k)
+            if prior is not None and prior != crc:
+                raise RuntimeError(
+                    f"stream wave {k} replay diverged from its committed "
+                    f"record: {prior} != {crc} — refusing to double-publish"
+                )
+            results[k] = verdicts
+            committed[k] = crc
+            _persist()
+
+        def wal(rec: Dict, _order=order) -> None:
+            inflight.clear()
+            inflight.update(rec)
+            # the global wave index the next commit will land on (the
+            # loop's own `wave` field is its local ordinal)
+            inflight["stream_wave"] = _order[0] if _order else -1
+            _persist()
+
+        loop = make_loop(commit, wal if checkpoint is not None else None)
+        if t_dead is not None:
+            # the replacement loop is ready: everything since the kill —
+            # revive, rebuild, recompile-if-cold — is the stream's takeover
+            # blackout, priced on the same series as a leader failover
+            blackout = time.perf_counter() - t_dead
+            t_dead = None
+            if metrics is not None:
+                metrics.observe("failover_duration_seconds", blackout)
+        try:
+            for k in todo:
+                loop.submit(waves[k])
+            loop.drain()
+        except chaos.ProcessKilled as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            t_dead = time.perf_counter()
+            chaos.revive()  # the latch belongs to the dead loop
+            if metrics is not None:
+                metrics.inc("scheduler_restarts_total")
+            chaos.record_recovery(
+                e.fault.site, "stream_restart", tracer=loop.tracer,
+                metrics=metrics, committed_waves=len(results),
+            )

@@ -145,6 +145,9 @@ def save_scheduler_state(
     wal: List[Tuple[str, str]],
     arrivals: Dict[str, float],
     lineage: str = "",
+    wave: Optional[Dict] = None,
+    cursor: Optional[Dict] = None,
+    popped: Optional[Dict[str, float]] = None,
 ) -> None:
     cm.save(
         SCHEDULER_STATE,
@@ -156,6 +159,23 @@ def save_scheduler_state(
             "assumed": dict(assumed),
             "wal": [[uid, node] for uid, node in wal],
             "arrivals": dict(arrivals),
+            # wave WAL (streaming crash-consistency): the in-flight commit
+            # wave's membership + verdict crc ({"uids": [...],
+            # "verdict_crc": str}), present only while a wave is between
+            # verdict and full publication — restore() splits it into the
+            # published prefix (store shows the bind), the durable suffix
+            # (deferred-bind wal above) and the requeued remainder
+            "wave": dict(wave) if wave else None,
+            # open-loop replay cursor ({"v_now", "i", "trace_crc",
+            # "scenario"}): the arrival trace's virtual clock + event offset
+            # ride the checkpoint so a standby resumes the replay at the
+            # exact trace position the leader died at (bench/loadgen.py)
+            "cursor": dict(cursor) if cursor else None,
+            # per-pod latest activeQ-pop AGE (uid -> seconds): the
+            # queue_wait/wave_wait SLI boundary — restored so a pod popped
+            # into a wave pre-kill keeps its original queue_wait and the
+            # blackout lands in wave_wait, not queue_wait
+            "popped": dict(popped) if popped else {},
             "saved_at": time.perf_counter(),
             # wall clock of the save: restore adds (now_wall - saved_wall)
             # to every arrival age so the BLACKOUT — the dead time between
@@ -172,12 +192,22 @@ def load_scheduler_state(cm: CheckpointManager) -> Optional[Dict]:
     doc = cm.load(SCHEDULER_STATE)
     if doc is None:
         return None
+    wave = doc.get("wave") or None
+    cursor = doc.get("cursor") or None
     return {
         "lineage": str(doc.get("lineage") or ""),
         "assumed": dict(doc.get("assumed") or {}),
         "wal": [(str(u), str(n)) for u, n in (doc.get("wal") or [])],
         "arrivals": {
             str(k): float(v) for k, v in (doc.get("arrivals") or {}).items()
+        },
+        "wave": {
+            "uids": [str(u) for u in (wave.get("uids") or [])],
+            "verdict_crc": str(wave.get("verdict_crc") or ""),
+        } if isinstance(wave, dict) else None,
+        "cursor": dict(cursor) if isinstance(cursor, dict) else None,
+        "popped": {
+            str(k): float(v) for k, v in (doc.get("popped") or {}).items()
         },
         "saved_at": float(doc.get("saved_at") or 0.0),
         "saved_wall": float(doc.get("saved_wall") or 0.0),

@@ -68,6 +68,15 @@ class FlightRecorder:
         self._lock = make_lock("FlightRecorder._lock")
         self._ring: deque = deque(maxlen=self.capacity)
         self._seq = 0
+        self._context: Dict = {}
+
+    def annotate(self, **fields) -> None:
+        """Run-level context merged into every later dump (not per-cycle —
+        the ring holds those).  The open-loop replay driver stamps the
+        arrival-trace fingerprint and its live cursor here each cycle, so
+        a mid-stream kill's black box says WHERE in the trace it died."""
+        with self._lock:
+            self._context.update(fields)
 
     def record(self, **fields) -> None:
         """Append one cycle record (called once per profile batch — the
@@ -87,11 +96,14 @@ class FlightRecorder:
         evidence must never mask the fault it documents."""
         if not self.directory:
             return None
+        with self._lock:
+            context = dict(self._context)
         doc = {
             "version": 1,
             "reason": reason,
             "dumped_wall": time.time(),
             "capacity": self.capacity,
+            "context": context,
             "records": self.records(),
         }
         path = os.path.join(self.directory, FLIGHT_FILENAME)
@@ -130,6 +142,13 @@ def render_flight(doc: Dict) -> str:
         f"{len(doc['records'])} record(s) "
         f"(ring capacity {doc.get('capacity', '?')})"
     ]
+    ctx = doc.get("context")
+    if isinstance(ctx, dict) and ctx:
+        # the run-level annotation block (annotate()): for an open-loop
+        # kill this names the arrival trace and the offset it died at
+        out.append("  context: " + " ".join(
+            f"{k}={ctx[k]}" for k in sorted(ctx)
+        ))
     for r in doc["records"]:
         line = (
             f"  #{r.get('seq', '?'):>4} {r.get('profile', '')} "

@@ -13,6 +13,8 @@ dispatch, the reference's R(t) progress with unit service time.
 from __future__ import annotations
 
 import hashlib
+import math
+import os
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
@@ -222,3 +224,165 @@ class APFController:
         with self._lock:
             if not self.queue_sets[req.level].cancel(req) and req.released:
                 self.queue_sets[req.level].finish(req)
+
+
+# --- the streaming admission valve (overload-graceful open-loop intake) ---
+#
+# Where the QueueSet machinery above models the apiserver's request path,
+# the valve below applies the same flow-control DOCTRINE — bounded queues,
+# fair share per priority band, shed instead of unbounded backlog — to the
+# scheduler's open-loop POD intake (bench/loadgen.py threads every due
+# arrival through it).  Because flow control lives upstream of the
+# component it protects, the valve's state legitimately survives a
+# scheduler kill: the replay driver (the apiserver stand-in) holds it, and
+# a leader takeover resumes against the same parked backlog.
+
+WATERMARK_ENV = "KTPU_ADMIT_WATERMARK"
+MAX_PARK_ENV = "KTPU_ADMIT_MAX_PARK_S"
+
+# the counter pair the valve maintains (bench artifacts read them through
+# report(); /metrics through the caller's Metrics)
+ADMISSION_COUNTERS = (
+    "scheduler_admission_parked_total",
+    "scheduler_admission_shed_total",
+)
+
+
+class AdmissionValve:
+    """Watermark-gated, priority-band fair-share admission over an arrival
+    stream.  Items need `.priority` (the FlowSchedule band) and `.t` (their
+    arrival instant in the caller's clock domain — the CO-honest base for
+    shed waits); `offer()` is called once per driver cycle with that
+    cycle's due arrivals and the current scheduler queue depth, in the same
+    time domain throughout.  Deterministic by construction: FIFO within a
+    band, bands served highest-first, no wall-clock reads — a replay under
+    the same trace and knobs admits the identical sequence (the
+    decision_crc parity gate covers valve-on runs too).
+
+    Knobs: KTPU_ADMIT_WATERMARK (0 = valve off, the default — existing
+    open-loop behavior is untouched), KTPU_ADMIT_MAX_PARK_S (staleness
+    bound, default 30 virtual seconds)."""
+
+    def __init__(self, watermark: Optional[int] = None,
+                 max_park_s: Optional[float] = None, metrics=None):
+        self.watermark = int(
+            os.environ.get(WATERMARK_ENV, "0") if watermark is None
+            else watermark
+        )
+        self.max_park_s = float(
+            os.environ.get(MAX_PARK_ENV, "30") if max_park_s is None
+            else max_park_s
+        )
+        self.metrics = metrics
+        # (admission seq, first-offer instant, item): FIFO within a band is
+        # the seq order; first-offer anchors the staleness bound
+        self._parked: List[Tuple[int, float, object]] = []
+        self._seq = 0
+        self.parked_total = 0  # cumulative first-parks
+        self.shed_total = 0
+        self.shed_items: List[object] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.watermark > 0
+
+    @property
+    def parked_count(self) -> int:
+        return len(self._parked)
+
+    def _floor(self) -> int:
+        # the adapted wave never starves entirely: even saturated, a sliver
+        # of the highest bands admits (apf's minimum concurrency shares)
+        return max(1, self.watermark // 8)
+
+    def _shed(self, entries, now: float) -> None:
+        for _, first, item in entries:
+            self.shed_total += 1
+            self.shed_items.append(item)
+            if self.metrics is not None:
+                self.metrics.inc("scheduler_admission_shed_total")
+                # CO-honest: the wait is measured from the arrival instant
+                # the TRACE assigned, not from when the valve got around to
+                # deciding — overload sheds with honestly long waits
+                t = getattr(item, "t", None)
+                self.metrics.hist("pod_admission_shed_wait_seconds").observe(
+                    max(0.0, now - (t if t is not None else first))
+                )
+
+    def offer(self, items, depth: int, now: float) -> List[object]:
+        """One driver cycle: merge `items` (this cycle's due arrivals) with
+        the parked backlog and return what admits NOW, given the scheduler
+        queue depth.  Under the watermark everything admits (the valve is
+        invisible); over it, stale parks shed first, then a fair share per
+        priority band of a budget that shrinks as depth grows."""
+        if not self.enabled:
+            return list(items)
+        pool = list(self._parked)
+        for item in items:
+            pool.append((self._seq, now, item))
+            self._seq += 1
+        self._parked = []
+        if not pool:
+            return []
+        if depth < self.watermark:
+            return [item for _, _, item in pool]
+        # saturated: shed past the staleness bound — admitting an arrival
+        # whose bound already expired would be serving a request the
+        # apiserver told the client to retry
+        live, stale = [], []
+        for e in pool:
+            (stale if now - e[1] > self.max_park_s else live).append(e)
+        self._shed(stale, now)
+        # wave adaptation: the admitted budget shrinks linearly as depth
+        # overshoots the watermark, never below the floor
+        budget = max(self._floor(), 2 * self.watermark - depth)
+        by_band: Dict[int, List] = {}
+        for e in live:
+            by_band.setdefault(getattr(e[2], "priority", 0), []).append(e)
+        bands = sorted(by_band, reverse=True)
+        share = math.ceil(budget / len(bands)) if bands else 0
+        admitted: List = []
+        # equal fair share per band, FIFO within the band...
+        for b in bands:
+            take = min(share, budget - len(admitted), len(by_band[b]))
+            admitted.extend(by_band[b][:take])
+            by_band[b] = by_band[b][take:]
+        # ...then any leftover budget spills highest-band-first
+        for b in bands:
+            room = budget - len(admitted)
+            if room <= 0:
+                break
+            admitted.extend(by_band[b][:room])
+            by_band[b] = by_band[b][room:]
+        newly_parked = 0
+        for b in bands:
+            for e in by_band[b]:
+                if e[1] == now:  # first offer this cycle — count the park
+                    newly_parked += 1
+                self._parked.append(e)
+        self._parked.sort(key=lambda e: e[0])  # FIFO across cycles
+        self.parked_total += newly_parked
+        if self.metrics is not None and newly_parked:
+            self.metrics.inc("scheduler_admission_parked_total",
+                             newly_parked)
+        return [item for _, _, item in admitted]
+
+    def flush(self, now: float) -> int:
+        """End of stream: every still-parked arrival sheds (the driver is
+        terminating; holding them would leak pods out of the accounting
+        identity shed + scheduled + unschedulable == arrivals).  Returns
+        the number shed."""
+        n = len(self._parked)
+        self._shed(self._parked, now)
+        self._parked = []
+        return n
+
+    def report(self) -> Dict[str, float]:
+        """Artifact block (bench/loadgen.py stamps it when enabled)."""
+        return {
+            "watermark": self.watermark,
+            "max_park_s": self.max_park_s,
+            "parked_total": self.parked_total,
+            "shed_total": self.shed_total,
+            "parked_now": len(self._parked),
+        }

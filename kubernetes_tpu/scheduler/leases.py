@@ -112,7 +112,8 @@ class HAReplica:
     def __init__(self, identity: str, leases: LeaseStore, make_scheduler,
                  name: str = "kube-scheduler",
                  lease_duration_s: float = LEASE_DURATION_S,
-                 metrics=None, tracer=None):
+                 metrics=None, tracer=None,
+                 killed_site: Optional[str] = None):
         self.identity = identity
         self.elector = LeaderElector(
             leases, identity, name=name, lease_duration_s=lease_duration_s
@@ -124,10 +125,11 @@ class HAReplica:
         self.dead = False  # a killed active stops ticking (kill -9 semantics)
         self._was_leader = False
         # the chaos kill.* site that felled the leader this standby replaces
-        # (run_ha_restartable stamps it from ProcessKilled.fault) — restore()
-        # records the recovery under that site so injected/recovered counts
-        # reconcile; None for organic takeovers (no injected fault)
-        self.killed_site: Optional[str] = None
+        # (the takeover drivers — scheduler.ha_takeover — stamp it from
+        # ProcessKilled.fault) — restore() records the recovery under that
+        # site so injected/recovered counts reconcile; None for organic
+        # takeovers (no injected fault)
+        self.killed_site: Optional[str] = killed_site
 
     def kill(self) -> None:
         """Simulate kill -9 on this replica: it stops renewing (the lease

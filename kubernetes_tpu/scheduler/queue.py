@@ -119,6 +119,12 @@ class PriorityQueue:
         # _observe_sli_phases).  Consumed at bind publication (take_popped)
         # or delete, so the table stays bounded like _arrival_at.
         self._popped_at: Dict[str, float] = {}
+        # uids whose pop stamp was restored from a checkpoint
+        # (restore_popped): the post-restore re-pop must NOT overwrite it —
+        # the pod already left the queue once, in the dead leader, and its
+        # queue_wait ended there; everything after (blackout included) is
+        # wave_wait.  Cleared with the stamp at take_popped/delete.
+        self._popped_pinned: Set[str] = set()
         self._seq = itertools.count()
         self._active: List[_Item] = []  # heap
         self._active_uids: Set[str] = set()
@@ -254,8 +260,11 @@ class PriorityQueue:
                 if tr is not None and tr.enabled:
                     # latest pop wins: after a retry the wait that counts
                     # toward queue_wait is everything up to the pop that
-                    # finally led to the bind
-                    self._popped_at[item.pod.uid] = _time.perf_counter()
+                    # finally led to the bind — EXCEPT a checkpoint-restored
+                    # stamp (pinned): the pod's queue_wait ended in the dead
+                    # leader, and the re-pop is wave replay, not queueing
+                    if item.pod.uid not in self._popped_pinned:
+                        self._popped_at[item.pod.uid] = _time.perf_counter()
                     t0 = self._enq_at.pop(item.pod.uid, None)
                     if t0 is not None:
                         # enqueue -> pop as a finished span on the pod's
@@ -374,6 +383,7 @@ class PriorityQueue:
         queue_wait/wave_wait boundary of the SLI phase decomposition.
         None when tracing was off or the pod never popped (same lifecycle
         as the queue.wait span it pairs with)."""
+        self._popped_pinned.discard(pod_uid)
         return self._popped_at.pop(pod_uid, None)
 
     @_locked
@@ -416,11 +426,39 @@ class PriorityQueue:
         return n
 
     @_locked
+    def export_popped(self) -> Dict[str, float]:
+        """Per-pod latest activeQ-pop AGE for the checkpoint — the
+        queue_wait/wave_wait SLI-phase boundary rides the crash-restart
+        state (same age-relative convention as export_arrivals).  Empty
+        when tracing is off (the table is tracer-gated)."""
+        now = _time.perf_counter()
+        return {uid: now - t for uid, t in self._popped_at.items()}
+
+    @_locked
+    def restore_popped(self, ages: Dict[str, float]) -> int:
+        """Re-base checkpointed pop stamps onto this process's clock and
+        PIN them: a pod popped into a wave pre-kill keeps its original
+        queue_wait — the takeover blackout and the replay re-pop both land
+        in wave_wait, where the dead time actually passed (the telescoping
+        invariant tests/test_storm_streaming.py asserts).  Gated like
+        restore_arrivals on the watch replay having re-admitted the pod.
+        Returns #restored."""
+        now = _time.perf_counter()
+        n = 0
+        for uid, age in ages.items():
+            if uid in self._arrival_at:
+                self._popped_at[uid] = now - max(0.0, float(age))
+                self._popped_pinned.add(uid)
+                n += 1
+        return n
+
+    @_locked
     def delete(self, pod_uid: str) -> None:
         self._active_uids.discard(pod_uid)
         self._enq_at.pop(pod_uid, None)
         self._arrival_at.pop(pod_uid, None)
         self._popped_at.pop(pod_uid, None)
+        self._popped_pinned.discard(pod_uid)
         self._unschedulable.pop(pod_uid, None)
         self._parked_at.pop(pod_uid, None)
         self._no_flush.discard(pod_uid)

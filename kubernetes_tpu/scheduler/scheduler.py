@@ -261,6 +261,17 @@ class Scheduler:
             config.pipeline_commit and os.environ.get("KTPU_PIPELINE") != "0"
         )
         self._deferred_binds: List[Tuple[t.Pod, str]] = []
+        # wave WAL (streaming crash-consistency): while a commit wave is
+        # between verdict and full publication, {"uids": [...],
+        # "verdict_crc": str} rides every checkpoint save so restore() can
+        # reconcile the killed wave to exactly-once publication
+        self._wave_wal: Optional[Dict] = None
+        # open-loop replay cursor (bench/loadgen.py stamps it per cycle):
+        # the arrival trace's virtual clock + event offset checkpoint
+        # alongside the WAL; restore() surfaces the dead leader's cursor
+        # as `restored_cursor` for the surviving replay driver to verify
+        self._replay_cursor: Optional[Dict] = None
+        self.restored_cursor: Optional[Dict] = None
         # deferral engages only under run_until_idle's cycle stream: a
         # directly-called schedule_batch() keeps its contract that binds
         # are store-visible on return
@@ -856,6 +867,9 @@ class Scheduler:
             [(p.uid, node) for p, node in self._deferred_binds],
             self.queue.export_arrivals(),
             lineage=self.store.lineage,
+            wave=self._wave_wal,
+            cursor=self._replay_cursor,
+            popped=self.queue.export_popped(),
         )
 
     def restore(self, killed_site: Optional[str] = None) -> Dict[str, int]:
@@ -888,7 +902,7 @@ class Scheduler:
         t0 = time.perf_counter()
         report = {
             "wal_applied": 0, "wal_skipped": 0, "reconciled_assumed": 0,
-            "restored_arrivals": 0,
+            "restored_arrivals": 0, "restored_popped": 0, "wave_requeued": 0,
         }
         doc = None
         if self._ckpt is not None:
@@ -917,6 +931,13 @@ class Scheduler:
             report["restored_arrivals"] = self.queue.restore_arrivals(
                 {u: a + dead_s for u, a in doc["arrivals"].items()}
             )
+            # pop stamps re-base with the same blackout shift and PIN: a
+            # pod popped into a wave pre-kill keeps its original queue_wait
+            # and the dead time lands in wave_wait, where it actually
+            # passed (the phase-telescoping invariant survives restore)
+            report["restored_popped"] = self.queue.restore_popped(
+                {u: a + dead_s for u, a in doc.get("popped", {}).items()}
+            )
             node_names = set(self.store.list_node_names())
             for uid, node in doc["wal"]:
                 cur = self.store.pods.get(uid)
@@ -936,6 +957,24 @@ class Scheduler:
                     # it to the WAL: the pod is already requeued (watch
                     # replay) with its original arrival stamp — count it
                     report["reconciled_assumed"] += 1
+            # wave WAL reconciliation: the commit wave in flight at the kill
+            # splits three ways — published prefix (store shows the bind:
+            # nothing to do), durable suffix (replayed by the deferred WAL
+            # loop above), and the unpublished remainder, which the watch
+            # replay already requeued; count it so tests can assert the
+            # split is exhaustive (no pod lost, none double-published)
+            wave = doc.get("wave")
+            if wave:
+                wal_uids = {u for u, _ in doc["wal"]}
+                for uid in wave.get("uids", ()):
+                    cur = self.store.pods.get(uid)
+                    if cur is not None and not cur.node_name and uid not in wal_uids:
+                        report["wave_requeued"] += 1
+            # the dead leader's open-loop replay cursor (None outside the
+            # load observatory): surfaced for the surviving replay driver —
+            # the trace offset the standby resumes from (loadgen.py
+            # verifies it against the generator's own position)
+            self.restored_cursor = doc.get("cursor")
         # crash-only rule: resident device caches rebuild from scratch
         if self._hoist_cache is not None:
             self._hoist_cache.invalidate()
@@ -1365,6 +1404,24 @@ class Scheduler:
         # is phantom capacity every later encode would subtract forever)
         assumed_now: List[str] = []
         done: set = set()  # pod names whose commit disposition fully landed
+        # wave WAL (streaming crash-consistency): before the first assume of
+        # this commit wave, record its membership + verdict crc in the
+        # checkpoint, so a kill anywhere inside the wave leaves restore()
+        # enough to reconcile exactly-once publication — the published
+        # prefix shows in the store, the durable suffix in the deferred
+        # WAL, and the rest of these uids are the requeued remainder.
+        # Built only when a checkpoint is armed (the crc is an O(P) pass);
+        # cleared + re-persisted once the wave fully lands.
+        if self._ckpt is not None:
+            from .flightrecorder import fingerprint
+
+            placed = {u: n for u, n in verdicts.items() if n is not None}
+            self._wave_wal = {
+                "uids": sorted(placed),
+                "verdict_crc": fingerprint(
+                    {u: placed[u] for u in sorted(placed)}
+                ),
+            }
         try:
             self._commit_profile_batch(
                 profile_name, snap, verdicts, result, failed, defer_ok,
@@ -1373,6 +1430,13 @@ class Scheduler:
         except Exception:
             self._release_crashed_commit(snap, done, assumed_now)
             raise
+        finally:
+            if self._wave_wal is not None:
+                self._wave_wal = None
+                # persist the cleared wave record; on a kill this is a no-op
+                # (_checkpoint_state early-returns on killed()) so the wave
+                # stays durable for the restore to reconcile
+                self._checkpoint_state()
         self._flight_record(profile_name, snap, result, len(failed), meta)
         return result, len(failed)
 
@@ -2014,6 +2078,11 @@ def reincarnate(dead: Scheduler) -> Scheduler:
     sched = Scheduler(
         dead.store,
         dead.config,
+        # the backoff clock is CLUSTER time, not process memory: a replay
+        # driving a FakeClock (bench/loadgen.py) must see the replacement's
+        # backoff maturity continue where the dead incarnation's left off,
+        # or the restarted run diverges from the un-killed oracle
+        clock=dead.queue.clock,
         collector=dead.collector,
         metrics=dead.metrics,
         checkpoint_dir=dead._ckpt.directory if dead._ckpt is not None else None,
@@ -2089,26 +2158,41 @@ def run_ha_restartable(
             restarts += 1
             if restarts > max_restarts:
                 raise
-            # the leader's renew loop (a background thread in client-go,
-            # ticking every retry period) was renewing right up to the kill
-            # — run_until_idle is synchronous here, so model its final
-            # renewal at the death instant.  The standby's blackout then
-            # measures death -> takeover (one lease expiry + build/restore),
-            # not lease staleness accumulated across the whole run segment.
-            leader.tick()
-            dead = sched
-            dead.detach()
-            chaos.revive()  # the latch belongs to the dead leader
-            standby = HAReplica(
-                f"sched-{restarts}", leases,
-                lambda d=dead: reincarnate(d),
+            sched, leader = ha_takeover(
+                sched, leases, leader, killed_site=e.fault.site,
                 lease_duration_s=lease_duration_s,
-                metrics=dead.metrics, tracer=dead.tracer,
+                name=f"sched-{restarts}",
             )
-            standby.killed_site = e.fault.site
-            # tick on the leaderelection retry period until the dead
-            # leader's lease decays and the takeover CAS lands
-            while not standby.tick():
-                time.sleep(lease_duration_s / 10.0)
-            sched = standby.scheduler
-            leader = standby.elector  # the next kill fells THIS leader
+
+
+def ha_takeover(dead: Scheduler, leases, leader, killed_site: Optional[str],
+                lease_duration_s: float = 0.25,
+                name: str = "sched-standby") -> Tuple[Scheduler, object]:
+    """One standby leader takeover over a just-killed leader — the except
+    body every kill-surviving driver shares (run_ha_restartable for snapshot
+    rounds, bench/loadgen.replay_trace for the open-loop stream).
+
+    The leader's renew loop (a background thread in client-go, ticking every
+    retry period) was renewing right up to the kill — the drivers are
+    synchronous here, so model its final renewal at the death instant.  The
+    standby's blackout then measures death -> takeover (one lease expiry +
+    build/restore), not lease staleness accumulated across the whole run
+    segment.  Returns (restored replacement, its elector) — the next kill
+    fells THAT leader."""
+    from .leases import HAReplica
+
+    leader.tick()
+    dead.detach()
+    chaos.revive()  # the latch belongs to the dead leader
+    standby = HAReplica(
+        name, leases,
+        lambda d=dead: reincarnate(d),
+        lease_duration_s=lease_duration_s,
+        metrics=dead.metrics, tracer=dead.tracer,
+        killed_site=killed_site,
+    )
+    # tick on the leaderelection retry period until the dead leader's
+    # lease decays and the takeover CAS lands
+    while not standby.tick():
+        time.sleep(lease_duration_s / 10.0)
+    return standby.scheduler, standby.elector

@@ -485,9 +485,12 @@ def test_dead_replica_never_reacquires(tmp_path):
 
 # --- chaos-site selection (bench.harness --chaos-sites) ---
 def test_sites_matching_globs():
-    assert chaos.sites_matching("kill.*") == chaos.KILL_SITES
+    # kill.* spans BOTH families now: the scheduler's four original kill
+    # points plus the streaming loop's (SITE_ACTIONS order)
+    assert chaos.sites_matching("kill.*") == chaos.ALL_KILL_SITES
+    assert chaos.ALL_KILL_SITES == chaos.KILL_SITES + chaos.STREAM_KILL_SITES
     rest = chaos.sites_matching("*,!kill.*")
-    assert not set(rest) & set(chaos.KILL_SITES)
+    assert not set(rest) & set(chaos.ALL_KILL_SITES)
     assert "sidecar.rpc" in rest
     mixed = chaos.sites_matching("scheduler.*,kill.mid_flush")
     assert "scheduler.step" in mixed and "kill.mid_flush" in mixed

@@ -126,6 +126,37 @@ def test_ktpu001_allowlisted_restart_driver_may_catch_kill():
     assert len(_run(KillSafetyRule, src)) == 1
 
 
+def test_ktpu001_streaming_restart_drivers_are_allowlisted():
+    # the storm-proof streaming drivers joined the restart-driver family:
+    # the stream wave-WAL replay loop and the open-loop HA takeover loop
+    # may catch ProcessKilled — in THEIR modules only
+    stream = (
+        "def run_stream_restartable(waves):\n"
+        "    try:\n"
+        "        drive()\n"
+        "    except ProcessKilled:\n"
+        "        return replay_suffix()\n"
+    )
+    assert _run(KillSafetyRule, stream,
+                relpath="kubernetes_tpu/parallel/pipeline.py") == []
+    assert len(_run(KillSafetyRule, stream)) == 1
+    replay = (
+        "def replay_trace(trace):\n"
+        "    try:\n"
+        "        cycle()\n"
+        "    except ProcessKilled:\n"
+        "        return takeover()\n"
+    )
+    assert _run(KillSafetyRule, replay,
+                relpath="kubernetes_tpu/bench/loadgen.py") == []
+    assert len(_run(KillSafetyRule, replay)) == 1
+    # ...and the allowlist entry covers exactly the named driver, nothing
+    # else in the same module
+    other = replay.replace("replay_trace", "some_helper")
+    assert len(_run(KillSafetyRule, other,
+                    relpath="kubernetes_tpu/bench/loadgen.py")) == 1
+
+
 def test_ktpu001_allowlist_does_not_cover_same_named_methods():
     # the exemption is the MODULE-LEVEL driver, not any method that happens
     # to share its name
