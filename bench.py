@@ -397,8 +397,13 @@ def main() -> None:
                 # the dense (pre-PR-5) kernel on the same box, same run —
                 # the incremental speedup's denominator
                 "step_dense_s": round(t_step_dense, 4),
-                "step_unchunked_s": (
-                    round(t_plain, 4) if t_plain is not None else None
+                # the unchunked leg only runs at small scale (the [P, N]
+                # dense program OOMs at north-star shape) — when it never
+                # ran, the key is OMITTED, not null: regression gates skip
+                # absent metrics but would choke comparing against null
+                **(
+                    {"step_unchunked_s": round(t_plain, 4)}
+                    if t_plain is not None else {}
                 ),
                 "end_to_end_s": round(end_to_end, 3),
                 "end_to_end_worst_s": round(e2es[-1], 3),

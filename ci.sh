@@ -61,7 +61,11 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo "=== [2/7] ktpu-verify (AST + device + shard + mem, incl. KTPU019/KTPU020) ==="
-JAX_PLATFORMS=cpu python -m kubernetes_tpu.analysis --device --shard --mem || {
+# packed data plane pinned ON explicitly (its default): the device/shard/mem
+# passes must price and reconcile the packed word planes + bf16 score path
+# (KTPU007 bf16-accumulation legality, KTPU012/KTPU020 packed size model)
+JAX_PLATFORMS=cpu KTPU_PACK_MASKS=1 KTPU_SCORE_DTYPE=bf16 \
+  python -m kubernetes_tpu.analysis --device --shard --mem || {
   rc=$?
   echo "ci: ktpu-verify failed (rc=$rc; 1 = unbaselined findings, 2 = unusable)" >&2
   exit "$rc"
@@ -165,6 +169,10 @@ run_gate --metric sli_p99_ms --current /tmp/KTPU_CI_OPENLOOP.json
 # stamps rounds_executed; a change that silently reinflates the round
 # count fails here even when wall time hides it on a fast box
 run_gate --metric rounds_executed
+# the packed-data-plane headline (BENCH_r08+): the analytic per-shard HBM
+# ceiling must never silently reinflate — a change that unpacks a resident
+# plane or widens a score matrix fails here even when wall time hides it
+run_gate --metric per_shard_hbm_bytes
 # storm-stage gates: recovered_waves must not silently drop (a storm that
 # stops restarting stopped testing failover) and the blackout-inclusive
 # failover p99 must not regress vs prior storm artifacts on this box
@@ -176,9 +184,11 @@ echo "=== [7/7] autotune smoke (sweep -> persist -> reload) ==="
 # constants); the second probe must RELOAD the persisted winner with no
 # knob env set — proving the ops/tuning.py env > winner > default chain
 rm -rf /tmp/ktpu-ci-tuning
+# one candidate per packed-plane setting (6-field syntax; the first also
+# proves the legacy-default fill for PACK_MASKS/SCORE_DTYPE stays bf16+packed)
 JAX_PLATFORMS=cpu KTPU_FORCE_CHUNKED=1 \
   python -m kubernetes_tpu.bench.autotune sweep --nodes 128 --pods 256 \
-  --candidates "32:48:12:256,16:32:6:128" --tuning-dir /tmp/ktpu-ci-tuning \
+  --candidates "32:48:12:256,16:32:6:128:0:f32" --tuning-dir /tmp/ktpu-ci-tuning \
   > /tmp/KTPU_CI_AUTOTUNE.json || {
   rc=$?
   echo "ci: autotune sweep failed (rc=$rc)" >&2

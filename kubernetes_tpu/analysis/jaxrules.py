@@ -63,6 +63,12 @@ _FORBIDDEN_DTYPES = ("float64", "complex64", "complex128")
 # float dtypes too narrow to carry the integer lattice exactly (int -> f32
 # is exact below 2^24, the documented invariant; int -> bf16/f16 is not)
 _NARROW_FLOATS = ("bfloat16", "float16")
+# additive-reduction primitives: their OUTPUT dtype is the accumulator.
+# bf16 STORAGE is legal (ops/bitplane.py — KTPU_SCORE_DTYPE), but every
+# sum/matmul/prefix-sum must accumulate in f32 — a narrow-float output on
+# one of these is silent precision loss, not storage compression.  max/min
+# reductions are exact in any width and stay unflagged.
+_ADDITIVE_REDUCE_PRIMS = ("reduce_sum", "dot_general", "cumsum")
 
 # collective primitives whose cross-shard ORDER is the deadlock surface
 COLLECTIVE_PRIMS = (
@@ -103,7 +109,15 @@ class DtypeFlowRule(DeviceRule):
     """KTPU007 — walk every eqn output dtype through the jaxpr (sub-jaxprs
     included): no f64/complex anywhere, no integer->{bf16,f16} narrowing,
     no f32->f64 widening, and the kernel outputs the route declares integer
-    (assignment, node_used, commit ordinals) stay integer dtypes."""
+    (assignment, node_used, commit ordinals) stay integer dtypes.
+
+    bf16 LEGALIZATION (the packed data plane): bf16 values flowing through
+    elementwise/select/gather ops are LEGAL — that is the storage half of
+    the bf16 score path (ops/bitplane.py).  What stays a finding is (a) an
+    integer-lattice value narrowed into bf16/f16, and (b) an ADDITIVE
+    reduction (sum / dot_general / cumsum) whose accumulator dtype is
+    bf16/f16 — the f32-accumulation rule (PARITY.md — packed-plane
+    invariants) enforced mechanically."""
 
     rule_id = "KTPU007"
     title = "dtype-flow: no f64 promotion; integer tie-break lattice exact"
@@ -125,6 +139,19 @@ class DtypeFlowRule(DeviceRule):
                             f"{name} value produced by `{eqn.primitive.name}`"
                             " — f64/complex promotion breaks cross-backend "
                             "bit-identity",
+                            key,
+                        ))
+                if eqn.primitive.name in _ADDITIVE_REDUCE_PRIMS \
+                        and name in _NARROW_FLOATS:
+                    key = f"{eqn.primitive.name}-acc->{name}"
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(_finding(
+                            t, self.rule_id,
+                            f"additive reduction `{eqn.primitive.name}` "
+                            f"accumulates in {name} — bf16 is a STORAGE "
+                            "dtype; sums/matmuls must accumulate in f32 "
+                            "(upcast before reducing)",
                             key,
                         ))
                 if eqn.primitive.name == "convert_element_type":

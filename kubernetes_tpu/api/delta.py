@@ -819,6 +819,9 @@ class DeltaEncoder:
         # them in place — warm deltas re-place only changed fields' shards
         self._mesh = None
         self._pad_memo: Dict[str, Tuple] = {}
+        # memoized per-(width, sharding) device unpackers for the packed
+        # bool-plane transfer path (_packed_put)
+        self._unpack_jits: Dict[Tuple, object] = {}
         if mesh is not None:
             self.set_mesh(mesh)
         # Cache validity is conditioned on OBJECT IDENTITY (_nodes_fp, record
@@ -894,6 +897,7 @@ class DeltaEncoder:
             self._mesh = mesh
             self._dev.clear()
             self._pad_memo.clear()
+            self._unpack_jits.clear()
 
     def _pad_for_mesh(self, name: str, a, pad: int, d_sentinel: int, n: int):
         """Per-field node-axis padding (the one shared rule set —
@@ -911,10 +915,38 @@ class DeltaEncoder:
         self._pad_memo[name] = (a, p)
         return p
 
+    def _packed_put(self, a: np.ndarray, sharding):
+        """Transfer a wide boolean matrix as PACKED uint32 words and unpack
+        on device (ops/bitplane.py): host->device bytes drop 8x while the
+        resident buffer stays the dense bool plane the kernels read.  Safe
+        for every bool field because none shards its LAST axis (the rule
+        table shards leading axes or replicates), so the word transfer can
+        ride the target's own sharding and the jitted unpack is shard-local
+        (out_shardings pins the dense result in place — no resharding).
+        The per-(n, sharding) jitted unpackers are memoized so the warm
+        path never re-traces."""
+        import jax
+
+        from ..ops import bitplane
+
+        n_last = a.shape[-1]
+        words = bitplane.np_pack_lastaxis(a)
+        wd = jax.device_put(words, sharding) if sharding is not None \
+            else jax.device_put(words)
+        key = (n_last, sharding)
+        fn = self._unpack_jits.get(key)
+        if fn is None:
+            kw = {"out_shardings": sharding} if sharding is not None else {}
+            fn = jax.jit(lambda w: bitplane.unpack(w, n_last), **kw)
+            self._unpack_jits[key] = fn
+        return fn(wd)
+
     def _to_device(self, arr, meta, fresh: bool = False):
         import dataclasses as _dc
 
         import jax
+
+        from ..ops import bitplane
 
         mesh = self._mesh
         if mesh is not None:
@@ -929,10 +961,19 @@ class DeltaEncoder:
         out = {}
         for f in _dc.fields(type(arr)):
             a = getattr(arr, f.name)
-            if mesh is not None:
-                if pad:
-                    a = self._pad_for_mesh(f.name, a, pad, d_sentinel, n)
-                put = lambda x, _s=sh[f.name]: jax.device_put(x, _s)  # noqa: E731
+            s = sh[f.name] if mesh is not None else None
+            if mesh is not None and pad:
+                a = self._pad_for_mesh(f.name, a, pad, d_sentinel, n)
+            if (
+                bitplane.PACK_MASKS
+                and isinstance(a, np.ndarray)
+                and a.dtype == np.bool_
+                and a.ndim >= 2
+                and a.shape[-1] >= 64
+            ):
+                put = lambda x, _s=s: self._packed_put(x, _s)  # noqa: E731
+            elif s is not None:
+                put = lambda x, _s=s: jax.device_put(x, _s)  # noqa: E731
             else:
                 put = jax.device_put
             if fresh:

@@ -246,13 +246,18 @@ _IMG_MAX_MB = 1000.0  # maxThreshold
 
 def image_score_value(sum_mb: float) -> np.float32:
     """ImageLocality score from summed present-image megabytes (f32,
-    mirrored by the oracle): 100 * (clip(sum) - min) / (max - min)."""
+    mirrored by the oracle): 100 * (clip(sum) - min) / (max - min).
+    Rounded onto the bf16 score lattice (ops/bitplane.py) — the oracle
+    calls this too, so both sides quantize identically under
+    KTPU_SCORE_DTYPE."""
+    from ..ops.bitplane import bf16_round_np
+
     s = np.float32(min(max(float(sum_mb), _IMG_MIN_MB), _IMG_MAX_MB))
-    return np.float32(
+    return np.float32(bf16_round_np(
         (s - np.float32(_IMG_MIN_MB))
         * np.float32(100.0)
         / np.float32(_IMG_MAX_MB - _IMG_MIN_MB)
-    )
+    ))
 
 
 def _image_score_matrix(nodes, reps, inv, N: int, P: int) -> np.ndarray:
@@ -264,12 +269,14 @@ def _image_score_matrix(nodes, reps, inv, N: int, P: int) -> np.ndarray:
     documented in PARITY.md).  `reps`/`inv` are the spec-interned unique
     pending-pod specs and each sorted pod's spec index: the matmul runs over
     unique specs and rows are gathered per pod."""
+    from ..ops.bitplane import np_score_dtype
+
     img_ids: Dict[str, int] = {}
     for pod in reps:
         for im in pod.images:
             img_ids.setdefault(im, len(img_ids))
     if not img_ids or not any(nd.images for nd in nodes):
-        return np.zeros((P, 1), dtype=np.float32)
+        return np.zeros((P, 1), dtype=np_score_dtype())
     I = len(img_ids)
     node_mb = np.zeros((N, I), dtype=np.float32)
     for i, nd in enumerate(nodes):
@@ -288,7 +295,13 @@ def _image_score_matrix(nodes, reps, inv, N: int, P: int) -> np.ndarray:
         * np.float32(100.0)
         / np.float32(_IMG_MAX_MB - _IMG_MIN_MB)
     ).astype(np.float32)
-    out = np.zeros((P, N), dtype=np.float32)  # zero == the empty-image score
+    from ..ops.bitplane import quantize_scores_np
+
+    # stored on the bf16 score lattice (halved transfer + resident bytes;
+    # the same round-to-nearest-even lattice image_score_value applies, so
+    # the oracle mirror and this matrix agree bit-for-bit)
+    scored = quantize_scores_np(scored)
+    out = np.zeros((P, N), dtype=scored.dtype)  # zero == the empty-image score
     if len(inv):
         out[: len(inv)] = scored[inv]
     return out

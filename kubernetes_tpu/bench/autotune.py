@@ -46,26 +46,48 @@ from typing import Any, Dict, List, Optional
 
 from ..ops.tuning import TUNABLE_KNOBS
 
-# candidate field order in the colon syntax (parallel to TUNABLE_KNOBS)
+# candidate field order in the colon syntax (parallel to TUNABLE_KNOBS).
+# The packed-data-plane pair (ops/bitplane.py) rides the same sweep: both
+# are trace-time constants, both change only perf (decisions stay
+# bit-identical to the oracle on every setting — tests/test_packed_masks.py),
+# so a measured winner is safe to persist exactly like the shape knobs.
 _FIELDS = ("KTPU_INC_CHUNK", "KTPU_WAVE_BLOCK", "KTPU_WAVE_ITERS",
-           "KTPU_WAVE_K")
+           "KTPU_WAVE_K", "KTPU_PACK_MASKS", "KTPU_SCORE_DTYPE")
+# defaults appended when a candidate uses the legacy 4-field syntax
+_FIELD_DEFAULTS = ("1", "bf16")
 
-DEFAULT_CANDIDATES = "32:48:12:256,32:64:14:256,32:32:6:256,64:48:12:512"
+DEFAULT_CANDIDATES = (
+    "32:48:12:256:1:bf16,32:64:14:256:1:bf16,32:32:6:256:1:bf16,"
+    "64:48:12:512:1:bf16,32:48:12:256:0:f32"
+)
 
 
-def parse_candidates(spec: str) -> List[Dict[str, int]]:
+def _field_value(name: str, raw: str):
+    from ..ops.tuning import _coerce
+
+    return _coerce(name, raw)
+
+
+def parse_candidates(spec: str) -> List[Dict[str, Any]]:
     out = []
     for tok in spec.split(","):
         tok = tok.strip()
         if not tok:
             continue
-        parts = [int(x) for x in tok.split(":")]
+        parts = tok.split(":")
+        if len(parts) == len(_FIELDS) - len(_FIELD_DEFAULTS):
+            # legacy 4-field candidates keep working (scripts predating the
+            # packed-plane knobs): packing/bf16 ride at their defaults
+            parts = parts + list(_FIELD_DEFAULTS)
         if len(parts) != len(_FIELDS):
             raise SystemExit(
                 f"autotune: candidate {tok!r} needs "
-                f"{len(_FIELDS)} fields {':'.join(_FIELDS)}"
+                f"{len(_FIELDS)} fields {':'.join(_FIELDS)} "
+                f"(or the legacy first {len(_FIELDS) - len(_FIELD_DEFAULTS)})"
             )
-        out.append(dict(zip(_FIELDS, parts)))
+        out.append({
+            f: _field_value(f, p) for f, p in zip(_FIELDS, parts)
+        })
     return out
 
 
@@ -86,12 +108,18 @@ def run_probe(args) -> None:
     from .harness import run_snapshot_workload
     from .workloads import heterogeneous
 
+    from ..ops import bitplane
+
     snap = heterogeneous(args.nodes, args.pods, seed=args.seed)
     resolved = {
         "KTPU_INC_CHUNK": assign._INC_CHUNK,
         "KTPU_WAVE_BLOCK": assign._WAVE_BLOCK,
         "KTPU_WAVE_ITERS": assign._WAVE_ITERS,
         "KTPU_WAVE_K": assign._WAVE_K,
+        # the packed-data-plane pair, as resolved at ops.bitplane import
+        # (env > persisted winner > default — the CI smoke asserts these)
+        "KTPU_PACK_MASKS": int(bitplane.PACK_MASKS),
+        "KTPU_SCORE_DTYPE": bitplane.SCORE_DTYPE,
     }
 
     # measured half: the real runtime loop (includes compile on the first
@@ -206,7 +234,9 @@ def run_sweep(args) -> int:
               file=sys.stderr)
         print(json.dumps({"winner": None, "candidates": rows}))
         return 1
-    knobs = {k: int(v) for k, v in winner["knobs"].items()
+    from ..ops.tuning import _coerce
+
+    knobs = {k: _coerce(k, v) for k, v in winner["knobs"].items()
              if k in TUNABLE_KNOBS}
     score = {
         "pods_per_sec": winner["pods_per_sec"],

@@ -47,11 +47,14 @@ def static_feasible(arr: ClusterArrays):
 
 
 def taint_prefer_counts(arr: ClusterArrays) -> np.ndarray:
-    return np.einsum(
+    from ..ops.bitplane import bf16_round_np
+
+    # bf16-lattice mirror of the device producer (ops/scores.py)
+    return bf16_round_np(np.einsum(
         "pt,nt->pn",
         (~arr.pod_tol_pref).astype(np.float32),
         arr.node_taint_pref.astype(np.float32),
-    )
+    ))
 
 
 def preferred_na_raw(arr: ClusterArrays, tm: np.ndarray) -> np.ndarray:
@@ -59,6 +62,9 @@ def preferred_na_raw(arr: ClusterArrays, tm: np.ndarray) -> np.ndarray:
     S = tm.shape[0]
     ids = np.maximum(arr.pod_pref_terms, 0)
     w = np.where(arr.pod_pref_terms >= 0, arr.pod_pref_weights, 0.0).astype(np.float32)
+    from ..ops.bitplane import bf16_round_np
+
     W = np.zeros((P, S), dtype=np.float32)
     np.add.at(W, (np.arange(P)[:, None], ids), w)
-    return W @ tm.astype(np.float32)
+    # bf16-lattice mirror of the device producer (ops/assign.py)
+    return bf16_round_np(W @ tm.astype(np.float32))

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..api.snapshot import ClusterArrays
-from . import filters, pairwise, tuning
+from . import bitplane, filters, pairwise, tuning
 from .scopes import subphase as _subphase
 from .scores import (
     MAX_NODE_SCORE,
@@ -112,15 +112,18 @@ def _global_top_k(vals, k, axis_name, base):
 
 
 def _preferred_node_affinity_raw(arr: ClusterArrays, term_matches: jax.Array) -> jax.Array:
-    """f32[P, N]: summed weights of matching preferred node-affinity terms
-    (nodeaffinity/node_affinity.go — Score).  One [P, S] @ [S, N] matmul."""
+    """[P, N] summed weights of matching preferred node-affinity terms
+    (nodeaffinity/node_affinity.go — Score).  One [P, S] @ [S, N] matmul in
+    f32, STORED on the bf16 lattice (ops/bitplane.py — the oracle and
+    native mirrors round identically); consumers upcast to f32 before
+    reducing."""
     P, _ = arr.pod_pref_terms.shape
     S = term_matches.shape[0]
     ids = jnp.maximum(arr.pod_pref_terms, 0)
     w = jnp.where(arr.pod_pref_terms >= 0, arr.pod_pref_weights, 0.0)
     W = jnp.zeros((P, S), dtype=jnp.float32)
     W = W.at[jnp.arange(P)[:, None], ids].add(w)
-    return W @ term_matches.astype(jnp.float32)
+    return bitplane.quantize_scores(W @ term_matches.astype(jnp.float32))
 
 
 def _image_on(arr: ClusterArrays, cfg: ScoreConfig, image_sharded) -> bool:
@@ -204,6 +207,9 @@ def schedule_scan(
 
     def norm_reverse(counts, feasible):
         with _subphase("normalize"):
+            # bf16-stored raw planes upcast before the reduction (f32
+            # accumulation rule); f32 inputs pass through untouched
+            counts = counts.astype(jnp.float32)
             mx = _rmax(jnp.where(feasible, counts, 0.0), axis_name)
             return jnp.where(
                 mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * counts / mx,
@@ -243,8 +249,8 @@ def schedule_scan(
             if cfg.enable_node_pref:
                 with _subphase("normalize"):
                     # NodeAffinity preferred: DefaultNormalizeScore (not
-                    # reversed)
-                    na_row = xs["na"]
+                    # reversed); bf16-stored raw upcast first
+                    na_row = xs["na"].astype(jnp.float32)
                     na_max = _rmax(jnp.where(feasible, na_row, 0.0), axis_name)
                     total = total + cfg.node_affinity_weight * jnp.where(
                         na_max > 0, na_row * MAX_NODE_SCORE / na_max, 0.0
@@ -270,7 +276,7 @@ def schedule_scan(
                     )
                 total = total + cfg.interpod_weight * ip_sc
             if "img" in xs:  # ImageLocality: static, no per-pod normalization
-                total = total + cfg.image_weight * xs["img"]
+                total = total + cfg.image_weight * xs["img"].astype(jnp.float32)
             total = jnp.where(feasible, total, -jnp.inf)
             best = _rmax(total, axis_name)
             schedulable = (best > -jnp.inf) & valid
@@ -484,7 +490,7 @@ def _chunk_routed(arr: ClusterArrays, cfg: ScoreConfig) -> bool:
 
 def _wave_commit_stage(
     cls, pvalid, preq, used_init, t0u_init, stat_full, n_alloc_full,
-    req_u, score_flat,
+    req_u, score_flat, nl=None,
 ):
     """CLASS-BATCHED COMMIT WAVES — the stage that collapses the O(C^2 K)
     prefix-commit round loop (ISSUE 17 / ROADMAP-1).  Commits pods in
@@ -541,12 +547,37 @@ def _wave_commit_stage(
     recompute), so it stays bit-identical to a fresh class hoist against
     the running usage throughout — the cross-chunk dirty-list carry.
 
+    PACKED PLANES (ops/bitplane.py — KTPU_PACK_MASKS): `stat_full` arrives
+    as uint32 bit-plane words packed in per-shard-local blocks of `nl` bits
+    (the tiled-all_gather layout; nl = N unsharded), tested per candidate
+    column with bitplane.test_cols; the epoch `claimed` register is a
+    single-block packed [ceil(N/32)] word vector (wave-internal — never
+    gathered), OR-scattered at the O(E) commit frontier.  Same bits, 8x
+    fewer resident/carried bytes.
+
     Returns (committed bool[P], out i32[P], ordinal i32[P] — the block
     index, a device-sweep ordinal like the round loop's round index,
     used i32[N, R], t0u f32[U1, N], n_blocks i32)."""
     P = cls.shape[0]
     U1, N = t0u_init.shape
     R = preq.shape[1]
+    if nl is None:
+        nl = N
+    PM = bitplane.PACK_MASKS
+
+    def st_cols(ids):
+        """stat_full at candidate columns (GLOBAL ids) — [U1, *ids.shape]."""
+        return bitplane.test_cols(stat_full, ids, nl) if PM else (
+            stat_full[:, ids]
+        )
+
+    def cl_test(cl, ids):
+        return bitplane.test_cols(cl, ids, N) if PM else cl[ids]
+
+    def cl_set(cl, ids, on):
+        if PM:
+            return bitplane.set_cols(cl, ids, on, N)
+        return cl.at[jnp.where(on, ids, N)].set(True, mode="drop")
     E = min(_WAVE_BLOCK, P)
     KW = min(_WAVE_K, N)
     # >= 1 pod commits per block, so P blocks always suffice; the budget
@@ -571,7 +602,7 @@ def _wave_commit_stage(
         tv, ti, nf = lax.cond(
             need_ep, refresh, lambda _: (tv, ti, nf), t0u
         )
-        claimed = jnp.where(need_ep, False, claimed)
+        claimed = jnp.where(need_ep, jnp.zeros_like(claimed), claimed)
         bmax = jnp.where(need_ep, neg_inf, bmax)
         bnode = jnp.where(need_ep, _INT_MAX, bnode)
         # ---- (B) the block: E pods at the frontier (clamped at the tail;
@@ -586,7 +617,7 @@ def _wave_commit_stage(
         # ---- (C) pointer walk: first feasible unclaimed list entry ----
         tvb = tv[bcls]  # [E, KW]
         tib = ti[bcls]
-        avail = (tvb > neg_inf) & ~claimed[tib] & live[:, None]
+        avail = (tvb > neg_inf) & ~cl_test(claimed, tib) & live[:, None]
         same = (bcls[:, None] == bcls[None, :]) & live[None, :]
         rank = (same & ltE).sum(axis=1).astype(jnp.int32)
         csum = jnp.cumsum(avail.astype(jnp.int32), axis=1)
@@ -644,7 +675,7 @@ def _wave_commit_stage(
             jnp.broadcast_to(na[None], reqd2.shape).reshape(-1, R),
         ).reshape(U1, E)
         s2 = jnp.where(
-            stat_full[:, an] & fit2 & picked[None, :], v2, neg_inf
+            st_cols(an) & fit2 & picked[None, :], v2, neg_inf
         )
         s2n = jnp.where(picked, a_node, _INT_MAX)
         # ---- (E) exclusive lexicographic scan: best touched node each pod
@@ -691,6 +722,8 @@ def _wave_commit_stage(
 
         def fb_rescore(args):
             used2, freq, fstat = args
+            if PM:  # packed class row -> dense [N] at this narrow frontier
+                fstat = bitplane.unpack_blocks(fstat, nl)
             ffit = filters.fit_ok(freq, used2, n_alloc_full)  # [N]
             fvals = jnp.where(
                 fstat & ffit,
@@ -723,7 +756,7 @@ def _wave_commit_stage(
         out = out.at[fscat].set(t_fb, mode="drop")
         committed = committed.at[fscat].set(True, mode="drop")
         ordn = ordn.at[fscat].set(blocks, mode="drop")
-        claimed = claimed.at[ucol].set(True, mode="drop")
+        claimed = cl_set(claimed, ucol, place_b)
         # a fallback STACKS when its exact argmax is a node this epoch
         # already touched (the prefix claims are already folded in above)
         # — the one case that breaks the touched-once-per-epoch invariant
@@ -731,8 +764,8 @@ def _wave_commit_stage(
         # one more touched node: claim it, fold its post-placement column,
         # and the epoch continues
         fnc = jnp.minimum(fcol, N - 1)
-        stacked = fb_ok & claimed[fnc]
-        claimed = claimed.at[fcol].set(True, mode="drop")
+        stacked = fb_ok & cl_test(claimed, fnc)
+        claimed = cl_set(claimed, fcol, fb_ok)
         # fold the committed prefix's columns into the epoch register
         cv = jnp.where(inpre[None], s2, neg_inf)
         cn = jnp.where(inpre, s2n, _INT_MAX)
@@ -754,7 +787,7 @@ def _wave_commit_stage(
         fv_u = score_flat(
             fnu[None] + req_u, jnp.broadcast_to(fna[None], req_u.shape)
         )
-        fcv = jnp.where(stat_full[:, fnc] & ffit_u, fv_u, neg_inf)
+        fcv = jnp.where(st_cols(fnc) & ffit_u, fv_u, neg_inf)
         t0u = t0u.at[:, fcol].set(fcv, mode="drop")
         # fold the fallback's post-placement column too (dead on refresh)
         fv2 = jnp.where(fb_ok, fcv, neg_inf)
@@ -777,7 +810,8 @@ def _wave_commit_stage(
         jnp.zeros(P, dtype=jnp.int32),
         used_init,
         t0u_init,
-        jnp.zeros(N, dtype=jnp.bool_),
+        jnp.zeros(bitplane.words_for(N), dtype=jnp.uint32)
+        if PM else jnp.zeros(N, dtype=jnp.bool_),
         jnp.full(U1, neg_inf, dtype=t0u_init.dtype),
         jnp.full(U1, _INT_MAX, dtype=jnp.int32),
         jnp.zeros((U1, KW), dtype=t0u_init.dtype),
@@ -913,7 +947,15 @@ def schedule_scan_chunked(
         U1 = inc.req_u.shape[0]
         req_u = inc.req_u
         with _subphase("hoist"):
-            t0u_init = jnp.where(inc.stat_u & inc.fit_u, inc.base_u, neg_inf)
+            # packed planes: stat & fit is the same bitwise AND on uint32
+            # words as on dense bools; the [U1, Nl] dense view exists only
+            # at this t0u frontier (scores are dense f32 regardless)
+            sfw = inc.stat_u & inc.fit_u
+            t0u_init = jnp.where(
+                bitplane.unpack(sfw, local_n)
+                if bitplane.PACK_MASKS else sfw,
+                inc.base_u, neg_inf,
+            )
             if axis_name:
                 # stitch the shard-local class hoists once per cycle; the
                 # chunk scan then carries the full [U1, N] matrix replicated
@@ -933,18 +975,44 @@ def schedule_scan_chunked(
     else:
         with _subphase("hoist"):
             tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
-            nodesel = filters.node_selection_ok_from(tm, arr)
-            pin = arr.pod_nodename[:, None]
-            nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-            sf = (
-                arr.node_valid[None, :]
-                & arr.pod_valid[:, None]
-                & filters.taints_ok(arr)
-                & nodesel
-                & nodename_ok
-            )
+            if bitplane.PACK_MASKS:
+                # chunk-wise packed hoist: each C-row block computes its
+                # dense [C, Nl] mask and packs it immediately (lax.map =
+                # sequential blocks), so the widest mask transient is
+                # [C, Nl] — the resident plane is [P, Wl] uint32 words, the
+                # 8x pn_masks cut shard_hbm_estimate prices
+                pod_blocks = (
+                    arr.pod_terms.reshape(P // C, C, -1),
+                    arr.pod_has_sel.reshape(P // C, C),
+                    arr.pod_tol_ns.reshape(P // C, C, -1),
+                    arr.pod_nodename.reshape(P // C, C),
+                    arr.pod_valid.reshape(P // C, C),
+                )
+
+                def _sf_block(px):
+                    pt, ph, ptol, pnn, pv = px
+                    sfb, _ = filters.static_feasible_rows(
+                        tm, arr.node_valid, arr.node_taint_ns, my_nodes,
+                        pt, ph, ptol, pnn, pv,
+                    )
+                    return bitplane.pack(sfb)
+
+                sfs = lax.map(_sf_block, pod_blocks)  # [P//C, C, Wl]
+            else:
+                nodesel = filters.node_selection_ok_from(tm, arr)
+                pin = arr.pod_nodename[:, None]
+                nodename_ok = jnp.where(
+                    pin == -1, True, pin == my_nodes[None, :]
+                )
+                sf = (
+                    arr.node_valid[None, :]
+                    & arr.pod_valid[:, None]
+                    & filters.taints_ok(arr)
+                    & nodesel
+                    & nodename_ok
+                )
+                sfs = sf.reshape(P // C, C, local_n)
         n_alloc = arr.node_alloc  # LOCAL node slice — hoist-side only
-        sfs = sf.reshape(P // C, C, local_n)
 
     def score_flat(requested, alloc):
         """Same formulas as the dense hoist, on flattened [*, R] rows —
@@ -977,6 +1045,7 @@ def schedule_scan_chunked(
                 _wave_commit_stage(
                     inc.cls, arr.pod_valid, arr.pod_req, used_init,
                     t0u_init, stat_full, n_alloc_full, req_u, score_flat,
+                    nl=local_n,
                 )
             )
         wcom_c = wcom.reshape(P // C, C)
@@ -1018,6 +1087,9 @@ def schedule_scan_chunked(
         else:
             used0 = carry  # FULL [N, R] usage (replicated under sharding)
             creq, csf, cvalid = xs
+            if bitplane.PACK_MASKS:
+                # the per-chunk unpack frontier: [C, Wl] words -> [C, Nl]
+                csf = bitplane.unpack(csf, local_n)
             if axis_name:
                 used0_l = lax.dynamic_slice_in_dim(
                     used0, base, local_n, axis=0
@@ -1267,7 +1339,10 @@ def schedule_scan_chunked(
                 reqd_u.reshape(-1, R),
                 jnp.broadcast_to(col_alloc[None], reqd_u.shape).reshape(-1, R),
             ).reshape(U1, C)
-            col_stat = stat_full[:, cn_out]  # [U1, C]
+            col_stat = (
+                bitplane.test_cols(stat_full, cn_out, local_n)
+                if bitplane.PACK_MASKS else stat_full[:, cn_out]
+            )  # [U1, C]
             newv = jnp.where(col_stat & col_fit, col_base, neg_inf)
             t0u = t0u.at[:, ucols].set(newv, mode="drop")
         return (used_out, t0u), (out, nrounds, ord_)
@@ -1507,16 +1582,21 @@ def schedule_scan_rounds(
         img_on = _image_on(arr, cfg, image_sharded)
         with _subphase("hoist"):
             tm = filters.term_match(arr.sel_mask, arr.sel_kind, arr.node_labels)
-            nodesel = filters.node_selection_ok_from(tm, arr)
-            pin = arr.pod_nodename[:, None]
-            nodename_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
-            sf = (
-                arr.node_valid[None, :]
-                & arr.pod_valid[:, None]
-                & filters.taints_ok(arr)
-                & nodesel
-                & nodename_ok
-            )
+            if not bitplane.PACK_MASKS:
+                # dense escape hatch; the packed hoist runs chunk-wise at
+                # the xs assembly below so no [P, Nl] transient traces
+                nodesel = filters.node_selection_ok_from(tm, arr)
+                pin = arr.pod_nodename[:, None]
+                nodename_ok = jnp.where(
+                    pin == -1, True, pin == my_nodes[None, :]
+                )
+                sf = (
+                    arr.node_valid[None, :]
+                    & arr.pod_valid[:, None]
+                    & filters.taints_ok(arr)
+                    & nodesel
+                    & nodename_ok
+                )
     n_alloc = arr.node_alloc
 
     def score_flat(requested, alloc):
@@ -1534,7 +1614,38 @@ def schedule_scan_rounds(
     if use_inc:
         xs["cls"] = seg(inc.cls)
     else:
-        xs["sf"] = seg(sf)
+        if bitplane.PACK_MASKS:
+            # chunk-wise packed static hoist (same discipline as the
+            # chunked kernel): [C, Nl] dense blocks pack immediately, the
+            # scan inputs ride as [P//C, C, Wl] uint32 word planes
+            with _subphase("hoist"):
+                pod_blocks = (
+                    seg(arr.pod_terms), seg(arr.pod_has_sel),
+                    seg(arr.pod_tol_ns), seg(arr.pod_nodename),
+                    seg(arr.pod_valid),
+                )
+
+                def _sf_block(px):
+                    pt, ph, ptol, pnn, pv = px
+                    sfb, nsb = filters.static_feasible_rows(
+                        tm, arr.node_valid, arr.node_taint_ns, my_nodes,
+                        pt, ph, ptol, pnn, pv,
+                    )
+                    out = (bitplane.pack(sfb),)
+                    if pw:
+                        out += (
+                            bitplane.pack(nsb & arr.node_valid[None, :]),
+                        )
+                    return out
+
+                packed = lax.map(_sf_block, pod_blocks)
+                xs["sf"] = packed[0]
+                if pw:
+                    xs["elig"] = packed[1]
+        else:
+            xs["sf"] = seg(sf)
+            if pw:
+                xs["elig"] = seg(nodesel & arr.node_valid[None, :])
         if cfg.enable_taint_score:
             with _subphase("hoist"):
                 xs["traw"] = seg(taint_prefer_counts(arr))
@@ -1543,8 +1654,6 @@ def schedule_scan_rounds(
                 xs["naraw"] = seg(_preferred_node_affinity_raw(arr, tm))
         if img_on:
             xs["img"] = seg(arr.image_score)
-        if pw:
-            xs["elig"] = seg(nodesel & arr.node_valid[None, :])
     if pw:
         xs.update(
             spread_t=seg(arr.pod_spread_terms),
@@ -1584,17 +1693,41 @@ def schedule_scan_rounds(
             # pod_valid folds back in per pod (stat_u excludes it so the
             # resident state survives the gang fixpoint's revocations)
             ccls = cx["cls"]
-            csf = inc.stat_u[ccls] & cvalid[:, None]
+            # packed class planes unpack at this per-chunk frontier
+            # ([C, Nl] dense transients, C = _RCHUNK); bf16-stored raws
+            # upcast to f32 before any normalization reduction
+            stat_rows = inc.stat_u[ccls]
+            if bitplane.PACK_MASKS:
+                stat_rows = bitplane.unpack(stat_rows, local_n)
+            csf = stat_rows & cvalid[:, None]
             celig = inc.elig_u[ccls] if pw else None
-            ctraw = inc.traw_u[ccls] if cfg.enable_taint_score else None
-            cnaraw = inc.naraw_u[ccls] if cfg.enable_node_pref else None
-            cimg = inc.img_u[ccls] if img_on else None
+            if pw and bitplane.PACK_MASKS:
+                celig = bitplane.unpack(celig, local_n)
+            ctraw = (
+                inc.traw_u[ccls].astype(jnp.float32)
+                if cfg.enable_taint_score else None
+            )
+            cnaraw = (
+                inc.naraw_u[ccls].astype(jnp.float32)
+                if cfg.enable_node_pref else None
+            )
+            cimg = inc.img_u[ccls].astype(jnp.float32) if img_on else None
         else:
             csf = cx["sf"]
             celig = cx["elig"] if pw else None
-            ctraw = cx["traw"] if cfg.enable_taint_score else None
-            cnaraw = cx["naraw"] if cfg.enable_node_pref else None
-            cimg = cx["img"] if img_on else None
+            if bitplane.PACK_MASKS:
+                csf = bitplane.unpack(csf, local_n)
+                if pw:
+                    celig = bitplane.unpack(celig, local_n)
+            ctraw = (
+                cx["traw"].astype(jnp.float32)
+                if cfg.enable_taint_score else None
+            )
+            cnaraw = (
+                cx["naraw"].astype(jnp.float32)
+                if cfg.enable_node_pref else None
+            )
+            cimg = cx["img"].astype(jnp.float32) if img_on else None
 
         # --- per-chunk static: interference incidence [C, C] ---
         with _subphase("hoist"):
@@ -2042,7 +2175,14 @@ def schedule_scan_rounds(
         arr.node_ports0,
     )
     if use_inc:
-        carry0 = carry0 + (inc.base_u, inc.fit_u)
+        # the carried fit plane is patched per round with mixed set/clear
+        # column writes, so it rides DENSE ([U1, Nl] bool — U-scale, tiny);
+        # the resident IncState form stays packed
+        fit_u0 = (
+            bitplane.unpack(inc.fit_u, local_n)
+            if bitplane.PACK_MASKS else inc.fit_u
+        )
+        carry0 = carry0 + (inc.base_u, fit_u0)
     (used_final, *_), (choices, rounds, ords) = lax.scan(chunk, carry0, xs)
     if with_ordinals:
         base = jnp.concatenate(
@@ -2073,7 +2213,10 @@ def inc_applicable(arr, cfg: ScoreConfig, inc):
         return None
     if inc.req_u.shape[0] >= arr.P or inc.cls.shape[0] != arr.P:
         return None
-    if inc.stat_u.shape[-1] != arr.N or inc.req_u.shape[1] != arr.R:
+    # node-axis width check reads base_u (always dense f32) — stat/fit/elig
+    # ride as packed uint32 words under KTPU_PACK_MASKS, so their last axis
+    # is a WORD count, not N
+    if inc.base_u.shape[-1] != arr.N or inc.req_u.shape[1] != arr.R:
         return None
     if arr.P % _INC_CHUNK:  # a hand-set KTPU_INC_CHUNK must divide P
         return None

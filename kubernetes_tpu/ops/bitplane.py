@@ -1,0 +1,278 @@
+"""Bit-packed mask planes + low-precision score storage — the packed data
+plane (ROADMAP item 3b).
+
+Boolean eligibility / validity / claim masks are stored as uint32 BIT-PLANE
+WORDS along their node axis: `[..., N] bool` becomes `[..., W] uint32` with
+`W = ceil(N / 32)`, bit `j` of word `k` holding node `k*32 + j`.  That cuts
+the resident HBM of the `[P, N]` / `[U, N]` mask planes 8x (bool is a whole
+byte on device) and shrinks every all-gather that ships them.  Raw score
+planes (`traw` / `naraw` / `img` — normalize inputs) store as bf16 and are
+upcast to f32 before every reduction (f32 accumulation), so the packed plane
+changes BYTES, never DECISIONS.
+
+SHARDED LAYOUT — per-shard-local word blocks: a mask sharded over `S` shards
+of `nl` local nodes packs each shard's slice independently (`Wl =
+ceil(nl/32)` words per shard), so the tiled `all_gather` along the word axis
+concatenates shard blocks IN SHARD ORDER and the gathered `[.., S*Wl]` array
+is exactly the packed form of the gathered dense mask.  Global node `g`
+lives at shard `s = g // nl`, local bit `l = g % nl`, i.e. word
+`s*Wl + l//32`, bit `l % 32` — `test_cols` below implements that map; with
+`nl == N` (single device) it degenerates to the standard `ceil(N/32)`
+layout.  TAIL-BIT RULE: bits past `nl` in a shard's last word are ALWAYS
+zero (pack pads with False), so popcount / any-reductions never need a
+separate tail mask.
+
+Both knobs are TRACE-TIME constants (read once at import, baked into every
+jit trace — the ops/tuning.py discipline, autotune sweeps run candidates in
+fresh subprocesses):
+
+  KTPU_PACK_MASKS=0    escape hatch back to dense bool planes
+  KTPU_SCORE_DTYPE=f32 escape hatch back to f32 raw score storage
+
+Decisions are bit-identical either way (tests/test_packed_masks.py); the
+knobs trade HBM/collective bytes against a little shift/mask compute at the
+unpack frontier.  The host-side mirrors (`np_*`, `bf16_round_np`) keep the
+DeltaEncoder, the serial oracle and the native engine on the very same
+quantization lattice, so decision parity against the oracle survives the
+bf16 move by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import tuning
+
+# trace-time knobs (env > persisted autotune winner > default)
+PACK_MASKS: bool = bool(int(tuning.tuned_knob("KTPU_PACK_MASKS", 1)))
+SCORE_DTYPE: str = str(tuning.tuned_knob("KTPU_SCORE_DTYPE", "bf16"))
+if SCORE_DTYPE not in ("bf16", "f32"):
+    raise ValueError(
+        f"KTPU_SCORE_DTYPE must be 'bf16' or 'f32', got {SCORE_DTYPE!r}"
+    )
+
+WORD_BITS = 32
+
+
+def words_for(n: int) -> int:
+    """Words per `n` mask bits: ceil(n / 32)."""
+    return -(-int(n) // WORD_BITS)
+
+
+# ---------------------------------------------------------------------------
+# device side (jax) — imported lazily so host-only callers (encoder, oracle,
+# native mirror) never touch a backend
+# ---------------------------------------------------------------------------
+
+def pack(x):
+    """bool [..., n] -> uint32 [..., words_for(n)].  Tail bits (past n in the
+    last word) are zero — pack pads with False, never garbage."""
+    import jax.numpy as jnp
+
+    n = x.shape[-1]
+    w = words_for(n)
+    pad = w * WORD_BITS - n
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad,), dtype=jnp.bool_)], axis=-1
+        )
+    # packbits first (8x reduction at the op level), then fold 4 bytes into
+    # each little-endian word — the widest transient is dense/2 bytes, not
+    # the 4x-dense a direct shift-and-reduce would materialize
+    b = jnp.packbits(x, axis=-1, bitorder="little")  # uint8 [..., w*4]
+    b = b.reshape(b.shape[:-1] + (w, 4)).astype(jnp.uint32)
+    shift = jnp.arange(4, dtype=jnp.uint32) * jnp.uint32(8)
+    return jnp.sum(b << shift, axis=-1, dtype=jnp.uint32)
+
+
+def unpack(w, n: int):
+    """uint32 [..., words_for(n)] -> bool [..., n] (the pack inverse)."""
+    import jax.numpy as jnp
+
+    shift = jnp.arange(4, dtype=jnp.uint32) * jnp.uint32(8)
+    b = ((w[..., None] >> shift) & jnp.uint32(0xFF)).astype(jnp.uint8)
+    bits = jnp.unpackbits(
+        b.reshape(b.shape[:-2] + (-1,)), axis=-1, bitorder="little"
+    )
+    return bits[..., :n].astype(jnp.bool_)
+
+
+def pack_blocks(x, s: int = 1):
+    """bool [..., S*nl] -> uint32 [..., S*Wl] packed in PER-SHARD-LOCAL
+    blocks: each of the `s` equal slices of the last axis packs
+    independently, so sharding the word axis into `s` parts hands every
+    shard exactly the packed form of its own node slice (the layout
+    unpack_blocks / test_cols read).  s == 1 is plain pack()."""
+    if s == 1:
+        return pack(x)
+    n = x.shape[-1] // s
+    xb = x.reshape(x.shape[:-1] + (s, n))
+    return pack(xb).reshape(x.shape[:-1] + (s * words_for(n),))
+
+
+def unpack_blocks(w, nl: int):
+    """uint32 [..., S*Wl] packed with PER-SHARD-LOCAL blocks of `nl` bits
+    (the tiled all_gather layout) -> dense bool [..., S*nl].  Each shard
+    block unpacks independently so the per-block pad bits (nl % 32 != 0)
+    never leak into the dense view.  With one block (S == 1) this is
+    exactly unpack(w, nl)."""
+    wl = words_for(nl)
+    s = w.shape[-1] // wl
+    if s == 1:
+        return unpack(w, nl)
+    wb = w.reshape(w.shape[:-1] + (s, wl))
+    return unpack(wb, nl).reshape(w.shape[:-1] + (s * nl,))
+
+
+def test_cols(w, cols, nl: int):
+    """Per-column bit test on a packed plane: `w[..., S*Wl]` packed with
+    per-shard-local blocks of `nl` bits, `cols` int32 GLOBAL node ids in
+    [0, S*nl).  Returns bool with shape w.shape[:-1] + cols.shape — the
+    packed equivalent of `dense[..., cols]`.  With nl == N (unsharded /
+    local view) the shard term vanishes."""
+    import jax.numpy as jnp
+
+    wl = words_for(nl)
+    s, l = jnp.divmod(cols, nl)
+    word = s * wl + l // WORD_BITS
+    bit = (l % WORD_BITS).astype(jnp.uint32)
+    return ((jnp.take(w, word, axis=-1) >> bit) & jnp.uint32(1)).astype(
+        jnp.bool_
+    )
+
+
+def popcount(w, axis: int = -1):
+    """Set-bit count along `axis` (int32) — exact because tail bits are
+    zero by the pack rule."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    return jnp.sum(
+        lax.population_count(w).astype(jnp.int32), axis=axis,
+        dtype=jnp.int32,
+    )
+
+
+def any_bits(w, axis: int = -1):
+    """Any bit set along `axis` — the packed `dense.any(axis)`."""
+    return (w != 0).any(axis=axis)
+
+
+def set_cols(w, cols, on, nl: int):
+    """Packed scatter: set bit `cols[i]` to True where `on[i]`, on a packed
+    [.., S*Wl] plane (duplicate columns are fine — OR semantics).  Routes
+    through a transient dense [.., S*nl] plane: the scatter frontier is
+    narrow (O(E) columns once per round), the RESIDENT form stays packed."""
+    import jax.numpy as jnp
+
+    n = (w.shape[-1] // words_for(nl)) * nl
+    dense = jnp.zeros(w.shape[:-1] + (n + 1,), dtype=jnp.bool_)
+    tgt = jnp.where(on, cols, n)
+    dense = dense.at[..., tgt].set(True, mode="drop")
+    return w | pack(dense[..., :n])
+
+
+def assign_cols(w, cols, on, nl: int):
+    """Packed column ASSIGNMENT: bit `cols[i]` := `on[..., i]` on a packed
+    [.., S*Wl] plane — the patch-frontier sibling of set_cols (which only
+    ORs).  `cols` are GLOBAL node ids in [0, S*nl]; ids == S*nl drop (the
+    kernels' usual sentinel).  Duplicate columns must carry equal values
+    (the callers' existing last-write-wins contract).  Routes through
+    transient dense [.., S*nl] planes — the frontier is O(C) columns, the
+    RESIDENT form stays packed."""
+    import jax.numpy as jnp
+
+    n = (w.shape[-1] // words_for(nl)) * nl
+    tgt = jnp.clip(cols, 0, n)
+    touched = jnp.zeros((n + 1,), dtype=jnp.bool_).at[tgt].set(True)[:n]
+    newbits = (
+        jnp.zeros(w.shape[:-1] + (n + 1,), dtype=jnp.bool_)
+        .at[..., tgt].set(on, mode="drop")[..., :n]
+    )
+    if words_for(nl) * WORD_BITS == nl or w.shape[-1] == words_for(nl):
+        tw = pack(touched)
+        nw = pack(newbits)
+    else:
+        # per-shard blocks: pack each block independently (unpack_blocks
+        # inverse) so block pad bits stay zero
+        s = w.shape[-1] // words_for(nl)
+        tw = pack(touched.reshape((s, nl))).reshape(-1)
+        nw = pack(
+            newbits.reshape(newbits.shape[:-1] + (s, nl))
+        ).reshape(w.shape)
+    return (w & ~tw) | nw
+
+
+# ---------------------------------------------------------------------------
+# score dtype (bf16 storage, f32 accumulation)
+# ---------------------------------------------------------------------------
+
+def score_store_dtype():
+    """The jnp dtype raw score planes are STORED in (bf16 unless the
+    KTPU_SCORE_DTYPE=f32 escape hatch is set).  Reductions always upcast to
+    f32 first — grep for `.astype(jnp.float32)` at the consumers."""
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if SCORE_DTYPE == "bf16" else jnp.float32
+
+
+def quantize_scores(x):
+    """Device-side: round a computed f32 raw score plane onto the storage
+    lattice (f32 -> bf16 keeps KTPU007 clean: never int -> bf16)."""
+    return x.astype(score_store_dtype())
+
+
+def np_score_dtype():
+    """Host-side storage dtype (ml_dtypes ships with jax — no new dep)."""
+    if SCORE_DTYPE == "bf16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float32)
+
+
+def quantize_scores_np(x: np.ndarray) -> np.ndarray:
+    """Host-side mirror of quantize_scores (encoder-built planes)."""
+    return np.asarray(x, dtype=np.float32).astype(np_score_dtype())
+
+
+def bf16_round_np(x):
+    """f32 -> storage lattice -> f32: the scalar/ndarray rounding the serial
+    oracle and the native mirror apply to every raw score they compute, so
+    their f32 values equal the device's upcast-from-storage values bit for
+    bit.  Identity when KTPU_SCORE_DTYPE=f32."""
+    if SCORE_DTYPE != "bf16":
+        return np.float32(x) if np.isscalar(x) else np.asarray(x, np.float32)
+    import ml_dtypes
+
+    out = np.asarray(x, np.float32).astype(ml_dtypes.bfloat16).astype(
+        np.float32
+    )
+    return np.float32(out) if out.ndim == 0 else out
+
+
+# ---------------------------------------------------------------------------
+# host side (numpy) — encoder transfer packing
+# ---------------------------------------------------------------------------
+
+def np_pack_lastaxis(a: np.ndarray) -> np.ndarray:
+    """bool [..., n] -> uint32 [..., words_for(n)], same bit layout as
+    pack() (little-endian bits within little-endian words — packbits
+    bitorder='little' + a uint8->uint32 view on a little-endian host)."""
+    a = np.ascontiguousarray(a, dtype=np.bool_)
+    n = a.shape[-1]
+    w = words_for(n)
+    pad = w * WORD_BITS - n
+    if pad:
+        a = np.concatenate(
+            [a, np.zeros(a.shape[:-1] + (pad,), dtype=np.bool_)], axis=-1
+        )
+    bytes_ = np.packbits(a, axis=-1, bitorder="little")
+    return np.ascontiguousarray(bytes_).view(np.uint32)
+
+
+def np_unpack_lastaxis(w: np.ndarray, n: int) -> np.ndarray:
+    """uint32 [..., words] -> bool [..., n] (np_pack_lastaxis inverse)."""
+    w = np.ascontiguousarray(w, dtype=np.uint32)
+    bits = np.unpackbits(w.view(np.uint8), axis=-1, bitorder="little")
+    return bits[..., :n].astype(np.bool_)

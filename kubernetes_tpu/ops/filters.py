@@ -89,6 +89,40 @@ def static_feasible(arr: ClusterArrays) -> jax.Array:
     )
 
 
+def static_feasible_rows(
+    tm: jax.Array, node_valid: jax.Array, node_taint_ns: jax.Array,
+    my_nodes: jax.Array, pod_terms: jax.Array, pod_has_sel: jax.Array,
+    pod_tol_ns: jax.Array, pod_nodename: jax.Array, pod_valid: jax.Array,
+):
+    """(sf [B, Nl], nodesel [B, Nl]) for a pod ROW BLOCK against the node
+    slice `my_nodes` (global ids — the sharded kernels' base + arange).
+
+    The block form exists for the packed data plane (ops/bitplane.py): the
+    chunked/rounds kernels map it over C-row blocks and pack each block's
+    result, so the widest dense mask transient is [C, Nl], never [P, Nl] —
+    the resident plane rides as uint32 words.  Same ops, same order as
+    static_feasible, so the bits are identical to the dense hoist."""
+    ids = jnp.maximum(pod_terms, 0)
+    per_term = tm[ids] & (pod_terms >= 0)[:, :, None]
+    nodesel = jnp.where(pod_has_sel[:, None], per_term.any(axis=1), True)
+    intolerable = jnp.einsum(
+        "pt,nt->pn",
+        (~pod_tol_ns).astype(jnp.float32),
+        node_taint_ns.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    pin = pod_nodename[:, None]
+    nn_ok = jnp.where(pin == -1, True, pin == my_nodes[None, :])
+    sf = (
+        node_valid[None, :]
+        & pod_valid[:, None]
+        & (intolerable == 0)
+        & nodesel
+        & nn_ok
+    )
+    return sf, nodesel
+
+
 def fit_ok(pod_req: jax.Array, node_used: jax.Array, node_alloc: jax.Array) -> jax.Array:
     """bool[N] for one pod: used + req <= alloc on every resource (int32 exact).
 

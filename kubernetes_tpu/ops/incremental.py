@@ -75,17 +75,26 @@ class IncState(NamedTuple):
     Mandatory fields serve the chunked (fit+balanced) route; the optional
     tail serves the rounds route's extra stages (None when the cfg disables
     the stage — None leaves drop out of the pytree, so jit/shard_map keys
-    on exactly the populated structure)."""
+    on exactly the populated structure).
+
+    Mask planes (stat_u / fit_u / elig_u) ride PACKED under
+    KTPU_PACK_MASKS (ops/bitplane.py): uint32 [U1, S*ceil(N/S/32)] word
+    planes in per-shard-local blocks, 8x smaller resident than the dense
+    bool rows; raw score matrices (traw_u / naraw_u / img_u) store on the
+    bf16 lattice under KTPU_SCORE_DTYPE=bf16 and are upcast to f32 by
+    every consumer before reduction.  Under the escape hatches
+    (KTPU_PACK_MASKS=0 / KTPU_SCORE_DTYPE=f32) the dense/f32 types below
+    apply verbatim."""
 
     cls: Any      # i32[P] per-pod equivalence-class index (U = padding class)
     req_u: Any    # i32[U1, R] scaled per-class requests
-    stat_u: Any   # bool[U1, N] static feasibility per class (usage-independent)
+    stat_u: Any   # bool[U1, N] static feasibility per class (packed: u32 words)
     base_u: Any   # f32[U1, N] fit+balanced base scores vs cycle-start usage
-    fit_u: Any    # bool[U1, N] fit mask vs cycle-start usage
-    elig_u: Any = None   # bool[U1, N] nodesel & node_valid (pairwise cfgs)
-    traw_u: Any = None   # f32[U1, N] TaintToleration raw counts
-    naraw_u: Any = None  # f32[U1, N] preferred node-affinity raws
-    img_u: Any = None    # f32[U1, N] ImageLocality static scores
+    fit_u: Any    # bool[U1, N] fit mask vs cycle-start usage (packed: u32 words)
+    elig_u: Any = None   # bool[U1, N] nodesel & node_valid (packed: u32 words)
+    traw_u: Any = None   # f32/bf16[U1, N] TaintToleration raw counts
+    naraw_u: Any = None  # f32/bf16[U1, N] preferred node-affinity raws
+    img_u: Any = None    # f32/bf16[U1, N] ImageLocality static scores
 
 
 def incremental_enabled() -> bool:
@@ -130,8 +139,11 @@ def class_view(arr, r_u: np.ndarray, pad: int = 0):
     return dataclasses.replace(arr, **repl)
 
 
-@partial(jax.jit, static_argnames=("want_elig", "want_traw", "want_naraw"))
-def _static_hoist(cv, want_elig, want_traw, want_naraw):
+@partial(
+    jax.jit,
+    static_argnames=("want_elig", "want_traw", "want_naraw", "n_shards"),
+)
+def _static_hoist(cv, want_elig, want_traw, want_naraw, n_shards=1):
     """Usage-independent class matrices from a class-view ClusterArrays —
     the same filter/score functions the kernels' dense preludes apply, so
     row u is bit-identical to any of class u's pod rows in those hoists.
@@ -141,8 +153,14 @@ def _static_hoist(cv, want_elig, want_traw, want_naraw):
     resident state survives pod_valid-only changes — in particular the gang
     fixpoint (ops/gang.py), which revokes whole groups between iterations.
     pod_group is part of the spec key, so a revocation masks whole classes
-    and class-row consistency holds throughout."""
-    from . import filters
+    and class-row consistency holds throughout.
+
+    Under KTPU_PACK_MASKS the stat/elig planes leave as uint32 word rows in
+    per-shard-local blocks (`n_shards` static — bitplane.pack_blocks), so
+    sharding the word axis hands each shard the packed form of its own node
+    slice; traw/naraw already arrive on the bf16 lattice from their
+    producers (ops/scores.py / ops/assign.py quantize at the source)."""
+    from . import bitplane, filters
     from .assign import _preferred_node_affinity_raw
     from .scopes import subphase
     from .scores import taint_prefer_counts
@@ -159,15 +177,21 @@ def _static_hoist(cv, want_elig, want_traw, want_naraw):
         elig = (nodesel & cv.node_valid[None, :]) if want_elig else None
         traw = taint_prefer_counts(cv) if want_traw else None
         naraw = _preferred_node_affinity_raw(cv, tm) if want_naraw else None
+        if bitplane.PACK_MASKS:
+            stat = bitplane.pack_blocks(stat, n_shards)
+            if elig is not None:
+                elig = bitplane.pack_blocks(elig, n_shards)
         return stat, elig, traw, naraw
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _usage_hoist(req_u, node_used, node_alloc, cfg):
+@partial(jax.jit, static_argnames=("cfg", "n_shards"))
+def _usage_hoist(req_u, node_used, node_alloc, cfg, n_shards=1):
     """Full [U1, N] fit+balanced hoist — the kernels' base_at/chunk hoist
     vmapped over classes instead of pods (elementwise per (row, node), so
-    float32 results are bit-identical to the per-pod dense hoist)."""
-    from . import filters
+    float32 results are bit-identical to the per-pod dense hoist).  The fit
+    MASK leaves packed (per-shard blocks) under KTPU_PACK_MASKS; the f32
+    base scores stay dense — they feed top_k directly."""
+    from . import bitplane, filters
     from .scopes import subphase
     from .scores import balanced_allocation, fit_score
 
@@ -181,21 +205,34 @@ def _usage_hoist(req_u, node_used, node_alloc, cfg):
         )(requested, node_alloc) + cfg.balanced_weight * jax.vmap(
             balanced_allocation, (0, None, None)
         )(requested, node_alloc, cfg.score_resources)
+        if bitplane.PACK_MASKS:
+            fit = bitplane.pack_blocks(fit, n_shards)
         return base, fit
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _patch_hoist(base_u, fit_u, req_u, node_used, node_alloc, cols, cfg):
+@partial(jax.jit, static_argnames=("cfg", "n_shards"))
+def _patch_hoist(
+    base_u, fit_u, req_u, node_used, node_alloc, cols, cfg, n_shards=1
+):
     """Recompute the dirty node COLUMNS of the resident usage-side cache.
     `cols` is a pow2-bucketed i32 vector of global node ids, padded with the
     out-of-range sentinel N (clipped on gather, dropped on scatter).  The
     per-column math is the same row-wise formulas as _usage_hoist, so a
     patched matrix equals a full re-hoist bit-for-bit.
 
+    Under KTPU_PACK_MASKS fit_u is a packed word plane: the column
+    ASSIGNMENT (mixed set/clear — a dirty node can flip either way) goes
+    through bitplane.assign_cols, which builds touched/new word masks from
+    a transient dense [U1, N] plane (U-scale — tiny) and merges with two
+    bit-ops, so the RESIDENT plane never unpacks.  cols are unique real
+    ids plus
+    repeated sentinel entries — exactly assign_cols' duplicate contract
+    (duplicates carry equal values; the sentinel clips to the drop slot).
+
     Deliberately NOT donating the previous generation: under the depth-1
     pipeline the in-flight step may still be reading it (the
     donation-aliasing rule in the module docstring)."""
-    from . import filters
+    from . import bitplane, filters
     from .scopes import subphase
     from .scores import balanced_allocation, fit_score
 
@@ -214,7 +251,10 @@ def _patch_hoist(base_u, fit_u, req_u, node_used, node_alloc, cols, cfg):
             lambda rq: balanced_allocation(rq, ca, cfg.score_resources)
         )(reqd)
         base_u = base_u.at[:, cols].set(base_c, mode="drop")
-        fit_u = fit_u.at[:, cols].set(fit_c, mode="drop")
+        if bitplane.PACK_MASKS:
+            fit_u = bitplane.assign_cols(fit_u, cols, fit_c, n // n_shards)
+        else:
+            fit_u = fit_u.at[:, cols].set(fit_c, mode="drop")
         return base_u, fit_u
 
 
@@ -441,7 +481,7 @@ class HoistCache:
         ):
             cv = class_view(arr, r_u, pad)
             stat, elig, traw, naraw = _static_hoist(
-                cv, want_elig, want_traw, want_naraw
+                cv, want_elig, want_traw, want_naraw, n_shards=n_shards
             )
             img = jnp.asarray(cv.image_score) if want_img else None
             self._statics = tuple(
@@ -473,7 +513,9 @@ class HoistCache:
             # of jit arguments would hide a per-cycle H2D copy here)
             nu = self._place_rows(_pad_rows(used_h, pad))
             na = self._place_rows(_pad_rows(arr.node_alloc, pad))
-            base_u, fit_u = _usage_hoist(req_dev, nu, na, cfg)
+            base_u, fit_u = _usage_hoist(
+                req_dev, nu, na, cfg, n_shards=n_shards
+            )
             self._usage = (self._place_node(base_u), self._place_node(fit_u))
             self.stats["full"] += 1
             frac, ncols = 1.0, np_nodes
@@ -498,7 +540,8 @@ class HoistCache:
             nu = self._place_rows(_pad_rows(used_h, pad))
             na = self._place_rows(_pad_rows(arr.node_alloc, pad))
             base_u, fit_u = _patch_hoist(
-                self._usage[0], self._usage[1], req_dev, nu, na, cols, cfg
+                self._usage[0], self._usage[1], req_dev, nu, na, cols, cfg,
+                n_shards=n_shards,
             )
             # device_put to the resident sharding is a no-op when GSPMD
             # already produced it there (jax short-circuits equal shardings)

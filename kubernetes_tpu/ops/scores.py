@@ -229,14 +229,24 @@ def balanced_allocation(
 
 
 def taint_prefer_counts(arr: ClusterArrays) -> jax.Array:
-    """f32[P, N]: # of intolerable PreferNoSchedule taints — TaintToleration's
+    """[P, N] # of intolerable PreferNoSchedule taints — TaintToleration's
     raw Score before normalization (tainttoleration/taint_toleration.go —
-    CountIntolerableTaintsPreferNoSchedule)."""
-    return jnp.einsum(
-        "pt,nt->pn",
-        (~arr.pod_tol_pref).astype(jnp.float32),
-        arr.node_taint_pref.astype(jnp.float32),
-        precision=jax.lax.Precision.HIGHEST,
+    CountIntolerableTaintsPreferNoSchedule).
+
+    Computed in f32 (counting matmul, exact < 2^24), STORED on the
+    bf16 lattice (ops/bitplane.py — KTPU_SCORE_DTYPE): the resident raw
+    plane is a normalize input, and the serial oracle / native engine round
+    through the same lattice, so decisions stay bit-identical.  Consumers
+    upcast to f32 before reducing."""
+    from . import bitplane
+
+    return bitplane.quantize_scores(
+        jnp.einsum(
+            "pt,nt->pn",
+            (~arr.pod_tol_pref).astype(jnp.float32),
+            arr.node_taint_pref.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
     )
 
 

@@ -37,7 +37,16 @@ from typing import Any, Dict, Optional
 # can never inject an unknown trace-time constant)
 TUNABLE_KNOBS = (
     "KTPU_INC_CHUNK", "KTPU_WAVE_K", "KTPU_WAVE_BLOCK", "KTPU_WAVE_ITERS",
+    "KTPU_PACK_MASKS", "KTPU_SCORE_DTYPE",
 )
+
+# per-knob value type: every knob is an int unless listed here
+# (KTPU_SCORE_DTYPE is a dtype name — "bf16" | "f32")
+_KNOB_TYPES = {"KTPU_SCORE_DTYPE": str}
+
+
+def _coerce(name: str, v: Any):
+    return _KNOB_TYPES.get(name, int)(v)
 
 
 def tuning_dir() -> Optional[str]:
@@ -78,7 +87,8 @@ def load_tuned(platform: Optional[str] = None) -> Dict[str, Any]:
         with open(path) as f:
             doc = json.load(f)
         knobs = doc.get("knobs", {})
-        return {k: int(v) for k, v in knobs.items() if k in TUNABLE_KNOBS}
+        return {k: _coerce(k, v) for k, v in knobs.items()
+                if k in TUNABLE_KNOBS}
     except (OSError, ValueError, TypeError):
         return {}
 
@@ -95,7 +105,8 @@ def save_tuned(
         return None
     os.makedirs(os.path.dirname(path), exist_ok=True)
     doc = {
-        "knobs": {k: int(v) for k, v in knobs.items() if k in TUNABLE_KNOBS},
+        "knobs": {k: _coerce(k, v) for k, v in knobs.items()
+                  if k in TUNABLE_KNOBS},
         "score": score,
         "platform": _platform(platform),
     }
@@ -107,15 +118,16 @@ def save_tuned(
     return path
 
 
-def tuned_knob(name: str, default: int) -> int:
+def tuned_knob(name: str, default):
     """Trace-time knob resolution: env var > persisted winner > default.
-    Called at `ops.assign` IMPORT time — the resolved value is baked into
-    every jit trace, exactly like the plain int(os.environ.get(...))
-    pattern it extends."""
+    Called at `ops.assign` / `ops.bitplane` IMPORT time — the resolved value
+    is baked into every jit trace, exactly like the plain
+    int(os.environ.get(...)) pattern it extends.  Value type follows the
+    knob (_KNOB_TYPES): ints except KTPU_SCORE_DTYPE (a dtype name)."""
     raw = os.environ.get(name, "")
     if raw:
-        return int(raw)
+        return _coerce(name, raw)
     tuned = load_tuned()
     if name in tuned:
-        return int(tuned[name])
+        return _coerce(name, tuned[name])
     return default

@@ -41,6 +41,14 @@ def _tolerates_all(pod: t.Pod, taints) -> bool:
     return True
 
 
+def _bf16r(x) -> f32:
+    """Round a raw score onto the device's bf16 storage lattice
+    (ops/bitplane.py — identity under the KTPU_SCORE_DTYPE=f32 hatch)."""
+    from ..ops.bitplane import bf16_round_np
+
+    return f32(bf16_round_np(np.float32(x)))
+
+
 def _intolerable_prefer_count(pod: t.Pod, taints) -> int:
     return sum(
         1
@@ -331,12 +339,17 @@ def _interpod_pref_raw(pod, nodes, existing, n, hard_w: float = 1.0) -> f32:
 
 
 def _preferred_na_raw(pod, nd) -> f32:
+    from ..ops.bitplane import bf16_round_np
+
     raw = f32(0.0)
     if pod.affinity:
         for pt in pod.affinity.preferred_node_terms:
             if pt.preference.match_expressions and _matches_term(pt.preference, nd.labels):
                 raw = f32(raw + f32(pt.weight))
-    return raw
+    # the device stores this raw plane on the bf16 lattice
+    # (ops/assign.py — _preferred_node_affinity_raw quantizes at the
+    # producer); round identically so normalization sees the same inputs
+    return f32(bf16_round_np(raw))
 
 
 def _image_score(pod: t.Pod, nd: t.Node) -> f32:
@@ -433,7 +446,9 @@ def oracle_schedule(
             if not _interpod_ok(pod, nodes, existing, i):
                 continue
             feasible.append(i)
-            pref_counts[i] = _intolerable_prefer_count(pod, taints)
+            # bf16-lattice mirror of the device's stored taint counts
+            # (ops/scores.py — taint_prefer_counts quantizes at the producer)
+            pref_counts[i] = _bf16r(_intolerable_prefer_count(pod, taints))
             spread_raws[i] = spread_raw
         if not feasible:
             out.append((pod.name, None))

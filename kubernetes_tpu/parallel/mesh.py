@@ -156,10 +156,25 @@ def shard_hbm_estimate(
 
     `u_classes` (incremental routes, ops/incremental.py): adds the
     resident [U1, Nl] class matrices (static/base/fit + the carried copy)
-    the IncState pins per shard."""
+    the IncState pins per shard.
+
+    PACKED DATA PLANE (ops/bitplane.py — KTPU_PACK_MASKS): the boolean
+    mask planes store as uint32 bit-plane words, so the `pn_masks` term and
+    the mask share of `class_matrices` price at ``ceil(n/32) * 4`` bytes
+    per row instead of ``n`` — the 8x HBM-ceiling cut BENCH_r08 lands.
+    The estimate keys on the same trace-time knob as the kernels, so the
+    analytic budget and the compiled buffers flip together (KTPU012)."""
+    from ..ops import bitplane
+
     nl = -(-n_nodes // n_shards)
+    # bytes of one [*, nl] / [*, N] boolean mask ROW under the active plane
+    row_l = 4 * bitplane.words_for(nl) if bitplane.PACK_MASKS else nl
+    row_n = (
+        4 * n_shards * bitplane.words_for(nl)
+        if bitplane.PACK_MASKS else n_nodes
+    )
     b = {
-        "pn_masks": 2 * n_pods * nl,                 # sf + nodesel, bool
+        "pn_masks": 2 * n_pods * row_l,              # sf + nodesel planes
         "chunk_hoist": 2 * chunk * nl * n_res * 4,   # requested + scores f32
         "count_state": 4 * max(1, n_terms) * nl * 4, # cnt/anti/pref/dom
         "gathered_scores": 2 * chunk * n_nodes * 4,  # [C, N] total0 + .T
@@ -169,9 +184,12 @@ def shard_hbm_estimate(
         + 4 * chunk * chunk * 4,
     }
     if u_classes:
-        # stat/base/fit resident + the gathered [U1, N] carry the chunk
-        # scan rides (full N: the class hoist is stitched once per cycle)
-        b["class_matrices"] = 4 * u_classes * n_nodes * 4
+        # the gathered [U1, N] f32 score carry (+ its masked copy) the
+        # chunk scan rides, plus the gathered stat/fit mask planes (packed:
+        # word rows; dense: byte rows) — full N, stitched once per cycle
+        b["class_matrices"] = (
+            2 * u_classes * n_nodes * 4 + 2 * u_classes * row_n
+        )
     # the resident INPUT set (every ClusterArrays field + the IncState
     # matrices), summed from the per-field size model the partition rule
     # table derives — the same model KTPU015's replicated-giant threshold

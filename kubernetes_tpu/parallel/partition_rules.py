@@ -138,59 +138,83 @@ PARTITION_RULES: Tuple[PartitionRule, ...] = (
 # analytic budgets and the lint can never drift onto different field sets.
 # --------------------------------------------------------------------------
 
-# qualname -> (dims symbols, itemsize).  Covers the RESIDENT buffer set:
-# every ClusterArrays field + every IncState field.  A ClusterArrays field
-# added without a row here fails the shard pass's coverage check loudly.
+# qualname -> (dims symbols, BITS per element).  Covers the RESIDENT buffer
+# set: every ClusterArrays field + every IncState field.  A ClusterArrays
+# field added without a row here fails the shard pass's coverage check
+# loudly.
+#
+# BITS MODEL (the packed data plane, ops/bitplane.py): bits >= 8 is a plain
+# element width (bytes = count * bits / 8).  bits == 1 marks a BIT-PACKED
+# plane: the concrete buffer stores uint32 words along its LAST dims symbol
+# (`[..., ceil(n/32)]`, per-shard-local word blocks under the mesh), and
+# `field_bytes` prices exactly that word-padded layout — so the one size
+# model feeding shard_hbm_estimate, memwatch's census (KTPU020) and the
+# KTPU015 threshold math prices packed fields correctly by construction.
+# The packed/bf16 rows key on the same trace-time knobs as the kernels
+# (KTPU_PACK_MASKS / KTPU_SCORE_DTYPE), so model and buffers flip together.
+_MASK_BITS: int
+_SCORE_BITS: int
+
+
+def _plane_bits() -> Tuple[int, int]:
+    from ..ops import bitplane
+
+    return (1 if bitplane.PACK_MASKS else 8,
+            16 if bitplane.SCORE_DTYPE == "bf16" else 32)
+
+
+_MASK_BITS, _SCORE_BITS = _plane_bits()
+
 FIELD_DIMS: Dict[str, Tuple[Tuple[str, ...], int]] = {
-    "arr.node_valid": (("N",), 1),
-    "arr.node_alloc": (("N", "R"), 4),
-    "arr.node_used": (("N", "R"), 4),
-    "arr.node_unsched": (("N",), 1),
-    "arr.node_labels": (("N", "L"), 4),
-    "arr.node_taint_ns": (("N", "T"), 1),
-    "arr.node_taint_pref": (("N", "T"), 1),
-    "arr.node_dom": (("K", "N"), 4),
-    "arr.node_ports0": (("N", "PT"), 1),
-    "arr.pod_valid": (("P",), 1),
-    "arr.pod_req": (("P", "R"), 4),
-    "arr.pod_prio": (("P",), 4),
-    "arr.pod_tol_ns": (("P", "T"), 1),
-    "arr.pod_tol_pref": (("P", "T"), 1),
-    "arr.pod_nodename": (("P",), 4),
-    "arr.pod_terms": (("P", "TT"), 4),
-    "arr.pod_has_sel": (("P",), 1),
-    "arr.sel_mask": (("S", "E", "L"), 4),
-    "arr.sel_kind": (("S", "E"), 4),
-    "arr.pod_pref_terms": (("P", "PW"), 4),
-    "arr.pod_pref_weights": (("P", "PW"), 4),
-    "arr.term_key": (("T2",), 4),
-    "arr.m_pend": (("T2", "P"), 4),
-    "arr.pod_match_terms": (("P", "MM"), 4),
-    "arr.pod_match_vals": (("P", "MM"), 4),
-    "arr.pod_aff_self": (("P", "A1"), 1),
-    "arr.term_counts0": (("T2", "D1"), 4),
-    "arr.anti_counts0": (("T2", "D1"), 4),
-    "arr.pod_aff_terms": (("P", "A1"), 4),
-    "arr.pod_anti_terms": (("P", "A2"), 4),
-    "arr.pod_pref_aff_terms": (("P", "B"), 4),
-    "arr.pod_pref_aff_w": (("P", "B"), 4),
-    "arr.pref_own0": (("T2", "D1"), 4),
-    "arr.pod_spread_terms": (("P", "C"), 4),
-    "arr.pod_spread_maxskew": (("P", "C"), 4),
-    "arr.pod_spread_hard": (("P", "C"), 1),
-    "arr.pod_ports": (("P", "PT"), 1),
-    "arr.pod_group": (("P",), 4),
-    "arr.group_min": (("G",), 4),
-    "arr.image_score": (("P", "N"), 4),
-    "inc.cls": (("P",), 4),
-    "inc.req_u": (("U", "R"), 4),
-    "inc.stat_u": (("U", "N"), 1),
-    "inc.base_u": (("U", "N"), 4),
-    "inc.fit_u": (("U", "N"), 1),
-    "inc.elig_u": (("U", "N"), 1),
-    "inc.traw_u": (("U", "N"), 4),
-    "inc.naraw_u": (("U", "N"), 4),
-    "inc.img_u": (("U", "N"), 4),
+    "arr.node_valid": (("N",), 8),
+    "arr.node_alloc": (("N", "R"), 32),
+    "arr.node_used": (("N", "R"), 32),
+    "arr.node_unsched": (("N",), 8),
+    "arr.node_labels": (("N", "L"), 32),
+    "arr.node_taint_ns": (("N", "T"), 8),
+    "arr.node_taint_pref": (("N", "T"), 8),
+    "arr.node_dom": (("K", "N"), 32),
+    "arr.node_ports0": (("N", "PT"), 8),
+    "arr.pod_valid": (("P",), 8),
+    "arr.pod_req": (("P", "R"), 32),
+    "arr.pod_prio": (("P",), 32),
+    "arr.pod_tol_ns": (("P", "T"), 8),
+    "arr.pod_tol_pref": (("P", "T"), 8),
+    "arr.pod_nodename": (("P",), 32),
+    "arr.pod_terms": (("P", "TT"), 32),
+    "arr.pod_has_sel": (("P",), 8),
+    "arr.sel_mask": (("S", "E", "L"), 32),
+    "arr.sel_kind": (("S", "E"), 32),
+    "arr.pod_pref_terms": (("P", "PW"), 32),
+    "arr.pod_pref_weights": (("P", "PW"), 32),
+    "arr.term_key": (("T2",), 32),
+    "arr.m_pend": (("T2", "P"), 32),
+    "arr.pod_match_terms": (("P", "MM"), 32),
+    "arr.pod_match_vals": (("P", "MM"), 32),
+    "arr.pod_aff_self": (("P", "A1"), 8),
+    "arr.term_counts0": (("T2", "D1"), 32),
+    "arr.anti_counts0": (("T2", "D1"), 32),
+    "arr.pod_aff_terms": (("P", "A1"), 32),
+    "arr.pod_anti_terms": (("P", "A2"), 32),
+    "arr.pod_pref_aff_terms": (("P", "B"), 32),
+    "arr.pod_pref_aff_w": (("P", "B"), 32),
+    "arr.pref_own0": (("T2", "D1"), 32),
+    "arr.pod_spread_terms": (("P", "C"), 32),
+    "arr.pod_spread_maxskew": (("P", "C"), 32),
+    "arr.pod_spread_hard": (("P", "C"), 8),
+    "arr.pod_ports": (("P", "PT"), 8),
+    "arr.pod_group": (("P",), 32),
+    "arr.group_min": (("G",), 32),
+    "arr.image_score": (("P", "N"), _SCORE_BITS),
+    "inc.cls": (("P",), 32),
+    "inc.req_u": (("U", "R"), 32),
+    "inc.stat_u": (("U", "N"), _MASK_BITS),
+    "inc.base_u": (("U", "N"), 32),
+    "inc.fit_u": (("U", "N"), _MASK_BITS),
+    "inc.elig_u": (("U", "N"), _MASK_BITS),
+    "inc.traw_u": (("U", "N"), _SCORE_BITS),
+    "inc.naraw_u": (("U", "N"), _SCORE_BITS),
+    "inc.img_u": (("U", "N"), _SCORE_BITS),
 }
 
 
@@ -317,19 +341,36 @@ def field_bytes(qualname: str, dims_env: Optional[Dict[str, int]] = None,
     (symbol -> size; CANONICAL_DIMS fills the gaps).  A dimension the
     table shards divides by ``n_shards``; replicated fields pay full size
     on every shard — the quantity KTPU015 thresholds and the
-    ``resident_inputs`` term of ``shard_hbm_estimate`` sums."""
-    dims, itemsize = FIELD_DIMS[qualname]
+    ``resident_inputs`` term of ``shard_hbm_estimate`` sums.
+
+    bits >= 8 rows price as ``count * bits/8``.  bits == 1 (bit-packed)
+    rows price the CONCRETE uint32 word layout: the last dims symbol packs
+    to ``ceil(size/32)`` words of 4 bytes (after the node-axis shard
+    division — per-shard-local word blocks, ops/bitplane.py), so the model
+    equals the live buffer byte-for-byte including word padding (KTPU020's
+    exact-equality contract)."""
+    dims, bits = FIELD_DIMS[qualname]
     env = dict(CANONICAL_DIMS)
     env.update(SCALE_DIMS)
     if dims_env:
         env.update(dims_env)
     spec = tuple(spec_for(qualname))
-    total = itemsize
+    sizes = []
     for i, sym in enumerate(dims):
         size = env[sym]
         if i < len(spec) and spec[i] == NODE_AXIS:
             size = -(-size // max(1, n_shards))
-        total *= max(1, size)
+        sizes.append(max(1, size))
+    if bits < 8:
+        # packed plane: last axis becomes uint32 words
+        words = -(-sizes[-1] // 32)
+        total = 4 * words
+        for size in sizes[:-1]:
+            total *= size
+        return total
+    total = bits // 8
+    for size in sizes:
+        total *= size
     return total
 
 
@@ -353,8 +394,8 @@ def resident_input_bytes(
         if q.startswith("inc.") and not u_classes:
             continue
         if q == "arr.image_score" and not image_sharded:
-            # the [P, 1] broadcast form: pod axis only
-            total += 4 * max(1, n_pods)
+            # the [P, 1] broadcast form: pod axis only, at the score width
+            total += (FIELD_DIMS[q][1] // 8) * max(1, n_pods)
             continue
         total += field_bytes(q, env, n_shards)
     return total

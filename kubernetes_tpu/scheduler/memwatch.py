@@ -180,10 +180,17 @@ def model_bytes_for(qualname: str, shape, n_shards: int = 1) -> Optional[int]:
     ent = FIELD_DIMS.get(qualname)
     if ent is None:
         return None
-    dims, _itemsize = ent
+    dims, bits = ent
     if len(dims) != len(shape):
         return None
     env = {sym: int(s) for sym, s in zip(dims, shape)}
+    if bits < 8:
+        # bit-packed plane (ops/bitplane.py): the CONCRETE last axis is
+        # already uint32 words — bind the symbol to the word capacity in
+        # BITS so field_bytes' ceil(n/32) arithmetic reproduces the word-
+        # padded layout byte-for-byte (the KTPU020 exact-equality contract;
+        # per-shard word blocks divide evenly by construction)
+        env[dims[-1]] = int(shape[-1]) * 32
     return field_bytes(qualname, env, n_shards)
 
 

@@ -220,7 +220,13 @@ def test_interference_heavy_wave_forces_fallback():
         return cfg.fit_weight * fit_score(requested, alloc, cfg) + \
             cfg.balanced_weight * balanced_allocation(requested, alloc, res)
 
-    t0u_init = jnp.where(inc.stat_u & inc.fit_u, inc.base_u, -jnp.inf)
+    # the kernels' inc hoist: packed word planes AND together, then unpack
+    # once at the dense-score frontier (ops/assign.py — schedule_scan_chunked)
+    from kubernetes_tpu.ops import bitplane
+
+    sfw = inc.stat_u & inc.fit_u
+    sf = bitplane.unpack(sfw, arr.N) if bitplane.PACK_MASKS else sfw
+    t0u_init = jnp.where(sf, inc.base_u, -jnp.inf)
     f = jax.jit(lambda c, pv, pr, ui, t0, st, na, ru:
                 assign._wave_commit_stage(c, pv, pr, ui, t0, st, na, ru,
                                           score_flat))

@@ -138,6 +138,36 @@ def test_ktpu007_clean_fixture_passes():
     assert jaxrules.DtypeFlowRule().check([t]) == []
 
 
+def test_ktpu007_bf16_accumulation_detected():
+    """An additive reduction whose accumulator is bf16 is a finding —
+    bf16 is a STORAGE dtype; matmuls/sums must accumulate in f32.  (A
+    bf16 matmul is the real-world shape: dot_general with
+    preferred_element_type=bfloat16.  jnp.sum of bf16 auto-upcasts its
+    accumulator at the jaxpr level, so matmul is the one that bites.)"""
+    t = RouteTrace.from_callable(
+        "fx/bf16acc",
+        lambda a, b: a.astype(jnp.bfloat16) @ b.astype(jnp.bfloat16),
+        jnp.ones((4, 4), dtype=jnp.float32),
+        jnp.ones((4, 4), dtype=jnp.float32))
+    fs = jaxrules.DtypeFlowRule().check([t])
+    assert fs and "accumulates in bfloat16" in fs[0].message
+
+
+def test_ktpu007_bf16_storage_f32_accumulate_passes():
+    """The legal bf16 score path: compute in f32, quantize to bf16 for
+    storage, upcast to f32 before every reduction — elementwise bf16 and
+    bf16 max reductions draw no finding."""
+    def fn(a):
+        stored = (a * 2.0).astype(jnp.bfloat16)       # bf16 storage
+        hi = jnp.max(stored)                           # exact in any width
+        total = jnp.sum(stored.astype(jnp.float32))    # f32 accumulation
+        return hi, total
+
+    t = RouteTrace.from_callable(
+        "fx/bf16ok", fn, jnp.ones(16, dtype=jnp.float32))
+    assert jaxrules.DtypeFlowRule().check([t]) == []
+
+
 # ---- KTPU008 donation fixtures ----
 
 def test_ktpu008_dropped_donation_detected():

@@ -1,0 +1,260 @@
+"""Packed data plane (bit-plane masks + bf16 scores) parity — the ISSUE 19
+acceptance tests.
+
+KTPU_PACK_MASKS / KTPU_SCORE_DTYPE are TRACE-TIME constants read once at
+`ops.bitplane` import, so packed-vs-unpacked cannot flip inside one process:
+the unpacked comparator runs in a FRESH subprocess with KTPU_PACK_MASKS=0
+pinned (the autotune / rounds_proof discipline).  Both sides ride the SAME
+bf16 score lattice, so packing is pure layout and every decision must be
+bit-identical across {chunked, rounds, inc} x {donate on/off} x
+{single-device, mesh8} warm churn.  Tier-1 runs a reduced leg set (each
+kernel on each mesh, both donate values); the full 8-leg matrix is `slow`.
+
+Plus the landability gates: a seeded chaos storm and a kill.post_assume
+crash-restart with the packed plane armed (the default import state) —
+a layout trick that cannot survive the storm is not landable (ROADMAP).
+"""
+
+import dataclasses
+import json
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.api.snapshot import Snapshot
+from kubernetes_tpu.ops import bitplane
+
+from helpers import mk_node, mk_pod, random_cluster  # noqa: F401 (mk_*: subproc)
+
+
+@pytest.fixture(autouse=True)
+def _packed_route(monkeypatch):
+    """Production route on the CPU sim + the packed plane at its default
+    (armed) import state; chaos injectors never leak across tests."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# --- the shared scenario: runs in THIS process (packed) and, via
+# _unpacked_payload, in a subprocess with KTPU_PACK_MASKS=0 pinned ---
+
+# tier-1 legs: each kernel on each mesh, both donate values exercised
+_SMOKE_LEGS = (
+    ("chunked", False, "single"),
+    ("chunked", True, "mesh8"),
+    ("rounds", True, "single"),
+    ("rounds", False, "mesh8"),
+)
+_FULL_LEGS = tuple(
+    (k, d, m)
+    for k in ("chunked", "rounds")
+    for d in (False, True)
+    for m in ("single", "mesh8")
+)
+
+
+def _snap_for(kernel: str):
+    rng = random.Random(42 if kernel == "chunked" else 9)
+    if kernel == "chunked":
+        # fit-only (infer_score_config strips the rest) -> chunked top-K
+        return random_cluster(rng, n_nodes=24, n_pods=120)
+    return random_cluster(
+        rng, n_nodes=24, n_pods=48,
+        with_taints=True, with_selectors=True, with_pairwise=True,
+    )
+
+
+def _decode(choices, meta):
+    ch = np.asarray(choices)
+    return [
+        [meta.pod_names[k],
+         meta.node_names[int(ch[k])] if int(ch[k]) >= 0 else None]
+        for k in range(meta.n_pods)
+    ]
+
+
+def _bind_some(snap, verdicts, k=4):
+    """k placed pods become bound, the rest re-pend under fresh names: a
+    small warm delta so later cycles ride the patched resident cache."""
+    by_name = {p.name: p for p in snap.pending_pods}
+    bound = []
+    for nm, node in verdicts:
+        if node is not None and len(bound) < k:
+            bound.append(dataclasses.replace(by_name[nm], node_name=node))
+    pend = [
+        dataclasses.replace(p, name=f"w-{p.name}", uid="")
+        for p in snap.pending_pods
+    ]
+    return Snapshot(nodes=snap.nodes, pending_pods=pend, bound_pods=bound)
+
+
+def _scenario_decisions(legs=_SMOKE_LEGS, cycles=3):
+    """Every leg: warm churn over `cycles` encode->route->bind cycles,
+    recording the dense route's decisions (cycle 0) and the incremental
+    route's decisions (every cycle).  Pure function of the seeds + the
+    trace-time packed-plane knobs — the payload is the parity artifact."""
+    from kubernetes_tpu.api.delta import DeltaEncoder
+    from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+    from kubernetes_tpu.ops.assign import schedule_batch_routed
+    from kubernetes_tpu.ops.incremental import HoistCache
+    from kubernetes_tpu.parallel import make_mesh
+
+    out = {
+        "pack": int(bitplane.PACK_MASKS),
+        "sdtype": bitplane.SCORE_DTYPE,
+        "decisions": {},
+    }
+    mesh8 = (make_mesh(8)
+             if any(m == "mesh8" for _, _, m in legs) else None)
+    try:
+        for kernel, donate, mname in legs:
+            if donate:
+                os.environ["KTPU_DONATE"] = "1"
+            else:
+                os.environ.pop("KTPU_DONATE", None)
+            mesh = mesh8 if mname == "mesh8" else None
+            snap = _snap_for(kernel)
+            enc = DeltaEncoder()
+            if mesh is not None:
+                enc.set_mesh(mesh)
+            cache = HoistCache(mesh=mesh)
+            key = f"{kernel}:{'donate' if donate else 'nodonate'}:{mname}"
+            recorded = []
+            for cycle in range(cycles):
+                arr, meta = enc.encode(snap)
+                cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+                if cycle == 0:
+                    dense_c, _ = schedule_batch_routed(
+                        arr, cfg, donate=False, mesh=mesh
+                    )
+                    recorded.append(["dense", _decode(dense_c, meta)])
+                inc = cache.ensure(arr, meta, cfg)
+                assert inc is not None, key
+                got_c, _ = schedule_batch_routed(
+                    arr, cfg, donate=donate, mesh=mesh, inc=inc
+                )
+                got = _decode(got_c, meta)
+                recorded.append(["inc", got])
+                snap = _bind_some(snap, [(nm, nd) for nm, nd in got])
+            # warm cycles really rode the patched resident cache — the
+            # packed fit plane was ASSIGNED in word space, not rebuilt
+            assert cache.stats["patched"] >= 1, (key, cache.stats)
+            out["decisions"][key] = recorded
+    finally:
+        os.environ.pop("KTPU_DONATE", None)
+    return out
+
+
+def _unpacked_payload(legs, cycles, timeout=840):
+    """The SAME scenario in a fresh subprocess with dense (unpacked) masks:
+    KTPU_PACK_MASKS=0, KTPU_SCORE_DTYPE=bf16 (identical score lattice —
+    only the mask LAYOUT differs between the two payloads)."""
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(tests_dir)
+    prog = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {root!r})\n"
+        f"sys.path.insert(0, {tests_dir!r})\n"
+        "from __graft_entry__ import force_cpu_platform\n"
+        "force_cpu_platform(8)\n"
+        "import test_packed_masks as m\n"
+        f"payload = m._scenario_decisions(legs={legs!r}, cycles={cycles})\n"
+        "print('PAYLOAD::' + json.dumps(payload))\n"
+    )
+    env = dict(os.environ)
+    env.pop("KTPU_DONATE", None)
+    env.update({
+        "KTPU_PACK_MASKS": "0",
+        "KTPU_SCORE_DTYPE": "bf16",
+        "KTPU_FORCE_CHUNKED": "1",
+    })
+    r = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=root,
+    )
+    assert r.returncode == 0, f"unpacked comparator died:\n{r.stderr[-2000:]}"
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("PAYLOAD::"):
+            return json.loads(line[len("PAYLOAD::"):])
+    raise AssertionError(f"no payload in comparator stdout: {r.stdout[-500:]}")
+
+
+def _assert_bit_identity(legs, cycles):
+    packed = json.loads(json.dumps(_scenario_decisions(legs, cycles)))
+    unpacked = _unpacked_payload(legs, cycles)
+    assert packed["pack"] == 1 and unpacked["pack"] == 0
+    assert packed["sdtype"] == unpacked["sdtype"] == "bf16"
+    assert packed["decisions"].keys() == unpacked["decisions"].keys()
+    for key in packed["decisions"]:
+        assert packed["decisions"][key] == unpacked["decisions"][key], (
+            f"packed/unpacked decision divergence on leg {key}"
+        )
+
+
+def test_packed_vs_unpacked_bit_identity_smoke():
+    """Packing is pure LAYOUT: flipping KTPU_PACK_MASKS must not move one
+    decision on any route.  Reduced leg set (each kernel on each mesh,
+    both donate values) — the full matrix is the slow variant below.
+    Two cycles: cycle 0 is the full hoist, cycle 1 the warm word-space
+    patch — enough to pin both paths while keeping tier-1 under its cap
+    (the slow variant churns 3)."""
+    if not bitplane.PACK_MASKS:
+        pytest.skip("suite running with packing disabled via env")
+    _assert_bit_identity(_SMOKE_LEGS, cycles=2)
+
+
+@pytest.mark.slow
+def test_packed_vs_unpacked_bit_identity_full_matrix():
+    """The full {chunked, rounds} x {donate on/off} x {single, mesh8}
+    matrix under warm churn (ISSUE 19 acceptance)."""
+    if not bitplane.PACK_MASKS:
+        pytest.skip("suite running with packing disabled via env")
+    _assert_bit_identity(_FULL_LEGS, cycles=3)
+
+
+# --- landability gates: the storm + the kill, packed plane armed ---
+
+def test_chaos_storm_with_packing_armed(monkeypatch):
+    """Seeded chaos storm through the Scheduler batch path with the packed
+    plane at its default (armed) state: placements bit-identical to the
+    fault-free serial oracle — the chaos parity invariant extended to the
+    packed data plane."""
+    from test_chaos import _churn_run
+
+    assert bitplane.PACK_MASKS, "packed plane must be the default"
+    assert bitplane.SCORE_DTYPE == "bf16"
+    monkeypatch.delenv("KTPU_MESH", raising=False)
+    oracle, _ = _churn_run(pipeline=False)
+    got, sched = _churn_run(
+        pipeline=True,
+        plan=chaos.FaultPlan.from_seed(
+            19, sites=("scheduler.step", "host.stall"), n_faults=4
+        ),
+    )
+    assert got == oracle
+    assert all(v for v in got.values())  # zero lost pods
+
+
+def test_kill_post_assume_crash_restart_with_packing(tmp_path):
+    """kill -9 at post-assume/pre-checkpoint with packing armed: the
+    restarted incarnation replays and finishes bit-identical to the
+    fault-free oracle — resident packed planes are rebuilt, never trusted
+    across the kill."""
+    from test_crash_restart import _run
+
+    assert bitplane.PACK_MASKS, "packed plane must be the default"
+    oracle, _, _ = _run(pipeline=False)
+    got, sched, restarts = _run(
+        chaos.FaultPlan.parse("kill.post_assume:kill@0"), ckpt_dir=tmp_path,
+    )
+    assert restarts >= 1
+    assert got == oracle
+    assert all(v for v in got.values())
+    assert sched.metrics.counters["scheduler_restarts_total"] >= 1
