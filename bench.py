@@ -89,12 +89,19 @@ def main() -> None:
         # at full scale), not the plain scan that would never run on TPU
         # (round-4 verdict weak #3); read at trace time, so setting it
         # before the first jit call suffices.
-        from kubernetes_tpu.bench._cpu import force_cpu_from_env
+        # KTPU_MESH / KTPU_MESH_PODS on the CPU fallback need that many
+        # VIRTUAL host devices, and the flag must precede first backend
+        # use.  meshreq is the ONE import-light kubernetes_tpu module: with
+        # KTPU_COMPILE_CACHE_DIR set, importing almost anything else
+        # (parallel.mesh included) initializes the backend as an import
+        # side effect — before this flag could take hold
+        from kubernetes_tpu.meshreq import (
+            mesh_request_devices,
+            parse_mesh_request,
+        )
 
-        # KTPU_MESH on the CPU fallback needs that many VIRTUAL host
-        # devices, and the flag must precede first backend use
         try:
-            mesh_req = int(os.environ.get("KTPU_MESH", "1") or 1)
+            mesh_req = mesh_request_devices(parse_mesh_request())
         except ValueError:
             mesh_req = 1
         if mesh_req > 1:
@@ -106,6 +113,8 @@ def main() -> None:
                 f"--xla_force_host_platform_device_count={mesh_req}"
             )
             os.environ["XLA_FLAGS"] = " ".join(parts)
+        from kubernetes_tpu.bench._cpu import force_cpu_from_env
+
         force_cpu_from_env(always=True)
         os.environ.setdefault("KTPU_FORCE_CHUNKED", "1")
         platform = "cpu-sim-fallback"
@@ -151,10 +160,15 @@ def main() -> None:
     # KTPU_MESH=<n>: run the routed north-star step node-axis sharded over
     # n chips (parallel/sharded.py); the encoder places resident buffers
     # shard-wise so warm deltas update shards in place
-    from kubernetes_tpu.parallel.mesh import mesh_from_env, shard_hbm_estimate
+    from kubernetes_tpu.parallel.mesh import (
+        mesh_axis_shards,
+        mesh_from_env,
+        shard_hbm_estimate,
+    )
 
     mesh = mesh_from_env()
     n_shards = int(mesh.size) if mesh is not None else 1
+    pod_shards, node_shards = mesh_axis_shards(mesh)
     print(f"platform: {platform}  devices: {jax.devices()}", file=sys.stderr)
     if mesh is not None:
         print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
@@ -343,11 +357,13 @@ def main() -> None:
     # shared with --stream (harness.memwatch_fields); bench sizes
     # per_shard_hbm_bytes exactly from the encoded arr dims below, so the
     # census-derived variant is dropped in favor of it.
-    mem_fields = memwatch_fields(loop, metrics, n_shards)
+    mem_fields = memwatch_fields(loop, metrics, n_shards,
+                                 mesh_shape=(pod_shards, node_shards))
     mem_fields.pop("per_shard_hbm_bytes", None)
     per_shard_hbm = shard_hbm_estimate(
-        arr.P, arr.N, n_shards, arr.R,
+        arr.P, arr.N, node_shards, arr.R,
         n_terms=arr.term_counts0.shape[0],
+        pod_shards=pod_shards,
     )["total"]
     # the PR-4 scale-out numbers as LIVE gauges, not just artifact fields
     # (unconditional — scale-out facts outlive a KTPU_MEMWATCH=0 run):
@@ -416,9 +432,11 @@ def main() -> None:
                 "overlap_fraction": round(overlap_fraction, 3),
                 "donated_waves": int(loop.stats["donated"]),
                 "compile_cache_dir": cache_dir,
-                # mesh scale-out: shard count + the per-shard HBM estimate
-                # of the kernel's dominant blocks at this shape
+                # mesh scale-out: shard count, the 2-D (pods, nodes) grid,
+                # and the per-shard HBM estimate of the kernel's dominant
+                # blocks at this shape
                 "n_shards": n_shards,
+                "mesh_shape": [pod_shards, node_shards],
                 "per_shard_hbm_bytes": per_shard_hbm,
                 # measured HBM telemetry: hbm_peak_bytes /
                 # hbm_resident_bytes + the memwatch sentinel block

@@ -214,22 +214,44 @@ class RouteTrace:
 class RouteSpec:
     kind: str        # chunked | rounds | inc
     donate: bool
-    n_shards: int
+    n_shards: int    # TOTAL device count (pods x nodes on a 2-D mesh)
+    # 2-D pods x nodes mesh shape; None = 1-D node-only mesh (or single)
+    mesh_shape: Optional[Tuple[int, int]] = None
 
     @property
     def name(self) -> str:
-        return (f"{self.kind}/{'donate' if self.donate else 'nodonate'}/"
-                f"{'mesh%d' % self.n_shards if self.n_shards > 1 else 'single'}")
+        if self.mesh_shape is not None:
+            tag = f"mesh{self.mesh_shape[0]}x{self.mesh_shape[1]}"
+        elif self.n_shards > 1:
+            tag = f"mesh{self.n_shards}"
+        else:
+            tag = "single"
+        return f"{self.kind}/{'donate' if self.donate else 'nodonate'}/{tag}"
+
+    @property
+    def axis_shards(self) -> Tuple[int, int]:
+        """(pod_shards, node_shards) this route runs at."""
+        if self.mesh_shape is not None:
+            return (int(self.mesh_shape[0]), int(self.mesh_shape[1]))
+        return (1, max(1, self.n_shards))
 
 
 def enumerate_routes(mesh_size: int = 8) -> List[RouteSpec]:
     """The production route matrix: {chunked, rounds, inc} x {donate
-    on/off} x {single-device, mesh}."""
+    on/off} x {single-device, 1-D node mesh, 2-D pods x nodes mesh} —
+    eighteen routes.  The 2-D shape folds the same device count as the 1-D
+    mesh (pods x nodes = mesh_size) so both shard layers trace on the same
+    virtual platform."""
+    shape_2d = (2, mesh_size // 2) if mesh_size >= 4 else None
+    meshes: List[Tuple[int, Optional[Tuple[int, int]]]] = [
+        (1, None), (mesh_size, None)]
+    if shape_2d is not None:
+        meshes.append((mesh_size, shape_2d))
     return [
-        RouteSpec(kind, donate, ns)
+        RouteSpec(kind, donate, ns, shape)
         for kind in ("chunked", "rounds", "inc")
         for donate in (False, True)
-        for ns in (1, mesh_size)
+        for ns, shape in meshes
     ]
 
 
@@ -301,20 +323,27 @@ def _out_sharding_report(compiled, mesh, declared, out_ndims) -> Optional[list]:
     return report
 
 
-def _shard_field_report(arr, inc, image_sharded: bool) -> list:
+def _shard_field_report(arr, inc, image_sharded: bool,
+                        pod_sharded: bool = False) -> list:
     """Per resident buffer: qualname, concrete shape, itemsize, resolved
     spec (through the partition rule table), dims symbols — what KTPU015
-    (replicated-giant) and KTPU016 (axis-consistency) check per route."""
+    (replicated-giant) and KTPU016 (axis-consistency) check per route.
+    Specs are the EFFECTIVE per-route placements: on a 1-D node mesh the
+    table's pods-axis rows strip to replicated (what the devices actually
+    hold), so KTPU015's replicated-on-every-route pass sees the truth per
+    mesh shape rather than the table's 2-D declaration."""
     import dataclasses as _dc
 
     import numpy as np
 
     from ..parallel.partition_rules import (
-        FIELD_DIMS, clusterarrays_specs, spec_for,
+        FIELD_DIMS, MESH_AXES, NODE_AXIS, clusterarrays_specs, spec_for,
+        strip_spec,
     )
 
+    keep = MESH_AXES if pod_sharded else (NODE_AXIS,)
     out = []
-    specs = clusterarrays_specs(image_sharded)
+    specs = clusterarrays_specs(image_sharded, pod_sharded=pod_sharded)
     missing = [
         f"arr.{f.name}" for f in _dc.fields(type(arr))
         if f"arr.{f.name}" not in FIELD_DIMS
@@ -353,7 +382,7 @@ def _shard_field_report(arr, inc, image_sharded: bool) -> list:
                 "qualname": q,
                 "shape": tuple(int(s) for s in v.shape),
                 "itemsize": int(v.dtype.itemsize),
-                "spec": tuple(spec_for(q)),
+                "spec": tuple(strip_spec(spec_for(q), keep)),
                 "dims": FIELD_DIMS[q][0],
             })
     return out
@@ -486,7 +515,13 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
                          f"(have {len(jax.devices())})")
         return t
 
-    mesh = make_mesh(spec.n_shards) if spec.n_shards > 1 else None
+    if spec.mesh_shape is not None:
+        mesh = make_mesh(shape=spec.mesh_shape)
+    elif spec.n_shards > 1:
+        mesh = make_mesh(spec.n_shards)
+    else:
+        mesh = None
+    pod_shards, node_shards = spec.axis_shards
     snap = _route_snapshot(spec.kind)
     enc = DeltaEncoder()
     cache = HoistCache(mesh=mesh) if spec.kind == "inc" else None
@@ -556,24 +591,25 @@ def trace_route(spec: RouteSpec) -> RouteTrace:
              "rounds": A._RCHUNK}[spec.kind]
     u1 = int(inc.req_u.shape[0]) if inc is not None else None
     t.est = shard_hbm_estimate(
-        arr.P, arr.N, spec.n_shards, n_res=arr.R,
+        arr.P, arr.N, node_shards, n_res=arr.R,
         n_terms=arr.term_counts0.shape[0], chunk=chunk,
-        u_classes=u1,
+        u_classes=u1, pod_shards=pod_shards,
     )
     # ---- shard-pass capture: resident-buffer report + comm budget ----
     from ..parallel.mesh import shard_comm_estimate
 
     img = arr.image_score.shape[1] == arr.N
-    t.shard_fields = _shard_field_report(arr, inc, img)
+    t.shard_fields = _shard_field_report(arr, inc, img,
+                                         pod_sharded=pod_shards > 1)
     t.mesh_axes = (
         {str(k): int(v) for k, v in mesh.shape.items()}
         if mesh is not None else {}
     )
     if mesh is not None:
         t.comm_est = shard_comm_estimate(
-            arr.P, arr.N, spec.n_shards, n_res=arr.R,
+            arr.P, arr.N, node_shards, n_res=arr.R,
             n_terms=arr.term_counts0.shape[0], chunk=chunk,
-            u_classes=u1, kind=spec.kind,
+            u_classes=u1, kind=spec.kind, pod_shards=pod_shards,
         )
     t.workload = {
         "P": int(arr.P), "N": int(arr.N), "R": int(arr.R),

@@ -242,14 +242,17 @@ class CollectiveSequenceRule(DeviceRule):
     collective subsequences (the first shard-divergent predicate deadlocks
     the mesh); (c) every trace of the same (kind, n_shards) group — donate
     on/off — must carry the IDENTICAL ordered sequence (a sequence that
-    moves under a donation flag is trace-order nondeterminism)."""
+    moves under a donation flag is trace-order nondeterminism).  Groups key
+    on the MESH SHAPE, not just the device count: a 1-D mesh8 and a 2-D
+    2x4 mesh both hold 8 devices but legitimately lower different sequences
+    (the 2-D route prepends the pod-axis entry gathers)."""
 
     rule_id = "KTPU009"
     title = "collective-sequence: identical ordered collectives per shard"
 
     def check(self, traces: Sequence) -> List[Finding]:
         findings: List[Finding] = []
-        groups: Dict[Tuple[str, int], List] = {}
+        groups: Dict[Tuple, List] = {}
         for t in traces:
             if t.n_shards <= 1 or t.jaxpr is None:
                 continue
@@ -268,17 +271,20 @@ class CollectiveSequenceRule(DeviceRule):
                     "the mesh",
                     f"cond:{desc}",
                 ))
-            groups.setdefault((t.kind, t.n_shards), []).append(t)
-        for (kind, ns), grp in groups.items():
+            shape = tuple(sorted(getattr(t, "mesh_axes", {}).items())) \
+                or (("n_shards", t.n_shards),)
+            groups.setdefault((t.kind, shape), []).append(t)
+        for (kind, shape), grp in groups.items():
             seqs = {tuple(t.collectives) for t in grp}
             if len(seqs) > 1:
+                tag = "x".join(str(v) for _k, v in shape)
                 findings.append(_finding(
                     grp[0], self.rule_id,
-                    f"route group ({kind}, mesh{ns}) traced "
+                    f"route group ({kind}, mesh {dict(shape)}) traced "
                     f"{len(seqs)} distinct collective sequences across "
                     "donate variants — the program order is not a pure "
                     "function of the route",
-                    f"group-divergence:{kind}:{ns}",
+                    f"group-divergence:{kind}:{tag}",
                 ))
         return findings
 

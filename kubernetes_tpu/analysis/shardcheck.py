@@ -180,60 +180,74 @@ def _scaled_bytes(entry: Dict) -> int:
 
 class ReplicatedGiantRule(ShardTraceRule):
     """KTPU015 — the exact ROADMAP-3a gap, as a gate: any resident buffer
-    (arr.* / inc.*) a mesh route carries FULLY REPLICATED whose dims scale
-    with P, N, or U, above REPLICATED_GIANT_BYTES at the target dims.
-    Deduped per field across routes; legitimately-replicated-for-now
-    buffers carry REQUIRED-reason baseline entries naming the 2-D mesh
-    follow-up, so the debt is enumerated, visible, and burnable."""
+    (arr.* / inc.*) carried FULLY REPLICATED on EVERY multi-shard route
+    whose dims scale with P, N, or U, above REPLICATED_GIANT_BYTES at the
+    target dims.  TWO-PASS across the route matrix: a field the 2-D
+    pods x nodes routes shard is paid-down debt even though the 1-D node
+    mesh (correctly, by stripping) still replicates it — only a field no
+    mesh shape anywhere shards is a finding.  Deduped per field;
+    legitimately-replicated-for-now buffers carry REQUIRED-reason baseline
+    entries naming the follow-up, so the debt is enumerated, visible, and
+    burnable."""
 
     rule_id = "KTPU015"
     title = "replicated-giant: no P/N/U-scaling buffer left fully replicated"
 
     def check(self, traces: Sequence) -> List[Finding]:
-        from ..parallel.partition_rules import NODE_AXIS, SCALE_SYMBOLS
+        from ..parallel.partition_rules import SCALE_SYMBOLS
 
-        findings: List[Finding] = []
-        seen: Set[str] = set()
+        # pass 1: which qualnames does ANY multi-shard route shard?
+        sharded_somewhere: Set[str] = set()
+        candidates: Dict[str, Dict] = {}
         for t in traces:
             if t.n_shards <= 1:
                 continue
+            axes = set(getattr(t, "mesh_axes", {}) or ())
             for entry in t.shard_fields:
                 q = entry["qualname"]
-                if q in seen:
-                    continue
                 spec = tuple(entry["spec"])
-                if NODE_AXIS in spec:
-                    continue  # sharded — not replicated debt
-                scaling = [s for s in entry["dims"] if s in SCALE_SYMBOLS]
-                if not scaling:
-                    continue  # vocabulary-axis table, bounded by design
-                size = _scaled_bytes(entry)
-                if size <= REPLICATED_GIANT_BYTES:
-                    continue
-                seen.add(q)
-                findings.append(_field_finding(
-                    self.rule_id, q,
-                    f"{q} ({'x'.join(entry['dims'])}) is fully replicated "
-                    f"across the mesh at ~{size // (1 << 20)} MiB per shard "
-                    "(ROADMAP-3 target dims) — shard it or baseline it "
-                    "with the follow-up that will",
-                    f"replicated-giant:{q}:{'x'.join(entry['dims'])}",
-                ))
+                if any(ax is not None and (not axes or ax in axes)
+                       for ax in spec):
+                    sharded_somewhere.add(q)
+                else:
+                    candidates.setdefault(q, entry)
+        # pass 2: flag only replicated-EVERYWHERE scaling giants
+        findings: List[Finding] = []
+        for q, entry in sorted(candidates.items()):
+            if q in sharded_somewhere:
+                continue
+            scaling = [s for s in entry["dims"] if s in SCALE_SYMBOLS]
+            if not scaling:
+                continue  # vocabulary-axis table, bounded by design
+            size = _scaled_bytes(entry)
+            if size <= REPLICATED_GIANT_BYTES:
+                continue
+            findings.append(_field_finding(
+                self.rule_id, q,
+                f"{q} ({'x'.join(entry['dims'])}) is fully replicated "
+                f"on every mesh shape at ~{size // (1 << 20)} MiB per "
+                "shard (ROADMAP-3 target dims) — shard it or baseline it "
+                "with the follow-up that will",
+                f"replicated-giant:{q}:{'x'.join(entry['dims'])}",
+            ))
         return findings
 
 
 class AxisConsistencyRule(ShardTraceRule):
-    """KTPU016 — the spec/mesh/shape contract, per traced route: (a) every
-    axis a spec names exists in the mesh; (b) the node axis shards exactly
-    the node-scaling dimension (a spec placing "nodes" on a vocabulary dim
-    is a silent wrong-axis reshard); (c) the sharded dimension divides the
-    axis size (padding must have happened before placement)."""
+    """KTPU016 — the spec/mesh/shape contract, per traced route and PER
+    MESH AXIS (the axis universe is partition_rules.AXIS_SCALE — nodes->N,
+    pods->P — so the 2-D mesh's pod rows get the same three gates the node
+    rows always had): (a) every axis a spec names exists in the mesh;
+    (b) each mesh axis shards exactly its scaling dimension (a spec placing
+    "nodes" on a vocabulary dim — or "pods" on a node dim — is a silent
+    wrong-axis reshard); (c) the sharded dimension divides the axis size
+    (padding must have happened before placement)."""
 
     rule_id = "KTPU016"
-    title = "axis-consistency: spec axes exist, map to N, and divide"
+    title = "axis-consistency: spec axes exist, map to their dim, and divide"
 
     def check(self, traces: Sequence) -> List[Finding]:
-        from ..parallel.partition_rules import NODE_AXIS
+        from ..parallel.partition_rules import AXIS_SCALE
 
         findings: List[Finding] = []
         seen: Set[str] = set()
@@ -263,24 +277,26 @@ class AxisConsistencyRule(ShardTraceRule):
                             "placement silently replicates",
                             f"unknown-axis:{q}:{axis}",
                         ))
-                if NODE_AXIS in spec:
-                    k = spec.index(NODE_AXIS)
-                    if k < len(dims) and dims[k] != "N" \
-                            and once(f"map:{q}"):
+                for mesh_axis, scale_sym in AXIS_SCALE.items():
+                    if mesh_axis not in spec:
+                        continue
+                    k = spec.index(mesh_axis)
+                    if k < len(dims) and dims[k] != scale_sym \
+                            and once(f"map:{q}:{mesh_axis}"):
                         findings.append(_route_finding(
                             t, self.rule_id,
-                            f"{q}: the node axis shards dim {k} "
-                            f"({dims[k]!r}), not the node-scaling "
+                            f"{q}: the {mesh_axis} axis shards dim {k} "
+                            f"({dims[k]!r}), not the {scale_sym}-scaling "
                             "dimension — wrong-axis sharding",
-                            f"node-axis-mismap:{q}:{k}",
+                            f"{mesh_axis}-axis-mismap:{q}:{k}",
                         ))
-                    n_ax = t.mesh_axes.get(NODE_AXIS, t.n_shards)
+                    n_ax = t.mesh_axes.get(mesh_axis, t.n_shards)
                     if k < len(shape) and shape[k] % max(1, n_ax) \
-                            and once(f"div:{q}"):
+                            and once(f"div:{q}:{mesh_axis}"):
                         findings.append(_route_finding(
                             t, self.rule_id,
                             f"{q}: sharded dim {k} (size {shape[k]}) does "
-                            f"not divide the {NODE_AXIS} axis size {n_ax} "
+                            f"not divide the {mesh_axis} axis size {n_ax} "
                             "— the route ran unpadded",
                             f"indivisible:{q}:{shape[k]}%{n_ax}",
                         ))

@@ -899,17 +899,21 @@ class DeltaEncoder:
             self._pad_memo.clear()
             self._unpack_jits.clear()
 
-    def _pad_for_mesh(self, name: str, a, pad: int, d_sentinel: int, n: int):
-        """Per-field node-axis padding (the one shared rule set —
-        parallel/mesh.py pad_field), memoized by input-array identity so
-        unchanged fields keep one stable padded object across cycles (the
-        resident-buffer identity check depends on it)."""
-        from ..parallel.mesh import pad_field
+    def _pad_for_mesh(self, name: str, a, pad: int, d_sentinel: int, n: int,
+                      pod_pad: int = 0):
+        """Per-field node- and pod-axis padding (the one shared rule set —
+        parallel/mesh.py pad_field / pad_pod_field), memoized by input-array
+        identity so unchanged fields keep one stable padded object across
+        cycles (the resident-buffer identity check depends on it).  Padding
+        host-side here makes the routed entry's pad_nodes/pad_pods no-ops,
+        so the device-resident buffers are never re-padded mid-flight."""
+        from ..parallel.mesh import pad_field, pad_pod_field
 
         memo = self._pad_memo.get(name)
         if memo is not None and memo[0] is a:
             return memo[1]
-        p = pad_field(name, a, pad, d_sentinel, n)
+        p = pad_pod_field(name, a, pod_pad) if pod_pad else a
+        p = pad_field(name, p, pad, d_sentinel, n) if pad else p
         if p is a:
             return a
         self._pad_memo[name] = (a, p)
@@ -950,11 +954,12 @@ class DeltaEncoder:
 
         mesh = self._mesh
         if mesh is not None:
-            from ..parallel.mesh import NODE_AXIS
+            from ..parallel.mesh import mesh_axis_shards
             from ..parallel.sharded import field_shardings
 
-            n_shards = int(mesh.shape[NODE_AXIS])
+            pod_shards, n_shards = mesh_axis_shards(mesh)
             pad = (-arr.N) % n_shards
+            pod_pad = (-arr.P) % pod_shards
             d_sentinel = arr.term_counts0.shape[1] - 1
             sh = field_shardings(mesh, arr.image_score.shape[1] == arr.N)
             n = arr.N
@@ -962,8 +967,10 @@ class DeltaEncoder:
         for f in _dc.fields(type(arr)):
             a = getattr(arr, f.name)
             s = sh[f.name] if mesh is not None else None
-            if mesh is not None and pad:
-                a = self._pad_for_mesh(f.name, a, pad, d_sentinel, n)
+            if mesh is not None and (pad or pod_pad):
+                a = self._pad_for_mesh(
+                    f.name, a, pad, d_sentinel, n, pod_pad=pod_pad
+                )
             if (
                 bitplane.PACK_MASKS
                 and isinstance(a, np.ndarray)

@@ -27,7 +27,11 @@ Usage:
       --candidates 32:48:12:256,32:64:14:256 --tuning-dir /path/cache
   python -m kubernetes_tpu.bench.autotune probe --nodes 500 --pods 2048
 
-Candidate syntax: INC_CHUNK:WAVE_BLOCK:WAVE_ITERS:WAVE_K (ints).  The
+Candidate syntax: INC_CHUNK:WAVE_BLOCK:WAVE_ITERS:WAVE_K:PACK:DTYPE:MESH
+(ints except DTYPE = "bf16" | "f32"; MESH = KTPU_MESH_PODS pod-shard
+count, 1 = legacy 1-D).  Shorter legacy candidates (the 4-field
+pre-packing or 6-field pre-mesh forms) fill the missing tail with
+defaults.  The
 `probe` subcommand is the internal per-candidate child; it prints one
 JSON line with the RESOLVED knob values (proving the env > winner >
 default resolution the CI smoke asserts on), the measured harness
@@ -51,14 +55,20 @@ from ..ops.tuning import TUNABLE_KNOBS
 # are trace-time constants, both change only perf (decisions stay
 # bit-identical to the oracle on every setting — tests/test_packed_masks.py),
 # so a measured winner is safe to persist exactly like the shape knobs.
+# The trailing MESH field is the 2-D pod-shard count (KTPU_MESH_PODS, 1 =
+# the legacy 1-D mesh): decisions are bit-identical on every mesh shape
+# (tests/test_sharded_routed.py), so a measured mesh winner persists under
+# the same safety argument.
 _FIELDS = ("KTPU_INC_CHUNK", "KTPU_WAVE_BLOCK", "KTPU_WAVE_ITERS",
-           "KTPU_WAVE_K", "KTPU_PACK_MASKS", "KTPU_SCORE_DTYPE")
-# defaults appended when a candidate uses the legacy 4-field syntax
-_FIELD_DEFAULTS = ("1", "bf16")
+           "KTPU_WAVE_K", "KTPU_PACK_MASKS", "KTPU_SCORE_DTYPE",
+           "KTPU_MESH_PODS")
+# defaults appended when a candidate uses a legacy shorter syntax (the
+# 4-field pre-packing form or the 6-field pre-mesh form)
+_FIELD_DEFAULTS = ("1", "bf16", "1")
 
 DEFAULT_CANDIDATES = (
-    "32:48:12:256:1:bf16,32:64:14:256:1:bf16,32:32:6:256:1:bf16,"
-    "64:48:12:512:1:bf16,32:48:12:256:0:f32"
+    "32:48:12:256:1:bf16:1,32:64:14:256:1:bf16:1,32:32:6:256:1:bf16:1,"
+    "64:48:12:512:1:bf16:1,32:48:12:256:0:f32:1"
 )
 
 
@@ -75,15 +85,17 @@ def parse_candidates(spec: str) -> List[Dict[str, Any]]:
         if not tok:
             continue
         parts = tok.split(":")
-        if len(parts) == len(_FIELDS) - len(_FIELD_DEFAULTS):
-            # legacy 4-field candidates keep working (scripts predating the
-            # packed-plane knobs): packing/bf16 ride at their defaults
-            parts = parts + list(_FIELD_DEFAULTS)
+        n_required = len(_FIELDS) - len(_FIELD_DEFAULTS)
+        if n_required <= len(parts) < len(_FIELDS):
+            # legacy shorter candidates keep working (scripts predating the
+            # packed-plane knobs or the mesh field): the missing tail rides
+            # at its defaults
+            parts = parts + list(_FIELD_DEFAULTS[len(parts) - n_required:])
         if len(parts) != len(_FIELDS):
             raise SystemExit(
                 f"autotune: candidate {tok!r} needs "
                 f"{len(_FIELDS)} fields {':'.join(_FIELDS)} "
-                f"(or the legacy first {len(_FIELDS) - len(_FIELD_DEFAULTS)})"
+                f"(or a legacy prefix of at least {n_required})"
             )
         out.append({
             f: _field_value(f, p) for f, p in zip(_FIELDS, parts)
@@ -109,6 +121,7 @@ def run_probe(args) -> None:
     from .workloads import heterogeneous
 
     from ..ops import bitplane
+    from ..ops.tuning import tuned_knob
 
     snap = heterogeneous(args.nodes, args.pods, seed=args.seed)
     resolved = {
@@ -120,6 +133,10 @@ def run_probe(args) -> None:
         # (env > persisted winner > default — the CI smoke asserts these)
         "KTPU_PACK_MASKS": int(bitplane.PACK_MASKS),
         "KTPU_SCORE_DTYPE": bitplane.SCORE_DTYPE,
+        # the 2-D mesh knob (env > persisted winner > default): probes run
+        # single-device so the shipped candidates pin 1, but a sweep on a
+        # multi-chip box may carry >1 and the winner persists like any knob
+        "KTPU_MESH_PODS": int(tuned_knob("KTPU_MESH_PODS", 1) or 1),
     }
 
     # measured half: the real runtime loop (includes compile on the first

@@ -30,7 +30,7 @@ import os
 import sys
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api.snapshot import Snapshot
 from ..scheduler import ClusterStore, Scheduler, SchedulerConfiguration
@@ -318,20 +318,29 @@ def queue_fields(metrics) -> Dict:
     return {"queue_depths": out or None}
 
 
-def memwatch_fields(loop, metrics, n_shards: int) -> Dict:
+def memwatch_fields(loop, metrics, n_shards: int,
+                    mesh_shape: Optional[Tuple[int, int]] = None) -> Dict:
     """The HBM telemetry artifact block (scheduler/memwatch.py): the
     loop's ledger summary — `hbm_peak_bytes` / `hbm_resident_bytes`
     stamped top-level so `bench.regression --metric hbm_peak_bytes` gates
     the measured HBM trajectory like step time — plus the PR-4 scale-out
     numbers as LIVE gauges (`n_shards`, `per_shard_hbm_bytes`), so a
-    /metrics scrape sees the same story the artifact tells.  Empty when
+    /metrics scrape sees the same story the artifact tells.  `mesh_shape`
+    is the 2-D (pod_shards, node_shards) grid; when None it is taken from
+    the ledger's own mesh so 1-D callers need no change.  Empty when
     KTPU_MEMWATCH=0 disabled the ledger."""
     mw = getattr(loop, "memwatch", None)
     if mw is None:
         return {}
     fields = mw.summary()
+    if mesh_shape is None:
+        mesh_shape = (getattr(mw, "pod_shards", 1),
+                      getattr(mw, "node_shards", n_shards))
+    fields["mesh_shape"] = [int(mesh_shape[0]), int(mesh_shape[1])]
     if metrics is not None:
         metrics.set("n_shards", n_shards)
+        metrics.set("mesh_pod_shards", int(mesh_shape[0]))
+        metrics.set("mesh_node_shards", int(mesh_shape[1]))
     est = mw.per_shard_hbm_estimate()
     if est is not None:
         fields["per_shard_hbm_bytes"] = est

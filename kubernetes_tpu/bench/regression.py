@@ -4,8 +4,11 @@ Loads the repo's BENCH_r*.json artifacts (both shapes: the driver wrapper
 {"n":…, "parsed": {…}} and the bare bench.py JSON line), normalizes
 per-box — runs are only comparable WITHIN one platform (a real TPU v5 run
 and the cpu-sim fallback differ by 20-40×, so cross-box deltas are noise,
-not regressions) — and exits nonzero when the newest run regressed more
-than --threshold against the BEST prior same-box run.
+not regressions) AND one shard topology (an 8-device sim run timeshares
+one core, and per-shard metrics are divided by the grid — `n_shards`
+joins the comparability key; pre-mesh artifacts normalize to 1) — and
+exits nonzero when the newest run regressed more than --threshold
+against the BEST prior same-box run.
 
 Exit codes:
   0  pass (improved, within threshold, or no comparable prior run)
@@ -126,9 +129,9 @@ def check_regression(
     higher_is_better: bool = False,
     threshold: float = 0.1,
 ) -> Dict:
-    """The gate: compare `current` against the best PRIOR same-platform run
-    on `metric` (same latency_mode too, for latency metrics — see
-    LATENCY_METRICS).  Returns a machine-readable verdict dict with
+    """The gate: compare `current` against the best PRIOR same-platform,
+    same-n_shards run on `metric` (same latency_mode too, for latency
+    metrics — see LATENCY_METRICS).  Returns a machine-readable verdict dict with
     `status` in {"pass", "regression", "error"}."""
     cur_name, cur = current
     cur_v = _metric(cur, metric)
@@ -139,6 +142,13 @@ def check_regression(
             "current": cur_name,
         }
     platform = cur.get("platform", "unknown")
+    # shard topology is part of the box identity: on the cpu-sim fallback an
+    # 8-device run timeshares one core (wall clocks ~8x a 1-device run of
+    # the same kernel), and per-shard quantities (per_shard_hbm_bytes,
+    # comm_bytes) are divided by the grid — so cross-topology deltas are
+    # configuration changes, not regressions, in BOTH directions.  Artifacts
+    # that predate the n_shards stamp were all single-device.
+    cur_shards = int(cur.get("n_shards") or 1)
     guard_mode = metric.split(".")[-1] in LATENCY_METRICS
     latency_mode = cur.get("latency_mode")
     prior: List[Tuple[str, float]] = []
@@ -148,6 +158,11 @@ def check_regression(
             continue
         if rec.get("platform", "unknown") != platform:
             skipped.append(f"{name} (platform {rec.get('platform', 'unknown')!r})")
+            continue
+        if int(rec.get("n_shards") or 1) != cur_shards:
+            skipped.append(
+                f"{name} (n_shards {int(rec.get('n_shards') or 1)} != "
+                f"{cur_shards})")
             continue
         if guard_mode and rec.get("latency_mode") != latency_mode:
             skipped.append(

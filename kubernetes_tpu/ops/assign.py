@@ -111,6 +111,45 @@ def _global_top_k(vals, k, axis_name, base):
     return mv, jnp.take_along_axis(ai, mp, axis=1)
 
 
+def pod_unshard(arr: ClusterArrays, inc=None, axis_name: str = "pods"):
+    """Entry stage of every kernel on a 2-D pods x nodes mesh: stitch the
+    pod-shard-local resident blocks back to full pod extent with ONE tiled
+    all_gather per pod-sharded field (axis positions from the rule table —
+    parallel/partition_rules.pod_axis_fields), then the existing kernels run
+    verbatim with their node-axis collectives.
+
+    Residency is where the 2-D win lives (the KTPU015 replicated-giant set
+    shards at rest and over the wire on placement); the gathered copies are
+    program transients, priced honestly by shard_hbm_estimate's
+    ``pod_gather`` term.  The gathers are UNCONDITIONAL and first — before
+    any cond/scan — so the per-shard collective sequence stays a pure
+    function of the route (KTPU009) and bit-identity vs the serial oracle
+    is by construction: every pod-row of the mesh computes the identical
+    full-pod program on identical node shards.
+
+    Returns (arr, inc) with full pod axes; ``inc`` (ops/incremental.py)
+    gathers only its pod-aligned ``cls`` vector — the [U, *] class matrices
+    are class-aligned and never pod-sharded."""
+    import dataclasses
+
+    from ..parallel.partition_rules import pod_axis_fields
+
+    fields = dict(pod_axis_fields())
+    fields["image_score"] = (0, 0)  # both [P, N] and [P, 1] forms
+    repl = {
+        name: lax.all_gather(
+            getattr(arr, name), axis_name, axis=axis, tiled=True
+        )
+        for name, (axis, _fill) in sorted(fields.items())
+    }
+    arr = dataclasses.replace(arr, **repl)
+    if inc is not None:
+        inc = inc._replace(
+            cls=lax.all_gather(inc.cls, axis_name, axis=0, tiled=True)
+        )
+    return arr, inc
+
+
 def _preferred_node_affinity_raw(arr: ClusterArrays, term_matches: jax.Array) -> jax.Array:
     """[P, N] summed weights of matching preferred node-affinity terms
     (nodeaffinity/node_affinity.go — Score).  One [P, S] @ [S, N] matmul in

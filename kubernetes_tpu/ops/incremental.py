@@ -336,12 +336,12 @@ class HoistCache:
 
         return sharding_for(self.mesh, "inc.stat_u")
 
-    def _rep_sharding(self):
+    def _rep_sharding(self, qualname: str = "inc.req_u"):
         if self.mesh is None:
             return None
         from ..parallel.partition_rules import sharding_for
 
-        return sharding_for(self.mesh, "inc.cls")
+        return sharding_for(self.mesh, qualname)
 
     def _place_node(self, a):
         if a is None:
@@ -360,10 +360,13 @@ class HoistCache:
 
         return jax.device_put(a, sharding_for(self.mesh, "arr.node_used"))
 
-    def _place_rep(self, name: str, host: np.ndarray):
-        """Replicated device copy memoized by host identity/value (the
-        class index and per-class requests are identity-stable across
-        steady-state waves via the encoder's pad caches)."""
+    def _place_rep(self, name: str, host: np.ndarray,
+                   qualname: str = "inc.req_u"):
+        """Device copy memoized by host identity/value (the class index and
+        per-class requests are identity-stable across steady-state waves via
+        the encoder's pad caches), placed through the named table row —
+        `inc.cls` shards over the pods axis on a 2-D mesh; `inc.req_u`
+        stays replicated."""
         ent = getattr(self, name)
         if ent is not None and (
             ent[0] is host
@@ -374,7 +377,7 @@ class HoistCache:
             )
         ):
             return ent[1]
-        sh = self._rep_sharding()
+        sh = self._rep_sharding(qualname)
         d = jax.device_put(host, sh) if sh is not None else jax.device_put(host)
         setattr(self, name, (host, d))
         return d
@@ -447,11 +450,11 @@ class HoistCache:
             self._note("skipped_degenerate", u1, 1.0, 0, t0, n_nodes=arr.N)
             return None
         if self.mesh is not None:
-            from ..parallel.mesh import NODE_AXIS
+            from ..parallel.mesh import mesh_axis_shards
 
-            n_shards = int(self.mesh.shape[NODE_AXIS])
+            pod_shards, n_shards = mesh_axis_shards(self.mesh)
         else:
-            n_shards = 1
+            pod_shards, n_shards = 1, 1
         pad = (-arr.N) % n_shards
         np_nodes = arr.N + pad
         n_real = getattr(meta, "n_nodes", 0) or arr.N
@@ -555,7 +558,15 @@ class HoistCache:
         self._req_u_host = req_u
         self._prev_used = used_h
 
-        cls_dev = self._place_rep("_cls_ent", pc)
+        # the class index pod-pads with the SAME rule the routed entry
+        # applies to the wave (parallel/mesh.py — pad_pods, fill 0): padded
+        # pods are pod_valid=False so their class-0 gathers never commit,
+        # and inc_applicable's cls.shape[0] == arr.P gate holds against the
+        # padded wave.  Sharded over the pods axis on a 2-D mesh (table row
+        # inc.cls) — the last whole-P i32 resident replica is gone.
+        pod_pad = (-int(pc.shape[0])) % pod_shards
+        cls_h = pc if not pod_pad else np.pad(pc, (0, pod_pad))
+        cls_dev = self._place_rep("_cls_ent", cls_h, "inc.cls")
         stat, elig, traw, naraw, img = self._statics
         self._note(action, u1, frac, ncols, t0, n_nodes=n_real)
         return IncState(

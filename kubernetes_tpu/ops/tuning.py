@@ -37,7 +37,7 @@ from typing import Any, Dict, Optional
 # can never inject an unknown trace-time constant)
 TUNABLE_KNOBS = (
     "KTPU_INC_CHUNK", "KTPU_WAVE_K", "KTPU_WAVE_BLOCK", "KTPU_WAVE_ITERS",
-    "KTPU_PACK_MASKS", "KTPU_SCORE_DTYPE",
+    "KTPU_PACK_MASKS", "KTPU_SCORE_DTYPE", "KTPU_MESH_PODS",
 )
 
 # per-knob value type: every knob is an int unless listed here

@@ -16,9 +16,14 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh
 
-# the mesh axis name + rule table live in partition_rules (the single
+# the mesh axis names + rule table live in partition_rules (the single
 # source of sharding truth); re-exported here for the existing import sites
-from .partition_rules import NODE_AXIS, node_axis_fields  # noqa: F401
+from .partition_rules import (  # noqa: F401
+    NODE_AXIS,
+    PODS_AXIS,
+    node_axis_fields,
+    pod_axis_fields,
+)
 
 # jax moved shard_map out of experimental around 0.5; alias whichever this
 # runtime has so the sharded paths work on both (the seed's bare
@@ -40,42 +45,86 @@ except AttributeError:  # jax <= 0.4.x
 # only when it is a real [P, N] matrix.
 NODE_AXIS_FIELDS: Dict[str, Tuple[int, object]] = node_axis_fields()
 
+# ClusterArrays fields carrying the POD axis, with (axis, fill 0) — the 2-D
+# mesh's second padding plane (pad_pods below; padded pods have pod_valid
+# False, which gates them out of every stage).
+POD_AXIS_FIELDS: Dict[str, Tuple[int, object]] = pod_axis_fields()
 
-def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+
+def make_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None,
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """1-D node-axis mesh by default; ``shape=(pods, nodes)`` builds the
+    2-D pods x nodes mesh over the first pods*nodes devices.  A 1-D mesh
+    deliberately carries NO pods axis, so sharding_for() strips the pod
+    rows and every pre-2-D call site behaves exactly as before."""
     devs = list(devices) if devices is not None else jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
     import numpy as np
 
+    if shape is not None:
+        p, n = int(shape[0]), int(shape[1])
+        if p <= 1:
+            # a degenerate pods dimension is just the 1-D nodes mesh
+            return make_mesh(n_devices=n, devices=devs)
+        if len(devs) < p * n:
+            raise ValueError(
+                f"mesh shape {p}x{n} needs {p * n} devices; "
+                f"only {len(devs)} available"
+            )
+        grid = np.array(devs[: p * n]).reshape(p, n)
+        return Mesh(grid, (PODS_AXIS, NODE_AXIS))
+    if n_devices is not None:
+        devs = devs[:n_devices]
     return Mesh(np.array(devs), (NODE_AXIS,))
+
+
+# the request grammar lives in the import-light kubernetes_tpu.meshreq
+# (bench.py parses it pre-backend); re-exported here for existing call sites
+from ..meshreq import (  # noqa: F401,E402
+    mesh_request_devices,
+    parse_mesh_request,
+)
 
 
 def mesh_from_env(raw: Optional[str] = None, source: str = "KTPU_MESH") -> Optional[Mesh]:
     """KTPU_MESH=<n>: build the node-axis mesh over the first n local
-    devices.  Unset / 1 / 0 -> None (the single-device path).  Invalid
-    values raise a clear ValueError instead of silently running
-    single-device; a request beyond the available device count CLAMPS with
-    a warning, so one deployment config serves hosts of different sizes.
-    The one validated entry for EVERY mesh-count request — config-sourced
-    counts (TPUScoreArgs.meshDevices) resolve through it too, with `source`
-    naming the knob in errors/warnings."""
-    if raw is None:
-        raw = os.environ.get("KTPU_MESH", "")
-    raw = raw.strip()
-    if not raw:
+    devices; KTPU_MESH=<p>x<n> (or the KTPU_MESH_PODS / KTPU_MESH_NODES
+    pair) the 2-D pods x nodes mesh.  Unset / 1 / 0 -> None (the
+    single-device path).  Invalid values raise a clear ValueError instead
+    of silently running single-device; a 1-D request beyond the available
+    device count CLAMPS with a warning, so one deployment config serves
+    hosts of different sizes — a 2-D shape RAISES instead (there is no
+    unambiguous way to shrink a grid).  The one validated entry for EVERY
+    mesh-count request — config-sourced counts (TPUScoreArgs.meshDevices)
+    resolve through it too, with `source` naming the knob in
+    errors/warnings."""
+    req = parse_mesh_request(raw, source=source)
+    if req is None:
         return None
-    try:
-        n = int(raw)
-    except ValueError:
-        raise ValueError(
-            f"{source}={raw!r}: expected an integer device count "
-            f"(e.g. {source}=8 for a v5e-8)"
-        ) from None
-    if n < 0:
-        raise ValueError(f"{source}={n}: device count must be >= 0")
-    if n <= 1:
-        return None
+    if (
+        isinstance(req, int)
+        and not os.environ.get("KTPU_MESH_PODS", "").strip()
+    ):
+        # fold a persisted autotune winner (ops/tuning.py — env > winner >
+        # default) into the 1-D request: KTPU_MESH_PODS=2 turns KTPU_MESH=8
+        # into the 2x4 grid.  Same total device count, so bench.py's
+        # jax-free pre-backend sizing (parse_mesh_request) stays correct.
+        from ..ops.tuning import tuned_knob
+
+        p = int(tuned_knob("KTPU_MESH_PODS", 0) or 0)
+        if p > 1 and req % p == 0 and req // p >= 1:
+            req = (p, req // p)
     avail = len(jax.devices())
+    if isinstance(req, tuple):
+        p, n = req
+        if p * n > avail:
+            raise ValueError(
+                f"{source}={p}x{n} needs {p * n} devices; only {avail} "
+                "available (2-D shapes do not clamp)"
+            )
+        return make_mesh(shape=(p, n))
+    n = req
     if n > avail:
         warnings.warn(
             f"{source}={n} exceeds the {avail} available device(s); "
@@ -86,6 +135,16 @@ def mesh_from_env(raw: Optional[str] = None, source: str = "KTPU_MESH") -> Optio
     if n <= 1:
         return None
     return make_mesh(n)
+
+
+def mesh_axis_shards(mesh) -> Tuple[int, int]:
+    """(pod_shards, node_shards) of a mesh — (1, 1) for None.  The one
+    accessor for code that needs per-axis counts (memwatch's size model,
+    the sharded wrappers, the encoder's two padding planes)."""
+    if mesh is None:
+        return (1, 1)
+    shape = dict(mesh.shape)
+    return (int(shape.get(PODS_AXIS, 1)), int(shape.get(NODE_AXIS, 1)))
 
 
 def pad_field(name: str, a, pad: int, d_sentinel: int, n: int):
@@ -134,9 +193,52 @@ def pad_nodes(arr, n_shards: int):
     return dataclasses.replace(arr, **repl), n
 
 
+def pad_pod_field(name: str, a, pad: int):
+    """Pad ONE ClusterArrays field's pod axis by `pad` entries (fill 0), or
+    return it untouched when it carries no pod axis.  image_score pads its
+    leading axis in BOTH the [P, N] matrix and [P, 1] broadcast forms.
+    Shared by pad_pods below and the resident encoder's placement-time
+    padding (api/delta.py)."""
+    import numpy as np
+
+    ent = POD_AXIS_FIELDS.get(name)
+    if ent is None:
+        if name == "image_score":
+            ent = (0, 0)
+        else:
+            return a
+    axis, fill = ent
+    a = np.asarray(a)
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return np.pad(a, widths, constant_values=fill)
+
+
+def pad_pods(arr, pod_shards: int):
+    """Pad the pod axis of a ClusterArrays to a multiple of `pod_shards`
+    with permanently invalid pods — `pod_valid` False is the master gate
+    (assignment -1, commits nothing, contributes zero usage), so decisions
+    over the real pods are unchanged.  Returns (arr, original_P); the input
+    comes back untouched when already divisible.  Mirrors pad_nodes: the
+    encoder's pow-of-2 bucketing usually makes this a no-op for pow-of-2
+    shard counts."""
+    p = arr.P
+    pad = (-p) % pod_shards
+    if pad == 0:
+        return arr, p
+    import dataclasses
+
+    repl = {
+        name: pad_pod_field(name, getattr(arr, name), pad)
+        for name in (*POD_AXIS_FIELDS, "image_score")
+    }
+    return dataclasses.replace(arr, **repl), p
+
+
 def shard_hbm_estimate(
     n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
     n_terms: int = 1, chunk: int = 128, u_classes: Optional[int] = None,
+    pod_shards: int = 1,
 ) -> Dict[str, int]:
     """Per-shard device-memory estimate (bytes) for the routed kernels'
     dominant blocks (PARITY.md HBM budget, sharded): the two [P, Nl] bool
@@ -163,7 +265,14 @@ def shard_hbm_estimate(
     the mask share of `class_matrices` price at ``ceil(n/32) * 4`` bytes
     per row instead of ``n`` — the 8x HBM-ceiling cut BENCH_r08 lands.
     The estimate keys on the same trace-time knob as the kernels, so the
-    analytic budget and the compiled buffers flip together (KTPU012)."""
+    analytic budget and the compiled buffers flip together (KTPU012).
+
+    2-D MESH (``pod_shards`` > 1): the resident pod-axis buffers divide by
+    ``pod_shards`` (the burned-down KTPU015 replicated-giant set), and the
+    kernel's entry all-gather over the pods axis materializes ONE full-size
+    transient copy of each gathered pod field — priced honestly as the
+    ``pod_gather`` term, so the budget covers the peak, not just the
+    at-rest residency win."""
     from ..ops import bitplane
 
     nl = -(-n_nodes // n_shards)
@@ -200,16 +309,50 @@ def shard_hbm_estimate(
 
     b["resident_inputs"] = resident_input_bytes(
         n_pods, n_nodes, n_shards, n_res=n_res, n_terms=n_terms,
-        u_classes=u_classes,
+        u_classes=u_classes, pod_shards=pod_shards,
     )
+    if pod_shards > 1:
+        b["pod_gather"] = pod_gather_bytes(
+            n_pods, n_nodes, n_shards, n_res=n_res, n_terms=n_terms,
+            u_classes=u_classes,
+        )
     b["total"] = sum(b.values())
     return b
+
+
+def pod_gather_bytes(
+    n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
+    n_terms: int = 1, u_classes: Optional[int] = None,
+) -> int:
+    """Bytes of the kernels' entry all-gather over the pods axis: each
+    pod-sharded resident field is stitched back to its FULL pod extent once
+    per program (node-sharded dims stay node-local).  This is both the 2-D
+    transient-HBM term of shard_hbm_estimate and the pod-axis collective
+    term of shard_comm_estimate — one number, two reconciliations
+    (KTPU012 / KTPU017), derived from the same rule table."""
+    from .partition_rules import FIELD_DIMS, field_bytes, sharded_on_pods
+
+    env = {"P": n_pods, "N": n_nodes, "R": n_res, "T2": max(1, n_terms),
+           "U": u_classes or 1}
+    total = 0
+    for q in FIELD_DIMS:
+        if q.startswith("inc.") and not u_classes:
+            continue
+        if not sharded_on_pods(q):
+            continue
+        if q == "arr.image_score":
+            # the broadcast [P, 1] form gathers at the score width; the
+            # real [P, N] matrix stays node-sharded after the pod gather
+            total += (FIELD_DIMS[q][1] // 8) * max(1, n_pods)
+            continue
+        total += field_bytes(q, env, n_shards, pod_shards=1)
+    return total
 
 
 def shard_comm_estimate(
     n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
     n_terms: int = 1, chunk: int = 128, u_classes: Optional[int] = None,
-    kind: str = "chunked",
+    kind: str = "chunked", pod_shards: int = 1,
 ) -> Dict[str, int]:
     """Analytic per-shard collective-traffic estimate (bytes) for ONE traced
     program of the sharded routed kernels — the KTPU017 reconciliation
@@ -229,6 +372,11 @@ def shard_comm_estimate(
                            and [C, R]-scale blocks
       ``class_stitch``     incremental routes: the [U1, N] class-matrix
                            gather the per-cycle hoist stitches once
+      ``pod_gather``       2-D mesh: the one-time entry all-gather of the
+                           pod-sharded resident fields back to full pod
+                           extent (pod_gather_bytes — each all_gather's
+                           output is the full array, the same bytes the
+                           KTPU012 transient term prices)
 
     The estimate models the dominant blocks, not every scalar pmax; the
     KTPU017 tolerance (analysis/shardcheck.COMM_TOLERANCE) absorbs the
@@ -239,12 +387,18 @@ def shard_comm_estimate(
     }
     if u_classes and kind == "inc":
         b["class_stitch"] = 4 * u_classes * n_nodes * 4
+    if pod_shards > 1:
+        b["pod_gather"] = pod_gather_bytes(
+            n_pods, n_nodes, n_shards, n_res=n_res, n_terms=n_terms,
+            u_classes=u_classes if kind == "inc" else None,
+        )
     b["total"] = sum(b.values())
     return b
 
 
 def init_distributed(
-    coordinator: str, num_processes: int, process_id: int
+    coordinator: str, num_processes: int, process_id: int,
+    mesh_shape: Optional[Tuple[int, int]] = None,
 ) -> Mesh:
     """Multi-host (DCN) entry: join the jax.distributed cluster, then build
     the node-axis mesh over ALL processes' devices.  The reference scales its
@@ -253,15 +407,18 @@ def init_distributed(
     (SURVEY.md §2.4 distributed-backend mapping).  Single-host callers never
     need this — make_mesh over local devices is the ICI path.
 
+    ``mesh_shape=(pods, nodes)`` builds the 2-D pods x nodes mesh over the
+    global device set instead of the 1-D node axis.
+
     Verified by tests/test_dcn_distributed.py: a 2-process CPU-sim cluster
-    runs the full sharded step with cross-process collectives and matches the
-    dense single-process decisions bit-for-bit."""
+    runs the full sharded step (1-D and 2-D) with cross-process collectives
+    and matches the dense single-process decisions bit-for-bit."""
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
     )
-    return make_mesh()
+    return make_mesh(shape=mesh_shape)
 
 
 def global_arrays(mesh: Mesh, tree):

@@ -40,8 +40,19 @@ from jax.sharding import PartitionSpec as P
 
 # The mesh axis names.  NODE_AXIS lives here (not parallel/mesh.py) so the
 # table is import-cycle-free; mesh.py re-exports it for existing callers.
+# PODS_AXIS is the 2-D mesh's second dimension (ROADMAP item 3): pod-scaling
+# resident buffers shard over it, node-scaling buffers over NODE_AXIS.  A
+# 1-D mesh simply omits the pods axis — sharding_for() strips axes the mesh
+# does not carry, so every 1-D call site keeps working unchanged.
 NODE_AXIS = "nodes"
-MESH_AXES = (NODE_AXIS,)
+PODS_AXIS = "pods"
+MESH_AXES = (PODS_AXIS, NODE_AXIS)
+
+# mesh axis -> the scale symbol its sharded dimension must carry (KTPU016's
+# axis-maps-to-scale-dim check generalizes over this instead of hardcoding
+# the node axis).  inc.cls shards its class-id vector over PODS_AXIS because
+# it is pod-aligned ([P]), not class-aligned.
+AXIS_SCALE: Dict[str, str] = {NODE_AXIS: "N", PODS_AXIS: "P"}
 
 # Scale-dimension symbols: axes whose size grows with the cluster (pods,
 # nodes, equivalence classes).  Everything else ("R", "T", "L", ...) is a
@@ -90,32 +101,44 @@ PARTITION_RULES: Tuple[PartitionRule, ...] = (
         r"|node_taint_pref|node_ports0)$",
         P(NODE_AXIS, None),
     ),
-    # [P, N] image-locality matrix: node-sharded when it is a real matrix;
-    # clusterarrays_specs() degrades it to replicated for the [P, 1]
-    # broadcast form (the shape-conditional rule, snippet-style)
-    PartitionRule(r"^arr\.image_score$", P(None, NODE_AXIS)),
-    # --- ClusterArrays pod/vocab fields (replicated: the ROADMAP-3a debt
-    # KTPU015 tracks — the 2-D pods x nodes mesh will shard the pod axis) ---
+    # [P, N] image-locality matrix: sharded on BOTH mesh axes when it is a
+    # real matrix; clusterarrays_specs() degrades the node axis for the
+    # [P, 1] broadcast form (the shape-conditional rule, snippet-style)
+    PartitionRule(r"^arr\.image_score$", P(PODS_AXIS, NODE_AXIS)),
+    # --- ClusterArrays pod-axis resident fields (shard over the pods mesh
+    # axis — the burned-down ROADMAP-3a replicated-giant debt; a 1-D nodes
+    # mesh strips the axis and these replicate exactly as before) ---
     PartitionRule(r"^arr\.sel_mask$", P(None, None, None)),
     PartitionRule(
-        r"^arr\.(pod_valid|pod_prio|pod_nodename|pod_has_sel|pod_group"
-        r"|group_min|term_key)$",
-        P(),
+        r"^arr\.(pod_valid|pod_prio|pod_nodename|pod_has_sel|pod_group)$",
+        P(PODS_AXIS),
     ),
-    # the remaining 2-D pod/vocab matrices, ENUMERATED (no catch-all: an
-    # unlisted future field must fail spec_for loudly, not replicate
-    # silently — the fail-closed contract KTPU014/16 build on)
+    # vocabulary vectors (term/group universes — bounded by spec diversity,
+    # not cluster size): replicated
+    PartitionRule(r"^arr\.(group_min|term_key)$", P()),
+    # [T2, P] pending-membership matrix: pod axis is SECOND
+    PartitionRule(r"^arr\.m_pend$", P(None, PODS_AXIS)),
+    # pod-leading 2-D matrices, ENUMERATED (no catch-all: an unlisted future
+    # field must fail spec_for loudly, not replicate silently — the
+    # fail-closed contract KTPU014/16 build on)
     PartitionRule(
-        r"^arr\.(pod_req|pod_tol_ns|pod_tol_pref|pod_terms|sel_kind"
-        r"|pod_pref_terms|pod_pref_weights|m_pend|pod_match_terms"
-        r"|pod_match_vals|pod_aff_self|term_counts0|anti_counts0"
+        r"^arr\.(pod_req|pod_tol_ns|pod_tol_pref|pod_terms"
+        r"|pod_pref_terms|pod_pref_weights|pod_match_terms"
+        r"|pod_match_vals|pod_aff_self"
         r"|pod_aff_terms|pod_anti_terms|pod_pref_aff_terms|pod_pref_aff_w"
-        r"|pref_own0|pod_spread_terms|pod_spread_maxskew|pod_spread_hard"
+        r"|pod_spread_terms|pod_spread_maxskew|pod_spread_hard"
         r"|pod_ports)$",
+        P(PODS_AXIS, None),
+    ),
+    # vocabulary matrices ([S,E] selector table, [T2,D1] per-term domain
+    # counts): bounded by spec diversity, replicated
+    PartitionRule(
+        r"^arr\.(sel_kind|term_counts0|anti_counts0|pref_own0)$",
         P(None, None),
     ),
     # --- IncState resident class matrices (ops/incremental.py) ---
-    PartitionRule(r"^inc\.cls$", P()),
+    # cls is pod-aligned ([P] class ids), so it shards with the pods
+    PartitionRule(r"^inc\.cls$", P(PODS_AXIS)),
     PartitionRule(r"^inc\.req_u$", P(None, None)),
     PartitionRule(r"^inc\..*_u$", P(None, NODE_AXIS)),
     # --- sharded routed-step outputs (parallel/sharded.py out_specs) ---
@@ -242,13 +265,27 @@ def spec_for(qualname: str) -> P:
     return rule_for(qualname).spec
 
 
+def strip_spec(spec: P, axis_names: Sequence[str]) -> P:
+    """`spec` with every axis NOT in `axis_names` replaced by None — how a
+    1-D nodes mesh (or a pods-only mesh) consumes the 2-D table: rows keep
+    declaring the full pods x nodes placement, and each mesh takes exactly
+    the axes it carries."""
+    names = set(axis_names)
+    return P(*(ax if ax in names else None for ax in tuple(spec)))
+
+
+def spec_for_mesh(mesh, qualname: str) -> P:
+    return strip_spec(spec_for(qualname), tuple(mesh.axis_names))
+
+
 def sharding_for(mesh, qualname: str):
     """NamedSharding over `mesh` for one table row — the ONE constructor
     every placement site routes through (KTPU014 flags NamedSharding
-    literals anywhere else in the package)."""
+    literals anywhere else in the package).  Axes the mesh does not carry
+    are stripped, so the same row serves 1-D and 2-D meshes."""
     from jax.sharding import NamedSharding
 
-    return NamedSharding(mesh, spec_for(qualname))
+    return NamedSharding(mesh, spec_for_mesh(mesh, qualname))
 
 
 def replicated_sharding(mesh):
@@ -260,52 +297,64 @@ def clusterarrays_shardings(mesh, image_sharded: bool) -> Dict[str, object]:
     """field name -> NamedSharding for every ClusterArrays field —
     the construction half of parallel/sharded.field_shardings (which
     memoizes per (mesh, image_sharded)); placement sites receive built
-    shardings, never build their own (KTPU014)."""
+    shardings, never build their own (KTPU014).  The pods axis rides only
+    when the mesh carries it (strip_spec)."""
     import dataclasses
 
     from jax.sharding import NamedSharding
 
-    specs = clusterarrays_specs(image_sharded)
+    axes = tuple(mesh.axis_names)
+    specs = clusterarrays_specs(image_sharded, pod_sharded=PODS_AXIS in axes)
     return {
-        f.name: NamedSharding(mesh, getattr(specs, f.name))
+        f.name: NamedSharding(mesh, strip_spec(getattr(specs, f.name), axes))
         for f in dataclasses.fields(type(specs))
     }
 
 
-def clusterarrays_specs(image_sharded: bool):
+def clusterarrays_specs(image_sharded: bool, pod_sharded: bool = False):
     """PartitionSpec pytree over every ClusterArrays field, resolved row by
     row from the table (replaces parallel/sharded.py's hand-written
     ``_node_sharding_specs``).  ``image_sharded`` keys the shape-conditional
-    image_score rule: the [P, 1] broadcast form replicates."""
+    image_score rule: the [P, 1] broadcast form drops the node axis (the pod
+    axis still shards when ``pod_sharded``).  ``pod_sharded=False`` (every
+    1-D caller) strips PODS_AXIS from all rows."""
     import dataclasses
 
     from ..api.snapshot import ClusterArrays
 
+    keep = MESH_AXES if pod_sharded else (NODE_AXIS,)
     specs = {}
     for f in dataclasses.fields(ClusterArrays):
+        spec = spec_for(f"arr.{f.name}")
         if f.name == "image_score" and not image_sharded:
-            specs[f.name] = P(None, None)
-        else:
-            specs[f.name] = spec_for(f"arr.{f.name}")
+            spec = P(tuple(spec)[0], None)
+        specs[f.name] = strip_spec(spec, keep)
     return ClusterArrays(**specs)
 
 
-def incstate_specs(elig: bool, traw: bool, naraw: bool, img: bool):
+def incstate_specs(elig: bool, traw: bool, naraw: bool, img: bool,
+                   pod_sharded: bool = False):
     """IncState PartitionSpec pytree for the populated optional structure
     (None leaves drop out of the pytree — parallel/sharded.py in_specs /
-    ops/incremental.inc_partition_specs both resolve through here)."""
+    ops/incremental.inc_partition_specs both resolve through here).
+    ``pod_sharded=False`` strips PODS_AXIS (1-D callers)."""
     from ..ops.incremental import IncState
 
+    keep = MESH_AXES if pod_sharded else (NODE_AXIS,)
+
+    def sf(q):
+        return strip_spec(spec_for(q), keep)
+
     return IncState(
-        cls=spec_for("inc.cls"),
-        req_u=spec_for("inc.req_u"),
-        stat_u=spec_for("inc.stat_u"),
-        base_u=spec_for("inc.base_u"),
-        fit_u=spec_for("inc.fit_u"),
-        elig_u=spec_for("inc.elig_u") if elig else None,
-        traw_u=spec_for("inc.traw_u") if traw else None,
-        naraw_u=spec_for("inc.naraw_u") if naraw else None,
-        img_u=spec_for("inc.img_u") if img else None,
+        cls=sf("inc.cls"),
+        req_u=sf("inc.req_u"),
+        stat_u=sf("inc.stat_u"),
+        base_u=sf("inc.base_u"),
+        fit_u=sf("inc.fit_u"),
+        elig_u=sf("inc.elig_u") if elig else None,
+        traw_u=sf("inc.traw_u") if traw else None,
+        naraw_u=sf("inc.naraw_u") if naraw else None,
+        img_u=sf("inc.img_u") if img else None,
     )
 
 
@@ -330,17 +379,40 @@ def node_axis_fields() -> Dict[str, Tuple[int, object]]:
     return out
 
 
+def pod_axis_fields() -> Dict[str, Tuple[int, object]]:
+    """field name -> (pod axis index, pad fill), DERIVED from the table
+    exactly like ``node_axis_fields`` — the ``pad_pods`` input.  Pod padding
+    always fills 0: a padded pod row has ``pod_valid`` False, which gates it
+    out of every stage (assignment -1, commits nothing), so in-vocabulary
+    zeros everywhere else are safe.  image_score stays excluded — its
+    [P, N]-vs-[P, 1] shape conditionality is handled at the padding call
+    sites, same as the node side."""
+    import dataclasses
+
+    from ..api.snapshot import ClusterArrays
+
+    out: Dict[str, Tuple[int, object]] = {}
+    for f in dataclasses.fields(ClusterArrays):
+        if f.name == "image_score":
+            continue
+        rule = rule_for(f"arr.{f.name}")
+        if PODS_AXIS in tuple(rule.spec):
+            out[f.name] = (tuple(rule.spec).index(PODS_AXIS), 0)
+    return out
+
+
 # --------------------------------------------------------------------------
 # the shared analytic size model
 # --------------------------------------------------------------------------
 
 
 def field_bytes(qualname: str, dims_env: Optional[Dict[str, int]] = None,
-                n_shards: int = 1) -> int:
+                n_shards: int = 1, pod_shards: int = 1) -> int:
     """Analytic PER-SHARD bytes of one resident field under `dims_env`
-    (symbol -> size; CANONICAL_DIMS fills the gaps).  A dimension the
-    table shards divides by ``n_shards``; replicated fields pay full size
-    on every shard — the quantity KTPU015 thresholds and the
+    (symbol -> size; CANONICAL_DIMS fills the gaps).  A dimension the table
+    shards divides by that axis's shard count (``n_shards`` is the NODE
+    axis, ``pod_shards`` the PODS axis); replicated fields pay full size on
+    every shard — the quantity KTPU015 thresholds and the
     ``resident_inputs`` term of ``shard_hbm_estimate`` sums.
 
     bits >= 8 rows price as ``count * bits/8``.  bits == 1 (bit-packed)
@@ -354,12 +426,13 @@ def field_bytes(qualname: str, dims_env: Optional[Dict[str, int]] = None,
     env.update(SCALE_DIMS)
     if dims_env:
         env.update(dims_env)
+    div = {NODE_AXIS: max(1, n_shards), PODS_AXIS: max(1, pod_shards)}
     spec = tuple(spec_for(qualname))
     sizes = []
     for i, sym in enumerate(dims):
         size = env[sym]
-        if i < len(spec) and spec[i] == NODE_AXIS:
-            size = -(-size // max(1, n_shards))
+        if i < len(spec) and spec[i] in div:
+            size = -(-size // div[spec[i]])
         sizes.append(max(1, size))
     if bits < 8:
         # packed plane: last axis becomes uint32 words
@@ -378,10 +451,14 @@ def sharded_on_nodes(qualname: str) -> bool:
     return NODE_AXIS in tuple(spec_for(qualname))
 
 
+def sharded_on_pods(qualname: str) -> bool:
+    return PODS_AXIS in tuple(spec_for(qualname))
+
+
 def resident_input_bytes(
     n_pods: int, n_nodes: int, n_shards: int, n_res: int = 4,
     n_terms: int = 1, u_classes: Optional[int] = None,
-    image_sharded: bool = False,
+    image_sharded: bool = False, pod_shards: int = 1,
 ) -> int:
     """Per-shard bytes of the resident input set (every ``arr.*`` field,
     plus ``inc.*`` when the incremental route rides) — the table-derived
@@ -395,7 +472,8 @@ def resident_input_bytes(
             continue
         if q == "arr.image_score" and not image_sharded:
             # the [P, 1] broadcast form: pod axis only, at the score width
-            total += (FIELD_DIMS[q][1] // 8) * max(1, n_pods)
+            p_local = -(-max(1, n_pods) // max(1, pod_shards))
+            total += (FIELD_DIMS[q][1] // 8) * p_local
             continue
-        total += field_bytes(q, env, n_shards)
+        total += field_bytes(q, env, n_shards, pod_shards)
     return total

@@ -26,39 +26,68 @@ import jax
 from jax.sharding import Mesh
 
 from ..api.snapshot import ClusterArrays
-from ..ops.assign import schedule_scan
+from ..ops.assign import pod_unshard, schedule_scan
 from ..ops.scores import ScoreConfig
-from .mesh import NODE_AXIS, shard_map
-from .partition_rules import clusterarrays_specs, incstate_specs, spec_for
+from .mesh import NODE_AXIS, PODS_AXIS, mesh_axis_shards, shard_map
+from .partition_rules import (
+    clusterarrays_specs,
+    incstate_specs,
+    spec_for,
+    strip_spec,
+)
 
 
-def _node_sharding_specs(image_sharded: bool) -> ClusterArrays:
+def _node_sharding_specs(
+    image_sharded: bool, pod_sharded: bool = False
+) -> ClusterArrays:
     """PartitionSpec pytree for every ClusterArrays field, resolved through
     the declarative rule table (parallel/partition_rules.py).  The former
     hand-written 40-line spec literal is gone: adding a field is one table
     row, and the ktpu-verify shard pass (KTPU014..018) proves the compiled
-    placements obey it."""
-    return clusterarrays_specs(image_sharded)
+    placements obey it.  ``pod_sharded`` keys the 2-D mesh's pod rows."""
+    return clusterarrays_specs(image_sharded, pod_sharded=pod_sharded)
+
+
+def _out_spec(qualname: str, mesh: Mesh):
+    """Table out-spec, stripped to the axes this mesh carries."""
+    return strip_spec(spec_for(qualname), tuple(mesh.axis_names))
 
 
 def sharded_schedule_batch(
     arr: ClusterArrays, cfg: ScoreConfig, mesh: Mesh
 ) -> Tuple[jax.Array, jax.Array]:
-    """Same contract as ops.assign.schedule_batch, node axis sharded over `mesh`.
+    """Same contract as ops.assign.schedule_batch, sharded over `mesh` —
+    node axis on a 1-D mesh, pods x nodes on a 2-D one (the pod-sharded
+    inputs stitch back to full pod extent at kernel entry — pod_unshard).
 
     Returns (assignment i32[P], node_used i32[N, R] — node-sharded).
     """
-    n_shards = mesh.shape[NODE_AXIS]
+    pod_shards, n_shards = mesh_axis_shards(mesh)
     if arr.N % n_shards:
         raise ValueError(f"node axis {arr.N} not divisible by mesh size {n_shards}")
+    if arr.P % pod_shards:
+        raise ValueError(
+            f"pod axis {arr.P} not divisible by pod shards {pod_shards}"
+        )
     img = arr.image_score.shape[1] == arr.N
+    pod_sharded = pod_shards > 1
+
+    def body(a):
+        if pod_sharded:
+            a, _ = pod_unshard(a, axis_name=PODS_AXIS)
+        return schedule_scan(
+            a, cfg=cfg, axis_name=NODE_AXIS, image_sharded=img
+        )
+
     fn = shard_map(
-        partial(
-            schedule_scan, cfg=cfg, axis_name=NODE_AXIS, image_sharded=img
-        ),
+        body,
         mesh=mesh,
-        in_specs=(_node_sharding_specs(img),),
-        out_specs=(spec_for("out.assignment"), spec_for("out.node_used_scan")),
+        in_specs=(_node_sharding_specs(img, pod_sharded),),
+        out_specs=(
+            _out_spec("out.assignment", mesh),
+            _out_spec("out.node_used_scan", mesh),
+        ),
+        check_rep=False,
     )
     return jax.jit(fn)(arr)
 
@@ -95,9 +124,12 @@ def _sharded_routed_fn(
 
     from ..ops import assign as A
 
-    n_shards = int(mesh.shape[NODE_AXIS])
+    pod_shards, n_shards = mesh_axis_shards(mesh)
+    pod_sharded = pod_shards > 1
     if kind == "scan":
         def body(a):
+            if pod_sharded:
+                a, _ = A.pod_unshard(a, axis_name=PODS_AXIS)
             c, u = A.schedule_scan(
                 a, cfg=cfg, axis_name=NODE_AXIS, image_sharded=image_sharded
             )
@@ -106,7 +138,7 @@ def _sharded_routed_fn(
             return c, u
 
         # the scan's used stays node-sharded (table row out.node_used_scan)
-        used_spec = spec_for("out.node_used_scan")
+        used_spec = _out_spec("out.node_used_scan", mesh)
     else:
         kernel = (
             A.schedule_scan_chunked if kind == "chunked"
@@ -114,6 +146,8 @@ def _sharded_routed_fn(
         )
         if inc_sig is not None:
             def body(a, inc):
+                if pod_sharded:
+                    a, inc = A.pod_unshard(a, inc, axis_name=PODS_AXIS)
                 return kernel(
                     a, cfg=cfg, with_ordinals=with_ordinals,
                     axis_name=NODE_AXIS, axis_size=n_shards,
@@ -121,6 +155,8 @@ def _sharded_routed_fn(
                 )
         else:
             def body(a):
+                if pod_sharded:
+                    a, _ = A.pod_unshard(a, axis_name=PODS_AXIS)
                 return kernel(
                     a, cfg=cfg, with_ordinals=with_ordinals,
                     axis_name=NODE_AXIS, axis_size=n_shards,
@@ -128,13 +164,13 @@ def _sharded_routed_fn(
                 )
 
         # chunked/rounds carry usage replicated (table row out.node_used)
-        used_spec = spec_for("out.node_used")
-    in_specs = (_node_sharding_specs(image_sharded),)
+        used_spec = _out_spec("out.node_used", mesh)
+    in_specs = (_node_sharding_specs(image_sharded, pod_sharded),)
     if kind != "scan" and inc_sig is not None:
         # the resident IncState's populated structure, from the rule table
-        in_specs = in_specs + (incstate_specs(*inc_sig),)
-    out_specs = (spec_for("out.assignment"), used_spec) + (
-        (spec_for("out.ordinals"), spec_for("out.n_commits"))
+        in_specs = in_specs + (incstate_specs(*inc_sig, pod_sharded=pod_sharded),)
+    out_specs = (_out_spec("out.assignment", mesh), used_spec) + (
+        (_out_spec("out.ordinals", mesh), _out_spec("out.n_commits", mesh))
         if with_ordinals else ()
     )
     fn = shard_map(
@@ -167,12 +203,18 @@ def sharded_schedule_batch_routed(
 
     donate=True hands the (freshly transferred, per-wave) input shards to
     XLA, same contract as schedule_batch_donated: per-shard [P, Nl]-scale
-    intermediates stop doubling peak HBM."""
-    from ..ops import assign as A
-    from .mesh import pad_nodes
+    intermediates stop doubling peak HBM.
 
-    n_shards = int(mesh.shape[NODE_AXIS])
+    On a 2-D pods x nodes mesh the pod axis pads too (pad_pods — BEFORE the
+    route choice and the inc_applicable gate, so both see the padded P the
+    kernel will run at), and per-pod outputs slice back to the caller's P
+    (padded pods are invalid: assignment -1, zero usage)."""
+    from ..ops import assign as A
+    from .mesh import pad_nodes, pad_pods
+
+    pod_shards, n_shards = mesh_axis_shards(mesh)
     arr, _n_orig = pad_nodes(arr, n_shards)
+    arr, p_orig = pad_pods(arr, pod_shards)
     if A._chunk_routed(arr, cfg):
         kind = "chunked"
     elif A._rounds_routed(arr, cfg):
@@ -180,8 +222,8 @@ def sharded_schedule_batch_routed(
     else:
         kind = "scan"
     # the incremental class state applies only to the chunked/rounds routes
-    # and must match the PADDED node axis (the HoistCache pads with the same
-    # parallel/mesh.py rule set)
+    # and must match the PADDED node and pod axes (the HoistCache pads with
+    # the same parallel/mesh.py rule set)
     inc = A.inc_applicable(arr, cfg, inc) if kind != "scan" else None
     inc_sig = None
     if inc is not None:
@@ -201,5 +243,15 @@ def sharded_schedule_batch_routed(
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            return fn(*args)
-    return fn(*args)
+            out = fn(*args)
+    else:
+        out = fn(*args)
+    if p_orig != arr.P:
+        # slice the per-pod outputs back to the caller's pod extent
+        # (assignment [+ ordinals]); node_used / n_commits are unaffected
+        # by invalid padded pods
+        out = (out[0][:p_orig], out[1]) + tuple(
+            o[:p_orig] if getattr(o, "ndim", 0) == 1 else o
+            for o in out[2:]
+        )
+    return out

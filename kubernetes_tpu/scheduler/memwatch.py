@@ -167,7 +167,8 @@ def _per_device_bytes(a) -> int:
 # --------------------------------------------------------------------------
 
 
-def model_bytes_for(qualname: str, shape, n_shards: int = 1) -> Optional[int]:
+def model_bytes_for(qualname: str, shape, n_shards: int = 1,
+                    pod_shards: int = 1) -> Optional[int]:
     """Analytic per-shard bytes of one resident buffer via the partition
     rule table's size model: FIELD_DIMS dims symbols bound to the CONCRETE
     shape, then ``partition_rules.field_bytes`` (the same routine
@@ -191,13 +192,14 @@ def model_bytes_for(qualname: str, shape, n_shards: int = 1) -> Optional[int]:
         # padded layout byte-for-byte (the KTPU020 exact-equality contract;
         # per-shard word blocks divide evenly by construction)
         env[dims[-1]] = int(shape[-1]) * 32
-    return field_bytes(qualname, env, n_shards)
+    return field_bytes(qualname, env, n_shards, pod_shards=pod_shards)
 
 
-def _census_entry(qualname: str, a, n_shards: int) -> Dict[str, Any]:
+def _census_entry(qualname: str, a, n_shards: int,
+                  pod_shards: int = 1) -> Dict[str, Any]:
     shape = tuple(int(s) for s in a.shape)
     actual = _per_device_bytes(a)
-    model = model_bytes_for(qualname, shape, n_shards)
+    model = model_bytes_for(qualname, shape, n_shards, pod_shards=pod_shards)
     # model >= itemsize by construction (field_bytes clamps every dim to
     # >= 1 so an analytic budget is never zero); a zero-size concrete
     # buffer occupies no device bytes — not a drift, just empty
@@ -213,7 +215,7 @@ def _census_entry(qualname: str, a, n_shards: int) -> Dict[str, Any]:
 
 
 def census_buffers(arr=None, inc=None, encoder=None, hoist=None,
-                   n_shards: int = 1) -> Dict[str, Any]:
+                   n_shards: int = 1, pod_shards: int = 1) -> Dict[str, Any]:
     """The host-side census of every resident device buffer the framework
     owns, deduped by buffer identity (an IncState's leaves ARE the
     HoistCache's device entries — one buffer, one entry):
@@ -244,7 +246,8 @@ def census_buffers(arr=None, inc=None, encoder=None, hoist=None,
                 return  # donated/retired: no longer resident anywhere
         except Exception:
             pass
-        entries.append(_census_entry(qualname, a, n_shards))
+        entries.append(_census_entry(qualname, a, n_shards,
+                                     pod_shards=pod_shards))
 
     if arr is not None:
         for f in _dc.fields(type(arr)):
@@ -341,8 +344,13 @@ class DeviceMemoryLedger:
 
     def __init__(self, mesh=None, metrics=None,
                  slack_bytes: int = SENTINEL_SLACK_BYTES):
+        from ..parallel.mesh import mesh_axis_shards
+
         self.mesh = mesh
+        # total device count (the KTPU012 measured/n division) plus the
+        # per-axis split the size model divides by on a 2-D mesh
         self.n_shards = int(mesh.size) if mesh is not None else 1
+        self.pod_shards, self.node_shards = mesh_axis_shards(mesh)
         self.metrics = metrics
         self.sentinel = LeakSentinel(slack_bytes=slack_bytes)
         self._baseline_live = 0
@@ -376,7 +384,8 @@ class DeviceMemoryLedger:
         if not self._baselined:
             self.baseline()
         census = census_buffers(arr=arr, inc=inc, encoder=encoder,
-                                hoist=hoist, n_shards=self.n_shards)
+                                hoist=hoist, n_shards=self.node_shards,
+                                pod_shards=self.pod_shards)
         live = live_device_bytes()
         stats = device_memory_stats()
         live_delta = max(0, live["bytes"] - self._baseline_live)
@@ -460,9 +469,9 @@ class DeviceMemoryLedger:
 
         chunk = A._INC_CHUNK if u else A._CHUNK
         return int(shard_hbm_estimate(
-            pr[0], nu[0], self.n_shards, n_res=pr[1],
+            pr[0], nu[0], self.node_shards, n_res=pr[1],
             n_terms=(tc[0] if tc else 1), chunk=chunk,
-            u_classes=(u[0] if u else None),
+            u_classes=(u[0] if u else None), pod_shards=self.pod_shards,
         )["total"])
 
     def summary(self) -> Dict[str, Any]:
@@ -473,6 +482,7 @@ class DeviceMemoryLedger:
             "hbm_peak_bytes": self.hbm_peak_bytes(),
             "hbm_resident_bytes": int(self.peak_resident_bytes),
             "memwatch": {
+                "mesh_shape": [self.pod_shards, self.node_shards],
                 "source": self.source(),
                 "memory_stats_available": self.memory_stats_available,
                 "samples": self.samples,

@@ -46,3 +46,15 @@ def mesh8():
 
     assert len(jax.devices()) >= 8, "conftest forces 8 virtual CPU devices"
     return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh2x4():
+    """The 2-D (2 pods x 4 nodes) grid over the same 8 virtual devices —
+    the tier-1-safe fixture for the pod-axis sharding tests
+    (test_mesh_2d): resident pod-scaling buffers live split across the
+    pods axis, kernels entry-gather them (ops/assign.py pod_unshard)."""
+    from kubernetes_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest forces 8 virtual CPU devices"
+    return make_mesh(shape=(2, 4))

@@ -180,3 +180,19 @@ def random_cluster(
             )
         )
     return Snapshot(nodes=nodes, pending_pods=pods)
+
+
+_SHARED_TRACES = {}
+
+
+def shared_route_traces():
+    """ONE 18-route trace shared by the three full-pass test modules
+    (test_devicecheck / test_shardcheck / test_memwatch) — the exact
+    `--device --shard --mem` single-trace contract the CLI runs, and the
+    single biggest CPU-sim cost in tier-1 (tracing the matrix three times
+    would triple it)."""
+    if "t" not in _SHARED_TRACES:
+        from kubernetes_tpu.analysis.devicecheck import collect_traces
+
+        _SHARED_TRACES["t"] = collect_traces()
+    return _SHARED_TRACES["t"]

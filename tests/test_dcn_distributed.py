@@ -19,16 +19,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = textwrap.dedent(
     """
     import sys
-    rank, port = int(sys.argv[1]), sys.argv[2]
+    rank, port, shape = int(sys.argv[1]), sys.argv[2], sys.argv[3]
     sys.path.insert(0, {repo!r})
     from __graft_entry__ import force_cpu_platform
     force_cpu_platform(4)  # 4 local CPU devices per process -> 8 global
     import jax
     import numpy as np
     from kubernetes_tpu.parallel.mesh import init_distributed, global_arrays
-    mesh = init_distributed(f"127.0.0.1:{{port}}", 2, rank)
+    mesh_shape = tuple(int(v) for v in shape.split("x")) if "x" in shape else None
+    mesh = init_distributed(f"127.0.0.1:{{port}}", 2, rank, mesh_shape=mesh_shape)
     assert len(jax.devices()) == 8, jax.devices()
     assert jax.process_count() == 2
+    if mesh_shape is not None:
+        assert tuple(mesh.shape.values()) == mesh_shape, dict(mesh.shape)
     from kubernetes_tpu.bench import workloads
     from kubernetes_tpu.api.snapshot import encode_snapshot
     from kubernetes_tpu.ops.scores import DEFAULT_SCORE_CONFIG, infer_score_config
@@ -55,7 +58,12 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_distributed_step_matches_dense():
+@pytest.mark.parametrize("shape", ["1d", "2x4"])
+def test_two_process_distributed_step_matches_dense(shape):
+    """1d: the legacy node-axis mesh over both processes.  2x4: the 2-D
+    pods x nodes grid spanning the DCN boundary — the pod axis falls across
+    the two processes (2 pod rows x 4 node columns over 2x4 local devices),
+    so the entry pod-gather is a REAL cross-process collective."""
     port = _free_port()
     env = {
         **os.environ,
@@ -64,7 +72,7 @@ def test_two_process_distributed_step_matches_dense():
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, str(rank), str(port)],
+            [sys.executable, "-c", WORKER, str(rank), str(port), shape],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,

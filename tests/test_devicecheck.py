@@ -34,9 +34,13 @@ _PASS_CACHE = {}
 
 
 def _full_pass() -> Report:
-    """The one full device pass this module pays for (~45 s CPU sim)."""
+    """The one full device pass this module pays for, over the 18-route
+    trace shared with the shard/mem modules (helpers.shared_route_traces)."""
     if "rep" not in _PASS_CACHE:
-        _PASS_CACHE["rep"] = run_device_pass(baseline=Baseline([]))
+        from helpers import shared_route_traces
+
+        _PASS_CACHE["rep"] = run_device_pass(
+            baseline=Baseline([]), pretraced=shared_route_traces())
     return _PASS_CACHE["rep"]
 
 
@@ -84,12 +88,12 @@ def test_committed_package_is_device_pass_clean():
 
 def test_every_route_listed_no_silent_skips():
     """The report lists EVERY enumerated route; on the tier-1 8-device CPU
-    platform all 12 trace (a skip anywhere must carry a reason)."""
+    platform all 18 trace (a skip anywhere must carry a reason)."""
     rep = _full_pass()
     routes = {r["name"]: r for r in rep.device["routes"]}
     assert set(routes) == {s.name for s in enumerate_routes(8)}
-    assert len(routes) == 12
-    assert rep.device["n_traced"] == 12 and rep.device["n_skipped"] == 0
+    assert len(routes) == 18
+    assert rep.device["n_traced"] == 18 and rep.device["n_skipped"] == 0
     for r in routes.values():
         assert r["status"] == "traced"
         assert r["warm"].get("cycles") == 3

@@ -322,7 +322,7 @@ def test_kubectl_describe_and_events_show_diagnosis(monkeypatch):
 
 # --- KTPU010 / KTPU011 stay clean with the plane armed ---
 def test_device_pass_retrace_and_transfer_rules_clean_with_explain(monkeypatch):
-    """KTPU_EXPLAIN=1 while the ktpu-verify device pass traces all twelve
+    """KTPU_EXPLAIN=1 while the ktpu-verify device pass traces all eighteen
     production routes: zero warm-cycle retraces (KTPU010) and a
     transfer-guard-clean warm loop (KTPU011) — the plane is additive."""
     monkeypatch.setenv("KTPU_EXPLAIN", "1")

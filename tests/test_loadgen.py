@@ -239,3 +239,39 @@ def test_regression_gate_never_compares_latency_across_driver_modes():
     cur_s = ("r5.json", _rec("open-loop", step_s=1.05))
     v4 = check_regression([old, cur_s], cur_s, metric="step_s")
     assert v4["status"] == "pass" and v4["best_prior"] == "r0.json"
+
+
+def test_regression_gate_never_compares_across_shard_topologies():
+    """An 8-device sim run timeshares one core (step_s ~8x a single-device
+    run of the same kernel) and its per-shard metrics are divided by the
+    grid — so n_shards joins platform in the comparability key, in BOTH
+    directions.  Artifacts that predate the n_shards stamp were all
+    single-device and must keep gating each other."""
+    single = ("r8.json", _rec(None, step_s=2.6, n_shards=1,
+                              per_shard_hbm_bytes=400_000_000))
+    mesh = ("r9.json", _rec(None, step_s=29.0, n_shards=8,
+                            mesh_shape=[2, 4],
+                            per_shard_hbm_bytes=126_000_000))
+
+    # the mesh run's 8x sim wall is a config change, not a regression
+    v = check_regression([single, mesh], mesh, metric="step_s")
+    assert v["status"] == "pass" and "no comparable" in v["reason"]
+    assert any("n_shards" in s for s in v["skipped"])
+
+    # and the mesh run's divided per-shard HBM never becomes the bar a
+    # later single-device run is judged against
+    nxt = ("r10.json", _rec(None, step_s=2.7, n_shards=1,
+                            per_shard_hbm_bytes=401_000_000))
+    v2 = check_regression([single, mesh, nxt], nxt,
+                          metric="per_shard_hbm_bytes")
+    assert v2["status"] == "pass" and v2["best_prior"] == "r8.json"
+
+    # pre-mesh artifacts (no n_shards stamp) normalize to 1 and still gate
+    old = ("r0.json", {"platform": "cpu-sim-fallback", "step_s": 1.0})
+    v3 = check_regression([old, nxt], nxt, metric="step_s")
+    assert v3["status"] == "regression" and v3["best_prior"] == "r0.json"
+
+    # same-topology mesh runs gate each other
+    worse = ("r11.json", _rec(None, step_s=40.0, n_shards=8))
+    v4 = check_regression([mesh, worse], worse, metric="step_s")
+    assert v4["status"] == "regression" and v4["best_prior"] == "r9.json"

@@ -3,7 +3,7 @@ leak sentinel, and the KTPU020 measured-vs-analytic reconciliation.
 
 Ordering note (tier-1 runs -p no:randomly, so file order holds): the
 acceptance gate runs first and pays this module's ONE full mem pass
-(a 12-route trace); every later trace-driven test reuses the cached
+(an 18-route trace); every later trace-driven test reuses the cached
 report.  Fixture tests build synthetic RouteTrace mem blocks."""
 
 import os
@@ -44,9 +44,13 @@ _PASS_CACHE = {}
 
 
 def _full_pass():
-    """The one full mem pass this module pays for (12-route trace)."""
+    """The one full mem pass this module pays for, over the 18-route
+    trace shared with the device/shard modules (helpers.shared_route_traces)."""
     if "rep" not in _PASS_CACHE:
-        _PASS_CACHE["rep"] = run_mem_pass(baseline=Baseline([]))
+        from helpers import shared_route_traces
+
+        _PASS_CACHE["rep"] = run_mem_pass(
+            baseline=Baseline([]), pretraced=shared_route_traces())
     return _PASS_CACHE["rep"]
 
 
@@ -63,22 +67,22 @@ def _wave(seed: int, n_nodes: int = 16, n_pods: int = 32) -> Snapshot:
     return Snapshot(nodes=nodes, pending_pods=pods)
 
 
-# ---- tentpole acceptance: the tier-1 clean gate over all twelve routes ----
+# ---- tentpole acceptance: the tier-1 clean gate over all eighteen routes ----
 
 
 def test_committed_package_is_mem_pass_clean():
     """The acceptance criterion: `--rules KTPU020` exits 0 on the
-    committed package — all twelve routes traced, each carrying a
+    committed package — all eighteen routes traced, each carrying a
     reconciled memory block, no unbaselined findings."""
     rep = _full_pass()
     assert rep.errors == []
     assert rep.unbaselined == [], "\n".join(
         f.render() for f in rep.unbaselined)
-    assert rep.device["n_traced"] == 12
+    assert rep.device["n_traced"] == 18
     assert rep.exit_code == 0
 
 
-def test_census_equals_field_dims_model_on_all_twelve_routes():
+def test_census_equals_field_dims_model_on_all_eighteen_routes():
     """census == FIELD_DIMS-model equality per route: every traced
     route's resident-buffer census resolved through the partition rule
     table's size model and MATCHED it buffer for buffer — the ledger and
@@ -538,7 +542,7 @@ def test_cli_knows_ktpu020_and_refuses_typos(capsys):
 
 
 def test_mem_pass_reuses_pretraced_routes():
-    """`--device --shard --mem` shares ONE 12-route trace: run_mem_pass
+    """`--device --shard --mem` shares ONE 18-route trace: run_mem_pass
     over the cached pass's traces reports the same clean verdict without
     re-tracing (the shared-trace contract)."""
     rep = _full_pass()
@@ -548,4 +552,4 @@ def test_mem_pass_reuses_pretraced_routes():
     rep2 = run_mem_pass(baseline=Baseline([]), pretraced=([t], []))
     assert rep2.exit_code == 0
     assert rep2.device["n_traced"] == 1
-    assert rep.device["n_traced"] == 12
+    assert rep.device["n_traced"] == 18

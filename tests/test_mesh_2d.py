@@ -1,0 +1,292 @@
+"""2-D pods x nodes mesh (ISSUE 20): the pod axis sharded too.
+
+Parity matrix: {chunked, rounds, incremental} x {donate on/off} on the
+(2, 4) grid over the conftest-forced 8-device CPU platform, decisions
+bit-identical to BOTH the single-device serial oracle AND the 1-D mesh8
+route — the 2-D grid is a pure residency/HBM win, never a decision change.
+Packed mask planes ride armed (their tier-1 default), so the bit-planes'
+pod-axis padding and entry gather are exercised, not just the dense forms.
+
+Plus the landability gates on the 2-D grid: pad_pods semantics, the
+KTPU_MESH request grammar, a seeded chaos storm with KTPU_MESH=2x4 armed,
+and a kill.post_assume crash-restart."""
+
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import chaos
+from kubernetes_tpu.api.snapshot import encode_snapshot
+from kubernetes_tpu.ops import DEFAULT_SCORE_CONFIG, infer_score_config
+from kubernetes_tpu.ops import bitplane
+from kubernetes_tpu.ops.assign import (
+    TRACE_COUNTS,
+    schedule_batch_ordinals_routed,
+    schedule_batch_routed,
+)
+from kubernetes_tpu.parallel.mesh import NODE_AXIS, PODS_AXIS
+
+from test_sharded_routed import _chunked_snap, _rounds_snap
+
+
+@pytest.fixture(autouse=True)
+def _force_production_route(monkeypatch):
+    """Chunked/rounds route on the CPU sim, same as test_sharded_routed."""
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+
+
+def _parity_2d(mesh2x4, mesh8, snap, bucket, cfg=None, donate=False,
+               route=None):
+    """Serial oracle vs 1-D mesh8 vs 2-D (2, 4): all three bit-identical,
+    with a strict TRACE_COUNTS proof that the 2-D run really compiled the
+    claimed sharded route."""
+    arr, meta = encode_snapshot(snap, bucket=bucket)
+    cfg = cfg if cfg is not None else infer_score_config(
+        arr, DEFAULT_SCORE_CONFIG)
+    n = arr.N
+    if route is not None:
+        import jax
+
+        jax.clear_caches()
+    want, want_used = schedule_batch_routed(arr, cfg, donate=False)
+    got_1d, _ = schedule_batch_routed(arr, cfg, donate=donate, mesh=mesh8)
+    before = dict(TRACE_COUNTS)
+    got_2d, got_used = schedule_batch_routed(
+        arr, cfg, donate=donate, mesh=mesh2x4)
+    np.testing.assert_array_equal(np.asarray(got_2d), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got_2d), np.asarray(got_1d))
+    gu = np.asarray(got_used)
+    np.testing.assert_array_equal(gu[:n], np.asarray(want_used))
+    assert not gu[n:].any()
+    if route is not None:
+        assert TRACE_COUNTS[route] > before[route], (before, TRACE_COUNTS)
+    return arr, meta, cfg
+
+
+@pytest.mark.parametrize("donate", [False, True])
+def test_2d_chunked_parity(mesh2x4, mesh8, donate, monkeypatch):
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    assert bitplane.PACK_MASKS, "packed plane must ride armed on the grid"
+    snap, bucket = _chunked_snap(False)  # N=27: node-axis padding too
+    _parity_2d(mesh2x4, mesh8, snap, bucket, donate=donate,
+               route="sharded_chunked")
+
+
+@pytest.mark.parametrize("donate", [False, True])
+def test_2d_rounds_parity(mesh2x4, mesh8, donate, monkeypatch):
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap, bucket = _rounds_snap(False)
+    _parity_2d(mesh2x4, mesh8, snap, bucket, cfg=DEFAULT_SCORE_CONFIG,
+               donate=donate, route="sharded_rounds")
+
+
+@pytest.mark.parametrize("donate", [False, True])
+def test_2d_incremental_parity(mesh2x4, mesh8, donate, monkeypatch):
+    """The warm-cycle incremental route on the 2-D grid: the hoist cache
+    built against the 2-D mesh (inc.cls pod-sharded, inc.req_u replicated)
+    schedules bit-identical to the serial inc oracle and the 1-D inc run."""
+    from kubernetes_tpu.bench.workloads import heterogeneous
+    from kubernetes_tpu.ops.incremental import HoistCache
+
+    if donate:
+        monkeypatch.setenv("KTPU_DONATE", "1")
+    snap = heterogeneous(48, 256, seed=3)
+    arr, meta = encode_snapshot(snap)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    inc_ser = HoistCache(mesh=None).ensure(arr, meta, cfg)
+    assert inc_ser is not None, "workload must be inc-applicable"
+    want, _ = schedule_batch_routed(arr, cfg, donate=False, inc=inc_ser)
+    inc_1d = HoistCache(mesh=mesh8).ensure(arr, meta, cfg)
+    got_1d, _ = schedule_batch_routed(
+        arr, cfg, donate=donate, mesh=mesh8, inc=inc_1d)
+    inc_2d = HoistCache(mesh=mesh2x4).ensure(arr, meta, cfg)
+    before = dict(TRACE_COUNTS)
+    got_2d, _ = schedule_batch_routed(
+        arr, cfg, donate=donate, mesh=mesh2x4, inc=inc_2d)
+    np.testing.assert_array_equal(np.asarray(got_2d), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got_2d), np.asarray(got_1d))
+    assert (
+        TRACE_COUNTS["sharded_chunked_inc"] > before["sharded_chunked_inc"]
+        or TRACE_COUNTS["sharded_rounds_inc"] > before["sharded_rounds_inc"]
+    ), (before, TRACE_COUNTS)
+
+
+def test_2d_ordinals_parity(mesh2x4):
+    """The ordinal-reporting scheduler-batch variant on the 2-D grid:
+    choices, per-pod commit ordinals and total sweeps all match."""
+    snap, bucket = _rounds_snap(True)
+    arr, _ = encode_snapshot(snap, bucket=bucket)
+    want_c, _, want_o, want_s = schedule_batch_ordinals_routed(
+        arr, DEFAULT_SCORE_CONFIG, donate=False)
+    got_c, _, got_o, got_s = schedule_batch_ordinals_routed(
+        arr, DEFAULT_SCORE_CONFIG, donate=False, mesh=mesh2x4)
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+    np.testing.assert_array_equal(np.asarray(got_o), np.asarray(want_o))
+    assert int(got_s) == int(want_s)
+
+
+def test_2d_pod_padding_parity(mesh2x4):
+    """A pod count NOT divisible by the pod-shard count: the routed wrapper
+    pod-pads before dispatch and slices the outputs back to the caller's P
+    — decisions over the real pods bit-identical to the serial oracle."""
+    import random
+
+    from helpers import random_cluster
+
+    rng = random.Random(77)
+    snap = random_cluster(rng, n_nodes=24, n_pods=51)  # 51 odd: pod-pads
+    arr, _ = encode_snapshot(snap, bucket=False)
+    cfg = infer_score_config(arr, DEFAULT_SCORE_CONFIG)
+    want, _ = schedule_batch_routed(arr, cfg, donate=False)
+    got, _ = schedule_batch_routed(arr, cfg, donate=False, mesh=mesh2x4)
+    got = np.asarray(got)
+    assert got.shape == np.asarray(want).shape  # sliced back, not padded
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_pad_pods_semantics():
+    """pad_pods adds permanently invalid pods: pod_valid False on the tail
+    (the master gate), zero requests — and is a no-op when divisible."""
+    from kubernetes_tpu.parallel.mesh import pad_pods
+
+    snap, _ = _rounds_snap(False)  # 48 pods
+    arr, _ = encode_snapshot(snap, bucket=False)
+    assert arr.P == 48
+    same, p0 = pad_pods(arr, 2)
+    assert same is arr and p0 == 48  # divisible: untouched
+    padded, p0 = pad_pods(arr, 5)
+    assert p0 == 48 and padded.P == 50
+    assert not padded.pod_valid[48:].any()
+    assert not padded.pod_req[48:].any()
+    np.testing.assert_array_equal(padded.pod_req[:48], arr.pod_req)
+
+
+def test_parse_mesh_request_grammar(monkeypatch):
+    """The KTPU_MESH / KTPU_MESH_PODS / KTPU_MESH_NODES request grammar —
+    jax-free (bench.py sizes the virtual platform with it pre-backend)."""
+    from kubernetes_tpu.parallel.mesh import (
+        mesh_request_devices,
+        parse_mesh_request,
+    )
+
+    cases = [
+        # (KTPU_MESH, KTPU_MESH_PODS, KTPU_MESH_NODES) -> expected
+        ((None, None, None), None),
+        (("8", None, None), 8),
+        (("2x4", None, None), (2, 4)),
+        (("1x4", None, None), 4),       # degenerate pod axis: plain 1-D
+        ((None, "2", "4"), (2, 4)),
+        (("8", "2", None), (2, 4)),     # pods divides the total
+        ((None, "2", None), (2, 1)),    # pods alone: pod-only grid
+        ((None, "1", None), None),      # degenerate pods alone: no mesh
+        (("8", "1", None), 8),          # degenerate pods + total: 1-D
+        (("2x4", "1", None), (2, 4)),   # explicit 2-D string still wins
+        (("1", None, None), None),
+        (("0", None, None), None),
+    ]
+    for (m, p, n), want in cases:
+        for k, v in (("KTPU_MESH", m), ("KTPU_MESH_PODS", p),
+                     ("KTPU_MESH_NODES", n)):
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+        assert parse_mesh_request() == want, (m, p, n)
+    assert mesh_request_devices(None) == 1
+    assert mesh_request_devices(8) == 8
+    assert mesh_request_devices((2, 4)) == 8
+    for m, p in [("banana", None), ("-3", None), ("2x4x2", None),
+                 ("8", "3"), ("3x0", None)]:
+        monkeypatch.setenv("KTPU_MESH", m)
+        if p is None:
+            monkeypatch.delenv("KTPU_MESH_PODS", raising=False)
+        else:
+            monkeypatch.setenv("KTPU_MESH_PODS", p)
+        monkeypatch.delenv("KTPU_MESH_NODES", raising=False)
+        with pytest.raises(ValueError):
+            parse_mesh_request()
+
+
+def test_pipelined_loop_with_2d_mesh_matches_serial(mesh2x4):
+    """The double-buffered loop against the 2-D grid: verdicts
+    bit-identical to the unsharded serial oracle, and the resident
+    pod-scaling buffers really live SPLIT across the pods axis (the HBM
+    win is residency, not a transient)."""
+    from kubernetes_tpu.api.snapshot import Snapshot
+    from kubernetes_tpu.parallel.pipeline import PipelinedBatchLoop, run_serial
+    from helpers import mk_node, mk_pod
+
+    def wave(seed):
+        rng = np.random.default_rng(seed)
+        return Snapshot(
+            nodes=[mk_node(f"w{seed}-n{i}", cpu=int(rng.integers(2000, 8000)))
+                   for i in range(10)],
+            pending_pods=[mk_pod(f"w{seed}-p{j}",
+                                 cpu=int(rng.integers(100, 1500)))
+                          for j in range(16)],
+        )
+
+    waves = [wave(s) for s in range(4)]
+    oracle = list(run_serial(waves))
+    loop = PipelinedBatchLoop(depth=1, mesh=mesh2x4)
+    got = list(loop.run(waves))
+    assert got == oracle
+    assert loop.enc._dev, "resident device buffers should exist"
+    specs = {
+        name: ent[1].sharding.spec for name, ent in loop.enc._dev.items()
+    }
+    assert PODS_AXIS in (specs["pod_req"] or ()), specs["pod_req"]
+    assert NODE_AXIS in (specs["node_labels"] or ()), specs["node_labels"]
+
+
+def test_chaos_storm_with_2d_mesh(monkeypatch):
+    """Seeded chaos storm through the Scheduler batch path with the 2-D
+    grid armed (KTPU_MESH=2x4): placements bit-identical to the fault-free,
+    UNSHARDED serial oracle."""
+    from test_chaos import _churn_run
+
+    monkeypatch.delenv("KTPU_MESH", raising=False)
+    monkeypatch.delenv("KTPU_FORCE_CHUNKED", raising=False)
+    oracle, _ = _churn_run(pipeline=False)
+    monkeypatch.setenv("KTPU_MESH", "2x4")
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    import jax
+
+    jax.clear_caches()  # strict route proof: the storm must RE-compile
+    before = dict(TRACE_COUNTS)
+    got, sched = _churn_run(
+        pipeline=True,
+        plan=chaos.FaultPlan.from_seed(
+            20, sites=("scheduler.step", "host.stall"), n_faults=4
+        ),
+    )
+    assert got == oracle
+    assert sched.mesh is not None
+    assert dict(sched.mesh.shape) == {PODS_AXIS: 2, NODE_AXIS: 4}
+    assert (
+        TRACE_COUNTS["sharded_rounds"] > before["sharded_rounds"]
+        or TRACE_COUNTS["sharded_rounds_inc"] > before["sharded_rounds_inc"]
+    ), (before, TRACE_COUNTS)
+
+
+def test_kill_post_assume_crash_restart_on_2d_mesh(tmp_path, monkeypatch):
+    """kill -9 at post-assume/pre-checkpoint with the 2-D grid armed: the
+    restarted incarnation rebuilds the pod-sharded resident buffers from
+    the checkpoint + LIST and finishes bit-identical to the fault-free
+    oracle — sharded residency is never trusted across the kill."""
+    from test_crash_restart import _run
+
+    monkeypatch.delenv("KTPU_MESH", raising=False)
+    oracle, _, _ = _run(pipeline=False)
+    monkeypatch.setenv("KTPU_MESH", "2x4")
+    monkeypatch.setenv("KTPU_FORCE_CHUNKED", "1")
+    got, sched, restarts = _run(
+        chaos.FaultPlan.parse("kill.post_assume:kill@0"), ckpt_dir=tmp_path,
+    )
+    assert restarts >= 1
+    assert got == oracle
+    assert all(v for v in got.values())  # zero lost pods
+    assert sched.metrics.counters["scheduler_restarts_total"] >= 1

@@ -663,8 +663,10 @@ def test_streaming_runs_do_not_bleed_across_invocations():
     out1 = run_streaming_workload("a", waves, warmup=False)
     out2 = run_streaming_workload("b", waves, warmup=False)
     assert out1["sli_count"] == out2["sli_count"] == out1["n_pods"]
-    # route counters bump at jit-TRACE time: run 1 compiled (plain=1); run
-    # 2 hits the warm cache and must report ZERO — a bleed would carry
-    # run 1's count forward instead
-    assert out1["route_trace_counts"]["plain"] == 1
+    # route counters bump at jit-TRACE time: run 1 compiled (the serial
+    # reference traces the plain kernel and the metered pipelined pass
+    # traces its ordinals twin, so the exact count is a kernel census, not
+    # the property under test); run 2 hits the warm cache and must report
+    # ZERO — a bleed would carry run 1's count forward instead
+    assert out1["route_trace_counts"]["plain"] >= 1
     assert all(v == 0 for v in out2["route_trace_counts"].values())

@@ -4,7 +4,7 @@
 
 Ordering note (tier-1 runs -p no:randomly, so file order holds): the
 acceptance gate runs first and pays this module's ONE full shard pass
-(12-route trace, shared machinery with the device pass); every later test
+(18-route trace, shared machinery with the device pass); every later test
 reuses the cached report or builds synthetic RouteTraces."""
 
 import json
@@ -32,12 +32,16 @@ _PASS_CACHE = {}
 
 
 def _full_pass() -> Report:
-    """The one full shard pass this module pays for (12-route trace)."""
+    """The one full shard pass this module pays for, over the 18-route
+    trace shared with the device/mem modules (helpers.shared_route_traces)."""
     if "rep" not in _PASS_CACHE:
+        from helpers import shared_route_traces
+
         from kubernetes_tpu.analysis.__main__ import default_baseline
 
         _PASS_CACHE["rep"] = run_shard_pass(
-            baseline=Baseline.load(default_baseline()))
+            baseline=Baseline.load(default_baseline()),
+            pretraced=shared_route_traces())
     return _PASS_CACHE["rep"]
 
 
@@ -45,21 +49,33 @@ def _full_pass() -> Report:
 
 def test_committed_package_is_shard_pass_clean():
     """`python -m kubernetes_tpu.analysis --shard` exits 0 on the committed
-    package under the committed baseline: all 12 routes traced (no silent
-    skips), KTPU014/016/017/018 clean, and every KTPU015 finding carries a
-    REQUIRED non-TODO baseline reason naming the ROADMAP-3 follow-up."""
+    package under the committed baseline: all 18 routes traced (no silent
+    skips — the 2-D pods x nodes grid tripled the matrix), KTPU014/016/017/
+    018 clean, and the ROADMAP-3 replication debt is GONE: the pod axis
+    shards every former KTPU015 giant, so the committed baseline is empty
+    and zero KTPU015 findings fire at all."""
     rep = _full_pass()
     assert rep.errors == []
     assert rep.unbaselined == [], "\n".join(
         f.render() for f in rep.unbaselined)
     assert rep.exit_code == 0
-    assert rep.device["n_traced"] == 12 and rep.device["n_skipped"] == 0
-    baselined = [f for f in rep.findings if f.baselined]
-    assert baselined, "the known 3a replication debt must be tracked"
-    for f in baselined:
-        assert f.rule == "KTPU015"
-        assert not f.baseline_reason.upper().startswith("TODO")
-        assert "ROADMAP-3" in f.baseline_reason
+    assert rep.device["n_traced"] == 18 and rep.device["n_skipped"] == 0
+    assert [f for f in rep.findings if f.rule == "KTPU015"] == [], (
+        "the pods axis must shard every scaling giant somewhere in the "
+        "route matrix — a KTPU015 finding means replication debt returned")
+    assert [f for f in rep.findings if f.baselined] == [], (
+        "the 21-entry ROADMAP-3 baseline was burned to zero; nothing "
+        "should need baselining now")
+
+
+def test_committed_baseline_is_empty():
+    """Satellite acceptance: analysis/baseline.json dropped its 21 KTPU015
+    entries to 0 — the debt is paid by sharding, not waived by baseline."""
+    from kubernetes_tpu.analysis.__main__ import default_baseline
+
+    with open(default_baseline()) as f:
+        doc = json.load(f)
+    assert doc.get("findings") == []
 
 
 def test_every_route_carries_a_shard_report():
